@@ -1,0 +1,19 @@
+"""shadow_tpu: a TPU-native discrete-event network simulation framework.
+
+Capabilities modeled on Shadow (the hybrid emulation/simulation tool): execute
+real applications, interpose on their syscalls, and connect them through a
+deterministic simulated network. The network/transport plane runs as batched
+JAX/XLA kernels over hosts-as-SoA arrays on TPU; the syscall plane runs
+natively on CPU.
+
+Layout:
+  core/       time, units, RNG, events, config, round loop (controller/manager/worker)
+  host/       simulated machine: processes, threads, descriptors, syscalls, timers
+  net/        graph, routing, packets, router (CoDel), relay (token bucket), NIC
+  tcp/        pure dependency-injected TCP state machine + Reno congestion control
+  tpu/        the TPU network plane: SoA state, vmap'd round step, mesh sharding
+  interpose/  native C++ plane: shmem IPC, preload shim, seccomp interposition
+  utils/      byte queues, interval maps, counters, pcap
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,70 @@
+import pytest
+
+from shadow_tpu.core.event import Event, EventQueue, TaskRef
+
+
+def _task():
+    return TaskRef(lambda host: None)
+
+
+def test_time_order():
+    q = EventQueue()
+    q.push(Event.new_local(200, _task(), event_id=1))
+    q.push(Event.new_local(100, _task(), event_id=2))
+    q.push(Event.new_local(150, _task(), event_id=3))
+    assert [q.pop().time for _ in range(3)] == [100, 150, 200]
+
+
+def test_packet_before_local_at_equal_time():
+    # Parity: reference event.rs:102-110 — deliberate, affects determinism.
+    q = EventQueue()
+    q.push(Event.new_local(100, _task(), event_id=1))
+    q.push(Event.new_packet(100, "pkt", src_host_id=9, src_event_id=5))
+    first, second = q.pop(), q.pop()
+    assert first.is_packet and not second.is_packet
+
+
+def test_packet_tiebreak_by_src_host_then_event_id():
+    # Parity: event.rs:131-155.
+    q = EventQueue()
+    q.push(Event.new_packet(100, "c", src_host_id=2, src_event_id=1))
+    q.push(Event.new_packet(100, "b", src_host_id=1, src_event_id=7))
+    q.push(Event.new_packet(100, "a", src_host_id=1, src_event_id=3))
+    assert [q.pop().payload for _ in range(3)] == ["a", "b", "c"]
+
+
+def test_local_tiebreak_by_event_id():
+    # Parity: event.rs:163-184.
+    q = EventQueue()
+    q.push(Event.new_local(100, TaskRef(lambda h: None, "second"), event_id=12))
+    q.push(Event.new_local(100, TaskRef(lambda h: None, "first"), event_id=4))
+    assert q.pop().payload.name == "first"
+    assert q.pop().payload.name == "second"
+
+
+def test_monotonic_pop_assert():
+    # Parity: event_queue.rs:36-39 — pushing into the past after popping is a bug.
+    q = EventQueue()
+    q.push(Event.new_local(100, _task(), event_id=1))
+    assert q.pop().time == 100
+    q.push(Event.new_local(50, _task(), event_id=2))
+    with pytest.raises(AssertionError):
+        q.pop()
+
+
+def test_duplicate_sort_key_is_loud():
+    # Two events with an identical sort key violate the per-host uniqueness
+    # invariant; the queue must surface that, not a cryptic TypeError.
+    q = EventQueue()
+    q.push(Event.new_packet(100, "a", src_host_id=1, src_event_id=1))
+    with pytest.raises(AssertionError, match="duplicate event sort key"):
+        q.push(Event.new_packet(100, "b", src_host_id=1, src_event_id=1))
+
+
+def test_next_time_and_len():
+    q = EventQueue()
+    assert q.next_time() is None
+    assert not q
+    q.push(Event.new_local(42, _task(), event_id=1))
+    assert q.next_time() == 42
+    assert len(q) == 1

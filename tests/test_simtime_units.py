@@ -1,0 +1,52 @@
+from shadow_tpu.core import simtime, units
+
+
+def test_constants():
+    assert simtime.SECOND == 1_000_000_000
+    assert simtime.MILLISECOND * 1000 == simtime.SECOND
+    assert simtime.MINUTE == 60 * simtime.SECOND
+
+
+def test_emulated_epoch_is_y2k():
+    # 2000-01-01 UTC = 946684800 unix seconds
+    assert simtime.EMUTIME_SIMULATION_START_UNIX_NS == 946684800 * simtime.SECOND
+    assert simtime.emulated_from_sim(5 * simtime.SECOND) == (946684800 + 5) * simtime.SECOND
+    assert simtime.sim_from_emulated(simtime.emulated_from_sim(123)) == 123
+
+
+def test_fmt():
+    assert simtime.fmt(3 * simtime.SECOND + 42) == "00:00:03.000000042"
+
+
+def test_parse_durations():
+    assert units.parse_duration_ns("10 ms") == 10 * simtime.MILLISECOND
+    assert units.parse_duration_ns("2s") == 2 * simtime.SECOND
+    assert units.parse_duration_ns("1 minute") == simtime.MINUTE
+    assert units.parse_duration_ns("500 us") == 500 * simtime.MICROSECOND
+    assert units.parse_duration_ns(30) == 30 * simtime.SECOND  # bare = seconds
+    assert units.parse_duration_ns("1.5 ms") == 1_500_000
+    assert units.parse_duration_ns("10 m") == 10 * simtime.MINUTE
+
+
+def test_parse_bytes():
+    assert units.parse_bytes("16 MiB") == 16 * 2**20
+    assert units.parse_bytes("1 KB") == 1000
+    assert units.parse_bytes("10") == 10
+    assert units.parse_bytes("2 kib") == 2048
+    assert units.parse_bytes("16 kibibytes") == 16 * 1024
+
+
+def test_parse_rates():
+    assert units.parse_bits_per_sec("1 Gbit") == 10**9
+    assert units.parse_bits_per_sec("100 Mbit") == 10**8
+    assert units.parse_bits_per_sec("10 Mbps") == 10**7
+    assert units.parse_bits_per_sec("1 megabit") == 10**6
+
+
+def test_parse_errors():
+    import pytest
+
+    with pytest.raises(units.UnitParseError):
+        units.parse_duration_ns("10 parsecs")
+    with pytest.raises(units.UnitParseError):
+        units.parse_bytes("x")
