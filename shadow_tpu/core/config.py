@@ -1,0 +1,372 @@
+"""Simulation configuration: typed options, YAML loading, merge semantics.
+
+Parity with the reference's three-layer config system
+(`src/main/core/configuration.rs`):
+- a YAML file provides `general`, `network`, `experimental`, `host_defaults`,
+  and `hosts` sections (`configuration.rs:93`);
+- CLI/programmatic overrides win field-by-field over the file, which wins
+  over defaults (`configuration.rs:112-196`);
+- `x-`-prefixed top-level extension keys are ignored so configs can hold YAML
+  anchors (`shadow.rs:366-385`); standard YAML merge keys (`<<`) are resolved
+  by the YAML loader;
+- durations/sizes/rates accept typed units ("10s", "1 Gbit");
+- the fully-resolved config can be re-serialized for reproducibility
+  (`manager.rs:182-193`).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import yaml
+
+from . import simtime, units
+
+
+class ConfigError(ValueError):
+    pass
+
+
+class LogLevel(enum.IntEnum):
+    ERROR = 0
+    WARNING = 1
+    INFO = 2
+    DEBUG = 3
+    TRACE = 4
+
+    @staticmethod
+    def parse(text: str) -> "LogLevel":
+        try:
+            return LogLevel[text.upper()]
+        except KeyError:
+            raise ConfigError(f"unknown log level: {text!r}") from None
+
+
+class QDiscMode(enum.Enum):
+    """NIC queuing discipline (`configuration.rs:961`)."""
+
+    FIFO = "fifo"
+    ROUND_ROBIN = "round-robin"
+
+
+class FinalState(enum.Enum):
+    """Expected process end state (`configuration.rs:614`)."""
+
+    RUNNING = "running"
+    EXITED = "exited"
+    SIGNALED = "signaled"
+
+
+@dataclass
+class ExpectedFinalState:
+    kind: FinalState = FinalState.EXITED
+    value: int = 0  # exit code or signal number
+
+    @staticmethod
+    def parse(raw: Any) -> "ExpectedFinalState":
+        if raw is None:
+            return ExpectedFinalState()
+        if isinstance(raw, str):
+            if raw == "running":
+                return ExpectedFinalState(FinalState.RUNNING, 0)
+            raise ConfigError(f"bad expected_final_state: {raw!r}")
+        if isinstance(raw, dict) and len(raw) == 1:
+            ((k, v),) = raw.items()
+            if k == "exited":
+                return ExpectedFinalState(FinalState.EXITED, int(v))
+            if k == "signaled":
+                return ExpectedFinalState(FinalState.SIGNALED, int(v))
+        raise ConfigError(f"bad expected_final_state: {raw!r}")
+
+
+@dataclass
+class GeneralOptions:
+    """`configuration.rs:197` GeneralOptions."""
+
+    stop_time: int = 0  # ns; required
+    seed: int = 1
+    parallelism: int = 0  # 0 = auto (min(cores, hosts), manager.rs:248-298)
+    bootstrap_end_time: int = 0  # ns; rate limits/loss bypassed before this
+    log_level: LogLevel = LogLevel.INFO
+    heartbeat_interval: Optional[int] = simtime.SECOND  # ns
+    data_directory: str = "shadow.data"
+    template_directory: Optional[str] = None
+    progress: bool = False
+    model_unblocked_syscall_latency: bool = False
+
+
+@dataclass
+class GraphSource:
+    """`network.graph` — built-in or GML by file/inline."""
+
+    type: str = "gml"  # "gml" | "1_gbit_switch"
+    file_path: Optional[str] = None
+    inline: Optional[str] = None
+
+
+@dataclass
+class NetworkOptions:
+    """`configuration.rs:282` NetworkOptions."""
+
+    graph: GraphSource = field(default_factory=GraphSource)
+    use_shortest_path: bool = True
+
+
+@dataclass
+class ExperimentalOptions:
+    """Subset of `configuration.rs:314-538` that is meaningful here; unknown
+    keys are rejected loudly rather than silently dropped."""
+
+    runahead: int = simtime.MILLISECOND  # lower bound on window size
+    use_dynamic_runahead: bool = False
+    interface_qdisc: QDiscMode = QDiscMode.FIFO
+    socket_send_buffer: int = 131072
+    socket_send_autotune: bool = True
+    socket_recv_buffer: int = 174760
+    socket_recv_autotune: bool = True
+    use_cpu_pinning: bool = True
+    use_worker_spinning: bool = True
+    use_memory_manager: bool = False
+    use_new_tcp: bool = False
+    max_unapplied_cpu_latency: int = simtime.MICROSECOND
+    unblocked_syscall_latency: int = simtime.MICROSECOND
+    unblocked_vdso_latency: int = 10 * simtime.NANOSECOND
+    host_heartbeat_interval: Optional[int] = simtime.SECOND
+    strace_logging_mode: str = "off"  # off | standard | deterministic
+    scheduler: str = "thread-per-core"  # thread-per-core | thread-per-host | serial
+    use_tpu_net_plane: bool = True  # offload router/relay/latency/loss to TPU
+    tpu_devices: Optional[int] = None  # None = all visible devices
+
+
+@dataclass
+class HostDefaultOptions:
+    """`configuration.rs:551` — per-host options with global defaults.
+
+    All fields default to None ("unset") so an explicit per-host value — even
+    one equal to the global default, like `pcap_enabled: false` overriding a
+    global `true` — is distinguishable from "not specified".
+    """
+
+    log_level: Optional[LogLevel] = None
+    pcap_enabled: Optional[bool] = None
+    pcap_capture_size: Optional[int] = None
+
+    def merged_with(self, override: "HostDefaultOptions") -> "HostDefaultOptions":
+        out = copy.copy(self)
+        for f in dataclasses.fields(override):
+            v = getattr(override, f.name)
+            if v is not None:
+                setattr(out, f.name, v)
+        return out
+
+    def resolved(self) -> "HostDefaultOptions":
+        """Concrete values with hard defaults filled in for unset fields."""
+        out = copy.copy(self)
+        if out.pcap_enabled is None:
+            out.pcap_enabled = False
+        if out.pcap_capture_size is None:
+            out.pcap_capture_size = 65535
+        return out
+
+
+@dataclass
+class ProcessOptions:
+    """`configuration.rs:644` ProcessOptions."""
+
+    path: str = ""
+    args: list[str] = field(default_factory=list)
+    environment: dict[str, str] = field(default_factory=dict)
+    start_time: int = 0  # ns
+    shutdown_time: Optional[int] = None  # ns
+    shutdown_signal: int = 15  # SIGTERM
+    expected_final_state: ExpectedFinalState = field(default_factory=ExpectedFinalState)
+
+
+@dataclass
+class HostOptions:
+    """`configuration.rs:675` HostOptions."""
+
+    network_node_id: int = 0
+    processes: list[ProcessOptions] = field(default_factory=list)
+    ip_addr: Optional[str] = None
+    bandwidth_down: Optional[int] = None  # bits/sec; overrides graph node
+    bandwidth_up: Optional[int] = None
+    host_options: HostDefaultOptions = field(default_factory=HostDefaultOptions)
+
+
+@dataclass
+class ConfigOptions:
+    general: GeneralOptions = field(default_factory=GeneralOptions)
+    network: NetworkOptions = field(default_factory=NetworkOptions)
+    experimental: ExperimentalOptions = field(default_factory=ExperimentalOptions)
+    host_defaults: HostDefaultOptions = field(default_factory=HostDefaultOptions)
+    hosts: dict[str, HostOptions] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Parsing
+# ---------------------------------------------------------------------------
+
+_DUR_FIELDS = {
+    "stop_time",
+    "bootstrap_end_time",
+    "heartbeat_interval",
+    "start_time",
+    "shutdown_time",
+    "runahead",
+    "max_unapplied_cpu_latency",
+    "unblocked_syscall_latency",
+    "unblocked_vdso_latency",
+    "host_heartbeat_interval",
+}
+_RATE_FIELDS = {"bandwidth_down", "bandwidth_up"}
+_BYTE_FIELDS = {"socket_send_buffer", "socket_recv_buffer", "pcap_capture_size"}
+
+
+def _coerce(name: str, value: Any, target_type: Any) -> Any:
+    if value is None:
+        return None
+    if name in _DUR_FIELDS:
+        return units.parse_duration_ns(value)
+    if name in _RATE_FIELDS:
+        return units.parse_bits_per_sec(value)
+    if name in _BYTE_FIELDS:
+        return units.parse_bytes(value)
+    if name == "log_level":
+        return LogLevel.parse(value)
+    if name == "interface_qdisc":
+        return QDiscMode(value)
+    if name == "expected_final_state":
+        return ExpectedFinalState.parse(value)
+    if name == "args":
+        return value.split() if isinstance(value, str) else [str(a) for a in value]
+    if name == "environment":
+        return {str(k): str(v) for k, v in (value or {}).items()}
+    return value
+
+
+def _fill_dataclass(cls, raw: dict, where: str):
+    if raw is None:
+        raw = {}
+    if not isinstance(raw, dict):
+        raise ConfigError(f"{where}: expected a mapping, got {type(raw).__name__}")
+    known = {f.name: f for f in dataclasses.fields(cls)}
+    obj = cls()
+    for key, value in raw.items():
+        key = str(key)
+        if key.startswith("x-"):
+            continue
+        if key not in known:
+            raise ConfigError(f"{where}: unknown option {key!r}")
+        f = known[key]
+        if f.name == "graph":
+            setattr(obj, key, _parse_graph(value))
+        elif f.name == "processes":
+            setattr(
+                obj,
+                key,
+                [_fill_dataclass(ProcessOptions, p, f"{where}.processes[{i}]")
+                 for i, p in enumerate(value or [])],
+            )
+        elif f.name == "host_options":
+            setattr(obj, key, _fill_dataclass(HostDefaultOptions, value, f"{where}.host_options"))
+        else:
+            setattr(obj, key, _coerce(key, value, f.type))
+    return obj
+
+
+def _parse_graph(raw: dict) -> GraphSource:
+    if not isinstance(raw, dict) or "type" not in raw:
+        raise ConfigError("network.graph: requires a 'type'")
+    g = GraphSource(type=raw["type"])
+    if g.type == "gml":
+        g.file_path = raw.get("file", {}).get("path") if isinstance(raw.get("file"), dict) else raw.get("file")
+        g.inline = raw.get("inline")
+        if (g.file_path is None) == (g.inline is None):
+            raise ConfigError("network.graph: gml needs exactly one of 'file' or 'inline'")
+    elif g.type != "1_gbit_switch":
+        raise ConfigError(f"network.graph: unknown type {g.type!r}")
+    return g
+
+
+def parse_config_dict(raw: dict) -> ConfigOptions:
+    if not isinstance(raw, dict):
+        raise ConfigError("config root must be a mapping")
+    cfg = ConfigOptions()
+    for key, value in raw.items():
+        key = str(key)
+        if key.startswith("x-"):
+            continue  # extension keys hold YAML anchors (shadow.rs:366-385)
+        if key == "general":
+            cfg.general = _fill_dataclass(GeneralOptions, value, "general")
+        elif key == "network":
+            cfg.network = _fill_dataclass(NetworkOptions, value, "network")
+        elif key == "experimental":
+            cfg.experimental = _fill_dataclass(ExperimentalOptions, value, "experimental")
+        elif key in ("host_defaults", "host_option_defaults"):
+            cfg.host_defaults = _fill_dataclass(HostDefaultOptions, value, key)
+        elif key == "hosts":
+            for name, hraw in (value or {}).items():
+                _validate_hostname(name)
+                cfg.hosts[str(name)] = _fill_dataclass(HostOptions, hraw, f"hosts.{name}")
+        else:
+            raise ConfigError(f"unknown top-level config section {key!r}")
+    if cfg.general.stop_time <= 0:
+        raise ConfigError("general.stop_time is required and must be positive")
+    if not cfg.hosts:
+        raise ConfigError("at least one host is required")
+    return cfg
+
+
+def _validate_hostname(name: str) -> None:
+    if not name or not all(c.isalnum() or c in ".-_" for c in str(name)):
+        raise ConfigError(f"invalid hostname {name!r}")
+
+
+def load_config_file(path: str, overrides: Optional[dict] = None) -> ConfigOptions:
+    with open(path) as fh:
+        raw = yaml.safe_load(fh)
+    return parse_config(raw, overrides)
+
+
+def load_config_str(text: str, overrides: Optional[dict] = None) -> ConfigOptions:
+    return parse_config(yaml.safe_load(text), overrides)
+
+
+def parse_config(raw: dict, overrides: Optional[dict] = None) -> ConfigOptions:
+    """Parse a raw config mapping, applying CLI-style overrides field-by-field
+    (overrides win over file values, which win over defaults)."""
+    if overrides:
+        raw = _deep_merge(copy.deepcopy(raw), overrides)
+    return parse_config_dict(raw)
+
+
+def _deep_merge(base: dict, over: dict) -> dict:
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            _deep_merge(base[k], v)
+        else:
+            base[k] = v
+    return base
+
+
+def to_processed_dict(cfg: ConfigOptions) -> dict:
+    """Fully-resolved config as plain data, suitable for re-serialization to
+    `processed-config.yaml` (`manager.rs:182-193`)."""
+
+    def conv(obj):
+        if dataclasses.is_dataclass(obj):
+            return {f.name: conv(getattr(obj, f.name)) for f in dataclasses.fields(obj)}
+        if isinstance(obj, enum.Enum):
+            return obj.name.lower() if isinstance(obj, LogLevel) else obj.value
+        if isinstance(obj, dict):
+            return {k: conv(v) for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [conv(v) for v in obj]
+        return obj
+
+    return conv(cfg)

@@ -20,7 +20,7 @@ earlier than one already popped is a simulation bug, never silently allowed.
 from __future__ import annotations
 
 import heapq
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 # EventData discriminants; packet < local so packets win time ties.
@@ -53,7 +53,7 @@ class TaskRef:
         return f"TaskRef({self.name})"
 
 
-@dataclass
+@dataclass(eq=False)
 class Event:
     """A scheduled occurrence on one host.
 
@@ -117,9 +117,12 @@ class EventQueue:
         if not self._heap:
             return None
         key, event = heapq.heappop(self._heap)
-        if self._last_popped is not None and key < self._last_popped:
+        # Keys are unique by contract, so equality is as much a bug as going
+        # backwards (it means a duplicate (src_host, event_id) slipped past the
+        # push-time guard, e.g. the same Event object pushed twice).
+        if self._last_popped is not None and key <= self._last_popped:
             raise AssertionError(
-                f"non-monotonic event pop: {key} after {self._last_popped}"
+                f"non-monotonic or duplicate event pop: {key} after {self._last_popped}"
             )
         self._last_popped = key
         return event

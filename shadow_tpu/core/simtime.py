@@ -21,9 +21,6 @@ SECOND = 1_000_000_000
 MINUTE = 60 * SECOND
 HOUR = 60 * MINUTE
 
-# SimulationTime: ns since simulation start. u64 range checked at boundaries.
-SIM_TIME_MAX = (1 << 64) - 1
-
 # EmulatedTime epoch: what sim-time zero looks like to managed applications.
 # 2000-01-01T00:00:00Z expressed as ns since the UNIX epoch.
 EMUTIME_SIMULATION_START_UNIX_NS = int(

@@ -78,6 +78,7 @@ def _build_scaled(suffixes: tuple[str, ...]) -> dict[str, int]:
 _BYTE_UNITS = _build_scaled(("B", "byte", "bytes"))
 _BYTE_UNITS[""] = 1
 _BIT_UNITS = _build_scaled(("bit", "bits", "b"))
+_BIT_UNITS[""] = 1  # bare numbers mean bits/sec, like bare bytes/durations
 
 
 class UnitParseError(ValueError):
@@ -115,7 +116,10 @@ def parse_bytes(text: str | int | float) -> int:
 def parse_bits_per_sec(text: str | int | float) -> int:
     """Parse a bandwidth ('1 Gbit', '10 Mbit', '100 Mbps') into bits/second."""
     num, unit = _split(text)
-    if unit.endswith("ps"):
+    # "Mbps"-style spellings: strip the per-second suffix, but only when a
+    # unit remains — a bare "ps" (e.g. a picosecond duration misplaced in a
+    # rate field) must stay an error, not parse as dimensionless bits/sec.
+    if unit.endswith("ps") and len(unit) > 2:
         unit = unit[:-2]
     try:
         scale = _BIT_UNITS[unit]

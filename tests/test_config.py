@@ -1,0 +1,173 @@
+import pytest
+
+from shadow_tpu.core import config, simtime
+from shadow_tpu.core.config import (
+    ConfigError,
+    FinalState,
+    LogLevel,
+    QDiscMode,
+    load_config_str,
+    to_processed_dict,
+)
+
+BASIC = """
+general:
+  stop_time: 10s
+  model_unblocked_syscall_latency: true
+network:
+  graph:
+    type: 1_gbit_switch
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - path: python3
+      args: -m http.server 80
+      start_time: 3s
+      expected_final_state: running
+  client1: &client_host
+    network_node_id: 0
+    processes:
+    - path: curl
+      args: -s server
+      start_time: 5s
+  client2: *client_host
+  client3: *client_host
+"""
+
+
+def test_basic_file_transfer_shape():
+    cfg = load_config_str(BASIC)
+    assert cfg.general.stop_time == 10 * simtime.SECOND
+    assert cfg.general.model_unblocked_syscall_latency is True
+    assert cfg.network.graph.type == "1_gbit_switch"
+    assert set(cfg.hosts) == {"server", "client1", "client2", "client3"}
+    srv = cfg.hosts["server"].processes[0]
+    assert srv.path == "python3"
+    assert srv.args == ["-m", "http.server", "80"]
+    assert srv.start_time == 3 * simtime.SECOND
+    assert srv.expected_final_state.kind == FinalState.RUNNING
+    # YAML anchors give clients identical process lists
+    assert cfg.hosts["client2"].processes[0].path == "curl"
+
+
+def test_inline_gml_and_bare_seconds():
+    cfg = load_config_str(
+        """
+general:
+  stop_time: 300
+network:
+  graph:
+    type: gml
+    inline: "graph []"
+hosts:
+  a: {network_node_id: 0}
+"""
+    )
+    assert cfg.general.stop_time == 300 * simtime.SECOND
+    assert cfg.network.graph.inline == "graph []"
+
+
+def test_overrides_win_over_file():
+    cfg = load_config_str(BASIC, overrides={"general": {"seed": 99, "stop_time": "5s"}})
+    assert cfg.general.seed == 99
+    assert cfg.general.stop_time == 5 * simtime.SECOND
+    # untouched fields keep file/default values
+    assert cfg.general.model_unblocked_syscall_latency is True
+
+
+def test_extension_keys_ignored():
+    cfg = load_config_str(
+        """
+x-anchors:
+  common: {foo: 1}
+general:
+  stop_time: 1s
+hosts:
+  a: {network_node_id: 0}
+"""
+    )
+    assert "a" in cfg.hosts
+
+
+def test_unknown_keys_rejected():
+    with pytest.raises(ConfigError, match="unknown option"):
+        load_config_str("general: {stop_time: 1s, frobnicate: 2}\nhosts: {a: {}}")
+    with pytest.raises(ConfigError, match="unknown top-level"):
+        load_config_str("general: {stop_time: 1s}\nbogus: {}\nhosts: {a: {}}")
+
+
+def test_required_fields():
+    with pytest.raises(ConfigError, match="stop_time"):
+        load_config_str("hosts: {a: {}}")
+    with pytest.raises(ConfigError, match="at least one host"):
+        load_config_str("general: {stop_time: 1s}")
+
+
+def test_expected_final_state_forms():
+    cfg = load_config_str(
+        """
+general: {stop_time: 1s}
+hosts:
+  a:
+    processes:
+    - {path: /bin/true, expected_final_state: {exited: 3}}
+    - {path: /bin/kill, expected_final_state: {signaled: 9}}
+"""
+    )
+    p0, p1 = cfg.hosts["a"].processes
+    assert (p0.expected_final_state.kind, p0.expected_final_state.value) == (FinalState.EXITED, 3)
+    assert (p1.expected_final_state.kind, p1.expected_final_state.value) == (FinalState.SIGNALED, 9)
+
+
+def test_experimental_and_host_defaults():
+    cfg = load_config_str(
+        """
+general: {stop_time: 1s, log_level: debug}
+experimental:
+  runahead: 5ms
+  interface_qdisc: round-robin
+  use_dynamic_runahead: true
+host_defaults:
+  pcap_enabled: true
+hosts:
+  a:
+    bandwidth_down: 100 Mbit
+    bandwidth_up: 50 Mbit
+"""
+    )
+    assert cfg.general.log_level == LogLevel.DEBUG
+    assert cfg.experimental.runahead == 5 * simtime.MILLISECOND
+    assert cfg.experimental.interface_qdisc == QDiscMode.ROUND_ROBIN
+    assert cfg.host_defaults.pcap_enabled is True
+    assert cfg.hosts["a"].bandwidth_down == 10**8
+    assert cfg.hosts["a"].bandwidth_up == 5 * 10**7
+
+
+def test_graph_validation():
+    with pytest.raises(ConfigError, match="exactly one"):
+        load_config_str(
+            "general: {stop_time: 1s}\nnetwork: {graph: {type: gml}}\nhosts: {a: {}}"
+        )
+    with pytest.raises(ConfigError, match="unknown type"):
+        load_config_str(
+            "general: {stop_time: 1s}\nnetwork: {graph: {type: petersen}}\nhosts: {a: {}}"
+        )
+
+
+def test_processed_config_roundtrip():
+    cfg = load_config_str(BASIC)
+    d = to_processed_dict(cfg)
+    assert d["general"]["stop_time"] == 10 * simtime.SECOND
+    assert d["hosts"]["server"]["processes"][0]["path"] == "python3"
+    # must be YAML-serializable
+    import yaml
+
+    yaml.safe_dump(d)
+
+
+def test_hostname_validation():
+    with pytest.raises(ConfigError, match="invalid hostname"):
+        load_config_str("general: {stop_time: 1s}\nhosts: {'bad host!': {}}")
+    cfg = load_config_str("general: {stop_time: 1s}\nhosts: {'lossy.tcpserver.echo': {}}")
+    assert "lossy.tcpserver.echo" in cfg.hosts

@@ -1,0 +1,49 @@
+"""Duplicate/ordering edge cases surfaced by review: same-object duplicates
+and post-pop duplicate keys must be caught, not silently processed."""
+
+import pytest
+
+from shadow_tpu.core.event import Event, EventQueue, TaskRef
+
+
+def test_same_object_pushed_twice_caught_at_pop():
+    q = EventQueue()
+    e = Event.new_packet(100, "pkt", src_host_id=1, src_event_id=1)
+    q.push(e)
+    q.push(e)  # identity-equal: push-time comparison cannot distinguish
+    q.pop()
+    with pytest.raises(AssertionError, match="duplicate"):
+        q.pop()
+
+
+def test_duplicate_key_after_pop_caught():
+    q = EventQueue()
+    q.push(Event.new_packet(100, "p1", src_host_id=1, src_event_id=1))
+    q.pop()
+    q.push(Event.new_packet(100, "p2", src_host_id=1, src_event_id=1))
+    with pytest.raises(AssertionError, match="duplicate"):
+        q.pop()
+
+
+def test_equal_key_distinct_payloads_caught_at_push():
+    q = EventQueue()
+    q.push(Event.new_packet(100, "a", src_host_id=1, src_event_id=1))
+    with pytest.raises(AssertionError, match="duplicate event sort key"):
+        q.push(Event.new_packet(100, "b", src_host_id=1, src_event_id=1))
+
+
+def test_array_like_payloads_do_not_break_comparisons():
+    import numpy as np
+
+    q = EventQueue()
+    q.push(Event.new_packet(100, np.array([1, 2]), src_host_id=1, src_event_id=1))
+    q.push(Event.new_packet(100, np.array([1, 2]), src_host_id=2, src_event_id=1))
+    assert q.pop().key[0] == 1
+    assert q.pop().key[0] == 2
+
+
+def test_bare_rate_numbers():
+    from shadow_tpu.core import units
+
+    assert units.parse_bits_per_sec(10**9) == 10**9
+    assert units.parse_bits_per_sec("500") == 500

@@ -1,0 +1,200 @@
+import lzma
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core import simtime
+from shadow_tpu.net import gml
+from shadow_tpu.net.graph import (
+    ONE_GBIT_SWITCH_GRAPH,
+    GraphError,
+    IpAssignment,
+    NetworkGraph,
+    build_routing,
+    load_graph_text,
+)
+
+
+def _line_graph(loss_ab=0.1, loss_bc=0.2, extra=""):
+    # a(0) -- b(1) -- c(2), self-loops everywhere
+    return NetworkGraph.parse(
+        f"""
+graph [
+  directed 0
+  node [ id 0 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+  node [ id 1 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+  node [ id 2 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+  edge [ source 0 target 0 latency "1 ms" ]
+  edge [ source 1 target 1 latency "1 ms" ]
+  edge [ source 2 target 2 latency "1 ms" ]
+  edge [ source 0 target 1 latency "10 ms" packet_loss {loss_ab} ]
+  edge [ source 1 target 2 latency "20 ms" packet_loss {loss_bc} ]
+  {extra}
+]
+"""
+    )
+
+
+def test_gml_parser_basics():
+    g = gml.parse('graph [ directed 1 node [ id 7 label "x" ] edge [ source 7 target 7 latency "1ms" ] ]')
+    assert g.get("directed") == 1
+    (node,) = g.get_all("node")
+    assert node.get("id") == 7 and node.get("label") == "x"
+
+
+def test_gml_comments_and_errors():
+    g = gml.parse("graph [ # comment\n directed 0 ]")
+    assert g.get("directed") == 0
+    with pytest.raises(gml.GmlError):
+        gml.parse("nothing here")
+    with pytest.raises(gml.GmlError):
+        gml.parse("graph [ key @bad ]")
+
+
+def test_builtin_switch_graph():
+    g = NetworkGraph.parse(ONE_GBIT_SWITCH_GRAPH)
+    assert len(g.nodes) == 1
+    assert g.nodes[0].bandwidth_up == 10**9
+    lat, loss = g.compute_shortest_paths([0])
+    assert lat[0, 0] == simtime.MILLISECOND
+    assert loss[0, 0] == 0.0
+
+
+def test_shortest_path_composition():
+    g = _line_graph()
+    lat, loss = g.compute_shortest_paths([0, 1, 2])
+    assert lat[0, 2] == 30 * simtime.MILLISECOND
+    # loss composes: 1 - (1-0.1)(1-0.2) = 0.28
+    assert loss[0, 2] == pytest.approx(0.28, abs=1e-6)
+    # symmetric (undirected)
+    assert lat[2, 0] == lat[0, 2]
+    # node->node uses the self-loop, not zero (graph/mod.rs:210-217)
+    assert lat[1, 1] == simtime.MILLISECOND
+
+
+def test_shortest_path_prefers_lower_latency_then_loss():
+    # two a-c routes with equal latency, different loss: pick lower loss
+    g = _line_graph(
+        extra='edge [ source 0 target 2 latency "30 ms" packet_loss 0.5 ]'
+    )
+    lat, loss = g.compute_shortest_paths([0, 2])
+    assert lat[0, 1] == 30 * simtime.MILLISECOND
+    assert loss[0, 1] == pytest.approx(0.28, abs=1e-6)
+    # and a strictly faster direct edge wins regardless of loss
+    g2 = _line_graph(extra='edge [ source 0 target 2 latency "5 ms" packet_loss 0.9 ]')
+    lat2, loss2 = g2.compute_shortest_paths([0, 2])
+    assert lat2[0, 1] == 5 * simtime.MILLISECOND
+    assert loss2[0, 1] == pytest.approx(0.9, abs=1e-6)
+
+
+def test_unused_nodes_still_relay():
+    # only endpoints used; middle node still relays traffic
+    g = _line_graph()
+    lat, _ = g.compute_shortest_paths([0, 2])
+    assert lat.shape == (2, 2)
+    assert lat[0, 1] == 30 * simtime.MILLISECOND
+
+
+def test_missing_self_loop_is_error():
+    g = NetworkGraph.parse(
+        """
+graph [ directed 0
+  node [ id 0 ] node [ id 1 ]
+  edge [ source 0 target 1 latency "10 ms" ]
+]
+"""
+    )
+    with pytest.raises(GraphError, match="self-loop"):
+        g.compute_shortest_paths([0, 1])
+
+
+def test_disconnected_is_error():
+    g = NetworkGraph.parse(
+        """
+graph [ directed 0
+  node [ id 0 ] node [ id 1 ]
+  edge [ source 0 target 0 latency "1 ms" ]
+  edge [ source 1 target 1 latency "1 ms" ]
+]
+"""
+    )
+    with pytest.raises(GraphError, match="no path"):
+        g.compute_shortest_paths([0, 1])
+
+
+def test_direct_paths():
+    g = _line_graph()
+    lat, loss = g.get_direct_paths([0, 1])
+    assert lat[0, 1] == 10 * simtime.MILLISECOND
+    # 0-2 has no direct edge
+    with pytest.raises(GraphError, match="exactly one edge"):
+        g.get_direct_paths([0, 2])
+
+
+def test_directed_graph():
+    g = NetworkGraph.parse(
+        """
+graph [ directed 1
+  node [ id 0 ] node [ id 1 ]
+  edge [ source 0 target 0 latency "1 ms" ]
+  edge [ source 1 target 1 latency "1 ms" ]
+  edge [ source 0 target 1 latency "10 ms" ]
+  edge [ source 1 target 0 latency "99 ms" ]
+]
+"""
+    )
+    lat, _ = g.compute_shortest_paths([0, 1])
+    assert lat[0, 1] == 10 * simtime.MILLISECOND
+    assert lat[1, 0] == 99 * simtime.MILLISECOND
+
+
+def test_edge_validation():
+    with pytest.raises(GraphError, match="must not be 0"):
+        NetworkGraph.parse(
+            'graph [ node [ id 0 ] edge [ source 0 target 0 latency "0 ms" ] ]'
+        )
+    with pytest.raises(GraphError, match="doesn't exist"):
+        NetworkGraph.parse(
+            'graph [ node [ id 0 ] edge [ source 0 target 5 latency "1 ms" ] ]'
+        )
+    with pytest.raises(GraphError, match="latency"):
+        NetworkGraph.parse("graph [ node [ id 0 ] edge [ source 0 target 0 ] ]")
+
+
+def test_ip_assignment():
+    ips = IpAssignment()
+    first = ips.assign_auto(0)
+    assert first == "11.0.0.1"
+    # skip .0 and .255
+    seen = {first}
+    for _ in range(600):
+        ip = ips.assign_auto(0)
+        assert not ip.endswith(".0") and not ip.endswith(".255")
+        assert ip not in seen
+        seen.add(ip)
+    ips.assign_manual("192.168.1.5", 3)
+    with pytest.raises(GraphError, match="previously assigned"):
+        ips.assign_manual("192.168.1.5", 4)
+    assert ips.node_for("192.168.1.5") == 3
+    assert ips.node_for("11.0.0.1") == 0
+    assert ips.node_for("10.9.9.9") is None
+
+
+def test_routing_info():
+    g = _line_graph()
+    ri = build_routing(g, [0, 2, 0], use_shortest_path=True)  # dup deduped
+    assert ri.used_ids == [0, 2]
+    p = ri.path(0, 2)
+    assert p.latency_ns == 30 * simtime.MILLISECOND
+    assert ri.get_smallest_latency_ns() == simtime.MILLISECOND  # self-loops
+    ri.increment_packet_count(0, 2)
+    ri.increment_packet_count(0, 2, 5)
+    assert ri.packet_counters[0, 1] == 6
+
+
+def test_compressed_graph(tmp_path):
+    p = tmp_path / "g.gml.xz"
+    with lzma.open(p, "wt") as fh:
+        fh.write(ONE_GBIT_SWITCH_GRAPH)
+    g = NetworkGraph.parse(load_graph_text(str(p)))
+    assert len(g.nodes) == 1
