@@ -227,7 +227,7 @@ _RATE_FIELDS = {"bandwidth_down", "bandwidth_up"}
 _BYTE_FIELDS = {"socket_send_buffer", "socket_recv_buffer", "pcap_capture_size"}
 
 
-def _coerce(name: str, value: Any, target_type: Any) -> Any:
+def _coerce(name: str, value: Any, default: Any) -> Any:
     if value is None:
         return None
     if name in _DUR_FIELDS:
@@ -246,6 +246,17 @@ def _coerce(name: str, value: Any, target_type: Any) -> Any:
         return value.split() if isinstance(value, str) else [str(a) for a in value]
     if name == "environment":
         return {str(k): str(v) for k, v in (value or {}).items()}
+    # Scalar fields: validate against the type of the field's default so a
+    # wrong-typed YAML value fails here, not deep inside the simulation.
+    if isinstance(default, bool):
+        if not isinstance(value, bool):
+            raise ConfigError(f"{name}: expected a boolean, got {value!r}")
+    elif isinstance(default, int):
+        if isinstance(value, bool) or not isinstance(value, int):
+            raise ConfigError(f"{name}: expected an integer, got {value!r}")
+    elif isinstance(default, str):
+        if not isinstance(value, str):
+            raise ConfigError(f"{name}: expected a string, got {value!r}")
     return value
 
 
@@ -275,7 +286,7 @@ def _fill_dataclass(cls, raw: dict, where: str):
         elif f.name == "host_options":
             setattr(obj, key, _fill_dataclass(HostDefaultOptions, value, f"{where}.host_options"))
         else:
-            setattr(obj, key, _coerce(key, value, f.type))
+            setattr(obj, key, _coerce(key, value, getattr(obj, f.name)))
     return obj
 
 
@@ -339,7 +350,15 @@ def load_config_str(text: str, overrides: Optional[dict] = None) -> ConfigOption
 
 def parse_config(raw: dict, overrides: Optional[dict] = None) -> ConfigOptions:
     """Parse a raw config mapping, applying CLI-style overrides field-by-field
-    (overrides win over file values, which win over defaults)."""
+    (overrides win over file values, which win over defaults).
+
+    Overrides use the same YAML-level value forms as the file: durations are
+    unit strings ("10s") or bare numbers meaning SECONDS (reference parity:
+    `stop_time: 10` in the reference's own configs means 10 s) — never raw
+    nanosecond ints.
+    """
+    if raw is None:
+        raw = {}  # empty YAML document; required-field errors fire below
     if overrides:
         raw = _deep_merge(copy.deepcopy(raw), overrides)
     return parse_config_dict(raw)

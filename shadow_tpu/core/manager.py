@@ -1,0 +1,180 @@
+"""The simulation driver: builds hosts from config, runs the round loop.
+
+Parity: reference `src/main/core/manager.rs` — builds hosts (`build_host`,
+`manager.rs:551`), shuffles them for thread assignment (`manager.rs:272`),
+picks parallelism = min(cores, hosts) (`manager.rs:248-298`), runs the
+boot → scheduling-loop → shutdown phases (`manager.rs:357-489`), and merges
+worker stats. The Controller supplies each next window from the global min
+next-event time (`controller.rs:80-113`).
+"""
+
+from __future__ import annotations
+
+import os
+import time as _walltime
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..host.host import Host
+from ..net import graph as netgraph
+from ..net.dns import Dns
+from .config import ConfigOptions
+from .controller import Controller, Runahead
+from .rng import Xoshiro256pp, host_seed_for
+from .scheduler import make_scheduler
+from .worker import WorkerShared
+
+
+@dataclass
+class SimStats:
+    """Merged end-of-run statistics (`sim_stats.rs`, `manager.rs:523-546`)."""
+
+    rounds: int = 0
+    events_executed: int = 0
+    packets_sent: int = 0
+    packets_dropped: int = 0
+    sim_time_ns: int = 0
+    wall_seconds: float = 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "rounds": self.rounds,
+            "packets_sent": self.packets_sent,
+            "packets_dropped": self.packets_dropped,
+            "sim_time_ns": self.sim_time_ns,
+            "wall_seconds": self.wall_seconds,
+        }
+
+
+class Manager:
+    def __init__(self, config: ConfigOptions):
+        self.config = config
+        self.global_rng = Xoshiro256pp(config.general.seed)
+        self.dns = Dns()
+        self.hosts: list[Host] = []
+        self.hosts_by_name: dict[str, Host] = {}
+
+        # --- network graph + routing ---------------------------------------
+        gsrc = config.network.graph
+        if gsrc.type == "1_gbit_switch":
+            text = netgraph.ONE_GBIT_SWITCH_GRAPH
+        elif gsrc.inline is not None:
+            text = gsrc.inline
+        else:
+            text = netgraph.load_graph_text(gsrc.file_path)
+        self.graph = netgraph.NetworkGraph.parse(text)
+
+        used_nodes = [h.network_node_id for h in config.hosts.values()]
+        self.routing = netgraph.build_routing(
+            self.graph, used_nodes, config.network.use_shortest_path
+        )
+
+        # --- IP assignment + host seeds (config-declared order) -------------
+        ips = netgraph.IpAssignment()
+        host_plans = []
+        for name, opts in config.hosts.items():
+            if opts.ip_addr is not None:
+                ips.assign_manual(opts.ip_addr, opts.network_node_id)
+                ip = opts.ip_addr
+            else:
+                ip = ips.assign_auto(opts.network_node_id)
+            seed = host_seed_for(self.global_rng, name)
+            host_plans.append((name, opts, ip, seed))
+
+        # --- runahead from the routing table --------------------------------
+        min_latency = self.routing.get_smallest_latency_ns()
+        self.runahead = Runahead(
+            config.experimental.use_dynamic_runahead,
+            min_latency,
+            config.experimental.runahead,
+        )
+        self.controller = Controller(config.general.stop_time, self.runahead)
+
+        # --- hosts -----------------------------------------------------------
+        ip_to_host: dict[str, Host] = {}
+        ip_to_node: dict[str, int] = {}
+        for host_id, (name, opts, ip, seed) in enumerate(host_plans, start=1):
+            node = self.graph.node_by_id(opts.network_node_id)
+            bw_down = opts.bandwidth_down or node.bandwidth_down
+            bw_up = opts.bandwidth_up or node.bandwidth_up
+            if bw_down is None or bw_up is None:
+                raise netgraph.GraphError(
+                    f"host {name!r}: no bandwidth on host or graph node "
+                    f"{opts.network_node_id}"
+                )
+            host = Host(
+                host_id=host_id,
+                name=name,
+                ip=ip,
+                node_id=opts.network_node_id,
+                seed=seed,
+                bandwidth_down_bps=bw_down,
+                bandwidth_up_bps=bw_up,
+                qdisc=config.experimental.interface_qdisc,
+            )
+            self.hosts.append(host)
+            self.hosts_by_name[name] = host
+            ip_to_host[ip] = host
+            ip_to_node[ip] = opts.network_node_id
+            self.dns.register(name, ip)
+
+        self.shared = WorkerShared(
+            dns=self.dns,
+            routing=self.routing,
+            ip_to_host=ip_to_host,
+            ip_to_node_id=ip_to_node,
+            runahead=self.runahead,
+            sim_end_time=config.general.stop_time,
+            bootstrap_end_time=config.general.bootstrap_end_time,
+        )
+
+        # parallelism = min(cores, hosts) unless configured
+        par = config.general.parallelism
+        if par <= 0:
+            par = min(os.cpu_count() or 1, len(self.hosts))
+        self.scheduler = make_scheduler(
+            config.experimental.scheduler, self.shared, par
+        )
+
+        # random thread-assignment order (`manager.rs:272`); per-round host
+        # iteration uses this fixed shuffled order
+        self._host_order = list(self.hosts)
+        self.global_rng.shuffle(self._host_order)
+
+        self.stats = SimStats()
+
+    # ------------------------------------------------------------------
+
+    def run(self) -> SimStats:
+        wall_start = _walltime.monotonic()
+
+        # round 0: boot all hosts (schedules application-start tasks)
+        for host in self._host_order:
+            host.boot()
+
+        # the scheduling loop (`manager.rs:392-478`)
+        min_next = min(
+            (t for t in (h.next_event_time() for h in self.hosts) if t is not None),
+            default=None,
+        )
+        window = self.controller.next_window(min_next)
+        while window is not None:
+            start, end = window
+            min_next = self.scheduler.run_round(self._host_order, end)
+            self.stats.rounds += 1
+            window = self.controller.next_window(min_next)
+
+        # teardown (`manager.rs:480-489`)
+        for host in self._host_order:
+            host.shutdown()
+        self.scheduler.join()
+
+        self.stats.sim_time_ns = self.config.general.stop_time
+        self.stats.packets_sent = int(self.routing.packet_counters.sum())
+        self.stats.packets_dropped = self.shared.packet_drop_count
+        self.stats.wall_seconds = _walltime.monotonic() - wall_start
+        return self.stats
+
+
+def run_simulation(config: ConfigOptions) -> SimStats:
+    return Manager(config).run()

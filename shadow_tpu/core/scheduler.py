@@ -1,0 +1,108 @@
+"""Host-parallel schedulers.
+
+Parity: reference `src/lib/scheduler/` — hosts are the unit of parallelism;
+within one round every host runs independently and a barrier separates
+rounds. `ThreadPerCoreScheduler` mirrors the default thread-per-core design
+with work stealing (`thread_per_core.rs:193-212`): worker threads drain a
+shared host list via an atomic cursor (equivalent to stealing from a global
+pool; determinism holds because per-round host execution is independent and
+all cross-host effects carry scheduling-independent ordering keys).
+`SerialScheduler` mirrors thread-per-host degenerate single-thread use and is
+the default for the Python plane (the heavy batched work belongs to the TPU
+plane; the C++ syscall plane has its own pool).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from .worker import Worker, WorkerShared
+
+
+class SerialScheduler:
+    parallelism = 1
+
+    def __init__(self, shared: WorkerShared):
+        self.worker = Worker(shared, 0)
+
+    def run_round(self, hosts, round_end: int) -> Optional[int]:
+        """Execute all hosts up to `round_end`; return the min next-event
+        time across hosts and in-flight packet deliveries."""
+        w = self.worker
+        w.start_round(round_end)
+        min_next: Optional[int] = None
+        for host in hosts:
+            w.set_active_host(host)
+            host.execute(round_end)
+            t = host.next_event_time()
+            if t is not None and (min_next is None or t < min_next):
+                min_next = t
+            w.set_active_host(None)
+        if w.next_event_time is not None and (
+            min_next is None or w.next_event_time < min_next
+        ):
+            min_next = w.next_event_time
+        return min_next
+
+    def join(self) -> None:
+        pass
+
+
+class ThreadPerCoreScheduler:
+    """N worker threads pull hosts from a shared cursor each round."""
+
+    def __init__(self, shared: WorkerShared, parallelism: int):
+        self.parallelism = max(1, parallelism)
+        self._workers = [Worker(shared, i) for i in range(self.parallelism)]
+
+    def run_round(self, hosts, round_end: int) -> Optional[int]:
+        hosts = list(hosts)
+        cursor = [0]
+        cursor_lock = threading.Lock()
+        results: list[Optional[int]] = [None] * self.parallelism
+
+        def run(worker: Worker, slot: int):
+            worker.start_round(round_end)
+            min_next: Optional[int] = None
+            while True:
+                with cursor_lock:
+                    i = cursor[0]
+                    cursor[0] += 1
+                if i >= len(hosts):
+                    break
+                host = hosts[i]
+                worker.set_active_host(host)
+                host.execute(round_end)
+                t = host.next_event_time()
+                if t is not None and (min_next is None or t < min_next):
+                    min_next = t
+                worker.set_active_host(None)
+            if worker.next_event_time is not None and (
+                min_next is None or worker.next_event_time < min_next
+            ):
+                min_next = worker.next_event_time
+            results[slot] = min_next
+
+        threads = [
+            threading.Thread(target=run, args=(w, i), daemon=True)
+            for i, w in enumerate(self._workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()  # the round barrier
+
+        live = [r for r in results if r is not None]
+        return min(live) if live else None
+
+    def join(self) -> None:
+        pass
+
+
+def make_scheduler(kind: str, shared: WorkerShared, parallelism: int):
+    if kind == "serial" or parallelism <= 1:
+        return SerialScheduler(shared)
+    if kind in ("thread-per-core", "thread-per-host"):
+        return ThreadPerCoreScheduler(shared, parallelism)
+    raise ValueError(f"unknown scheduler {kind!r}")

@@ -1,0 +1,52 @@
+"""Simulated-CPU time accounting.
+
+Parity: reference `src/main/host/cpu.rs:8-40` — native execution time spent
+by managed code is charged to a simulated CPU at a configured frequency; when
+accumulated unapplied delay exceeds a threshold, event execution is pushed
+into the future (rounded up to a precision), modelling an oversubscribed CPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class Cpu:
+    __slots__ = ("_sim_freq_khz", "_native_freq_khz", "_threshold", "_precision", "_now", "_time_cursor")
+
+    def __init__(
+        self,
+        sim_frequency_khz: int,
+        native_frequency_khz: int,
+        threshold_ns: Optional[int],
+        precision_ns: Optional[int],
+    ):
+        self._sim_freq_khz = sim_frequency_khz
+        self._native_freq_khz = native_frequency_khz
+        self._threshold = threshold_ns
+        self._precision = precision_ns
+        self._now = 0
+        # The simulated-CPU "busy until" cursor; delay = cursor - now.
+        self._time_cursor = 0
+
+    def update_time(self, now: int) -> None:
+        self._now = now
+        if self._time_cursor < now:
+            self._time_cursor = now
+
+    def add_delay(self, native_ns: int) -> None:
+        """Charge native execution time, scaled by the frequency ratio."""
+        scaled = native_ns * self._native_freq_khz // max(1, self._sim_freq_khz)
+        self._time_cursor += scaled
+
+    def delay(self) -> int:
+        """Outstanding delay to apply, 0 if below threshold. Rounded up to the
+        configured precision so events don't splinter into ns-grade wakeups."""
+        if self._threshold is None:
+            return 0
+        raw = self._time_cursor - self._now
+        if raw <= self._threshold:
+            return 0
+        if self._precision:
+            raw = -(-raw // self._precision) * self._precision
+        return raw

@@ -1,0 +1,217 @@
+"""The simulated machine.
+
+Parity: reference `src/main/host/host.rs` — a Host owns its event queue, RNG
+(seeded from config, `host.rs:233`), a router plus three relays (inet-out
+rate-limited by up-bandwidth, inet-in by down-bandwidth, loopback unlimited,
+`host.rs:295-311`), a network namespace, monotone counters that feed the
+deterministic event/packet ordering (`host.rs:159-168,679-720`), an optional
+CPU model, and its applications. `Host::execute` (`host.rs:810-865`) is the
+inner hot loop: pop events below the round end; packet events enter the
+router's CoDel queue and wake the inet-in relay; local events run their task.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..core.config import QDiscMode
+from ..core.event import Event, EventQueue, TaskRef
+from ..core.rng import Xoshiro256pp
+from ..net.interface import NetworkInterface
+from ..net.namespace import NetworkNamespace
+from ..net.packet import Packet
+from ..net.relay import Relay
+from ..net.router import Router
+from .cpu import Cpu
+
+
+class Host:
+    def __init__(
+        self,
+        *,
+        host_id: int,
+        name: str,
+        ip: str,
+        node_id: int,
+        seed: int,
+        bandwidth_down_bps: int,
+        bandwidth_up_bps: int,
+        qdisc: QDiscMode = QDiscMode.FIFO,
+        cpu: Optional[Cpu] = None,
+        pcap_hook=None,
+    ):
+        self.host_id = host_id
+        self.name = name
+        self.ip = ip
+        self.node_id = node_id
+        self.rng = Xoshiro256pp(seed)
+        self.cpu = cpu
+
+        self.event_queue = EventQueue()
+        self._queue_lock = threading.Lock()  # cross-thread packet pushes
+
+        # Deterministic ordering counters (`host.rs:159-168`).
+        self._local_event_id = 0
+        self._packet_event_id = 0
+        self._packet_priority = 0
+
+        # Clock: maintained by execute(); relays and sockets read it.
+        self._now = 0
+        # The worker currently executing this host (set by the scheduler).
+        self._worker = None
+
+        self.netns = NetworkNamespace(ip, qdisc, pcap_hook)
+        # The router's address is the unspecified address (`host.rs:298`):
+        # get_packet_device maps any non-local address to it, and relays'
+        # "local delivery" checks (src address == packet dst) never match it.
+        self.router = Router("0.0.0.0", self._send_packet_out, self.now)
+        # bits/sec -> bytes/sec for the relay rate limiters
+        self.relay_inet_out = Relay(self, ip, bandwidth_up_bps // 8)
+        self.relay_inet_in = Relay(self, "0.0.0.0", bandwidth_down_bps // 8)
+        self.relay_loopback = Relay(self, "127.0.0.1", None)
+        self._in_notify_socket_has_packets = False
+
+        # Applications: (start_time, callable(host)) pairs added before boot.
+        self._applications: list[tuple[int, Callable]] = []
+        self.processes: list = []  # populated by the process plane
+
+    # -- relay/host environment protocol ------------------------------------
+
+    def now(self) -> int:
+        return self._now
+
+    def is_bootstrapping(self) -> bool:
+        return self._worker.is_bootstrapping() if self._worker else False
+
+    def get_packet_device(self, ip: str):
+        """The host's routing table (`host.rs:965-973`): local interfaces for
+        local addresses, the router for everything else."""
+        iface = self.netns.interface_for(ip)
+        return iface if iface is not None else self.router
+
+    def schedule_relay_task(self, callback: Callable[[], None], delay_ns: int) -> None:
+        self.schedule_task_with_delay(TaskRef(lambda host: callback(), "relay"), delay_ns)
+
+    def _send_packet_out(self, packet: Packet) -> None:
+        """Router egress → the simulated internet via the worker."""
+        self._worker.send_packet(self, packet)
+
+    # -- counters -----------------------------------------------------------
+
+    def next_packet_event_id(self) -> int:
+        self._packet_event_id += 1
+        return self._packet_event_id
+
+    def get_next_packet_priority(self) -> int:
+        self._packet_priority += 1
+        return self._packet_priority
+
+    # -- scheduling ---------------------------------------------------------
+
+    def schedule_task_at(self, task: TaskRef, time_ns: int) -> None:
+        assert time_ns >= self._now, "cannot schedule into the past"
+        self._local_event_id += 1
+        with self._queue_lock:
+            self.event_queue.push(Event.new_local(time_ns, task, self._local_event_id))
+
+    def schedule_task_with_delay(self, task: TaskRef, delay_ns: int) -> None:
+        self.schedule_task_at(task, self._now + delay_ns)
+
+    def push_packet_event(
+        self, packet: Packet, time_ns: int, src_host_id: int, src_event_id: int
+    ) -> None:
+        """Called from ANY worker thread (`worker.rs:629-639`)."""
+        with self._queue_lock:
+            self.event_queue.push(
+                Event.new_packet(time_ns, packet, src_host_id, src_event_id)
+            )
+
+    def next_event_time(self) -> Optional[int]:
+        with self._queue_lock:
+            return self.event_queue.next_time()
+
+    # -- applications -------------------------------------------------------
+
+    def add_application(self, start_time_ns: int, app: Callable) -> None:
+        """Register a callable(host) to run at `start_time_ns` (the process
+        plane schedules spawns through this, `host.rs:406-454`)."""
+        self._applications.append((start_time_ns, app))
+
+    def boot(self) -> None:
+        for start_time, app in self._applications:
+            self.schedule_task_at(TaskRef(app, "process-start"), start_time)
+
+    def shutdown(self) -> None:
+        for proc in self.processes:
+            stop = getattr(proc, "stop", None)
+            if stop is not None:
+                stop()
+
+    # -- the inner hot loop (`host.rs:810-865`) ------------------------------
+
+    def execute(self, until_ns: int) -> None:
+        while True:
+            with self._queue_lock:
+                nxt = self.event_queue.next_time()
+                if nxt is None or nxt >= until_ns:
+                    return
+                event = self.event_queue.pop()
+
+            self._now = event.time
+            if self._worker is not None:
+                self._worker.current_time = event.time
+
+            # CPU oversubscription can push the event into the future
+            # (`host.rs:821-849`).
+            if self.cpu is not None:
+                self.cpu.update_time(event.time)
+                delay = self.cpu.delay()
+                if delay > 0:
+                    new_time = event.time + delay
+                    if event.is_packet:
+                        with self._queue_lock:
+                            self.event_queue.push(
+                                Event.new_packet(
+                                    new_time, event.payload, event.key[0], event.key[1]
+                                )
+                            )
+                    else:
+                        self._local_event_id += 1
+                        with self._queue_lock:
+                            self.event_queue.push(
+                                Event.new_local(
+                                    new_time, event.payload, self._local_event_id
+                                )
+                            )
+                    continue
+
+            if event.is_packet:
+                self.router.route_incoming_packet(event.payload)
+                self.notify_router_has_packets()
+            else:
+                event.payload.execute(self)
+
+    # -- notifications ------------------------------------------------------
+
+    def notify_router_has_packets(self) -> None:
+        self.relay_inet_in.notify()
+
+    def notify_socket_has_packets(self, ip: str, socket) -> None:
+        """A socket has data to send on the interface with address `ip`
+        (`host.rs:988-1002`). Not reentrant (recursion guard mirrors
+        `host.rs:989-991`)."""
+        if self._in_notify_socket_has_packets:
+            raise AssertionError("recursive notify_socket_has_packets")
+        self._in_notify_socket_has_packets = True
+        try:
+            iface = self.netns.interface_for(ip)
+            if iface is None:
+                return
+            iface.add_data_source(socket)
+            if iface is self.netns.localhost:
+                self.relay_loopback.notify()
+            else:
+                self.relay_inet_out.notify()
+        finally:
+            self._in_notify_socket_has_packets = False

@@ -242,7 +242,10 @@ class NetworkGraph:
         return out_lat.astype(np.int64), out_loss.astype(np.float32)
 
     def _used_indices(self, used_ids: list[int]) -> list[int]:
-        return [self.node_id_to_index[self.node_by_id(i).id] for i in used_ids]
+        try:
+            return [self.node_id_to_index[i] for i in used_ids]
+        except KeyError as missing:
+            raise GraphError(f"graph node {missing} doesn't exist") from None
 
 
 class IpAssignment:

@@ -1,0 +1,83 @@
+from shadow_tpu.core import simtime
+from shadow_tpu.net.packet import Packet, PacketStatus, Protocol
+from shadow_tpu.net.router import CoDelQueue, Router, INTERVAL, TARGET
+
+MS = simtime.MILLISECOND
+
+
+def _pkt(n=1200):
+    return Packet(Protocol.UDP, ("11.0.0.1", 1), ("11.0.0.2", 2), b"x" * n)
+
+
+def test_fifo_below_target():
+    q = CoDelQueue()
+    pkts = [_pkt() for _ in range(10)]
+    for p in pkts:
+        q.push(p, 0)
+    out = [q.pop(5 * MS) for _ in range(10)]
+    assert out == pkts  # FIFO, no drops below target
+    assert q.pop(5 * MS) is None
+    assert q.dropped_count == 0
+
+
+def test_small_queue_never_drops():
+    # standing delay above target but <= MTU bytes stored: good state
+    q = CoDelQueue()
+    p = _pkt(100)
+    q.push(p, 0)
+    assert q.pop(500 * MS) is p
+    assert q.dropped_count == 0
+
+
+def test_drops_after_sustained_delay():
+    q = CoDelQueue()
+    # Keep >MTU bytes stored and standing delay >TARGET for over an INTERVAL.
+    for i in range(60):
+        q.push(_pkt(), i)  # all enqueued ~t=0
+    popped, dropped_seen = [], q.dropped_count
+    # Pop slowly: one packet every 25ms starting at t=20ms (delay > 10ms TARGET)
+    t = 20 * MS
+    while len(q):
+        p = q.pop(t)
+        if p is not None:
+            popped.append(p)
+        t += 25 * MS
+    assert q.dropped_count > 0, "sustained over-target delay must trigger drops"
+    assert len(popped) + q.dropped_count == 60
+    # dropped packets carry the ROUTER_DROPPED status
+    assert all(
+        PacketStatus.ROUTER_DROPPED not in p.statuses for p in popped
+    )
+
+
+def test_recovery_resets_to_store_mode():
+    q = CoDelQueue()
+    for i in range(60):
+        q.push(_pkt(), 0)
+    t = 20 * MS
+    while len(q):
+        q.pop(t)
+        t += 25 * MS
+    assert q.dropped_count > 0
+    # now a fresh, fast-drained queue: no more drops
+    before = q.dropped_count
+    for i in range(10):
+        q.push(_pkt(), t)
+    for i in range(10):
+        assert q.pop(t + 1 * MS) is not None
+    assert q.dropped_count == before
+
+
+def test_router_device():
+    now = [0]
+    sent = []
+    r = Router("11.0.0.1", sent.append, lambda: now[0])
+    assert r.get_address() == "11.0.0.1"
+    assert r.pop() is None
+    p = _pkt()
+    r.route_incoming_packet(p)
+    assert r.inbound_len() == 1
+    assert r.pop() is p
+    out = _pkt()
+    r.push(out)  # outward: forwarded to the send hook
+    assert sent == [out]

@@ -1,0 +1,129 @@
+import pytest
+
+from shadow_tpu.core.config import QDiscMode
+from shadow_tpu.core.rng import Xoshiro256pp
+from shadow_tpu.net.dns import Dns, DnsError
+from shadow_tpu.net.interface import NetworkInterface, WILDCARD_PEER
+from shadow_tpu.net.namespace import (
+    EPHEMERAL_PORT_MAX,
+    EPHEMERAL_PORT_MIN,
+    NetworkNamespace,
+)
+from shadow_tpu.net.packet import Packet, PacketStatus, Protocol
+
+
+class FakeSocket:
+    def __init__(self):
+        self.outq = []
+        self.inq = []
+
+    def pull_out_packet(self):
+        return self.outq.pop(0) if self.outq else None
+
+    def peek_next_priority(self):
+        return self.outq[0].priority if self.outq else None
+
+    def push_in_packet(self, packet):
+        self.inq.append(packet)
+
+
+def _pkt(src_port=1, dst_port=80, prio=0, proto=Protocol.UDP):
+    return Packet(
+        proto, ("11.0.0.1", src_port), ("11.0.0.2", dst_port), b"data", priority=prio
+    )
+
+
+def test_fifo_qdisc_orders_by_priority():
+    nic = NetworkInterface("11.0.0.1", QDiscMode.FIFO)
+    a, b = FakeSocket(), FakeSocket()
+    a.outq = [_pkt(prio=5), _pkt(prio=6)]
+    b.outq = [_pkt(prio=1), _pkt(prio=9)]
+    nic.add_data_source(a)
+    nic.add_data_source(b)
+    order = [nic.pop().priority for _ in range(4)]
+    assert order == [1, 5, 6, 9]
+    assert nic.pop() is None
+
+
+def test_rr_qdisc_alternates_sockets():
+    nic = NetworkInterface("11.0.0.1", QDiscMode.ROUND_ROBIN)
+    a, b = FakeSocket(), FakeSocket()
+    a.outq = [_pkt(src_port=1), _pkt(src_port=1), _pkt(src_port=1)]
+    b.outq = [_pkt(src_port=2)]
+    nic.add_data_source(a)
+    nic.add_data_source(b)
+    srcs = [nic.pop().src[1] for _ in range(4)]
+    assert srcs == [1, 2, 1, 1]
+
+
+def test_receive_delivery_exact_then_wildcard():
+    nic = NetworkInterface("11.0.0.2")
+    listener, child = FakeSocket(), FakeSocket()
+    nic.associate(listener, Protocol.TCP, 80)  # wildcard peer
+    nic.associate(child, Protocol.TCP, 80, peer=("11.0.0.1", 5))
+    p_known = _pkt(src_port=5, proto=Protocol.TCP)
+    p_new = _pkt(src_port=7, proto=Protocol.TCP)
+    nic.push(p_known)
+    nic.push(p_new)
+    assert child.inq == [p_known]
+    assert listener.inq == [p_new]
+
+
+def test_receive_no_association_drops():
+    nic = NetworkInterface("11.0.0.2")
+    p = _pkt()
+    nic.push(p)
+    assert PacketStatus.RCV_INTERFACE_DROPPED in p.statuses
+
+
+def test_double_association_rejected():
+    nic = NetworkInterface("11.0.0.2")
+    s = FakeSocket()
+    nic.associate(s, Protocol.TCP, 80)
+    with pytest.raises(ValueError, match="association exists"):
+        nic.associate(FakeSocket(), Protocol.TCP, 80)
+    nic.disassociate(Protocol.TCP, 80)
+    nic.associate(s, Protocol.TCP, 80)  # ok after disassociate
+
+
+def test_namespace_interfaces_and_ports():
+    ns = NetworkNamespace("11.0.0.5")
+    assert ns.interface_for("127.0.0.1") is ns.localhost
+    assert ns.interface_for("11.0.0.5") is ns.internet
+    assert ns.interface_for("9.9.9.9") is None
+    rng = Xoshiro256pp(1)
+    port = ns.get_random_free_port(Protocol.TCP, rng)
+    assert EPHEMERAL_PORT_MIN <= port <= EPHEMERAL_PORT_MAX
+    # binding 0.0.0.0 takes the port on both interfaces
+    ns.associate(FakeSocket(), Protocol.TCP, "0.0.0.0", port)
+    assert not ns.is_port_free(Protocol.TCP, port)
+    port2 = ns.get_random_free_port(Protocol.TCP, rng)
+    assert port2 != port
+
+
+def test_namespace_port_determinism():
+    a = NetworkNamespace("11.0.0.5")
+    b = NetworkNamespace("11.0.0.5")
+    ra, rb = Xoshiro256pp(7), Xoshiro256pp(7)
+    pa = [a.get_random_free_port(Protocol.UDP, ra) for _ in range(20)]
+    pb = [b.get_random_free_port(Protocol.UDP, rb) for _ in range(20)]
+    assert pa == pb
+
+
+def test_dns():
+    dns = Dns()
+    dns.register("server", "11.0.0.1")
+    dns.register("client1", "11.0.0.2")
+    assert dns.name_to_ip("server") == "11.0.0.1"
+    assert dns.name_to_ip("localhost") == "127.0.0.1"
+    assert dns.ip_to_name("11.0.0.2") == "client1"
+    assert dns.name_to_ip("nope") is None
+    with pytest.raises(DnsError):
+        dns.register("server", "11.0.0.9")
+    with pytest.raises(DnsError):
+        dns.register("other", "11.0.0.1")
+    hosts = dns.hosts_file()
+    assert "127.0.0.1 localhost" in hosts
+    assert "11.0.0.1 server" in hosts
+    dns.deregister("server")
+    assert dns.name_to_ip("server") is None

@@ -1,0 +1,148 @@
+from shadow_tpu.core import simtime
+from shadow_tpu.net.packet import CONFIG_MTU, Packet, PacketStatus, Protocol
+from shadow_tpu.net.relay import Relay, TokenBucket, create_token_bucket
+
+MS = simtime.MILLISECOND
+
+
+class FakeDevice:
+    def __init__(self, address):
+        self.address = address
+        self.outq = []
+        self.received = []
+
+    def get_address(self):
+        return self.address
+
+    def pop(self):
+        return self.outq.pop(0) if self.outq else None
+
+    def push(self, packet):
+        self.received.append(packet)
+
+
+class FakeHost:
+    def __init__(self):
+        self.devices = {}
+        self.tasks = []  # (fire_time, callback)
+        self.time = 0
+        self.bootstrapping = False
+
+    def get_packet_device(self, ip):
+        return self.devices[ip]
+
+    def schedule_relay_task(self, cb, delay_ns):
+        self.tasks.append((self.time + delay_ns, cb))
+
+    def now(self):
+        return self.time
+
+    def is_bootstrapping(self):
+        return self.bootstrapping
+
+    def run_due(self):
+        due = [t for t in self.tasks if t[0] <= self.time]
+        self.tasks = [t for t in self.tasks if t[0] > self.time]
+        for _, cb in sorted(due, key=lambda x: x[0]):
+            cb()
+
+
+def _pkt(dst, n=1000):
+    return Packet(Protocol.UDP, ("10.0.0.1", 1), (dst, 2), b"x" * n)
+
+
+def test_token_bucket_refill_and_wait():
+    tb = TokenBucket(capacity=100, refill_increment=10, refill_interval=MS)
+    ok, bal = tb.conforming_remove(100, now=0)
+    assert ok and bal == 0
+    ok, wait = tb.conforming_remove(25, now=0)
+    assert not ok and wait == 3 * MS  # 3 refills of 10 needed for 25
+    tb2 = TokenBucket(100, 10, MS)
+    tb2.conforming_remove(100, 0)
+    ok, bal = tb2.conforming_remove(30, now=5 * MS)  # 5 refills passed
+    assert ok and bal == 20
+
+
+def test_token_bucket_capacity_clamp():
+    tb = TokenBucket(100, 10, MS)
+    ok, bal = tb.conforming_remove(0, now=1000 * MS)
+    assert ok and bal == 100  # refills never exceed capacity
+
+
+def test_create_token_bucket_burst_allowance():
+    tb = create_token_bucket(1_000_000)  # 1 MB/s
+    assert tb.refill_increment == 1000
+    assert tb.capacity == 1000 + CONFIG_MTU
+
+
+def test_relay_unlimited_forwards_all():
+    host = FakeHost()
+    src = FakeDevice("10.0.0.1")
+    dst = FakeDevice("10.0.0.9")
+    host.devices = {"10.0.0.1": src, "10.0.0.9": dst}
+    relay = Relay(host, "10.0.0.1", bytes_per_second=None)
+    src.outq = [_pkt("10.0.0.9") for _ in range(5)]
+    relay.notify()
+    host.run_due()
+    assert len(dst.received) == 5
+    assert all(PacketStatus.RELAY_FORWARDED in p.statuses for p in dst.received)
+
+
+def test_relay_rate_limit_blocks_and_resumes():
+    host = FakeHost()
+    src = FakeDevice("10.0.0.1")
+    dst = FakeDevice("10.0.0.9")
+    host.devices = {"10.0.0.1": src, "10.0.0.9": dst}
+    # 1 MB/s -> 1000 bytes/ms refill, capacity 1000+1500=2500.
+    relay = Relay(host, "10.0.0.1", bytes_per_second=1_000_000)
+    pkts = [_pkt("10.0.0.9") for _ in range(5)]  # 1042 total bytes each
+    src.outq = list(pkts)
+    relay.notify()
+    host.run_due()
+    # capacity 2500 admits two packets (2084), third blocks
+    assert len(dst.received) == 2
+    assert host.tasks, "relay must have rescheduled itself"
+    assert PacketStatus.RELAY_CACHED in pkts[2].statuses
+    # advance until all delivered
+    for _ in range(20):
+        if not host.tasks:
+            break
+        host.time = max(t for t, _ in host.tasks)
+        host.run_due()
+    assert len(dst.received) == 5
+    assert [p for p in dst.received] == pkts
+
+
+def test_relay_local_delivery_exempt_from_rate_limit():
+    host = FakeHost()
+    lo = FakeDevice("127.0.0.1")
+    host.devices = {"127.0.0.1": lo}
+    relay = Relay(host, "127.0.0.1", bytes_per_second=1)  # absurdly low limit
+    lo.outq = [_pkt("127.0.0.1") for _ in range(10)]
+    relay.notify()
+    host.run_due()
+    assert len(lo.received) == 10  # local: no limit applies
+
+
+def test_relay_bootstrap_bypasses_rate_limit():
+    host = FakeHost()
+    host.bootstrapping = True
+    src = FakeDevice("10.0.0.1")
+    dst = FakeDevice("10.0.0.9")
+    host.devices = {"10.0.0.1": src, "10.0.0.9": dst}
+    relay = Relay(host, "10.0.0.1", bytes_per_second=1)
+    src.outq = [_pkt("10.0.0.9") for _ in range(10)]
+    relay.notify()
+    host.run_due()
+    assert len(dst.received) == 10
+
+
+def test_relay_notify_while_pending_is_noop():
+    host = FakeHost()
+    src = FakeDevice("10.0.0.1")
+    host.devices = {"10.0.0.1": src}
+    relay = Relay(host, "10.0.0.1", None)
+    relay.notify()
+    relay.notify()
+    relay.notify()
+    assert len(host.tasks) == 1  # only one forward task scheduled
