@@ -111,6 +111,7 @@ class Manager:
                 bandwidth_down_bps=bw_down,
                 bandwidth_up_bps=bw_up,
                 qdisc=config.experimental.interface_qdisc,
+                experimental=config.experimental,
             )
             self.hosts.append(host)
             self.hosts_by_name[name] = host

@@ -40,6 +40,7 @@ class Host:
         qdisc: QDiscMode = QDiscMode.FIFO,
         cpu: Optional[Cpu] = None,
         pcap_hook=None,
+        experimental=None,
     ):
         self.host_id = host_id
         self.name = name
@@ -47,6 +48,9 @@ class Host:
         self.node_id = node_id
         self.rng = Xoshiro256pp(seed)
         self.cpu = cpu
+        # ExperimentalOptions (socket buffer sizes/autotuning, TCP selection);
+        # sockets read their defaults from here.
+        self.config_experimental = experimental
 
         self.event_queue = EventQueue()
         self._queue_lock = threading.Lock()  # cross-thread packet pushes

@@ -1,0 +1,1 @@
+"""Simulated sockets (inet UDP/TCP; unix later)."""
