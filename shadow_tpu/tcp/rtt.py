@@ -1,0 +1,58 @@
+"""RFC 6298 round-trip-time estimation and retransmission timeout.
+
+Parity: reference `tcp.c:1128-1170` (`_tcp_updateRTTEstimate`,
+`_tcp_setRetransmitTimeout`) and `definitions.h:46-48`: millisecond
+granularity integer arithmetic, SRTT/RTTVAR with alpha=1/8 beta=1/4,
+RTO = SRTT + 4*RTTVAR clamped to [200ms, 120s], initial RTO 1s,
+exponential backoff on expiry, and Karn's rule (no estimate updates from
+echoes while backed off, `tcp.c:2315-2316`).
+
+Integer milliseconds — not ns — deliberately: the estimator divides, and
+keeping the reference's ms units makes the arithmetic exact and cheap for
+the eventual int32 TPU port.
+"""
+
+from __future__ import annotations
+
+RTO_INIT_MS = 1000  # CONFIG_TCP_RTO_INIT (NET_TCP_HZ = 1000 ms)
+RTO_MIN_MS = 200  # CONFIG_TCP_RTO_MIN
+RTO_MAX_MS = 120_000  # CONFIG_TCP_RTO_MAX
+
+
+class RttEstimator:
+    __slots__ = ("srtt_ms", "rttvar_ms", "rto_ms", "backoff_count")
+
+    def __init__(self):
+        self.srtt_ms = 0  # 0 = no measurement yet
+        self.rttvar_ms = 0
+        self.rto_ms = RTO_INIT_MS
+        self.backoff_count = 0
+
+    def update(self, rtt_ms: int) -> None:
+        """Fold one RTT sample in; recompute the RTO. Callers must not feed
+        samples taken from retransmitted segments (Karn's rule) — gate on
+        `backoff_count == 0` like the reference does."""
+        rtt_ms = max(1, rtt_ms)
+        if self.srtt_ms == 0:
+            self.srtt_ms = rtt_ms
+            self.rttvar_ms = rtt_ms // 2
+        else:
+            self.rttvar_ms = (3 * self.rttvar_ms) // 4 + abs(self.srtt_ms - rtt_ms) // 4
+            self.srtt_ms = (7 * self.srtt_ms) // 8 + rtt_ms // 8
+        self._set_rto(self.srtt_ms + 4 * self.rttvar_ms)
+        self.backoff_count = 0
+
+    def backoff(self) -> None:
+        """RTO expiry: double the timeout (`tcp.c:1499`)."""
+        self.backoff_count += 1
+        self._set_rto(self.rto_ms * 2)
+
+    def reset_backoff(self) -> None:
+        self.backoff_count = 0
+
+    def _set_rto(self, ms: int) -> None:
+        self.rto_ms = min(max(ms, RTO_MIN_MS), RTO_MAX_MS)
+
+    @property
+    def rto_ns(self) -> int:
+        return self.rto_ms * 1_000_000
