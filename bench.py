@@ -1,0 +1,212 @@
+#!/usr/bin/env python3
+"""Benchmark: TPU network-plane packet throughput vs the CPU object plane.
+
+Workload: a PHOLD-style closed loop (the classic PDES benchmark Shadow
+ships configs for, `src/test/phold/`) — every delivered packet spawns a new
+packet to a pseudorandom destination, so the event population is constant
+and every round does real routing/loss/rate-limit work.
+
+- TPU side: N_HOSTS hosts as SoA arrays; R rounds of `window_step` +
+  on-device respawn, driven by one `jax.lax.scan` (a single compiled
+  program; no host transfers inside the loop).
+- Baseline: the same PHOLD logic on the CPU object plane (Host/Worker/
+  EventQueue, the faithful Shadow-architecture path) — the stand-in for the
+  reference's per-packet CPU cost on this machine.
+
+Prints ONE JSON line:
+  {"metric": "packet_events_per_sec", "value": ..., "unit": "events/s",
+   "vs_baseline": <tpu_rate / cpu_object_plane_rate>}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+MS = 1_000_000
+
+N_HOSTS = int(os.environ.get("BENCH_HOSTS", "4096"))
+ROUNDS = int(os.environ.get("BENCH_ROUNDS", "256"))
+EGRESS_CAP = 16
+INGRESS_CAP = 32
+SPAWN_PER_DELIVERY = 1
+
+
+def bench_tpu() -> tuple[float, int]:
+    import jax
+    import jax.numpy as jnp
+
+    from shadow_tpu.tpu import ingest, make_params, make_state, window_step
+
+    N = N_HOSTS
+    rng = np.random.default_rng(0)
+    lat = rng.integers(1 * MS, 50 * MS, size=(N, N), dtype=np.int32)
+    lat = np.minimum(lat, lat.T)  # symmetric-ish
+    loss = np.zeros((N, N), np.float32)
+    bw = np.full((N,), 10_000_000_000, np.int64)  # 10 Gbit: not bw-bound
+    params = make_params(lat, loss, bw)
+    state = make_state(N, egress_cap=EGRESS_CAP, ingress_cap=INGRESS_CAP,
+                       initial_tokens=np.asarray(params.tb_cap))
+
+    # seed: 4 packets per host
+    k = 4
+    src0 = jnp.repeat(jnp.arange(N, dtype=jnp.int32), k)
+    dst0 = (src0 * 1566083941 + jnp.tile(jnp.arange(k, dtype=jnp.int32), N) * 40503 + 1) % N
+    b0 = src0.shape[0]
+    state = ingest(
+        state, src0, dst0,
+        jnp.full((b0,), 1400, jnp.int32),
+        jnp.arange(b0, dtype=jnp.int32),
+        jnp.arange(b0, dtype=jnp.int32),
+        jnp.zeros((b0,), bool),
+    )
+
+    key = jax.random.key(1)
+    CI = INGRESS_CAP
+    window = jnp.int32(10 * MS)
+
+    def round_fn(carry, round_idx):
+        state, spawn_seq = carry
+        shift = jnp.where(round_idx == 0, jnp.int32(0), window)
+        state, delivered, next_ev = window_step(state, params, key, shift, window)
+        # respawn: each delivered packet triggers one new packet from the
+        # receiving host to a hashed destination (deterministic)
+        host = jnp.broadcast_to(
+            jnp.arange(N, dtype=jnp.int32)[:, None], (N, CI)
+        ).reshape(-1)
+        mask = delivered["mask"].reshape(-1)
+        d_src = delivered["src"].reshape(-1)
+        d_seq = delivered["seq"].reshape(-1)
+        new_dst = (d_src * 40503 + d_seq * 1566083941 + round_idx * 97) % N
+        # per-slot seq: base + rank within the host's row (delivered entries
+        # occupy a contiguous prefix after the due-first sort)
+        rank = jnp.arange(N * CI, dtype=jnp.int32) % CI
+        seq_vals = spawn_seq[host] + rank
+        state = ingest(
+            state, host, new_dst,
+            jnp.full((N * CI,), 1400, jnp.int32),
+            seq_vals,  # priority: reuse seq (FIFO-ish)
+            seq_vals,
+            jnp.zeros((N * CI,), bool),
+            valid=mask,
+        )
+        spawn_seq = spawn_seq + jax.ops.segment_sum(
+            mask.astype(jnp.int32), host, num_segments=N
+        )
+        return (state, spawn_seq), delivered["mask"].sum(dtype=jnp.int32)
+
+    @jax.jit
+    def run(state):
+        spawn_seq = jnp.full((N,), 10_000, jnp.int32)
+        (state, _), delivered_counts = jax.lax.scan(
+            round_fn, (state, spawn_seq), jnp.arange(ROUNDS, dtype=jnp.int32)
+        )
+        return state, delivered_counts.sum()
+
+    # compile
+    t0 = time.monotonic()
+    state_out, ndel = run(state)
+    jax.block_until_ready(state_out)
+    compile_and_first = time.monotonic() - t0
+
+    # timed run (fresh state, compiled)
+    state2 = make_state(N, egress_cap=EGRESS_CAP, ingress_cap=INGRESS_CAP,
+                        initial_tokens=np.asarray(params.tb_cap))
+    state2 = ingest(
+        state2, src0, dst0,
+        jnp.full((b0,), 1400, jnp.int32),
+        jnp.arange(b0, dtype=jnp.int32),
+        jnp.arange(b0, dtype=jnp.int32),
+        jnp.zeros((b0,), bool),
+    )
+    jax.block_until_ready(state2)
+    t0 = time.monotonic()
+    state_out, ndel = run(state2)
+    ndel = int(ndel)
+    jax.block_until_ready(state_out)
+    wall = time.monotonic() - t0
+
+    sent = int(np.asarray(state_out.n_sent).sum())
+    events = ndel + sent  # send + deliver events, like Shadow's event count
+    return events / wall, events
+
+
+def bench_cpu_baseline() -> float:
+    """PHOLD on the object plane (Host/EventQueue/Worker path)."""
+    from shadow_tpu.core.config import load_config_str
+    from shadow_tpu.core.event import TaskRef
+    from shadow_tpu.core.manager import Manager
+    from shadow_tpu.net.packet import Packet, Protocol
+
+    n_hosts = 64
+    hosts_yaml = "\n".join(
+        f"  peer{i}:\n    network_node_id: 0" for i in range(n_hosts)
+    )
+    cfg = load_config_str(
+        f"general:\n  stop_time: 2s\n  seed: 1\n"
+        f"network:\n  graph:\n    type: 1_gbit_switch\nhosts:\n{hosts_yaml}"
+    )
+    mgr = Manager(cfg)
+    peer_ips = [h.ip for h in mgr.hosts]
+    events = [0]
+
+    class App:
+        PORT = 9000
+
+        def __init__(self, host):
+            self.host = host
+            self.outq = []
+            host.netns.associate(self, Protocol.UDP, "0.0.0.0", self.PORT)
+
+        def pull_out_packet(self):
+            return self.outq.pop(0) if self.outq else None
+
+        def peek_next_priority(self):
+            return self.outq[0].priority if self.outq else None
+
+        def push_in_packet(self, packet):
+            events[0] += 1
+            self.send_one()
+
+        def send_one(self):
+            events[0] += 1
+            dst = peer_ips[self.host.rng.randrange(0, len(peer_ips))]
+            self.outq.append(
+                Packet(Protocol.UDP, (self.host.ip, self.PORT), (dst, self.PORT),
+                       b"x" * 1400, priority=self.host.get_next_packet_priority())
+            )
+            self.host.notify_socket_has_packets(self.host.ip, self)
+
+        def start(self, host):
+            for _ in range(4):
+                self.send_one()
+
+    for host in mgr.hosts:
+        app = App(host)
+        host.add_application(MS, app.start)
+    t0 = time.monotonic()
+    mgr.run()
+    wall = time.monotonic() - t0
+    return events[0] / wall
+
+
+def main():
+    tpu_rate, events = bench_tpu()
+    cpu_rate = bench_cpu_baseline()
+    print(
+        json.dumps(
+            {
+                "metric": "packet_events_per_sec",
+                "value": round(tpu_rate, 1),
+                "unit": "events/s",
+                "vs_baseline": round(tpu_rate / cpu_rate, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

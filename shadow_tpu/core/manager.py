@@ -18,7 +18,7 @@ from typing import Optional
 from ..host.host import Host
 from ..net import graph as netgraph
 from ..net.dns import Dns
-from .config import ConfigOptions
+from .config import ConfigOptions, FinalState
 from .controller import Controller, Runahead
 from .rng import Xoshiro256pp, host_seed_for
 from .scheduler import make_scheduler
@@ -35,6 +35,7 @@ class SimStats:
     packets_dropped: int = 0
     sim_time_ns: int = 0
     wall_seconds: float = 0.0
+    process_failures: list = field(default_factory=list)
 
     def as_dict(self) -> dict:
         return {
@@ -43,6 +44,7 @@ class SimStats:
             "packets_dropped": self.packets_dropped,
             "sim_time_ns": self.sim_time_ns,
             "wall_seconds": self.wall_seconds,
+            "process_failures": list(self.process_failures),
         }
 
 
@@ -118,6 +120,7 @@ class Manager:
             ip_to_host[ip] = host
             ip_to_node[ip] = opts.network_node_id
             self.dns.register(name, ip)
+            self._wire_processes(host, name, opts)
 
         self.shared = WorkerShared(
             dns=self.dns,
@@ -146,6 +149,75 @@ class Manager:
 
     # ------------------------------------------------------------------
 
+    def _wire_processes(self, host: Host, host_name: str, opts) -> None:
+        """Schedule spawn (and optional shutdown-signal) tasks for each
+        configured process (`manager.rs:551` build_host + `host.rs:406-454`
+        add_application)."""
+        from .. import apps as app_registry
+        from ..process.process import SimProcess
+        from .event import TaskRef
+
+        self._spawned = getattr(self, "_spawned", [])
+        for i, popt in enumerate(opts.processes):
+            app = app_registry.resolve(popt.path)
+            proc_name = f"{host_name}.{popt.path.rsplit('/', 1)[-1]}.{i}"
+            cell: dict = {}
+
+            def spawn(h, app=app, popt=popt, proc_name=proc_name, cell=cell):
+                proc = SimProcess(h, proc_name, app, tuple(popt.args))
+                cell["proc"] = proc
+                proc.spawn()
+                if cell.get("pending_kill") is not None and proc.is_alive:
+                    # shutdown_time <= start_time: deliver the signal at
+                    # the spawn instant rather than dropping it
+                    proc.stop(cell["pending_kill"])
+
+            host.add_application(popt.start_time, spawn)
+            if popt.shutdown_time is not None:
+
+                def shutdown(h, popt=popt, cell=cell):
+                    proc = cell.get("proc")
+                    if proc is not None:
+                        proc.stop(popt.shutdown_signal)
+                    else:
+                        # spawn hasn't run yet (same-timestamp ordering or
+                        # shutdown before start): record the pending kill
+                        cell["pending_kill"] = popt.shutdown_signal
+
+                host.schedule_task_at(
+                    TaskRef(shutdown, "process-shutdown"), popt.shutdown_time
+                )
+            self._spawned.append((proc_name, popt, cell))
+
+    def _check_final_states(self) -> list:
+        """Compare each process against expected_final_state
+        (`worker.rs:589-604` plugin-error accounting)."""
+        from ..process.process import ProcessState
+
+        failures = []
+        for proc_name, popt, cell in getattr(self, "_spawned", []):
+            proc = cell.get("proc")
+            exp = popt.expected_final_state
+            if proc is None:
+                failures.append((proc_name, "never spawned"))
+                continue
+            if exp.kind == FinalState.RUNNING:
+                ok = proc.state in (ProcessState.RUNNING,)
+            elif exp.kind == FinalState.EXITED:
+                ok = proc.state == ProcessState.EXITED and proc.exit_status == exp.value
+            else:  # SIGNALED
+                ok = proc.state == ProcessState.KILLED and proc.kill_signal == exp.value
+            if not ok:
+                failures.append(
+                    (
+                        proc_name,
+                        f"expected {exp.kind.value}({exp.value}), got "
+                        f"{proc.state.value}(exit={proc.exit_status} "
+                        f"sig={proc.kill_signal})",
+                    )
+                )
+        return failures
+
     def run(self) -> SimStats:
         wall_start = _walltime.monotonic()
 
@@ -164,6 +236,9 @@ class Manager:
             min_next = self.scheduler.run_round(self._host_order, end)
             self.stats.rounds += 1
             window = self.controller.next_window(min_next)
+
+        # expected-final-state check happens before teardown kills everyone
+        self.stats.process_failures = self._check_final_states()
 
         # teardown (`manager.rs:480-489`)
         for host in self._host_order:

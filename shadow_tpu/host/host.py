@@ -59,6 +59,9 @@ class Host:
         self._local_event_id = 0
         self._packet_event_id = 0
         self._packet_priority = 0
+        # virtual PID allocation base (process.FIRST_PID; not imported to
+        # keep host free of process-plane dependencies)
+        self._next_pid = 1000
 
         # Clock: maintained by execute(); relays and sockets read it.
         self._now = 0
@@ -106,6 +109,17 @@ class Host:
     def next_packet_event_id(self) -> int:
         self._packet_event_id += 1
         return self._packet_event_id
+
+    def next_pid(self) -> int:
+        pid = self._next_pid
+        self._next_pid += 1
+        return pid
+
+    def dns_lookup(self, name: str):
+        """Simulated DNS (the worker holds the global registry)."""
+        if self._worker is None:
+            return None
+        return self._worker.shared.dns.name_to_ip(name)
 
     def get_next_packet_priority(self) -> int:
         self._packet_priority += 1

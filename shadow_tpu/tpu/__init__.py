@@ -1,0 +1,24 @@
+"""The TPU network plane.
+
+Everything from "socket emits packet" down — rate limiting, latency/loss
+sampling, cross-host delivery, queueing — runs as batched JAX ops over
+hosts-as-SoA arrays (SURVEY.md §7). The CPU planes (sockets, syscalls,
+processes) stay object-level; this plane carries packet *metadata* at scale.
+Payload bytes never leave the host: the (src, seq) pair correlates delivered
+metadata back to payloads buffered CPU-side.
+"""
+
+from .plane import NetPlaneParams, NetPlaneState, ingest, make_params, make_state, window_step
+from .mesh import host_sharding, make_mesh, shard_state
+
+__all__ = [
+    "NetPlaneParams",
+    "NetPlaneState",
+    "ingest",
+    "make_params",
+    "make_state",
+    "window_step",
+    "make_mesh",
+    "host_sharding",
+    "shard_state",
+]

@@ -1,0 +1,51 @@
+"""Mesh construction and host-axis sharding for the network plane.
+
+Parity concept: Shadow parallelizes over hosts (SURVEY.md §2.2 — hosts are
+the unit of parallelism; work stealing balances them across cores). The TPU
+mapping shards the host axis of every SoA array over the device mesh; the
+cross-host routing scatter inside `window_step` is then lowered by the SPMD
+partitioner to on-mesh collectives — the moral equivalent of the reference's
+cross-thread `push_packet_to_host` (`worker.rs:629-639`) riding ICI instead
+of a mutex.
+
+Routing matrices are row-sharded ([N, N]: rows = sending host, so each
+shard holds its own hosts' outbound path data); scalar/stat arrays shard on
+their only axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .plane import NetPlaneParams
+
+HOST_AXIS = "hosts"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (HOST_AXIS,))
+
+
+def host_sharding(mesh: Mesh) -> NamedSharding:
+    """Axis-0-sharded layout for [N, ...] per-host arrays."""
+    return NamedSharding(mesh, P(HOST_AXIS))
+
+
+def param_shardings(mesh: Mesh) -> NetPlaneParams:
+    row = NamedSharding(mesh, P(HOST_AXIS, None))
+    vec = NamedSharding(mesh, P(HOST_AXIS))
+    return NetPlaneParams(latency_ns=row, loss=row, tb_rate=vec, tb_cap=vec)
+
+
+def shard_state(state: NetPlaneState, params: NetPlaneParams, mesh: Mesh):
+    """Place state/params onto the mesh with host-axis sharding."""
+    state = jax.device_put(state, host_sharding(mesh))
+    params = jax.device_put(params, param_shardings(mesh))
+    return state, params

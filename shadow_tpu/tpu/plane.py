@@ -1,0 +1,332 @@
+"""Batched network-plane state and the per-window step function.
+
+This is the TPU-native re-design of Shadow's per-packet hot path
+(`src/main/core/worker.rs:326-410` send_packet, `src/main/network/relay/`
+token buckets, per-host event queues) as dense array ops:
+
+- `RoutingInfo` becomes the `[N, N]` latency/loss matrices already produced
+  by `shadow_tpu.net.graph` (SURVEY.md §2.5 "this is the table that becomes
+  a dense HBM array").
+- Per-host rate limiting (`relay/token_bucket.rs`) becomes a vectorized
+  token-bucket refill + prefix-sum spend over each host's egress queue.
+- Bernoulli path loss from the *source host's* RNG stream
+  (`worker.rs:359-375`) becomes counter-based threefry: every egress slot
+  derives its key from (root_key, per-host monotone counter), so draws are
+  identical under any vectorization or sharding.
+- The deliver-time clamp to the round end (`worker.rs:396-399`) is what
+  makes window-batched exchange legal; it is applied on-device.
+- Cross-host "push to destination queue under mutex" (`worker.rs:629-639`)
+  becomes a deterministic sorted scatter into fixed-capacity ingress
+  queues; with the host axis sharded over a mesh the scatter is the
+  all-to-all the SPMD partitioner lowers to ICI collectives.
+
+Dtype discipline (TPU-first):
+- Everything is int32/float32; no x64 dependence.
+- Times on-device are *relative to the current window start* and rebased by
+  `shift` each round, so int32 ns never overflows (constraint: path
+  latency + window length < ~2.1 s, amply true for network sims).
+- Invalid/empty slots use INT32_MAX sentinels so min-reductions are clean.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+I32_MAX = np.int32(2**31 - 1)
+
+
+class NetPlaneParams(NamedTuple):
+    """Static per-simulation data (replicated or row-sharded over the mesh)."""
+
+    latency_ns: jax.Array  # [N, N] int32 — path latency between hosts
+    loss: jax.Array  # [N, N] float32 — path loss probability
+    tb_rate: jax.Array  # [N] int32 — egress bytes per millisecond (up-bw)
+    tb_cap: jax.Array  # [N] int32 — bucket capacity (rate/ms + 1 MTU burst)
+
+
+class NetPlaneState(NamedTuple):
+    """Mutable SoA state, axis 0 = host, sharded over the mesh."""
+
+    # egress queues (outbound, awaiting bandwidth): [N, CE]
+    eg_dst: jax.Array  # int32 dest host index (-1 invalid)
+    eg_bytes: jax.Array  # int32 total wire size
+    eg_prio: jax.Array  # int32 host-assigned FIFO priority
+    eg_seq: jax.Array  # int32 per-source packet id (payload correlation)
+    eg_ctrl: jax.Array  # bool — control packets are never loss-dropped
+    eg_valid: jax.Array  # bool
+    # ingress queues (in flight toward this host): [N, CI]
+    in_src: jax.Array  # int32 source host index
+    in_bytes: jax.Array  # int32
+    in_seq: jax.Array  # int32
+    in_deliver_rel: jax.Array  # int32 ns relative to current window start
+    in_valid: jax.Array  # bool
+    # scalars per host: [N]
+    tb_balance: jax.Array  # int32 token bytes available
+    tb_rem_ns: jax.Array  # int32 sub-millisecond refill remainder
+    rng_counter: jax.Array  # int32 draws consumed (determinism contract)
+    # counters (per host, int32)
+    n_sent: jax.Array
+    n_loss_dropped: jax.Array
+    n_overflow_dropped: jax.Array
+    n_delivered: jax.Array
+
+
+def make_params(latency_ns: np.ndarray, loss: np.ndarray, up_bw_bps: np.ndarray,
+                mtu: int = 1500) -> NetPlaneParams:
+    """Build params from the routing matrices (`RoutingInfo.latency_ns/loss`
+    mapped host→node) and per-host up-bandwidths in bits/sec."""
+    rate = np.maximum(1, (up_bw_bps // 8) // 1000).astype(np.int32)  # B/ms
+    return NetPlaneParams(
+        latency_ns=jnp.asarray(latency_ns, jnp.int32),
+        loss=jnp.asarray(loss, jnp.float32),
+        tb_rate=jnp.asarray(rate),
+        tb_cap=jnp.asarray(rate + mtu, jnp.int32),
+    )
+
+
+def make_state(n_hosts: int, egress_cap: int = 32, ingress_cap: int = 64,
+               initial_tokens: np.ndarray | None = None) -> NetPlaneState:
+    N, CE, CI = n_hosts, egress_cap, ingress_cap
+    z = lambda shape: jnp.zeros(shape, jnp.int32)
+    return NetPlaneState(
+        eg_dst=jnp.full((N, CE), -1, jnp.int32),
+        eg_bytes=z((N, CE)),
+        eg_prio=jnp.full((N, CE), I32_MAX, jnp.int32),
+        eg_seq=z((N, CE)),
+        eg_ctrl=jnp.zeros((N, CE), bool),
+        eg_valid=jnp.zeros((N, CE), bool),
+        in_src=jnp.full((N, CI), -1, jnp.int32),
+        in_bytes=z((N, CI)),
+        in_seq=z((N, CI)),
+        in_deliver_rel=jnp.full((N, CI), I32_MAX, jnp.int32),
+        in_valid=jnp.zeros((N, CI), bool),
+        tb_balance=(jnp.asarray(initial_tokens, jnp.int32)
+                    if initial_tokens is not None else z((N,))),
+        tb_rem_ns=z((N,)),
+        rng_counter=z((N,)),
+        n_sent=z((N,)),
+        n_loss_dropped=z((N,)),
+        n_overflow_dropped=z((N,)),
+        n_delivered=z((N,)),
+    )
+
+
+def _row_sort(*arrays, keys: int):
+    """Sort each row of the given [N, C] arrays lexicographically by the
+    first `keys` arrays. Returns the arrays reordered."""
+    return jax.lax.sort(arrays, dimension=1, is_stable=True, num_keys=keys)
+
+
+def _scatter_append(group, in_order_rank_src, n_valid, cap, n_groups):
+    """Deterministic append-slot allocation for grouped scatter.
+
+    `group` [B]: destination row per item, already SORTED ascending (items
+    for the same row in their deterministic order); values >= n_groups mean
+    "drop". `n_valid` [n_groups]: current occupancy per row. Returns
+    (flat_idx [B] into a [n_groups, cap] buffer with out-of-bounds for
+    dropped/overflowed items, ok mask, overflow count per group).
+    """
+    first = jnp.searchsorted(group, group, side="left")
+    rank = jnp.arange(group.shape[0], dtype=jnp.int32) - first.astype(jnp.int32)
+    in_range = group < n_groups
+    slot = jnp.where(
+        in_range, n_valid[jnp.clip(group, 0, n_groups - 1)] + rank, cap
+    )
+    ok = in_order_rank_src & (slot < cap) & in_range
+    flat_idx = jnp.where(ok, group * cap + slot, n_groups * cap)
+    overflow = jax.ops.segment_sum(
+        (in_order_rank_src & in_range & (slot >= cap)).astype(jnp.int32),
+        jnp.clip(group, 0, n_groups - 1),
+        num_segments=n_groups,
+    )
+    return flat_idx, ok, overflow
+
+
+def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
+           nbytes: jax.Array, prio: jax.Array, seq: jax.Array,
+           ctrl: jax.Array, valid: jax.Array | None = None) -> NetPlaneState:
+    """Append a batch of outbound packets ([B] arrays; src = emitting host
+    index) to the egress queues. Slots are allocated after the current valid
+    entries per row; overflow beyond capacity is counted and dropped.
+    `valid` masks out dead batch slots (fixed-shape on-device producers).
+
+    The CPU syscall plane calls this once per round with everything the
+    sockets emitted (double-buffered host arrays in the full system)."""
+    N, CE = state.eg_dst.shape
+    if valid is not None:
+        # dead slots route to src N (out of range) and never place
+        src = jnp.where(valid, src, N)
+    # rank of each packet within its src group, deterministic by (src, seq)
+    order = jnp.lexsort((seq, src))
+    src_s, dst_s = src[order], dst[order]
+    bytes_s, prio_s = nbytes[order], prio[order]
+    seq_s, ctrl_s = seq[order], ctrl[order]
+
+    n_valid = state.eg_valid.sum(axis=1).astype(jnp.int32)  # [N]
+    # rows are front-compacted (window_step re-sorts), so slot placement is
+    # append; overflowing packets get an out-of-bounds index and drop
+    live = jnp.ones_like(src_s, bool)
+    flat, ok, overflow = _scatter_append(src_s, live, n_valid, CE, N)
+
+    def put(buf, vals):
+        return buf.reshape(-1).at[flat].set(vals, mode="drop").reshape(N, CE)
+
+    eg_dst = put(state.eg_dst, dst_s)
+    eg_bytes = put(state.eg_bytes, bytes_s)
+    eg_prio = put(state.eg_prio, prio_s)
+    eg_seq = put(state.eg_seq, seq_s)
+    eg_ctrl = put(state.eg_ctrl, ctrl_s)
+    eg_valid = put(state.eg_valid, jnp.ones_like(ok))
+    return state._replace(
+        eg_dst=eg_dst, eg_bytes=eg_bytes, eg_prio=eg_prio, eg_seq=eg_seq,
+        eg_ctrl=eg_ctrl, eg_valid=eg_valid,
+        n_overflow_dropped=state.n_overflow_dropped + overflow,
+    )
+
+
+def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Array,
+                shift_ns: jax.Array, window_ns: jax.Array):
+    """Advance one scheduling round [t, t + window_ns).
+
+    `shift_ns` = this window's start minus the previous window's start;
+    stored relative times are rebased by it. Returns
+    (state', delivered, next_event_rel) where `delivered` is a dict of
+    [N, CI] arrays masked by delivered['mask'] (packets that arrived within
+    this window, in deterministic (deliver_t, src, seq) order per host) and
+    `next_event_rel` is the min pending delivery time relative to the new
+    window start (INT32_MAX when idle).
+    """
+    N, CE = state.eg_dst.shape
+    CI = state.in_src.shape[1]
+
+    # --- 1. rebase clocks + refill token buckets -----------------------
+    in_deliver = jnp.where(state.in_valid, state.in_deliver_rel - shift_ns,
+                           I32_MAX)
+    # lazy 1ms-interval refill (`relay/token_bucket.rs`); the sub-ms
+    # remainder carries across rounds so short windows don't leak bandwidth
+    rem_total = state.tb_rem_ns + (shift_ns % 1_000_000)
+    elapsed_ms = (shift_ns // 1_000_000) + (rem_total // 1_000_000)
+    tb_rem_ns = rem_total % 1_000_000
+    # clamp elapsed to "enough to fill the bucket" BEFORE multiplying, so
+    # rate*elapsed stays within int32 even after long idle windows
+    fill_ms = params.tb_cap // params.tb_rate + 1
+    elapsed_eff = jnp.minimum(elapsed_ms, fill_ms)
+    balance = jnp.minimum(
+        state.tb_balance + params.tb_rate * elapsed_eff, params.tb_cap
+    )
+
+    # --- 2. egress: qdisc order, token-bucket gate ----------------------
+    # FIFO-by-priority qdisc (`network_interface.c:205-303`): valid first,
+    # then ascending priority.
+    inv = (~state.eg_valid).astype(jnp.int32)
+    eg_inv, eg_prio, eg_dst, eg_bytes, eg_seq, eg_ctrl, eg_valid = _row_sort(
+        inv, state.eg_prio, state.eg_dst, state.eg_bytes, state.eg_seq,
+        state.eg_ctrl, state.eg_valid, keys=2,
+    )
+    cum = jnp.cumsum(jnp.where(eg_valid, eg_bytes, 0), axis=1)
+    sendable = eg_valid & (cum <= balance[:, None])
+    spent = jnp.where(sendable, eg_bytes, 0).sum(axis=1)
+    balance = balance - spent
+
+    # --- 3. loss sampling + latency lookup ------------------------------
+    host_idx = jnp.arange(N, dtype=jnp.int32)[:, None]
+    counter = state.rng_counter[:, None] + jnp.arange(CE, dtype=jnp.int32)
+    pkt_key = jax.vmap(jax.vmap(
+        lambda h, c: jax.random.fold_in(jax.random.fold_in(rng_root, h), c)
+    ))(jnp.broadcast_to(host_idx, (N, CE)), counter)
+    u = jax.vmap(jax.vmap(jax.random.uniform))(pkt_key)
+    dst_clipped = jnp.clip(eg_dst, 0, N - 1)
+    p_loss = params.loss[jnp.broadcast_to(host_idx, (N, CE)), dst_clipped]
+    lost = sendable & (u < p_loss) & ~eg_ctrl
+    sent = sendable & ~lost
+    # draws consumed only for slots that attempted transmission, keeping the
+    # stream independent of queue occupancy beyond the sendable prefix
+    rng_counter = state.rng_counter + sendable.sum(axis=1, dtype=jnp.int32)
+
+    latency = params.latency_ns[jnp.broadcast_to(host_idx, (N, CE)), dst_clipped]
+    # deliver no earlier than the round barrier (`worker.rs:396-399`)
+    deliver_rel = jnp.maximum(latency, window_ns)  # relative to window start
+
+    # egress queue keeps only what didn't go out (compacted after routing,
+    # which still indexes this ordering)
+    eg_valid_left = eg_valid & ~sendable
+
+    # --- 4. ingress: deliver due packets, then compact ------------------
+    due = state.in_valid & (in_deliver < window_ns)
+    # deterministic presentation order: (deliver_t, src, seq), due first
+    not_due = (~due).astype(jnp.int32)
+    nd, d_t, d_src, d_seq, d_bytes, d_mask = _row_sort(
+        not_due, in_deliver, state.in_src, state.in_seq, state.in_bytes, due,
+        keys=4,
+    )
+    delivered = {
+        "mask": d_mask, "src": d_src, "seq": d_seq, "bytes": d_bytes,
+        "deliver_rel": d_t,
+    }
+    in_valid_left = state.in_valid & ~due
+
+    # compact remaining ingress: valid first, by (deliver, src, seq)
+    inv_in = (~in_valid_left).astype(jnp.int32)
+    key_deliver = jnp.where(in_valid_left, in_deliver, I32_MAX)
+    _, in_deliver_c, in_src_c, in_seq_c, in_bytes_c, in_valid_c = _row_sort(
+        inv_in, key_deliver, state.in_src, state.in_seq, state.in_bytes,
+        in_valid_left, keys=2,
+    )
+    n_valid_in = in_valid_c.sum(axis=1).astype(jnp.int32)  # [N]
+
+    # --- 5. route sent packets into destination ingress queues ----------
+    flat_sent = sent.reshape(-1)
+    flat_dst = jnp.where(flat_sent, eg_dst.reshape(-1), N)  # N = "nowhere"
+    flat_deliver = deliver_rel.reshape(-1)
+    flat_src = jnp.broadcast_to(host_idx, (N, CE)).reshape(-1)
+    flat_seq = eg_seq.reshape(-1)
+    flat_bytes = eg_bytes.reshape(-1)
+
+    # deterministic insertion order per destination
+    order = jnp.lexsort((flat_seq, flat_src, flat_deliver, flat_dst))
+    o_dst = flat_dst[order]
+    o_sent = flat_sent[order]
+    flat_idx, ok, overflowed = _scatter_append(o_dst, o_sent, n_valid_in, CI, N)
+
+    def scatter(buf, vals):
+        return buf.reshape(-1).at[flat_idx].set(vals, mode="drop").reshape(N, CI)
+
+    in_src_new = scatter(in_src_c, flat_src[order])
+    in_seq_new = scatter(in_seq_c, flat_seq[order])
+    in_bytes_new = scatter(in_bytes_c, flat_bytes[order])
+    in_deliver_new = scatter(
+        jnp.where(in_valid_c, in_deliver_c, I32_MAX), flat_deliver[order]
+    )
+    # non-ok slots carry an out-of-bounds flat_idx, so only accepted
+    # arrivals flip their slot valid
+    in_valid_new = scatter(in_valid_c, jnp.ones_like(ok))
+
+    # --- 6. compact leftover egress so rows stay front-packed for ingest
+    eg_prio_left = jnp.where(eg_valid_left, eg_prio, I32_MAX)
+    _, eg_prio_c, eg_dst_c, eg_bytes_c, eg_seq_c, eg_ctrl_c, eg_valid_c = _row_sort(
+        (~eg_valid_left).astype(jnp.int32), eg_prio_left, eg_dst, eg_bytes,
+        eg_seq, eg_ctrl, eg_valid_left, keys=2,
+    )
+
+    # --- 7. stats + next-event reduction --------------------------------
+    next_event = jnp.minimum(
+        jnp.where(in_valid_new, in_deliver_new, I32_MAX).min(axis=1).min(),
+        jnp.where(eg_valid_c.any(), window_ns, I32_MAX),
+    )
+
+    new_state = NetPlaneState(
+        eg_dst=eg_dst_c, eg_bytes=eg_bytes_c, eg_prio=eg_prio_c,
+        eg_seq=eg_seq_c, eg_ctrl=eg_ctrl_c, eg_valid=eg_valid_c,
+        in_src=in_src_new, in_bytes=in_bytes_new, in_seq=in_seq_new,
+        in_deliver_rel=in_deliver_new, in_valid=in_valid_new,
+        tb_balance=balance, tb_rem_ns=tb_rem_ns, rng_counter=rng_counter,
+        n_sent=state.n_sent + sent.sum(axis=1, dtype=jnp.int32),
+        n_loss_dropped=state.n_loss_dropped + lost.sum(axis=1, dtype=jnp.int32),
+        n_overflow_dropped=state.n_overflow_dropped + overflowed,
+        n_delivered=state.n_delivered + due.sum(axis=1, dtype=jnp.int32),
+    )
+    return new_state, delivered, next_event

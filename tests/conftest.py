@@ -3,13 +3,22 @@
 Tests run on CPU with 8 virtual XLA devices so multi-chip sharding paths are
 exercised without TPU hardware (the driver separately dry-runs the real
 multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: this environment's sitecustomize imports jax at interpreter start with
+JAX_PLATFORMS=axon (the real-TPU tunnel), so the env var is already cached —
+`jax.config.update` is the only override that still works here. Using the
+tunnel from tests would be both slow (every dispatch crosses it) and wrong
+(bench.py owns the real chip).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

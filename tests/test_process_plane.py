@@ -1,0 +1,249 @@
+"""Process-plane tests: coroutine processes, blocking syscalls via
+conditions, config-driven spawning, expected_final_state checking — capped
+by the BASELINE rung-1 analogue (3-host basic file transfer:
+`examples/docs/basic-file-transfer/shadow.yaml`).
+"""
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.manager import Manager
+from shadow_tpu.process.process import ProcessState
+
+MS = simtime.MILLISECOND
+S = simtime.SECOND
+
+BASIC_TRANSFER = """
+general:
+  stop_time: 60s
+  seed: 1
+network:
+  graph:
+    type: 1_gbit_switch
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - path: http-server
+      args: ["80", "1048576"]
+      start_time: 3s
+      expected_final_state: running
+  client1:
+    network_node_id: 0
+    processes:
+    - path: http-client
+      args: ["server", "80"]
+      start_time: 5s
+  client2:
+    network_node_id: 0
+    processes:
+    - path: http-client
+      args: ["server", "80"]
+      start_time: 5s
+"""
+
+
+def test_basic_file_transfer():
+    """BASELINE rung 1: two clients fetch 1 MiB from an http server."""
+    mgr = Manager(load_config_str(BASIC_TRANSFER))
+    stats = mgr.run()
+    assert stats.process_failures == [], stats.process_failures
+    # both clients exited 0; server still running
+    procs = {p.name: p for h in mgr.hosts for p in h.processes}
+    assert procs["client1.http-client.0"].state == ProcessState.EXITED
+    assert procs["client1.http-client.0"].exit_status == 0
+    assert procs["client2.http-client.0"].exit_status == 0
+    # the server was RUNNING at the final-state check (no failure recorded)
+    # and was then torn down by shutdown
+    assert procs["server.http-server.0"].state == ProcessState.KILLED
+
+
+def test_basic_file_transfer_deterministic():
+    runs = []
+    for _ in range(2):
+        mgr = Manager(load_config_str(BASIC_TRANSFER))
+        stats = mgr.run()
+        runs.append((stats.rounds, stats.packets_sent, stats.packets_dropped))
+    assert runs[0] == runs[1]
+
+
+def test_udp_echo_apps():
+    cfg = load_config_str(
+        """
+general: {stop_time: 10s, seed: 3}
+network: {graph: {type: 1_gbit_switch}}
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - {path: udp-echo-server, args: ["9000"], start_time: 1s,
+       expected_final_state: running}
+  client:
+    network_node_id: 0
+    processes:
+    - {path: udp-client, args: ["server", "9000", "5", "50"], start_time: 2s}
+"""
+    )
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
+
+
+def test_tgen_fixed_size_transfer():
+    cfg = load_config_str(
+        """
+general: {stop_time: 60s, seed: 4}
+network: {graph: {type: 1_gbit_switch}}
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - {path: tgen-server, args: ["8888"], start_time: 1s,
+       expected_final_state: running}
+  client:
+    network_node_id: 0
+    processes:
+    - {path: tgen-client, args: ["server", "8888", "2097152", "2"],
+       start_time: 2s}
+"""
+    )
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
+
+
+def test_shutdown_signal_and_expected_signaled():
+    cfg = load_config_str(
+        """
+general: {stop_time: 10s, seed: 5}
+network: {graph: {type: 1_gbit_switch}}
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - {path: udp-echo-server, args: ["9000"], start_time: 1s,
+       shutdown_time: 5s, shutdown_signal: 15,
+       expected_final_state: {signaled: 15}}
+"""
+    )
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
+
+
+def test_expected_state_mismatch_reported():
+    cfg = load_config_str(
+        """
+general: {stop_time: 10s, seed: 6}
+network: {graph: {type: 1_gbit_switch}}
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - {path: udp-echo-server, args: ["9000"], start_time: 1s,
+       expected_final_state: {exited: 0}}
+"""
+    )
+    stats = Manager(cfg).run()
+    # echo server never exits on its own -> mismatch must be reported
+    assert len(stats.process_failures) == 1
+
+
+def test_sleep_advances_emulated_time():
+    cfg = load_config_str(
+        """
+general: {stop_time: 5s, seed: 7}
+network: {graph: {type: 1_gbit_switch}}
+hosts:
+  a: {network_node_id: 0}
+"""
+    )
+    mgr = Manager(cfg)
+    host = mgr.hosts[0]
+    times = []
+
+    def napper(api):
+        times.append(api.now())
+        yield from api.sleep(500 * MS)
+        times.append(api.now())
+        yield from api.sleep(1 * S)
+        times.append(api.now())
+
+    from shadow_tpu.process.process import SimProcess
+
+    def start(h):
+        SimProcess(h, "napper", napper).spawn()
+
+    host.add_application(100 * MS, start)
+    mgr.run()
+    assert times == [100 * MS, 600 * MS, 1600 * MS]
+
+
+def test_app_crash_is_contained():
+    """An app raising an arbitrary exception is an abnormal exit of that
+    process, not a simulator crash."""
+    cfg = load_config_str(
+        """
+general: {stop_time: 5s, seed: 8}
+network: {graph: {type: 1_gbit_switch}}
+hosts:
+  a: {network_node_id: 0}
+  b:
+    network_node_id: 0
+    processes:
+    - {path: udp-client, args: ["a", "9", "1", "10"], start_time: 1s,
+       expected_final_state: {exited: 0}}
+"""
+    )
+    mgr = Manager(cfg)
+    host = mgr.hosts_by_name["a"]
+
+    def crasher(api):
+        yield from api.sleep(100 * MS)
+        raise ValueError("app bug")
+
+    from shadow_tpu.process.process import SimProcess
+
+    def start(h):
+        SimProcess(h, "crasher", crasher).spawn()
+
+    host.add_application(50 * MS, start)
+    stats = mgr.run()  # must not raise
+    crashed = [p for p in host.processes if p.name == "crasher"][0]
+    assert crashed.state == ProcessState.EXITED
+    assert crashed.exit_status == 1
+
+
+def test_shutdown_at_start_time_not_dropped():
+    cfg = load_config_str(
+        """
+general: {stop_time: 10s, seed: 9}
+network: {graph: {type: 1_gbit_switch}}
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - {path: udp-echo-server, args: ["9000"], start_time: 2s,
+       shutdown_time: 2s, shutdown_signal: 9,
+       expected_final_state: {signaled: 9}}
+"""
+    )
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
+
+
+def test_digit_leading_hostname_resolves():
+    cfg = load_config_str(
+        """
+general: {stop_time: 10s, seed: 10}
+network: {graph: {type: 1_gbit_switch}}
+hosts:
+  3server:
+    network_node_id: 0
+    processes:
+    - {path: udp-echo-server, args: ["9000"], start_time: 1s,
+       expected_final_state: running}
+  client:
+    network_node_id: 0
+    processes:
+    - {path: udp-client, args: ["3server", "9000", "3", "10"], start_time: 2s}
+"""
+    )
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
