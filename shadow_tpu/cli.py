@@ -1,0 +1,148 @@
+"""Command-line entry point: `python -m shadow_tpu <config.yaml>`.
+
+Parity: reference `src/main/shadow.rs` `run_shadow` — load + merge config
+(CLI over file), init logging, create the data directory (refusing to
+clobber an existing one), write `processed-config.yaml` for
+reproducibility (`manager.rs:182-193`), run the simulation, write
+`sim-stats.json` (`manager.rs:523-546`), and exit nonzero when any process
+missed its expected final state (`controller.rs:69-73`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import shutil
+import sys
+
+from .core import shadowlog, units
+from .core.config import ConfigOptions, load_config_file
+from .core.manager import Manager
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="shadow_tpu",
+        description="TPU-native discrete-event network simulator",
+    )
+    p.add_argument("config", help="simulation YAML config")
+    p.add_argument("--seed", type=int, help="override general.seed")
+    p.add_argument("--stop-time", help="override general.stop_time (e.g. 10s)")
+    p.add_argument("--parallelism", type=int, help="worker parallelism")
+    p.add_argument(
+        "--log-level",
+        choices=["error", "warning", "info", "debug", "trace"],
+        help="override general.log_level",
+    )
+    p.add_argument(
+        "-d", "--data-directory", help="override general.data_directory"
+    )
+    p.add_argument(
+        "-e",
+        "--force",
+        action="store_true",
+        help="remove a pre-existing data directory instead of refusing",
+    )
+    p.add_argument(
+        "--show-config", action="store_true",
+        help="print the processed config and exit",
+    )
+    return p
+
+
+def _apply_overrides(config: ConfigOptions, args) -> None:
+    if args.seed is not None:
+        config.general.seed = args.seed
+    if args.stop_time is not None:
+        config.general.stop_time = units.parse_duration_ns(args.stop_time)
+    if args.parallelism is not None:
+        config.general.parallelism = args.parallelism
+    if args.data_directory is not None:
+        config.general.data_directory = args.data_directory
+
+
+def _config_as_dict(config: ConfigOptions) -> dict:
+    import dataclasses
+    import enum as _enum
+
+    def conv(x):
+        if dataclasses.is_dataclass(x) and not isinstance(x, type):
+            return {k: conv(v) for k, v in dataclasses.asdict(x).items()}
+        if isinstance(x, _enum.Enum):
+            return x.value if not isinstance(x.value, int) else x.name.lower()
+        if isinstance(x, dict):
+            return {k: conv(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            return [conv(v) for v in x]
+        return x
+
+    return {
+        "general": conv(config.general),
+        "network": conv(config.network),
+        "experimental": conv(config.experimental),
+        "hosts": {name: conv(h) for name, h in config.hosts.items()},
+    }
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        config = load_config_file(args.config)
+    except Exception as e:
+        print(f"shadow_tpu: config error: {e}", file=sys.stderr)
+        return 1
+    _apply_overrides(config, args)
+
+    if args.show_config:
+        json.dump(_config_as_dict(config), sys.stdout, indent=2)
+        print()
+        return 0
+
+    level_name = args.log_level or config.general.log_level.name
+    level = {"TRACE": logging.DEBUG}.get(
+        str(level_name).upper(), getattr(logging, str(level_name).upper(), logging.INFO)
+    )
+    shadowlog.init_logging(level)
+    log = logging.getLogger("shadow_tpu.cli")
+
+    data_dir = config.general.data_directory
+    if os.path.exists(data_dir):
+        if not args.force:
+            print(
+                f"shadow_tpu: data directory {data_dir!r} exists "
+                "(pass -e/--force to replace it)",
+                file=sys.stderr,
+            )
+            return 1
+        shutil.rmtree(data_dir)
+    os.makedirs(data_dir)
+
+    import yaml
+
+    with open(os.path.join(data_dir, "processed-config.yaml"), "w") as fh:
+        yaml.safe_dump(_config_as_dict(config), fh, sort_keys=False)
+
+    mgr = Manager(config, data_dir=data_dir)
+    log.info("simulation starting: %d hosts", len(mgr.hosts))
+    stats = mgr.run()
+    log.info(
+        "simulation finished: %d rounds, %d packets, %.2fs wall",
+        stats.rounds, stats.packets_sent, stats.wall_seconds,
+    )
+
+    payload = stats.as_dict()
+    payload["hosts"] = mgr.host_stats()
+    with open(os.path.join(data_dir, "sim-stats.json"), "w") as fh:
+        json.dump(payload, fh, indent=2)
+
+    if stats.process_failures:
+        for name, why in stats.process_failures:
+            log.error("process failure: %s: %s", name, why)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

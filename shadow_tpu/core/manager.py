@@ -22,6 +22,7 @@ from .config import ConfigOptions, FinalState
 from .controller import Controller, Runahead
 from .rng import Xoshiro256pp, host_seed_for
 from .scheduler import make_scheduler
+from . import worker as worker_mod
 from .worker import WorkerShared
 
 
@@ -48,9 +49,20 @@ class SimStats:
         }
 
 
+def _tracker_dispatch(packet, status):
+    """Route a packet status to the executing host's trackers."""
+    host = worker_mod.current_host()
+    if host is None:
+        return
+    for tracker in getattr(host, "trackers", ()):
+        tracker.on_packet_status(packet, status)
+
+
 class Manager:
-    def __init__(self, config: ConfigOptions):
+    def __init__(self, config: ConfigOptions, data_dir: Optional[str] = None):
         self.config = config
+        self.data_dir = data_dir  # set by the CLI; enables pcap/stats files
+        self._pcap_writers = []
         self.global_rng = Xoshiro256pp(config.general.seed)
         self.dns = Dns()
         self.hosts: list[Host] = []
@@ -104,6 +116,8 @@ class Manager:
                     f"host {name!r}: no bandwidth on host or graph node "
                     f"{opts.network_node_id}"
                 )
+            host_opts = config.host_defaults.merged_with(opts.host_options).resolved()
+            pcap_factory = self._make_pcap_factory(name, host_opts)
             host = Host(
                 host_id=host_id,
                 name=name,
@@ -114,6 +128,7 @@ class Manager:
                 bandwidth_up_bps=bw_up,
                 qdisc=config.experimental.interface_qdisc,
                 experimental=config.experimental,
+                pcap_factory=pcap_factory,
             )
             self.hosts.append(host)
             self.hosts_by_name[name] = host
@@ -147,7 +162,52 @@ class Manager:
 
         self.stats = SimStats()
 
+        # Per-host trackers dispatch off the packet status-trace stream —
+        # only when something consumes them (heartbeats or stats output),
+        # so library runs with heartbeats disabled pay nothing per packet.
+        from ..host.tracker import Tracker
+        from ..net import packet as packet_mod
+
+        hb = config.experimental.host_heartbeat_interval
+        if hb or self.data_dir:
+            self.trackers = {
+                h.name: Tracker(h, hb) for h in self.hosts
+            }
+            packet_mod.status_trace_hook = _tracker_dispatch
+        else:
+            self.trackers = {}
+
     # ------------------------------------------------------------------
+
+    def _make_pcap_factory(self, host_name: str, host_opts):
+        """Per-host, per-interface pcap capture when enabled and a data dir
+        exists (`host.rs:279-282` PcapConfig; lo.pcap + eth0.pcap like the
+        reference)."""
+        if not self.data_dir or not host_opts.pcap_enabled:
+            return None
+        import os
+
+        from ..utils.pcap import PcapWriter
+
+        host_dir = os.path.join(self.data_dir, "hosts", host_name)
+        os.makedirs(host_dir, exist_ok=True)
+        snaplen = host_opts.pcap_capture_size
+        if snaplen is None:
+            snaplen = 65535
+
+        def factory(iface_name: str):
+            writer = PcapWriter(
+                open(os.path.join(host_dir, f"{iface_name}.pcap"), "wb"), snaplen
+            )
+            self._pcap_writers.append(writer)
+
+            def hook(packet, inbound, _writer=writer):
+                host = worker_mod.current_host()
+                _writer.record(packet, host.now() if host else 0)
+
+            return hook
+
+        return factory
 
     def _wire_processes(self, host: Host, host_name: str, opts) -> None:
         """Schedule spawn (and optional shutdown-signal) tasks for each
@@ -224,6 +284,8 @@ class Manager:
         # round 0: boot all hosts (schedules application-start tasks)
         for host in self._host_order:
             host.boot()
+        for tracker in self.trackers.values():
+            tracker.start()
 
         # the scheduling loop (`manager.rs:392-478`)
         min_next = min(
@@ -249,7 +311,13 @@ class Manager:
         self.stats.packets_sent = int(self.routing.packet_counters.sum())
         self.stats.packets_dropped = self.shared.packet_drop_count
         self.stats.wall_seconds = _walltime.monotonic() - wall_start
+        for writer in self._pcap_writers:
+            writer.close()
         return self.stats
+
+    def host_stats(self) -> dict:
+        """Per-host tracker counters for sim-stats.json."""
+        return {name: t.counters.as_dict() for name, t in self.trackers.items()}
 
 
 def run_simulation(config: ConfigOptions) -> SimStats:

@@ -1,0 +1,52 @@
+"""Simulation-aware logging.
+
+Parity: reference `src/main/core/logger/shadow_logger.rs` — every record is
+tagged with the *emulated* time and the executing host, so logs from
+parallel runs are comparable and the determinism harness can diff them.
+The reference buffers asynchronously for throughput; Python's logging is
+synchronous, so the deterministic content contract is the part preserved
+(timestamps of the real clock are excluded from the deterministic format).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from . import simtime
+from .worker import current_host
+
+
+class SimContextFilter(logging.Filter):
+    """Injects sim_time / host_name fields into every record."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        host = current_host()
+        record.host_name = host.name if host is not None else "-"
+        record.sim_time = host.now() if host is not None else 0
+        record.sim_time_str = simtime.fmt(record.sim_time)
+        return True
+
+
+DETERMINISTIC_FORMAT = (
+    "%(sim_time_str)s [%(levelname)s] [%(host_name)s] %(name)s: %(message)s"
+)
+WALL_FORMAT = (
+    "%(asctime)s %(sim_time_str)s [%(levelname)s] [%(host_name)s] "
+    "%(name)s: %(message)s"
+)
+
+
+def init_logging(level: int = logging.INFO, deterministic: bool = True,
+                 stream=None) -> logging.Handler:
+    """Install a handler on the shadow_tpu logger tree; returns it so the
+    CLI can flush/remove. Deterministic mode omits wall-clock timestamps
+    (the diffable format the determinism harness compares)."""
+    logger = logging.getLogger("shadow_tpu")
+    handler = logging.StreamHandler(stream)
+    handler.setFormatter(
+        logging.Formatter(DETERMINISTIC_FORMAT if deterministic else WALL_FORMAT)
+    )
+    handler.addFilter(SimContextFilter())
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    return handler

@@ -24,6 +24,15 @@ from typing import Optional
 from ..net.packet import Packet, PacketStatus
 from .event import Event
 
+# Thread-local "which host is executing on this scheduler thread" — the
+# dispatch point for per-host instrumentation (tracker counters, strace),
+# mirroring the reference's thread-local Worker (`worker.rs:57`).
+_active = threading.local()
+
+
+def current_host():
+    return getattr(_active, "host", None)
+
 
 class WorkerShared:
     """Global state shared by all workers; read-mostly after setup."""
@@ -91,6 +100,7 @@ class Worker:
 
     def set_active_host(self, host) -> None:
         self.active_host = host
+        _active.host = host
         if host is not None:
             host._worker = self
 

@@ -39,7 +39,7 @@ class Host:
         bandwidth_up_bps: int,
         qdisc: QDiscMode = QDiscMode.FIFO,
         cpu: Optional[Cpu] = None,
-        pcap_hook=None,
+        pcap_factory=None,
         experimental=None,
     ):
         self.host_id = host_id
@@ -68,7 +68,7 @@ class Host:
         # The worker currently executing this host (set by the scheduler).
         self._worker = None
 
-        self.netns = NetworkNamespace(ip, qdisc, pcap_hook)
+        self.netns = NetworkNamespace(ip, qdisc, pcap_factory)
         # The router's address is the unspecified address (`host.rs:298`):
         # get_packet_device maps any non-local address to it, and relays'
         # "local delivery" checks (src address == packet dst) never match it.

@@ -1,0 +1,88 @@
+"""Per-host traffic/heartbeat tracking.
+
+Parity: reference `src/main/host/tracker.c` — per-host counters (packets
+and bytes, in/out, by protocol) logged as heartbeat lines at
+`host_heartbeat_interval`, feeding log-parsing tools. Counters hook the
+packet status-trace stream, the same instrumentation point the reference's
+`PacketCounter`/`ByteCounter` use.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+from ..core.event import TaskRef
+from ..net.packet import Packet, PacketStatus, Protocol
+
+log = logging.getLogger("shadow_tpu.tracker")
+
+
+@dataclass
+class Counters:
+    packets_in: int = 0
+    packets_out: int = 0
+    bytes_in: int = 0
+    bytes_out: int = 0
+    packets_dropped: int = 0
+    retransmitted: int = 0
+    by_protocol: dict = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {
+            "packets_in": self.packets_in,
+            "packets_out": self.packets_out,
+            "bytes_in": self.bytes_in,
+            "bytes_out": self.bytes_out,
+            "packets_dropped": self.packets_dropped,
+            "retransmitted": self.retransmitted,
+            "by_protocol": dict(self.by_protocol),
+        }
+
+
+class Tracker:
+    """Attach to a host; records its interface traffic and logs heartbeats."""
+
+    def __init__(self, host, heartbeat_interval_ns: int | None):
+        self.host = host
+        self.counters = Counters()
+        self._interval = heartbeat_interval_ns
+        host.trackers = getattr(host, "trackers", [])
+        host.trackers.append(self)
+
+    def start(self) -> None:
+        if self._interval:
+            self.host.schedule_task_with_delay(
+                TaskRef(self._heartbeat, "tracker-heartbeat"), self._interval
+            )
+
+    def on_packet_status(self, packet: Packet, status: PacketStatus) -> None:
+        c = self.counters
+        size = packet.total_size()
+        proto = Protocol(packet.protocol).name
+        if status == PacketStatus.SND_INTERFACE_SENT:
+            c.packets_out += 1
+            c.bytes_out += size
+            c.by_protocol[proto] = c.by_protocol.get(proto, 0) + 1
+        elif status == PacketStatus.RCV_INTERFACE_RECEIVED:
+            c.packets_in += 1
+            c.bytes_in += size
+        elif status in (
+            PacketStatus.INET_DROPPED,
+            PacketStatus.ROUTER_DROPPED,
+            PacketStatus.RCV_SOCKET_DROPPED,
+            PacketStatus.RCV_INTERFACE_DROPPED,
+        ):
+            c.packets_dropped += 1
+        elif status == PacketStatus.SND_TCP_RETRANSMITTED:
+            c.retransmitted += 1
+
+    def _heartbeat(self, host) -> None:
+        log.info(
+            "heartbeat host=%s time_ns=%d %s",
+            self.host.name, self.host.now(), self.counters.as_dict(),
+        )
+        if self._interval:
+            self.host.schedule_task_with_delay(
+                TaskRef(self._heartbeat, "tracker-heartbeat"), self._interval
+            )

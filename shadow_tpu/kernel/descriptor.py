@@ -1,0 +1,79 @@
+"""The file-descriptor table: fd integers -> open files.
+
+Parity: reference `src/main/host/descriptor/mod.rs` `DescriptorTable` —
+lowest-available fd allocation, dup sharing the same underlying file,
+close-on-last-reference, and explicit fd targets (dup2). Flags (CLOEXEC)
+are per-descriptor, not per-file.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import errors
+
+
+class Descriptor:
+    __slots__ = ("file", "cloexec")
+
+    def __init__(self, file, cloexec: bool = False):
+        self.file = file
+        self.cloexec = cloexec
+
+
+class DescriptorTable:
+    def __init__(self):
+        self._table: dict[int, Descriptor] = {}
+        self._next_hint = 0
+
+    def register(self, file, cloexec: bool = False) -> int:
+        fd = self._lowest_free()
+        self._table[fd] = Descriptor(file, cloexec)
+        return fd
+
+    def register_at(self, fd: int, file, cloexec: bool = False) -> int:
+        """dup2-style: closes whatever occupied fd first."""
+        if fd < 0:
+            raise errors.SyscallError(errors.EBADF)
+        if fd in self._table:
+            self.close(fd)
+        self._table[fd] = Descriptor(file, cloexec)
+        return fd
+
+    def get(self, fd: int):
+        entry = self._table.get(fd)
+        if entry is None:
+            raise errors.SyscallError(errors.EBADF)
+        return entry.file
+
+    def dup(self, fd: int) -> int:
+        entry = self._table.get(fd)
+        if entry is None:
+            raise errors.SyscallError(errors.EBADF)
+        new_fd = self._lowest_free()
+        self._table[new_fd] = Descriptor(entry.file, cloexec=False)
+        return new_fd
+
+    def close(self, fd: int) -> None:
+        entry = self._table.pop(fd, None)
+        if entry is None:
+            raise errors.SyscallError(errors.EBADF)
+        # close the file only when no other descriptor references it
+        if not any(d.file is entry.file for d in self._table.values()):
+            entry.file.close()
+
+    def close_all(self) -> None:
+        for fd in sorted(self._table):
+            try:
+                self.close(fd)
+            except errors.SyscallError:
+                pass
+
+    def fds(self) -> list[int]:
+        return sorted(self._table)
+
+    def _lowest_free(self) -> int:
+        fd = 0
+        while fd in self._table:
+            fd += 1
+        return fd

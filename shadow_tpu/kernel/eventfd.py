@@ -1,0 +1,67 @@
+"""eventfd: a 64-bit kernel counter usable as a wakeup channel.
+
+Parity: reference `src/main/host/descriptor/eventfd.rs` — read returns the
+counter (and zeroes it; or decrements by 1 in semaphore mode), write adds;
+READABLE when counter > 0, WRITABLE while a write of 1 wouldn't overflow.
+"""
+
+from __future__ import annotations
+
+from . import errors
+from .status import FileState, StatefulFile
+
+_MAX = (1 << 64) - 2
+
+
+class EventFd(StatefulFile):
+    def __init__(self, initval: int = 0, semaphore: bool = False):
+        super().__init__(FileState.ACTIVE | FileState.WRITABLE)
+        self.counter = initval
+        self.semaphore = semaphore
+        self.nonblocking = False
+        self._refresh()
+
+    def read_value(self) -> int:
+        if self.is_closed():
+            raise errors.SyscallError(errors.EBADF)
+        if self.counter == 0:
+            if self.nonblocking:
+                raise errors.SyscallError(errors.EWOULDBLOCK)
+            raise errors.Blocked(self, FileState.READABLE)
+        if self.semaphore:
+            self.counter -= 1
+            value = 1
+        else:
+            value, self.counter = self.counter, 0
+        self._refresh()
+        return value
+
+    def write_value(self, value: int) -> None:
+        if self.is_closed():
+            raise errors.SyscallError(errors.EBADF)
+        if value >= (1 << 64) - 1:
+            raise errors.SyscallError(errors.EINVAL)
+        if self.counter + value > _MAX:
+            if self.nonblocking:
+                raise errors.SyscallError(errors.EWOULDBLOCK)
+            raise errors.Blocked(self, FileState.WRITABLE)
+        self.counter += value
+        self._refresh()
+
+    def close(self) -> None:
+        if self.is_closed():
+            return
+        self.update_state(
+            FileState.ACTIVE | FileState.READABLE | FileState.WRITABLE | FileState.CLOSED,
+            FileState.CLOSED,
+        )
+
+    def _refresh(self) -> None:
+        if self.is_closed():
+            return
+        values = FileState.NONE
+        if self.counter > 0:
+            values |= FileState.READABLE
+        if self.counter + 1 <= _MAX:
+            values |= FileState.WRITABLE
+        self.update_state(FileState.READABLE | FileState.WRITABLE, values)

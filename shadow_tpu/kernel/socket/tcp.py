@@ -28,7 +28,7 @@ from ...core.event import TaskRef
 from ...net.packet import Packet, PacketStatus, Protocol, TcpHeader
 from ...tcp.connection import Segment, TcpConfig, TcpConnection, TcpError, TcpFlags, TcpState
 from .. import errors
-from ..status import FileState, StatefulFile
+from ..status import FileSignal, FileState, StatefulFile
 
 UNSPECIFIED = "0.0.0.0"
 LOCALHOST = "127.0.0.1"
@@ -278,7 +278,10 @@ class TcpSocket(StatefulFile):
             packet.add_status(PacketStatus.RCV_SOCKET_DROPPED)
             return
         packet.add_status(PacketStatus.RCV_SOCKET_PROCESSED)
+        before = self.conn.readable_bytes()
         self.conn.on_segment(packet_to_segment(packet))
+        if self.conn.readable_bytes() > before:
+            self.emit_signal(FileSignal.READ_BUFFER_GREW)
 
     # ==================================================================
     # listener internals

@@ -22,7 +22,7 @@ from typing import Optional
 
 from ...net.packet import Packet, PacketStatus, Protocol
 from .. import errors
-from ..status import CallbackQueue, FileState, StatefulFile, queue_and_run
+from ..status import CallbackQueue, FileSignal, FileState, StatefulFile, queue_and_run
 
 CONFIG_DATAGRAM_MAX_SIZE = 65507  # `definitions.h:134`
 
@@ -222,6 +222,7 @@ class UdpSocket(StatefulFile):
         packet.add_status(PacketStatus.RCV_SOCKET_BUFFERED)
         packet.add_status(PacketStatus.RCV_SOCKET_DELIVERED)
         self._refresh_readable_writable(None)
+        self.emit_signal(FileSignal.READ_BUFFER_GREW)
 
     # ------------------------------------------------------------------
 

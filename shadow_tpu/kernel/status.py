@@ -32,6 +32,16 @@ class FileState(enum.IntFlag):
     CHILD_EVENTS = 1 << 6
 
 
+class FileSignal(enum.IntFlag):
+    """Edge events that are not state-bit transitions (reference
+    `FileSignals`): e.g. more bytes arriving while a file is already
+    READABLE — invisible to state-change listeners, but exactly what
+    edge-triggered epoll must see (`epoll(7)`)."""
+
+    NONE = 0
+    READ_BUFFER_GREW = 1 << 0
+
+
 class ListenerFilter(enum.Enum):
     """When a listener fires, relative to the monitored bits' transition
     (`descriptor/listener.rs` StateListenerFilter)."""
@@ -88,10 +98,15 @@ class StateEventSource:
     __slots__ = ("_listeners", "_next_handle")
 
     def __init__(self):
-        # handle -> (monitoring mask, filter, callback(state, changed, cq))
+        # handle -> (state mask, signal mask, filter, cb(state, changed, cq))
         self._listeners: dict[
             int,
-            tuple[FileState, ListenerFilter, Callable[[FileState, FileState, CallbackQueue], None]],
+            tuple[
+                FileState,
+                FileSignal,
+                ListenerFilter,
+                Callable[[FileState, FileState, CallbackQueue], None],
+            ],
         ] = {}
         self._next_handle = 0
 
@@ -100,10 +115,11 @@ class StateEventSource:
         monitoring: FileState,
         filter: ListenerFilter,
         callback: Callable[[FileState, FileState, CallbackQueue], None],
+        signals: FileSignal = FileSignal.NONE,
     ) -> int:
         handle = self._next_handle
         self._next_handle += 1
-        self._listeners[handle] = (monitoring, filter, callback)
+        self._listeners[handle] = (monitoring, signals, filter, callback)
         return handle
 
     def remove_listener(self, handle: int) -> None:
@@ -113,11 +129,21 @@ class StateEventSource:
         return bool(self._listeners)
 
     def notify(
-        self, state: FileState, changed: FileState, cb_queue: CallbackQueue
+        self,
+        state: FileState,
+        changed: FileState,
+        cb_queue: CallbackQueue,
+        signals: FileSignal = FileSignal.NONE,
     ) -> None:
         """Queue notifications for every listener whose monitored bits
-        intersect `changed` in the direction its filter requires."""
-        for monitoring, filt, callback in list(self._listeners.values()):
+        intersect `changed` in the direction its filter requires, or whose
+        monitored signals intersect `signals`."""
+        for monitoring, want_sig, filt, callback in list(self._listeners.values()):
+            if signals & want_sig:
+                cb_queue.add(
+                    lambda cq, cb=callback, s=state, c=changed: cb(s, c, cq)
+                )
+                continue
             hit = monitoring & changed
             if not hit:
                 continue
@@ -151,11 +177,25 @@ class StatefulFile:
         monitoring: FileState,
         filter: ListenerFilter,
         callback: Callable[[FileState, FileState, CallbackQueue], None],
+        signals: FileSignal = FileSignal.NONE,
     ) -> int:
-        return self._event_source.add_listener(monitoring, filter, callback)
+        return self._event_source.add_listener(monitoring, filter, callback, signals)
 
     def remove_listener(self, handle: int) -> None:
         self._event_source.remove_listener(handle)
+
+    def emit_signal(
+        self, signals: FileSignal, cb_queue: Optional[CallbackQueue] = None
+    ) -> None:
+        """Fire signal-only listeners (no state bits changed) — e.g. the
+        read buffer grew while already READABLE."""
+        if not signals:
+            return
+        if cb_queue is None:
+            with queue_and_run() as cq:
+                self._event_source.notify(self._state, FileState.NONE, cq, signals)
+        else:
+            self._event_source.notify(self._state, FileState.NONE, cb_queue, signals)
 
     def update_state(
         self,

@@ -31,11 +31,16 @@ class NetworkNamespace:
         self,
         public_ip: str,
         qdisc: QDiscMode = QDiscMode.FIFO,
-        pcap_hook=None,
+        pcap_factory=None,
     ):
+        """`pcap_factory(iface_name)` returns a per-interface capture hook
+        (or None) — captures are per-interface files (lo.pcap/eth0.pcap)
+        like the reference's."""
         self.public_ip = public_ip
-        self.localhost = NetworkInterface("127.0.0.1", qdisc, pcap_hook)
-        self.internet = NetworkInterface(public_ip, qdisc, pcap_hook)
+        lo_hook = pcap_factory("lo") if pcap_factory else None
+        eth_hook = pcap_factory("eth0") if pcap_factory else None
+        self.localhost = NetworkInterface("127.0.0.1", qdisc, lo_hook)
+        self.internet = NetworkInterface(public_ip, qdisc, eth_hook)
 
     def interface_for(self, ip: str) -> Optional[NetworkInterface]:
         if ip == "127.0.0.1":

@@ -160,6 +160,30 @@ class Syscalls:
     def udp_socket(self) -> UdpSocket:
         return UdpSocket(self.host)
 
+    def pipe(self):
+        from ..kernel.pipe import make_pipe
+
+        return make_pipe()
+
+    def eventfd(self, initval: int = 0, semaphore: bool = False):
+        from ..kernel.eventfd import EventFd
+
+        return EventFd(initval, semaphore)
+
+    def timerfd(self):
+        from ..kernel.timerfd import TimerFd
+
+        return TimerFd(self.host)
+
+    def epoll(self):
+        from ..kernel.epoll import Epoll
+
+        return Epoll()
+
+    def epoll_wait(self, ep, max_events: int = 64):
+        """Blocking epoll_wait (generator)."""
+        return ep.wait(max_events)
+
     def close(self, f) -> None:
         f.close()
 

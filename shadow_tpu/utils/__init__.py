@@ -1,0 +1,1 @@
+"""Utility layer (parity: reference `src/main/utility/`)."""

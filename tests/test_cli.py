@@ -1,0 +1,111 @@
+"""CLI + output-artifact tests: processed-config, sim-stats.json, pcap,
+exit codes, determinism harness (parity: reference `src/test/cli`,
+`src/test/config`, determinism CI)."""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIG = """
+general: {{stop_time: 5s, seed: 11, data_directory: {data_dir}}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+host_defaults:
+  pcap_enabled: true
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - {{path: udp-echo-server, args: ["9000"], start_time: 1s,
+       expected_final_state: running}}
+  client:
+    network_node_id: 0
+    processes:
+    - {{path: udp-client, args: ["server", "9000", "3", "50"], start_time: 2s}}
+"""
+
+
+def run_cli(args, cwd):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "shadow_tpu", *args],
+        cwd=cwd, env=env, capture_output=True, text=True,
+    )
+
+
+def write_config(tmp_path, name="sim.yaml"):
+    cfg = tmp_path / name
+    cfg.write_text(CONFIG.format(data_dir=str(tmp_path / "data")))
+    return cfg
+
+
+def test_cli_run_and_artifacts(tmp_path):
+    cfg = write_config(tmp_path)
+    proc = run_cli([str(cfg)], cwd=tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    data = tmp_path / "data"
+    stats = json.loads((data / "sim-stats.json").read_text())
+    assert stats["process_failures"] == []
+    assert stats["packets_sent"] == 6  # 3 pings + 3 echoes
+    assert stats["hosts"]["client"]["packets_out"] == 3
+    assert (data / "processed-config.yaml").exists()
+    pcap = (data / "hosts" / "client" / "eth0.pcap").read_bytes()
+    magic, = struct.unpack("<I", pcap[:4])
+    assert magic == 0xA1B2C3D4
+
+
+def test_cli_refuses_existing_data_dir(tmp_path):
+    cfg = write_config(tmp_path)
+    (tmp_path / "data").mkdir()
+    proc = run_cli([str(cfg)], cwd=tmp_path)
+    assert proc.returncode == 1
+    assert "exists" in proc.stderr
+    proc = run_cli([str(cfg), "--force"], cwd=tmp_path)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_cli_exit_code_on_process_failure(tmp_path):
+    cfg = tmp_path / "bad.yaml"
+    cfg.write_text(
+        """
+general: {stop_time: 2s, seed: 1, data_directory: %s}
+network: {graph: {type: 1_gbit_switch}}
+hosts:
+  a:
+    network_node_id: 0
+    processes:
+    - {path: udp-echo-server, args: ["1"], start_time: 1s,
+       expected_final_state: {exited: 0}}
+"""
+        % (tmp_path / "data2")
+    )
+    proc = run_cli([str(cfg)], cwd=tmp_path)
+    assert proc.returncode == 1
+    assert "process failure" in proc.stderr or "process failure" in proc.stdout
+
+
+def test_cli_show_config(tmp_path):
+    cfg = write_config(tmp_path)
+    proc = run_cli([str(cfg), "--show-config"], cwd=tmp_path)
+    assert proc.returncode == 0
+    parsed = json.loads(proc.stdout)
+    assert parsed["general"]["seed"] == 11
+    assert "server" in parsed["hosts"]
+
+
+def test_determinism_harness(tmp_path):
+    cfg = write_config(tmp_path)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "compare_runs.py"), str(cfg)],
+        env=env, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "DETERMINISTIC" in proc.stdout

@@ -1,0 +1,217 @@
+"""Kernel file-type tests: pipes, eventfd, timerfd, epoll, descriptor
+table — including in-sim use through processes (parity model:
+`src/test/{pipe,eventfd,timerfd,epoll,dup}`).
+"""
+
+import pytest
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.manager import Manager
+from shadow_tpu.kernel import errors
+from shadow_tpu.kernel.descriptor import DescriptorTable
+from shadow_tpu.kernel.epoll import Epoll, EpollEvents
+from shadow_tpu.kernel.eventfd import EventFd
+from shadow_tpu.kernel.pipe import PIPE_CAPACITY, make_pipe
+from shadow_tpu.kernel.status import FileState
+from shadow_tpu.process.process import SimProcess
+
+MS = simtime.MILLISECOND
+
+
+def _host():
+    cfg = load_config_str(
+        """
+general: {stop_time: 10s, seed: 2}
+network: {graph: {type: 1_gbit_switch}}
+hosts:
+  a: {network_node_id: 0}
+"""
+    )
+    mgr = Manager(cfg)
+    return mgr, mgr.hosts[0]
+
+
+# -- pipes ------------------------------------------------------------
+
+
+def test_pipe_roundtrip_and_eof():
+    r, w = make_pipe()
+    assert w.send(b"hello") == 5
+    assert r.state & FileState.READABLE
+    assert r.recv(3) == b"hel"
+    assert r.recv(100) == b"lo"
+    with pytest.raises(errors.Blocked):
+        r.recv(1)
+    w.close()
+    assert r.recv(1) == b""  # EOF
+
+
+def test_pipe_capacity_and_epipe():
+    r, w = make_pipe()
+    assert w.send(b"x" * (PIPE_CAPACITY + 5)) == PIPE_CAPACITY
+    with pytest.raises(errors.Blocked):
+        w.send(b"y")
+    assert not (w.state & FileState.WRITABLE)
+    r.recv(10)
+    assert w.state & FileState.WRITABLE
+    r.close()
+    with pytest.raises(errors.SyscallError) as e:
+        w.send(b"z")
+    assert e.value.errno == errors.EPIPE
+
+
+# -- eventfd ----------------------------------------------------------
+
+
+def test_eventfd_counter_and_semaphore():
+    e = EventFd()
+    with pytest.raises(errors.Blocked):
+        e.read_value()
+    e.write_value(3)
+    e.write_value(4)
+    assert e.read_value() == 7
+    with pytest.raises(errors.Blocked):
+        e.read_value()
+
+    s = EventFd(2, semaphore=True)
+    assert s.read_value() == 1
+    assert s.read_value() == 1
+    with pytest.raises(errors.Blocked):
+        s.read_value()
+
+
+# -- timerfd ----------------------------------------------------------
+
+
+def test_timerfd_oneshot_and_interval():
+    mgr, host = _host()
+    ticks = []
+
+    def app(api):
+        tfd = api.timerfd()
+        tfd.settime(100 * MS)  # one-shot at +100ms
+        while True:
+            try:
+                n = tfd.read_expirations()
+            except errors.Blocked as b:
+                yield b
+                continue
+            ticks.append((api.now(), n))
+            if len(ticks) == 1:
+                tfd.settime(50 * MS, interval_ns=200 * MS)
+            if len(ticks) >= 4:
+                return
+
+    host.add_application(1 * MS, lambda h: SimProcess(h, "t", app).spawn())
+    mgr.run()
+    times = [t for t, _ in ticks]
+    assert times[0] == 101 * MS
+    assert times[1] == 151 * MS
+    assert times[2] == 351 * MS
+    assert times[3] == 551 * MS
+
+
+# -- epoll ------------------------------------------------------------
+
+
+def test_epoll_level_triggered():
+    r, w = make_pipe()
+    ep = Epoll()
+    ep.add(r, EpollEvents.IN, data="r")
+    ep.add(w, EpollEvents.OUT, data="w")
+    got = dict(ep.ready())
+    assert "w" in got and "r" not in got  # empty pipe: only writable
+    w.send(b"data")
+    got = dict(ep.ready())
+    assert "r" in got  # level-triggered: remains ready until drained
+    got = dict(ep.ready())
+    assert "r" in got
+    r.recv(100)
+    got = dict(ep.ready())
+    assert "r" not in got
+    assert ep.state & FileState.READABLE  # w still ready
+
+
+def test_epoll_edge_triggered():
+    r, w = make_pipe()
+    ep = Epoll()
+    ep.add(r, EpollEvents.IN | EpollEvents.ET, data="r")
+    assert dict(ep.ready()) == {}
+    w.send(b"x")
+    assert "r" in dict(ep.ready())
+    assert "r" not in dict(ep.ready())  # consumed edge
+    # Linux ET: NEW data while already readable is a fresh event
+    # (epoll(7); delivered via the READ_BUFFER_GREW signal path)
+    w.send(b"y")
+    assert "r" in dict(ep.ready())
+    assert "r" not in dict(ep.ready())
+    r.recv(100)  # drain -> off
+    w.send(b"z")  # off->on transition also re-arms
+    assert "r" in dict(ep.ready())
+
+
+def test_epoll_oneshot_and_modify():
+    r, w = make_pipe()
+    ep = Epoll()
+    ep.add(r, EpollEvents.IN | EpollEvents.ONESHOT, data="r")
+    w.send(b"x")
+    assert "r" in dict(ep.ready())
+    assert "r" not in dict(ep.ready())  # disarmed
+    ep.modify(r, EpollEvents.IN)  # re-arm, level-triggered
+    assert "r" in dict(ep.ready())
+    ep.remove(r)
+    assert dict(ep.ready()) == {}
+    with pytest.raises(errors.SyscallError):
+        ep.remove(r)
+
+
+def test_epoll_wait_blocks_process_until_ready():
+    mgr, host = _host()
+    log = []
+
+    def app(api):
+        r, w = api.pipe()
+        ep = api.epoll()
+        ep.add(r, EpollEvents.IN, data="pipe")
+        tfd = api.timerfd()
+        tfd.settime(200 * MS)
+        ep.add(tfd, EpollEvents.IN, data="timer")
+        events = yield from api.epoll_wait(ep)
+        log.append((api.now(), sorted(d for d, _ in events)))
+
+    host.add_application(1 * MS, lambda h: SimProcess(h, "e", app).spawn())
+    mgr.run()
+    assert log == [(201 * MS, ["timer"])]
+
+
+# -- descriptor table -------------------------------------------------
+
+
+def test_descriptor_table_alloc_dup_close():
+    t = DescriptorTable()
+    r, w = make_pipe()
+    fd_r = t.register(r)
+    fd_w = t.register(w)
+    assert (fd_r, fd_w) == (0, 1)
+    fd_r2 = t.dup(fd_r)
+    assert fd_r2 == 2
+    t.close(fd_r)
+    assert not r.is_closed()  # dup still references it
+    t.close(fd_r2)
+    assert r.is_closed()  # last reference closed the file
+    assert t.get(fd_w) is w
+    with pytest.raises(errors.SyscallError):
+        t.get(fd_r)
+    fd_new = t.register(make_pipe()[0])
+    assert fd_new == 0  # lowest free fd reused
+
+
+def test_descriptor_register_at_closes_previous():
+    t = DescriptorTable()
+    r1, w1 = make_pipe()
+    r2, _w2 = make_pipe()
+    fd = t.register(r1)
+    t.register_at(fd, r2)
+    assert r1.is_closed()
+    assert t.get(fd) is r2

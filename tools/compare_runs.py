@@ -1,0 +1,77 @@
+#!/usr/bin/env python3
+"""Determinism harness: run a config twice and diff the deterministic
+artifacts.
+
+Parity: reference determinism CI (`src/test/determinism/CMakeLists.txt` —
+run identical sims twice, strip nondeterministic lines with
+`strip_log_for_compare.py`, diff). Here the deterministic artifacts are
+sim-stats.json (minus wall_seconds) and the per-host pcap captures, which
+encode exact packet timing and content.
+
+Usage: python tools/compare_runs.py <config.yaml> [--runs 2]
+Exit 0 when all runs match bit-for-bit; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_once(config: str, data_dir: str) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu", config, "-d", data_dir, "--force"],
+        env=env, capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        print(proc.stdout, proc.stderr, file=sys.stderr)
+        raise SystemExit(f"run failed (exit {proc.returncode})")
+    with open(os.path.join(data_dir, "sim-stats.json")) as fh:
+        stats = json.load(fh)
+    stats.pop("wall_seconds", None)  # the one legitimately nondeterministic field
+    digest = {"sim-stats": stats}
+    hosts_dir = os.path.join(data_dir, "hosts")
+    if os.path.isdir(hosts_dir):
+        for host in sorted(os.listdir(hosts_dir)):
+            for f in sorted(os.listdir(os.path.join(hosts_dir, host))):
+                path = os.path.join(hosts_dir, host, f)
+                with open(path, "rb") as fh:
+                    digest[f"{host}/{f}"] = hashlib.sha256(fh.read()).hexdigest()
+    return digest
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("config")
+    ap.add_argument("--runs", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    digests = []
+    with tempfile.TemporaryDirectory() as tmp:
+        for i in range(args.runs):
+            digests.append(run_once(args.config, os.path.join(tmp, f"run{i}")))
+    base = digests[0]
+    ok = True
+    for i, d in enumerate(digests[1:], start=2):
+        if d != base:
+            ok = False
+            for key in sorted(set(base) | set(d)):
+                if base.get(key) != d.get(key):
+                    print(f"MISMATCH run1 vs run{i}: {key}")
+                    print(f"  run1: {base.get(key)}")
+                    print(f"  run{i}: {d.get(key)}")
+    print("DETERMINISTIC" if ok else "NONDETERMINISTIC")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
