@@ -1,0 +1,230 @@
+"""Python bindings for the native runtime plane (ctypes).
+
+Parity: this package is the C++ re-implementation of the reference's
+cross-process substrate — `src/lib/shmem` (serializable shared-memory
+blocks), `src/lib/vasi-sync/src/scchannel.rs` (futex rendezvous channels),
+and `src/lib/shadow-shim-helper-rs/src/ipc.rs` + `shim_event.rs` (the
+per-thread IPC block and event protocol). The seccomp/LD_PRELOAD shim that
+rides on it is the next layer up.
+
+Build: `make -C shadow_tpu/interpose` (pure g++, no external deps). The
+bindings load lazily and raise a clear error when the library is missing,
+so the Python planes work without the native build.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libshadow_ipc.so")
+
+SHMEM_HANDLE_MAX = 128
+SCCHANNEL_MSG_MAX = 480
+
+
+class ShMemBlock(ctypes.Structure):
+    _fields_ = [
+        ("addr", ctypes.c_void_p),
+        ("size", ctypes.c_size_t),
+        ("name", ctypes.c_char * 64),
+        ("owner", ctypes.c_int),
+    ]
+
+
+class ShimSyscallArgs(ctypes.Structure):
+    _fields_ = [("number", ctypes.c_int64), ("args", ctypes.c_uint64 * 6)]
+
+
+class ShimSyscallComplete(ctypes.Structure):
+    _fields_ = [
+        ("retval", ctypes.c_int64),
+        ("restartable", ctypes.c_uint32),
+        ("_pad", ctypes.c_uint32),
+    ]
+
+
+class ShimStartReq(ctypes.Structure):
+    _fields_ = [
+        ("host_shmem_handle", ctypes.c_char * SHMEM_HANDLE_MAX),
+        ("process_shmem_handle", ctypes.c_char * SHMEM_HANDLE_MAX),
+        ("thread_shmem_handle", ctypes.c_char * SHMEM_HANDLE_MAX),
+    ]
+
+
+class ShimAddThreadReq(ctypes.Structure):
+    _fields_ = [
+        ("ipc_handle", ctypes.c_char * SHMEM_HANDLE_MAX),
+        ("flags", ctypes.c_uint64),
+        ("child_stack", ctypes.c_uint64),
+        ("ptid", ctypes.c_uint64),
+        ("ctid", ctypes.c_uint64),
+        ("newtls", ctypes.c_uint64),
+    ]
+
+
+class ShimAddThreadRes(ctypes.Structure):
+    _fields_ = [("child_native_tid", ctypes.c_int64)]
+
+
+class _ShimEventUnion(ctypes.Union):
+    _fields_ = [
+        ("syscall", ShimSyscallArgs),
+        ("complete", ShimSyscallComplete),
+        ("start_req", ShimStartReq),
+        ("add_thread_req", ShimAddThreadReq),
+        ("add_thread_res", ShimAddThreadRes),
+    ]
+
+
+class ShimEvent(ctypes.Structure):
+    _fields_ = [
+        ("kind", ctypes.c_uint32),
+        ("_pad", ctypes.c_uint32),
+        ("sim_time_ns", ctypes.c_uint64),
+        ("u", _ShimEventUnion),
+    ]
+
+
+# ShimEventKind values (ipc.h)
+EVENT_NONE = 0
+EVENT_START_REQ = 1
+EVENT_SYSCALL_COMPLETE = 2
+EVENT_SYSCALL_DO_NATIVE = 3
+EVENT_ADD_THREAD_REQ = 4
+EVENT_START_RES = 5
+EVENT_SYSCALL = 6
+EVENT_ADD_THREAD_RES = 7
+EVENT_PROCESS_DEATH = 8
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build(force: bool = False) -> str:
+    """Build the native library with make; returns its path."""
+    if force or not os.path.exists(_LIB_PATH):
+        subprocess.run(
+            ["make", "-C", _DIR], check=True, capture_output=True, text=True
+        )
+    return _LIB_PATH
+
+
+def load() -> ctypes.CDLL:
+    """Load (building if needed) and configure the library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        try:
+            build()
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"native IPC library build failed (run `make -C {_DIR}`):\n"
+                f"{e.stderr}"
+            ) from e
+        except Exception as e:
+            raise RuntimeError(
+                f"native IPC library not built and build failed: {e}; "
+                f"run `make -C {_DIR}`"
+            ) from e
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.shmem_alloc.argtypes = [ctypes.c_size_t, ctypes.POINTER(ShMemBlock)]
+    lib.shmem_alloc.restype = ctypes.c_int
+    lib.shmem_serialize.argtypes = [ctypes.POINTER(ShMemBlock), ctypes.c_char_p]
+    lib.shmem_serialize.restype = ctypes.c_int
+    lib.shmem_deserialize.argtypes = [ctypes.c_char_p, ctypes.POINTER(ShMemBlock)]
+    lib.shmem_deserialize.restype = ctypes.c_int
+    lib.shmem_free.argtypes = [ctypes.POINTER(ShMemBlock)]
+    lib.shmem_free.restype = ctypes.c_int
+    lib.shmem_cleanup.restype = ctypes.c_int
+    for name in ("ipc_to_shim_send", "ipc_to_shadow_send"):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_void_p, ctypes.POINTER(ShimEvent)]
+        fn.restype = ctypes.c_int
+    for name in ("ipc_to_shim_recv", "ipc_to_shadow_recv"):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_void_p, ctypes.POINTER(ShimEvent)]
+        fn.restype = ctypes.c_long
+    lib.ipc_init.argtypes = [ctypes.c_void_p]
+    lib.ipc_close.argtypes = [ctypes.c_void_p]
+    lib.ipc_sizeof.restype = ctypes.c_uint64
+    lib.shim_event_sizeof.restype = ctypes.c_uint64
+    _lib = lib
+    return lib
+
+
+class SharedBlock:
+    """RAII wrapper over ShMemBlock."""
+
+    def __init__(self, size: Optional[int] = None, handle: Optional[str] = None):
+        self._lib = load()
+        self.block = ShMemBlock()
+        if handle is not None:
+            rc = self._lib.shmem_deserialize(handle.encode(), ctypes.byref(self.block))
+        else:
+            rc = self._lib.shmem_alloc(size, ctypes.byref(self.block))
+        if rc != 0:
+            raise OSError(f"shmem {'map' if handle else 'alloc'} failed")
+
+    @property
+    def addr(self) -> int:
+        return self.block.addr
+
+    @property
+    def size(self) -> int:
+        return self.block.size
+
+    def serialize(self) -> str:
+        buf = ctypes.create_string_buffer(SHMEM_HANDLE_MAX)
+        if self._lib.shmem_serialize(ctypes.byref(self.block), buf) != 0:
+            raise OSError("shmem_serialize failed")
+        return buf.value.decode()
+
+    def free(self) -> None:
+        if self.block.addr:
+            self._lib.shmem_free(ctypes.byref(self.block))
+
+
+class IpcChannel:
+    """The per-thread IPCData block, shadow side or shim side."""
+
+    def __init__(self, block: SharedBlock, init: bool = False):
+        self._lib = load()
+        self.block = block
+        if block.size < self._lib.ipc_sizeof():
+            raise ValueError("shmem block too small for IPCData")
+        if init:
+            self._lib.ipc_init(block.addr)
+
+    @classmethod
+    def create(cls) -> "IpcChannel":
+        lib = load()
+        return cls(SharedBlock(size=int(lib.ipc_sizeof())), init=True)
+
+    @classmethod
+    def attach(cls, handle: str) -> "IpcChannel":
+        return cls(SharedBlock(handle=handle), init=False)
+
+    def send_to_shim(self, ev: ShimEvent) -> None:
+        if self._lib.ipc_to_shim_send(self.block.addr, ctypes.byref(ev)) != 0:
+            raise OSError("ipc send failed")
+
+    def recv_from_shadow(self) -> Optional[ShimEvent]:
+        ev = ShimEvent()
+        n = self._lib.ipc_to_shim_recv(self.block.addr, ctypes.byref(ev))
+        return ev if n >= 0 else None
+
+    def send_to_shadow(self, ev: ShimEvent) -> None:
+        if self._lib.ipc_to_shadow_send(self.block.addr, ctypes.byref(ev)) != 0:
+            raise OSError("ipc send failed")
+
+    def recv_from_shim(self) -> Optional[ShimEvent]:
+        ev = ShimEvent()
+        n = self._lib.ipc_to_shadow_recv(self.block.addr, ctypes.byref(ev))
+        return ev if n >= 0 else None
+
+    def close(self) -> None:
+        self._lib.ipc_close(self.block.addr)

@@ -1,0 +1,32 @@
+#include "ipc.h"
+
+#include <string.h>
+
+void ipc_init(IPCData *ipc) {
+    scchannel_init(&ipc->to_shim);
+    scchannel_init(&ipc->to_shadow);
+}
+
+int ipc_to_shim_send(IPCData *ipc, const ShimEvent *ev) {
+    return scchannel_send(&ipc->to_shim, ev, sizeof(*ev));
+}
+
+long ipc_to_shim_recv(IPCData *ipc, ShimEvent *ev) {
+    return scchannel_recv(&ipc->to_shim, ev, sizeof(*ev));
+}
+
+int ipc_to_shadow_send(IPCData *ipc, const ShimEvent *ev) {
+    return scchannel_send(&ipc->to_shadow, ev, sizeof(*ev));
+}
+
+long ipc_to_shadow_recv(IPCData *ipc, ShimEvent *ev) {
+    return scchannel_recv(&ipc->to_shadow, ev, sizeof(*ev));
+}
+
+void ipc_close(IPCData *ipc) {
+    scchannel_close_writer(&ipc->to_shim);
+    scchannel_close_writer(&ipc->to_shadow);
+}
+
+uint64_t ipc_sizeof(void) { return sizeof(IPCData); }
+uint64_t shim_event_sizeof(void) { return sizeof(ShimEvent); }

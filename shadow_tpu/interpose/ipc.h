@@ -1,0 +1,122 @@
+/* The shim IPC vocabulary: event structs + the per-thread IPCData block.
+ *
+ * Parity: reference src/lib/shadow-shim-helper-rs/src/shim_event.rs
+ * (ShimEventToShim / ShimEventToShadow) and ipc.rs (IPCData = one
+ * shadow->plugin channel + one plugin->shadow channel, cache-line aligned
+ * because false sharing between the two directions measurably hurt —
+ * reference ipc.rs:10-14 / PR #2791).
+ *
+ * Everything here crosses address spaces, so every type must be standard
+ * layout and trivially copyable with no pointers — the C++ equivalent of
+ * the reference's VirtualAddressSpaceIndependent derive (src/lib/vasi).
+ */
+#ifndef SHADOW_TPU_IPC_H
+#define SHADOW_TPU_IPC_H
+
+#include <stdint.h>
+
+#include "scchannel.h"
+#include "shmem.h"
+
+#define SHMEM_HANDLE_MAX_IPC SHMEM_HANDLE_MAX
+
+#ifdef __cplusplus
+#include <type_traits>
+#endif
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+enum ShimEventKind {
+    SHIM_EVENT_NONE = 0,
+    /* shadow -> shim */
+    SHIM_EVENT_START_REQ = 1,
+    SHIM_EVENT_SYSCALL_COMPLETE = 2,
+    SHIM_EVENT_SYSCALL_DO_NATIVE = 3,
+    SHIM_EVENT_ADD_THREAD_REQ = 4,
+    /* shim -> shadow */
+    SHIM_EVENT_START_RES = 5,
+    SHIM_EVENT_SYSCALL = 6,
+    SHIM_EVENT_ADD_THREAD_RES = 7,
+    SHIM_EVENT_PROCESS_DEATH = 8,
+};
+
+typedef struct ShimSyscallArgs {
+    int64_t number;
+    uint64_t args[6];
+} ShimSyscallArgs;
+
+typedef struct ShimSyscallComplete {
+    int64_t retval;
+    uint32_t restartable;
+    uint32_t _pad;
+} ShimSyscallComplete;
+
+typedef struct ShimStartReq {
+    /* serialized shmem handles the shim must map at startup */
+    char host_shmem_handle[SHMEM_HANDLE_MAX_IPC];
+    char process_shmem_handle[SHMEM_HANDLE_MAX_IPC];
+    char thread_shmem_handle[SHMEM_HANDLE_MAX_IPC];
+} ShimStartReq;
+
+typedef struct ShimAddThreadReq {
+    char ipc_handle[SHMEM_HANDLE_MAX_IPC];
+    uint64_t flags;       /* clone flags */
+    uint64_t child_stack;
+    uint64_t ptid;
+    uint64_t ctid;
+    uint64_t newtls;
+} ShimAddThreadReq;
+
+typedef struct ShimAddThreadRes {
+    int64_t child_native_tid;
+} ShimAddThreadRes;
+
+typedef struct ShimEvent {
+    uint32_t kind;  /* ShimEventKind */
+    uint32_t _pad;
+    uint64_t sim_time_ns;  /* shim-advanced clock rides along each event */
+    union {
+        ShimSyscallArgs syscall;
+        ShimSyscallComplete complete;
+        ShimStartReq start_req;
+        ShimAddThreadReq add_thread_req;
+        ShimAddThreadRes add_thread_res;
+    } u;
+} ShimEvent;
+
+#ifdef __cplusplus
+#define SHIM_CACHELINE alignas(64)
+#else
+#define SHIM_CACHELINE _Alignas(64)
+#endif
+
+/* One per managed thread, allocated in its own shmem block. */
+typedef struct IPCData {
+    SHIM_CACHELINE SelfContainedChannel to_shim;    /* shadow -> plugin */
+    SHIM_CACHELINE SelfContainedChannel to_shadow;  /* plugin -> shadow */
+} IPCData;
+
+void ipc_init(IPCData *ipc);
+int ipc_to_shim_send(IPCData *ipc, const ShimEvent *ev);
+long ipc_to_shim_recv(IPCData *ipc, ShimEvent *ev);
+int ipc_to_shadow_send(IPCData *ipc, const ShimEvent *ev);
+long ipc_to_shadow_recv(IPCData *ipc, ShimEvent *ev);
+void ipc_close(IPCData *ipc);
+uint64_t ipc_sizeof(void);
+uint64_t shim_event_sizeof(void);
+
+#ifdef __cplusplus
+}
+
+static_assert(std::is_standard_layout<ShimEvent>::value &&
+                  std::is_trivially_copyable<ShimEvent>::value,
+              "ShimEvent must be address-space independent");
+static_assert(std::is_standard_layout<IPCData>::value &&
+                  std::is_trivially_copyable<IPCData>::value,
+              "IPCData must be address-space independent");
+static_assert(sizeof(ShimEvent) <= SCCHANNEL_MSG_MAX,
+              "ShimEvent must fit one channel message");
+#endif
+#endif

@@ -1,0 +1,95 @@
+#include "scchannel.h"
+
+#include <errno.h>
+#include <linux/futex.h>
+#include <string.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#define CLOSED_BIT 0x4u
+#define STATE_MASK 0x3u
+
+static long futex(uint32_t *uaddr, int op, uint32_t val) {
+    return syscall(SYS_futex, uaddr, op, val, NULL, NULL, 0);
+}
+
+static uint32_t load_acq(const uint32_t *p) {
+    return __atomic_load_n(p, __ATOMIC_ACQUIRE);
+}
+
+static int cas(uint32_t *p, uint32_t expect, uint32_t want) {
+    return __atomic_compare_exchange_n(p, &expect, want, 0, __ATOMIC_ACQ_REL,
+                                       __ATOMIC_ACQUIRE);
+}
+
+static void wait_while(uint32_t *word, uint32_t observed) {
+    /* Sleep until *word changes from `observed` (futex handles the race). */
+    futex(word, FUTEX_WAIT, observed);
+}
+
+static void wake_all(uint32_t *word) {
+    futex(word, FUTEX_WAKE, INT32_MAX);
+}
+
+void scchannel_init(SelfContainedChannel *ch) {
+    memset(ch, 0, sizeof(*ch));
+    __atomic_store_n(&ch->state, SCCHANNEL_EMPTY, __ATOMIC_RELEASE);
+}
+
+/* Move the low state bits to `next` with a CAS loop so a concurrent
+ * close_writer fetch_or can never be clobbered by a stale plain store. */
+static void set_state(SelfContainedChannel *ch, uint32_t next) {
+    for (;;) {
+        uint32_t cur = load_acq(&ch->state);
+        if (cas(&ch->state, cur, (cur & CLOSED_BIT) | next)) return;
+    }
+}
+
+int scchannel_send(SelfContainedChannel *ch, const void *buf, uint32_t len) {
+    if (len > SCCHANNEL_MSG_MAX) return -1;
+    for (;;) {
+        uint32_t cur = load_acq(&ch->state);
+        if (cur & CLOSED_BIT) return -1; /* peer is gone: fail, don't hang */
+        uint32_t st = cur & STATE_MASK;
+        if (st == SCCHANNEL_EMPTY) {
+            if (!cas(&ch->state, cur, (cur & CLOSED_BIT) | SCCHANNEL_WRITING))
+                continue;
+            break;
+        }
+        /* previous message unread: rendezvous discipline says wait */
+        wait_while(&ch->state, cur);
+    }
+    memcpy(ch->msg, buf, len);
+    ch->len = len;
+    set_state(ch, SCCHANNEL_READY);
+    wake_all(&ch->state);
+    return 0;
+}
+
+long scchannel_recv(SelfContainedChannel *ch, void *buf, uint32_t cap) {
+    for (;;) {
+        uint32_t cur = load_acq(&ch->state);
+        uint32_t st = cur & STATE_MASK;
+        if (st == SCCHANNEL_READY) {
+            if (!cas(&ch->state, cur, (cur & CLOSED_BIT) | SCCHANNEL_READING))
+                continue;
+            uint32_t n = ch->len;
+            if (n > cap) n = cap;
+            memcpy(buf, ch->msg, n);
+            set_state(ch, SCCHANNEL_EMPTY);
+            wake_all(&ch->state);
+            return (long)n;
+        }
+        if (cur & CLOSED_BIT) return -1; /* closed and nothing pending */
+        wait_while(&ch->state, cur);
+    }
+}
+
+void scchannel_close_writer(SelfContainedChannel *ch) {
+    __atomic_fetch_or(&ch->state, CLOSED_BIT, __ATOMIC_ACQ_REL);
+    wake_all(&ch->state);
+}
+
+int scchannel_writer_closed(const SelfContainedChannel *ch) {
+    return (load_acq(&ch->state) & CLOSED_BIT) != 0;
+}

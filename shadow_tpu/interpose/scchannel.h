@@ -1,0 +1,60 @@
+/* SelfContainedChannel: a two-party rendezvous channel that lives entirely
+ * inside one shared-memory region.
+ *
+ * Parity: reference src/lib/vasi-sync/src/scchannel.rs — states
+ * Empty/Writing/Ready/Reading plus a writer-closed flag; readers block on a
+ * futex until a message (or close) arrives; everything is
+ * position-independent (offsets only, no pointers) so the same bytes work
+ * at different mapped addresses in different processes.
+ *
+ * One channel carries one message at a time (strict rendezvous): that is
+ * exactly the shim IPC pattern — shadow-to-plugin and plugin-to-shadow each
+ * get their own channel inside IPCData (reference ipc.rs), and the two
+ * sides strictly alternate.
+ */
+#ifndef SHADOW_TPU_SCCHANNEL_H
+#define SHADOW_TPU_SCCHANNEL_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define SCCHANNEL_MSG_MAX 480  /* fits IPCData in two cache-lined channels */
+
+enum {
+    SCCHANNEL_EMPTY = 0,
+    SCCHANNEL_WRITING = 1,
+    SCCHANNEL_READY = 2,
+    SCCHANNEL_READING = 3,
+};
+
+typedef struct SelfContainedChannel {
+    /* futex word: low 2 bits = state, bit 2 = writer closed */
+    uint32_t state;
+    uint32_t len;
+    uint8_t msg[SCCHANNEL_MSG_MAX];
+} SelfContainedChannel;
+
+void scchannel_init(SelfContainedChannel *ch);
+
+/* Blocking send; returns 0, or -1 if len > SCCHANNEL_MSG_MAX. Spins/futex
+ * waits while a previous message is still unread. */
+int scchannel_send(SelfContainedChannel *ch, const void *buf, uint32_t len);
+
+/* Blocking receive; returns message length, or -1 when the writer closed
+ * with no message pending (parity: WriterIsClosed). */
+long scchannel_recv(SelfContainedChannel *ch, void *buf, uint32_t cap);
+
+/* Mark the writer side closed and wake any blocked reader (parity: the
+ * ChildPidWatcher closing the channel when a managed process dies). */
+void scchannel_close_writer(SelfContainedChannel *ch);
+
+int scchannel_writer_closed(const SelfContainedChannel *ch);
+
+#ifdef __cplusplus
+}
+#endif
+#endif

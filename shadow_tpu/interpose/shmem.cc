@@ -1,0 +1,97 @@
+#include "shmem.h"
+
+#include <dirent.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <limits.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+static int g_counter = 0;
+
+int shmem_alloc(size_t size, ShMemBlock *out) {
+    if (!out || size == 0) return -1;
+    memset(out, 0, sizeof(*out));
+    snprintf(out->name, sizeof(out->name), "/shadow_tpu_shm_%d_%d",
+             (int)getpid(), __atomic_fetch_add(&g_counter, 1, __ATOMIC_RELAXED));
+    int fd = shm_open(out->name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return -1;
+    if (ftruncate(fd, (off_t)size) != 0) {
+        close(fd);
+        shm_unlink(out->name);
+        return -1;
+    }
+    void *addr = mmap(NULL, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);
+    if (addr == MAP_FAILED) {
+        shm_unlink(out->name);
+        return -1;
+    }
+    out->addr = addr;
+    out->size = size;
+    out->owner = 1;
+    return 0;
+}
+
+int shmem_serialize(const ShMemBlock *block, char *out) {
+    if (!block || !out) return -1;
+    snprintf(out, SHMEM_HANDLE_MAX, "%s:%zu", block->name, block->size);
+    return 0;
+}
+
+int shmem_deserialize(const char *handle, ShMemBlock *out) {
+    if (!handle || !out) return -1;
+    memset(out, 0, sizeof(*out));
+    const char *colon = strrchr(handle, ':');
+    if (!colon) return -1;
+    size_t name_len = (size_t)(colon - handle);
+    if (name_len >= sizeof(out->name)) return -1;
+    memcpy(out->name, handle, name_len);
+    out->name[name_len] = '\0';
+    out->size = strtoull(colon + 1, NULL, 10);
+    if (out->size == 0) return -1;
+    int fd = shm_open(out->name, O_RDWR, 0600);
+    if (fd < 0) return -1;
+    void *addr = mmap(NULL, out->size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);
+    if (addr == MAP_FAILED) return -1;
+    out->addr = addr;
+    out->owner = 0;
+    return 0;
+}
+
+int shmem_free(ShMemBlock *block) {
+    if (!block || !block->addr) return -1;
+    munmap(block->addr, block->size);
+    int rc = 0;
+    if (block->owner) rc = shm_unlink(block->name);
+    block->addr = NULL;
+    return rc;
+}
+
+int shmem_cleanup(void) {
+    DIR *d = opendir("/dev/shm");
+    if (!d) return 0;
+    int removed = 0;
+    struct dirent *e;
+    char self_prefix[64];
+    snprintf(self_prefix, sizeof(self_prefix), "shadow_tpu_shm_%d_", (int)getpid());
+    while ((e = readdir(d)) != NULL) {
+        if (strncmp(e->d_name, "shadow_tpu_shm_", 15) != 0) continue;
+        if (strncmp(e->d_name, self_prefix, strlen(self_prefix)) == 0) continue;
+        /* Reclaim only when the owner is provably dead (ESRCH); EPERM
+         * means alive-but-other-user — leave those alone. */
+        int pid = atoi(e->d_name + 15);
+        if (pid > 0 && !(kill(pid, 0) != 0 && errno == ESRCH)) continue;
+        char path[NAME_MAX + 2];
+        snprintf(path, sizeof(path), "/%s", e->d_name);
+        if (shm_unlink(path) == 0) removed++;
+    }
+    closedir(d);
+    return removed;
+}

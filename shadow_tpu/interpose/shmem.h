@@ -1,0 +1,54 @@
+/* Cross-process shared-memory blocks with serializable handles.
+ *
+ * Parity: reference src/lib/shmem (Rust) — an allocator whose blocks can be
+ * serialized to a string handle, passed to another process (over IPC or
+ * argv/env), and mapped there at a different address. All data structures
+ * placed inside must therefore be position-independent (no raw pointers) —
+ * the property the reference proves with the VirtualAddressSpaceIndependent
+ * trait (src/lib/vasi) and we assert with standard-layout/trivially-copyable
+ * static_asserts in ipc.h.
+ *
+ * Implementation: one POSIX shm object (shm_open) per block. The reference
+ * sub-allocates pools; block-per-allocation is simpler and sufficient for
+ * the per-thread IPC blocks the interposition plane needs (one IPCData per
+ * managed thread, reference ipc.rs).
+ */
+#ifndef SHADOW_TPU_SHMEM_H
+#define SHADOW_TPU_SHMEM_H
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Serialized handle: fits in a fixed buffer, printable, NUL-terminated. */
+#define SHMEM_HANDLE_MAX 128
+
+typedef struct ShMemBlock {
+    void *addr;
+    size_t size;
+    char name[64];  /* shm object name, e.g. "/shadow_tpu_shm_<pid>_<n>" */
+    int owner;      /* owner unlinks the shm object on free */
+} ShMemBlock;
+
+/* Allocate a zeroed shared block; returns 0 on success. */
+int shmem_alloc(size_t size, ShMemBlock *out);
+
+/* Write a printable handle for the block into out[SHMEM_HANDLE_MAX]. */
+int shmem_serialize(const ShMemBlock *block, char *out);
+
+/* Map a block from a serialized handle (in another process). */
+int shmem_deserialize(const char *handle, ShMemBlock *out);
+
+/* Unmap; the owning side also unlinks the shm object. */
+int shmem_free(ShMemBlock *block);
+
+/* Unlink any leftover shadow_tpu shm objects from dead runs
+ * (parity: shadow.rs shm_cleanup). Returns number removed. */
+int shmem_cleanup(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif

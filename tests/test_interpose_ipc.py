@@ -1,0 +1,145 @@
+"""Native runtime plane tests: shared-memory blocks crossing a real process
+boundary, futex channel rendezvous, shim event round-trips, writer-close
+semantics (parity model: reference shmem/scchannel/ipc unit tests +
+ChildPidWatcher close behavior).
+"""
+
+import ctypes
+import os
+import signal
+import struct
+import sys
+
+import pytest
+
+from shadow_tpu import interpose
+from shadow_tpu.interpose import (
+    EVENT_PROCESS_DEATH,
+    EVENT_SYSCALL,
+    EVENT_SYSCALL_COMPLETE,
+    IpcChannel,
+    SharedBlock,
+    ShimEvent,
+)
+
+
+@pytest.fixture(scope="module")
+def lib():
+    return interpose.load()
+
+
+def test_layout_contract(lib):
+    """ctypes structs must match the C++ layout exactly."""
+    assert ctypes.sizeof(ShimEvent) == lib.shim_event_sizeof()
+    assert lib.ipc_sizeof() >= 2 * 64  # two cache-aligned channels
+
+
+def test_shmem_roundtrip_same_process(lib):
+    b = SharedBlock(size=4096)
+    try:
+        handle = b.serialize()
+        assert handle.startswith("/shadow_tpu_shm_")
+        ctypes.memmove(b.addr, b"hello shmem", 11)
+        b2 = SharedBlock(handle=handle)
+        data = ctypes.string_at(b2.addr, 11)
+        assert data == b"hello shmem"
+        # writes through the second mapping appear in the first
+        ctypes.memmove(b2.addr, b"HELLO", 5)
+        assert ctypes.string_at(b.addr, 11) == b"HELLO shmem"
+        b2.free()
+    finally:
+        b.free()
+
+
+def test_ipc_cross_process_syscall_roundtrip(lib):
+    """Fork a real child ('the shim side'); exchange syscall events over the
+    futex channels through shared memory — the managed_thread resume loop in
+    miniature (`managed_thread.rs:185-322`)."""
+    ipc = IpcChannel.create()
+    handle = ipc.block.serialize()
+
+    pid = os.fork()
+    if pid == 0:
+        # child: the shim side
+        try:
+            shim = IpcChannel.attach(handle)
+            for _ in range(3):
+                # "make a syscall": send nr + args, await completion
+                ev = ShimEvent()
+                ev.kind = EVENT_SYSCALL
+                ev.sim_time_ns = 42
+                ev.u.syscall.number = 39  # getpid
+                shim.send_to_shadow(ev)
+                reply = shim.recv_from_shadow()
+                assert reply is not None
+                assert reply.kind == EVENT_SYSCALL_COMPLETE
+                assert reply.u.complete.retval == 1000
+            death = ShimEvent()
+            death.kind = EVENT_PROCESS_DEATH
+            shim.send_to_shadow(death)
+            os._exit(0)
+        except BaseException:
+            os._exit(1)
+
+    # parent: the shadow side
+    handled = 0
+    while True:
+        ev = ipc.recv_from_shim()
+        assert ev is not None
+        if ev.kind == EVENT_PROCESS_DEATH:
+            break
+        assert ev.kind == EVENT_SYSCALL
+        assert ev.u.syscall.number == 39
+        assert ev.sim_time_ns == 42
+        reply = ShimEvent()
+        reply.kind = EVENT_SYSCALL_COMPLETE
+        reply.u.complete.retval = 1000
+        reply.u.complete.restartable = 1
+        ipc.send_to_shim(reply)
+        handled += 1
+    _, status = os.waitpid(pid, 0)
+    assert os.waitstatus_to_exitcode(status) == 0
+    assert handled == 3
+    ipc.block.free()
+
+
+def test_writer_close_unblocks_reader(lib):
+    """A dying 'managed process' closes the channel; the blocked shadow-side
+    recv returns closed instead of hanging (ChildPidWatcher semantics,
+    `managed_thread.rs:444-447`)."""
+    ipc = IpcChannel.create()
+    handle = ipc.block.serialize()
+
+    pid = os.fork()
+    if pid == 0:
+        shim = IpcChannel.attach(handle)
+        shim.close()  # abrupt death: close both directions, send nothing
+        os._exit(0)
+
+    got = ipc.recv_from_shim()  # blocks on the futex until the close wakes it
+    assert got is None  # WriterIsClosed
+    os.waitpid(pid, 0)
+    ipc.block.free()
+
+
+def test_shmem_cleanup_ignores_live_blocks(lib):
+    b = SharedBlock(size=256)
+    try:
+        removed = lib.shmem_cleanup()
+        # our own (live-pid) block must survive
+        b2 = SharedBlock(handle=b.serialize())
+        b2.free()
+        assert removed >= 0
+    finally:
+        b.free()
+
+
+def test_send_on_closed_channel_fails_fast(lib):
+    """Sending to a dead peer returns an error instead of blocking forever."""
+    ipc = IpcChannel.create()
+    ipc.close()
+    ev = ShimEvent()
+    ev.kind = EVENT_SYSCALL
+    with pytest.raises(OSError):
+        ipc.send_to_shim(ev)
+    ipc.block.free()
