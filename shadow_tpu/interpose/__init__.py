@@ -103,9 +103,16 @@ EVENT_PROCESS_DEATH = 8
 _lib: Optional[ctypes.CDLL] = None
 
 
+SHIM_LIB_PATH = os.path.join(_DIR, "libshadow_shim.so")
+
+
 def build(force: bool = False) -> str:
-    """Build the native library with make; returns its path."""
-    if force or not os.path.exists(_LIB_PATH):
+    """Build the native libraries with make; returns the IPC lib path."""
+    if (
+        force
+        or not os.path.exists(_LIB_PATH)
+        or not os.path.exists(SHIM_LIB_PATH)
+    ):
         subprocess.run(
             ["make", "-C", _DIR], check=True, capture_output=True, text=True
         )

@@ -1,0 +1,150 @@
+/* vDSO patching: force the kernel's userspace time functions onto the
+ * syscall path so the seccomp filter can trap them.
+ *
+ * Parity: reference src/lib/shim/patch_vdso.c — locate [vdso] via the
+ * auxv, walk .dynsym/.dynstr, and overwrite the entry points of
+ * clock_gettime / gettimeofday / time / getcpu. The reference injects
+ * jump trampolines to replacement functions; here each function is
+ * overwritten *in place* with `mov eax, NR; syscall; ret` (8 bytes,
+ * argument registers already correct), which avoids the reference's
+ * jump-offset range fallbacks entirely: the syscall executes at a vDSO
+ * instruction pointer, outside shim_text, so the filter traps it and the
+ * simulator serves virtual time.
+ *
+ * Must run BEFORE the seccomp filter is installed (mprotect + plain libc
+ * calls are used freely here).
+ */
+
+#include <elf.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/auxv.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+namespace {
+
+struct Target {
+    const char *name;
+    uint32_t nr;  /* x86_64 syscall number */
+};
+
+const Target kTargets[] = {
+    {"clock_gettime", 228},   {"__vdso_clock_gettime", 228},
+    {"gettimeofday", 96},     {"__vdso_gettimeofday", 96},
+    {"time", 201},            {"__vdso_time", 201},
+    {"getcpu", 309},          {"__vdso_getcpu", 309},
+    {"clock_getres", 229},    {"__vdso_clock_getres", 229},
+};
+
+const Elf64_Shdr *find_section(const Elf64_Ehdr *ehdr, const char *want) {
+    if (ehdr->e_shoff == 0 || ehdr->e_shstrndx == SHN_UNDEF) return nullptr;
+    const Elf64_Shdr *sections =
+        (const Elf64_Shdr *)((const char *)ehdr + ehdr->e_shoff);
+    const char *names =
+        (const char *)ehdr + sections[ehdr->e_shstrndx].sh_offset;
+    for (int i = 0; i < ehdr->e_shnum; i++) {
+        if (strcmp(names + sections[i].sh_name, want) == 0) return &sections[i];
+    }
+    return nullptr;
+}
+
+/* mov eax, imm32; syscall; ret */
+void write_stub(uint8_t *at, uint32_t nr) {
+    at[0] = 0xb8;
+    memcpy(at + 1, &nr, 4);
+    at[5] = 0x0f;
+    at[6] = 0x05;
+    at[7] = 0xc3;
+}
+
+/* Some kernels export the vDSO time functions as 5-byte `jmp rel32` stubs
+ * into a shared internal implementation (symbol sizes too small for our
+ * 8-byte stub). Follow such jumps to the real entry before patching. */
+uint8_t *resolve_entry(uint8_t *addr, uintptr_t lo, uintptr_t hi) {
+    for (int hops = 0; hops < 4; hops++) {
+        if ((uintptr_t)addr < lo || (uintptr_t)addr + 5 > hi) return nullptr;
+        if (addr[0] != 0xe9) return addr;
+        int32_t rel;
+        memcpy(&rel, addr + 1, 4);
+        addr = addr + 5 + rel;
+    }
+    return nullptr;
+}
+
+/* [vdso] bounds from /proc/self/maps (reference _getVdsoBounds). */
+int vdso_bounds(uintptr_t *start, uintptr_t *end) {
+    FILE *maps = fopen("/proc/self/maps", "r");
+    if (!maps) return -1;
+    char line[512];
+    int found = -1;
+    while (fgets(line, sizeof(line), maps)) {
+        if (!strstr(line, "[vdso]")) continue;
+        unsigned long lo, hi;
+        if (sscanf(line, "%lx-%lx", &lo, &hi) == 2) {
+            *start = lo;
+            *end = hi;
+            found = 0;
+        }
+        break;
+    }
+    fclose(maps);
+    return found;
+}
+
+}  // namespace
+
+extern "C" int shadow_tpu_patch_vdso(void) {
+    const Elf64_Ehdr *ehdr = (const Elf64_Ehdr *)getauxval(AT_SYSINFO_EHDR);
+    if (!ehdr) return -1;
+    if (memcmp(ehdr->e_ident, ELFMAG, SELFMAG) != 0) return -1;
+
+    const Elf64_Shdr *dynsym = find_section(ehdr, ".dynsym");
+    const Elf64_Shdr *dynstr = find_section(ehdr, ".dynstr");
+    if (!dynsym || !dynstr || dynsym->sh_entsize == 0) return -1;
+    const Elf64_Sym *syms =
+        (const Elf64_Sym *)((const char *)ehdr + dynsym->sh_offset);
+    const char *strs = (const char *)ehdr + dynstr->sh_offset;
+    size_t nsyms = dynsym->sh_size / dynsym->sh_entsize;
+
+    uintptr_t base = (uintptr_t)ehdr;
+    uintptr_t map_lo = 0, map_hi = 0;
+    if (vdso_bounds(&map_lo, &map_hi) != 0 || base < map_lo || base >= map_hi)
+        return -1;
+    size_t span = map_hi - base;
+    if (mprotect((void *)base, span, PROT_READ | PROT_WRITE | PROT_EXEC) != 0)
+        return -1;
+
+    int patched = 0;
+    uint8_t *done_addr[16];
+    uint32_t done_nr[16];
+    int n_done = 0;
+    for (size_t i = 0; i < nsyms; i++) {
+        const char *name = strs + syms[i].st_name;
+        for (const Target &t : kTargets) {
+            if (strcmp(name, t.name) != 0) continue;
+            if (syms[i].st_value == 0) continue;
+            uint8_t *entry = resolve_entry(
+                (uint8_t *)(base + syms[i].st_value), base, base + span);
+            if (!entry || (uintptr_t)entry + 8 > base + span) continue;
+            bool conflict = false, dup = false;
+            for (int d = 0; d < n_done; d++) {
+                if (done_addr[d] != entry) continue;
+                if (done_nr[d] == t.nr) dup = true;
+                else conflict = true;  /* two syscalls share an impl: skip */
+            }
+            if (dup || conflict) continue;
+            write_stub(entry, t.nr);
+            if (n_done < 16) {
+                done_addr[n_done] = entry;
+                done_nr[n_done] = t.nr;
+                n_done++;
+            }
+            patched++;
+        }
+    }
+    mprotect((void *)base, span, PROT_READ | PROT_EXEC);
+    return patched;
+}

@@ -9,8 +9,14 @@
 #define CLOSED_BIT 0x4u
 #define STATE_MASK 0x3u
 
+/* The futex syscall goes through the shared shim_text stub so the managed
+ * process's seccomp filter (IP-range whitelist) never traps the channel's
+ * own blocking machinery. */
+#include "shim_syscall.h"
+
 static long futex(uint32_t *uaddr, int op, uint32_t val) {
-    return syscall(SYS_futex, uaddr, op, val, NULL, NULL, 0);
+    return shim_text_syscall(SYS_futex, (long)(uintptr_t)uaddr, op, val, 0, 0,
+                             0);
 }
 
 static uint32_t load_acq(const uint32_t *p) {

@@ -1,0 +1,175 @@
+/* The interposition shim: LD_PRELOADed into managed processes.
+ *
+ * Parity: reference src/lib/shim — on load, attach the IPC shared-memory
+ * block named by SHADOW_TPU_IPC_HANDLE, install a seccomp filter that
+ * allows syscalls issued from the shim's own text range and traps every
+ * other syscall to SIGSYS (shim_seccomp.c:144-260), then forward each
+ * trapped syscall to the simulator over the futex channel and either
+ * return the simulated result or re-execute natively from shim text
+ * (shim_api_syscall.c / shim_sys.c). The reference also patches the vDSO
+ * so clock_gettime etc. take the syscall path (patch_vdso.c) — same here,
+ * by overwriting vDSO entry points with a jump to a trapping stub.
+ *
+ * Scope (round 1): single-threaded managed processes; clone/fork are
+ * answered natively but child threads are not yet individually managed.
+ */
+
+#define _GNU_SOURCE 1
+#include <errno.h>
+#include <linux/audit.h>
+#include <linux/filter.h>
+#include <linux/seccomp.h>
+#include <signal.h>
+#include <stddef.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/prctl.h>
+#include <sys/syscall.h>
+#include <ucontext.h>
+#include <unistd.h>
+
+#include "ipc.h"
+#include "shmem.h"
+
+/* ------------------------------------------------------------------ */
+/* Raw syscall from *shim text* — the only code the seccomp filter
+ * whitelists by instruction pointer. Must not call into libc. */
+
+#include "shim_syscall.h"
+#define shim_raw_syscall shim_text_syscall
+
+/* ------------------------------------------------------------------ */
+
+extern "C" int shadow_tpu_patch_vdso(void);
+
+static ShMemBlock g_ipc_block;
+static IPCData *g_ipc = NULL;
+static int g_interposing = 0;
+
+/* The seccomp IP whitelist covers the "shim_text" section, which holds
+ * every syscall *instruction* the shim itself executes (shim_raw_syscall
+ * here, raw_futex in scchannel.cc). The linker defines the bounds. */
+extern char __start_shim_text[];
+extern char __stop_shim_text[];
+
+static void shim_log(const char *msg) {
+    if (getenv("SHADOW_TPU_SHIM_DEBUG"))
+        shim_raw_syscall(SYS_write, 2, (long)msg, (long)strlen(msg), 0, 0, 0);
+}
+
+/* Forward one syscall to the simulator; returns the value to hand back. */
+static long shim_emulate_syscall(long nr, const uint64_t args[6]) {
+    ShimEvent ev;
+    memset(&ev, 0, sizeof(ev));
+    ev.kind = SHIM_EVENT_SYSCALL;
+    ev.u.syscall.number = nr;
+    for (int i = 0; i < 6; i++) ev.u.syscall.args[i] = args[i];
+    if (ipc_to_shadow_send(g_ipc, &ev) != 0) {
+        /* simulator is gone: die quietly */
+        shim_raw_syscall(SYS_exit_group, 1, 0, 0, 0, 0, 0);
+    }
+    ShimEvent reply;
+    long n = ipc_to_shim_recv(g_ipc, &reply);
+    if (n < 0) shim_raw_syscall(SYS_exit_group, 1, 0, 0, 0, 0, 0);
+    if (reply.kind == SHIM_EVENT_SYSCALL_DO_NATIVE) {
+        return shim_raw_syscall(nr, (long)args[0], (long)args[1], (long)args[2],
+                                (long)args[3], (long)args[4], (long)args[5]);
+    }
+    return reply.u.complete.retval;
+}
+
+static void shim_sigsys_handler(int sig, siginfo_t *info, void *ucontext) {
+    (void)sig;
+    ucontext_t *ctx = (ucontext_t *)ucontext;
+    greg_t *regs = ctx->uc_mcontext.gregs;
+    long nr = info->si_syscall;
+    uint64_t args[6] = {
+        (uint64_t)regs[REG_RDI], (uint64_t)regs[REG_RSI],
+        (uint64_t)regs[REG_RDX], (uint64_t)regs[REG_R10],
+        (uint64_t)regs[REG_R8],  (uint64_t)regs[REG_R9],
+    };
+    regs[REG_RAX] = shim_emulate_syscall(nr, args);
+}
+
+/* ------------------------------------------------------------------ */
+
+static int install_seccomp_filter(void) {
+    uintptr_t lo = (uintptr_t)__start_shim_text;
+    uintptr_t hi = (uintptr_t)__stop_shim_text;
+    if (hi <= lo) return -1;
+
+    struct sock_filter filter[] = {
+        /* A = arch; bail (allow) on non-x86_64 just in case */
+        BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                 offsetof(struct seccomp_data, arch)),
+        BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, AUDIT_ARCH_X86_64, 1, 0),
+        BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW),
+        /* allow rt_sigreturn (signal trampoline lives outside shim text) */
+        BPF_STMT(BPF_LD | BPF_W | BPF_ABS, offsetof(struct seccomp_data, nr)),
+        BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, SYS_rt_sigreturn, 0, 1),
+        BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW),
+        /* allow when instruction_pointer in [lo, hi) — the shim itself */
+        BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                 offsetof(struct seccomp_data, instruction_pointer) + 4),
+        BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, (uint32_t)(lo >> 32), 0, 4),
+        BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                 offsetof(struct seccomp_data, instruction_pointer)),
+        BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, (uint32_t)lo, 0, 2),
+        BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, (uint32_t)hi, 1, 0),
+        BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW),
+        /* everything else traps to SIGSYS */
+        BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_TRAP),
+    };
+    struct sock_fprog prog = {
+        .len = (unsigned short)(sizeof(filter) / sizeof(filter[0])),
+        .filter = filter,
+    };
+    if (prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) != 0) return -1;
+    if (prctl(PR_SET_SECCOMP, SECCOMP_MODE_FILTER, &prog) != 0) return -1;
+    return 0;
+}
+
+/* ------------------------------------------------------------------ */
+
+__attribute__((constructor)) static void shim_init(void) {
+    const char *handle = getenv("SHADOW_TPU_IPC_HANDLE");
+    if (!handle || !*handle) return; /* not under the simulator */
+
+    if (shmem_deserialize(handle, &g_ipc_block) != 0) {
+        fprintf(stderr, "shadow_tpu shim: cannot map IPC block %s\n", handle);
+        _exit(112);
+    }
+    g_ipc = (IPCData *)g_ipc_block.addr;
+
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_sigaction = shim_sigsys_handler;
+    sa.sa_flags = SA_SIGINFO | SA_NODEFER;
+    if (sigaction(SIGSYS, &sa, NULL) != 0) _exit(113);
+
+    /* force vDSO time functions onto the (trappable) syscall path */
+    if (shadow_tpu_patch_vdso() <= 0)
+        shim_log("shadow_tpu shim: vdso patch failed (libc time may leak real time)\n");
+
+    /* announce readiness (carries our pid for the simulator's records) */
+    ShimEvent ev;
+    memset(&ev, 0, sizeof(ev));
+    ev.kind = SHIM_EVENT_START_RES;
+    ev.u.add_thread_res.child_native_tid = (int64_t)getpid();
+    ipc_to_shadow_send(g_ipc, &ev);
+
+    if (install_seccomp_filter() != 0) _exit(114);
+    g_interposing = 1;
+    shim_log("shadow_tpu shim: interposition active\n");
+}
+
+__attribute__((destructor)) static void shim_fini(void) {
+    if (g_ipc && g_interposing) {
+        ShimEvent ev;
+        memset(&ev, 0, sizeof(ev));
+        ev.kind = SHIM_EVENT_PROCESS_DEATH;
+        ipc_to_shadow_send(g_ipc, &ev);
+    }
+}

@@ -1,0 +1,30 @@
+/* The one raw-syscall primitive shared by all shim-side code.
+ *
+ * Lives in the "shim_text" linker section: the seccomp filter whitelists
+ * exactly [__start_shim_text, __stop_shim_text), so syscall instructions
+ * here execute natively while everything else in the process traps to
+ * SIGSYS (reference shim_seccomp.c's shim-IP allowance). `static` gives
+ * each translation unit its own copy — both land in the section.
+ *
+ * Must not call libc (libc IPs would trap, recursing into the handler).
+ */
+#ifndef SHADOW_TPU_SHIM_SYSCALL_H
+#define SHADOW_TPU_SHIM_SYSCALL_H
+
+#define SHIM_TEXT __attribute__((section("shim_text"), noinline, unused))
+
+SHIM_TEXT static long shim_text_syscall(long nr, long a1, long a2, long a3,
+                                        long a4, long a5, long a6) {
+    register long r10 __asm__("r10") = a4;
+    register long r8 __asm__("r8") = a5;
+    register long r9 __asm__("r9") = a6;
+    long ret;
+    __asm__ volatile("syscall"
+                     : "=a"(ret)
+                     : "a"(nr), "D"(a1), "S"(a2), "d"(a3), "r"(r10), "r"(r8),
+                       "r"(r9)
+                     : "rcx", "r11", "memory");
+    return ret;
+}
+
+#endif

@@ -1,0 +1,232 @@
+"""Managed native processes: real Linux binaries under interposition.
+
+Parity: reference `src/main/host/managed_thread.rs` + `process.rs` — spawn
+the binary with the shim preloaded (`inject_preloads`,
+`managed_thread.rs:546-640`), then service its syscalls over the
+shared-memory IPC channel: each trapped syscall arrives as a `ShimEvent`,
+and the simulator answers with an emulated result (`SyscallComplete`) or
+tells the shim to execute it natively (`SyscallDoNative`) — the dispatch
+split in `syscall/handler/mod.rs`.
+
+Round-1 scope: the syscall server virtualizes *time* (clock_gettime /
+gettimeofday / time / nanosleep / clock_nanosleep answered from the
+simulation clock, sleeps advancing it with zero wall-time) and identity
+(getpid), passes everything else through natively, and reads/writes the
+managed process's memory with process_vm_readv/writev — the
+`MemoryCopier` half of the reference's memory manager
+(`memory_copier.rs:185,246`). Full event-loop integration (one Host task
+per resume, blocking syscalls parking on conditions) is the next layer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import os
+import struct
+import subprocess
+import threading
+from typing import Callable, Optional
+
+from ..core import simtime
+from ..interpose import (
+    EVENT_PROCESS_DEATH,
+    EVENT_START_RES,
+    EVENT_SYSCALL,
+    EVENT_SYSCALL_COMPLETE,
+    EVENT_SYSCALL_DO_NATIVE,
+    IpcChannel,
+    ShimEvent,
+)
+
+_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "interpose")
+SHIM_PATH = os.path.join(_DIR, "libshadow_shim.so")
+
+# x86_64 syscall numbers the server emulates
+SYS_write = 1
+SYS_getpid = 39
+SYS_nanosleep = 35
+SYS_gettimeofday = 96
+SYS_time = 201
+SYS_clock_gettime = 228
+SYS_clock_nanosleep = 230
+SYS_exit_group = 231
+
+_libc = ctypes.CDLL(None, use_errno=True)
+
+
+class _IoVec(ctypes.Structure):
+    _fields_ = [("iov_base", ctypes.c_void_p), ("iov_len", ctypes.c_size_t)]
+
+
+class MemoryCopier:
+    """Read/write another process's memory (`memory_copier.rs`)."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+
+    def read(self, remote_addr: int, n: int) -> bytes:
+        buf = ctypes.create_string_buffer(n)
+        local = _IoVec(ctypes.cast(buf, ctypes.c_void_p), n)
+        remote = _IoVec(ctypes.c_void_p(remote_addr), n)
+        got = _libc.process_vm_readv(
+            self.pid, ctypes.byref(local), 1, ctypes.byref(remote), 1, 0
+        )
+        if got != n:
+            raise OSError(ctypes.get_errno(), "process_vm_readv failed")
+        return buf.raw
+
+    def write(self, remote_addr: int, data: bytes) -> None:
+        buf = ctypes.create_string_buffer(data, len(data))
+        local = _IoVec(ctypes.cast(buf, ctypes.c_void_p), len(data))
+        remote = _IoVec(ctypes.c_void_p(remote_addr), len(data))
+        got = _libc.process_vm_writev(
+            self.pid, ctypes.byref(local), 1, ctypes.byref(remote), 1, 0
+        )
+        if got != len(data):
+            raise OSError(ctypes.get_errno(), "process_vm_writev failed")
+
+
+class SyscallServer:
+    """Answers one managed process's syscall stream with virtual time.
+
+    `clock` returns the simulation time in ns; `advance` moves it forward
+    (standalone use drives a plain counter; event-loop integration hands
+    these to the Host)."""
+
+    def __init__(self, *, virtual_pid: int = 1000,
+                 clock: Optional[Callable[[], int]] = None,
+                 advance: Optional[Callable[[int], None]] = None):
+        self._vtime = 0
+        self.clock = clock or (lambda: self._vtime)
+        self.advance = advance or self._advance_own
+        self.virtual_pid = virtual_pid
+        self.syscall_counts: dict[int, int] = {}
+        self.mem: Optional[MemoryCopier] = None
+
+    def _advance_own(self, delta_ns: int) -> None:
+        self._vtime += delta_ns
+
+    # -- dispatch -------------------------------------------------------
+
+    def handle(self, nr: int, args) -> Optional[int]:
+        """Returns an emulated retval, or None for native passthrough."""
+        self.syscall_counts[nr] = self.syscall_counts.get(nr, 0) + 1
+        if nr == SYS_getpid:
+            return self.virtual_pid
+        if nr == SYS_clock_gettime:
+            return self._clock_gettime(args[0], args[1])
+        if nr == SYS_gettimeofday:
+            return self._gettimeofday(args[0])
+        if nr == SYS_time:
+            t = simtime.emulated_from_sim(self.clock()) // simtime.SECOND
+            if args[0]:
+                self.mem.write(args[0], struct.pack("<q", t))
+            return t
+        if nr in (SYS_nanosleep, SYS_clock_nanosleep):
+            return self._nanosleep(nr, args)
+        return None  # DO_NATIVE
+
+    def _clock_gettime(self, clockid: int, ts_addr: int) -> int:
+        now = self.clock()
+        if clockid in (1, 4, 6):  # MONOTONIC, MONOTONIC_RAW, MONOTONIC_COARSE
+            ns = now
+        else:  # REALTIME & friends observe the emulated epoch
+            ns = simtime.emulated_from_sim(now)
+        if ts_addr:
+            self.mem.write(ts_addr, struct.pack("<qq", ns // 10**9, ns % 10**9))
+        return 0
+
+    def _gettimeofday(self, tv_addr: int) -> int:
+        ns = simtime.emulated_from_sim(self.clock())
+        if tv_addr:
+            self.mem.write(tv_addr, struct.pack("<qq", ns // 10**9,
+                                                (ns % 10**9) // 1000))
+        return 0
+
+    def _nanosleep(self, nr: int, args) -> int:
+        TIMER_ABSTIME = 1
+        req_addr = args[2] if nr == SYS_clock_nanosleep else args[0]
+        raw = self.mem.read(req_addr, 16)
+        sec, nsec = struct.unpack("<qq", raw)
+        t = sec * simtime.SECOND + nsec
+        if nr == SYS_clock_nanosleep and args[1] & TIMER_ABSTIME:
+            # absolute deadline on the given clock; REALTIME deadlines are
+            # relative to the emulated epoch
+            clockid = args[0]
+            now = self.clock() if clockid in (1, 4, 6) else simtime.emulated_from_sim(self.clock())
+            t -= now
+        if t > 0:
+            self.advance(t)
+        return 0
+
+
+class ManagedProcess:
+    """Spawn + serve one native binary under the shim."""
+
+    def __init__(self, argv: list[str], server: Optional[SyscallServer] = None,
+                 capture_output: bool = True, env: Optional[dict] = None):
+        if not os.path.exists(SHIM_PATH):
+            from .. import interpose
+
+            interpose.build()
+        self.server = server or SyscallServer()
+        self.ipc = IpcChannel.create()
+        full_env = dict(env if env is not None else os.environ)
+        # preload injection (`managed_thread.rs` inject_preloads)
+        preload = full_env.get("LD_PRELOAD", "")
+        full_env["LD_PRELOAD"] = (
+            SHIM_PATH + (" " + preload if preload else "")
+        )
+        full_env["SHADOW_TPU_IPC_HANDLE"] = self.ipc.block.serialize()
+        self.proc = subprocess.Popen(
+            argv,
+            env=full_env,
+            stdout=subprocess.PIPE if capture_output else None,
+            stderr=subprocess.PIPE if capture_output else None,
+        )
+        self.server.mem = MemoryCopier(self.proc.pid)
+        self.native_pid: Optional[int] = None
+        self.death_seen = threading.Event()
+        self._serve_thread = threading.Thread(target=self._serve, daemon=True)
+        self._serve_thread.start()
+
+    def _serve(self) -> None:
+        while True:
+            ev = self.ipc.recv_from_shim()
+            if ev is None:
+                return  # channel closed
+            if ev.kind == EVENT_START_RES:
+                self.native_pid = int(ev.u.add_thread_res.child_native_tid)
+                continue
+            if ev.kind == EVENT_PROCESS_DEATH:
+                self.death_seen.set()
+                continue
+            if ev.kind != EVENT_SYSCALL:
+                continue
+            nr = int(ev.u.syscall.number)
+            args = [int(ev.u.syscall.args[i]) for i in range(6)]
+            try:
+                ret = self.server.handle(nr, args)
+            except OSError:
+                ret = None  # memory gone (racing exit): let it run natively
+            reply = ShimEvent()
+            if ret is None:
+                reply.kind = EVENT_SYSCALL_DO_NATIVE
+            else:
+                reply.kind = EVENT_SYSCALL_COMPLETE
+                reply.u.complete.retval = ret
+                reply.u.complete.restartable = 1
+            try:
+                self.ipc.send_to_shim(reply)
+            except OSError:
+                return
+
+    def wait(self, timeout: Optional[float] = None):
+        """Wait for exit; returns (exit_code, stdout, stderr)."""
+        out, err = self.proc.communicate(timeout=timeout)
+        self.ipc.close()  # unblock the server thread
+        self._serve_thread.join(timeout=5)
+        self.ipc.block.free()  # unlink the /dev/shm object
+        return self.proc.returncode, out, err

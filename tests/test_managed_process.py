@@ -1,0 +1,153 @@
+"""Interposition end-to-end: a REAL native binary (compiled in the test)
+runs under the LD_PRELOAD + seccomp shim; its syscalls are trapped,
+forwarded over shared-memory IPC, and answered with *virtual* time —
+5 simulated seconds of sleeping pass in near-zero wall time.
+
+Parity model: the reference's core claim (`README.md:18-63` — directly
+executes real unmodified binaries, co-opted via syscall interposition)
+and its linux-vs-shadow dual test pattern (`src/test/CMakeLists.txt`).
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+from shadow_tpu.process.managed import ManagedProcess, SyscallServer
+
+CC = shutil.which("gcc") or shutil.which("cc")
+
+TEST_PROGRAM = r"""
+#include <stdio.h>
+#include <time.h>
+#include <unistd.h>
+#include <sys/syscall.h>
+
+int main(void) {
+    struct timespec ts;
+    syscall(SYS_clock_gettime, CLOCK_MONOTONIC, &ts);
+    long t0_sec = ts.tv_sec, t0_nsec = ts.tv_nsec;
+
+    struct timespec req = {5, 0};  /* five SIMULATED seconds */
+    syscall(SYS_nanosleep, &req, (void *)0);
+
+    syscall(SYS_clock_gettime, CLOCK_MONOTONIC, &ts);
+    long pid = syscall(SYS_getpid);
+    printf("pid=%ld start=%ld.%09ld elapsed=%ld\n",
+           pid, t0_sec, t0_nsec, ts.tv_sec - t0_sec);
+
+    /* REALTIME clock observes the emulated epoch (2000-01-01) */
+    syscall(SYS_clock_gettime, CLOCK_REALTIME, &ts);
+    printf("realtime=%ld\n", ts.tv_sec);
+    return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def test_binary(tmp_path_factory):
+    if CC is None:
+        pytest.skip("no C compiler")
+    d = tmp_path_factory.mktemp("managed")
+    src = d / "vtime.c"
+    src.write_text(TEST_PROGRAM)
+    binary = d / "vtime"
+    subprocess.run([CC, "-O1", "-o", str(binary), str(src)], check=True)
+    return str(binary)
+
+
+def test_binary_runs_unmanaged(test_binary):
+    """The linux half of the dual-execution pattern: the binary itself is
+    valid (elapsed ~5 REAL seconds would be too slow; use a quick check
+    that it at least starts and prints a pid)."""
+    proc = subprocess.run([test_binary], capture_output=True, text=True,
+                          timeout=10)
+    assert proc.returncode == 0
+    assert "pid=" in proc.stdout
+
+
+def test_virtual_time_under_interposition(test_binary):
+    server = SyscallServer(virtual_pid=4242)
+    wall_start = time.monotonic()
+    mp = ManagedProcess([test_binary], server=server)
+    code, out, err = mp.wait(timeout=30)
+    wall = time.monotonic() - wall_start
+    text = out.decode()
+    assert code == 0, (code, text, err.decode())
+    first_line = text.strip().splitlines()[0]
+    parts = dict(p.split("=") for p in first_line.split())
+    assert int(parts["pid"]) == 4242  # virtual pid, not the real one
+    assert parts["start"].startswith("0.")  # virtual monotonic starts at 0
+    assert int(parts["elapsed"]) == 5  # five virtual seconds elapsed
+    assert wall < 10.0  # ...in approximately zero wall time
+    # realtime clock sits at the emulated epoch (2000-01-01 => 946684800)
+    realtime = int(text.strip().splitlines()[1].split("=")[1])
+    assert 946684800 <= realtime <= 946684800 + 10
+    # the server actually saw the syscalls
+    assert server.syscall_counts.get(228, 0) >= 3  # clock_gettime
+    assert server.syscall_counts.get(35, 0) == 1  # nanosleep
+    assert mp.native_pid is not None and mp.native_pid != 4242
+
+
+def test_interposition_is_transparent_to_output(test_binary):
+    """stdout write()s pass through natively and are captured intact."""
+    mp = ManagedProcess([test_binary])
+    code, out, _err = mp.wait(timeout=30)
+    assert code == 0
+    assert out.decode().startswith("pid=1000 ")
+
+
+def test_real_coreutils_under_shim():
+    """An unmodified system binary (/bin/echo) survives full interposition."""
+    echo = shutil.which("echo")
+    if echo is None:
+        pytest.skip("no echo binary")
+    mp = ManagedProcess([echo, "hello", "managed", "world"])
+    code, out, _err = mp.wait(timeout=30)
+    assert code == 0
+    assert out == b"hello managed world\n"
+
+
+LIBC_TIME_PROGRAM = r"""
+#include <stdio.h>
+#include <time.h>
+#include <sys/time.h>
+int main(void) {
+    /* plain libc calls — normally served by the vDSO without any syscall;
+       the shim's vdso patching forces them onto the trappable path */
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    long t0 = ts.tv_sec;
+    struct timespec req = {7, 0};
+    nanosleep(&req, NULL);
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    struct timeval tv;
+    gettimeofday(&tv, NULL);
+    printf("elapsed=%ld realtime=%ld\n", ts.tv_sec - t0, (long)tv.tv_sec);
+    return 0;
+}
+"""
+
+
+def test_vdso_time_virtualized(tmp_path):
+    """libc/vDSO-routed time is virtualized, not just raw syscalls
+    (reference patch_vdso.c capability)."""
+    if CC is None:
+        pytest.skip("no C compiler")
+    src = tmp_path / "libc_time.c"
+    src.write_text(LIBC_TIME_PROGRAM)
+    binary = tmp_path / "libc_time"
+    subprocess.run([CC, "-O1", "-o", str(binary), str(src)], check=True)
+
+    wall_start = time.monotonic()
+    mp = ManagedProcess([str(binary)])
+    code, out, _err = mp.wait(timeout=30)
+    wall = time.monotonic() - wall_start
+    assert code == 0
+    parts = dict(p.split("=") for p in out.decode().split())
+    assert int(parts["elapsed"]) == 7  # virtual seconds via plain libc calls
+    assert 946684800 <= int(parts["realtime"]) <= 946684900  # emulated epoch
+    assert wall < 10.0
