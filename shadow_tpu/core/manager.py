@@ -219,12 +219,35 @@ class Manager:
 
         self._spawned = getattr(self, "_spawned", [])
         for i, popt in enumerate(opts.processes):
-            app = app_registry.resolve(popt.path)
+            # app-registry coroutines first; real executables run as managed
+            # native processes under the interposition shim
+            app = None
+            try:
+                app = app_registry.resolve(popt.path)
+            except ValueError:
+                import shutil as _shutil
+
+                if not (os.path.isfile(popt.path) and os.access(popt.path, os.X_OK)) \
+                        and _shutil.which(popt.path) is None:
+                    raise
             proc_name = f"{host_name}.{popt.path.rsplit('/', 1)[-1]}.{i}"
             cell: dict = {}
 
-            def spawn(h, app=app, popt=popt, proc_name=proc_name, cell=cell):
-                proc = SimProcess(h, proc_name, app, tuple(popt.args))
+            def spawn(h, app=app, popt=popt, proc_name=proc_name, cell=cell,
+                      host_name=host_name):
+                if app is not None:
+                    proc = SimProcess(h, proc_name, app, tuple(popt.args))
+                else:
+                    from ..process.managed import ManagedSimProcess
+
+                    out_dir = (
+                        os.path.join(self.data_dir, "hosts", host_name)
+                        if self.data_dir else None
+                    )
+                    proc = ManagedSimProcess(
+                        h, proc_name, [popt.path, *popt.args],
+                        output_dir=out_dir,
+                    )
                 cell["proc"] = proc
                 proc.spawn()
                 if cell.get("pending_kill") is not None and proc.is_alive:

@@ -29,6 +29,7 @@ import threading
 from typing import Callable, Optional
 
 from ..core import simtime
+from .process import ProcessState
 from ..interpose import (
     EVENT_PROCESS_DEATH,
     EVENT_START_RES,
@@ -230,3 +231,188 @@ class ManagedProcess:
         self._serve_thread.join(timeout=5)
         self.ipc.block.free()  # unlink the /dev/shm object
         return self.proc.returncode, out, err
+
+
+class ManagedSimProcess:
+    """A native binary coordinated by the simulation event loop.
+
+    Parity: the reference's resume model (`managed_thread.rs:185-322`,
+    `Host::resume` `host.rs:474-501`): the worker thread executing this
+    host hands control to the plugin (which runs natively, sim time frozen)
+    and services its syscalls inline until one *blocks*; blocking sleeps
+    become scheduled host tasks that deliver the completion later, so
+    emulated time advances only through the event loop.
+
+    Round-1 syscall surface: time/identity virtualized from the host
+    clock, sleeps event-scheduled, everything else native passthrough
+    (network syscalls join in the next round's handler table).
+    """
+
+    def __init__(self, host, name: str, argv: list[str],
+                 output_dir: Optional[str] = None):
+        self.host = host
+        self.name = name
+        self.argv = argv
+        self.pid = host.next_pid()
+        self.state = ProcessState.PENDING
+        self.exit_status: Optional[int] = None
+        self.kill_signal: Optional[int] = None
+        self.server = SyscallServer(virtual_pid=self.pid,
+                                    clock=lambda: self.host.now())
+        self.ipc: Optional[IpcChannel] = None
+        self.proc = None
+        self._death_seen = False
+        self._output_dir = output_dir
+        self._stdout = self._stderr = None
+        host.processes.append(self)
+
+    @property
+    def is_alive(self) -> bool:
+        return self.state in (ProcessState.PENDING, ProcessState.RUNNING)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def spawn(self) -> None:
+        assert self.state == ProcessState.PENDING
+        if not os.path.exists(SHIM_PATH):
+            from .. import interpose
+
+            interpose.build()
+        self.ipc = IpcChannel.create()
+        env = dict(os.environ)
+        preload = env.get("LD_PRELOAD", "")
+        env["LD_PRELOAD"] = SHIM_PATH + (" " + preload if preload else "")
+        env["SHADOW_TPU_IPC_HANDLE"] = self.ipc.block.serialize()
+        if self._output_dir:
+            os.makedirs(self._output_dir, exist_ok=True)
+            self._stdout = open(os.path.join(self._output_dir,
+                                             f"{self.name}.stdout"), "wb")
+            self._stderr = open(os.path.join(self._output_dir,
+                                             f"{self.name}.stderr"), "wb")
+        self.proc = subprocess.Popen(
+            self.argv, env=env,
+            stdout=self._stdout or subprocess.DEVNULL,
+            stderr=self._stderr or subprocess.DEVNULL,
+        )
+        self.server.mem = MemoryCopier(self.proc.pid)
+        self.state = ProcessState.RUNNING
+        self._resume()
+
+    def stop(self, signal_nr: int = 15) -> None:
+        if self.state != ProcessState.RUNNING or self.proc is None:
+            return
+        self.proc.send_signal(signal_nr)
+        try:
+            self.proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=5)
+        self.state = ProcessState.KILLED
+        self.kill_signal = signal_nr
+        self._cleanup()
+
+    # -- the inline resume loop ----------------------------------------
+
+    def _resume(self) -> None:
+        """Service the plugin until it blocks or dies (runs on the worker
+        thread currently executing this host, like the reference)."""
+        while True:
+            ev = self.ipc.recv_from_shim()
+            if ev is None:
+                self._reap()
+                return
+            if ev.kind == EVENT_START_RES:
+                continue
+            if ev.kind == EVENT_PROCESS_DEATH:
+                self._death_seen = True
+                continue
+            if ev.kind != EVENT_SYSCALL:
+                continue
+            nr = int(ev.u.syscall.number)
+            args = [int(ev.u.syscall.args[i]) for i in range(6)]
+
+            if nr in (SYS_nanosleep, SYS_clock_nanosleep):
+                delay = self._sleep_duration(nr, args)
+                if delay > 0:
+                    # park: the shim stays blocked in recv until the timer
+                    # task sends the completion (SysCallCondition analogue)
+                    from ..core.event import TaskRef
+
+                    self.host.schedule_task_with_delay(
+                        TaskRef(lambda h: self._finish_sleep(), "managed-sleep"),
+                        delay,
+                    )
+                    return
+                self._reply_complete(0)
+                continue
+
+            try:
+                ret = self.server.handle(nr, args)
+            except OSError:
+                ret = None
+            if ret is None:
+                self._reply_native()
+            else:
+                self._reply_complete(ret)
+            if nr == SYS_exit_group:
+                self._reap()
+                return
+
+    def _sleep_duration(self, nr: int, args) -> int:
+        try:
+            raw = self.server.mem.read(
+                args[2] if nr == SYS_clock_nanosleep else args[0], 16
+            )
+        except OSError:
+            return 0
+        sec, nsec = struct.unpack("<qq", raw)
+        t = sec * simtime.SECOND + nsec
+        if nr == SYS_clock_nanosleep and args[1] & 1:  # TIMER_ABSTIME
+            clockid = args[0]
+            now = (self.host.now() if clockid in (1, 4, 6)
+                   else simtime.emulated_from_sim(self.host.now()))
+            t -= now
+        return max(0, t)
+
+    def _finish_sleep(self) -> None:
+        if self.state != ProcessState.RUNNING:
+            return
+        self._reply_complete(0)
+        self._resume()
+
+    def _reply_complete(self, retval: int) -> None:
+        reply = ShimEvent()
+        reply.kind = EVENT_SYSCALL_COMPLETE
+        reply.u.complete.retval = retval
+        reply.u.complete.restartable = 1
+        try:
+            self.ipc.send_to_shim(reply)
+        except OSError:
+            pass
+
+    def _reply_native(self) -> None:
+        reply = ShimEvent()
+        reply.kind = EVENT_SYSCALL_DO_NATIVE
+        try:
+            self.ipc.send_to_shim(reply)
+        except OSError:
+            pass
+
+    def _reap(self) -> None:
+        try:
+            self.exit_status = self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.exit_status = self.proc.wait(timeout=5)
+        self.state = ProcessState.EXITED
+        self._cleanup()
+
+    def _cleanup(self) -> None:
+        if self.ipc is not None:
+            self.ipc.close()
+            self.ipc.block.free()
+            self.ipc = None
+        for fh in (self._stdout, self._stderr):
+            if fh is not None:
+                fh.close()
+        self._stdout = self._stderr = None

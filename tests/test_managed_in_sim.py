@@ -1,0 +1,103 @@
+"""Managed native processes inside the simulation event loop: /bin/sleep
+and compiled binaries run under the shim with their sleeps scheduled as
+host events — emulated time, not wall time, decides when they finish.
+
+Parity model: the reference's whole point — real binaries inside the
+discrete-event simulation (`docs/design_2x.md`).
+"""
+
+import shutil
+import subprocess
+import time
+
+import pytest
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.manager import Manager
+from shadow_tpu.process.process import ProcessState
+
+S = simtime.SECOND
+
+SLEEP = shutil.which("sleep")
+
+
+@pytest.mark.skipif(SLEEP is None, reason="no sleep binary")
+def test_bin_sleep_finishes_in_simulated_time():
+    """/bin/sleep 30 completes inside a 60s simulation in ~zero wall time;
+    a 30s simulation ends with it still running."""
+    cfg_text = """
+general: {{stop_time: {stop}, seed: 1}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  box:
+    network_node_id: 0
+    processes:
+    - {{path: {sleep}, args: ["30"], start_time: 1s,
+       expected_final_state: {expect}}}
+"""
+    wall_start = time.monotonic()
+    stats = Manager(
+        load_config_str(cfg_text.format(stop="60s", sleep=SLEEP,
+                                        expect="{exited: 0}"))
+    ).run()
+    wall = time.monotonic() - wall_start
+    assert stats.process_failures == [], stats.process_failures
+    assert wall < 15.0  # 30 simulated seconds, not 30 real ones
+
+    stats = Manager(
+        load_config_str(cfg_text.format(stop="20s", sleep=SLEEP,
+                                        expect="running"))
+    ).run()
+    assert stats.process_failures == [], stats.process_failures
+
+
+def test_mixed_native_and_coroutine_processes(tmp_path):
+    """A compiled binary and coroutine apps share one simulation; the
+    binary's virtual clock tracks the same host timeline."""
+    cc = shutil.which("gcc") or shutil.which("cc")
+    if cc is None:
+        pytest.skip("no C compiler")
+    src = tmp_path / "ticker.c"
+    src.write_text(
+        r"""
+#include <stdio.h>
+#include <time.h>
+int main(void) {
+    for (int i = 0; i < 3; i++) {
+        struct timespec req = {2, 0};
+        nanosleep(&req, 0);
+        struct timespec ts;
+        clock_gettime(CLOCK_MONOTONIC, &ts);
+        printf("tick %ld\n", (long)ts.tv_sec);
+    }
+    return 0;
+}
+"""
+    )
+    binary = tmp_path / "ticker"
+    subprocess.run([cc, "-O1", "-o", str(binary), str(src)], check=True)
+
+    cfg = load_config_str(
+        f"""
+general: {{stop_time: 30s, seed: 2, data_directory: {tmp_path}/data}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  native:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, start_time: 1s, expected_final_state: {{exited: 0}}}}
+  pyapp:
+    network_node_id: 0
+    processes:
+    - {{path: udp-echo-server, args: ["9000"], start_time: 1s,
+       expected_final_state: running}}
+"""
+    )
+    mgr = Manager(cfg, data_dir=str(tmp_path / "data"))
+    stats = mgr.run()
+    assert stats.process_failures == [], stats.process_failures
+    out = (tmp_path / "data" / "hosts" / "native" /
+           "native.ticker.0.stdout").read_bytes()
+    # started at sim 1s; ticks at 3, 5, 7 virtual seconds
+    assert out == b"tick 3\ntick 5\ntick 7\n"
