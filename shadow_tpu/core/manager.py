@@ -173,9 +173,15 @@ class Manager:
             self.trackers = {
                 h.name: Tracker(h, hb) for h in self.hosts
             }
-            packet_mod.status_trace_hook = _tracker_dispatch
+            # per-instance wrapper so run()'s cleanup can tell OUR hook from
+            # one installed by a different Manager in the same process
+            self._status_hook = lambda packet, status: _tracker_dispatch(
+                packet, status
+            )
+            packet_mod.status_trace_hook = self._status_hook
         else:
             self.trackers = {}
+            self._status_hook = None
 
     # ------------------------------------------------------------------
 
@@ -303,40 +309,52 @@ class Manager:
 
     def run(self) -> SimStats:
         wall_start = _walltime.monotonic()
+        try:
+            # round 0: boot all hosts (schedules application-start tasks)
+            for host in self._host_order:
+                host.boot()
+            for tracker in self.trackers.values():
+                tracker.start()
 
-        # round 0: boot all hosts (schedules application-start tasks)
-        for host in self._host_order:
-            host.boot()
-        for tracker in self.trackers.values():
-            tracker.start()
-
-        # the scheduling loop (`manager.rs:392-478`)
-        min_next = min(
-            (t for t in (h.next_event_time() for h in self.hosts) if t is not None),
-            default=None,
-        )
-        window = self.controller.next_window(min_next)
-        while window is not None:
-            start, end = window
-            min_next = self.scheduler.run_round(self._host_order, end)
-            self.stats.rounds += 1
+            # the scheduling loop (`manager.rs:392-478`)
+            min_next = min(
+                (t for t in (h.next_event_time() for h in self.hosts) if t is not None),
+                default=None,
+            )
             window = self.controller.next_window(min_next)
+            while window is not None:
+                start, end = window
+                min_next = self.scheduler.run_round(self._host_order, end)
+                self.stats.rounds += 1
+                window = self.controller.next_window(min_next)
 
-        # expected-final-state check happens before teardown kills everyone
-        self.stats.process_failures = self._check_final_states()
+            # expected-final-state check happens before teardown kills everyone
+            self.stats.process_failures = self._check_final_states()
 
-        # teardown (`manager.rs:480-489`)
-        for host in self._host_order:
-            host.shutdown()
-        self.scheduler.join()
+            # teardown (`manager.rs:480-489`)
+            for host in self._host_order:
+                host.shutdown()
+            self.scheduler.join()
 
-        self.stats.sim_time_ns = self.config.general.stop_time
-        self.stats.packets_sent = int(self.routing.packet_counters.sum())
-        self.stats.packets_dropped = self.shared.packet_drop_count
-        self.stats.wall_seconds = _walltime.monotonic() - wall_start
-        for writer in self._pcap_writers:
-            writer.close()
-        return self.stats
+            self.stats.sim_time_ns = self.config.general.stop_time
+            self.stats.packets_sent = int(self.routing.packet_counters.sum())
+            self.stats.packets_dropped = self.shared.packet_drop_count
+            self.stats.wall_seconds = _walltime.monotonic() - wall_start
+            for writer in self._pcap_writers:
+                writer.close()
+            return self.stats
+        finally:
+            # drop the process-wide status hook so later Manager instances
+            # in the same process don't pay per-packet dispatch to a stale
+            # tracker set (only if it is still ours — a newer Manager may
+            # have installed its own)
+            from ..net import packet as packet_mod
+
+            if (
+                self._status_hook is not None
+                and packet_mod.status_trace_hook is self._status_hook
+            ):
+                packet_mod.status_trace_hook = None
 
     def host_stats(self) -> dict:
         """Per-host tracker counters for sim-stats.json."""

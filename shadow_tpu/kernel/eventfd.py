@@ -19,6 +19,13 @@ class EventFd(StatefulFile):
         self.counter = initval
         self.semaphore = semaphore
         self.nonblocking = False
+        # Smallest value a blocked writer is waiting to add (0 = none). The
+        # poll-visible WRITABLE bit keeps Linux's "a write of 1 won't block"
+        # meaning; blocked writers wait on EVENTFD_WRITE_SPACE, which turns
+        # on once a read makes room for the smallest waiter. Tracking the
+        # min means wakeups can be spurious (a larger waiter retries and
+        # re-blocks) but never missed.
+        self._pending_write = 0
         self._refresh()
 
     def read_value(self) -> int:
@@ -44,8 +51,14 @@ class EventFd(StatefulFile):
         if self.counter + value > _MAX:
             if self.nonblocking:
                 raise errors.SyscallError(errors.EWOULDBLOCK)
-            raise errors.Blocked(self, FileState.WRITABLE)
+            self._pending_write = (
+                value if self._pending_write == 0
+                else min(self._pending_write, value)
+            )
+            self._refresh()
+            raise errors.Blocked(self, FileState.EVENTFD_WRITE_SPACE)
         self.counter += value
+        self._pending_write = 0
         self._refresh()
 
     def close(self) -> None:
@@ -64,4 +77,9 @@ class EventFd(StatefulFile):
             values |= FileState.READABLE
         if self.counter + 1 <= _MAX:
             values |= FileState.WRITABLE
-        self.update_state(FileState.READABLE | FileState.WRITABLE, values)
+        if self.counter + max(1, self._pending_write) <= _MAX:
+            values |= FileState.EVENTFD_WRITE_SPACE
+        self.update_state(
+            FileState.READABLE | FileState.WRITABLE | FileState.EVENTFD_WRITE_SPACE,
+            values,
+        )

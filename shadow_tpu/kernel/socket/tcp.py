@@ -367,6 +367,8 @@ class TcpSocket(StatefulFile):
         self._staged = segment_to_packet(
             seg, src, self.peer_addr, self._host.get_next_packet_priority()
         )
+        if getattr(self.conn, "last_segment_retransmit", False):
+            self._staged.add_status(PacketStatus.SND_TCP_RETRANSMITTED)
         return True
 
     def _effective_src(self) -> tuple[str, int]:

@@ -30,6 +30,10 @@ class FileState(enum.IntFlag):
     SOCKET_ALLOWING_CONNECT = 1 << 4
     FUTEX_WAKEUP = 1 << 5
     CHILD_EVENTS = 1 << 6
+    # eventfd-internal: room for the largest value a blocked writer is
+    # waiting to add (distinct from WRITABLE, which keeps poll's "a write
+    # of 1 won't block" meaning).
+    EVENTFD_WRITE_SPACE = 1 << 7
 
 
 class FileSignal(enum.IntFlag):

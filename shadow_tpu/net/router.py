@@ -6,8 +6,8 @@ unbounded limit (`codel_queue.rs:23-33`). The router holds packets inbound
 from the simulated internet until the host pops them.
 
 TPU note: the CoDel decision (standing delay vs TARGET, control-law drop
-times) is pure arithmetic on enqueue timestamps — the TPU plane implements the
-same law over ring-buffer timestamp arrays (see `shadow_tpu/tpu/netplane.py`).
+times) is pure arithmetic on enqueue timestamps, which makes it a natural
+fit for ring-buffer timestamp arrays on device (`shadow_tpu/tpu/plane.py`).
 """
 
 from __future__ import annotations

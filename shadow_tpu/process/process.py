@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import enum
 import ipaddress
+import logging
 from typing import Callable, Generator, Optional
 
 from ..core.event import TaskRef
@@ -37,6 +38,9 @@ from ..kernel.socket.tcp import TcpSocket
 from ..kernel.socket.udp import UdpSocket
 from ..kernel.status import FileState
 from .condition import SysCallCondition
+
+log = logging.getLogger("shadow_tpu.process")
+
 
 class ProcessState(enum.Enum):
     PENDING = "pending"
@@ -116,10 +120,24 @@ class SimProcess:
         except StopIteration as stop:
             self._finish(stop.value if isinstance(stop.value, int) else 0)
             return
+        except errors.Blocked:
+            # A blocking op was *raised* instead of yielded — an app bug the
+            # generator contract can't express; surface it loudly.
+            log.warning(
+                "process %r raised Blocked instead of yielding it; blocking "
+                "ops must be driven with `yield from api....`",
+                self.name, exc_info=True,
+            )
+            self._finish(1)
+            return
         except Exception:
             # Any uncaught app error (errno, assertion, bug) is an abnormal
             # exit of THIS process, never a simulator crash — the analogue
-            # of a plugin error (`worker.rs:589-604`).
+            # of a plugin error, which the reference logs (`worker.rs:589-604`).
+            log.warning(
+                "process %r exited abnormally with an uncaught exception",
+                self.name, exc_info=True,
+            )
             self._finish(1)
             return
         if not isinstance(blocked, errors.Blocked):

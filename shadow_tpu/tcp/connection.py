@@ -167,6 +167,7 @@ class TcpConnection:
         self.fin_sent = False
         self.fin_acked = False
         self._syn_outstanding = False  # our SYN/SYN-ACK is in flight
+        self._syn_sends = 0  # builds of our SYN; >1 means handshake retransmit
         self.syn_acked = False
         self._retx_pending = False  # rebuild a segment at snd_una
         self._probe_pending = False  # zero-window probe: 1 byte past window
@@ -331,7 +332,16 @@ class TcpConnection:
         if kind is None:
             return None
         builder = getattr(self, f"_build_{kind}")
-        return builder()
+        self.last_segment_kind = kind
+        seg = builder()
+        # visible to the socket wrapper so retransmissions can be stamped
+        # with SND_TCP_RETRANSMITTED for the tracker (`tracker.c:24-41`);
+        # covers handshake RTOs (kind 'syn' rebuilt after _on_rto_fire)
+        # as well as data retransmits and zero-window probes
+        self.last_segment_retransmit = kind in ("retransmit", "probe") or (
+            kind == "syn" and self._syn_sends > 1
+        )
+        return seg
 
     def _next_kind(self) -> Optional[str]:
         if self._rst_pending:
@@ -405,6 +415,9 @@ class TcpConnection:
 
     def _build_syn(self) -> Segment:
         self._syn_outstanding = True
+        self._syn_sends += 1
+        if self._syn_sends > 1:
+            self.retransmit_count += 1
         if self.state == TcpState.SYN_SENT:
             flags, ack = TcpFlags.SYN, 0
         else:  # SYN_RCVD: SYN|ACK

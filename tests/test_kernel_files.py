@@ -81,6 +81,30 @@ def test_eventfd_counter_and_semaphore():
         s.read_value()
 
 
+def test_eventfd_blocked_write_waits_for_read():
+    """A write that would overflow parks on a state bit that is OFF until a
+    read makes room for that write's value — not on the always-on WRITABLE
+    bit (which would spin the retry loop at the same sim time)."""
+    from shadow_tpu.kernel.status import FileState
+
+    e = EventFd(0)
+    big = (1 << 64) - 3  # fills the counter completely
+    e.write_value(big)
+    with pytest.raises(errors.Blocked) as bi:
+        e.write_value(5)
+    mask = bi.value.state_mask
+    # the armed condition must NOT be satisfied yet
+    assert not (e.state & mask)
+    # a read drains the counter; now the blocked write's value fits
+    assert e.read_value() == big
+    assert e.state & mask
+    e.write_value(5)
+    assert e.read_value() == 5
+    # poll-visible WRITABLE semantics unchanged: write of 1 possible
+    e2 = EventFd(0)
+    assert e2.state & FileState.WRITABLE
+
+
 # -- timerfd ----------------------------------------------------------
 
 
