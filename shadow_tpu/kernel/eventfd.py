@@ -51,10 +51,18 @@ class EventFd(StatefulFile):
         if self.counter + value > _MAX:
             if self.nonblocking:
                 raise errors.SyscallError(errors.EWOULDBLOCK)
-            self._pending_write = (
-                value if self._pending_write == 0
-                else min(self._pending_write, value)
-            )
+            if self.state & FileState.EVENTFD_WRITE_SPACE:
+                # The bit is on yet this write doesn't fit: whatever smaller
+                # value it was advertising is stale (a cancelled writer) or
+                # already consumed (every armed waiter fired when it turned
+                # on). Re-seed from this writer so the bit turns OFF instead
+                # of livelocking an immediate-wakeup retry loop.
+                self._pending_write = value
+            else:
+                self._pending_write = (
+                    value if self._pending_write == 0
+                    else min(self._pending_write, value)
+                )
             self._refresh()
             raise errors.Blocked(self, FileState.EVENTFD_WRITE_SPACE)
         self.counter += value

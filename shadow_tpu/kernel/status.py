@@ -30,9 +30,10 @@ class FileState(enum.IntFlag):
     SOCKET_ALLOWING_CONNECT = 1 << 4
     FUTEX_WAKEUP = 1 << 5
     CHILD_EVENTS = 1 << 6
-    # eventfd-internal: room for the largest value a blocked writer is
+    # eventfd-internal: room for the SMALLEST value a blocked writer is
     # waiting to add (distinct from WRITABLE, which keeps poll's "a write
-    # of 1 won't block" meaning).
+    # of 1 won't block" meaning). Wakeups may be spurious for larger
+    # waiters — they must retry and re-block — but are never missed.
     EVENTFD_WRITE_SPACE = 1 << 7
 
 

@@ -48,6 +48,7 @@ SHIM_PATH = os.path.join(_DIR, "libshadow_shim.so")
 SYS_write = 1
 SYS_getpid = 39
 SYS_nanosleep = 35
+SYS_kill = 62
 SYS_gettimeofday = 96
 SYS_time = 201
 SYS_clock_gettime = 228
@@ -103,6 +104,7 @@ class SyscallServer:
         self.clock = clock or (lambda: self._vtime)
         self.advance = advance or self._advance_own
         self.virtual_pid = virtual_pid
+        self.native_pid: Optional[int] = None  # set once the child is spawned
         self.syscall_counts: dict[int, int] = {}
         self.mem: Optional[MemoryCopier] = None
 
@@ -127,7 +129,28 @@ class SyscallServer:
             return t
         if nr in (SYS_nanosleep, SYS_clock_nanosleep):
             return self._nanosleep(nr, args)
+        if nr == SYS_kill:
+            return self._kill(args[0], args[1])
         return None  # DO_NATIVE
+
+    def _kill(self, target: int, sig: int) -> Optional[int]:
+        """kill(2) with pid translation: the process only knows virtual
+        pids (getpid returns one), so a native passthrough would target an
+        unrelated — or nonexistent — real process. Translate the pids we
+        know; fail with ESRCH for ones we don't rather than leak a signal
+        outside the simulation (`process.rs:1309` signal dispatch)."""
+        import errno as _errno
+
+        target = ctypes.c_int64(target).value  # sign-extend from u64
+        if target in (self.virtual_pid, 0, -self.virtual_pid) and self.native_pid:
+            try:
+                os.kill(self.native_pid, sig)
+            except ProcessLookupError:
+                return -_errno.ESRCH
+            except PermissionError:
+                return -_errno.EPERM
+            return 0
+        return -_errno.ESRCH
 
     def _clock_gettime(self, clockid: int, ts_addr: int) -> int:
         now = self.clock()
@@ -188,6 +211,7 @@ class ManagedProcess:
             stderr=subprocess.PIPE if capture_output else None,
         )
         self.server.mem = MemoryCopier(self.proc.pid)
+        self.server.native_pid = self.proc.pid
         self.native_pid: Optional[int] = None
         self.death_seen = threading.Event()
         self._serve_thread = threading.Thread(target=self._serve, daemon=True)
@@ -264,6 +288,10 @@ class ManagedSimProcess:
         self._death_seen = False
         self._output_dir = output_dir
         self._stdout = self._stderr = None
+        # Serializes IPC close/free between the worker thread (cleanup) and
+        # the ChildPidWatcher thread (death callback): the callback must
+        # never touch a freed shmem mapping.
+        self._ipc_lock = threading.Lock()
         host.processes.append(self)
 
     @property
@@ -295,7 +323,15 @@ class ManagedSimProcess:
             stderr=self._stderr or subprocess.DEVNULL,
         )
         self.server.mem = MemoryCopier(self.proc.pid)
+        self.server.native_pid = self.proc.pid
         self.state = ProcessState.RUNNING
+        # Liveness guarantee (`childpid_watcher.rs`): if the child dies
+        # without the shim destructor running (SIGKILL, segfault), close
+        # the IPC writer so a recv_from_shim blocked on the worker thread
+        # returns instead of deadlocking the simulation.
+        from .pidwatcher import get_watcher
+
+        get_watcher().watch(self.proc.pid, self._on_child_death)
         self._resume()
 
     def stop(self, signal_nr: int = 15) -> None:
@@ -398,20 +434,38 @@ class ManagedSimProcess:
         except OSError:
             pass
 
+    def _on_child_death(self) -> None:
+        """Watcher-thread callback: the child died. Close the channel
+        writers (never free — the worker thread may be mid-recv on the
+        mapping) so any blocked recv_from_shim returns None."""
+        with self._ipc_lock:
+            if self.ipc is not None:
+                self.ipc.close()
+
     def _reap(self) -> None:
         try:
             self.exit_status = self.proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             self.proc.kill()
             self.exit_status = self.proc.wait(timeout=5)
-        self.state = ProcessState.EXITED
+        if self.exit_status is not None and self.exit_status < 0:
+            # died to an unhandled signal (SIGKILL, SIGSEGV, ...)
+            self.state = ProcessState.KILLED
+            self.kill_signal = -self.exit_status
+        else:
+            self.state = ProcessState.EXITED
         self._cleanup()
 
     def _cleanup(self) -> None:
-        if self.ipc is not None:
-            self.ipc.close()
-            self.ipc.block.free()
-            self.ipc = None
+        if self.proc is not None:
+            from .pidwatcher import get_watcher
+
+            get_watcher().unwatch(self.proc.pid)
+        with self._ipc_lock:
+            if self.ipc is not None:
+                self.ipc.close()
+                self.ipc.block.free()
+                self.ipc = None
         for fh in (self._stdout, self._stderr):
             if fh is not None:
                 fh.close()

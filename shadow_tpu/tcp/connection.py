@@ -332,7 +332,6 @@ class TcpConnection:
         if kind is None:
             return None
         builder = getattr(self, f"_build_{kind}")
-        self.last_segment_kind = kind
         seg = builder()
         # visible to the socket wrapper so retransmissions can be stamped
         # with SND_TCP_RETRANSMITTED for the tracker (`tracker.c:24-41`);
@@ -618,6 +617,9 @@ class TcpConnection:
             self.snd_wnd = seg.window
             self.state = TcpState.SYN_RCVD
             self._syn_outstanding = False  # rebuild as SYN|ACK
+            # that rebuild is a NEW segment (first SYN|ACK), not a
+            # handshake retransmission — don't let it count as one
+            self._syn_sends = 0
 
     def _process_ack(self, seg: Segment) -> None:
         ack_off = self._unwrap_ack(seg.ack)

@@ -1,0 +1,88 @@
+"""Process-death robustness: a managed binary that dies without running its
+shim destructor (SIGKILL, crash) must not deadlock the simulation.
+
+Parity: reference `src/main/utility/childpid_watcher.rs` +
+`managed_thread.rs:444-447` — the pidfd watcher closes the IPC channel
+writer on child death, so a simulator thread blocked in recv wakes with
+WriterIsClosed and the process is reaped as signal-killed.
+"""
+
+import os
+import shutil
+import signal
+import time
+
+import pytest
+
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.manager import Manager
+from shadow_tpu.process.process import ProcessState
+
+SH = shutil.which("sh")
+
+
+@pytest.mark.skipif(SH is None, reason="no sh binary")
+def test_self_sigkill_does_not_deadlock():
+    """The binary SIGKILLs itself: the kill syscall is passed through
+    natively and the process dies while the simulator is blocked waiting
+    for its next syscall — the deadlock scenario from round 1."""
+    cfg = load_config_str(
+        f"""
+general: {{stop_time: 10s, seed: 3}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  box:
+    network_node_id: 0
+    processes:
+    - {{path: {SH}, args: ["-c", "kill -9 $$"], start_time: 1s,
+       expected_final_state: {{signaled: 9}}}}
+"""
+    )
+    start = time.monotonic()
+    stats = Manager(cfg).run()
+    wall = time.monotonic() - start
+    assert stats.process_failures == [], stats.process_failures
+    assert wall < 30.0  # and in particular: it finished at all
+
+
+SLEEP = shutil.which("sleep")
+
+
+@pytest.mark.skipif(SLEEP is None or SH is None, reason="needs sleep + sh")
+def test_external_sigkill_mid_sleep_marks_process_killed():
+    """SIGKILL arrives from outside while the binary is parked on a
+    simulated sleep: the watcher closes the channel, the pending wakeup
+    reply fails harmlessly, and the sim finishes with the process KILLED."""
+    cfg = load_config_str(
+        f"""
+general: {{stop_time: 20s, seed: 4}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  box:
+    network_node_id: 0
+    processes:
+    - {{path: {SLEEP}, args: ["8"], start_time: 1s,
+       expected_final_state: {{signaled: 9}}}}
+"""
+    )
+    mgr = Manager(cfg)
+    host = mgr.hosts_by_name["box"]
+
+    # at sim t=2s (while sleep(8) is parked on its condition), SIGKILL the
+    # native process from a host task — the simulation's own timeline
+    from shadow_tpu.core.event import TaskRef
+
+    def assassin(h):
+        (proc,) = h.processes
+        os.kill(proc.proc.pid, signal.SIGKILL)
+
+    host.schedule_task_at(TaskRef(assassin, "assassin"), 2 * 10**9)
+
+    start = time.monotonic()
+    stats = Manager.run(mgr)
+    wall = time.monotonic() - start
+    assert stats.process_failures == [], stats.process_failures
+    (proc,) = host.processes
+    assert proc.state == ProcessState.KILLED
+    assert proc.kill_signal == signal.SIGKILL
+    assert wall < 30.0
