@@ -325,8 +325,22 @@ class Manager:
             while window is not None:
                 start, end = window
                 min_next = self.scheduler.run_round(self._host_order, end)
+                # round boundary: absorb watcher-thread posts (managed
+                # process deaths) into the now-quiescent host queues
+                for host in self.hosts:
+                    t = host.drain_cross_thread_tasks()
+                    if t is not None:
+                        min_next = t if min_next is None else min(min_next, t)
                 self.stats.rounds += 1
                 window = self.controller.next_window(min_next)
+
+            # absorb any managed-process death the watcher reported too
+            # late for a round-boundary drain
+            for host in self.hosts:
+                for proc in host.processes:
+                    reap = getattr(proc, "reap_if_native_dead", None)
+                    if reap is not None:
+                        reap()
 
             # expected-final-state check happens before teardown kills everyone
             self.stats.process_failures = self._check_final_states()

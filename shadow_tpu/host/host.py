@@ -54,6 +54,8 @@ class Host:
 
         self.event_queue = EventQueue()
         self._queue_lock = threading.Lock()  # cross-thread packet pushes
+        self._cross_lock = threading.Lock()  # cross-thread task posts
+        self._cross_pending: list[TaskRef] = []
 
         # Deterministic ordering counters (`host.rs:159-168`).
         self._local_event_id = 0
@@ -144,6 +146,28 @@ class Host:
             self.event_queue.push(
                 Event.new_packet(time_ns, packet, src_host_id, src_event_id)
             )
+
+    def post_cross_thread_task(self, task: TaskRef) -> None:
+        """Queue a task from a non-worker thread (the ChildPidWatcher
+        reporting a managed-process death). Posted tasks cannot go straight
+        into the event queue — the poster can't observe a coherent host
+        clock, and a stale-timestamped event would break the monotonic-pop
+        invariant — so the Manager drains them at the next round boundary
+        (`drain_cross_thread_tasks`), when the host is quiescent."""
+        with self._cross_lock:
+            self._cross_pending.append(task)
+
+    def drain_cross_thread_tasks(self) -> Optional[int]:
+        """Round-boundary drain (called by the Manager between rounds, no
+        worker running this host): schedules every posted task at the host
+        clock and returns that time, or None if nothing was pending."""
+        with self._cross_lock:
+            pending, self._cross_pending = self._cross_pending, []
+        if not pending:
+            return None
+        for task in pending:
+            self.schedule_task_at(task, self._now)
+        return self._now
 
     def next_event_time(self) -> Optional[int]:
         with self._queue_lock:

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import ctypes
 import ctypes.util
+import logging
 import os
 import struct
 import subprocess
@@ -29,7 +30,13 @@ import threading
 from typing import Callable, Optional
 
 from ..core import simtime
+from ..core.event import TaskRef
+from ..kernel import errors as kerrors
+from .condition import SysCallCondition
 from .process import ProcessState
+from .syscall_handler import DispatchCtx, NativeSyscall, SyscallHandler
+
+log = logging.getLogger("shadow_tpu.process")
 from ..interpose import (
     EVENT_PROCESS_DEATH,
     EVENT_START_RES,
@@ -283,11 +290,16 @@ class ManagedSimProcess:
         self.kill_signal: Optional[int] = None
         self.server = SyscallServer(virtual_pid=self.pid,
                                     clock=lambda: self.host.now())
+        # the simulated-kernel dispatch table (network, readiness, sleep)
+        self.handler = SyscallHandler(self)
         self.ipc: Optional[IpcChannel] = None
         self.proc = None
         self._death_seen = False
         self._output_dir = output_dir
         self._stdout = self._stderr = None
+        # park state for a blocked syscall (`SysCallCondition` trigger)
+        self._parked_condition = None
+        self._park_deadline: Optional[int] = None
         # Serializes IPC close/free between the worker thread (cleanup) and
         # the ChildPidWatcher thread (death callback): the callback must
         # never touch a freed shmem mapping.
@@ -345,13 +357,18 @@ class ManagedSimProcess:
             self.proc.wait(timeout=5)
         self.state = ProcessState.KILLED
         self.kill_signal = signal_nr
+        if self._parked_condition is not None:
+            cond, self._parked_condition = self._parked_condition, None
+            cond.cancel()
+        self._close_descriptors()
         self._cleanup()
 
     # -- the inline resume loop ----------------------------------------
 
     def _resume(self) -> None:
         """Service the plugin until it blocks or dies (runs on the worker
-        thread currently executing this host, like the reference)."""
+        thread currently executing this host, like the reference
+        `managed_thread.rs:185-322` resume loop)."""
         while True:
             ev = self.ipc.recv_from_shim()
             if ev is None:
@@ -367,54 +384,90 @@ class ManagedSimProcess:
             nr = int(ev.u.syscall.number)
             args = [int(ev.u.syscall.args[i]) for i in range(6)]
 
-            if nr in (SYS_nanosleep, SYS_clock_nanosleep):
-                delay = self._sleep_duration(nr, args)
-                if delay > 0:
-                    # park: the shim stays blocked in recv until the timer
-                    # task sends the completion (SysCallCondition analogue)
-                    from ..core.event import TaskRef
-
-                    self.host.schedule_task_with_delay(
-                        TaskRef(lambda h: self._finish_sleep(), "managed-sleep"),
-                        delay,
-                    )
-                    return
-                self._reply_complete(0)
-                continue
-
-            try:
-                ret = self.server.handle(nr, args)
-            except OSError:
-                ret = None
-            if ret is None:
-                self._reply_native()
-            else:
-                self._reply_complete(ret)
             if nr == SYS_exit_group:
+                # close simulated descriptors (FINs go out, ports free) and
+                # let the exit run natively
+                self._close_descriptors()
+                self._reply_native()
                 self._reap()
                 return
 
-    def _sleep_duration(self, nr: int, args) -> int:
-        try:
-            raw = self.server.mem.read(
-                args[2] if nr == SYS_clock_nanosleep else args[0], 16
-            )
-        except OSError:
-            return 0
-        sec, nsec = struct.unpack("<qq", raw)
-        t = sec * simtime.SECOND + nsec
-        if nr == SYS_clock_nanosleep and args[1] & 1:  # TIMER_ABSTIME
-            clockid = args[0]
-            now = (self.host.now() if clockid in (1, 4, 6)
-                   else simtime.emulated_from_sim(self.host.now()))
-            t -= now
-        return max(0, t)
+            if self._handle_syscall_event(nr, args):
+                return  # parked on a condition; no reply yet
 
-    def _finish_sleep(self) -> None:
-        if self.state != ProcessState.RUNNING:
+    def _handle_syscall_event(self, nr: int, args, wake=None) -> bool:
+        """Dispatch one trapped syscall. Returns True when the process
+        parked (the shim gets its reply when the condition fires)."""
+        ctx = DispatchCtx(wake, self._park_deadline if wake else None)
+        try:
+            ret = self.handler.dispatch(nr, args, ctx)
+        except NativeSyscall:
+            # not simulated-kernel territory: time/identity emulation, then
+            # native passthrough
+            try:
+                ret2 = self.server.handle(nr, args)
+            except OSError:
+                ret2 = None  # memory gone (racing exit): run it natively
+            if ret2 is None:
+                self._reply_native()
+            else:
+                self._reply_complete(ret2)
+            return False
+        except kerrors.SyscallError as e:
+            self._reply_complete(-e.errno)
+            return False
+        except kerrors.Blocked as b:
+            self._park(nr, args, b)
+            return True
+        except OSError:
+            # A process_vm read/write failed mid-handler. For a live
+            # process that's a bad pointer: report EFAULT (never re-run a
+            # simulated-kernel syscall natively — simulated side effects
+            # may already have happened). For a dying process the shim is
+            # gone and the reply lands nowhere anyway.
+            import errno as _errno
+
+            self._reply_complete(-_errno.EFAULT)
+            return False
+        self._reply_complete(ret)
+        return False
+
+    def _park(self, nr: int, args, blocked) -> None:
+        """Arm a SysCallCondition for a blocked syscall; the shim stays in
+        recv until the wakeup re-dispatches and replies."""
+        timeout_at = None
+        if blocked.timeout_ns is not None:
+            timeout_at = self.host.now() + blocked.timeout_ns
+        self._park_deadline = timeout_at
+
+        def wakeup(reason, nr=nr, args=tuple(args)):
+            self._unpark(nr, list(args), reason)
+
+        cond = SysCallCondition(
+            self.host,
+            file=blocked.file,
+            state_mask=blocked.state_mask,
+            timeout_at_ns=timeout_at,
+            wakeup=wakeup,
+        )
+        self._parked_condition = cond
+        cond.arm()
+
+    def _unpark(self, nr: int, args, reason: str) -> None:
+        self._parked_condition = None
+        if self.state != ProcessState.RUNNING or reason == "cancel":
             return
-        self._reply_complete(0)
-        self._resume()
+        # a parked poll/select holds a transient wait-epoll; release it
+        self.handler._drop_wait_epoll()
+        if not self._handle_syscall_event(nr, args, wake=reason):
+            self._resume()
+
+    def _close_descriptors(self) -> None:
+        try:
+            self.handler.close_all()
+        except Exception:
+            log.warning("error closing %r descriptors at exit", self.name,
+                        exc_info=True)
 
     def _reply_complete(self, retval: int) -> None:
         reply = ShimEvent()
@@ -437,10 +490,37 @@ class ManagedSimProcess:
     def _on_child_death(self) -> None:
         """Watcher-thread callback: the child died. Close the channel
         writers (never free — the worker thread may be mid-recv on the
-        mapping) so any blocked recv_from_shim returns None."""
+        mapping) so any blocked recv_from_shim returns None, and post a
+        reap task for the case where nobody is in recv at all: a process
+        parked on an untimed condition (blocking recv/accept) would
+        otherwise stay RUNNING forever, its sockets never sending FIN."""
         with self._ipc_lock:
             if self.ipc is not None:
                 self.ipc.close()
+        self.host.post_cross_thread_task(
+            TaskRef(lambda h: self._reap_if_parked(), "managed-death-reap")
+        )
+
+    def _reap_if_parked(self) -> None:
+        """Worker-thread task: reap a child that died while parked. If the
+        death was already observed (via recv returning None), this is a
+        no-op."""
+        if self.state != ProcessState.RUNNING:
+            return
+        if self._parked_condition is not None:
+            # drop the condition; if it fires later, _unpark's state check
+            # discards the wakeup
+            self._parked_condition = None
+        self._reap()
+
+    def reap_if_native_dead(self) -> None:
+        """End-of-run sweep (Manager, single-threaded): a child that died
+        so close to simulation end that the watcher's posted reap task
+        never got a round boundary to drain into must still be reaped, or
+        the final-state check would report a dead process as running."""
+        if self.state == ProcessState.RUNNING and self.proc is not None \
+                and self.proc.poll() is not None:
+            self._reap_if_parked()
 
     def _reap(self) -> None:
         try:
@@ -454,6 +534,7 @@ class ManagedSimProcess:
             self.kill_signal = -self.exit_status
         else:
             self.state = ProcessState.EXITED
+        self._close_descriptors()
         self._cleanup()
 
     def _cleanup(self) -> None:

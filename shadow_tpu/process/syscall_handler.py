@@ -1,0 +1,891 @@
+"""The syscall-handler table for managed native processes.
+
+Parity: reference `src/main/host/syscall/handler/mod.rs` (dispatch table at
+`mod.rs:357-496`) — this is the layer that makes real binaries use the
+*simulated* network: socket-family syscalls are emulated against the
+simulated kernel objects (`shadow_tpu.kernel`), readiness syscalls
+(poll/select/epoll) wait on simulated file state, and blocking syscalls
+park the managed thread on a `SysCallCondition` until a file-status or
+timeout trigger fires (`syscall_condition.c`). Anything not emulated is
+executed natively by the shim (`SyscallDoNative`), and anything fd-based is
+routed by descriptor: virtual fds (>= VFD_BASE) belong to the simulated
+kernel, low fds belong to the real one.
+
+The reference virtualizes *every* fd; this rebuild keeps native files
+native and gives simulated descriptors a disjoint range — chosen below
+FD_SETSIZE so select() bitmaps still work, above anything a real process
+plausibly allocates.
+
+Blocking protocol: a handler raises `errors.Blocked(file, state_mask,
+timeout_ns)`; the ManagedSimProcess parks the shim (no IPC reply) and arms
+a condition; when it fires, the same syscall is re-dispatched with
+`ctx.wake` set ("file" | "timeout") and `ctx.deadline` carrying the
+original absolute timeout, so timed waits (poll/select/epoll_wait) expire
+correctly across spurious wakeups.
+
+Multi-file waits (poll/select) are implemented over a transient kernel
+`Epoll` instance, the same trick as the reference's handler-internal epoll
+(`handler/mod.rs:80-107`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from typing import Optional
+
+from ..core import simtime
+from ..kernel import errors
+from ..kernel.descriptor import DescriptorTable
+from ..kernel.epoll import Epoll, EpollEvents
+from ..kernel.socket.tcp import TcpSocket
+from ..kernel.socket.udp import UdpSocket
+from ..kernel.status import FileState
+
+# ---------------------------------------------------------------------------
+# x86_64 syscall numbers (the emulated subset)
+
+SYS_read = 0
+SYS_write = 1
+SYS_close = 3
+SYS_fstat = 5
+SYS_poll = 7
+SYS_ioctl = 16
+SYS_readv = 19
+SYS_writev = 20
+SYS_select = 23
+SYS_dup = 32
+SYS_dup2 = 33
+SYS_nanosleep = 35
+SYS_socket = 41
+SYS_connect = 42
+SYS_accept = 43
+SYS_sendto = 44
+SYS_recvfrom = 45
+SYS_sendmsg = 46
+SYS_recvmsg = 47
+SYS_shutdown = 48
+SYS_bind = 49
+SYS_listen = 50
+SYS_getsockname = 51
+SYS_getpeername = 52
+SYS_setsockopt = 54
+SYS_getsockopt = 55
+SYS_fcntl = 72
+SYS_epoll_create = 213
+SYS_clock_nanosleep = 230
+SYS_epoll_wait = 232
+SYS_epoll_ctl = 233
+SYS_pselect6 = 270
+SYS_ppoll = 271
+SYS_epoll_pwait = 281
+SYS_accept4 = 288
+SYS_epoll_create1 = 291
+SYS_dup3 = 292
+SYS_getrandom = 318
+
+# socket constants
+AF_UNIX = 1
+AF_INET = 2
+AF_INET6 = 10
+SOCK_STREAM = 1
+SOCK_DGRAM = 2
+SOCK_TYPE_MASK = 0xF
+SOCK_NONBLOCK = 0o4000
+SOCK_CLOEXEC = 0o2000000
+
+SOL_SOCKET = 1
+IPPROTO_TCP = 6
+SO_REUSEADDR = 2
+SO_ERROR = 4
+SO_SNDBUF = 7
+SO_RCVBUF = 8
+
+MSG_DONTWAIT = 0x40
+
+O_NONBLOCK = 0o4000
+F_GETFD = 1
+F_SETFD = 2
+F_GETFL = 3
+F_SETFL = 4
+F_DUPFD = 0
+F_DUPFD_CLOEXEC = 1030
+
+FIONREAD = 0x541B
+FIONBIO = 0x5421
+
+SHUT_RD, SHUT_WR, SHUT_RDWR = 0, 1, 2
+
+# poll events
+POLLIN = 0x001
+POLLPRI = 0x002
+POLLOUT = 0x004
+POLLERR = 0x008
+POLLHUP = 0x010
+POLLNVAL = 0x020
+POLLRDHUP = 0x2000
+
+EPOLL_CTL_ADD = 1
+EPOLL_CTL_DEL = 2
+EPOLL_CTL_MOD = 3
+
+UNSPECIFIED = "0.0.0.0"
+
+MS = 1_000_000  # ns per millisecond
+
+
+class NativeSyscall(Exception):
+    """Handler verdict: execute this syscall natively in the shim."""
+
+
+class DispatchCtx:
+    """Per-dispatch context threaded through handlers.
+
+    `wake` is None on first dispatch, else the condition-fire reason
+    ("file" | "timeout"); `deadline` is the absolute sim-time the original
+    call's timeout expires (None = untimed), fixed at first block so timed
+    waits don't restart their clock on every spurious wakeup.
+    """
+
+    __slots__ = ("wake", "deadline")
+
+    def __init__(self, wake: Optional[str] = None,
+                 deadline: Optional[int] = None):
+        self.wake = wake
+        self.deadline = deadline
+
+
+def _i32(v: int) -> int:
+    return ctypes.c_int32(v & 0xFFFFFFFF).value
+
+
+def _i64(v: int) -> int:
+    return ctypes.c_int64(v).value
+
+
+class SyscallHandler:
+    """One per managed process (`SyscallHandler` in `handler/mod.rs`)."""
+
+    VFD_BASE = 700  # above real fds, below FD_SETSIZE
+
+    def __init__(self, process):
+        self.process = process
+        self.host = process.host
+        # fd -> simulated file; offset table keeps vfds in our range
+        self._table = DescriptorTable()
+        # the one transient wait-epoll a parked poll/select holds
+        self._wait_epoll: Optional[Epoll] = None
+        # sockets with a connect() issued and not yet reported complete
+        self.syscall_counts: dict[int, int] = {}
+
+    # -- descriptor plumbing -------------------------------------------
+
+    @property
+    def mem(self):
+        return self.process.server.mem
+
+    def _vfd(self, file, cloexec: bool = False) -> int:
+        return self._table.register(file, cloexec) + self.VFD_BASE
+
+    def _file(self, fd: int):
+        fd = _i32(fd)
+        if fd < self.VFD_BASE:
+            raise NativeSyscall()
+        try:
+            return self._table.get(fd - self.VFD_BASE)
+        except errors.SyscallError:
+            # in our range but not ours: report EBADF rather than letting
+            # the kernel act on a fd the process never opened
+            raise errors.SyscallError(errors.EBADF) from None
+
+    def has_vfd(self, fd: int) -> bool:
+        fd = _i32(fd)
+        if fd < self.VFD_BASE:
+            return False
+        try:
+            self._table.get(fd - self.VFD_BASE)
+            return True
+        except errors.SyscallError:
+            return False
+
+    def close_all(self) -> None:
+        self._table.close_all()
+        self._drop_wait_epoll()
+
+    def _drop_wait_epoll(self) -> None:
+        if self._wait_epoll is not None:
+            self._wait_epoll.close()  # removes its listeners
+            self._wait_epoll = None
+
+    # -- sockaddr codec ------------------------------------------------
+
+    def _read_sockaddr(self, addr: int, addrlen: int) -> tuple[str, int]:
+        if addrlen < 8:
+            raise errors.SyscallError(errors.EINVAL)
+        raw = self.mem.read(addr, min(addrlen, 16))
+        (family,) = struct.unpack_from("<H", raw, 0)
+        if family != AF_INET:
+            raise errors.SyscallError(errors.EAFNOSUPPORT)
+        port = struct.unpack_from(">H", raw, 2)[0]
+        ip = ".".join(str(b) for b in raw[4:8])
+        return ip, port
+
+    def _write_sockaddr(self, addr: int, addrlen_ptr: int,
+                        sockaddr: Optional[tuple[str, int]]) -> None:
+        if not addr or not addrlen_ptr:
+            return
+        ip, port = sockaddr if sockaddr is not None else (UNSPECIFIED, 0)
+        raw = struct.pack("<H", AF_INET) + struct.pack(">H", port) + bytes(
+            int(p) for p in ip.split(".")
+        ) + b"\x00" * 8
+        (cap,) = struct.unpack("<I", self.mem.read(addrlen_ptr, 4))
+        self.mem.write(addr, raw[: min(cap, len(raw))])
+        self.mem.write(addrlen_ptr, struct.pack("<I", len(raw)))
+
+    # -- dispatch ------------------------------------------------------
+
+    def dispatch(self, nr: int, args, ctx: DispatchCtx) -> int:
+        """Returns the syscall retval; raises NativeSyscall for
+        passthrough, errors.SyscallError for -errno, errors.Blocked to
+        park. Re-dispatched (ctx.wake set) calls must be idempotent up to
+        their blocking point."""
+        self.syscall_counts[nr] = self.syscall_counts.get(nr, 0) + 1
+        handler = self._HANDLERS.get(nr)
+        if handler is None:
+            raise NativeSyscall()
+        return handler(self, args, ctx)
+
+    # -- socket family -------------------------------------------------
+
+    def _sys_socket(self, args, ctx) -> int:
+        domain, type_, _proto = _i32(args[0]), _i32(args[1]), _i32(args[2])
+        if domain == AF_UNIX:
+            raise NativeSyscall()  # intra-host IPC: no simulated semantics
+        if domain == AF_INET6:
+            # v4-only simulated internet; apps fall back (`inet/mod.rs`)
+            raise errors.SyscallError(errors.EAFNOSUPPORT)
+        if domain != AF_INET:
+            raise errors.SyscallError(errors.EAFNOSUPPORT)
+        kind = type_ & SOCK_TYPE_MASK
+        if kind == SOCK_STREAM:
+            sock = TcpSocket(self.host)
+        elif kind == SOCK_DGRAM:
+            sock = UdpSocket(self.host)
+        else:
+            raise errors.SyscallError(errors.EPROTONOSUPPORT)
+        sock.nonblocking = bool(type_ & SOCK_NONBLOCK)
+        return self._vfd(sock, cloexec=bool(type_ & SOCK_CLOEXEC))
+
+    def _sys_bind(self, args, ctx) -> int:
+        sock = self._file(args[0])
+        addr = self._read_sockaddr(args[1], _i32(args[2]))
+        sock.bind(addr)
+        return 0
+
+    def _sys_listen(self, args, ctx) -> int:
+        sock = self._file(args[0])
+        if not isinstance(sock, TcpSocket):
+            raise errors.SyscallError(errors.EOPNOTSUPP)
+        backlog = _i32(args[1])
+        sock.listen(backlog if backlog > 0 else 1)
+        return 0
+
+    def _sys_connect(self, args, ctx) -> int:
+        sock = self._file(args[0])
+        if isinstance(sock, UdpSocket):
+            addr = self._read_sockaddr(args[1], _i32(args[2]))
+            sock.connect(addr)
+            return 0
+        if ctx.wake is not None:
+            # resuming a blocked connect: report the handshake outcome
+            if sock.conn is not None and sock.conn.error is not None:
+                raise errors.SyscallError(sock.conn.error)
+            if sock.is_connected():
+                return 0
+            raise errors.Blocked(
+                sock, FileState.SOCKET_ALLOWING_CONNECT, restartable=False
+            )
+        addr = self._read_sockaddr(args[1], _i32(args[2]))
+        sock.connect(addr)  # raises Blocked (blocking) or EINPROGRESS
+        return 0
+
+    def _sys_accept(self, args, ctx, flags: int = 0) -> int:
+        listener = self._file(args[0])
+        if not isinstance(listener, TcpSocket):
+            raise errors.SyscallError(errors.EOPNOTSUPP)
+        child = listener.accept()  # raises Blocked when queue empty
+        child.nonblocking = bool(flags & SOCK_NONBLOCK)
+        fd = self._vfd(child, cloexec=bool(flags & SOCK_CLOEXEC))
+        self._write_sockaddr(args[1], args[2], child.getpeername())
+        return fd
+
+    def _sys_accept4(self, args, ctx) -> int:
+        return self._sys_accept(args, ctx, flags=_i32(args[3]))
+
+    def _sys_shutdown(self, args, ctx) -> int:
+        sock = self._file(args[0])
+        how = _i32(args[1])
+        if how not in (SHUT_RD, SHUT_WR, SHUT_RDWR):
+            raise errors.SyscallError(errors.EINVAL)
+        if isinstance(sock, TcpSocket):
+            if sock.conn is None:
+                raise errors.SyscallError(errors.ENOTCONN)
+            if how in (SHUT_WR, SHUT_RDWR) and not sock.conn.fin_requested:
+                sock.conn.close()
+                sock._pump_out()
+        return 0
+
+    def _sys_getsockname(self, args, ctx) -> int:
+        sock = self._file(args[0])
+        self._write_sockaddr(args[1], args[2], sock.getsockname())
+        return 0
+
+    def _sys_getpeername(self, args, ctx) -> int:
+        sock = self._file(args[0])
+        peer = sock.getpeername()
+        if peer is None:
+            raise errors.SyscallError(errors.ENOTCONN)
+        self._write_sockaddr(args[1], args[2], peer)
+        return 0
+
+    def _sys_setsockopt(self, args, ctx) -> int:
+        self._file(args[0])  # EBADF check
+        # SO_REUSEADDR / TCP_NODELAY / buffer sizes: accepted, not modeled
+        return 0
+
+    def _sys_getsockopt(self, args, ctx) -> int:
+        sock = self._file(args[0])
+        level, optname = _i32(args[1]), _i32(args[2])
+        optval, optlen_ptr = args[3], args[4]
+        if level == SOL_SOCKET and optname == SO_ERROR:
+            err = 0
+            if isinstance(sock, TcpSocket) and sock.conn is not None \
+                    and sock.conn.error is not None:
+                err = sock.conn.error
+            self._write_int_opt(optval, optlen_ptr, err)
+            return 0
+        if level == SOL_SOCKET and optname in (SO_SNDBUF, SO_RCVBUF):
+            self._write_int_opt(optval, optlen_ptr, 131072)
+            return 0
+        self._write_int_opt(optval, optlen_ptr, 0)
+        return 0
+
+    def _write_int_opt(self, optval: int, optlen_ptr: int, value: int) -> None:
+        if not optval or not optlen_ptr:
+            return
+        (cap,) = struct.unpack("<I", self.mem.read(optlen_ptr, 4))
+        raw = struct.pack("<i", value)[: max(0, cap)]
+        if raw:
+            self.mem.write(optval, raw)
+        self.mem.write(optlen_ptr, struct.pack("<I", len(raw)))
+
+    # -- data transfer -------------------------------------------------
+
+    def _recv_common(self, sock, bufp: int, n: int, flags: int,
+                     want_src: bool):
+        dontwait = bool(flags & MSG_DONTWAIT)
+        saved = sock.nonblocking
+        if dontwait:
+            sock.nonblocking = True
+        try:
+            if isinstance(sock, UdpSocket):
+                data, src = sock.recvfrom()
+                data = data[:n]  # datagram truncation
+            else:
+                data = sock.recv(n)
+                src = sock.getpeername()
+        finally:
+            sock.nonblocking = saved
+        if data:
+            self.mem.write(bufp, data)
+        return len(data), src
+
+    def _sys_recvfrom(self, args, ctx) -> int:
+        sock = self._file(args[0])
+        n = args[2]
+        got, src = self._recv_common(sock, args[1], n, _i32(args[3]),
+                                     want_src=True)
+        self._write_sockaddr(args[4], args[5], src)
+        return got
+
+    def _sys_read(self, args, ctx) -> int:
+        sock = self._file(args[0])
+        got, _src = self._recv_common(sock, args[1], args[2], 0, False)
+        return got
+
+    def _sys_readv(self, args, ctx) -> int:
+        sock = self._file(args[0])
+        iovs = self._read_iovec(args[1], _i32(args[2]))
+        total = sum(ln for _, ln in iovs)
+        dontwait_data = sock.recv(total) if not isinstance(sock, UdpSocket) \
+            else sock.recvfrom()[0][:total]
+        off = 0
+        for base, ln in iovs:
+            chunk = dontwait_data[off:off + ln]
+            if not chunk:
+                break
+            self.mem.write(base, chunk)
+            off += len(chunk)
+        return len(dontwait_data)
+
+    def _sys_sendto(self, args, ctx) -> int:
+        sock = self._file(args[0])
+        bufp, n, flags = args[1], args[2], _i32(args[3])
+        data = self.mem.read(bufp, n) if n else b""
+        dontwait = bool(flags & MSG_DONTWAIT)
+        saved = sock.nonblocking
+        if dontwait:
+            sock.nonblocking = True
+        try:
+            if isinstance(sock, UdpSocket):
+                dst = None
+                if args[4]:
+                    dst = self._read_sockaddr(args[4], _i32(args[5]))
+                return sock.sendto(data, dst)
+            return sock.send(data)
+        finally:
+            sock.nonblocking = saved
+
+    def _sys_write(self, args, ctx) -> int:
+        sock = self._file(args[0])
+        data = self.mem.read(args[1], args[2]) if args[2] else b""
+        return sock.send(data)
+
+    def _sys_writev(self, args, ctx) -> int:
+        sock = self._file(args[0])
+        iovs = self._read_iovec(args[1], _i32(args[2]))
+        data = b"".join(self.mem.read(base, ln) for base, ln in iovs if ln)
+        return sock.send(data)
+
+    def _read_iovec(self, iovp: int, iovcnt: int) -> list[tuple[int, int]]:
+        if iovcnt < 0 or iovcnt > 1024:
+            raise errors.SyscallError(errors.EINVAL)
+        raw = self.mem.read(iovp, iovcnt * 16)
+        return [struct.unpack_from("<QQ", raw, i * 16) for i in range(iovcnt)]
+
+    def _parse_msghdr(self, msgp: int):
+        # x86_64 struct msghdr: name(8) namelen(4+4pad) iov(8) iovlen(8)
+        # control(8) controllen(8) flags(4+4pad) = 56 bytes
+        raw = self.mem.read(msgp, 56)
+        name, namelen, iovp, iovlen, _ctrl, _ctrllen, _flags = struct.unpack(
+            "<QI4xQQQQi4x", raw
+        )
+        return name, namelen, self._read_iovec(iovp, iovlen)
+
+    def _sys_sendmsg(self, args, ctx) -> int:
+        sock = self._file(args[0])
+        name, namelen, iovs = self._parse_msghdr(args[1])
+        data = b"".join(self.mem.read(base, ln) for base, ln in iovs if ln)
+        if isinstance(sock, UdpSocket):
+            dst = self._read_sockaddr(name, namelen) if name else None
+            return sock.sendto(data, dst)
+        return sock.send(data)
+
+    def _sys_recvmsg(self, args, ctx) -> int:
+        sock = self._file(args[0])
+        name, _namelen, iovs = self._parse_msghdr(args[1])
+        total = sum(ln for _, ln in iovs)
+        if isinstance(sock, UdpSocket):
+            data, src = sock.recvfrom()
+            data = data[:total]
+        else:
+            data = sock.recv(total)
+            src = sock.getpeername()
+        off = 0
+        for base, ln in iovs:
+            chunk = data[off:off + ln]
+            if not chunk:
+                break
+            self.mem.write(base, chunk)
+            off += len(chunk)
+        # msg_name writeback: namelen lives at msgp+8; write src if wanted
+        if name and src is not None:
+            raw = struct.pack("<H", AF_INET) + struct.pack(">H", src[1]) + \
+                bytes(int(p) for p in src[0].split(".")) + b"\x00" * 8
+            self.mem.write(name, raw)
+            self.mem.write(args[1] + 8, struct.pack("<I", len(raw)))
+        return len(data)
+
+    # -- descriptor ops ------------------------------------------------
+
+    def _sys_close(self, args, ctx) -> int:
+        fd = _i32(args[0])
+        if fd < self.VFD_BASE:
+            raise NativeSyscall()
+        try:
+            self._table.close(fd - self.VFD_BASE)
+        except errors.SyscallError:
+            raise errors.SyscallError(errors.EBADF) from None
+        return 0
+
+    def _sys_dup(self, args, ctx) -> int:
+        fd = _i32(args[0])
+        if fd < self.VFD_BASE:
+            raise NativeSyscall()
+        self._file(fd)  # EBADF check
+        return self._table.dup(fd - self.VFD_BASE) + self.VFD_BASE
+
+    def _sys_dup2(self, args, ctx, flags: int = 0) -> int:
+        oldfd, newfd = _i32(args[0]), _i32(args[1])
+        if oldfd < self.VFD_BASE and newfd < self.VFD_BASE:
+            raise NativeSyscall()
+        if oldfd < self.VFD_BASE or newfd < self.VFD_BASE:
+            # mixing planes (dup a socket onto stdin, ...): unsupported
+            raise errors.SyscallError(errors.EBADF)
+        file = self._file(oldfd)
+        if oldfd == newfd:
+            return newfd
+        self._table.register_at(newfd - self.VFD_BASE, file)
+        return newfd
+
+    def _sys_dup3(self, args, ctx) -> int:
+        if _i32(args[0]) == _i32(args[1]):
+            raise errors.SyscallError(errors.EINVAL)
+        return self._sys_dup2(args, ctx, flags=_i32(args[2]))
+
+    def _sys_fstat(self, args, ctx) -> int:
+        self._file(args[0])  # EBADF check / native routing
+        # minimal S_IFSOCK stat (layout: x86_64 struct stat, st_mode at 24)
+        st = bytearray(144)
+        struct.pack_into("<I", st, 24, 0o140777)
+        struct.pack_into("<Q", st, 16, 1)  # st_nlink
+        self.mem.write(args[1], bytes(st))
+        return 0
+
+    def _sys_fcntl(self, args, ctx) -> int:
+        fd = _i32(args[0])
+        if fd < self.VFD_BASE:
+            raise NativeSyscall()
+        file = self._file(fd)
+        cmd, arg = _i32(args[1]), args[2]
+        if cmd == F_GETFL:
+            return O_NONBLOCK if getattr(file, "nonblocking", False) else 0
+        if cmd == F_SETFL:
+            file.nonblocking = bool(arg & O_NONBLOCK)
+            return 0
+        if cmd in (F_GETFD, F_SETFD):
+            return 0
+        if cmd in (F_DUPFD, F_DUPFD_CLOEXEC):
+            return self._table.dup(fd - self.VFD_BASE) + self.VFD_BASE
+        raise errors.SyscallError(errors.EINVAL)
+
+    def _sys_ioctl(self, args, ctx) -> int:
+        fd = _i32(args[0])
+        if fd < self.VFD_BASE:
+            raise NativeSyscall()
+        file = self._file(fd)
+        req = args[1]
+        if req == FIONBIO:
+            (val,) = struct.unpack("<i", self.mem.read(args[2], 4))
+            file.nonblocking = bool(val)
+            return 0
+        if req == FIONREAD:
+            n = 0
+            if isinstance(file, TcpSocket) and file.conn is not None:
+                n = file.conn.readable_bytes()
+            elif isinstance(file, UdpSocket) and len(file._recv_buffer):
+                n = file._recv_buffer.queue[0][2]
+            self.mem.write(args[2], struct.pack("<i", n))
+            return 0
+        raise errors.SyscallError(errors.EINVAL)
+
+    # -- readiness: poll/select/epoll ----------------------------------
+
+    def _poll_revents(self, fd: int, events: int) -> int:
+        """Readiness bits for one pollfd entry. Native fds report 0 (we
+        cannot wait on them without breaking determinism); mixing native
+        and simulated fds in one poll set is unsupported-but-harmless."""
+        if not self.has_vfd(fd):
+            return POLLNVAL if fd >= self.VFD_BASE else 0
+        file = self._file(fd)
+        state = file.state
+        r = 0
+        if state & FileState.READABLE:
+            r |= POLLIN
+        if state & FileState.WRITABLE:
+            r |= POLLOUT
+        if state & FileState.CLOSED:
+            r |= POLLHUP
+        if isinstance(file, TcpSocket) and file.conn is not None:
+            if file.conn.error is not None:
+                r |= POLLERR
+            if file.conn.at_eof():
+                r |= POLLRDHUP | POLLIN  # EOF: read returns 0
+        return r & (events | POLLERR | POLLHUP | POLLNVAL)
+
+    def _block_on_files(self, entries: list[tuple[int, int]],
+                        timeout_ns: Optional[int]):
+        """Arm a transient epoll over (fd, poll-events) pairs and block on
+        it (`handler/mod.rs:80-107` internal-epoll pattern)."""
+        ep = Epoll()
+        for fd, events in entries:
+            if not self.has_vfd(fd):
+                continue
+            interest = EpollEvents(0)
+            if events & (POLLIN | POLLPRI | POLLRDHUP):
+                interest |= EpollEvents.IN
+            if events & POLLOUT:
+                interest |= EpollEvents.OUT
+            try:
+                ep.add(self._file(fd), interest)
+            except errors.SyscallError:
+                pass
+        self._wait_epoll = ep
+        raise errors.Blocked(ep, FileState.READABLE, timeout_ns=timeout_ns)
+
+    def _remaining(self, ctx: DispatchCtx,
+                   timeout_ns: Optional[int]) -> Optional[int]:
+        """Remaining wait from the original deadline (set at first block)."""
+        if ctx.deadline is not None:
+            return max(0, ctx.deadline - self.host.now())
+        return timeout_ns
+
+    def _sys_poll(self, args, ctx, timeout_ns: Optional[int] = -1) -> int:
+        fdsp, nfds = args[0], args[1]
+        if timeout_ns == -1:  # plain poll: ms timeout in arg 2
+            tmo = _i32(args[2])
+            timeout_ns = None if tmo < 0 else tmo * MS
+        if nfds > 4096:
+            raise errors.SyscallError(errors.EINVAL)
+        raw = self.mem.read(fdsp, nfds * 8) if nfds else b""
+        entries = []
+        for i in range(nfds):
+            fd, events, _rev = struct.unpack_from("<ihh", raw, i * 8)
+            entries.append((fd, events))
+        ready = 0
+        out = bytearray(raw)
+        for i, (fd, events) in enumerate(entries):
+            rev = self._poll_revents(fd, events) if fd >= 0 else 0
+            struct.pack_into("<h", out, i * 8 + 6, rev)
+            if rev:
+                ready += 1
+        if ready or timeout_ns == 0:
+            self.mem.write(fdsp, bytes(out))
+            return ready
+        if ctx.wake == "timeout":
+            self.mem.write(fdsp, bytes(out))
+            return 0
+        self._block_on_files(
+            [(fd, ev) for fd, ev in entries if fd >= 0],
+            self._remaining(ctx, timeout_ns),
+        )
+
+    def _sys_ppoll(self, args, ctx) -> int:
+        tsp = args[2]
+        if tsp:
+            sec, nsec = struct.unpack("<qq", self.mem.read(tsp, 16))
+            timeout_ns = sec * simtime.SECOND + nsec
+        else:
+            timeout_ns = None
+        return self._sys_poll(args, ctx, timeout_ns=timeout_ns)
+
+    def _sys_select(self, args, ctx, timeout_ns: Optional[int] = -1) -> int:
+        nfds = _i32(args[0])
+        if nfds < 0 or nfds > 1024:
+            raise errors.SyscallError(errors.EINVAL)
+        nbytes = (nfds + 7) // 8
+        sets = []
+        for argi, want in ((args[1], POLLIN), (args[2], POLLOUT),
+                           (args[3], POLLPRI)):
+            if argi and nbytes:
+                sets.append((argi, want, bytearray(self.mem.read(argi, nbytes))))
+            else:
+                sets.append((argi, want, None))
+        if timeout_ns == -1:  # plain select: struct timeval in arg 4
+            if args[4]:
+                sec, usec = struct.unpack("<qq", self.mem.read(args[4], 16))
+                timeout_ns = sec * simtime.SECOND + usec * 1000
+            else:
+                timeout_ns = None
+
+        entries: dict[int, int] = {}
+        for _ptr, want, bits in sets:
+            if bits is None:
+                continue
+            for fd in range(nfds):
+                if bits[fd // 8] & (1 << (fd % 8)):
+                    entries[fd] = entries.get(fd, 0) | want
+
+        ready_fds = 0
+        outs = []
+        for ptr, want, bits in sets:
+            if bits is None:
+                outs.append((ptr, None))
+                continue
+            out = bytearray(nbytes)
+            for fd in range(nfds):
+                if bits[fd // 8] & (1 << (fd % 8)):
+                    if self._poll_revents(fd, want) & (want | POLLERR | POLLHUP):
+                        out[fd // 8] |= 1 << (fd % 8)
+                        ready_fds += 1
+            outs.append((ptr, out))
+
+        if ready_fds or timeout_ns == 0 or ctx.wake == "timeout":
+            for ptr, out in outs:
+                if out is not None:
+                    self.mem.write(ptr, bytes(out))
+            return ready_fds
+        self._block_on_files(list(entries.items()),
+                             self._remaining(ctx, timeout_ns))
+
+    def _sys_pselect6(self, args, ctx) -> int:
+        tsp = args[4]
+        if tsp:
+            sec, nsec = struct.unpack("<qq", self.mem.read(tsp, 16))
+            timeout_ns = sec * simtime.SECOND + nsec
+        else:
+            timeout_ns = None
+        return self._sys_select(args, ctx, timeout_ns=timeout_ns)
+
+    def _sys_epoll_create(self, args, ctx) -> int:
+        return self._vfd(Epoll())
+
+    def _sys_epoll_create1(self, args, ctx) -> int:
+        return self._vfd(Epoll(), cloexec=bool(args[0] & SOCK_CLOEXEC))
+
+    def _sys_epoll_ctl(self, args, ctx) -> int:
+        ep = self._file(args[0])
+        if not isinstance(ep, Epoll):
+            raise errors.SyscallError(errors.EINVAL)
+        op, fd = _i32(args[1]), _i32(args[2])
+        if not self.has_vfd(fd):
+            # native fds can't join a simulated interest list; Linux says
+            # EPERM for files that don't support epoll
+            raise errors.SyscallError(errors.EPERM)
+        target = self._file(fd)
+        if op == EPOLL_CTL_DEL:
+            ep.remove(target)
+            return 0
+        raw = self.mem.read(args[3], 12)  # packed epoll_event
+        events, data = struct.unpack("<IQ", raw)
+        interest = EpollEvents(0)
+        if events & POLLIN:
+            interest |= EpollEvents.IN
+        if events & POLLOUT:
+            interest |= EpollEvents.OUT
+        if events & (1 << 31):
+            interest |= EpollEvents.ET
+        if events & (1 << 30):
+            interest |= EpollEvents.ONESHOT
+        if op == EPOLL_CTL_ADD:
+            ep.add(target, interest, data=(fd, data))
+        elif op == EPOLL_CTL_MOD:
+            ep.modify(target, interest, data=(fd, data))
+        else:
+            raise errors.SyscallError(errors.EINVAL)
+        return 0
+
+    def _sys_epoll_wait(self, args, ctx) -> int:
+        ep = self._file(args[0])
+        if not isinstance(ep, Epoll):
+            raise errors.SyscallError(errors.EINVAL)
+        evp, maxev, tmo_ms = args[1], _i32(args[2]), _i32(args[3])
+        if maxev <= 0:
+            raise errors.SyscallError(errors.EINVAL)
+        got = ep.ready(maxev)
+        if got:
+            out = bytearray(12 * len(got))
+            for i, (data, hits) in enumerate(got):
+                fd, user_data = data if isinstance(data, tuple) else (0, 0)
+                ev = 0
+                if hits & EpollEvents.IN:
+                    ev |= POLLIN
+                if hits & EpollEvents.OUT:
+                    ev |= POLLOUT
+                if hits & EpollEvents.HUP:
+                    ev |= POLLHUP
+                if hits & EpollEvents.ERR:
+                    ev |= POLLERR
+                struct.pack_into("<IQ", out, i * 12, ev, user_data)
+            self.mem.write(evp, bytes(out))
+            return len(got)
+        timeout_ns = None if tmo_ms < 0 else tmo_ms * MS
+        if timeout_ns == 0 or ctx.wake == "timeout":
+            return 0
+        raise errors.Blocked(ep, FileState.READABLE,
+                             timeout_ns=self._remaining(ctx, timeout_ns))
+
+    def _sys_epoll_pwait(self, args, ctx) -> int:
+        return self._sys_epoll_wait(args, ctx)
+
+    # -- time / sleep / random -----------------------------------------
+
+    def _sys_nanosleep(self, args, ctx) -> int:
+        if ctx.wake == "timeout":
+            return 0
+        delay = self._sleep_ns(args[0], absolute=False, clockid=0)
+        if delay <= 0:
+            return 0
+        raise errors.Blocked(None, FileState.NONE, timeout_ns=delay)
+
+    def _sys_clock_nanosleep(self, args, ctx) -> int:
+        if ctx.wake == "timeout":
+            return 0
+        TIMER_ABSTIME = 1
+        delay = self._sleep_ns(args[2], absolute=bool(args[1] & TIMER_ABSTIME),
+                               clockid=_i32(args[0]))
+        if delay <= 0:
+            return 0
+        raise errors.Blocked(None, FileState.NONE, timeout_ns=delay)
+
+    def _sleep_ns(self, req_addr: int, absolute: bool, clockid: int) -> int:
+        sec, nsec = struct.unpack("<qq", self.mem.read(req_addr, 16))
+        t = sec * simtime.SECOND + nsec
+        if absolute:
+            now = (self.host.now() if clockid in (1, 4, 6)
+                   else simtime.emulated_from_sim(self.host.now()))
+            t -= now
+        return max(0, t)
+
+    def _sys_getrandom(self, args, ctx) -> int:
+        bufp, n = args[0], min(args[1], 1 << 20)
+        # deterministic bytes from the host RNG stream (`random.rs` handler;
+        # same role as preload-openssl's deterministic RNG)
+        out = bytearray()
+        while len(out) < n:
+            out += struct.pack("<Q", self.host.rng.next_u64())
+        self.mem.write(bufp, bytes(out[:n]))
+        return n
+
+    # -- table ----------------------------------------------------------
+
+    _HANDLERS = {
+        SYS_socket: _sys_socket,
+        SYS_bind: _sys_bind,
+        SYS_listen: _sys_listen,
+        SYS_connect: _sys_connect,
+        SYS_accept: _sys_accept,
+        SYS_accept4: _sys_accept4,
+        SYS_shutdown: _sys_shutdown,
+        SYS_getsockname: _sys_getsockname,
+        SYS_getpeername: _sys_getpeername,
+        SYS_setsockopt: _sys_setsockopt,
+        SYS_getsockopt: _sys_getsockopt,
+        SYS_sendto: _sys_sendto,
+        SYS_recvfrom: _sys_recvfrom,
+        SYS_sendmsg: _sys_sendmsg,
+        SYS_recvmsg: _sys_recvmsg,
+        SYS_read: _sys_read,
+        SYS_write: _sys_write,
+        SYS_readv: _sys_readv,
+        SYS_writev: _sys_writev,
+        SYS_close: _sys_close,
+        SYS_dup: _sys_dup,
+        SYS_dup2: _sys_dup2,
+        SYS_dup3: _sys_dup3,
+        SYS_fstat: _sys_fstat,
+        SYS_fcntl: _sys_fcntl,
+        SYS_ioctl: _sys_ioctl,
+        SYS_poll: _sys_poll,
+        SYS_ppoll: _sys_ppoll,
+        SYS_select: _sys_select,
+        SYS_pselect6: _sys_pselect6,
+        SYS_epoll_create: _sys_epoll_create,
+        SYS_epoll_create1: _sys_epoll_create1,
+        SYS_epoll_ctl: _sys_epoll_ctl,
+        SYS_epoll_wait: _sys_epoll_wait,
+        SYS_epoll_pwait: _sys_epoll_pwait,
+        SYS_nanosleep: _sys_nanosleep,
+        SYS_clock_nanosleep: _sys_clock_nanosleep,
+        SYS_getrandom: _sys_getrandom,
+    }

@@ -69,6 +69,11 @@ class RenoCongestion:
             return True
         return False
 
+    def on_partial_ack(self, n_segments: int) -> None:
+        """NewReno partial ack during fast recovery (RFC 6582): deflate by
+        the amount acked, add back one segment, stay in recovery."""
+        self.cwnd = max(1, self.cwnd - n_segments + 1)
+
     def on_timeout(self) -> None:
         self.dup_acks = 0
         self.ssthresh = self.cwnd // 2 + 1

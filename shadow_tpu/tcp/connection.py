@@ -171,6 +171,9 @@ class TcpConnection:
         self.syn_acked = False
         self._retx_pending = False  # rebuild a segment at snd_una
         self._probe_pending = False  # zero-window probe: 1 byte past window
+        self._recover = 0  # NewReno fast-recovery point (snd_nxt at entry)
+        self._gbn_high = 0  # go-back-N: resends below this are retransmits
+        self.snd_max = 0  # highest stream offset ever transmitted (+FIN slot)
         self._rst_pending = False
 
         # --- receive side -------------------------------------------------
@@ -332,13 +335,20 @@ class TcpConnection:
         if kind is None:
             return None
         builder = getattr(self, f"_build_{kind}")
+        before_nxt = self.snd_nxt
         seg = builder()
         # visible to the socket wrapper so retransmissions can be stamped
         # with SND_TCP_RETRANSMITTED for the tracker (`tracker.c:24-41`);
-        # covers handshake RTOs (kind 'syn' rebuilt after _on_rto_fire)
-        # as well as data retransmits and zero-window probes
-        self.last_segment_retransmit = kind in ("retransmit", "probe") or (
-            kind == "syn" and self._syn_sends > 1
+        # covers handshake RTOs (kind 'syn' rebuilt after _on_rto_fire),
+        # data retransmits, zero-window probes, and go-back-N resends of
+        # previously-transmitted data after an RTO
+        gbn_resend = kind in ("data", "fin") and before_nxt < self._gbn_high
+        if gbn_resend:
+            self.retransmit_count += 1
+        self.last_segment_retransmit = (
+            kind in ("retransmit", "probe")
+            or (kind == "syn" and self._syn_sends > 1)
+            or gbn_resend
         )
         return seg
 
@@ -366,6 +376,7 @@ class TcpConnection:
             TcpState.ESTABLISHED,
             TcpState.CLOSE_WAIT,
             TcpState.FIN_WAIT_1,  # data queued before close() drains first
+            TcpState.CLOSING,  # ditto, after a simultaneous close
             TcpState.LAST_ACK,
         ):
             return False
@@ -440,6 +451,7 @@ class TcpConnection:
         assert n > 0
         payload = bytes(self.snd_buf[off - self.snd_una : off - self.snd_una + n])
         self.snd_nxt = off + n
+        self.snd_max = max(self.snd_max, self.snd_nxt)
         self._ack_pending = False
         if not self._rto_armed:
             self._arm_rto()
@@ -485,6 +497,7 @@ class TcpConnection:
         off = self.snd_nxt
         payload = bytes(self.snd_buf[off - self.snd_una : off - self.snd_una + 1])
         self.snd_nxt = off + 1
+        self.snd_max = max(self.snd_max, self.snd_nxt)
         if not self._rto_armed:
             self._arm_rto()
         return self._stamp(
@@ -501,6 +514,7 @@ class TcpConnection:
         if not retransmit:
             self.fin_sent = True
             self.snd_nxt = self.stream_len + 1  # FIN occupies one seq slot
+            self.snd_max = max(self.snd_max, self.snd_nxt)
         self._ack_pending = False
         if not self._rto_armed:
             self._arm_rto()
@@ -649,11 +663,19 @@ class TcpConnection:
                 self.snd_nxt = self.snd_una
             if acked_bytes > 0:
                 n_seg = (acked_bytes + self.config.mss - 1) // self.config.mss
-                self.cong.on_new_ack(n_seg)
+                if self.cong.in_fast_recovery and ack_off < self._recover:
+                    # NewReno (RFC 6582): a partial ack means the next hole
+                    # is also lost — retransmit it NOW, stay in recovery
+                    self.cong.on_partial_ack(n_seg)
+                    self._retx_pending = True
+                else:
+                    self.cong.on_new_ack(n_seg)
+                    self._retx_pending = False
+            else:
+                self._retx_pending = False
             if seg.timestamp_echo and self.rtt.backoff_count == 0:
                 self.rtt.update(self._now_ms() - seg.timestamp_echo)
             self.rtt.reset_backoff()
-            self._retx_pending = False
             # RTO restarts while anything is in flight
             if self.snd_nxt > self.snd_una or (self.fin_sent and not self.fin_acked):
                 self._arm_rto()
@@ -669,6 +691,7 @@ class TcpConnection:
         ):
             if self.cong.on_duplicate_ack():
                 self._retx_pending = True  # fast retransmit
+                self._recover = self.snd_nxt  # NewReno recovery point
 
         self.snd_wnd = new_window
         if self.snd_wnd == 0 and self.stream_len > self.snd_nxt:
@@ -684,7 +707,10 @@ class TcpConnection:
         delta = seqmod.sub(wire_ack, base)
         if delta < (1 << 31):
             off = self.snd_una + delta
-            if off > self.snd_nxt:
+            # bound by the ever-sent high-water mark, not snd_nxt: after a
+            # go-back-N rollback, in-flight acks legitimately cover data
+            # above the rolled-back snd_nxt
+            if off > max(self.snd_nxt, self.snd_max):
                 return None  # acks bytes we never transmitted
             return off
         return self.snd_una - seqmod.sub(base, wire_ack)
@@ -802,7 +828,21 @@ class TcpConnection:
         if self.state in (TcpState.SYN_SENT, TcpState.SYN_RCVD):
             self._syn_outstanding = False  # rebuild the SYN / SYN|ACK
         else:
-            self._retx_pending = True
+            # Go-back-N (pre-SACK TCP timeout recovery): the receiver may
+            # have discarded any or all of the in-flight tail, so resend
+            # everything unacked through normal slow-start-paced
+            # transmission instead of trickling one MSS per (backed-off)
+            # RTO. Segments below the old snd_nxt are stamped as
+            # retransmissions. A pure unacked FIN lands here too (its
+            # sequence slot keeps snd_nxt above snd_una) and re-sends via
+            # fin_sent=False once the data, if any, drains.
+            self._gbn_high = max(self._gbn_high, self.snd_nxt)
+            self.snd_nxt = self.snd_una
+            self._retx_pending = False
+            if self.fin_sent and not self.fin_acked:
+                self.fin_sent = False
+            if self.snd_wnd == 0 and self.stream_len > self.snd_nxt:
+                self._arm_persist()  # window may never reopen via acks
         self._arm_rto()
         self.deps.notify()
 

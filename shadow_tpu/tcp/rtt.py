@@ -48,7 +48,17 @@ class RttEstimator:
         self._set_rto(self.rto_ms * 2)
 
     def reset_backoff(self) -> None:
+        """Forward progress after a timeout: restore the RTO from the
+        estimator instead of keeping the exponentially-inflated value
+        (otherwise every later loss doubles from the inflated base and
+        recovery degenerates into minutes-long stalls)."""
+        if self.backoff_count == 0:
+            return
         self.backoff_count = 0
+        if self.srtt_ms:
+            self._set_rto(self.srtt_ms + 4 * self.rttvar_ms)
+        else:
+            self._set_rto(RTO_INIT_MS)
 
     def _set_rto(self, ms: int) -> None:
         self.rto_ms = min(max(ms, RTO_MIN_MS), RTO_MAX_MS)

@@ -45,6 +45,76 @@ hosts:
     assert wall < 30.0  # and in particular: it finished at all
 
 
+ACCEPT_FOREVER_C = r"""
+#include <netinet/in.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+int main(void) {
+    int ls = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in a;
+    memset(&a, 0, sizeof a);
+    a.sin_family = AF_INET;
+    a.sin_port = htons(7070);
+    a.sin_addr.s_addr = INADDR_ANY;
+    if (bind(ls, (struct sockaddr *)&a, sizeof a)) return 1;
+    if (listen(ls, 4)) return 2;
+    accept(ls, 0, 0); /* parks forever on a simulated condition */
+    return 3;
+}
+"""
+
+CC = shutil.which("gcc") or shutil.which("cc")
+
+
+@pytest.mark.skipif(CC is None, reason="no C compiler")
+def test_sigkill_while_parked_on_untimed_condition(tmp_path):
+    """The binary is parked on a blocking accept() — an untimed
+    SysCallCondition, nobody in recv_from_shim — when SIGKILL arrives.
+    The watcher's posted reap task must still mark it killed and close its
+    simulated sockets (round-2 review finding)."""
+    import subprocess
+
+    src = tmp_path / "acceptor.c"
+    src.write_text(ACCEPT_FOREVER_C)
+    binary = tmp_path / "acceptor"
+    subprocess.run([CC, "-O1", "-o", str(binary), str(src)], check=True)
+
+    cfg = load_config_str(
+        f"""
+general: {{stop_time: 20s, seed: 5}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+hosts:
+  box:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, start_time: 1s, expected_final_state: {{signaled: 9}}}}
+  ticker:
+    network_node_id: 0
+    processes:
+    - {{path: udp-echo-server, args: ["9000"], start_time: 1s,
+       expected_final_state: running}}
+"""
+    )
+    mgr = Manager(cfg)
+    host = mgr.hosts_by_name["box"]
+    from shadow_tpu.core.event import TaskRef
+
+    def assassin(h):
+        (proc,) = h.processes
+        os.kill(proc.proc.pid, signal.SIGKILL)
+
+    host.schedule_task_at(TaskRef(assassin, "assassin"), 3 * 10**9)
+    start = time.monotonic()
+    stats = mgr.run()
+    wall = time.monotonic() - start
+    assert stats.process_failures == [], stats.process_failures
+    (proc,) = host.processes
+    assert proc.state == ProcessState.KILLED
+    assert proc.kill_signal == signal.SIGKILL
+    assert wall < 30.0
+
+
 SLEEP = shutil.which("sleep")
 
 
