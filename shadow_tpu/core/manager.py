@@ -129,6 +129,9 @@ class Manager:
                 qdisc=config.experimental.interface_qdisc,
                 experimental=config.experimental,
                 pcap_factory=pcap_factory,
+                model_unblocked_syscall_latency=(
+                    config.general.model_unblocked_syscall_latency
+                ),
             )
             self.hosts.append(host)
             self.hosts_by_name[name] = host

@@ -41,6 +41,7 @@ class Host:
         cpu: Optional[Cpu] = None,
         pcap_factory=None,
         experimental=None,
+        model_unblocked_syscall_latency: bool = False,
     ):
         self.host_id = host_id
         self.name = name
@@ -51,6 +52,9 @@ class Host:
         # ExperimentalOptions (socket buffer sizes/autotuning, TCP selection);
         # sockets read their defaults from here.
         self.config_experimental = experimental
+        # `general.model_unblocked_syscall_latency` (`configuration.rs`):
+        # gates the in-shim latency accumulator for managed processes
+        self.model_unblocked_syscall_latency = model_unblocked_syscall_latency
 
         self.event_queue = EventQueue()
         self._queue_lock = threading.Lock()  # cross-thread packet pushes

@@ -195,6 +195,55 @@ class SharedBlock:
             self._lib.shmem_free(ctypes.byref(self.block))
 
 
+class ProcessShmemStruct(ctypes.Structure):
+    """Mirror of ProcessShmem in shim_shmem.h."""
+
+    _fields_ = [
+        ("sim_time_ns", ctypes.c_uint64),
+        ("max_runahead_ns", ctypes.c_uint64),
+        ("epoch_offset_ns", ctypes.c_uint64),
+        ("syscall_latency_ns", ctypes.c_uint64),
+        ("enabled", ctypes.c_uint32),
+        ("_pad", ctypes.c_uint32),
+    ]
+
+
+class ProcessClock:
+    """Simulator-side view of one managed process's shared clock block
+    (the in-shim time fast path, `shim_sys.c:25-80`). Single-writer
+    alternation with the shim: only touch it while the shim is blocked in
+    recv (i.e. from the worker thread that owns the process)."""
+
+    def __init__(self):
+        load()  # ensures the library (and shmem symbols) exist
+        self.block = SharedBlock(size=ctypes.sizeof(ProcessShmemStruct))
+        self._view = ProcessShmemStruct.from_address(self.block.addr)
+        self._view.enabled = 0
+
+    def configure(self, epoch_offset_ns: int, syscall_latency_ns: int) -> None:
+        self._view.epoch_offset_ns = epoch_offset_ns
+        self._view.syscall_latency_ns = syscall_latency_ns
+
+    def publish(self, sim_time_ns: int, max_runahead_ns: int) -> None:
+        """Called before handing control to the shim: the clock only moves
+        forward (the shim may have advanced it past the host clock)."""
+        if sim_time_ns > self._view.sim_time_ns:
+            self._view.sim_time_ns = sim_time_ns
+        self._view.max_runahead_ns = max_runahead_ns
+        self._view.enabled = 1
+
+    @property
+    def sim_time_ns(self) -> int:
+        return int(self._view.sim_time_ns)
+
+    def serialize(self) -> str:
+        return self.block.serialize()
+
+    def free(self) -> None:
+        self._view = None
+        self.block.free()
+
+
 class IpcChannel:
     """The per-thread IPCData block, shadow side or shim side."""
 

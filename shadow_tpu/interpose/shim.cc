@@ -31,6 +31,7 @@
 #include <unistd.h>
 
 #include "ipc.h"
+#include "shim_shmem.h"
 #include "shmem.h"
 
 /* ------------------------------------------------------------------ */
@@ -47,6 +48,65 @@ extern "C" int shadow_tpu_patch_vdso(void);
 static ShMemBlock g_ipc_block;
 static IPCData *g_ipc = NULL;
 static int g_interposing = 0;
+
+/* per-process clock block (optional; fast path off when absent) */
+static ShMemBlock g_proc_block;
+static ProcessShmem *g_proc = NULL;
+
+/* ------------------------------------------------------------------ */
+/* In-shim time fast path (shim_sys.c:25-80): answer clock reads from
+ * the shared clock, charging the modeled syscall latency, while the
+ * advanced clock stays below the runahead bound. Returns 1 when the
+ * syscall was fully handled locally. */
+
+struct shim_timespec { int64_t tv_sec; int64_t tv_nsec; };
+struct shim_timeval { int64_t tv_sec; int64_t tv_usec; };
+
+static int clockid_is_monotonic(long clockid) {
+    /* MONOTONIC(1), MONOTONIC_RAW(4), MONOTONIC_COARSE(6), BOOTTIME(7) */
+    return clockid == 1 || clockid == 4 || clockid == 6 || clockid == 7;
+}
+
+static int shim_try_time_fastpath(long nr, const uint64_t args[6],
+                                  long *out_ret) {
+    if (!g_proc || !__atomic_load_n(&g_proc->enabled, __ATOMIC_ACQUIRE))
+        return 0;
+    if (nr != SYS_clock_gettime && nr != SYS_gettimeofday && nr != SYS_time)
+        return 0;
+    uint64_t now = g_proc->sim_time_ns + g_proc->syscall_latency_ns;
+    if (now > g_proc->max_runahead_ns)
+        return 0; /* runahead exhausted: yield to the simulator via IPC */
+    g_proc->sim_time_ns = now;
+
+    if (nr == SYS_clock_gettime) {
+        long clockid = (long)args[0];
+        struct shim_timespec *ts = (struct shim_timespec *)args[1];
+        uint64_t ns = clockid_is_monotonic(clockid)
+                          ? now
+                          : g_proc->epoch_offset_ns + now;
+        if (ts) {
+            ts->tv_sec = (int64_t)(ns / 1000000000ull);
+            ts->tv_nsec = (int64_t)(ns % 1000000000ull);
+        }
+        *out_ret = 0;
+        return 1;
+    }
+    if (nr == SYS_gettimeofday) {
+        struct shim_timeval *tv = (struct shim_timeval *)args[0];
+        uint64_t ns = g_proc->epoch_offset_ns + now;
+        if (tv) {
+            tv->tv_sec = (int64_t)(ns / 1000000000ull);
+            tv->tv_usec = (int64_t)((ns % 1000000000ull) / 1000);
+        }
+        *out_ret = 0;
+        return 1;
+    }
+    /* SYS_time */
+    uint64_t sec = (g_proc->epoch_offset_ns + now) / 1000000000ull;
+    if (args[0]) *(int64_t *)args[0] = (int64_t)sec;
+    *out_ret = (long)sec;
+    return 1;
+}
 
 /* The seccomp IP whitelist covers the "shim_text" section, which holds
  * every syscall *instruction* the shim itself executes (shim_raw_syscall
@@ -90,6 +150,11 @@ static void shim_sigsys_handler(int sig, siginfo_t *info, void *ucontext) {
         (uint64_t)regs[REG_RDX], (uint64_t)regs[REG_R10],
         (uint64_t)regs[REG_R8],  (uint64_t)regs[REG_R9],
     };
+    long fast_ret;
+    if (shim_try_time_fastpath(nr, args, &fast_ret)) {
+        regs[REG_RAX] = fast_ret;
+        return;
+    }
     regs[REG_RAX] = shim_emulate_syscall(nr, args);
 }
 
@@ -142,6 +207,14 @@ __attribute__((constructor)) static void shim_init(void) {
         _exit(112);
     }
     g_ipc = (IPCData *)g_ipc_block.addr;
+
+    /* optional per-process clock block for the in-shim time fast path */
+    const char *proc_handle = getenv("SHADOW_TPU_SHMEM_HANDLE");
+    if (proc_handle && *proc_handle &&
+        shmem_deserialize(proc_handle, &g_proc_block) == 0 &&
+        g_proc_block.size >= sizeof(ProcessShmem)) {
+        g_proc = (ProcessShmem *)g_proc_block.addr;
+    }
 
     struct sigaction sa;
     memset(&sa, 0, sizeof(sa));

@@ -1,0 +1,51 @@
+/* Per-process shared state between the simulator and the shim.
+ *
+ * Parity: reference src/lib/shadow-shim-helper-rs/src/shim_shmem.rs
+ * (ProcessShmem / HostShmem protected clock fields) + the in-shim hot
+ * path it powers (src/lib/shim/shim_sys.c:25-80,200-226): time syscalls
+ * are answered INSIDE the managed process from this block — zero IPC
+ * round trips — with a per-syscall latency accumulated into the clock,
+ * advancing locally while it stays under the round's runahead bound.
+ * Crossing the bound falls back to the full IPC path, which hands
+ * control to the simulator at the barrier (the reference's
+ * SYS_shadow_yield has the same effect).
+ *
+ * Single-writer discipline: the simulator writes while the shim is
+ * blocked in recv; the shim writes sim_time_ns while the simulator is
+ * blocked in recv. Strict rendezvous alternation means no concurrent
+ * writers; loads/stores are plain (the futex channel provides the
+ * ordering).
+ */
+#ifndef SHADOW_TPU_SHIM_SHMEM_H
+#define SHADOW_TPU_SHIM_SHMEM_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+#include <type_traits>
+extern "C" {
+#endif
+
+typedef struct ProcessShmem {
+    /* simulation clock (ns); monotonic-clock zero == simulation start */
+    uint64_t sim_time_ns;
+    /* the shim may advance sim_time_ns locally up to this bound
+     * (current round end); beyond it, syscalls take the IPC path */
+    uint64_t max_runahead_ns;
+    /* emulated-epoch offset: REALTIME = offset + sim_time
+     * (reference EmulatedTime epoch 2000-01-01, emulated_time.rs:18-45) */
+    uint64_t epoch_offset_ns;
+    /* modeled cost charged per locally-answered syscall */
+    uint64_t syscall_latency_ns;
+    /* 1 = the fast path is enabled (simulator has initialized bounds) */
+    uint32_t enabled;
+    uint32_t _pad;
+} ProcessShmem;
+
+#ifdef __cplusplus
+}
+static_assert(std::is_standard_layout<ProcessShmem>::value &&
+                  std::is_trivially_copyable<ProcessShmem>::value,
+              "ProcessShmem must be address-space independent");
+#endif
+#endif

@@ -161,7 +161,7 @@ class SyscallServer:
 
     def _clock_gettime(self, clockid: int, ts_addr: int) -> int:
         now = self.clock()
-        if clockid in (1, 4, 6):  # MONOTONIC, MONOTONIC_RAW, MONOTONIC_COARSE
+        if clockid in (1, 4, 6, 7):  # MONOTONIC{,_RAW,_COARSE}, BOOTTIME
             ns = now
         else:  # REALTIME & friends observe the emulated epoch
             ns = simtime.emulated_from_sim(now)
@@ -186,7 +186,7 @@ class SyscallServer:
             # absolute deadline on the given clock; REALTIME deadlines are
             # relative to the emulated epoch
             clockid = args[0]
-            now = self.clock() if clockid in (1, 4, 6) else simtime.emulated_from_sim(self.clock())
+            now = self.clock() if clockid in (1, 4, 6, 7) else simtime.emulated_from_sim(self.clock())
             t -= now
         if t > 0:
             self.advance(t)
@@ -289,9 +289,11 @@ class ManagedSimProcess:
         self.exit_status: Optional[int] = None
         self.kill_signal: Optional[int] = None
         self.server = SyscallServer(virtual_pid=self.pid,
-                                    clock=lambda: self.host.now())
+                                    clock=self._clock_ns)
         # the simulated-kernel dispatch table (network, readiness, sleep)
         self.handler = SyscallHandler(self)
+        # the shared clock powering the in-shim time fast path
+        self.proc_clock = None
         self.ipc: Optional[IpcChannel] = None
         self.proc = None
         self._death_seen = False
@@ -323,6 +325,19 @@ class ManagedSimProcess:
         preload = env.get("LD_PRELOAD", "")
         env["LD_PRELOAD"] = SHIM_PATH + (" " + preload if preload else "")
         env["SHADOW_TPU_IPC_HANDLE"] = self.ipc.block.serialize()
+        # shared clock block: the shim answers clock_gettime/gettimeofday/
+        # time locally from it, zero IPC round trips (`shim_sys.c:25-80`)
+        from ..interpose import ProcessClock
+
+        self.proc_clock = ProcessClock()
+        latency = 0
+        if getattr(self.host, "model_unblocked_syscall_latency", False):
+            exp = getattr(self.host, "config_experimental", None)
+            latency = getattr(exp, "unblocked_syscall_latency", 1000) or 0
+        self.proc_clock.configure(
+            simtime.EMUTIME_SIMULATION_START_UNIX_NS, latency
+        )
+        env["SHADOW_TPU_SHMEM_HANDLE"] = self.proc_clock.serialize()
         if self._output_dir:
             os.makedirs(self._output_dir, exist_ok=True)
             self._stdout = open(os.path.join(self._output_dir,
@@ -469,7 +484,27 @@ class ManagedSimProcess:
             log.warning("error closing %r descriptors at exit", self.name,
                         exc_info=True)
 
+    def _clock_ns(self) -> int:
+        """The process's observable clock: the host clock, or the shim's
+        locally-advanced time when it ran ahead within the runahead bound
+        (keeps slow-path time answers monotonic with fast-path ones)."""
+        now = self.host.now()
+        if self.proc_clock is not None:
+            return max(now, self.proc_clock.sim_time_ns)
+        return now
+
+    def _publish_clock(self) -> None:
+        """Refresh the shared clock before handing control to the shim
+        (`continue_plugin` writing max_runahead_time, `managed_thread.rs:
+        431-467`): runahead bound = current round end."""
+        if self.proc_clock is None:
+            return
+        worker = getattr(self.host, "_worker", None)
+        round_end = getattr(worker, "round_end_time", 0) or self.host.now()
+        self.proc_clock.publish(self.host.now(), round_end)
+
     def _reply_complete(self, retval: int) -> None:
+        self._publish_clock()
         reply = ShimEvent()
         reply.kind = EVENT_SYSCALL_COMPLETE
         reply.u.complete.retval = retval
@@ -480,6 +515,7 @@ class ManagedSimProcess:
             pass
 
     def _reply_native(self) -> None:
+        self._publish_clock()
         reply = ShimEvent()
         reply.kind = EVENT_SYSCALL_DO_NATIVE
         try:
@@ -547,6 +583,9 @@ class ManagedSimProcess:
                 self.ipc.close()
                 self.ipc.block.free()
                 self.ipc = None
+        if self.proc_clock is not None:
+            self.proc_clock.free()
+            self.proc_clock = None
         for fh in (self._stdout, self._stderr):
             if fh is not None:
                 fh.close()

@@ -72,7 +72,10 @@ SYS_getpeername = 52
 SYS_setsockopt = 54
 SYS_getsockopt = 55
 SYS_fcntl = 72
+SYS_gettimeofday = 96
+SYS_time = 201
 SYS_epoll_create = 213
+SYS_clock_gettime = 228
 SYS_clock_nanosleep = 230
 SYS_epoll_wait = 232
 SYS_epoll_ctl = 233
@@ -175,7 +178,8 @@ class SyscallHandler:
         self._table = DescriptorTable()
         # the one transient wait-epoll a parked poll/select holds
         self._wait_epoll: Optional[Epoll] = None
-        # sockets with a connect() issued and not yet reported complete
+        # per-syscall dispatch tally for sim-stats (first dispatches only;
+        # condition-wakeup re-dispatches of the same call don't re-count)
         self.syscall_counts: dict[int, int] = {}
 
     # -- descriptor plumbing -------------------------------------------
@@ -230,17 +234,31 @@ class SyscallHandler:
         ip = ".".join(str(b) for b in raw[4:8])
         return ip, port
 
+    @staticmethod
+    def _pack_sockaddr(sockaddr: Optional[tuple[str, int]]) -> bytes:
+        ip, port = sockaddr if sockaddr is not None else (UNSPECIFIED, 0)
+        return struct.pack("<H", AF_INET) + struct.pack(">H", port) + bytes(
+            int(p) for p in ip.split(".")
+        ) + b"\x00" * 8
+
     def _write_sockaddr(self, addr: int, addrlen_ptr: int,
                         sockaddr: Optional[tuple[str, int]]) -> None:
         if not addr or not addrlen_ptr:
             return
-        ip, port = sockaddr if sockaddr is not None else (UNSPECIFIED, 0)
-        raw = struct.pack("<H", AF_INET) + struct.pack(">H", port) + bytes(
-            int(p) for p in ip.split(".")
-        ) + b"\x00" * 8
+        raw = self._pack_sockaddr(sockaddr)
         (cap,) = struct.unpack("<I", self.mem.read(addrlen_ptr, 4))
         self.mem.write(addr, raw[: min(cap, len(raw))])
         self.mem.write(addrlen_ptr, struct.pack("<I", len(raw)))
+
+    def _scatter(self, iovs: list[tuple[int, int]], data: bytes) -> None:
+        """Write `data` across iovec buffers (readv/recvmsg gather side)."""
+        off = 0
+        for base, ln in iovs:
+            chunk = data[off:off + ln]
+            if not chunk:
+                break
+            self.mem.write(base, chunk)
+            off += len(chunk)
 
     # -- dispatch ------------------------------------------------------
 
@@ -249,7 +267,8 @@ class SyscallHandler:
         passthrough, errors.SyscallError for -errno, errors.Blocked to
         park. Re-dispatched (ctx.wake set) calls must be idempotent up to
         their blocking point."""
-        self.syscall_counts[nr] = self.syscall_counts.get(nr, 0) + 1
+        if ctx.wake is None:
+            self.syscall_counts[nr] = self.syscall_counts.get(nr, 0) + 1
         handler = self._HANDLERS.get(nr)
         if handler is None:
             raise NativeSyscall()
@@ -417,16 +436,10 @@ class SyscallHandler:
         sock = self._file(args[0])
         iovs = self._read_iovec(args[1], _i32(args[2]))
         total = sum(ln for _, ln in iovs)
-        dontwait_data = sock.recv(total) if not isinstance(sock, UdpSocket) \
+        data = sock.recv(total) if not isinstance(sock, UdpSocket) \
             else sock.recvfrom()[0][:total]
-        off = 0
-        for base, ln in iovs:
-            chunk = dontwait_data[off:off + ln]
-            if not chunk:
-                break
-            self.mem.write(base, chunk)
-            off += len(chunk)
-        return len(dontwait_data)
+        self._scatter(iovs, data)
+        return len(data)
 
     def _sys_sendto(self, args, ctx) -> int:
         sock = self._file(args[0])
@@ -476,33 +489,41 @@ class SyscallHandler:
         sock = self._file(args[0])
         name, namelen, iovs = self._parse_msghdr(args[1])
         data = b"".join(self.mem.read(base, ln) for base, ln in iovs if ln)
-        if isinstance(sock, UdpSocket):
-            dst = self._read_sockaddr(name, namelen) if name else None
-            return sock.sendto(data, dst)
-        return sock.send(data)
+        dontwait = bool(_i32(args[2]) & MSG_DONTWAIT)
+        saved = sock.nonblocking
+        if dontwait:
+            sock.nonblocking = True
+        try:
+            if isinstance(sock, UdpSocket):
+                dst = self._read_sockaddr(name, namelen) if name else None
+                return sock.sendto(data, dst)
+            return sock.send(data)
+        finally:
+            sock.nonblocking = saved
 
     def _sys_recvmsg(self, args, ctx) -> int:
         sock = self._file(args[0])
-        name, _namelen, iovs = self._parse_msghdr(args[1])
+        name, namelen, iovs = self._parse_msghdr(args[1])
         total = sum(ln for _, ln in iovs)
-        if isinstance(sock, UdpSocket):
-            data, src = sock.recvfrom()
-            data = data[:total]
-        else:
-            data = sock.recv(total)
-            src = sock.getpeername()
-        off = 0
-        for base, ln in iovs:
-            chunk = data[off:off + ln]
-            if not chunk:
-                break
-            self.mem.write(base, chunk)
-            off += len(chunk)
-        # msg_name writeback: namelen lives at msgp+8; write src if wanted
+        dontwait = bool(_i32(args[2]) & MSG_DONTWAIT)
+        saved = sock.nonblocking
+        if dontwait:
+            sock.nonblocking = True
+        try:
+            if isinstance(sock, UdpSocket):
+                data, src = sock.recvfrom()
+                data = data[:total]
+            else:
+                data = sock.recv(total)
+                src = sock.getpeername()
+        finally:
+            sock.nonblocking = saved
+        self._scatter(iovs, data)
+        # msg_name writeback, capped at the caller's msg_namelen; the
+        # written length lands in msg_namelen (offset 8 in msghdr)
         if name and src is not None:
-            raw = struct.pack("<H", AF_INET) + struct.pack(">H", src[1]) + \
-                bytes(int(p) for p in src[0].split(".")) + b"\x00" * 8
-            self.mem.write(name, raw)
+            raw = self._pack_sockaddr(src)
+            self.mem.write(name, raw[: min(namelen, len(raw))])
             self.mem.write(args[1] + 8, struct.pack("<I", len(raw)))
         return len(data)
 
@@ -832,10 +853,27 @@ class SyscallHandler:
         sec, nsec = struct.unpack("<qq", self.mem.read(req_addr, 16))
         t = sec * simtime.SECOND + nsec
         if absolute:
-            now = (self.host.now() if clockid in (1, 4, 6)
+            now = (self.host.now() if clockid in (1, 4, 6, 7)
                    else simtime.emulated_from_sim(self.host.now()))
             t -= now
         return max(0, t)
+
+    def _sys_time_read(self, args, ctx) -> int:
+        """clock_gettime / gettimeofday / time arriving over IPC.
+
+        Normally these are answered INSIDE the shim from the shared clock
+        (`shim_sys.c:25-80`); they reach us only before the first clock
+        publish or when the shim exhausted its runahead bound. In the
+        latter case the shim's local clock is ahead of the host clock —
+        park until simulated time catches up (the reference's
+        SYS_shadow_yield barrier, `shim_sys.c:225`), then answer from the
+        merged clock via the slow path."""
+        pc = getattr(self.process, "proc_clock", None)
+        if pc is not None and ctx.wake is None:
+            ahead = pc.sim_time_ns - self.host.now()
+            if ahead > 0:
+                raise errors.Blocked(None, FileState.NONE, timeout_ns=ahead)
+        raise NativeSyscall()  # SyscallServer answers from the merged clock
 
     def _sys_getrandom(self, args, ctx) -> int:
         bufp, n = args[0], min(args[1], 1 << 20)
@@ -887,5 +925,8 @@ class SyscallHandler:
         SYS_epoll_pwait: _sys_epoll_pwait,
         SYS_nanosleep: _sys_nanosleep,
         SYS_clock_nanosleep: _sys_clock_nanosleep,
+        SYS_clock_gettime: _sys_time_read,
+        SYS_gettimeofday: _sys_time_read,
+        SYS_time: _sys_time_read,
         SYS_getrandom: _sys_getrandom,
     }

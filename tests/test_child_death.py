@@ -103,6 +103,10 @@ hosts:
     def assassin(h):
         (proc,) = h.processes
         os.kill(proc.proc.pid, signal.SIGKILL)
+        # wait (without reaping) until the kernel marks it dead, so the
+        # death is observable before the simulation fast-forwards to its
+        # end — an external kill is wall-asynchronous by nature
+        os.waitid(os.P_PID, proc.proc.pid, os.WEXITED | os.WNOWAIT)
 
     host.schedule_task_at(TaskRef(assassin, "assassin"), 3 * 10**9)
     start = time.monotonic()

@@ -249,7 +249,8 @@ hosts:
 def test_udp_echo_with_poll_and_virtual_rtt(tmp_path):
     """A compiled C UDP echo pair: recvfrom/sendto with address writeback,
     poll()-based waits, and clock_gettime showing the simulated RTT (2 x
-    25ms latency) rather than wall time."""
+    25ms latency) rather than wall time. The threshold sits just under the
+    exact RTT to stay robust if the syscall-latency model is enabled."""
     echo = _compile(tmp_path, "uecho", UDP_ECHO_C)
     cli = _compile(tmp_path, "uclient", UDP_CLIENT_C)
     cfg = load_config_str(f"""
@@ -274,7 +275,7 @@ hosts:
     network_node_id: 0
     ip_addr: 11.0.0.2
     processes:
-    - {{path: {cli}, args: ["11.0.0.1", "9000", "5", "50000000"],
+    - {{path: {cli}, args: ["11.0.0.1", "9000", "5", "49000000"],
        start_time: 2s, expected_final_state: {{exited: 0}}}}
 """)
     stats = Manager(cfg).run()
