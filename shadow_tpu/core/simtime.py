@@ -28,6 +28,13 @@ EMUTIME_SIMULATION_START_UNIX_NS = int(
 ) * SECOND
 
 
+# clockids whose reads are raw simulation time (zero at sim start) rather
+# than emulated-epoch time: MONOTONIC(1), MONOTONIC_RAW(4),
+# MONOTONIC_COARSE(6), BOOTTIME(7). Must match clockid_is_monotonic() in
+# interpose/shim.cc (the in-shim fast path answers the same clocks).
+MONOTONIC_CLOCK_IDS = frozenset((1, 4, 6, 7))
+
+
 def emulated_from_sim(sim_ns: int) -> int:
     """Map simulation time -> emulated UNIX time (ns) seen by applications."""
     return EMUTIME_SIMULATION_START_UNIX_NS + sim_ns

@@ -104,6 +104,7 @@ _lib: Optional[ctypes.CDLL] = None
 
 
 SHIM_LIB_PATH = os.path.join(_DIR, "libshadow_shim.so")
+PRELOAD_LIBC_LIB_PATH = os.path.join(_DIR, "libshadow_preload_libc.so")
 
 
 def build(force: bool = False) -> str:
@@ -112,6 +113,7 @@ def build(force: bool = False) -> str:
         force
         or not os.path.exists(_LIB_PATH)
         or not os.path.exists(SHIM_LIB_PATH)
+        or not os.path.exists(PRELOAD_LIBC_LIB_PATH)
     ):
         subprocess.run(
             ["make", "-C", _DIR], check=True, capture_output=True, text=True

@@ -140,6 +140,85 @@ static long shim_emulate_syscall(long nr, const uint64_t args[6]) {
     return reply.u.complete.retval;
 }
 
+/* ------------------------------------------------------------------ */
+/* rdtsc/rdtscp trap-and-emulate (reference src/lib/shim/shim_rdtsc.c +
+ * src/lib/tsc): PR_SET_TSC(PR_TSC_SIGSEGV) makes every rdtsc fault; the
+ * SIGSEGV handler decodes the instruction and returns the EMULATED
+ * cycle count — a nominal 1 GHz TSC, so cycles == simulated ns — then
+ * skips the instruction. Without this, real time leaks into any binary
+ * using rdtsc (most modern language runtimes via their clock vDSO
+ * fallbacks). */
+
+static uint64_t shim_emulated_tsc_ns(void) {
+    if (g_proc && __atomic_load_n(&g_proc->enabled, __ATOMIC_ACQUIRE)) {
+        /* charge the modeled latency and honor the runahead bound like
+         * the clock_gettime fast path — a TSC spin-wait must advance
+         * time and eventually yield, or the simulation livelocks */
+        uint64_t now = g_proc->sim_time_ns + g_proc->syscall_latency_ns;
+        if (now <= g_proc->max_runahead_ns) {
+            g_proc->sim_time_ns = now;
+            return now;
+        }
+    }
+    /* no shared clock yet, or runahead exhausted: ask the simulator
+     * (full IPC round trip; it parks us until sim time catches up) */
+    struct shim_timespec ts = {0, 0};
+    uint64_t args[6] = {1 /* CLOCK_MONOTONIC */, (uint64_t)&ts, 0, 0, 0, 0};
+    shim_emulate_syscall(SYS_clock_gettime, args);
+    return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+static void shim_sigsegv_handler(int sig, siginfo_t *info, void *ucontext) {
+    ucontext_t *ctx = (ucontext_t *)ucontext;
+    greg_t *regs = ctx->uc_mcontext.gregs;
+    const uint8_t *ip = (const uint8_t *)regs[REG_RIP];
+    /* An exec fault (jump to unmapped memory) has si_addr == RIP; reading
+     * the instruction bytes would re-fault and recurse. Only decode when
+     * the faulting address is NOT the instruction pointer (a PR_SET_TSC
+     * trap reports si_addr = NULL with RIP at the rdtsc). */
+    int decodable = ip && (const uint8_t *)info->si_addr != ip;
+    int is_rdtsc = decodable && ip[0] == 0x0f && ip[1] == 0x31;
+    int is_rdtscp =
+        decodable && ip[0] == 0x0f && ip[1] == 0x01 && ip[2] == 0xf9;
+    if (!is_rdtsc && !is_rdtscp) {
+        /* a real crash: fall back to default disposition and re-raise.
+         * Raw syscalls only — libc getpid() is interposed by the preload
+         * wrappers and would return the VIRTUAL pid (and re-enter IPC
+         * from inside a crash handler). */
+        signal(SIGSEGV, SIG_DFL);
+        long tgid = shim_raw_syscall(SYS_getpid, 0, 0, 0, 0, 0, 0);
+        long tid = shim_raw_syscall(SYS_gettid, 0, 0, 0, 0, 0, 0);
+        shim_raw_syscall(SYS_tgkill, tgid, tid, SIGSEGV, 0, 0, 0);
+        return;
+    }
+    uint64_t tsc = shim_emulated_tsc_ns(); /* 1 GHz: cycles == ns */
+    regs[REG_RAX] = (greg_t)(tsc & 0xffffffffu);
+    regs[REG_RDX] = (greg_t)(tsc >> 32);
+    if (is_rdtscp) {
+        regs[REG_RCX] = 0; /* IA32_TSC_AUX: cpu 0, node 0 */
+        regs[REG_RIP] += 3;
+    } else {
+        regs[REG_RIP] += 2;
+    }
+    (void)sig;
+    (void)info;
+}
+
+/* Direct entry for the preload-libc wrappers (reference
+ * src/lib/preload-libc + shim_api_syscall.c): same dispatch as the
+ * SIGSYS path but via a plain function call — no signal delivery, no
+ * kernel round trip for locally-answered syscalls. */
+extern "C" long shadow_tpu_api_syscall(long nr, long a, long b, long c,
+                                       long d, long e, long f) {
+    if (!g_interposing)
+        return shim_raw_syscall(nr, a, b, c, d, e, f);
+    uint64_t args[6] = {(uint64_t)a, (uint64_t)b, (uint64_t)c,
+                        (uint64_t)d, (uint64_t)e, (uint64_t)f};
+    long fast;
+    if (shim_try_time_fastpath(nr, args, &fast)) return fast;
+    return shim_emulate_syscall(nr, args);
+}
+
 static void shim_sigsys_handler(int sig, siginfo_t *info, void *ucontext) {
     (void)sig;
     ucontext_t *ctx = (ucontext_t *)ucontext;
@@ -221,6 +300,19 @@ __attribute__((constructor)) static void shim_init(void) {
     sa.sa_sigaction = shim_sigsys_handler;
     sa.sa_flags = SA_SIGINFO | SA_NODEFER;
     if (sigaction(SIGSYS, &sa, NULL) != 0) _exit(113);
+
+    /* trap rdtsc/rdtscp so cycle counters observe simulated time */
+    struct sigaction segv;
+    memset(&segv, 0, sizeof(segv));
+    segv.sa_sigaction = shim_sigsegv_handler;
+    segv.sa_flags = SA_SIGINFO | SA_NODEFER;
+    if (sigaction(SIGSEGV, &segv, NULL) == 0) {
+#ifndef PR_TSC_SIGSEGV
+#define PR_TSC_SIGSEGV 2
+#endif
+        if (prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0) != 0)
+            shim_log("shadow_tpu shim: PR_SET_TSC failed (rdtsc leaks real time)\n");
+    }
 
     /* force vDSO time functions onto the (trappable) syscall path */
     if (shadow_tpu_patch_vdso() <= 0)

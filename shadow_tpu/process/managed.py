@@ -50,6 +50,16 @@ from ..interpose import (
 _DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                     "interpose")
 SHIM_PATH = os.path.join(_DIR, "libshadow_shim.so")
+PRELOAD_LIBC_PATH = os.path.join(_DIR, "libshadow_preload_libc.so")
+
+
+def _preload_chain() -> str:
+    """LD_PRELOAD value: libc wrappers first (so application symbol lookups
+    hit them before libc), then the shim they call into
+    (`inject_preloads`, `managed_thread.rs:546-640`)."""
+    if os.path.exists(PRELOAD_LIBC_PATH):
+        return PRELOAD_LIBC_PATH + " " + SHIM_PATH
+    return SHIM_PATH
 
 # x86_64 syscall numbers the server emulates
 SYS_write = 1
@@ -161,7 +171,7 @@ class SyscallServer:
 
     def _clock_gettime(self, clockid: int, ts_addr: int) -> int:
         now = self.clock()
-        if clockid in (1, 4, 6, 7):  # MONOTONIC{,_RAW,_COARSE}, BOOTTIME
+        if clockid in simtime.MONOTONIC_CLOCK_IDS:
             ns = now
         else:  # REALTIME & friends observe the emulated epoch
             ns = simtime.emulated_from_sim(now)
@@ -186,7 +196,8 @@ class SyscallServer:
             # absolute deadline on the given clock; REALTIME deadlines are
             # relative to the emulated epoch
             clockid = args[0]
-            now = self.clock() if clockid in (1, 4, 6, 7) else simtime.emulated_from_sim(self.clock())
+            now = (self.clock() if clockid in simtime.MONOTONIC_CLOCK_IDS
+                   else simtime.emulated_from_sim(self.clock()))
             t -= now
         if t > 0:
             self.advance(t)
@@ -208,7 +219,7 @@ class ManagedProcess:
         # preload injection (`managed_thread.rs` inject_preloads)
         preload = full_env.get("LD_PRELOAD", "")
         full_env["LD_PRELOAD"] = (
-            SHIM_PATH + (" " + preload if preload else "")
+            _preload_chain() + (" " + preload if preload else "")
         )
         full_env["SHADOW_TPU_IPC_HANDLE"] = self.ipc.block.serialize()
         self.proc = subprocess.Popen(
@@ -323,7 +334,7 @@ class ManagedSimProcess:
         self.ipc = IpcChannel.create()
         env = dict(os.environ)
         preload = env.get("LD_PRELOAD", "")
-        env["LD_PRELOAD"] = SHIM_PATH + (" " + preload if preload else "")
+        env["LD_PRELOAD"] = _preload_chain() + (" " + preload if preload else "")
         env["SHADOW_TPU_IPC_HANDLE"] = self.ipc.block.serialize()
         # shared clock block: the shim answers clock_gettime/gettimeofday/
         # time locally from it, zero IPC round trips (`shim_sys.c:25-80`)

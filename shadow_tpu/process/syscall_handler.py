@@ -50,6 +50,7 @@ SYS_write = 1
 SYS_close = 3
 SYS_fstat = 5
 SYS_poll = 7
+SYS_rt_sigaction = 13
 SYS_ioctl = 16
 SYS_readv = 19
 SYS_writev = 20
@@ -853,7 +854,7 @@ class SyscallHandler:
         sec, nsec = struct.unpack("<qq", self.mem.read(req_addr, 16))
         t = sec * simtime.SECOND + nsec
         if absolute:
-            now = (self.host.now() if clockid in (1, 4, 6, 7)
+            now = (self.host.now() if clockid in simtime.MONOTONIC_CLOCK_IDS
                    else simtime.emulated_from_sim(self.host.now()))
             t -= now
         return max(0, t)
@@ -874,6 +875,20 @@ class SyscallHandler:
             if ahead > 0:
                 raise errors.Blocked(None, FileState.NONE, timeout_ns=ahead)
         raise NativeSyscall()  # SyscallServer answers from the merged clock
+
+    # shim-owned signals: SIGSEGV carries the rdtsc trap-and-emulate
+    # handler, SIGSYS the seccomp trampoline. An app install would clobber
+    # interposition process-wide (reference: the shim interposes sigaction
+    # to protect its signals, `shim/src/lib.rs`).
+    _SHIM_OWNED_SIGNALS = (11, 31)  # SIGSEGV, SIGSYS
+
+    def _sys_rt_sigaction(self, args, ctx) -> int:
+        signum = _i32(args[0])
+        if signum in self._SHIM_OWNED_SIGNALS and args[1]:
+            # pretend success without replacing the shim's handler; reads
+            # (act==NULL) still pass through natively
+            return 0
+        raise NativeSyscall()
 
     def _sys_getrandom(self, args, ctx) -> int:
         bufp, n = args[0], min(args[1], 1 << 20)
@@ -928,5 +943,6 @@ class SyscallHandler:
         SYS_clock_gettime: _sys_time_read,
         SYS_gettimeofday: _sys_time_read,
         SYS_time: _sys_time_read,
+        SYS_rt_sigaction: _sys_rt_sigaction,
         SYS_getrandom: _sys_getrandom,
     }
