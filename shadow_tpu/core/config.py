@@ -140,6 +140,11 @@ class ExperimentalOptions:
     scheduler: str = "thread-per-core"  # thread-per-core | thread-per-host | serial
     use_tpu_net_plane: bool = True  # offload router/relay/latency/loss to TPU
     tpu_devices: Optional[int] = None  # None = all visible devices
+    # route live inter-host transport through the device plane (one device
+    # round trip per scheduling round); event order matches CPU transport
+    use_tpu_transport: bool = False
+    tpu_egress_cap: int = 256  # per-host device egress slots
+    tpu_ingress_cap: int = 256  # per-host device in-flight slots
 
 
 @dataclass

@@ -149,6 +149,16 @@ class Manager:
             sim_end_time=config.general.stop_time,
             bootstrap_end_time=config.general.bootstrap_end_time,
         )
+        self.transport = None
+        if config.experimental.use_tpu_transport:
+            from ..tpu.transport import DeviceTransport
+
+            self.transport = DeviceTransport(
+                self.hosts, self.routing, ip_to_node,
+                egress_cap=config.experimental.tpu_egress_cap,
+                ingress_cap=config.experimental.tpu_ingress_cap,
+            )
+            self.shared.device_transport = self.transport
 
         # parallelism = min(cores, hosts) unless configured
         par = config.general.parallelism
@@ -327,7 +337,17 @@ class Manager:
             window = self.controller.next_window(min_next)
             while window is not None:
                 start, end = window
+                if self.transport is not None:
+                    # release device-held packets due in this window into
+                    # host event queues before anyone executes
+                    self.transport.release(start, end)
                 min_next = self.scheduler.run_round(self._host_order, end)
+                if self.transport is not None:
+                    # barrier: ship this round's captured egress to device
+                    self.transport.finish_round(start, end)
+                    t = self.transport.next_pending_abs
+                    if t is not None:
+                        min_next = t if min_next is None else min(min_next, t)
                 # round boundary: absorb watcher-thread posts (managed
                 # process deaths) into the now-quiescent host queues
                 for host in self.hosts:

@@ -56,6 +56,9 @@ class WorkerShared:
         self.sim_end_time = sim_end_time
         self.bootstrap_end_time = bootstrap_end_time
         self.packet_drop_count = 0
+        # set by the Manager when experimental.use_tpu_transport is on:
+        # cross-host delivery runs through the device plane
+        self.device_transport = None
         # guards the (non-atomic) numpy counter updates and the drop count
         self._count_lock = threading.Lock()
 
@@ -153,6 +156,15 @@ class Worker:
         self.update_next_event_time(deliver_time)
 
         src_event_id = src_host.next_packet_event_id()
+        transport = self.shared.device_transport
+        if transport is not None:
+            # device mode: the plane computes the deliver time and routes
+            # the packet; everything above (RNG draw, counters, statuses,
+            # event-id allocation) already happened identically, so event
+            # keys — and therefore event order — match the CPU path
+            transport.capture(src_host, dst_host, packet, now, src_event_id,
+                              self.round_end_time)
+            return
         dst_host.push_packet_event(
             packet, deliver_time, src_host.host_id, src_event_id
         )

@@ -37,6 +37,11 @@ import jax.numpy as jnp
 import numpy as np
 
 I32_MAX = np.int32(2**31 - 1)
+# eg_clamp sentinel: "clamp this packet's delivery to the end of whatever
+# window processes it" (the pure-device mode, where ingest and step share a
+# window). Integrated transport passes the send-round end instead, since the
+# processing step runs one round later (`worker.rs:396-399` semantics).
+NO_CLAMP = np.int32(-(2**30))
 
 
 class NetPlaneParams(NamedTuple):
@@ -57,6 +62,8 @@ class NetPlaneState(NamedTuple):
     eg_prio: jax.Array  # int32 host-assigned FIFO priority
     eg_seq: jax.Array  # int32 per-source packet id (payload correlation)
     eg_ctrl: jax.Array  # bool — control packets are never loss-dropped
+    eg_tsend: jax.Array  # int32 ns send time relative to window start
+    eg_clamp: jax.Array  # int32 barrier clamp (NO_CLAMP = current window end)
     eg_valid: jax.Array  # bool
     # ingress queues (in flight toward this host): [N, CI]
     in_src: jax.Array  # int32 source host index
@@ -98,6 +105,8 @@ def make_state(n_hosts: int, egress_cap: int = 32, ingress_cap: int = 64,
         eg_prio=jnp.full((N, CE), I32_MAX, jnp.int32),
         eg_seq=z((N, CE)),
         eg_ctrl=jnp.zeros((N, CE), bool),
+        eg_tsend=z((N, CE)),
+        eg_clamp=jnp.full((N, CE), NO_CLAMP, jnp.int32),
         eg_valid=jnp.zeros((N, CE), bool),
         in_src=jnp.full((N, CI), -1, jnp.int32),
         in_bytes=z((N, CI)),
@@ -148,11 +157,16 @@ def _scatter_append(group, in_order_rank_src, n_valid, cap, n_groups):
 
 def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
            nbytes: jax.Array, prio: jax.Array, seq: jax.Array,
-           ctrl: jax.Array, valid: jax.Array | None = None) -> NetPlaneState:
+           ctrl: jax.Array, valid: jax.Array | None = None,
+           send_rel: jax.Array | None = None,
+           clamp_rel: jax.Array | None = None) -> NetPlaneState:
     """Append a batch of outbound packets ([B] arrays; src = emitting host
     index) to the egress queues. Slots are allocated after the current valid
     entries per row; overflow beyond capacity is counted and dropped.
     `valid` masks out dead batch slots (fixed-shape on-device producers).
+    `send_rel` is each packet's emission time relative to the current
+    window start (defaults to 0 = window start), giving per-packet deliver
+    times that bitwise-match the CPU plane's now + latency.
 
     The CPU syscall plane calls this once per round with everything the
     sockets emitted (double-buffered host arrays in the full system)."""
@@ -160,11 +174,16 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
     if valid is not None:
         # dead slots route to src N (out of range) and never place
         src = jnp.where(valid, src, N)
+    if send_rel is None:
+        send_rel = jnp.zeros_like(seq)
+    if clamp_rel is None:
+        clamp_rel = jnp.full_like(seq, NO_CLAMP)
     # rank of each packet within its src group, deterministic by (src, seq)
     order = jnp.lexsort((seq, src))
     src_s, dst_s = src[order], dst[order]
     bytes_s, prio_s = nbytes[order], prio[order]
-    seq_s, ctrl_s = seq[order], ctrl[order]
+    seq_s, ctrl_s, tsend_s = seq[order], ctrl[order], send_rel[order]
+    clamp_s = clamp_rel[order]
 
     n_valid = state.eg_valid.sum(axis=1).astype(jnp.int32)  # [N]
     # rows are front-compacted (window_step re-sorts), so slot placement is
@@ -180,10 +199,13 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
     eg_prio = put(state.eg_prio, prio_s)
     eg_seq = put(state.eg_seq, seq_s)
     eg_ctrl = put(state.eg_ctrl, ctrl_s)
+    eg_tsend = put(state.eg_tsend, tsend_s)
+    eg_clamp = put(state.eg_clamp, clamp_s)
     eg_valid = put(state.eg_valid, jnp.ones_like(ok))
     return state._replace(
         eg_dst=eg_dst, eg_bytes=eg_bytes, eg_prio=eg_prio, eg_seq=eg_seq,
-        eg_ctrl=eg_ctrl, eg_valid=eg_valid,
+        eg_ctrl=eg_ctrl, eg_tsend=eg_tsend, eg_clamp=eg_clamp,
+        eg_valid=eg_valid,
         n_overflow_dropped=state.n_overflow_dropped + overflow,
     )
 
@@ -221,11 +243,18 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
 
     # --- 2. egress: qdisc order, token-bucket gate ----------------------
     # FIFO-by-priority qdisc (`network_interface.c:205-303`): valid first,
-    # then ascending priority.
+    # then ascending priority. Send times / clamps of leftover packets were
+    # taken relative to the window they were ingested in; rebase them too.
+    eg_tsend_rb = jnp.where(state.eg_valid, state.eg_tsend - shift_ns, 0)
+    eg_clamp_rb = jnp.where(
+        state.eg_valid & (state.eg_clamp != NO_CLAMP),
+        state.eg_clamp - shift_ns, state.eg_clamp,
+    )
     inv = (~state.eg_valid).astype(jnp.int32)
-    eg_inv, eg_prio, eg_dst, eg_bytes, eg_seq, eg_ctrl, eg_valid = _row_sort(
+    (eg_inv, eg_prio, eg_dst, eg_bytes, eg_seq, eg_ctrl, eg_tsend, eg_clamp,
+     eg_valid) = _row_sort(
         inv, state.eg_prio, state.eg_dst, state.eg_bytes, state.eg_seq,
-        state.eg_ctrl, state.eg_valid, keys=2,
+        state.eg_ctrl, eg_tsend_rb, eg_clamp_rb, state.eg_valid, keys=2,
     )
     cum = jnp.cumsum(jnp.where(eg_valid, eg_bytes, 0), axis=1)
     sendable = eg_valid & (cum <= balance[:, None])
@@ -248,37 +277,30 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     rng_counter = state.rng_counter + sendable.sum(axis=1, dtype=jnp.int32)
 
     latency = params.latency_ns[jnp.broadcast_to(host_idx, (N, CE)), dst_clipped]
-    # deliver no earlier than the round barrier (`worker.rs:396-399`)
-    deliver_rel = jnp.maximum(latency, window_ns)  # relative to window start
+    # send time + latency, but no earlier than the round barrier the packet
+    # was sent under (`worker.rs:396-399`); NO_CLAMP means "this window's
+    # end" (pure-device mode, where ingest and step share the window)
+    clamp_eff = jnp.where(eg_clamp == NO_CLAMP, window_ns, eg_clamp)
+    deliver_rel = jnp.maximum(eg_tsend + latency, clamp_eff)
 
     # egress queue keeps only what didn't go out (compacted after routing,
     # which still indexes this ordering)
     eg_valid_left = eg_valid & ~sendable
 
-    # --- 4. ingress: deliver due packets, then compact ------------------
-    due = state.in_valid & (in_deliver < window_ns)
-    # deterministic presentation order: (deliver_t, src, seq), due first
-    not_due = (~due).astype(jnp.int32)
-    nd, d_t, d_src, d_seq, d_bytes, d_mask = _row_sort(
-        not_due, in_deliver, state.in_src, state.in_seq, state.in_bytes, due,
-        keys=4,
-    )
-    delivered = {
-        "mask": d_mask, "src": d_src, "seq": d_seq, "bytes": d_bytes,
-        "deliver_rel": d_t,
-    }
-    in_valid_left = state.in_valid & ~due
-
-    # compact remaining ingress: valid first, by (deliver, src, seq)
-    inv_in = (~in_valid_left).astype(jnp.int32)
-    key_deliver = jnp.where(in_valid_left, in_deliver, I32_MAX)
+    # --- 4. compact surviving ingress (front-packed for the scatter) -----
+    inv_in = (~state.in_valid).astype(jnp.int32)
+    key_deliver = jnp.where(state.in_valid, in_deliver, I32_MAX)
     _, in_deliver_c, in_src_c, in_seq_c, in_bytes_c, in_valid_c = _row_sort(
         inv_in, key_deliver, state.in_src, state.in_seq, state.in_bytes,
-        in_valid_left, keys=2,
+        state.in_valid, keys=2,
     )
     n_valid_in = in_valid_c.sum(axis=1).astype(jnp.int32)  # [N]
 
     # --- 5. route sent packets into destination ingress queues ----------
+    # This happens BEFORE the due check so a packet whose deliver time
+    # falls inside this window (integrated transport: sent last round,
+    # clamped to this window's start) is released THIS round, matching the
+    # CPU plane's push-then-execute ordering.
     flat_sent = sent.reshape(-1)
     flat_dst = jnp.where(flat_sent, eg_dst.reshape(-1), N)  # N = "nowhere"
     flat_deliver = deliver_rel.reshape(-1)
@@ -295,21 +317,41 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     def scatter(buf, vals):
         return buf.reshape(-1).at[flat_idx].set(vals, mode="drop").reshape(N, CI)
 
-    in_src_new = scatter(in_src_c, flat_src[order])
-    in_seq_new = scatter(in_seq_c, flat_seq[order])
-    in_bytes_new = scatter(in_bytes_c, flat_bytes[order])
-    in_deliver_new = scatter(
+    in_src_m = scatter(in_src_c, flat_src[order])
+    in_seq_m = scatter(in_seq_c, flat_seq[order])
+    in_bytes_m = scatter(in_bytes_c, flat_bytes[order])
+    in_deliver_m = scatter(
         jnp.where(in_valid_c, in_deliver_c, I32_MAX), flat_deliver[order]
     )
     # non-ok slots carry an out-of-bounds flat_idx, so only accepted
     # arrivals flip their slot valid
-    in_valid_new = scatter(in_valid_c, jnp.ones_like(ok))
+    in_valid_m = scatter(in_valid_c, jnp.ones_like(ok))
+
+    # --- 5b. deliver everything due in this window from the MERGED set ---
+    in_deliver_key = jnp.where(in_valid_m, in_deliver_m, I32_MAX)
+    due = in_valid_m & (in_deliver_key < window_ns)
+    # one sort serves both purposes: not-due first keyed by deliver time
+    # keeps the surviving entries front-packed; the due block lands at the
+    # row tail in deterministic (deliver_t, src, seq) presentation order
+    is_due = due.astype(jnp.int32)
+    _, d_t, d_src, d_seq, d_bytes, d_due, d_valid = _row_sort(
+        is_due, jnp.where(in_valid_m, in_deliver_m, I32_MAX), in_src_m,
+        in_seq_m, in_bytes_m, due, in_valid_m, keys=4,
+    )
+    delivered = {
+        "mask": d_due, "src": d_src, "seq": d_seq, "bytes": d_bytes,
+        "deliver_rel": d_t,
+    }
+    in_valid_new = d_valid & ~d_due
+    in_deliver_new = jnp.where(in_valid_new, d_t, I32_MAX)
+    in_src_new, in_seq_new, in_bytes_new = d_src, d_seq, d_bytes
 
     # --- 6. compact leftover egress so rows stay front-packed for ingest
     eg_prio_left = jnp.where(eg_valid_left, eg_prio, I32_MAX)
-    _, eg_prio_c, eg_dst_c, eg_bytes_c, eg_seq_c, eg_ctrl_c, eg_valid_c = _row_sort(
+    (_, eg_prio_c, eg_dst_c, eg_bytes_c, eg_seq_c, eg_ctrl_c, eg_tsend_c,
+     eg_clamp_c, eg_valid_c) = _row_sort(
         (~eg_valid_left).astype(jnp.int32), eg_prio_left, eg_dst, eg_bytes,
-        eg_seq, eg_ctrl, eg_valid_left, keys=2,
+        eg_seq, eg_ctrl, eg_tsend, eg_clamp, eg_valid_left, keys=2,
     )
 
     # --- 7. stats + next-event reduction --------------------------------
@@ -320,7 +362,8 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
 
     new_state = NetPlaneState(
         eg_dst=eg_dst_c, eg_bytes=eg_bytes_c, eg_prio=eg_prio_c,
-        eg_seq=eg_seq_c, eg_ctrl=eg_ctrl_c, eg_valid=eg_valid_c,
+        eg_seq=eg_seq_c, eg_ctrl=eg_ctrl_c, eg_tsend=eg_tsend_c,
+        eg_clamp=eg_clamp_c, eg_valid=eg_valid_c,
         in_src=in_src_new, in_bytes=in_bytes_new, in_seq=in_seq_new,
         in_deliver_rel=in_deliver_new, in_valid=in_valid_new,
         tb_balance=balance, tb_rem_ns=tb_rem_ns, rng_counter=rng_counter,

@@ -1,0 +1,177 @@
+"""Device-resident inter-host transport for live simulations.
+
+This wires the batched network plane (`shadow_tpu.tpu.plane`) into the
+Manager's round loop, replacing the per-packet cross-host push
+(`src/main/core/worker.rs:629-639`) with one device round trip per
+scheduling round:
+
+- during a round, `Worker.send_packet` CAPTURES each surviving outbound
+  packet (source-host RNG loss draw, routing counters, and statuses all
+  happen on the CPU exactly as in CPU-transport mode, so the two modes
+  consume identical RNG streams and produce identical drop decisions);
+- at the round barrier the batch is ingested into the device egress
+  arrays with per-packet send times;
+- at the START of the next round, `window_step` computes deliver times
+  (send + latency, clamped to the round barrier — `worker.rs:396-399`),
+  routes packets into per-destination ingress rows with the deterministic
+  (deliver, src, seq) order, and releases everything due in the new
+  window; released entries are pushed into host event queues under the
+  same (time, src_host_id, src_event_id) keys the CPU path uses — so
+  event order is bitwise-identical between transport modes.
+
+The device token bucket is transparent here (relays already rate-limit on
+the host side, `relay/mod.rs`), and the device loss matrix is zero (the
+draw happened at capture). The device owns the transport data motion:
+latency lookup, per-destination scatter, due-release, and the min
+next-event reduction that feeds the controller.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("shadow_tpu.tpu")
+
+I32_MAX = 2**31 - 1
+
+
+class DeviceTransport:
+    def __init__(self, hosts, routing, ip_to_node_id, *,
+                 egress_cap: int = 256, ingress_cap: int = 256):
+        import jax
+        import jax.numpy as jnp
+
+        from . import plane
+
+        self._plane = plane
+        self._jnp = jnp
+        # host index = host_id - 1 (Manager assigns ids densely from 1)
+        self.hosts = sorted(hosts, key=lambda h: h.host_id)
+        n = len(self.hosts)
+        assert [h.host_id for h in self.hosts] == list(range(1, n + 1))
+
+        lat = np.zeros((n, n), np.int64)
+        for i, a in enumerate(self.hosts):
+            for j, b in enumerate(self.hosts):
+                props = routing.path(a.node_id, b.node_id)
+                lat[i, j] = props.latency_ns
+        if lat.max() >= I32_MAX:
+            raise ValueError("path latency exceeds the int32 device budget")
+        self.params = plane.make_params(
+            lat.astype(np.int32),
+            np.zeros((n, n), np.float32),  # loss drawn at capture, on CPU
+            np.full(n, 8e12),  # transparent bucket: relays already paced
+        )
+        self.state = plane.make_state(n, egress_cap, ingress_cap,
+                                      initial_tokens=np.full(
+                                          n, I32_MAX // 2, np.int32))
+        self._rng_root = jax.random.PRNGKey(0)  # unused: loss matrix is 0
+        self._step = jax.jit(plane.window_step)
+        self._ingest = jax.jit(plane.ingest)
+        self._ingress_cap = ingress_cap
+
+        # capture buffers (protected by the manager's round structure: all
+        # appends happen during run_round, all reads at the barrier)
+        self._pending: list[tuple] = []
+        self._packets: dict[tuple[int, int], object] = {}
+        self._prev_start: Optional[int] = None
+        self.next_pending_abs: Optional[int] = None
+        self._overflow_seen = 0
+        self._batch_pad = 64
+
+    # -- capture (called from Worker.send_packet, any worker thread) -----
+
+    def capture(self, src_host, dst_host, packet, now_ns: int, seq: int,
+                round_end_ns: int) -> None:
+        src_idx = src_host.host_id - 1
+        dst_idx = dst_host.host_id - 1
+        self._pending.append((
+            src_idx, dst_idx,
+            packet.payload_size() + 40,  # wire size approximation
+            packet.priority or 0, seq,
+            packet.payload_size() == 0, now_ns, round_end_ns,
+        ))
+        self._packets[(src_idx, seq)] = packet
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._packets)
+
+    # -- round barrier: ingest this round's captures ---------------------
+
+    def finish_round(self, start_ns: int, end_ns: int) -> None:
+        if not self._pending:
+            return
+        jnp = self._jnp
+        batch = self._pending
+        self._pending = []
+        b = len(batch)
+        pad = self._batch_pad
+        while pad < b:
+            pad *= 2
+        self._batch_pad = pad
+        arr = np.zeros((8, pad), np.int64)
+        arr[0, b:] = len(self.hosts)  # pad slots: out-of-range src
+        arr[7, b:] = start_ns  # harmless clamp for dead slots
+        for i, row in enumerate(batch):
+            for k in range(8):
+                arr[k, i] = int(row[k])
+        send_rel = arr[6] - start_ns
+        clamp_rel = arr[7] - start_ns  # the send-round's end
+        self.state = self._ingest(
+            self.state,
+            jnp.asarray(arr[0], jnp.int32), jnp.asarray(arr[1], jnp.int32),
+            jnp.asarray(arr[2], jnp.int32), jnp.asarray(arr[3], jnp.int32),
+            jnp.asarray(arr[4], jnp.int32),
+            jnp.asarray(arr[5].astype(bool)),
+            valid=jnp.asarray(np.arange(pad) < b),
+            send_rel=jnp.asarray(send_rel, jnp.int32),
+            clamp_rel=jnp.asarray(clamp_rel, jnp.int32),
+        )
+
+    # -- round start: release everything due in [start, end) -------------
+
+    def release(self, start_ns: int, end_ns: int) -> None:
+        """Run the window step and push due deliveries into host queues."""
+        if not self._packets:
+            # nothing on device: skip the step; rebasing is irrelevant
+            # because every slot is invalid
+            self._prev_start = start_ns
+            self.next_pending_abs = None
+            return
+        shift = 0 if self._prev_start is None else start_ns - self._prev_start
+        assert 0 <= shift < I32_MAX, "window shift exceeds int32 ns budget"
+        self._prev_start = start_ns
+        self.state, delivered, next_rel = self._step(
+            self.state, self.params, self._rng_root,
+            self._jnp.int32(shift), self._jnp.int32(end_ns - start_ns),
+        )
+        import jax
+
+        mask, src, seq, d_t, overflow = jax.device_get((
+            delivered["mask"], delivered["src"], delivered["seq"],
+            delivered["deliver_rel"], self.state.n_overflow_dropped,
+        ))
+        total_overflow = int(overflow.sum())
+        if total_overflow > self._overflow_seen:
+            log.error(
+                "device transport dropped %d packets to ingress-capacity "
+                "overflow — raise experimental.tpu_ingress_cap",
+                total_overflow - self._overflow_seen,
+            )
+            self._overflow_seen = total_overflow
+
+        rows, cols = np.nonzero(mask)
+        for i, j in zip(rows.tolist(), cols.tolist()):
+            s, q, t = int(src[i, j]), int(seq[i, j]), int(d_t[i, j])
+            packet = self._packets.pop((s, q), None)
+            if packet is None:
+                continue  # overflow-dropped at ingest (already counted)
+            self.hosts[i].push_packet_event(packet, start_ns + t, s + 1, q)
+
+        self.next_pending_abs = (
+            start_ns + int(next_rel) if int(next_rel) < I32_MAX else None
+        )

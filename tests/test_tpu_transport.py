@@ -1,0 +1,131 @@
+"""Device-transport integration: live simulations with inter-host packet
+motion on the device plane, bitwise-matching the CPU transport.
+
+Parity model: this replaces `Worker::send_packet`'s cross-host push
+(`worker.rs:326-410,629-639`) with one device round trip per scheduling
+round; the round-1 verdict's top item ("wire the TPU plane into the
+simulation loop; done = identical event order to the CPU plane").
+"""
+
+import pytest
+
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.manager import Manager
+from shadow_tpu.net import packet as packet_mod
+
+BASIC = """
+general: {{stop_time: 60s, seed: 1}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+experimental: {{use_tpu_transport: {device}}}
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - {{path: http-server, args: ["80", "1048576"], start_time: 3s,
+       expected_final_state: running}}
+  client1:
+    network_node_id: 0
+    processes:
+    - {{path: http-client, args: ["server", "80"], start_time: 5s}}
+  client2:
+    network_node_id: 0
+    processes:
+    - {{path: http-client, args: ["server", "80"], start_time: 5s}}
+"""
+
+PHOLD = """
+general: {{stop_time: 20s, seed: 42}}
+network: {{graph: {{type: 1_gbit_switch}}}}
+experimental: {{use_tpu_transport: {device}}}
+hosts:
+  peer0:
+    network_node_id: 0
+    processes:
+    - {{path: udp-echo-server, args: ["9000"], start_time: 1s,
+       expected_final_state: running}}
+    - {{path: udp-client, args: ["peer1", "9000", "200", "10"], start_time: 2s}}
+  peer1:
+    network_node_id: 0
+    processes:
+    - {{path: udp-echo-server, args: ["9000"], start_time: 1s,
+       expected_final_state: running}}
+    - {{path: udp-client, args: ["peer2", "9000", "200", "10"], start_time: 2s}}
+  peer2:
+    network_node_id: 0
+    processes:
+    - {{path: udp-echo-server, args: ["9000"], start_time: 1s,
+       expected_final_state: running}}
+    - {{path: udp-client, args: ["peer0", "9000", "200", "10"], start_time: 2s}}
+"""
+
+LOSSY = """
+general: {{stop_time: 60s, seed: 7}}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        edge [ source 0 target 0 latency "20 ms" packet_loss 0.05 ]
+      ]
+experimental: {{use_tpu_transport: {device}}}
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - {{path: http-server, args: ["80", "262144"], start_time: 1s,
+       expected_final_state: running}}
+  client:
+    network_node_id: 0
+    processes:
+    - {{path: http-client, args: ["server", "80"], start_time: 2s}}
+"""
+
+
+def _run_traced(cfg_text: str):
+    """Run a config collecting the full packet-status event stream — a
+    complete witness of packet event order and timing."""
+    trace = []
+
+    def hook(packet, status):
+        from shadow_tpu.core import worker as worker_mod
+
+        host = worker_mod.current_host()
+        trace.append((
+            host.name if host else None,
+            host.now() if host else -1,
+            int(status), packet.src, packet.dst, packet.payload_size(),
+        ))
+
+    cfg = load_config_str(cfg_text)
+    mgr = Manager(cfg)
+    old = packet_mod.status_trace_hook
+    packet_mod.status_trace_hook = hook
+    try:
+        stats = mgr.run()
+    finally:
+        packet_mod.status_trace_hook = old
+    assert stats.process_failures == [], stats.process_failures
+    return stats, trace
+
+
+@pytest.mark.parametrize("cfg", [BASIC, PHOLD, LOSSY],
+                         ids=["basic-file-transfer", "phold", "lossy"])
+def test_device_transport_matches_cpu_bitwise(cfg):
+    s_cpu, t_cpu = _run_traced(cfg.format(device="false"))
+    s_dev, t_dev = _run_traced(cfg.format(device="true"))
+    assert s_cpu.packets_sent == s_dev.packets_sent
+    assert s_cpu.packets_dropped == s_dev.packets_dropped
+    assert len(t_cpu) == len(t_dev)
+    # bitwise-identical packet event stream: every status transition on
+    # every host at the same simulated time in the same order
+    for i, (a, b) in enumerate(zip(t_cpu, t_dev)):
+        assert a == b, f"trace diverges at index {i}: cpu={a} device={b}"
+
+
+def test_device_transport_deterministic_across_runs():
+    s1, t1 = _run_traced(PHOLD.format(device="true"))
+    s2, t2 = _run_traced(PHOLD.format(device="true"))
+    assert t1 == t2
+    assert (s1.rounds, s1.packets_sent) == (s2.rounds, s2.packets_sent)
