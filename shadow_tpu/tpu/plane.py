@@ -86,7 +86,12 @@ def make_params(latency_ns: np.ndarray, loss: np.ndarray, up_bw_bps: np.ndarray,
                 mtu: int = 1500) -> NetPlaneParams:
     """Build params from the routing matrices (`RoutingInfo.latency_ns/loss`
     mapped host→node) and per-host up-bandwidths in bits/sec."""
-    rate = np.maximum(1, (up_bw_bps // 8) // 1000).astype(np.int32)  # B/ms
+    # cap the per-ms rate at 2^30 - mtu so the refill arithmetic in
+    # window_step (balance + rate*elapsed_eff <= cap + rate <= 2*rate + mtu)
+    # can never overflow int32; 2^30 B/ms ~ 8.6 Tbit/s, beyond any modeled NIC
+    rate = np.minimum(
+        np.maximum(1, (up_bw_bps // 8) // 1000), 2**30 - mtu
+    ).astype(np.int32)  # B/ms
     return NetPlaneParams(
         latency_ns=jnp.asarray(latency_ns, jnp.int32),
         loss=jnp.asarray(loss, jnp.float32),
@@ -233,10 +238,14 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     rem_total = state.tb_rem_ns + (shift_ns % 1_000_000)
     elapsed_ms = (shift_ns // 1_000_000) + (rem_total // 1_000_000)
     tb_rem_ns = rem_total % 1_000_000
-    # clamp elapsed to "enough to fill the bucket" BEFORE multiplying, so
-    # rate*elapsed stays within int32 even after long idle windows
-    fill_ms = params.tb_cap // params.tb_rate + 1
-    elapsed_eff = jnp.minimum(elapsed_ms, fill_ms)
+    # refill only up to the headroom, clamping elapsed BEFORE multiplying:
+    # rate * elapsed_eff <= headroom + rate and balance + that <= cap + rate,
+    # which stays inside int32 for any rate <= 2^30 (make_params guarantees
+    # it) — the naive balance + rate*fill_ms wrapped negative for rates near
+    # 1e9 B/ms and stalled every egress queue for one round
+    headroom = jnp.maximum(params.tb_cap - state.tb_balance, 0)
+    need_ms = (headroom + params.tb_rate - 1) // params.tb_rate
+    elapsed_eff = jnp.minimum(elapsed_ms, need_ms)
     balance = jnp.minimum(
         state.tb_balance + params.tb_rate * elapsed_eff, params.tb_cap
     )
