@@ -32,6 +32,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stop-time", help="override general.stop_time (e.g. 10s)")
     p.add_argument("--parallelism", type=int, help="worker parallelism")
     p.add_argument(
+        "--scheduler",
+        choices=["serial", "thread-per-core", "thread-per-host"],
+        help="override experimental.scheduler",
+    )
+    p.add_argument(
         "--log-level",
         choices=["error", "warning", "info", "debug", "trace"],
         help="override general.log_level",
@@ -59,6 +64,8 @@ def _apply_overrides(config: ConfigOptions, args) -> None:
         config.general.stop_time = units.parse_duration_ns(args.stop_time)
     if args.parallelism is not None:
         config.general.parallelism = args.parallelism
+    if args.scheduler is not None:
+        config.experimental.scheduler = args.scheduler
     if args.data_directory is not None:
         config.general.data_directory = args.data_directory
 

@@ -109,3 +109,18 @@ def test_determinism_harness(tmp_path):
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "DETERMINISTIC" in proc.stdout
+
+
+def test_determinism_scheduler_matrix(tmp_path):
+    """Artifacts must be identical across schedulers and thread counts
+    (reference determinism2, src/test/determinism/CMakeLists.txt:8-24)."""
+    cfg = write_config(tmp_path)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "compare_runs.py"),
+         str(cfg), "--matrix"],
+        env=env, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "DETERMINISTIC" in proc.stdout

@@ -1,14 +1,19 @@
 #!/usr/bin/env python3
-"""Determinism harness: run a config twice and diff the deterministic
-artifacts.
+"""Determinism harness: run a config repeatedly — and across scheduler /
+parallelism variants — and diff the deterministic artifacts.
 
 Parity: reference determinism CI (`src/test/determinism/CMakeLists.txt` —
-run identical sims twice, strip nondeterministic lines with
-`strip_log_for_compare.py`, diff). Here the deterministic artifacts are
+determinism1a/1b run identical sims twice and diff; determinism2 repeats
+with `--scheduler thread-per-host` to prove event order is independent of
+the parallelization strategy). Here the deterministic artifacts are
 sim-stats.json (minus wall_seconds) and the per-host pcap captures, which
 encode exact packet timing and content.
 
-Usage: python tools/compare_runs.py <config.yaml> [--runs 2]
+Usage:
+  python tools/compare_runs.py <config.yaml> [--runs 2]       # repeat-diff
+  python tools/compare_runs.py <config.yaml> --matrix         # vary
+      scheduler (serial / thread-per-core / thread-per-host) and
+      parallelism (1 / 2 / 4) and require identical artifacts across all
 Exit 0 when all runs match bit-for-bit; 1 otherwise.
 """
 
@@ -21,15 +26,18 @@ import os
 import subprocess
 import sys
 import tempfile
+from typing import Sequence
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_once(config: str, data_dir: str) -> dict:
+def run_once(config: str, data_dir: str,
+             extra_args: "Sequence[str]" = ()) -> dict:
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.run(
-        [sys.executable, "-m", "shadow_tpu", config, "-d", data_dir, "--force"],
+        [sys.executable, "-m", "shadow_tpu", config, "-d", data_dir, "--force",
+         *extra_args],
         env=env, capture_output=True, text=True,
     )
     if proc.returncode != 0:
@@ -49,26 +57,51 @@ def run_once(config: str, data_dir: str) -> dict:
     return digest
 
 
+# scheduler × parallelism variants for --matrix (determinism2 analogue);
+# parallelism is pinned explicitly so a single-core runner cannot silently
+# collapse the threaded variants to SerialScheduler (parallelism auto =
+# min(cores, hosts))
+MATRIX = [
+    ("serial-p1", ["--scheduler", "serial", "--parallelism", "1"]),
+    ("tpc-p2", ["--scheduler", "thread-per-core", "--parallelism", "2"]),
+    ("tpc-p4", ["--scheduler", "thread-per-core", "--parallelism", "4"]),
+    ("tph-p4", ["--scheduler", "thread-per-host", "--parallelism", "4"]),
+]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("config")
-    ap.add_argument("--runs", type=int, default=2)
+    ap.add_argument("--runs", type=int, default=None,
+                    help="repeat count (incompatible with --matrix)")
+    ap.add_argument(
+        "--matrix", action="store_true",
+        help="vary scheduler and parallelism instead of repeating",
+    )
     args = ap.parse_args(argv)
+    if args.matrix and args.runs is not None:
+        ap.error("--runs and --matrix are mutually exclusive")
 
+    variants = (
+        MATRIX if args.matrix
+        else [(f"run{i}", []) for i in range(args.runs or 2)]
+    )
     digests = []
     with tempfile.TemporaryDirectory() as tmp:
-        for i in range(args.runs):
-            digests.append(run_once(args.config, os.path.join(tmp, f"run{i}")))
-    base = digests[0]
+        for name, extra in variants:
+            digests.append(
+                (name, run_once(args.config, os.path.join(tmp, name), extra))
+            )
+    base_name, base = digests[0]
     ok = True
-    for i, d in enumerate(digests[1:], start=2):
+    for name, d in digests[1:]:
         if d != base:
             ok = False
             for key in sorted(set(base) | set(d)):
                 if base.get(key) != d.get(key):
-                    print(f"MISMATCH run1 vs run{i}: {key}")
-                    print(f"  run1: {base.get(key)}")
-                    print(f"  run{i}: {d.get(key)}")
+                    print(f"MISMATCH {base_name} vs {name}: {key}")
+                    print(f"  {base_name}: {base.get(key)}")
+                    print(f"  {name}: {d.get(key)}")
     print("DETERMINISTIC" if ok else "NONDETERMINISTIC")
     return 0 if ok else 1
 
