@@ -164,14 +164,17 @@ class Manager:
         par = config.general.parallelism
         if par <= 0:
             par = min(os.cpu_count() or 1, len(self.hosts))
-        self.scheduler = make_scheduler(
-            config.experimental.scheduler, self.shared, par
-        )
 
         # random thread-assignment order (`manager.rs:272`); per-round host
         # iteration uses this fixed shuffled order
         self._host_order = list(self.hosts)
         self.global_rng.shuffle(self._host_order)
+
+        self.scheduler = make_scheduler(
+            config.experimental.scheduler, self.shared, par,
+            hosts=self._host_order,
+            pin_cpus=config.experimental.use_cpu_pinning,
+        )
 
         self.stats = SimStats()
 

@@ -1,0 +1,93 @@
+"""Scheduler semantics: persistent pools, stealing, error transport.
+
+Parity: reference `src/lib/scheduler/` unit tests
+(`thread_per_core.rs:214-328`) — run/run_with_hosts over a persistent pool,
+plus the determinism contract that scheduling strategy never changes
+results (covered end-to-end by tools/compare_runs.py --matrix).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from shadow_tpu.core.scheduler import (
+    SerialScheduler,
+    ThreadPerCoreScheduler,
+    ThreadPerHostScheduler,
+    make_scheduler,
+)
+from shadow_tpu.core.worker import WorkerShared
+
+
+class FakeHost:
+    """Minimal host: execute() records the call; next_event_time fixed."""
+
+    def __init__(self, next_time=None, fail=False):
+        self._next = next_time
+        self._fail = fail
+        self.executed = 0
+
+    def execute(self, until):
+        if self._fail:
+            raise RuntimeError("host exploded")
+        self.executed += 1
+
+    def next_event_time(self):
+        return self._next
+
+
+def make_shared():
+    return WorkerShared(
+        dns=None, routing=None, ip_to_host={}, ip_to_node_id={},
+        runahead=None, sim_end_time=10**9,
+    )
+
+
+@pytest.mark.parametrize("kind", ["serial", "thread-per-core"])
+def test_all_hosts_execute_and_min_next(kind):
+    shared = make_shared()
+    hosts = [FakeHost(next_time=100 + i) for i in range(7)]
+    sched = make_scheduler(kind, shared, 3)
+    try:
+        for round_no in range(3):
+            got = sched.run_round(hosts, 10**9)
+            assert got == 100
+        assert all(h.executed == 3 for h in hosts)
+    finally:
+        sched.join()
+
+
+def test_thread_per_host_pins_hosts():
+    shared = make_shared()
+    hosts = [FakeHost(next_time=50), FakeHost(next_time=40), FakeHost()]
+    sched = make_scheduler("thread-per-host", shared, 2, hosts=hosts)
+    assert isinstance(sched, ThreadPerHostScheduler)
+    try:
+        assert sched.run_round(hosts, 10**9) == 40
+        assert all(h.executed == 1 for h in hosts)
+        with pytest.raises(ValueError):
+            sched.run_round(hosts[:2], 10**9)
+    finally:
+        sched.join()
+
+
+def test_worker_exception_propagates_and_pool_survives():
+    """A failing host must raise on the driving thread, and the pool must
+    stay usable for the next round (a dead worker thread would deadlock)."""
+    shared = make_shared()
+    good = [FakeHost(next_time=10) for _ in range(3)]
+    bad = FakeHost(fail=True)
+    sched = ThreadPerCoreScheduler(shared, 2, pin_cpus=False)
+    try:
+        with pytest.raises(RuntimeError, match="host exploded"):
+            sched.run_round(good + [bad], 10**9)
+        # pool survives: next round (without the bad host) runs normally
+        assert sched.run_round(good, 10**9) == 10
+    finally:
+        sched.join()
+
+
+def test_serial_when_parallelism_one():
+    shared = make_shared()
+    assert isinstance(make_scheduler("thread-per-core", shared, 1), SerialScheduler)
+    assert isinstance(make_scheduler("serial", shared, 8), SerialScheduler)
