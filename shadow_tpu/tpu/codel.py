@@ -1,0 +1,300 @@
+"""Batched CoDel AQM: the per-host inbound router queue as a device kernel.
+
+Parity: reference `src/main/network/router/codel_queue.rs:23-33` (RFC 8289
+with Shadow's TARGET = 10ms, INTERVAL = 100ms, unbounded limit) — the same
+state machine as the CPU plane's `shadow_tpu.net.router.CoDelQueue`, which
+this kernel must match drop-for-drop on any trace (tests/test_tpu_codel.py
+replays random traces through both).
+
+Design (TPU-first):
+- One window's drain is a bounded `lax.fori_loop` of "micro-steps", each of
+  which consumes at most one queue entry or completes one empty pop — the
+  CPU implementation's nested pop loops linearized so every host advances
+  in lock-step; `vmap` batches hosts.
+- All times int32, relative to the window start; the two "None" sentinels
+  of the scalar state (`interval_end`, `drop_next`) become explicit bool
+  flags so rebasing across windows stays branch-free.
+- The control law `now + INTERVAL/sqrt(count)` is served from a
+  precomputed int32 table so device results match the CPU plane's
+  float64 `round()` bitwise. Counts beyond the table (4096 consecutive
+  drops — far above any sane queue) clamp to the last entry.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import simtime
+from ..net.packet import CONFIG_MTU
+
+TARGET = np.int32(10 * simtime.MILLISECOND)
+INTERVAL = np.int32(100 * simtime.MILLISECOND)
+I32_MAX = np.int32(2**31 - 1)
+
+_MODE_STORE = np.int32(0)
+_MODE_DROP = np.int32(1)
+
+# control_law(t, c) = t + CTRL_TABLE[min(c, len)-1]; CTRL_TABLE[0] unused
+# spare (count=0 never queried by the state machine, kept for safe indexing)
+_MAX_COUNT = 4096
+CTRL_TABLE = jnp.asarray(
+    [round(float(INTERVAL))]
+    + [round(float(INTERVAL) / float(np.sqrt(np.float64(c))))
+       for c in range(1, _MAX_COUNT + 1)],
+    jnp.int32,
+)
+
+# entry status codes produced by codel_drain
+STATUS_QUEUED = 0  # not consumed this window (still in queue)
+STATUS_DELIVERED = 1
+STATUS_DROPPED = 2
+
+
+class CodelState(NamedTuple):
+    """Per-host scalar CoDel state, axis 0 = host."""
+
+    mode: jax.Array  # int32: 0 store / 1 drop
+    has_interval_end: jax.Array  # bool
+    interval_end: jax.Array  # int32 rel ns (valid iff flag)
+    has_drop_next: jax.Array  # bool
+    drop_next: jax.Array  # int32 rel ns (valid iff flag)
+    cur_count: jax.Array  # int32 current drop count
+    prev_count: jax.Array  # int32 drop count at last store->drop switch
+    entry_idx: jax.Array  # int32 entries consumed from the trace
+    consumed_bytes: jax.Array  # int32 bytes consumed from the trace
+    dropped: jax.Array  # int32 total drops (router-drop counter)
+
+
+def make_codel_state(n_hosts: int) -> CodelState:
+    z = lambda: jnp.zeros((n_hosts,), jnp.int32)
+    f = lambda: jnp.zeros((n_hosts,), bool)
+    return CodelState(
+        mode=z(), has_interval_end=f(), interval_end=z(),
+        has_drop_next=f(), drop_next=z(), cur_count=z(), prev_count=z(),
+        entry_idx=z(), consumed_bytes=z(), dropped=z(),
+    )
+
+
+def rebase_codel_state(state: CodelState, shift_ns) -> CodelState:
+    """Rebase the stored absolute-ish times when the window start moves."""
+    shift = jnp.int32(shift_ns)
+    return state._replace(
+        interval_end=jnp.where(
+            state.has_interval_end, state.interval_end - shift,
+            state.interval_end,
+        ),
+        drop_next=jnp.where(
+            state.has_drop_next, state.drop_next - shift, state.drop_next
+        ),
+    )
+
+
+def _drain_one_host(arrival, size, pops, n_pops, st: CodelState):
+    """Drain one host's queue through its pop trace.
+
+    arrival [K] int32 ascending (I32_MAX padding), size [K] int32,
+    pops [P] int32 ascending pop-invocation times, of which the first
+    `n_pops` are real. `st` holds scalars for THIS host (already indexed).
+    Returns (st', status [K], deliver_t [K]).
+    """
+    K = arrival.shape[0]
+    P = pops.shape[0]
+    pushed_bytes = jnp.cumsum(size * (arrival < I32_MAX))  # [K] prefix sums
+
+    # phases of the linearized pop state machine
+    PH_START = 0  # at the top of pop(now)
+    PH_AFTER_STORE_DROP = 1  # store-mode drop done; pop-and-return next
+    PH_DROP_LOOP = 2  # inside drop-mode while; front entry just dropped
+
+    def micro_step(_, carry):
+        (mode, has_ie, ie, has_dn, dn, cur, prev, eidx, cbytes, dropped,
+         pidx, phase, status, deliver_t) = carry
+
+        active = pidx < n_pops
+        now = jnp.where(active, pops[jnp.minimum(pidx, P - 1)], 0)
+
+        # queue contents at `now`: entries pushed (arrival <= now) and not
+        # yet consumed. arrival is sorted so pushed count = searchsorted.
+        n_pushed = jnp.searchsorted(arrival, now, side="right").astype(jnp.int32)
+        empty = eidx >= n_pushed
+        e = jnp.minimum(eidx, K - 1)  # front entry index (clamped for gather)
+        e_arr = arrival[e]
+        e_size = size[e]
+
+        # --- _codel_pop(now): consume front entry, standing-delay check ---
+        # total_bytes AFTER removing this entry (the CPU code decrements
+        # before _process_standing_delay reads it)
+        total_after = pushed_bytes[jnp.minimum(n_pushed - 1, K - 1)] * (
+            n_pushed > 0
+        ) - cbytes - e_size
+        standing = now - e_arr
+        below = (standing < TARGET) | (total_after <= CONFIG_MTU)
+        entered_bad = ~below & ~has_ie
+        # ok_to_drop per _process_standing_delay
+        ok = ~below & has_ie & (now >= ie)
+        ie_new = jnp.where(below, ie, jnp.where(entered_bad, now + INTERVAL, ie))
+        has_ie_new = jnp.where(below, False, True)
+
+        # helper: control law via table (count >= 1 always when queried)
+        def ctrl(t, c):
+            return t + CTRL_TABLE[jnp.clip(c, 1, _MAX_COUNT)]
+
+        # ----- dispatch on phase -----------------------------------------
+        # Defaults: no entry consumed, nothing recorded, pop not finished.
+        consume = jnp.bool_(False)
+        rec_status = jnp.int32(STATUS_QUEUED)
+        pop_done = jnp.bool_(False)
+        n_mode, n_has_ie, n_ie = mode, has_ie_new, ie_new
+        n_has_dn, n_dn, n_cur, n_prev = has_dn, dn, cur, prev
+        n_phase = phase
+
+        is_start = phase == PH_START
+        is_after_sd = phase == PH_AFTER_STORE_DROP
+        is_drop_loop = phase == PH_DROP_LOOP
+
+        # ---- PH_START -----------------------------------------------------
+        # empty queue: pop returns None; mode=store; interval_end=None
+        c_empty = is_start & empty
+        # (CPU _codel_pop clears interval_end when empty)
+        # not ok_to_drop: deliver; mode=store
+        c_deliver = is_start & ~empty & ~ok
+        # ok & store mode: drop entry, switch to drop mode (store-mode drop)
+        c_store_drop = is_start & ~empty & ok & (mode == _MODE_STORE)
+        # ok & drop mode: should_drop(now)?
+        should = has_dn & (now >= dn)
+        c_drop_again = is_start & ~empty & ok & (mode == _MODE_DROP) & should
+        c_drop_deliver = is_start & ~empty & ok & (mode == _MODE_DROP) & ~should
+
+        # ---- PH_AFTER_STORE_DROP -------------------------------------------
+        a_empty = is_after_sd & empty
+        a_deliver = is_after_sd & ~empty  # delivered regardless of its ok flag
+
+        # ---- PH_DROP_LOOP ---------------------------------------------------
+        # front entry state machine: _codel_pop; if empty → return None
+        d_empty = is_drop_loop & empty
+        # non-empty: if ok → drop_next=ctrl(drop_next, cur) else mode=store;
+        # then re-check while condition with the NEW drop_next/mode
+        d_nonempty = is_drop_loop & ~empty
+        dn_upd = jnp.where(d_nonempty & ok, ctrl(dn, cur), dn)
+        mode_upd = jnp.where(d_nonempty & ~ok, _MODE_STORE, mode)
+        should2 = has_dn & (now >= dn_upd)
+        d_drop = d_nonempty & ok & should2  # mode still drop, keep dropping
+        d_deliver = d_nonempty & ~d_drop
+
+        # ----- merge transitions ------------------------------------------
+        # empty-queue outcomes (all phases): pop completes, nothing consumed
+        any_empty = c_empty | a_empty | d_empty
+        pop_done = pop_done | any_empty
+        # CPU: PH_START empty → mode=store (pop()'s None branch). Phase 1 /
+        # phase 2 empty: _codel_pop cleared interval_end; mode untouched in
+        # phase 2; phase 1 returns None from _drop_from_store_mode (mode
+        # was already set to DROP before the nested pop)
+        n_mode = jnp.where(c_empty, _MODE_STORE, n_mode)
+        n_has_ie = jnp.where(any_empty, False, n_has_ie)
+
+        # deliver outcomes
+        deliver = c_deliver | a_deliver | c_drop_deliver | d_deliver
+        consume = consume | deliver
+        rec_status = jnp.where(deliver, STATUS_DELIVERED, rec_status)
+        pop_done = pop_done | deliver
+        n_mode = jnp.where(c_deliver, _MODE_STORE, n_mode)
+        n_mode = jnp.where(d_deliver, mode_upd, n_mode)
+        n_dn = jnp.where(d_deliver, dn_upd, n_dn)
+
+        # store-mode drop: drop entry now; count bookkeeping; enter phase 1
+        consume = consume | c_store_drop
+        rec_status = jnp.where(c_store_drop, STATUS_DROPPED, rec_status)
+        recently = has_dn & ((jnp.maximum(0, now - dn)) < INTERVAL * 16)
+        delta = cur - prev
+        new_cur = jnp.where(recently & (delta > 1), delta, 1)
+        n_cur = jnp.where(c_store_drop, new_cur, n_cur)
+        n_prev = jnp.where(c_store_drop, new_cur, n_prev)
+        n_dn = jnp.where(c_store_drop, ctrl(now, new_cur), n_dn)
+        n_has_dn = jnp.where(c_store_drop, True, n_has_dn)
+        n_mode = jnp.where(c_store_drop, _MODE_DROP, n_mode)
+        n_phase = jnp.where(c_store_drop, PH_AFTER_STORE_DROP, n_phase)
+
+        # drop-mode drop (from PH_START): drop entry, count++, enter loop
+        consume = consume | c_drop_again
+        rec_status = jnp.where(c_drop_again, STATUS_DROPPED, rec_status)
+        n_cur = jnp.where(c_drop_again, cur + 1, n_cur)
+        n_phase = jnp.where(c_drop_again, PH_DROP_LOOP, n_phase)
+
+        # drop-loop continued drop: entry dropped, count++, stay in loop
+        consume = consume | d_drop
+        rec_status = jnp.where(d_drop, STATUS_DROPPED, rec_status)
+        n_cur = jnp.where(d_drop, cur + 1, n_cur)
+        n_dn = jnp.where(d_drop, dn_upd, n_dn)
+
+        # completing any pop resets the phase
+        n_phase = jnp.where(pop_done, PH_START, n_phase)
+
+        # gate everything on `active` (pops exhausted = this host is done)
+        consume = consume & active
+        pop_done = pop_done & active
+
+        def sel(new, old):
+            return jnp.where(active, new, old)
+
+        status = status.at[e].set(
+            jnp.where(consume, rec_status, status[e]), mode="drop"
+        )
+        deliver_t = deliver_t.at[e].set(
+            jnp.where(consume & (rec_status == STATUS_DELIVERED), now,
+                      deliver_t[e]),
+            mode="drop",
+        )
+        return (
+            sel(n_mode, mode), sel(n_has_ie, has_ie), sel(n_ie, ie),
+            sel(n_has_dn, has_dn), sel(n_dn, dn), sel(n_cur, cur),
+            sel(n_prev, prev),
+            jnp.where(consume, eidx + 1, eidx),
+            jnp.where(consume, cbytes + e_size, cbytes),
+            jnp.where(consume & (rec_status == STATUS_DROPPED),
+                      dropped + 1, dropped),
+            jnp.where(pop_done, pidx + 1, pidx),
+            sel(n_phase, phase),
+            status, deliver_t,
+        )
+
+    status0 = jnp.zeros((K,), jnp.int32)
+    deliver0 = jnp.full((K,), I32_MAX, jnp.int32)
+    carry = (
+        st.mode, st.has_interval_end, st.interval_end, st.has_drop_next,
+        st.drop_next, st.cur_count, st.prev_count, st.entry_idx,
+        st.consumed_bytes, st.dropped, jnp.int32(0), jnp.int32(PH_START),
+        status0, deliver0,
+    )
+    # bound: every micro-step consumes an entry or completes a pop
+    carry = jax.lax.fori_loop(0, K + P, micro_step, carry)
+    (mode, has_ie, ie, has_dn, dn, cur, prev, eidx, cbytes, dropped,
+     _pidx, _phase, status, deliver_t) = carry
+    st_out = CodelState(
+        mode=mode, has_interval_end=has_ie, interval_end=ie,
+        has_drop_next=has_dn, drop_next=dn, cur_count=cur, prev_count=prev,
+        entry_idx=eidx, consumed_bytes=cbytes, dropped=dropped,
+    )
+    return st_out, status, deliver_t
+
+
+def codel_drain(arrival: jax.Array, size: jax.Array, pops: jax.Array,
+                state: CodelState):
+    """Replay pop invocations against per-host entry traces.
+
+    arrival/size: [N, K] entries per host, arrival ascending with I32_MAX
+    padding; pops: [N, P] pop times ascending with I32_MAX padding (a
+    padded pop is ignored). state: per-host CodelState ([N] arrays).
+    Returns (state', status [N, K], deliver_t [N, K]) where status uses
+    STATUS_QUEUED / STATUS_DELIVERED / STATUS_DROPPED and deliver_t is the
+    pop time for delivered entries (I32_MAX otherwise).
+    """
+    # padded pops (I32_MAX) are inert: the per-host machine stops once its
+    # real pop count is exhausted
+    n_real_pops = (pops < I32_MAX).sum(axis=1).astype(jnp.int32)
+    return jax.vmap(_drain_one_host, in_axes=(0, 0, 0, 0, 0))(
+        arrival, size, pops, n_real_pops, state
+    )

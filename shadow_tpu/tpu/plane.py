@@ -64,6 +64,7 @@ class NetPlaneState(NamedTuple):
     eg_ctrl: jax.Array  # bool — control packets are never loss-dropped
     eg_tsend: jax.Array  # int32 ns send time relative to window start
     eg_clamp: jax.Array  # int32 barrier clamp (NO_CLAMP = current window end)
+    eg_sock: jax.Array  # int32 emitting-socket id (round-robin qdisc key)
     eg_valid: jax.Array  # bool
     # ingress queues (in flight toward this host): [N, CI]
     in_src: jax.Array  # int32 source host index
@@ -112,6 +113,7 @@ def make_state(n_hosts: int, egress_cap: int = 32, ingress_cap: int = 64,
         eg_ctrl=jnp.zeros((N, CE), bool),
         eg_tsend=z((N, CE)),
         eg_clamp=jnp.full((N, CE), NO_CLAMP, jnp.int32),
+        eg_sock=z((N, CE)),
         eg_valid=jnp.zeros((N, CE), bool),
         in_src=jnp.full((N, CI), -1, jnp.int32),
         in_bytes=z((N, CI)),
@@ -164,7 +166,8 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
            nbytes: jax.Array, prio: jax.Array, seq: jax.Array,
            ctrl: jax.Array, valid: jax.Array | None = None,
            send_rel: jax.Array | None = None,
-           clamp_rel: jax.Array | None = None) -> NetPlaneState:
+           clamp_rel: jax.Array | None = None,
+           sock: jax.Array | None = None) -> NetPlaneState:
     """Append a batch of outbound packets ([B] arrays; src = emitting host
     index) to the egress queues. Slots are allocated after the current valid
     entries per row; overflow beyond capacity is counted and dropped.
@@ -183,12 +186,14 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
         send_rel = jnp.zeros_like(seq)
     if clamp_rel is None:
         clamp_rel = jnp.full_like(seq, NO_CLAMP)
+    if sock is None:
+        sock = jnp.zeros_like(seq)
     # rank of each packet within its src group, deterministic by (src, seq)
     order = jnp.lexsort((seq, src))
     src_s, dst_s = src[order], dst[order]
     bytes_s, prio_s = nbytes[order], prio[order]
     seq_s, ctrl_s, tsend_s = seq[order], ctrl[order], send_rel[order]
-    clamp_s = clamp_rel[order]
+    clamp_s, sock_s = clamp_rel[order], sock[order]
 
     n_valid = state.eg_valid.sum(axis=1).astype(jnp.int32)  # [N]
     # rows are front-compacted (window_step re-sorts), so slot placement is
@@ -206,11 +211,12 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
     eg_ctrl = put(state.eg_ctrl, ctrl_s)
     eg_tsend = put(state.eg_tsend, tsend_s)
     eg_clamp = put(state.eg_clamp, clamp_s)
+    eg_sock = put(state.eg_sock, sock_s)
     eg_valid = put(state.eg_valid, jnp.ones_like(ok))
     return state._replace(
         eg_dst=eg_dst, eg_bytes=eg_bytes, eg_prio=eg_prio, eg_seq=eg_seq,
         eg_ctrl=eg_ctrl, eg_tsend=eg_tsend, eg_clamp=eg_clamp,
-        eg_valid=eg_valid,
+        eg_sock=eg_sock, eg_valid=eg_valid,
         n_overflow_dropped=state.n_overflow_dropped + overflow,
     )
 
