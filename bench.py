@@ -71,7 +71,8 @@ def bench_tpu() -> tuple[float, int]:
     def round_fn(carry, round_idx):
         state, spawn_seq = carry
         shift = jnp.where(round_idx == 0, jnp.int32(0), window)
-        state, delivered, next_ev = window_step(state, params, key, shift, window)
+        state, delivered, next_ev = window_step(state, params, key, shift,
+                                                window, rr_enabled=False)
         # respawn: each delivered packet triggers one new packet from the
         # receiving host to a hashed destination (deterministic)
         host = jnp.broadcast_to(

@@ -270,7 +270,10 @@ class ThreadPerHostScheduler:
 
 def make_scheduler(kind: str, shared: WorkerShared, parallelism: int,
                    hosts: Optional[Sequence] = None, pin_cpus: bool = True):
-    if kind == "thread-per-host" and hosts is not None and len(hosts) > 0:
+    if kind == "thread-per-host":
+        if not hosts:
+            raise ValueError(
+                "thread-per-host scheduler requires a non-empty host list")
         return ThreadPerHostScheduler(shared, hosts, parallelism, pin_cpus)
     if kind == "serial" or parallelism <= 1:
         return SerialScheduler(shared)

@@ -41,7 +41,8 @@ def host_sharding(mesh: Mesh) -> NamedSharding:
 def param_shardings(mesh: Mesh) -> NetPlaneParams:
     row = NamedSharding(mesh, P(HOST_AXIS, None))
     vec = NamedSharding(mesh, P(HOST_AXIS))
-    return NetPlaneParams(latency_ns=row, loss=row, tb_rate=vec, tb_cap=vec)
+    return NetPlaneParams(latency_ns=row, loss=row, tb_rate=vec, tb_cap=vec,
+                          qdisc_rr=vec)
 
 
 def shard_state(state: NetPlaneState, params: NetPlaneParams, mesh: Mesh):

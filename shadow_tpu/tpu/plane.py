@@ -37,6 +37,10 @@ import jax.numpy as jnp
 import numpy as np
 
 I32_MAX = np.int32(2**31 - 1)
+# Bounded per-host socket-slot space for the round-robin qdisc's fairness
+# counters; socket ids hash in with `% RR_SOCK_SLOTS` (collisions merge
+# flows, as in classic stochastic fair queuing — determinism is unaffected).
+RR_SOCK_SLOTS = 16
 # eg_clamp sentinel: "clamp this packet's delivery to the end of whatever
 # window processes it" (the pure-device mode, where ingest and step share a
 # window). Integrated transport passes the send-round end instead, since the
@@ -51,6 +55,7 @@ class NetPlaneParams(NamedTuple):
     loss: jax.Array  # [N, N] float32 — path loss probability
     tb_rate: jax.Array  # [N] int32 — egress bytes per millisecond (up-bw)
     tb_cap: jax.Array  # [N] int32 — bucket capacity (rate/ms + 1 MTU burst)
+    qdisc_rr: jax.Array  # [N] bool — per-host qdisc: round-robin vs FIFO
 
 
 class NetPlaneState(NamedTuple):
@@ -76,6 +81,11 @@ class NetPlaneState(NamedTuple):
     tb_balance: jax.Array  # int32 token bytes available
     tb_rem_ns: jax.Array  # int32 sub-millisecond refill remainder
     rng_counter: jax.Array  # int32 draws consumed (determinism contract)
+    # RR qdisc fairness: [N, RR_SOCK_SLOTS] int32 — virtual finish counter
+    # per socket slot (packets this socket has pushed through the qdisc,
+    # floored to the active minimum so idle sockets re-join at the current
+    # virtual time instead of monopolizing on return)
+    rr_sent: jax.Array
     # counters (per host, int32)
     n_sent: jax.Array
     n_loss_dropped: jax.Array
@@ -84,20 +94,28 @@ class NetPlaneState(NamedTuple):
 
 
 def make_params(latency_ns: np.ndarray, loss: np.ndarray, up_bw_bps: np.ndarray,
-                mtu: int = 1500) -> NetPlaneParams:
+                mtu: int = 1500,
+                qdisc_rr: np.ndarray | None = None) -> NetPlaneParams:
     """Build params from the routing matrices (`RoutingInfo.latency_ns/loss`
-    mapped host→node) and per-host up-bandwidths in bits/sec."""
+    mapped host→node) and per-host up-bandwidths in bits/sec.
+
+    `qdisc_rr` [N] bool selects the per-host queuing discipline
+    (`QDiscMode` in `configuration.rs:961`): False = FIFO by packet
+    priority, True = round-robin across emitting sockets. Default FIFO."""
     # cap the per-ms rate at 2^30 - mtu so the refill arithmetic in
     # window_step (balance + rate*elapsed_eff <= cap + rate <= 2*rate + mtu)
     # can never overflow int32; 2^30 B/ms ~ 8.6 Tbit/s, beyond any modeled NIC
     rate = np.minimum(
         np.maximum(1, (up_bw_bps // 8) // 1000), 2**30 - mtu
     ).astype(np.int32)  # B/ms
+    n = np.asarray(latency_ns).shape[0]
     return NetPlaneParams(
         latency_ns=jnp.asarray(latency_ns, jnp.int32),
         loss=jnp.asarray(loss, jnp.float32),
         tb_rate=jnp.asarray(rate),
         tb_cap=jnp.asarray(rate + mtu, jnp.int32),
+        qdisc_rr=(jnp.asarray(qdisc_rr, bool) if qdisc_rr is not None
+                  else jnp.zeros(n, bool)),
     )
 
 
@@ -124,6 +142,7 @@ def make_state(n_hosts: int, egress_cap: int = 32, ingress_cap: int = 64,
                     if initial_tokens is not None else z((N,))),
         tb_rem_ns=z((N,)),
         rng_counter=z((N,)),
+        rr_sent=z((N, RR_SOCK_SLOTS)),
         n_sent=z((N,)),
         n_loss_dropped=z((N,)),
         n_overflow_dropped=z((N,)),
@@ -222,8 +241,16 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
 
 
 def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Array,
-                shift_ns: jax.Array, window_ns: jax.Array):
+                shift_ns: jax.Array, window_ns: jax.Array, *,
+                rr_enabled: bool = True):
     """Advance one scheduling round [t, t + window_ns).
+
+    `rr_enabled` is a static (trace-time) switch: False compiles the
+    FIFO-only qdisc without the RR rank/one-hot tensors — use it when no
+    host configures round-robin (e.g. the integrated DeviceTransport,
+    where the CPU NIC owns qdisc ordering). The RR path materializes
+    [N, CE, CE] pairwise tensors, which DOMINATE the per-window cost
+    whenever N < CE^2; callers with all-FIFO configs should pass False.
 
     `shift_ns` = this window's start minus the previous window's start;
     stored relative times are rebased by it. Returns
@@ -257,24 +284,65 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     )
 
     # --- 2. egress: qdisc order, token-bucket gate ----------------------
-    # FIFO-by-priority qdisc (`network_interface.c:205-303`): valid first,
-    # then ascending priority. Send times / clamps of leftover packets were
-    # taken relative to the window they were ingested in; rebase them too.
+    # Two qdiscs (`network_interface.c:205-303`, `QDiscMode`): FIFO sends
+    # valid-first by ascending packet priority; round-robin interleaves
+    # emitting sockets, taking one packet from each in turn (FIFO within a
+    # socket by per-source seq, which is monotone in emission order). The
+    # RR key is each slot's rank among same-socket slots, a [N, CE, CE]
+    # pairwise count — the dominant per-window cost when N < CE^2, which
+    # is why all-FIFO callers should compile with rr_enabled=False.
+    # Send times / clamps of leftover packets were taken relative to the
+    # window they were ingested in; rebase them too.
     eg_tsend_rb = jnp.where(state.eg_valid, state.eg_tsend - shift_ns, 0)
     eg_clamp_rb = jnp.where(
         state.eg_valid & (state.eg_clamp != NO_CLAMP),
         state.eg_clamp - shift_ns, state.eg_clamp,
     )
     inv = (~state.eg_valid).astype(jnp.int32)
-    (eg_inv, eg_prio, eg_dst, eg_bytes, eg_seq, eg_ctrl, eg_tsend, eg_clamp,
-     eg_valid) = _row_sort(
-        inv, state.eg_prio, state.eg_dst, state.eg_bytes, state.eg_seq,
-        state.eg_ctrl, eg_tsend_rb, eg_clamp_rb, state.eg_valid, keys=2,
+    if rr_enabled:
+        S = RR_SOCK_SLOTS
+        sock_slot = jnp.where(state.eg_valid, state.eg_sock % S, S - 1)
+        # active sockets re-join at the current virtual time (start-time
+        # fair queuing floor) so a returning socket gets its fair turn, not
+        # a burst; rows with nothing queued reset to 0 (counters only mean
+        # anything relative to each other, and the rebase below keeps every
+        # value within ~CE of zero, so int32 never wraps)
+        slot_onehot = sock_slot[:, :, None] == jnp.arange(S, dtype=jnp.int32)
+        active = (slot_onehot & state.eg_valid[:, :, None]).any(axis=1)
+        vtime = jnp.where(active, state.rr_sent, I32_MAX).min(axis=1)  # [N]
+        vtime = jnp.where(active.any(axis=1), vtime, 0)
+        rr_base = jnp.maximum(state.rr_sent, vtime[:, None])  # [N, S]
+        same_sock = sock_slot[:, :, None] == sock_slot[:, None, :]
+        both_valid = state.eg_valid[:, :, None] & state.eg_valid[:, None, :]
+        earlier = state.eg_seq[:, None, :] < state.eg_seq[:, :, None]
+        rr_rank = jnp.sum(same_sock & both_valid & earlier, axis=2,
+                          dtype=jnp.int32)
+        rr_key = jnp.take_along_axis(rr_base, sock_slot, axis=1) + rr_rank
+        rr_mode = params.qdisc_rr[:, None]
+        qkey1 = jnp.where(rr_mode, rr_key, state.eg_prio)
+        qkey2 = jnp.where(rr_mode, state.eg_sock, 0)
+    else:
+        qkey1, qkey2 = state.eg_prio, jnp.zeros_like(state.eg_sock)
+    (eg_inv, _, _, eg_prio, eg_sock, eg_dst, eg_bytes, eg_seq, eg_ctrl,
+     eg_tsend, eg_clamp, eg_valid) = _row_sort(
+        inv, qkey1, qkey2, state.eg_prio, state.eg_sock, state.eg_dst,
+        state.eg_bytes, state.eg_seq, state.eg_ctrl, eg_tsend_rb,
+        eg_clamp_rb, state.eg_valid, keys=3,
     )
     cum = jnp.cumsum(jnp.where(eg_valid, eg_bytes, 0), axis=1)
     sendable = eg_valid & (cum <= balance[:, None])
     spent = jnp.where(sendable, eg_bytes, 0).sum(axis=1)
     balance = balance - spent
+    if rr_enabled:
+        # advance virtual finish by packets pushed through, then rebase to
+        # the floor so counters stay bounded (per the dtype discipline)
+        sent_slot = jnp.where(eg_valid, eg_sock % S, S - 1)
+        sent_per_sock = jnp.sum(
+            (sent_slot[:, :, None] == jnp.arange(S, dtype=jnp.int32))
+            & sendable[:, :, None], axis=1, dtype=jnp.int32)
+        rr_sent = rr_base - vtime[:, None] + sent_per_sock
+    else:
+        rr_sent = state.rr_sent
 
     # --- 3. loss sampling + latency lookup ------------------------------
     host_idx = jnp.arange(N, dtype=jnp.int32)[:, None]
@@ -364,9 +432,9 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     # --- 6. compact leftover egress so rows stay front-packed for ingest
     eg_prio_left = jnp.where(eg_valid_left, eg_prio, I32_MAX)
     (_, eg_prio_c, eg_dst_c, eg_bytes_c, eg_seq_c, eg_ctrl_c, eg_tsend_c,
-     eg_clamp_c, eg_valid_c) = _row_sort(
+     eg_clamp_c, eg_sock_c, eg_valid_c) = _row_sort(
         (~eg_valid_left).astype(jnp.int32), eg_prio_left, eg_dst, eg_bytes,
-        eg_seq, eg_ctrl, eg_tsend, eg_clamp, eg_valid_left, keys=2,
+        eg_seq, eg_ctrl, eg_tsend, eg_clamp, eg_sock, eg_valid_left, keys=2,
     )
 
     # --- 7. stats + next-event reduction --------------------------------
@@ -378,10 +446,11 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     new_state = NetPlaneState(
         eg_dst=eg_dst_c, eg_bytes=eg_bytes_c, eg_prio=eg_prio_c,
         eg_seq=eg_seq_c, eg_ctrl=eg_ctrl_c, eg_tsend=eg_tsend_c,
-        eg_clamp=eg_clamp_c, eg_valid=eg_valid_c,
+        eg_clamp=eg_clamp_c, eg_sock=eg_sock_c, eg_valid=eg_valid_c,
         in_src=in_src_new, in_bytes=in_bytes_new, in_seq=in_seq_new,
         in_deliver_rel=in_deliver_new, in_valid=in_valid_new,
         tb_balance=balance, tb_rem_ns=tb_rem_ns, rng_counter=rng_counter,
+        rr_sent=rr_sent,
         n_sent=state.n_sent + sent.sum(axis=1, dtype=jnp.int32),
         n_loss_dropped=state.n_loss_dropped + lost.sum(axis=1, dtype=jnp.int32),
         n_overflow_dropped=state.n_overflow_dropped + overflowed,

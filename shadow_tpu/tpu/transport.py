@@ -69,7 +69,10 @@ class DeviceTransport:
                                       initial_tokens=np.full(
                                           n, I32_MAX // 2, np.int32))
         self._rng_root = jax.random.PRNGKey(0)  # unused: loss matrix is 0
-        self._step = jax.jit(plane.window_step)
+        # qdisc ordering happened on the CPU NIC before capture, so the
+        # device plane compiles the FIFO-only path
+        self._step = jax.jit(
+            lambda *a: plane.window_step(*a, rr_enabled=False))
         self._ingest = jax.jit(plane.ingest)
         self._ingress_cap = ingress_cap
 
