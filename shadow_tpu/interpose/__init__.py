@@ -157,6 +157,9 @@ def load() -> ctypes.CDLL:
         fn = getattr(lib, name)
         fn.argtypes = [ctypes.c_void_p, ctypes.POINTER(ShimEvent)]
         fn.restype = ctypes.c_long
+    lib.ipc_to_shadow_recv_timed.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ShimEvent), ctypes.c_int64]
+    lib.ipc_to_shadow_recv_timed.restype = ctypes.c_long
     lib.ipc_init.argtypes = [ctypes.c_void_p]
     lib.ipc_close.argtypes = [ctypes.c_void_p]
     lib.ipc_sizeof.restype = ctypes.c_uint64
@@ -282,6 +285,16 @@ class IpcChannel:
     def recv_from_shim(self) -> Optional[ShimEvent]:
         ev = ShimEvent()
         n = self._lib.ipc_to_shadow_recv(self.block.addr, ctypes.byref(ev))
+        return ev if n >= 0 else None
+
+    def recv_from_shim_timed(self, timeout_ns: int) -> Optional[ShimEvent]:
+        """Bounded recv: the event, None when the writer closed, or
+        TimeoutError after timeout_ns of wall time with nothing sent."""
+        ev = ShimEvent()
+        n = self._lib.ipc_to_shadow_recv_timed(self.block.addr,
+                                               ctypes.byref(ev), timeout_ns)
+        if n == -2:
+            raise TimeoutError
         return ev if n >= 0 else None
 
     def close(self) -> None:

@@ -23,6 +23,11 @@ long ipc_to_shadow_recv(IPCData *ipc, ShimEvent *ev) {
     return scchannel_recv(&ipc->to_shadow, ev, sizeof(*ev));
 }
 
+long ipc_to_shadow_recv_timed(IPCData *ipc, ShimEvent *ev,
+                              int64_t timeout_ns) {
+    return scchannel_recv_timed(&ipc->to_shadow, ev, sizeof(*ev), timeout_ns);
+}
+
 void ipc_close(IPCData *ipc) {
     scchannel_close_writer(&ipc->to_shim);
     scchannel_close_writer(&ipc->to_shadow);

@@ -103,6 +103,8 @@ int ipc_to_shim_send(IPCData *ipc, const ShimEvent *ev);
 long ipc_to_shim_recv(IPCData *ipc, ShimEvent *ev);
 int ipc_to_shadow_send(IPCData *ipc, const ShimEvent *ev);
 long ipc_to_shadow_recv(IPCData *ipc, ShimEvent *ev);
+long ipc_to_shadow_recv_timed(IPCData *ipc, ShimEvent *ev,
+                              int64_t timeout_ns);
 void ipc_close(IPCData *ipc);
 uint64_t ipc_sizeof(void);
 uint64_t shim_event_sizeof(void);

@@ -4,6 +4,7 @@
 #include <linux/futex.h>
 #include <string.h>
 #include <sys/syscall.h>
+#include <time.h>
 #include <unistd.h>
 
 #define CLOSED_BIT 0x4u
@@ -88,6 +89,37 @@ long scchannel_recv(SelfContainedChannel *ch, void *buf, uint32_t cap) {
         }
         if (cur & CLOSED_BIT) return -1; /* closed and nothing pending */
         wait_while(&ch->state, cur);
+    }
+}
+
+long scchannel_recv_timed(SelfContainedChannel *ch, void *buf, uint32_t cap,
+                          int64_t timeout_ns) {
+    struct timespec now;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    int64_t deadline =
+        (int64_t)now.tv_sec * 1000000000 + now.tv_nsec + timeout_ns;
+    for (;;) {
+        uint32_t cur = load_acq(&ch->state);
+        uint32_t st = cur & STATE_MASK;
+        if (st == SCCHANNEL_READY) {
+            if (!cas(&ch->state, cur, (cur & CLOSED_BIT) | SCCHANNEL_READING))
+                continue;
+            uint32_t n = ch->len;
+            if (n > cap) n = cap;
+            memcpy(buf, ch->msg, n);
+            set_state(ch, SCCHANNEL_EMPTY);
+            wake_all(&ch->state);
+            return (long)n;
+        }
+        if (cur & CLOSED_BIT) return -1;
+        clock_gettime(CLOCK_MONOTONIC, &now);
+        int64_t rem =
+            deadline - ((int64_t)now.tv_sec * 1000000000 + now.tv_nsec);
+        if (rem <= 0) return -2;
+        struct timespec ts = {(time_t)(rem / 1000000000),
+                              (long)(rem % 1000000000)};
+        shim_text_syscall(SYS_futex, (long)(uintptr_t)&ch->state, FUTEX_WAIT,
+                          cur, (long)(uintptr_t)&ts, 0, 0);
     }
 }
 

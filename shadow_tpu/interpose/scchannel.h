@@ -48,6 +48,12 @@ int scchannel_send(SelfContainedChannel *ch, const void *buf, uint32_t len);
  * with no message pending (parity: WriterIsClosed). */
 long scchannel_recv(SelfContainedChannel *ch, void *buf, uint32_t cap);
 
+/* Like scchannel_recv but bounded by timeout_ns of wall time; returns -2
+ * on timeout. Shadow-side only (uses clock_gettime, which a seccomp'd
+ * shim must not call through libc). */
+long scchannel_recv_timed(SelfContainedChannel *ch, void *buf, uint32_t cap,
+                          int64_t timeout_ns);
+
 /* Mark the writer side closed and wake any blocked reader (parity: the
  * ChildPidWatcher closing the channel when a managed process dies). */
 void scchannel_close_writer(SelfContainedChannel *ch);

@@ -10,8 +10,16 @@
  * so clock_gettime etc. take the syscall path (patch_vdso.c) — same here,
  * by overwriting vDSO entry points with a jump to a trapping stub.
  *
- * Scope (round 1): single-threaded managed processes; clone/fork are
- * answered natively but child threads are not yet individually managed.
+ * Threads and fork (reference managed_thread.rs:349-428 + shim/src/clone.rs):
+ * each managed thread owns its own IPC channel. A trapped clone() with
+ * CLONE_VM follows the AddThread handshake — the simulator allocates a
+ * child channel and replies ADD_THREAD_REQ; the shim runs the native clone
+ * with a trampoline stack frame; the child attaches its channel (raw
+ * syscalls only), announces itself, waits for the simulator's go-ahead,
+ * then restores the app's trapped register state with rax=0 and jumps back
+ * into application code. A fork-like clone (no CLONE_VM) needs no
+ * trampoline: the child keeps its copied stack, swaps in the new channel,
+ * and returns 0 through the normal signal path.
  */
 
 #define _GNU_SOURCE 1
@@ -19,12 +27,14 @@
 #include <linux/audit.h>
 #include <linux/filter.h>
 #include <linux/seccomp.h>
+#include <fcntl.h>
 #include <signal.h>
 #include <stddef.h>
 #include <stdint.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <sys/mman.h>
 #include <sys/prctl.h>
 #include <sys/syscall.h>
 #include <ucontext.h>
@@ -48,6 +58,77 @@ extern "C" int shadow_tpu_patch_vdso(void);
 static ShMemBlock g_ipc_block;
 static IPCData *g_ipc = NULL;
 static int g_interposing = 0;
+
+/* Per-thread IPC channel (reference: one IPCData per managed thread,
+ * ipc.rs:14-46). initial-exec TLS: fs-relative access, safe from signal
+ * handlers, no lazy allocation. The main thread uses g_ipc.
+ *
+ * TLS only works for threads that own their TLS: a clone(CLONE_VM)
+ * WITHOUT CLONE_SETTLS (Go's newosproc, other non-glibc runtimes that
+ * set %fs after clone) shares the parent's %fs base at first, so a TLS
+ * write from the child would clobber the PARENT's slot and cross their
+ * channels. Those threads register in a tid-keyed table instead, and
+ * cur_ipc() verifies TLS ownership by tid once any such thread exists. */
+static __thread IPCData *t_ipc __attribute__((tls_model("initial-exec")));
+static __thread long t_ipc_tid __attribute__((tls_model("initial-exec")));
+
+#define TID_IPC_SLOTS 512
+static struct { long tid; IPCData *ipc; } g_tid_ipc[TID_IPC_SLOTS];
+static int g_shared_tls_threads; /* any live no-SETTLS thread */
+
+static int tid_ipc_has_free_slot(void) {
+    for (int i = 0; i < TID_IPC_SLOTS; i++) {
+        if (__atomic_load_n(&g_tid_ipc[i].tid, __ATOMIC_ACQUIRE) == 0)
+            return 1;
+    }
+    return 0;
+}
+
+static long raw_gettid(void) {
+    return shim_raw_syscall(SYS_gettid, 0, 0, 0, 0, 0, 0);
+}
+
+static int tid_ipc_register(long tid, IPCData *ipc) {
+    for (int i = 0; i < TID_IPC_SLOTS; i++) {
+        long expect = 0;
+        if (__atomic_compare_exchange_n(&g_tid_ipc[i].tid, &expect, tid, 0,
+                                        __ATOMIC_ACQ_REL, __ATOMIC_ACQUIRE)) {
+            __atomic_store_n(&g_tid_ipc[i].ipc, ipc, __ATOMIC_RELEASE);
+            __atomic_store_n(&g_shared_tls_threads, 1, __ATOMIC_RELEASE);
+            return 0;
+        }
+    }
+    return -1;
+}
+
+static void tid_ipc_clear(long tid) {
+    for (int i = 0; i < TID_IPC_SLOTS; i++) {
+        if (__atomic_load_n(&g_tid_ipc[i].tid, __ATOMIC_ACQUIRE) == tid) {
+            __atomic_store_n(&g_tid_ipc[i].ipc, (IPCData *)NULL,
+                             __ATOMIC_RELEASE);
+            __atomic_store_n(&g_tid_ipc[i].tid, 0, __ATOMIC_RELEASE);
+            return;
+        }
+    }
+}
+
+static IPCData *tid_ipc_lookup(long tid) {
+    for (int i = 0; i < TID_IPC_SLOTS; i++) {
+        if (__atomic_load_n(&g_tid_ipc[i].tid, __ATOMIC_ACQUIRE) == tid)
+            return __atomic_load_n(&g_tid_ipc[i].ipc, __ATOMIC_ACQUIRE);
+    }
+    return NULL;
+}
+
+static inline IPCData *cur_ipc(void) {
+    if (!__atomic_load_n(&g_shared_tls_threads, __ATOMIC_ACQUIRE))
+        return t_ipc ? t_ipc : g_ipc; /* fast path: TLS is trustworthy */
+    long me = raw_gettid();
+    if (t_ipc && t_ipc_tid == me) return t_ipc;
+    IPCData *p = tid_ipc_lookup(me);
+    if (p) return p;
+    return t_ipc ? t_ipc : g_ipc;
+}
 
 /* per-process clock block (optional; fast path off when absent) */
 static ShMemBlock g_proc_block;
@@ -126,18 +207,303 @@ static long shim_emulate_syscall(long nr, const uint64_t args[6]) {
     ev.kind = SHIM_EVENT_SYSCALL;
     ev.u.syscall.number = nr;
     for (int i = 0; i < 6; i++) ev.u.syscall.args[i] = args[i];
-    if (ipc_to_shadow_send(g_ipc, &ev) != 0) {
+    if (ipc_to_shadow_send(cur_ipc(), &ev) != 0) {
         /* simulator is gone: die quietly */
         shim_raw_syscall(SYS_exit_group, 1, 0, 0, 0, 0, 0);
     }
     ShimEvent reply;
-    long n = ipc_to_shim_recv(g_ipc, &reply);
+    long n = ipc_to_shim_recv(cur_ipc(), &reply);
     if (n < 0) shim_raw_syscall(SYS_exit_group, 1, 0, 0, 0, 0, 0);
     if (reply.kind == SHIM_EVENT_SYSCALL_DO_NATIVE) {
+        if (nr == SYS_exit && g_shared_tls_threads)
+            tid_ipc_clear(raw_gettid()); /* free the no-SETTLS slot */
         return shim_raw_syscall(nr, (long)args[0], (long)args[1], (long)args[2],
                                 (long)args[3], (long)args[4], (long)args[5]);
     }
     return reply.u.complete.retval;
+}
+
+/* ------------------------------------------------------------------ */
+/* clone / fork support.
+ *
+ * shmem attach without libc: the clone child must map its IPC block
+ * before it can announce itself, and it cannot touch interposed or
+ * non-async-signal-safe libc on the way. Handles look like
+ * "/shadow_tpu_shm_<pid>_<n>:<size>" (shmem.cc shmem_serialize). */
+
+#ifndef CLONE_VM
+#define CLONE_VM 0x100
+#endif
+#ifndef CLONE_VFORK
+#define CLONE_VFORK 0x4000
+#endif
+
+static void *shim_raw_attach(const char *handle, uint64_t *size_out) {
+    char path[160];
+    const char *p = handle;
+    const char *colon = NULL;
+    for (const char *q = handle; *q; q++)
+        if (*q == ':') colon = q;
+    if (!colon) return NULL;
+    uint64_t size = 0;
+    for (const char *q = colon + 1; *q >= '0' && *q <= '9'; q++)
+        size = size * 10 + (uint64_t)(*q - '0');
+    if (size == 0) return NULL;
+    size_t n = 0;
+    const char prefix[] = "/dev/shm";
+    for (; prefix[n]; n++) path[n] = prefix[n];
+    for (; p < colon && n + 1 < sizeof(path); p++) path[n++] = *p;
+    path[n] = '\0';
+    long fd = shim_raw_syscall(SYS_openat, -100 /* AT_FDCWD */, (long)path,
+                               O_RDWR, 0, 0, 0);
+    if (fd < 0) return NULL;
+    long addr = shim_raw_syscall(SYS_mmap, 0, (long)size,
+                                 PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    shim_raw_syscall(SYS_close, fd, 0, 0, 0, 0, 0);
+    if (addr < 0 && addr > -4096) return NULL;
+    if (size_out) *size_out = size;
+    return (void *)addr;
+}
+
+/* The trampoline frame the clone child starts on (carved just below the
+ * app-provided child stack; the app's own frame data at [child_stack, ...)
+ * — e.g. glibc clone.S's pushed fn/arg — is untouched). Offsets are
+ * hard-coded in the restore asm below. */
+struct CloneFrame {
+    uint64_t rip;                       /* 0x00: app post-syscall rip */
+    uint64_t rsp;                       /* 0x08: app child stack (arg 2) */
+    uint64_t rbx, rbp, r12, r13, r14, r15; /* 0x10 - 0x38 */
+    uint64_t rdi, rsi, rdx, rcx, r8, r9, r10, r11; /* 0x40 - 0x78 */
+    char ipc_handle[SHMEM_HANDLE_MAX];  /* 0x80 */
+    uint64_t settls; /* clone had CLONE_SETTLS: the child owns its TLS */
+};
+
+static_assert(offsetof(CloneFrame, ipc_handle) == 0x80, "frame layout");
+
+extern "C" long shim_clone_raw(uint64_t flags, uint64_t child_sp,
+                               uint64_t ptid, uint64_t ctid, uint64_t tls);
+
+/* Restore the app's trapped register state in the child: rax = 0 (the
+ * child's clone return), rsp = the stack glibc handed to clone, rip = the
+ * instruction after the trapped syscall. The transient push lands below
+ * the app stack pointer (free space) and ret pops it back. */
+__asm__(
+    ".text\n"
+    ".local shim_clone_jump\n"
+    "shim_clone_jump:\n"
+    "  movq 0x08(%rdi), %rsp\n"
+    "  movq 0x10(%rdi), %rbx\n"
+    "  movq 0x18(%rdi), %rbp\n"
+    "  movq 0x20(%rdi), %r12\n"
+    "  movq 0x28(%rdi), %r13\n"
+    "  movq 0x30(%rdi), %r14\n"
+    "  movq 0x38(%rdi), %r15\n"
+    "  movq 0x50(%rdi), %rdx\n"
+    "  movq 0x58(%rdi), %rcx\n"
+    "  movq 0x60(%rdi), %r8\n"
+    "  movq 0x68(%rdi), %r9\n"
+    "  movq 0x70(%rdi), %r10\n"
+    "  movq 0x78(%rdi), %r11\n"
+    "  pushq 0x00(%rdi)\n"
+    "  movq 0x48(%rdi), %rsi\n"
+    "  movq 0x40(%rdi), %rdi\n"
+    "  xorl %eax, %eax\n"
+    "  ret\n");
+extern "C" void shim_clone_jump(CloneFrame *f) __attribute__((noreturn));
+
+/* Child-side start: attach the per-thread channel, announce, wait for the
+ * simulator's go-ahead, then become the application thread. Raw syscalls
+ * only — nothing here may recurse into interposition. */
+extern "C" __attribute__((visibility("hidden"), noreturn, used))
+void shim_clone_child(CloneFrame *f) {
+    IPCData *my = (IPCData *)shim_raw_attach(f->ipc_handle, NULL);
+    if (!my) shim_raw_syscall(SYS_exit, 117, 0, 0, 0, 0, 0);
+    long tid = shim_raw_syscall(SYS_gettid, 0, 0, 0, 0, 0, 0);
+    if (f->settls) {
+        /* fresh TLS (kernel installed it before we ran): safe to own */
+        t_ipc = my;
+        t_ipc_tid = tid;
+    } else {
+        /* %fs still points at the PARENT's TLS — writing t_ipc here
+         * would hijack the parent's channel. Register by tid instead. */
+        if (tid_ipc_register(tid, my) != 0)
+            shim_raw_syscall(SYS_exit, 117, 0, 0, 0, 0, 0);
+    }
+    /* rdtsc trapping is a per-thread CPU flag; re-arm it here */
+#ifndef PR_TSC_SIGSEGV
+#define PR_TSC_SIGSEGV 2
+#endif
+    shim_raw_syscall(SYS_prctl, PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0, 0);
+    ShimEvent ev;
+    memset(&ev, 0, sizeof(ev));
+    ev.kind = SHIM_EVENT_START_RES;
+    ev.u.add_thread_res.child_native_tid = tid;
+    if (ipc_to_shadow_send(my, &ev) != 0)
+        shim_raw_syscall(SYS_exit, 117, 0, 0, 0, 0, 0);
+    ShimEvent go;
+    if (ipc_to_shim_recv(my, &go) < 0)
+        shim_raw_syscall(SYS_exit, 117, 0, 0, 0, 0, 0);
+    shim_clone_jump(f);
+}
+
+/* The native clone syscall, child path diverted onto the trampoline. Lives
+ * in shim_text so the syscall instruction passes the seccomp IP filter. */
+__asm__(
+    ".pushsection shim_text,\"ax\",@progbits\n"
+    ".globl shim_clone_raw\n"
+    "shim_clone_raw:\n"
+    "  movq %rcx, %r10\n"
+    "  movl $56, %eax\n" /* SYS_clone */
+    "  syscall\n"
+    "  testq %rax, %rax\n"
+    "  jnz 1f\n"
+    "  movq %rsp, %rdi\n" /* child: rsp = CloneFrame */
+    "  call shim_clone_child\n"
+    "1: ret\n"
+    ".popsection\n");
+
+/* Thread-flavored clone (CLONE_VM): AddThread handshake + trampoline.
+ * Returns the value for the app's rax. Needs the trapped register state
+ * for the child's jump back into app code. */
+static long shim_handle_clone_thread(const uint64_t args[6], greg_t *regs) {
+#ifndef CLONE_SETTLS
+#define CLONE_SETTLS 0x00080000
+#endif
+    /* The trampoline frame is carved below the child stack: a NULL stack
+     * (run-on-parent's-stack clone) would wrap the pointer — refuse it
+     * like the fork path refuses caller-provided stacks. */
+    if (args[1] == 0) return -38; /* ENOSYS */
+    /* A no-SETTLS child can only be routed via the tid table; reserve
+     * capacity BEFORE the native clone, because afterwards the app has
+     * already been told the thread exists and a silent 117-exit would
+     * hang it. Threads of one managed process never run concurrently, so
+     * this check cannot race another clone. */
+    if (!(args[0] & CLONE_SETTLS) && !tid_ipc_has_free_slot())
+        return -11; /* EAGAIN */
+    ShimEvent ev;
+    memset(&ev, 0, sizeof(ev));
+    ev.kind = SHIM_EVENT_SYSCALL;
+    ev.u.syscall.number = SYS_clone;
+    for (int i = 0; i < 6; i++) ev.u.syscall.args[i] = args[i];
+    if (ipc_to_shadow_send(cur_ipc(), &ev) != 0)
+        shim_raw_syscall(SYS_exit_group, 1, 0, 0, 0, 0, 0);
+    ShimEvent reply;
+    if (ipc_to_shim_recv(cur_ipc(), &reply) < 0)
+        shim_raw_syscall(SYS_exit_group, 1, 0, 0, 0, 0, 0);
+    if (reply.kind == SHIM_EVENT_SYSCALL_COMPLETE)
+        return reply.u.complete.retval; /* simulator refused (EAGAIN...) */
+    if (reply.kind != SHIM_EVENT_ADD_THREAD_REQ) return -38; /* ENOSYS */
+
+    uint64_t stack_top = args[1];
+    CloneFrame *f = (CloneFrame *)((stack_top - sizeof(CloneFrame)) & ~63ULL);
+    f->rip = (uint64_t)regs[REG_RIP];
+    f->rsp = stack_top;
+    f->rbx = (uint64_t)regs[REG_RBX];
+    f->rbp = (uint64_t)regs[REG_RBP];
+    f->r12 = (uint64_t)regs[REG_R12];
+    f->r13 = (uint64_t)regs[REG_R13];
+    f->r14 = (uint64_t)regs[REG_R14];
+    f->r15 = (uint64_t)regs[REG_R15];
+    f->rdi = (uint64_t)regs[REG_RDI];
+    f->rsi = (uint64_t)regs[REG_RSI];
+    f->rdx = (uint64_t)regs[REG_RDX];
+    f->rcx = (uint64_t)regs[REG_RCX];
+    f->r8 = (uint64_t)regs[REG_R8];
+    f->r9 = (uint64_t)regs[REG_R9];
+    f->r10 = (uint64_t)regs[REG_R10];
+    f->r11 = (uint64_t)regs[REG_R11];
+    memcpy(f->ipc_handle, reply.u.add_thread_req.ipc_handle,
+           sizeof(f->ipc_handle));
+    f->settls = (args[0] & CLONE_SETTLS) ? 1 : 0;
+
+    /* CLONE_VFORK (posix_spawn/system) would block the parent in the
+     * native clone until the child execs — but the child is parked
+     * waiting for the simulator's go-ahead, which needs the parent's
+     * ADD_THREAD_RES first: guaranteed deadlock. Strip it; the child has
+     * its own stack (glibc allocates one for spawn helpers), so running
+     * the parent concurrently is safe. Cost: exec-failure reporting from
+     * posix_spawn helpers may be unreliable (known limitation). */
+    long tid = shim_clone_raw(args[0] & ~(uint64_t)CLONE_VFORK, (uint64_t)f,
+                              args[2], args[3], args[4]);
+
+    ShimEvent res;
+    memset(&res, 0, sizeof(res));
+    res.kind = SHIM_EVENT_ADD_THREAD_RES;
+    res.u.add_thread_res.child_native_tid = tid;
+    if (ipc_to_shadow_send(cur_ipc(), &res) != 0)
+        shim_raw_syscall(SYS_exit_group, 1, 0, 0, 0, 0, 0);
+    ShimEvent fin;
+    if (ipc_to_shim_recv(cur_ipc(), &fin) < 0)
+        shim_raw_syscall(SYS_exit_group, 1, 0, 0, 0, 0, 0);
+    return fin.u.complete.retval;
+}
+
+/* Fork-flavored clone (no CLONE_VM) and SYS_fork: the child keeps its
+ * copied stack, so no trampoline — swap channels and return 0 upward
+ * through the normal reply path. */
+static long shim_handle_fork(long nr, const uint64_t args[6]) {
+    /* a fork-like clone with a caller-provided stack would resume the
+     * child mid-C-function on that stack (frame/ret addrs live on the old
+     * one) — only the glibc fork shape (stack = 0) is supported */
+    if (nr == SYS_clone && args[1] != 0) return -38; /* ENOSYS */
+    ShimEvent ev;
+    memset(&ev, 0, sizeof(ev));
+    ev.kind = SHIM_EVENT_SYSCALL;
+    ev.u.syscall.number = nr;
+    for (int i = 0; i < 6; i++) ev.u.syscall.args[i] = args[i];
+    if (ipc_to_shadow_send(cur_ipc(), &ev) != 0)
+        shim_raw_syscall(SYS_exit_group, 1, 0, 0, 0, 0, 0);
+    ShimEvent reply;
+    if (ipc_to_shim_recv(cur_ipc(), &reply) < 0)
+        shim_raw_syscall(SYS_exit_group, 1, 0, 0, 0, 0, 0);
+    if (reply.kind == SHIM_EVENT_SYSCALL_COMPLETE)
+        return reply.u.complete.retval;
+    if (reply.kind != SHIM_EVENT_ADD_THREAD_REQ) return -38; /* ENOSYS */
+
+    char handle[SHMEM_HANDLE_MAX];
+    memcpy(handle, reply.u.add_thread_req.ipc_handle, sizeof(handle));
+
+    long pid = shim_raw_syscall(nr, (long)args[0], (long)args[1],
+                                (long)args[2], (long)args[3], (long)args[4],
+                                (long)args[5]);
+    if (pid == 0) {
+        /* child: our copies of the parent's channels must never be used
+         * again; the clock block is shared with the parent, so the fast
+         * path is disabled here (the simulator answers time slowly but
+         * correctly for forked children). */
+        void *addr = shim_raw_attach(handle, NULL);
+        if (!addr) shim_raw_syscall(SYS_exit_group, 117, 0, 0, 0, 0, 0);
+        g_ipc = (IPCData *)addr;
+        t_ipc = g_ipc;
+        t_ipc_tid = shim_raw_syscall(SYS_gettid, 0, 0, 0, 0, 0, 0);
+        /* only the forking thread survives fork: stale no-SETTLS slots
+         * (and their parent-owned mappings) must not be consulted here */
+        memset(g_tid_ipc, 0, sizeof(g_tid_ipc));
+        __atomic_store_n(&g_shared_tls_threads, 0, __ATOMIC_RELEASE);
+        g_proc = NULL;
+        ShimEvent hello;
+        memset(&hello, 0, sizeof(hello));
+        hello.kind = SHIM_EVENT_START_RES;
+        hello.u.add_thread_res.child_native_tid =
+            shim_raw_syscall(SYS_getpid, 0, 0, 0, 0, 0, 0);
+        if (ipc_to_shadow_send(g_ipc, &hello) != 0)
+            shim_raw_syscall(SYS_exit_group, 117, 0, 0, 0, 0, 0);
+        ShimEvent go;
+        if (ipc_to_shim_recv(g_ipc, &go) < 0)
+            shim_raw_syscall(SYS_exit_group, 117, 0, 0, 0, 0, 0);
+        return 0;
+    }
+
+    ShimEvent res;
+    memset(&res, 0, sizeof(res));
+    res.kind = SHIM_EVENT_ADD_THREAD_RES;
+    res.u.add_thread_res.child_native_tid = pid;
+    if (ipc_to_shadow_send(cur_ipc(), &res) != 0)
+        shim_raw_syscall(SYS_exit_group, 1, 0, 0, 0, 0, 0);
+    ShimEvent fin;
+    if (ipc_to_shim_recv(cur_ipc(), &fin) < 0)
+        shim_raw_syscall(SYS_exit_group, 1, 0, 0, 0, 0, 0);
+    return fin.u.complete.retval;
 }
 
 /* ------------------------------------------------------------------ */
@@ -216,6 +582,10 @@ extern "C" long shadow_tpu_api_syscall(long nr, long a, long b, long c,
                         (uint64_t)d, (uint64_t)e, (uint64_t)f};
     long fast;
     if (shim_try_time_fastpath(nr, args, &fast)) return fast;
+    if (nr == SYS_fork || (nr == SYS_clone && !(args[0] & CLONE_VM)))
+        return shim_handle_fork(nr, args);
+    if (nr == SYS_clone || nr == SYS_clone3 || nr == SYS_vfork)
+        return -38; /* thread clone needs the trapped registers: ENOSYS */
     return shim_emulate_syscall(nr, args);
 }
 
@@ -232,6 +602,19 @@ static void shim_sigsys_handler(int sig, siginfo_t *info, void *ucontext) {
     long fast_ret;
     if (shim_try_time_fastpath(nr, args, &fast_ret)) {
         regs[REG_RAX] = fast_ret;
+        return;
+    }
+    if (nr == SYS_clone3 || nr == SYS_vfork) {
+        /* ENOSYS: glibc falls back to plain clone / fork semantics */
+        regs[REG_RAX] = -38;
+        return;
+    }
+    if (nr == SYS_clone && (args[0] & CLONE_VM)) {
+        regs[REG_RAX] = shim_handle_clone_thread(args, regs);
+        return;
+    }
+    if (nr == SYS_fork || nr == SYS_clone) {
+        regs[REG_RAX] = shim_handle_fork(nr, args);
         return;
     }
     regs[REG_RAX] = shim_emulate_syscall(nr, args);
@@ -286,6 +669,7 @@ __attribute__((constructor)) static void shim_init(void) {
         _exit(112);
     }
     g_ipc = (IPCData *)g_ipc_block.addr;
+    t_ipc = g_ipc;
 
     /* optional per-process clock block for the in-shim time fast path */
     const char *proc_handle = getenv("SHADOW_TPU_SHMEM_HANDLE");

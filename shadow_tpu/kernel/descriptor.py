@@ -4,11 +4,15 @@ Parity: reference `src/main/host/descriptor/mod.rs` `DescriptorTable` —
 lowest-available fd allocation, dup sharing the same underlying file,
 close-on-last-reference, and explicit fd targets (dup2). Flags (CLOEXEC)
 are per-descriptor, not per-file.
+
+Files are refcounted across ALL tables referencing them (`_open_refs` on
+the file object, the moral equivalent of the reference's Arc<File>): fork
+clones the parent's table into the child (`process.rs:591`
+new_forked_process), after which both processes hold descriptors to the
+same open files and the file closes only when the last one goes away.
 """
 
 from __future__ import annotations
-
-from typing import Optional
 
 from . import errors
 
@@ -21,14 +25,24 @@ class Descriptor:
         self.cloexec = cloexec
 
 
+def _ref(file) -> None:
+    file._open_refs = getattr(file, "_open_refs", 0) + 1
+
+
+def _unref(file) -> None:
+    file._open_refs = getattr(file, "_open_refs", 1) - 1
+    if file._open_refs <= 0:
+        file.close()
+
+
 class DescriptorTable:
     def __init__(self):
         self._table: dict[int, Descriptor] = {}
-        self._next_hint = 0
 
     def register(self, file, cloexec: bool = False) -> int:
         fd = self._lowest_free()
         self._table[fd] = Descriptor(file, cloexec)
+        _ref(file)
         return fd
 
     def register_at(self, fd: int, file, cloexec: bool = False) -> int:
@@ -38,6 +52,7 @@ class DescriptorTable:
         if fd in self._table:
             self.close(fd)
         self._table[fd] = Descriptor(file, cloexec)
+        _ref(file)
         return fd
 
     def get(self, fd: int):
@@ -52,15 +67,14 @@ class DescriptorTable:
             raise errors.SyscallError(errors.EBADF)
         new_fd = self._lowest_free()
         self._table[new_fd] = Descriptor(entry.file, cloexec=False)
+        _ref(entry.file)
         return new_fd
 
     def close(self, fd: int) -> None:
         entry = self._table.pop(fd, None)
         if entry is None:
             raise errors.SyscallError(errors.EBADF)
-        # close the file only when no other descriptor references it
-        if not any(d.file is entry.file for d in self._table.values()):
-            entry.file.close()
+        _unref(entry.file)
 
     def close_all(self) -> None:
         for fd in sorted(self._table):
@@ -68,6 +82,15 @@ class DescriptorTable:
                 self.close(fd)
             except errors.SyscallError:
                 pass
+
+    def fork_into(self) -> "DescriptorTable":
+        """fork(2) semantics: the child gets its own fd table whose entries
+        reference the same open files (shared offsets/state)."""
+        child = DescriptorTable()
+        for fd, entry in self._table.items():
+            child._table[fd] = Descriptor(entry.file, entry.cloexec)
+            _ref(entry.file)
+        return child
 
     def fds(self) -> list[int]:
         return sorted(self._table)

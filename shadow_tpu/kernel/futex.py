@@ -1,0 +1,122 @@
+"""Emulated futexes: pthread mutexes/condvars/joins block in SIMULATED time.
+
+Parity: reference `src/main/host/futex.c` (per-word wait queues, wake-N,
+requeue) + `futex_table.rs` + the futex syscall handler
+(`syscall/handler/futex.rs`). Without this, a managed pthread program's
+blocking primitives would either spin natively (wall-clock leaks into the
+sim) or native-block forever (the waker is sim-scheduled).
+
+Design: one `FutexWaiter` token per blocked thread, queued FIFO per futex
+word. A waiter parks on its token's FUTEX_WAKEUP state bit through the
+ordinary `SysCallCondition` machinery, so timeouts compose exactly like
+every other blocking syscall. `wake(n)` pops the first n tokens and flips
+each token's bit individually — waking exactly n threads, in arrival
+order, deterministically.
+
+Word addresses are virtual addresses in the managed process; the table is
+per-process (threads share it via the shared handler). Cross-process
+shared-memory futexes are out of scope (the reference resolves those via
+physical page addresses, `futex_table.rs`).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from .status import FileState, StatefulFile
+
+# futex op numbers (linux/futex.h)
+FUTEX_WAIT = 0
+FUTEX_WAKE = 1
+FUTEX_REQUEUE = 3
+FUTEX_CMP_REQUEUE = 4
+FUTEX_WAKE_OP = 5
+FUTEX_WAIT_BITSET = 9
+FUTEX_WAKE_BITSET = 10
+FUTEX_PRIVATE_FLAG = 128
+FUTEX_CLOCK_REALTIME = 256
+FUTEX_CMD_MASK = ~(FUTEX_PRIVATE_FLAG | FUTEX_CLOCK_REALTIME)
+
+
+MATCH_ANY = 0xFFFFFFFF
+
+
+class FutexWaiter(StatefulFile):
+    """One parked thread's wake token."""
+
+    __slots__ = ("addr", "bitset")
+
+    def __init__(self, addr: int, bitset: int = MATCH_ANY):
+        super().__init__(FileState.ACTIVE)
+        self.addr = addr
+        self.bitset = bitset
+
+    def close(self) -> None:  # descriptor-table protocol compat
+        pass
+
+
+class FutexTable:
+    """word address -> FIFO of waiter tokens (`futex.c` FutexTable)."""
+
+    def __init__(self):
+        self._queues: dict[int, deque[FutexWaiter]] = {}
+
+    def add_waiter(self, addr: int, bitset: int = MATCH_ANY) -> FutexWaiter:
+        w = FutexWaiter(addr, bitset)
+        self._queues.setdefault(addr, deque()).append(w)
+        return w
+
+    def remove_waiter(self, waiter: FutexWaiter) -> None:
+        """Timeout/cancel cleanup: drop the token if still queued."""
+        q = self._queues.get(waiter.addr)
+        if q is None:
+            return
+        try:
+            q.remove(waiter)
+        except ValueError:
+            pass  # already woken
+        if not q:
+            del self._queues[waiter.addr]
+
+    def wake(self, addr: int, n: int, bitset: int = MATCH_ANY) -> int:
+        """Wake up to n waiters whose bitset intersects `bitset`, in FIFO
+        order; non-matching waiters keep their queue position (the
+        kernel's FUTEX_WAKE_BITSET semantics)."""
+        q = self._queues.get(addr)
+        if not q:
+            return 0
+        woken = 0
+        kept: deque[FutexWaiter] = deque()
+        while q:
+            w = q.popleft()
+            if woken < n and (w.bitset & bitset):
+                woken += 1
+                # the state flip fires the parked thread's condition listener
+                w.update_state(FileState.FUTEX_WAKEUP, FileState.FUTEX_WAKEUP)
+            else:
+                kept.append(w)
+        if kept:
+            self._queues[addr] = kept
+        else:
+            self._queues.pop(addr, None)
+        return woken
+
+    def requeue(self, addr: int, n_wake: int, addr2: int,
+                n_requeue: int) -> tuple[int, int]:
+        """Wake up to n_wake waiters of `addr`, then move up to n_requeue of
+        the remainder to `addr2`'s queue. Returns (woken, requeued) — the
+        syscall layer composes the op-specific return convention."""
+        woken = self.wake(addr, n_wake)
+        q = self._queues.get(addr)
+        moved = 0
+        while q and moved < n_requeue:
+            w = q.popleft()
+            w.addr = addr2
+            self._queues.setdefault(addr2, deque()).append(w)
+            moved += 1
+        if q is not None and not q:
+            self._queues.pop(addr, None)
+        return woken, moved
+
+    def waiter_count(self, addr: int) -> int:
+        return len(self._queues.get(addr, ()))

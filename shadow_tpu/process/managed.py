@@ -32,12 +32,15 @@ from typing import Callable, Optional
 from ..core import simtime
 from ..core.event import TaskRef
 from ..kernel import errors as kerrors
+from ..kernel.status import FileState, StatefulFile
 from .condition import SysCallCondition
 from .process import ProcessState
 from .syscall_handler import DispatchCtx, NativeSyscall, SyscallHandler
 
 log = logging.getLogger("shadow_tpu.process")
 from ..interpose import (
+    EVENT_ADD_THREAD_REQ,
+    EVENT_ADD_THREAD_RES,
     EVENT_PROCESS_DEATH,
     EVENT_START_RES,
     EVENT_SYSCALL,
@@ -65,6 +68,9 @@ def _preload_chain() -> str:
 SYS_write = 1
 SYS_getpid = 39
 SYS_nanosleep = 35
+SYS_clone = 56
+SYS_fork = 57
+SYS_exit = 60
 SYS_kill = 62
 SYS_gettimeofday = 96
 SYS_time = 201
@@ -72,7 +78,17 @@ SYS_clock_gettime = 228
 SYS_clock_nanosleep = 230
 SYS_exit_group = 231
 
-_libc = ctypes.CDLL(None, use_errno=True)
+CLONE_VM = 0x100
+CLONE_CHILD_CLEARTID = 0x200000
+
+
+def _i32_exit(v: int) -> int:
+    """exit_group status as the kernel reports it: low 8 bits, never
+    negative (exit(-1) is WEXITSTATUS 255, not a signal death)."""
+    return v & 0xFF
+
+
+from .syscall_handler import _libc  # the package's one libc handle
 
 
 class _IoVec(ctypes.Structure):
@@ -275,34 +291,67 @@ class ManagedProcess:
         return self.proc.returncode, out, err
 
 
+class ManagedThread:
+    """Simulator-side record of one native thread of a managed process.
+
+    Parity: reference `ManagedThread` (`managed_thread.rs`) — owns the
+    thread's IPC channel, park state for blocked syscalls, and the
+    CLONE_CHILD_CLEARTID bookkeeping that lets pthread_join block on the
+    EMULATED futex (`thread.rs` handles the clear + wake explicitly; the
+    kernel's native clear happens too, but no native waiter exists).
+
+    Thread ids stay NATIVE in this rebuild (glibc writes the native tid
+    into its own pthread struct via CLONE_PARENT_SETTID before we ever see
+    it); only process ids are virtual.
+    """
+
+    __slots__ = ("process", "ipc", "native_tid", "parked_condition",
+                 "park_deadline", "futex_waiter", "wait_epoll", "ctid_addr",
+                 "dead", "is_main")
+
+    def __init__(self, process, ipc, is_main: bool = False):
+        self.process = process
+        self.ipc = ipc
+        self.native_tid: Optional[int] = None
+        self.parked_condition = None
+        self.park_deadline: Optional[int] = None
+        self.futex_waiter = None
+        self.wait_epoll = None
+        self.ctid_addr = 0
+        self.dead = False
+        self.is_main = is_main
+
+
 class ManagedSimProcess:
     """A native binary coordinated by the simulation event loop.
 
     Parity: the reference's resume model (`managed_thread.rs:185-322`,
     `Host::resume` `host.rs:474-501`): the worker thread executing this
-    host hands control to the plugin (which runs natively, sim time frozen)
-    and services its syscalls inline until one *blocks*; blocking sleeps
-    become scheduled host tasks that deliver the completion later, so
-    emulated time advances only through the event loop.
+    host hands control to ONE managed thread at a time (which runs
+    natively, sim time frozen) and services its syscalls inline until one
+    *blocks*; blocking syscalls park that thread on a `SysCallCondition`
+    and the event loop resumes whichever thread's condition fires next —
+    threads of a process never run concurrently, which is what keeps the
+    simulation deterministic.
 
-    Round-1 syscall surface: time/identity virtualized from the host
-    clock, sleeps event-scheduled, everything else native passthrough
-    (network syscalls join in the next round's handler table).
+    clone() with CLONE_VM follows the AddThread handshake (reference
+    `managed_thread.rs:349-428`): allocate a child channel, let the shim
+    run the native clone with a trampoline, schedule the child's first
+    resume as a host task. fork-like clone creates a child
+    ManagedSimProcess whose descriptor table is forked from the parent's
+    (`process.rs:591` new_forked_process).
     """
 
-    def __init__(self, host, name: str, argv: list[str],
-                 output_dir: Optional[str] = None):
+    def _init_common(self, host, name: str, argv: list[str],
+                     output_dir: Optional[str] = None) -> None:
         self.host = host
         self.name = name
         self.argv = argv
         self.pid = host.next_pid()
-        self.state = ProcessState.PENDING
         self.exit_status: Optional[int] = None
         self.kill_signal: Optional[int] = None
         self.server = SyscallServer(virtual_pid=self.pid,
                                     clock=self._clock_ns)
-        # the simulated-kernel dispatch table (network, readiness, sleep)
-        self.handler = SyscallHandler(self)
         # the shared clock powering the in-shim time fast path
         self.proc_clock = None
         self.ipc: Optional[IpcChannel] = None
@@ -310,14 +359,76 @@ class ManagedSimProcess:
         self._death_seen = False
         self._output_dir = output_dir
         self._stdout = self._stderr = None
-        # park state for a blocked syscall (`SysCallCondition` trigger)
-        self._parked_condition = None
-        self._park_deadline: Optional[int] = None
+        # threads (main first); clone in flight between ADD_THREAD_REQ and
+        # ADD_THREAD_RES parks here
+        self.threads: list[ManagedThread] = []
+        self._pending_clone = None
+        # fork/wait bookkeeping (`handler/wait.rs`): children + the file
+        # wait4 blocks on; parent links back for getppid
+        self.children: list["ManagedSimProcess"] = []
+        self.parent: Optional["ManagedSimProcess"] = None
+        self.reaped = False
+        self.child_waiter = StatefulFile()
+        self._exit_code: Optional[int] = None
         # Serializes IPC close/free between the worker thread (cleanup) and
         # the ChildPidWatcher thread (death callback): the callback must
         # never touch a freed shmem mapping.
         self._ipc_lock = threading.Lock()
         host.processes.append(self)
+
+    def __init__(self, host, name: str, argv: list[str],
+                 output_dir: Optional[str] = None):
+        self._init_common(host, name, argv, output_dir)
+        self.state = ProcessState.PENDING
+        # the simulated-kernel dispatch table (network, readiness, sleep)
+        self.handler = SyscallHandler(self)
+
+    @classmethod
+    def forked(cls, parent: "ManagedSimProcess") -> "ManagedSimProcess":
+        """The simulator-side half of fork(2): a child process object that
+        shares the parent's open files through a forked descriptor table.
+        The native child is created by the parent's shim; `_finish_fork`
+        wires its pid in once the clone returns."""
+        self = cls.__new__(cls)
+        self._init_common(parent.host,
+                          f"{parent.name}.fork{len(parent.children)}",
+                          parent.argv)
+        self.state = ProcessState.RUNNING  # the native child exists shortly
+        self.handler = SyscallHandler(
+            self, table=parent.handler._table.fork_into())
+        # fast path stays disabled (proc_clock None): the clock block would
+        # be shared with the parent
+        self.ipc = IpcChannel.create()
+        self.threads = [ManagedThread(self, self.ipc, is_main=True)]
+        self.parent = parent
+        parent.children.append(self)
+        return self
+
+    def _abort_fork(self) -> None:
+        """The native fork failed: erase the phantom child entirely —
+        release the forked descriptor references (or the parent's sockets
+        would never close) and disappear from all bookkeeping."""
+        if self.parent is not None and self in self.parent.children:
+            self.parent.children.remove(self)
+        if self in self.host.processes:
+            self.host.processes.remove(self)
+        self.state = ProcessState.KILLED
+        self.kill_signal = 9
+        self._close_descriptors()
+        self._cleanup()
+
+    def _finish_fork(self, native_pid: int) -> None:
+        """Parent's ADD_THREAD_RES arrived: the native child exists."""
+        self.server.mem = MemoryCopier(native_pid)
+        self.server.native_pid = native_pid
+        self.threads[0].native_tid = native_pid
+        from .pidwatcher import get_watcher
+
+        get_watcher().watch(native_pid, self._on_child_death)
+        self.host.schedule_task_with_delay(
+            TaskRef(lambda h: self._start_thread(self.threads[0]),
+                    "fork-child-start"), 0,
+        )
 
     @property
     def is_alive(self) -> bool:
@@ -332,6 +443,7 @@ class ManagedSimProcess:
 
             interpose.build()
         self.ipc = IpcChannel.create()
+        self.threads = [ManagedThread(self, self.ipc, is_main=True)]
         env = dict(os.environ)
         preload = env.get("LD_PRELOAD", "")
         env["LD_PRELOAD"] = _preload_chain() + (" " + preload if preload else "")
@@ -370,40 +482,62 @@ class ManagedSimProcess:
         from .pidwatcher import get_watcher
 
         get_watcher().watch(self.proc.pid, self._on_child_death)
-        self._resume()
+        self._resume(self.threads[0])
 
     def stop(self, signal_nr: int = 15) -> None:
-        if self.state != ProcessState.RUNNING or self.proc is None:
+        if self.state != ProcessState.RUNNING:
             return
-        self.proc.send_signal(signal_nr)
-        try:
-            self.proc.wait(timeout=5)
-        except subprocess.TimeoutExpired:
-            self.proc.kill()
-            self.proc.wait(timeout=5)
+        if self.proc is not None:
+            self.proc.send_signal(signal_nr)
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=5)
+        elif self.server.native_pid is not None:
+            # forked child: not our native child — signal by pid; its
+            # native parent (the managed parent) reaps or abandons the
+            # zombie, which the kernel collects at that parent's exit
+            try:
+                os.kill(self.server.native_pid, signal_nr)
+            except (ProcessLookupError, PermissionError):
+                pass
         self.state = ProcessState.KILLED
         self.kill_signal = signal_nr
-        if self._parked_condition is not None:
-            cond, self._parked_condition = self._parked_condition, None
-            cond.cancel()
+        self._abort_pending_clone()
+        self._cancel_all_parks()
         self._close_descriptors()
         self._cleanup()
+        self._notify_parent()
+
+    def _cancel_all_parks(self) -> None:
+        for t in self.threads:
+            if t.parked_condition is not None:
+                cond, t.parked_condition = t.parked_condition, None
+                cond.cancel()
 
     # -- the inline resume loop ----------------------------------------
 
-    def _resume(self) -> None:
-        """Service the plugin until it blocks or dies (runs on the worker
-        thread currently executing this host, like the reference
-        `managed_thread.rs:185-322` resume loop)."""
+    def _resume(self, thread: ManagedThread) -> None:
+        """Service ONE managed thread until it blocks, exits, or dies (runs
+        on the worker thread currently executing this host, like the
+        reference `managed_thread.rs:185-322` resume loop)."""
         while True:
-            ev = self.ipc.recv_from_shim()
+            ev = thread.ipc.recv_from_shim()
             if ev is None:
                 self._reap()
                 return
             if ev.kind == EVENT_START_RES:
+                if thread.native_tid is None:
+                    thread.native_tid = int(
+                        ev.u.add_thread_res.child_native_tid)
                 continue
             if ev.kind == EVENT_PROCESS_DEATH:
                 self._death_seen = True
+                continue
+            if ev.kind == EVENT_ADD_THREAD_RES:
+                self._finish_clone(
+                    thread, int(ev.u.add_thread_res.child_native_tid))
                 continue
             if ev.kind != EVENT_SYSCALL:
                 continue
@@ -411,20 +545,232 @@ class ManagedSimProcess:
             args = [int(ev.u.syscall.args[i]) for i in range(6)]
 
             if nr == SYS_exit_group:
-                # close simulated descriptors (FINs go out, ports free) and
-                # let the exit run natively
-                self._close_descriptors()
-                self._reply_native()
-                self._reap()
+                self._handle_exit_group(thread, args)
                 return
+            if nr == SYS_exit:
+                if self._handle_thread_exit(thread, args):
+                    return  # thread (or process) left the running set
+                continue
+            if nr == SYS_clone and (args[0] & CLONE_VM):
+                self._begin_clone_thread(thread, args)
+                continue  # next recv: ADD_THREAD_RES from the parent shim
+            if nr in (SYS_fork, SYS_clone):
+                self._begin_fork(thread, nr, args)
+                continue
 
-            if self._handle_syscall_event(nr, args):
+            if self._handle_syscall_event(thread, nr, args):
                 return  # parked on a condition; no reply yet
 
-    def _handle_syscall_event(self, nr: int, args, wake=None) -> bool:
-        """Dispatch one trapped syscall. Returns True when the process
+    # -- clone / fork handshakes ----------------------------------------
+
+    def _begin_clone_thread(self, thread: ManagedThread, args) -> None:
+        """Reply ADD_THREAD_REQ with a fresh channel; the shim runs the
+        native clone + trampoline (`managed_thread.rs:349-428`)."""
+        child_ipc = IpcChannel.create()
+        child = ManagedThread(self, child_ipc)
+        if args[0] & CLONE_CHILD_CLEARTID:
+            child.ctid_addr = args[3]
+        with self._ipc_lock:  # threads is read by the death watcher
+            self.threads.append(child)
+        self._pending_clone = child
+        reply = ShimEvent()
+        reply.kind = EVENT_ADD_THREAD_REQ
+        reply.u.add_thread_req.ipc_handle = child_ipc.block.serialize().encode()
+        self._publish_clock()
+        try:
+            thread.ipc.send_to_shim(reply)
+        except OSError:
+            pass
+
+    def _begin_fork(self, thread: ManagedThread, nr: int, args) -> None:
+        child = ManagedSimProcess.forked(self)
+        self._pending_clone = child
+        reply = ShimEvent()
+        reply.kind = EVENT_ADD_THREAD_REQ
+        reply.u.add_thread_req.ipc_handle = child.ipc.block.serialize().encode()
+        self._publish_clock()
+        try:
+            thread.ipc.send_to_shim(reply)
+        except OSError:
+            pass
+
+    def _finish_clone(self, thread: ManagedThread, native_tid: int) -> None:
+        pending, self._pending_clone = self._pending_clone, None
+        if pending is None:
+            self._reply_complete(thread, -kerrors.EINVAL)
+            return
+        if isinstance(pending, ManagedThread):
+            if native_tid < 0:  # native clone failed
+                with self._ipc_lock:  # vs the death watcher's close sweep
+                    self.threads.remove(pending)
+                    pending.ipc.close()
+                    pending.ipc.block.free()
+                    pending.ipc = None
+                self._reply_complete(thread, native_tid)
+                return
+            pending.native_tid = native_tid
+            self.host.schedule_task_with_delay(
+                TaskRef(lambda h, c=pending: self._start_thread(c),
+                        "thread-start"), 0,
+            )
+            # native tids stay visible to the app (glibc already stored
+            # this value in its pthread struct via CLONE_PARENT_SETTID)
+            self._reply_complete(thread, native_tid)
+        else:  # forked child process
+            if native_tid < 0:
+                pending._abort_fork()
+                self._reply_complete(thread, native_tid)
+                return
+            pending._finish_fork(native_tid)
+            # the app sees the VIRTUAL child pid (wait4/kill use it)
+            self._reply_complete(thread, pending.pid)
+
+    def _start_thread(self, child: ManagedThread) -> None:
+        """Host task: first resume of a cloned thread (or forked child's
+        main thread) — consume its START_RES, send the go-ahead, serve.
+
+        The cloned thread may die before the rendezvous (`shim_clone_child`
+        exits if attach fails) — only the THREAD dies, so the process
+        watcher never closes this channel and a plain blocking recv would
+        hang the whole simulation. Recv in bounded slices and check the
+        native task's liveness on each timeout."""
+        if child.dead or self.state != ProcessState.RUNNING:
+            return
+        while True:
+            try:
+                ev = child.ipc.recv_from_shim_timed(50_000_000)  # START_RES
+                break
+            except TimeoutError:
+                if self._native_task_running(child.native_tid):
+                    continue
+                log.warning("cloned thread %s of %r died before rendezvous",
+                            child.native_tid, self.name)
+                with self._ipc_lock:
+                    child.dead = True
+                    if child.ipc is not None:
+                        child.ipc.close()
+                        child.ipc.block.free()
+                        child.ipc = None
+                return
+        if ev is None:
+            self._reap()
+            return
+        self._reply_complete(child, 0)  # the go-ahead
+        self._resume(child)
+
+    # -- exits -----------------------------------------------------------
+
+    def _handle_exit_group(self, thread: ManagedThread, args) -> None:
+        """exit_group: close simulated descriptors (FINs go out, ports
+        free), record the exit code, and let the native exit run."""
+        self._exit_code = _i32_exit(args[0])
+        for t in self.threads:
+            if t is not thread:
+                self._thread_cleartid(t)
+            t.dead = True
+        self._cancel_all_parks()
+        self._close_descriptors()
+        self._reply_native(thread)
+        self._reap()
+
+    def _handle_thread_exit(self, thread: ManagedThread, args) -> bool:
+        """SYS_exit: one thread leaves. Returns True when the caller's
+        resume loop should stop (always — the thread is gone; if it was the
+        last one the process is reaped)."""
+        thread.dead = True
+        self._reply_native(thread)
+        # The emulated cleartid wake must not fire before the native thread
+        # is really gone: a woken joiner may free the dying thread's stack
+        # (glibc __nptl_free_tcb) while it is still running. Zombie-wait on
+        # /proc like the reference (`managed_thread.rs:481-531`); exited
+        # non-leader threads are auto-reaped, so the task dir vanishing is
+        # the all-clear.
+        self._wait_native_thread_gone(thread)
+        self._thread_cleartid(thread)
+        # Release the dead thread's channel NOW, not at process teardown: a
+        # server cloning one thread per request would otherwise accumulate
+        # one shmem block + one ManagedThread record per request for the
+        # whole simulation.
+        with self._ipc_lock:
+            if thread.ipc is not None and thread.ipc is not self.ipc:
+                thread.ipc.close()
+                thread.ipc.block.free()
+                thread.ipc = None
+            if not thread.is_main:
+                self.threads.remove(thread)
+        if all(t.dead for t in self.threads):
+            self._exit_code = _i32_exit(args[0])
+            self._close_descriptors()
+            self._reap()
+        return True
+
+    def _wait_native_thread_gone(self, thread: ManagedThread,
+                                 timeout_s: float = 2.0) -> None:
+        tid = thread.native_tid
+        if not self.server.native_pid or not tid:
+            return
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while self._native_task_running(tid):
+            if _time.monotonic() > deadline:
+                log.warning("thread %d of %r did not exit within %ss",
+                            tid, self.name, timeout_s)
+                return
+            _time.sleep(0.00005)
+
+    @staticmethod
+    def _proc_stat_fields(pid: int, tid: Optional[int] = None) \
+            -> Optional[list[bytes]]:
+        """/proc/<pid>[/task/<tid>]/stat fields AFTER the parenthesized
+        comm (i.e. index 0 = state, stat field 3), or None when the entry
+        is gone/unreadable. rsplit on ')' survives a comm containing
+        parentheses."""
+        path = (f"/proc/{pid}/task/{tid}/stat" if tid is not None
+                else f"/proc/{pid}/stat")
+        try:
+            with open(path, "rb") as f:
+                fields = f.read().rsplit(b")", 1)[1].split()
+            return fields or None
+        except (OSError, IndexError):
+            return None
+
+    def _native_task_running(self, tid: Optional[int]) -> bool:
+        """Whether the native task may still be executing user code. Gone =
+        its /proc task entry vanished (exited non-leader threads are
+        auto-reaped) OR it parks as a zombie — a thread-group leader's
+        entry lingers in Z state until the whole group exits, and a zombie
+        runs no more user code, so waiting on the entry itself would spin
+        out the full timeout on every leader pthread_exit."""
+        pid = self.server.native_pid
+        if not pid or not tid:
+            return False
+        fields = self._proc_stat_fields(pid, tid)
+        return fields is not None and fields[0] not in (b"Z", b"X")
+
+    def _thread_cleartid(self, thread: ManagedThread) -> None:
+        """CLONE_CHILD_CLEARTID contract against the EMULATED futex: write
+        0 to the ctid word and wake its waiters (pthread_join blocks
+        there). The kernel's native clear/wake happens too, but only our
+        wake reaches simulated waiters (`thread.rs` handle_child_cleartid).
+        """
+        if not thread.ctid_addr:
+            return
+        try:
+            self.server.mem.write(thread.ctid_addr, struct.pack("<i", 0))
+        except OSError:
+            pass  # address space already gone
+        self.handler.futexes.wake(thread.ctid_addr, 2**31)
+        thread.ctid_addr = 0
+
+    # -- syscall dispatch ------------------------------------------------
+
+    def _handle_syscall_event(self, thread: ManagedThread, nr: int, args,
+                              wake=None) -> bool:
+        """Dispatch one trapped syscall. Returns True when the thread
         parked (the shim gets its reply when the condition fires)."""
-        ctx = DispatchCtx(wake, self._park_deadline if wake else None)
+        ctx = DispatchCtx(wake, thread.park_deadline if wake else None,
+                          thread)
         try:
             ret = self.handler.dispatch(nr, args, ctx)
         except NativeSyscall:
@@ -435,15 +781,15 @@ class ManagedSimProcess:
             except OSError:
                 ret2 = None  # memory gone (racing exit): run it natively
             if ret2 is None:
-                self._reply_native()
+                self._reply_native(thread)
             else:
-                self._reply_complete(ret2)
+                self._reply_complete(thread, ret2)
             return False
         except kerrors.SyscallError as e:
-            self._reply_complete(-e.errno)
+            self._reply_complete(thread, -e.errno)
             return False
         except kerrors.Blocked as b:
-            self._park(nr, args, b)
+            self._park(thread, nr, args, b)
             return True
         except OSError:
             # A process_vm read/write failed mid-handler. For a live
@@ -453,21 +799,21 @@ class ManagedSimProcess:
             # gone and the reply lands nowhere anyway.
             import errno as _errno
 
-            self._reply_complete(-_errno.EFAULT)
+            self._reply_complete(thread, -_errno.EFAULT)
             return False
-        self._reply_complete(ret)
+        self._reply_complete(thread, ret)
         return False
 
-    def _park(self, nr: int, args, blocked) -> None:
+    def _park(self, thread: ManagedThread, nr: int, args, blocked) -> None:
         """Arm a SysCallCondition for a blocked syscall; the shim stays in
         recv until the wakeup re-dispatches and replies."""
         timeout_at = None
         if blocked.timeout_ns is not None:
             timeout_at = self.host.now() + blocked.timeout_ns
-        self._park_deadline = timeout_at
+        thread.park_deadline = timeout_at
 
-        def wakeup(reason, nr=nr, args=tuple(args)):
-            self._unpark(nr, list(args), reason)
+        def wakeup(reason, thread=thread, nr=nr, args=tuple(args)):
+            self._unpark(thread, nr, list(args), reason)
 
         cond = SysCallCondition(
             self.host,
@@ -476,17 +822,19 @@ class ManagedSimProcess:
             timeout_at_ns=timeout_at,
             wakeup=wakeup,
         )
-        self._parked_condition = cond
+        thread.parked_condition = cond
         cond.arm()
 
-    def _unpark(self, nr: int, args, reason: str) -> None:
-        self._parked_condition = None
-        if self.state != ProcessState.RUNNING or reason == "cancel":
+    def _unpark(self, thread: ManagedThread, nr: int, args,
+                reason: str) -> None:
+        thread.parked_condition = None
+        if self.state != ProcessState.RUNNING or thread.dead \
+                or reason == "cancel":
             return
         # a parked poll/select holds a transient wait-epoll; release it
-        self.handler._drop_wait_epoll()
-        if not self._handle_syscall_event(nr, args, wake=reason):
-            self._resume()
+        self.handler._drop_wait_epoll(thread)
+        if not self._handle_syscall_event(thread, nr, args, wake=reason):
+            self._resume(thread)
 
     def _close_descriptors(self) -> None:
         try:
@@ -514,50 +862,50 @@ class ManagedSimProcess:
         round_end = getattr(worker, "round_end_time", 0) or self.host.now()
         self.proc_clock.publish(self.host.now(), round_end)
 
-    def _reply_complete(self, retval: int) -> None:
+    def _reply_complete(self, thread: ManagedThread, retval: int) -> None:
         self._publish_clock()
         reply = ShimEvent()
         reply.kind = EVENT_SYSCALL_COMPLETE
         reply.u.complete.retval = retval
         reply.u.complete.restartable = 1
         try:
-            self.ipc.send_to_shim(reply)
+            thread.ipc.send_to_shim(reply)
         except OSError:
             pass
 
-    def _reply_native(self) -> None:
+    def _reply_native(self, thread: ManagedThread) -> None:
         self._publish_clock()
         reply = ShimEvent()
         reply.kind = EVENT_SYSCALL_DO_NATIVE
         try:
-            self.ipc.send_to_shim(reply)
+            thread.ipc.send_to_shim(reply)
         except OSError:
             pass
 
     def _on_child_death(self) -> None:
-        """Watcher-thread callback: the child died. Close the channel
-        writers (never free — the worker thread may be mid-recv on the
-        mapping) so any blocked recv_from_shim returns None, and post a
-        reap task for the case where nobody is in recv at all: a process
-        parked on an untimed condition (blocking recv/accept) would
-        otherwise stay RUNNING forever, its sockets never sending FIN."""
+        """Watcher-thread callback: the native process died. Close every
+        thread channel's writer (never free — the worker thread may be
+        mid-recv on the mapping) so any blocked recv_from_shim returns
+        None, and post a reap task for the case where nobody is in recv at
+        all: a thread parked on an untimed condition (blocking recv/accept)
+        would otherwise stay RUNNING forever, its sockets never sending
+        FIN."""
         with self._ipc_lock:
-            if self.ipc is not None:
-                self.ipc.close()
+            for t in self.threads:
+                if t.ipc is not None:
+                    t.ipc.close()
         self.host.post_cross_thread_task(
             TaskRef(lambda h: self._reap_if_parked(), "managed-death-reap")
         )
 
     def _reap_if_parked(self) -> None:
-        """Worker-thread task: reap a child that died while parked. If the
-        death was already observed (via recv returning None), this is a
-        no-op."""
+        """Worker-thread task: reap a process that died while its threads
+        were parked. If the death was already observed (via recv returning
+        None), this is a no-op."""
         if self.state != ProcessState.RUNNING:
             return
-        if self._parked_condition is not None:
-            # drop the condition; if it fires later, _unpark's state check
-            # discards the wakeup
-            self._parked_condition = None
+        for t in self.threads:
+            t.parked_condition = None
         self._reap()
 
     def reap_if_native_dead(self) -> None:
@@ -565,35 +913,119 @@ class ManagedSimProcess:
         so close to simulation end that the watcher's posted reap task
         never got a round boundary to drain into must still be reaped, or
         the final-state check would report a dead process as running."""
-        if self.state == ProcessState.RUNNING and self.proc is not None \
-                and self.proc.poll() is not None:
+        if self.state != ProcessState.RUNNING:
+            return
+        if self.proc is not None and self.proc.poll() is not None:
+            self._reap_if_parked()
+        elif self.proc is None and self._death_seen_natively():
             self._reap_if_parked()
 
-    def _reap(self) -> None:
+    def _native_term_signal(self) -> Optional[int]:
+        """Forked child killed by a signal: no exit_group was trapped and
+        it is not waitpid-able from here (its native parent is the managed
+        parent process), but the zombie's waitpid-style exit code is
+        /proc/<pid>/stat field 52 — readable since the simulator has
+        ptrace access to its descendants."""
+        pid = self.server.native_pid
+        if pid is None:
+            return None
+        fields = self._proc_stat_fields(pid)
+        if fields is None or len(fields) < 50:
+            return None
         try:
-            self.exit_status = self.proc.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            self.proc.kill()
-            self.exit_status = self.proc.wait(timeout=5)
+            code = int(fields[49])  # stat field 52: waitpid-style exit code
+        except ValueError:
+            return None
+        return os.WTERMSIG(code) if os.WIFSIGNALED(code) else None
+
+    def _death_seen_natively(self) -> bool:
+        """Forked children are not our native children (their native
+        parent is the managed parent process, which never native-waits),
+        so a dead one lingers as a ZOMBIE — kill(pid, 0) still succeeds on
+        those. Read the /proc state instead."""
+        pid = self.server.native_pid
+        if pid is None:
+            return False
+        fields = self._proc_stat_fields(pid)
+        return fields is None or fields[0] in (b"Z", b"X")
+
+    def _abort_pending_clone(self) -> None:
+        """The process died between ADD_THREAD_REQ and ADD_THREAD_RES: the
+        pending half-born thread (or forked-child process object) must not
+        outlive it — a phantom forked child would sit RUNNING forever (it
+        has no native pid for liveness sweeps to notice) and leak its IPC
+        shmem block."""
+        pending, self._pending_clone = self._pending_clone, None
+        if pending is None:
+            return
+        if isinstance(pending, ManagedThread):
+            with self._ipc_lock:
+                if pending in self.threads:
+                    self.threads.remove(pending)
+                pending.dead = True
+                if pending.ipc is not None:
+                    pending.ipc.close()
+                    pending.ipc.block.free()
+                    pending.ipc = None
+        else:
+            pending._abort_fork()
+
+    def _reap(self) -> None:
+        if self.state not in (ProcessState.PENDING, ProcessState.RUNNING):
+            return  # already reaped
+        self._abort_pending_clone()
+        if self.proc is not None:
+            try:
+                self.exit_status = self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.exit_status = self.proc.wait(timeout=5)
+        else:
+            # forked child: not waitpid-able from the simulator (its native
+            # parent is the managed parent); the exit code was captured at
+            # exit_group, signal deaths surface as None
+            self.exit_status = self._exit_code
         if self.exit_status is not None and self.exit_status < 0:
             # died to an unhandled signal (SIGKILL, SIGSEGV, ...)
             self.state = ProcessState.KILLED
             self.kill_signal = -self.exit_status
+        elif self.proc is None and self._exit_code is None:
+            self.state = ProcessState.KILLED
+            self.kill_signal = self._native_term_signal() or 9
         else:
             self.state = ProcessState.EXITED
+        for t in self.threads:
+            t.dead = True
         self._close_descriptors()
         self._cleanup()
+        self._notify_parent()
+
+    def _notify_parent(self) -> None:
+        """Wake the parent's wait4 (`handler/wait.rs`): pulse the
+        CHILD_EVENTS bit so parked conditions fire OFF_TO_ON."""
+        p = self.parent
+        if p is None or not p.is_alive:
+            return
+        p.child_waiter.update_state(FileState.CHILD_EVENTS,
+                                    FileState.CHILD_EVENTS)
+        p.child_waiter.update_state(FileState.CHILD_EVENTS, FileState.NONE)
 
     def _cleanup(self) -> None:
         if self.proc is not None:
             from .pidwatcher import get_watcher
 
             get_watcher().unwatch(self.proc.pid)
+        elif self.server.native_pid is not None:
+            from .pidwatcher import get_watcher
+
+            get_watcher().unwatch(self.server.native_pid)
         with self._ipc_lock:
-            if self.ipc is not None:
-                self.ipc.close()
-                self.ipc.block.free()
-                self.ipc = None
+            for t in self.threads:
+                if t.ipc is not None:
+                    t.ipc.close()
+                    t.ipc.block.free()
+                    t.ipc = None
+            self.ipc = None
         if self.proc_clock is not None:
             self.proc_clock.free()
             self.proc_clock = None

@@ -36,11 +36,15 @@ from typing import Optional
 
 from ..core import simtime
 from ..kernel import errors
+from ..kernel import futex as kfutex
 from ..kernel.descriptor import DescriptorTable
 from ..kernel.epoll import Epoll, EpollEvents
+from ..kernel.eventfd import EventFd
+from ..kernel.pipe import PipeReader, PipeWriter, make_pipe
 from ..kernel.socket.tcp import TcpSocket
 from ..kernel.socket.udp import UdpSocket
 from ..kernel.status import FileState
+from ..kernel.timerfd import TimerFd
 
 # ---------------------------------------------------------------------------
 # x86_64 syscall numbers (the emulated subset)
@@ -50,6 +54,29 @@ SYS_write = 1
 SYS_close = 3
 SYS_fstat = 5
 SYS_poll = 7
+SYS_pipe = 22
+SYS_sched_yield = 24
+SYS_wait4 = 61
+SYS_kill = 62
+SYS_uname = 63
+SYS_sysinfo = 99
+SYS_getppid = 110
+SYS_tkill = 200
+SYS_futex = 202
+SYS_sched_getaffinity = 204
+SYS_set_tid_address = 218
+SYS_tgkill = 234
+SYS_waitid = 247
+SYS_set_robust_list = 273
+SYS_timerfd_create = 283
+SYS_eventfd = 284
+SYS_timerfd_settime = 286
+SYS_timerfd_gettime = 287
+SYS_eventfd2 = 290
+SYS_pipe2 = 293
+SYS_getcpu = 309
+SYS_membarrier = 324
+SYS_clone3 = 435
 SYS_rt_sigaction = 13
 SYS_ioctl = 16
 SYS_readv = 19
@@ -120,6 +147,11 @@ FIONBIO = 0x5421
 
 SHUT_RD, SHUT_WR, SHUT_RDWR = 0, 1, 2
 
+O_CLOEXEC = 0o2000000
+EFD_SEMAPHORE = 1
+TFD_TIMER_ABSTIME = 1
+WNOHANG = 1
+
 # poll events
 POLLIN = 0x001
 POLLPRI = 0x002
@@ -148,15 +180,27 @@ class DispatchCtx:
     `wake` is None on first dispatch, else the condition-fire reason
     ("file" | "timeout"); `deadline` is the absolute sim-time the original
     call's timeout expires (None = untimed), fixed at first block so timed
-    waits don't restart their clock on every spurious wakeup.
+    waits don't restart their clock on every spurious wakeup. `thread` is
+    the managed thread issuing the call (None for single-context callers).
     """
 
-    __slots__ = ("wake", "deadline")
+    __slots__ = ("wake", "deadline", "thread")
 
     def __init__(self, wake: Optional[str] = None,
-                 deadline: Optional[int] = None):
+                 deadline: Optional[int] = None, thread=None):
         self.wake = wake
         self.deadline = deadline
+        self.thread = thread
+
+
+_libc = ctypes.CDLL(None, use_errno=True)
+
+
+def _libc_syscall(nr: int, *args: int) -> int:
+    rc = _libc.syscall(ctypes.c_long(nr), *(ctypes.c_long(a) for a in args))
+    if rc < 0:
+        return -ctypes.get_errno()
+    return rc
 
 
 def _i32(v: int) -> int:
@@ -172,13 +216,17 @@ class SyscallHandler:
 
     VFD_BASE = 700  # above real fds, below FD_SETSIZE
 
-    def __init__(self, process):
+    def __init__(self, process, table: Optional[DescriptorTable] = None):
         self.process = process
         self.host = process.host
-        # fd -> simulated file; offset table keeps vfds in our range
-        self._table = DescriptorTable()
-        # the one transient wait-epoll a parked poll/select holds
+        # fd -> simulated file; offset table keeps vfds in our range.
+        # fork passes the parent table's fork_into() clone.
+        self._table = table if table is not None else DescriptorTable()
+        # the one transient wait-epoll a parked poll/select holds (fallback
+        # slot for single-context callers; threads park on their own)
         self._wait_epoll: Optional[Epoll] = None
+        # emulated futexes, shared by all threads of the process
+        self.futexes = kfutex.FutexTable()
         # per-syscall dispatch tally for sim-stats (first dispatches only;
         # condition-wakeup re-dispatches of the same call don't re-count)
         self.syscall_counts: dict[int, int] = {}
@@ -217,8 +265,11 @@ class SyscallHandler:
         self._table.close_all()
         self._drop_wait_epoll()
 
-    def _drop_wait_epoll(self) -> None:
-        if self._wait_epoll is not None:
+    def _drop_wait_epoll(self, thread=None) -> None:
+        if thread is not None and getattr(thread, "wait_epoll", None) is not None:
+            thread.wait_epoll.close()
+            thread.wait_epoll = None
+        if thread is None and self._wait_epoll is not None:
             self._wait_epoll.close()  # removes its listeners
             self._wait_epoll = None
 
@@ -429,8 +480,27 @@ class SyscallHandler:
         return got
 
     def _sys_read(self, args, ctx) -> int:
-        sock = self._file(args[0])
-        got, _src = self._recv_common(sock, args[1], args[2], 0, False)
+        file = self._file(args[0])
+        if isinstance(file, EventFd):
+            if args[2] < 8:
+                raise errors.SyscallError(errors.EINVAL)
+            value = file.read_value()
+            self.mem.write(args[1], struct.pack("<Q", value))
+            return 8
+        if isinstance(file, TimerFd):
+            if args[2] < 8:
+                raise errors.SyscallError(errors.EINVAL)
+            n = file.read_expirations()
+            self.mem.write(args[1], struct.pack("<Q", n))
+            return 8
+        if isinstance(file, PipeReader):
+            data = file.recv(args[2])
+            if data:
+                self.mem.write(args[1], data)
+            return len(data)
+        if isinstance(file, (PipeWriter, Epoll)):
+            raise errors.SyscallError(errors.EBADF)
+        got, _src = self._recv_common(file, args[1], args[2], 0, False)
         return got
 
     def _sys_readv(self, args, ctx) -> int:
@@ -461,9 +531,17 @@ class SyscallHandler:
             sock.nonblocking = saved
 
     def _sys_write(self, args, ctx) -> int:
-        sock = self._file(args[0])
+        file = self._file(args[0])
+        if isinstance(file, EventFd):
+            if args[2] < 8:
+                raise errors.SyscallError(errors.EINVAL)
+            (value,) = struct.unpack("<Q", self.mem.read(args[1], 8))
+            file.write_value(value)
+            return 8
+        if isinstance(file, (TimerFd, PipeReader, Epoll)):
+            raise errors.SyscallError(errors.EBADF)
         data = self.mem.read(args[1], args[2]) if args[2] else b""
-        return sock.send(data)
+        return file.send(data)
 
     def _sys_writev(self, args, ctx) -> int:
         sock = self._file(args[0])
@@ -636,7 +714,7 @@ class SyscallHandler:
         return r & (events | POLLERR | POLLHUP | POLLNVAL)
 
     def _block_on_files(self, entries: list[tuple[int, int]],
-                        timeout_ns: Optional[int]):
+                        timeout_ns: Optional[int], ctx=None):
         """Arm a transient epoll over (fd, poll-events) pairs and block on
         it (`handler/mod.rs:80-107` internal-epoll pattern)."""
         ep = Epoll()
@@ -652,7 +730,10 @@ class SyscallHandler:
                 ep.add(self._file(fd), interest)
             except errors.SyscallError:
                 pass
-        self._wait_epoll = ep
+        if ctx is not None and ctx.thread is not None:
+            ctx.thread.wait_epoll = ep
+        else:
+            self._wait_epoll = ep
         raise errors.Blocked(ep, FileState.READABLE, timeout_ns=timeout_ns)
 
     def _remaining(self, ctx: DispatchCtx,
@@ -689,7 +770,7 @@ class SyscallHandler:
             return 0
         self._block_on_files(
             [(fd, ev) for fd, ev in entries if fd >= 0],
-            self._remaining(ctx, timeout_ns),
+            self._remaining(ctx, timeout_ns), ctx,
         )
 
     def _sys_ppoll(self, args, ctx) -> int:
@@ -748,7 +829,7 @@ class SyscallHandler:
                     self.mem.write(ptr, bytes(out))
             return ready_fds
         self._block_on_files(list(entries.items()),
-                             self._remaining(ctx, timeout_ns))
+                             self._remaining(ctx, timeout_ns), ctx)
 
     def _sys_pselect6(self, args, ctx) -> int:
         tsp = args[4]
@@ -900,6 +981,288 @@ class SyscallHandler:
         self.mem.write(bufp, bytes(out[:n]))
         return n
 
+    # -- pipes / eventfd / timerfd (`handler/{eventfd,timerfd}.rs`,
+    #    `descriptor/pipe.rs`) -------------------------------------------
+
+    def _sys_pipe(self, args, ctx, flags: int = 0) -> int:
+        r, w = make_pipe()
+        if flags & O_NONBLOCK:
+            r.nonblocking = w.nonblocking = True
+        cloexec = bool(flags & O_CLOEXEC)
+        rfd = self._vfd(r, cloexec)
+        wfd = self._vfd(w, cloexec)
+        self.mem.write(args[0], struct.pack("<ii", rfd, wfd))
+        return 0
+
+    def _sys_pipe2(self, args, ctx) -> int:
+        return self._sys_pipe(args, ctx, flags=_i32(args[1]))
+
+    def _sys_eventfd(self, args, ctx, flags: int = 0) -> int:
+        ev = EventFd(args[0] & 0xFFFFFFFF, semaphore=bool(flags & EFD_SEMAPHORE))
+        ev.nonblocking = bool(flags & O_NONBLOCK)
+        return self._vfd(ev, cloexec=bool(flags & O_CLOEXEC))
+
+    def _sys_eventfd2(self, args, ctx) -> int:
+        return self._sys_eventfd(args, ctx, flags=_i32(args[1]))
+
+    def _sys_timerfd_create(self, args, ctx) -> int:
+        clockid, flags = _i32(args[0]), _i32(args[1])
+        if clockid not in (0, 1, 7):  # REALTIME, MONOTONIC, BOOTTIME
+            raise errors.SyscallError(errors.EINVAL)
+        tfd = TimerFd(self.host)
+        tfd.clockid = clockid
+        tfd.nonblocking = bool(flags & O_NONBLOCK)
+        return self._vfd(tfd, cloexec=bool(flags & O_CLOEXEC))
+
+    def _read_itimerspec(self, addr: int) -> tuple[int, int]:
+        """(interval_ns, value_ns) from a struct itimerspec."""
+        isec, insec, vsec, vnsec = struct.unpack("<qqqq", self.mem.read(addr, 32))
+        return (isec * simtime.SECOND + insec, vsec * simtime.SECOND + vnsec)
+
+    def _write_itimerspec(self, addr: int, interval_ns: int,
+                          value_ns: Optional[int]) -> None:
+        v = value_ns or 0
+        self.mem.write(addr, struct.pack(
+            "<qqqq", interval_ns // simtime.SECOND, interval_ns % simtime.SECOND,
+            v // simtime.SECOND, v % simtime.SECOND))
+
+    def _sys_timerfd_settime(self, args, ctx) -> int:
+        tfd = self._file(args[0])
+        if not isinstance(tfd, TimerFd):
+            raise errors.SyscallError(errors.EINVAL)
+        flags = _i32(args[1])
+        interval_ns, value_ns = self._read_itimerspec(args[2])
+        if args[3]:
+            rem, old_int = tfd.gettime()
+            self._write_itimerspec(args[3], old_int, rem)
+        if value_ns and (flags & TFD_TIMER_ABSTIME):
+            # absolute REALTIME deadlines are relative to the emulated epoch
+            if getattr(tfd, "clockid", 1) == 0:
+                value_ns -= simtime.EMUTIME_SIMULATION_START_UNIX_NS
+            tfd.settime(max(1, value_ns), interval_ns, absolute=True)
+        else:
+            tfd.settime(value_ns, interval_ns, absolute=False)
+        return 0
+
+    def _sys_timerfd_gettime(self, args, ctx) -> int:
+        tfd = self._file(args[0])
+        if not isinstance(tfd, TimerFd):
+            raise errors.SyscallError(errors.EINVAL)
+        rem, interval = tfd.gettime()
+        self._write_itimerspec(args[1], interval, rem)
+        return 0
+
+    # -- futex (`futex.c`, `handler/futex.rs`) ---------------------------
+
+    def _sys_futex(self, args, ctx) -> int:
+        uaddr, op, val = args[0], _i32(args[1]), args[2] & 0xFFFFFFFF
+        cmd = op & kfutex.FUTEX_CMD_MASK
+        if cmd in (kfutex.FUTEX_WAIT, kfutex.FUTEX_WAIT_BITSET):
+            thread = ctx.thread
+            if ctx.wake == "file":
+                if thread is not None:
+                    thread.futex_waiter = None
+                return 0
+            if ctx.wake == "timeout":
+                w = thread.futex_waiter if thread is not None else None
+                if thread is not None:
+                    thread.futex_waiter = None
+                if w is not None:
+                    # a wake may have popped this waiter at the same sim
+                    # instant the timeout fired; the wake already counted
+                    # it, so losing it here would strand another waiter
+                    if w.state & FileState.FUTEX_WAKEUP:
+                        return 0
+                    self.futexes.remove_waiter(w)
+                return -errors.ETIMEDOUT
+            (cur,) = struct.unpack("<I", self.mem.read(uaddr, 4))
+            if cur != val:
+                return -errors.EAGAIN
+            timeout_ns = None
+            if args[3]:
+                sec, nsec = struct.unpack("<qq", self.mem.read(args[3], 16))
+                t = sec * simtime.SECOND + nsec
+                if cmd == kfutex.FUTEX_WAIT_BITSET:
+                    # absolute deadline; realtime clocks sit on the epoch
+                    now = (simtime.emulated_from_sim(self.host.now())
+                           if op & kfutex.FUTEX_CLOCK_REALTIME
+                           else self.host.now())
+                    t -= now
+                timeout_ns = max(0, t)
+            bitset = (args[5] & 0xFFFFFFFF
+                      if cmd == kfutex.FUTEX_WAIT_BITSET else kfutex.MATCH_ANY)
+            if bitset == 0:
+                raise errors.SyscallError(errors.EINVAL)
+            waiter = self.futexes.add_waiter(uaddr, bitset)
+            if thread is not None:
+                thread.futex_waiter = waiter
+            raise errors.Blocked(waiter, FileState.FUTEX_WAKEUP,
+                                 timeout_ns=timeout_ns)
+        if cmd in (kfutex.FUTEX_WAKE, kfutex.FUTEX_WAKE_BITSET):
+            bitset = (args[5] & 0xFFFFFFFF
+                      if cmd == kfutex.FUTEX_WAKE_BITSET else kfutex.MATCH_ANY)
+            if bitset == 0:
+                raise errors.SyscallError(errors.EINVAL)
+            return self.futexes.wake(uaddr, max(0, _i32(args[2])), bitset)
+        if cmd in (kfutex.FUTEX_REQUEUE, kfutex.FUTEX_CMP_REQUEUE):
+            if cmd == kfutex.FUTEX_CMP_REQUEUE:
+                (cur,) = struct.unpack("<I", self.mem.read(uaddr, 4))
+                if cur != (args[5] & 0xFFFFFFFF):
+                    return -errors.EAGAIN
+            woken, moved = self.futexes.requeue(
+                uaddr, max(0, _i32(args[2])), args[4], max(0, _i32(args[3]))
+            )
+            # CMP_REQUEUE returns woken+requeued; plain REQUEUE only woken
+            return woken + moved if cmd == kfutex.FUTEX_CMP_REQUEUE else woken
+        raise errors.SyscallError(errors.ENOSYS)
+
+    # -- process family (`handler/{wait,clone,unistd}.rs`) ---------------
+
+    def _sys_wait4(self, args, ctx) -> int:
+        pid, options = _i64(args[0]), _i32(args[2])
+        proc = self.process
+        children = getattr(proc, "children", [])
+
+        def matches(c):
+            return pid in (-1, 0) or pid == c.pid
+
+        candidates = [c for c in children
+                      if matches(c) and not getattr(c, "reaped", False)]
+        if not candidates:
+            raise errors.SyscallError(errors.ECHILD)
+        for c in candidates:
+            if not c.is_alive:
+                c.reaped = True
+                if c.kill_signal is not None:
+                    status = c.kill_signal & 0x7F
+                else:
+                    status = ((c.exit_status or 0) & 0xFF) << 8
+                if args[1]:
+                    self.mem.write(args[1], struct.pack("<i", status))
+                return c.pid
+        if options & WNOHANG:
+            return 0
+        if ctx.wake == "timeout":
+            return 0
+        raise errors.Blocked(proc.child_waiter, FileState.CHILD_EVENTS)
+
+    def _sys_getppid(self, args, ctx) -> int:
+        parent = getattr(self.process, "parent", None)
+        if parent is not None and parent.is_alive:
+            return parent.pid
+        return 1
+
+    def _sys_kill_family(self, args, ctx, nr: int) -> int:
+        """kill/tkill/tgkill with virtual-pid translation: processes only
+        know virtual pids (`process.rs:1309`); native tids pass through
+        (this rebuild keeps thread ids native — see managed.py)."""
+        if nr == SYS_kill:
+            target, sig = _i64(args[0]), _i32(args[1])
+            native = self._native_pid_for(target)
+            if native is None:
+                raise errors.SyscallError(errors.ESRCH)
+            try:
+                import os as _os
+
+                _os.kill(native, sig)
+            except ProcessLookupError:
+                raise errors.SyscallError(errors.ESRCH) from None
+            except PermissionError:
+                raise errors.SyscallError(errors.EPERM) from None
+            return 0
+        if nr == SYS_tgkill:
+            tgid, tid, sig = _i64(args[0]), _i64(args[1]), _i32(args[2])
+            native = self._native_pid_for(tgid)
+            if native is None:
+                raise errors.SyscallError(errors.ESRCH)
+            rc = _libc_syscall(SYS_tgkill, native, tid, sig)
+            if rc < 0:
+                raise errors.SyscallError(-rc)
+            return 0
+        # tkill: native tid, no pid translation needed
+        raise NativeSyscall()
+
+    def _native_pid_for(self, vpid: int) -> Optional[int]:
+        proc = self.process
+        if vpid in (proc.pid, 0, -proc.pid):
+            return proc.server.native_pid
+        for other in getattr(self.host, "processes", []):
+            if getattr(other, "pid", None) == abs(vpid) and other.is_alive:
+                return getattr(other.server, "native_pid", None) \
+                    if hasattr(other, "server") else None
+        return None
+
+    def _sys_kill(self, args, ctx) -> int:
+        return self._sys_kill_family(args, ctx, SYS_kill)
+
+    def _sys_tgkill(self, args, ctx) -> int:
+        return self._sys_kill_family(args, ctx, SYS_tgkill)
+
+    def _sys_set_tid_address(self, args, ctx) -> int:
+        if ctx.thread is not None:
+            ctx.thread.ctid_addr = args[0]
+            return ctx.thread.native_tid or 0
+        return 0
+
+    def _sys_set_robust_list(self, args, ctx) -> int:
+        return 0  # recorded nowhere: robust-futex death handling is native
+
+    # -- identity / topology (`handler/{sched,sysinfo,prctl}.rs`) --------
+
+    def _sys_uname(self, args, ctx) -> int:
+        """Deterministic utsname with the SIMULATED hostname
+        (`handler/uname` analogue; nodename comes from the host)."""
+
+        def field(s: str) -> bytes:
+            b = s.encode()[:64]
+            return b + b"\x00" * (65 - len(b))
+
+        name = getattr(self.host, "name", "shadow-host")
+        buf = (field("Linux") + field(name) + field("5.15.0-shadow")
+               + field("#1 SMP shadow_tpu") + field("x86_64") + field("(none)"))
+        self.mem.write(args[0], buf)
+        return 0
+
+    def _sys_sysinfo(self, args, ctx) -> int:
+        """Deterministic sysinfo: uptime = simulated seconds, fixed memory
+        figures (16 GiB total / 8 GiB free), zero load."""
+        buf = struct.pack(
+            "<q3Q6QHH4x2QI",
+            self.host.now() // simtime.SECOND,  # uptime
+            0, 0, 0,  # loads
+            16 << 30, 8 << 30, 0, 0, 0, 0,  # ram/swap
+            len(getattr(self.host, "processes", [])) or 1, 0,  # procs, pad
+            0, 0,  # high mem
+            1,  # mem_unit
+        ).ljust(112, b"\x00")
+        self.mem.write(args[0], buf)
+        return 0
+
+    def _sys_sched_yield(self, args, ctx) -> int:
+        return 0
+
+    def _sys_sched_getaffinity(self, args, ctx) -> int:
+        size = args[2]
+        if size < 8:
+            raise errors.SyscallError(errors.EINVAL)
+        # one deterministic CPU: runtimes size their pools predictably
+        self.mem.write(args[1], struct.pack("<Q", 1))
+        return 8
+
+    def _sys_getcpu(self, args, ctx) -> int:
+        if args[0]:
+            self.mem.write(args[0], struct.pack("<I", 0))
+        if args[1]:
+            self.mem.write(args[1], struct.pack("<I", 0))
+        return 0
+
+    def _sys_clone3(self, args, ctx) -> int:
+        # force glibc's fallback to classic clone, which the shim traps
+        raise errors.SyscallError(errors.ENOSYS)
+
+    def _sys_waitid(self, args, ctx) -> int:
+        raise errors.SyscallError(errors.ENOSYS)  # callers fall back to wait4
+
     # -- table ----------------------------------------------------------
 
     _HANDLERS = {
@@ -945,4 +1308,25 @@ class SyscallHandler:
         SYS_time: _sys_time_read,
         SYS_rt_sigaction: _sys_rt_sigaction,
         SYS_getrandom: _sys_getrandom,
+        SYS_pipe: _sys_pipe,
+        SYS_pipe2: _sys_pipe2,
+        SYS_eventfd: _sys_eventfd,
+        SYS_eventfd2: _sys_eventfd2,
+        SYS_timerfd_create: _sys_timerfd_create,
+        SYS_timerfd_settime: _sys_timerfd_settime,
+        SYS_timerfd_gettime: _sys_timerfd_gettime,
+        SYS_futex: _sys_futex,
+        SYS_wait4: _sys_wait4,
+        SYS_waitid: _sys_waitid,
+        SYS_getppid: _sys_getppid,
+        SYS_kill: _sys_kill,
+        SYS_tgkill: _sys_tgkill,
+        SYS_set_tid_address: _sys_set_tid_address,
+        SYS_set_robust_list: _sys_set_robust_list,
+        SYS_uname: _sys_uname,
+        SYS_sysinfo: _sys_sysinfo,
+        SYS_sched_yield: _sys_sched_yield,
+        SYS_sched_getaffinity: _sys_sched_getaffinity,
+        SYS_getcpu: _sys_getcpu,
+        SYS_clone3: _sys_clone3,
     }

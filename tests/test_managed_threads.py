@@ -1,0 +1,501 @@
+"""Managed multithreading + process family: pthreads (clone trampoline,
+per-thread IPC channels, emulated futex), fork (child process objects with
+forked descriptor tables), wait4, pipes, eventfd, timerfd, and uname — all
+exercised by REAL compiled binaries on the simulated network.
+
+Parity: reference `src/test/{threads,clone,futex,pipe,eventfd,timerfd,
+wait,unistd}` + `managed_thread.rs:349-428` (AddThread handshake) +
+`shim/src/clone.rs` (clone trampoline).
+"""
+
+import shutil
+import subprocess
+
+import pytest
+
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.manager import Manager
+
+CC = shutil.which("gcc") or shutil.which("cc")
+
+pytestmark = pytest.mark.skipif(CC is None, reason="no C compiler")
+
+
+def _compile(tmp_path, name: str, src: str, libs=("-pthread",)) -> str:
+    c = tmp_path / f"{name}.c"
+    c.write_text(src)
+    binary = tmp_path / name
+    subprocess.run([CC, "-O1", "-o", str(binary), str(c), *libs], check=True)
+    return str(binary)
+
+
+GRAPH = """
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        edge [ source 0 target 0 latency "5 ms" packet_loss 0.0 ]
+      ]
+"""
+
+THREADED_CLIENT_C = r"""
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <pthread.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+static const char *g_ip;
+static int g_port;
+static pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+static pthread_cond_t cv = PTHREAD_COND_INITIALIZER;
+static int done_count = 0;
+
+static void *worker(void *arg) {
+    long idx = (long)arg;
+    int s = socket(AF_INET, SOCK_STREAM, 0);
+    if (s < 0) return (void *)10;
+    struct sockaddr_in a;
+    memset(&a, 0, sizeof a);
+    a.sin_family = AF_INET;
+    a.sin_port = htons(g_port);
+    a.sin_addr.s_addr = inet_addr(g_ip);
+    if (connect(s, (struct sockaddr *)&a, sizeof a)) return (void *)11;
+    char msg[32], back[32];
+    memset(msg, 'a' + (int)idx, sizeof msg);
+    if (write(s, msg, sizeof msg) != (long)sizeof msg) return (void *)12;
+    long got = 0;
+    while (got < (long)sizeof back) {
+        long n = read(s, back + got, sizeof back - got);
+        if (n <= 0) return (void *)13;
+        got += n;
+    }
+    if (memcmp(msg, back, sizeof msg)) return (void *)14;
+    close(s);
+    pthread_mutex_lock(&mu);
+    done_count++;
+    pthread_cond_signal(&cv);
+    pthread_mutex_unlock(&mu);
+    return (void *)0;
+}
+
+int main(int argc, char **argv) {
+    g_ip = argv[1];
+    g_port = atoi(argv[2]);
+    pthread_t t1, t2;
+    if (pthread_create(&t1, 0, worker, (void *)1)) return 1;
+    if (pthread_create(&t2, 0, worker, (void *)2)) return 2;
+    /* condvar wait: emulated futex WAIT, woken by the workers' signals */
+    pthread_mutex_lock(&mu);
+    while (done_count < 2) pthread_cond_wait(&cv, &mu);
+    pthread_mutex_unlock(&mu);
+    /* join: emulated futex on the CLONE_CHILD_CLEARTID word */
+    void *r1 = 0, *r2 = 0;
+    if (pthread_join(t1, &r1)) return 3;
+    if (pthread_join(t2, &r2)) return 4;
+    if (r1 || r2) return 5;
+    return 0;
+}
+"""
+
+ECHO2_SERVER_C = r"""
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    int port = atoi(argv[1]);
+    int conns = atoi(argv[2]);
+    int ls = socket(AF_INET, SOCK_STREAM, 0);
+    if (ls < 0) return 20;
+    struct sockaddr_in a;
+    memset(&a, 0, sizeof a);
+    a.sin_family = AF_INET;
+    a.sin_port = htons(port);
+    a.sin_addr.s_addr = INADDR_ANY;
+    if (bind(ls, (struct sockaddr *)&a, sizeof a)) return 21;
+    if (listen(ls, 8)) return 22;
+    for (int i = 0; i < conns; i++) {
+        int c = accept(ls, 0, 0);
+        if (c < 0) return 23;
+        char buf[32];
+        long got = 0;
+        while (got < (long)sizeof buf) {
+            long n = read(c, buf + got, sizeof buf - got);
+            if (n <= 0) return 24;
+            got += n;
+        }
+        if (write(c, buf, sizeof buf) != (long)sizeof buf) return 25;
+        close(c);
+    }
+    close(ls);
+    return 0;
+}
+"""
+
+FORK_PIPE_C = r"""
+#include <errno.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+int main(void) {
+    int p[2];
+    if (pipe(p)) return 1;
+    pid_t pid = fork();
+    if (pid < 0) return 2;
+    if (pid == 0) {
+        close(p[0]);
+        if (write(p[1], "from-child", 10) != 10) _exit(3);
+        _exit(42);
+    }
+    if (pid == getpid()) return 8;
+    close(p[1]);
+    char buf[16];
+    long got = 0, n;
+    while ((n = read(p[0], buf + got, sizeof buf - got)) > 0) got += n;
+    if (got != 10 || memcmp(buf, "from-child", 10)) return 4;
+    int st = 0;
+    pid_t w = waitpid(pid, &st, 0);
+    if (w != pid) return 5;
+    if (!WIFEXITED(st)) return 6;
+    if (WEXITSTATUS(st) != 42) return 7;
+    /* drain loop: a second wait must see ECHILD, not block forever */
+    if (waitpid(-1, 0, 0) != -1 || errno != ECHILD) return 9;
+    return 0;
+}
+"""
+
+KERNEL_OBJECTS_C = r"""
+#include <string.h>
+#include <stdint.h>
+#include <sys/eventfd.h>
+#include <sys/timerfd.h>
+#include <sys/utsname.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(void) {
+    struct utsname u;
+    if (uname(&u)) return 1;
+    if (strcmp(u.nodename, "box")) return 2; /* the SIMULATED hostname */
+    int efd = eventfd(5, 0);
+    if (efd < 0) return 3;
+    uint64_t v = 0;
+    if (read(efd, &v, 8) != 8 || v != 5) return 4;
+    v = 7;
+    if (write(efd, &v, 8) != 8) return 5;
+    v = 0;
+    if (read(efd, &v, 8) != 8 || v != 7) return 6;
+    close(efd);
+
+    int tfd = timerfd_create(CLOCK_MONOTONIC, 0);
+    if (tfd < 0) return 7;
+    struct itimerspec its;
+    memset(&its, 0, sizeof its);
+    its.it_value.tv_nsec = 50 * 1000 * 1000; /* 50 ms */
+    struct timespec t0, t1;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    if (timerfd_settime(tfd, 0, &its, 0)) return 8;
+    if (read(tfd, &v, 8) != 8 || v != 1) return 9; /* blocks in SIM time */
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    long long d = (t1.tv_sec - t0.tv_sec) * 1000000000LL
+                  + (t1.tv_nsec - t0.tv_nsec);
+    if (d < 50 * 1000 * 1000) return 10; /* virtual clock must have moved */
+    close(tfd);
+    return 0;
+}
+"""
+
+
+def test_pthreads_sockets_futex_join(tmp_path):
+    """Two pthreads each run a TCP exchange over the simulated network;
+    the main thread blocks on a condvar (emulated futex) and then joins
+    both (emulated CLEARTID futex). VERDICT round-2 item #2's criterion."""
+    client = _compile(tmp_path, "threaded-client", THREADED_CLIENT_C)
+    server = _compile(tmp_path, "echo2-server", ECHO2_SERVER_C, libs=())
+    cfg = load_config_str(f"""
+general: {{stop_time: 30s, seed: 21}}
+network:
+  graph:
+    type: gml
+    inline: |
+{GRAPH}
+hosts:
+  server:
+    network_node_id: 0
+    ip_addr: 11.0.0.1
+    processes:
+    - {{path: {server}, args: ["7000", "2"], start_time: 1s,
+       expected_final_state: {{exited: 0}}}}
+  client:
+    network_node_id: 0
+    ip_addr: 11.0.0.2
+    processes:
+    - {{path: {client}, args: ["11.0.0.1", "7000"], start_time: 2s,
+       expected_final_state: {{exited: 0}}}}
+""")
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
+
+
+RAW_CLONE_C = r"""
+/* A thread created the way Go's runtime.newosproc does it: raw
+ * clone(CLONE_VM|CLONE_THREAD|...) WITHOUT CLONE_SETTLS, child jumps
+ * straight into a function that uses only raw syscalls. The child shares
+ * the parent's TLS, so the shim must route its syscalls by tid, not TLS
+ * -- a TLS'd shim would cross the two channels and hang the simulation. */
+#define _GNU_SOURCE
+#include <sched.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+static char child_stack[65536] __attribute__((aligned(64)));
+static int pipefd[2];
+
+static long rawsys3(long nr, long a, long b, long c) {
+    long ret;
+    __asm__ volatile("syscall"
+                     : "=a"(ret)
+                     : "a"(nr), "D"(a), "S"(b), "d"(c)
+                     : "rcx", "r11", "memory");
+    return ret;
+}
+
+static void child_main(void) {
+    rawsys3(SYS_write, pipefd[1], (long)"hi", 2);
+    rawsys3(SYS_exit, 0, 0, 0);
+    __builtin_unreachable();
+}
+
+int main(void) {
+    if (pipe(pipefd)) return 1;
+    long flags = CLONE_VM | CLONE_FS | CLONE_FILES | CLONE_SIGHAND
+                 | CLONE_THREAD;
+    long tid;
+    register long r10 __asm__("r10") = 0;
+    register long r8 __asm__("r8") = 0;
+    register void (*fn)(void) __asm__("rbx") = child_main;
+    __asm__ volatile(
+        "syscall\n\t"
+        "test %%rax, %%rax\n\t"
+        "jnz 1f\n\t"
+        "call *%%rbx\n\t" /* child: new stack, shared TLS */
+        "1:"
+        : "=a"(tid)
+        : "a"(SYS_clone), "D"(flags),
+          "S"((long)(child_stack + sizeof child_stack)), "d"(0), "r"(r10),
+          "r"(r8), "r"(fn)
+        : "rcx", "r11", "memory");
+    if (tid <= 0) return 2;
+    char buf[2];
+    long got = 0;
+    while (got < 2) { /* the parent's own syscalls must stay on ITS channel */
+        long n = read(pipefd[0], buf + got, 2 - got);
+        if (n <= 0) return 3;
+        got += n;
+    }
+    if (buf[0] != 'h' || buf[1] != 'i') return 4;
+    return 0;
+}
+"""
+
+
+def test_raw_clone_without_settls(tmp_path):
+    """Go-runtime-shaped threading: raw clone with no CLONE_SETTLS. The
+    child shares the parent's TLS; shim channel routing must fall back to
+    the tid table or the parent's channel gets hijacked (hang)."""
+    binary = _compile(tmp_path, "raw-clone", RAW_CLONE_C, libs=())
+    cfg = load_config_str(f"""
+general: {{stop_time: 10s, seed: 24}}
+network:
+  graph:
+    type: 1_gbit_switch
+hosts:
+  box:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, start_time: 1s, expected_final_state: {{exited: 0}}}}
+""")
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
+
+
+LEADER_EXIT_C = r"""
+#include <pthread.h>
+#include <stdio.h>
+#include <unistd.h>
+
+static void *worker(void *arg) {
+    (void)arg;
+    usleep(5000);
+    printf("worker outlived leader\n");
+    return NULL;
+}
+
+int main(void) {
+    pthread_t th;
+    if (pthread_create(&th, NULL, worker, NULL)) return 1;
+    pthread_exit(NULL); /* leader exits; the group lives on via the worker */
+}
+"""
+
+
+def test_leader_pthread_exit_workers_continue(tmp_path):
+    """The main thread pthread_exit()s while a worker keeps running: the
+    zombie leader's /proc task entry lingers until the whole group exits,
+    so the thread-gone wait must treat state Z as gone (not spin out its
+    wall-clock timeout), and the process must still exit cleanly."""
+    import time
+
+    binary = _compile(tmp_path, "leader-exit", LEADER_EXIT_C)
+    cfg = load_config_str(f"""
+general: {{stop_time: 5s, seed: 23}}
+network:
+  graph:
+    type: 1_gbit_switch
+hosts:
+  box:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, start_time: 1s, expected_final_state: {{exited: 0}}}}
+""")
+    t0 = time.monotonic()
+    stats = Manager(cfg).run()
+    wall = time.monotonic() - t0
+    assert stats.process_failures == [], stats.process_failures
+    # the old /proc-exists wait burned a full 2s timeout on the zombie
+    # leader; the Z-state-aware wait finishes in milliseconds
+    assert wall < 2.0, f"leader zombie wait leaked wall time ({wall:.2f}s)"
+
+
+def test_fork_pipe_wait4(tmp_path):
+    """fork() creates a managed child process sharing the parent's pipe
+    through a forked descriptor table; the parent reads the child's bytes
+    and reaps its exit code via emulated wait4."""
+    binary = _compile(tmp_path, "fork-pipe", FORK_PIPE_C, libs=())
+    cfg = load_config_str(f"""
+general: {{stop_time: 10s, seed: 22}}
+network:
+  graph:
+    type: 1_gbit_switch
+hosts:
+  box:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, start_time: 1s, expected_final_state: {{exited: 0}}}}
+""")
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
+
+
+def test_eventfd_timerfd_uname(tmp_path):
+    """eventfd counter semantics, a timerfd firing on the VIRTUAL clock,
+    and uname reporting the simulated hostname."""
+    binary = _compile(tmp_path, "kernel-objects", KERNEL_OBJECTS_C, libs=())
+    cfg = load_config_str(f"""
+general: {{stop_time: 10s, seed: 23}}
+network:
+  graph:
+    type: 1_gbit_switch
+hosts:
+  box:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, start_time: 1s, expected_final_state: {{exited: 0}}}}
+""")
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
+
+
+PY_CLIENT = (
+    "import urllib.request,sys\n"
+    "r = urllib.request.urlopen('http://11.0.0.1:8000/', timeout=60)\n"
+    "body = r.read()\n"
+    "sys.exit(0 if r.status == 200 and len(body) > 0 else 9)\n"
+)
+
+
+def test_python_http_server_and_client(tmp_path):
+    """The reference's literal rung-1 workload
+    (`examples/docs/basic-file-transfer/shadow.yaml`): a REAL python3
+    http.server (threaded: one clone per request) serving a REAL python3
+    urllib client over the simulated network."""
+    import shutil as _sh
+
+    py = _sh.which("python3")
+    if py is None:
+        pytest.skip("no python3")
+    (tmp_path / "index.html").write_text("hello from the simulation\n")
+    script = tmp_path / "client.py"
+    script.write_text(PY_CLIENT)
+    cfg = load_config_str(f"""
+general: {{stop_time: 60s, seed: 24}}
+network:
+  graph:
+    type: gml
+    inline: |
+{GRAPH}
+hosts:
+  server:
+    network_node_id: 0
+    ip_addr: 11.0.0.1
+    processes:
+    - {{path: {py}, args: ["-m", "http.server", "8000", "--bind", "0.0.0.0",
+        "--directory", "{tmp_path}"], start_time: 1s,
+       expected_final_state: running}}
+  client:
+    network_node_id: 0
+    ip_addr: 11.0.0.2
+    processes:
+    - {{path: {py}, args: ["{script}"], start_time: 3s,
+       expected_final_state: {{exited: 0}}}}
+""")
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
+
+
+def test_curl_fetches_from_python_http_server(tmp_path):
+    """The reference's rung-1 binaries verbatim
+    (`examples/docs/basic-file-transfer/shadow.yaml`): real curl
+    downloading a file from real `python3 -m http.server`, bytes
+    verified. VERDICT round-2 item #3's 'done' criterion."""
+    import shutil as _sh
+
+    py = _sh.which("python3")
+    curl = _sh.which("curl")
+    if py is None or curl is None:
+        pytest.skip("python3/curl not available")
+    payload = bytes(range(256)) * 128  # 32 KiB, position-coded
+    (tmp_path / "data.bin").write_bytes(payload)
+    out = tmp_path / "fetched.bin"
+    cfg = load_config_str(f"""
+general: {{stop_time: 60s, seed: 25}}
+network:
+  graph:
+    type: gml
+    inline: |
+{GRAPH}
+hosts:
+  server:
+    network_node_id: 0
+    ip_addr: 11.0.0.1
+    processes:
+    - {{path: {py}, args: ["-m", "http.server", "8000", "--bind", "0.0.0.0",
+        "--directory", "{tmp_path}"], start_time: 1s,
+       expected_final_state: running}}
+  client:
+    network_node_id: 0
+    ip_addr: 11.0.0.2
+    processes:
+    - {{path: {curl}, args: ["-s", "-f", "-o", "{out}",
+        "http://11.0.0.1:8000/data.bin"], start_time: 3s,
+       expected_final_state: {{exited: 0}}}}
+""")
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
+    assert out.read_bytes() == payload
