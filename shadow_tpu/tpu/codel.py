@@ -93,6 +93,125 @@ def rebase_codel_state(state: CodelState, shift_ns) -> CodelState:
     )
 
 
+# phases of the linearized pop state machine (shared by both kernels)
+_PH_START = 0  # at the top of pop(now)
+_PH_AFTER_STORE_DROP = 1  # store-mode drop done; pop-and-return next
+_PH_DROP_LOOP = 2  # inside drop-mode while; front entry just dropped
+
+
+def _codel_pop_step(phase, mode, has_ie, ie, has_dn, dn, cur, prev,
+                    now, empty, e_arr, e_size, total_after):
+    """One micro-step of the CoDel pop state machine: the CPU
+    `CoDelQueue.pop` nested drop loops, unrolled one
+    queue-entry-or-empty-pop at a time. Pure function of the codel scalars
+    and the front-entry view; shared by the trace-replay kernel
+    (`_drain_one_host`) and the integrated router (`_route_one_host`) so
+    the parity-critical logic exists exactly once.
+
+    Returns (scalars', outcome) where scalars' =
+    (mode, has_ie, ie, has_dn, dn, cur, prev, phase_mid) — phase_mid
+    reflects drop transitions only; the CALLER resolves the final phase of
+    a completed pop (trace replay restarts at _PH_START; the integrated
+    router goes idle on empty or token-block) — and outcome =
+    (consume, rec_status, pop_done, any_empty, deliver).
+    """
+    # --- _codel_pop(now): standing-delay check on the front entry -------
+    standing = now - e_arr
+    below = (standing < TARGET) | (total_after <= CONFIG_MTU)
+    entered_bad = ~below & ~has_ie
+    # ok_to_drop per _process_standing_delay
+    ok = ~below & has_ie & (now >= ie)
+    n_ie = jnp.where(below, ie, jnp.where(entered_bad, now + INTERVAL, ie))
+    n_has_ie = jnp.where(below, False, True)
+
+    # control law via table (count >= 1 always when queried)
+    def ctrl(t, c):
+        return t + CTRL_TABLE[jnp.clip(c, 1, _MAX_COUNT)]
+
+    consume = jnp.bool_(False)
+    rec_status = jnp.int32(STATUS_QUEUED)
+    n_mode, n_has_dn, n_dn = mode, has_dn, dn
+    n_cur, n_prev, n_phase = cur, prev, phase
+
+    is_start = phase == _PH_START
+    is_after_sd = phase == _PH_AFTER_STORE_DROP
+    is_drop_loop = phase == _PH_DROP_LOOP
+
+    # ---- _PH_START -----------------------------------------------------
+    # empty queue: pop returns None; mode=store; interval_end=None
+    c_empty = is_start & empty
+    # not ok_to_drop: deliver; mode=store
+    c_deliver = is_start & ~empty & ~ok
+    # ok & store mode: drop entry, switch to drop mode (store-mode drop)
+    c_store_drop = is_start & ~empty & ok & (mode == _MODE_STORE)
+    # ok & drop mode: should_drop(now)?
+    should = has_dn & (now >= dn)
+    c_drop_again = is_start & ~empty & ok & (mode == _MODE_DROP) & should
+    c_drop_deliver = is_start & ~empty & ok & (mode == _MODE_DROP) & ~should
+
+    # ---- _PH_AFTER_STORE_DROP ------------------------------------------
+    a_empty = is_after_sd & empty
+    a_deliver = is_after_sd & ~empty  # delivered regardless of its ok flag
+
+    # ---- _PH_DROP_LOOP --------------------------------------------------
+    # front entry state machine: _codel_pop; if empty -> return None
+    d_empty = is_drop_loop & empty
+    # non-empty: if ok -> drop_next=ctrl(drop_next, cur) else mode=store;
+    # then re-check the while condition with the NEW drop_next/mode
+    d_nonempty = is_drop_loop & ~empty
+    dn_upd = jnp.where(d_nonempty & ok, ctrl(dn, cur), dn)
+    mode_upd = jnp.where(d_nonempty & ~ok, _MODE_STORE, mode)
+    should2 = has_dn & (now >= dn_upd)
+    d_drop = d_nonempty & ok & should2  # mode still drop, keep dropping
+    d_deliver = d_nonempty & ~d_drop
+
+    # ----- merge transitions --------------------------------------------
+    # empty-queue outcomes (all phases): pop completes, nothing consumed.
+    # CPU: _PH_START empty -> mode=store (pop()'s None branch); phases 1/2
+    # leave mode alone; _codel_pop cleared interval_end in every case.
+    any_empty = c_empty | a_empty | d_empty
+    n_mode = jnp.where(c_empty, _MODE_STORE, n_mode)
+    n_has_ie = jnp.where(any_empty, False, n_has_ie)
+
+    # deliver outcomes
+    deliver = c_deliver | a_deliver | c_drop_deliver | d_deliver
+    consume = consume | deliver
+    rec_status = jnp.where(deliver, STATUS_DELIVERED, rec_status)
+    n_mode = jnp.where(c_deliver, _MODE_STORE, n_mode)
+    n_mode = jnp.where(d_deliver, mode_upd, n_mode)
+    n_dn = jnp.where(d_deliver, dn_upd, n_dn)
+
+    # store-mode drop: drop entry now; count bookkeeping; enter phase 1
+    consume = consume | c_store_drop
+    rec_status = jnp.where(c_store_drop, STATUS_DROPPED, rec_status)
+    recently = has_dn & ((jnp.maximum(0, now - dn)) < INTERVAL * 16)
+    delta = cur - prev
+    new_cur = jnp.where(recently & (delta > 1), delta, 1)
+    n_cur = jnp.where(c_store_drop, new_cur, n_cur)
+    n_prev = jnp.where(c_store_drop, new_cur, n_prev)
+    n_dn = jnp.where(c_store_drop, ctrl(now, new_cur), n_dn)
+    n_has_dn = jnp.where(c_store_drop, True, n_has_dn)
+    n_mode = jnp.where(c_store_drop, _MODE_DROP, n_mode)
+    n_phase = jnp.where(c_store_drop, _PH_AFTER_STORE_DROP, n_phase)
+
+    # drop-mode drop (from _PH_START): drop entry, count++, enter loop
+    consume = consume | c_drop_again
+    rec_status = jnp.where(c_drop_again, STATUS_DROPPED, rec_status)
+    n_cur = jnp.where(c_drop_again, cur + 1, n_cur)
+    n_phase = jnp.where(c_drop_again, _PH_DROP_LOOP, n_phase)
+
+    # drop-loop continued drop: entry dropped, count++, stay in loop
+    consume = consume | d_drop
+    rec_status = jnp.where(d_drop, STATUS_DROPPED, rec_status)
+    n_cur = jnp.where(d_drop, cur + 1, n_cur)
+    n_dn = jnp.where(d_drop, dn_upd, n_dn)
+
+    pop_done = any_empty | deliver
+    scalars = (n_mode, n_has_ie, n_ie, n_has_dn, n_dn, n_cur, n_prev,
+               n_phase)
+    return scalars, (consume, rec_status, pop_done, any_empty, deliver)
+
+
 def _drain_one_host(arrival, size, pops, n_pops, st: CodelState):
     """Drain one host's queue through its pop trace.
 
@@ -104,11 +223,6 @@ def _drain_one_host(arrival, size, pops, n_pops, st: CodelState):
     K = arrival.shape[0]
     P = pops.shape[0]
     pushed_bytes = jnp.cumsum(size * (arrival < I32_MAX))  # [K] prefix sums
-
-    # phases of the linearized pop state machine
-    PH_START = 0  # at the top of pop(now)
-    PH_AFTER_STORE_DROP = 1  # store-mode drop done; pop-and-return next
-    PH_DROP_LOOP = 2  # inside drop-mode while; front entry just dropped
 
     def micro_step(_, carry):
         (mode, has_ie, ie, has_dn, dn, cur, prev, eidx, cbytes, dropped,
@@ -122,116 +236,19 @@ def _drain_one_host(arrival, size, pops, n_pops, st: CodelState):
         n_pushed = jnp.searchsorted(arrival, now, side="right").astype(jnp.int32)
         empty = eidx >= n_pushed
         e = jnp.minimum(eidx, K - 1)  # front entry index (clamped for gather)
-        e_arr = arrival[e]
-        e_size = size[e]
-
-        # --- _codel_pop(now): consume front entry, standing-delay check ---
         # total_bytes AFTER removing this entry (the CPU code decrements
         # before _process_standing_delay reads it)
         total_after = pushed_bytes[jnp.minimum(n_pushed - 1, K - 1)] * (
             n_pushed > 0
-        ) - cbytes - e_size
-        standing = now - e_arr
-        below = (standing < TARGET) | (total_after <= CONFIG_MTU)
-        entered_bad = ~below & ~has_ie
-        # ok_to_drop per _process_standing_delay
-        ok = ~below & has_ie & (now >= ie)
-        ie_new = jnp.where(below, ie, jnp.where(entered_bad, now + INTERVAL, ie))
-        has_ie_new = jnp.where(below, False, True)
+        ) - cbytes - size[e]
 
-        # helper: control law via table (count >= 1 always when queried)
-        def ctrl(t, c):
-            return t + CTRL_TABLE[jnp.clip(c, 1, _MAX_COUNT)]
-
-        # ----- dispatch on phase -----------------------------------------
-        # Defaults: no entry consumed, nothing recorded, pop not finished.
-        consume = jnp.bool_(False)
-        rec_status = jnp.int32(STATUS_QUEUED)
-        pop_done = jnp.bool_(False)
-        n_mode, n_has_ie, n_ie = mode, has_ie_new, ie_new
-        n_has_dn, n_dn, n_cur, n_prev = has_dn, dn, cur, prev
-        n_phase = phase
-
-        is_start = phase == PH_START
-        is_after_sd = phase == PH_AFTER_STORE_DROP
-        is_drop_loop = phase == PH_DROP_LOOP
-
-        # ---- PH_START -----------------------------------------------------
-        # empty queue: pop returns None; mode=store; interval_end=None
-        c_empty = is_start & empty
-        # (CPU _codel_pop clears interval_end when empty)
-        # not ok_to_drop: deliver; mode=store
-        c_deliver = is_start & ~empty & ~ok
-        # ok & store mode: drop entry, switch to drop mode (store-mode drop)
-        c_store_drop = is_start & ~empty & ok & (mode == _MODE_STORE)
-        # ok & drop mode: should_drop(now)?
-        should = has_dn & (now >= dn)
-        c_drop_again = is_start & ~empty & ok & (mode == _MODE_DROP) & should
-        c_drop_deliver = is_start & ~empty & ok & (mode == _MODE_DROP) & ~should
-
-        # ---- PH_AFTER_STORE_DROP -------------------------------------------
-        a_empty = is_after_sd & empty
-        a_deliver = is_after_sd & ~empty  # delivered regardless of its ok flag
-
-        # ---- PH_DROP_LOOP ---------------------------------------------------
-        # front entry state machine: _codel_pop; if empty → return None
-        d_empty = is_drop_loop & empty
-        # non-empty: if ok → drop_next=ctrl(drop_next, cur) else mode=store;
-        # then re-check while condition with the NEW drop_next/mode
-        d_nonempty = is_drop_loop & ~empty
-        dn_upd = jnp.where(d_nonempty & ok, ctrl(dn, cur), dn)
-        mode_upd = jnp.where(d_nonempty & ~ok, _MODE_STORE, mode)
-        should2 = has_dn & (now >= dn_upd)
-        d_drop = d_nonempty & ok & should2  # mode still drop, keep dropping
-        d_deliver = d_nonempty & ~d_drop
-
-        # ----- merge transitions ------------------------------------------
-        # empty-queue outcomes (all phases): pop completes, nothing consumed
-        any_empty = c_empty | a_empty | d_empty
-        pop_done = pop_done | any_empty
-        # CPU: PH_START empty → mode=store (pop()'s None branch). Phase 1 /
-        # phase 2 empty: _codel_pop cleared interval_end; mode untouched in
-        # phase 2; phase 1 returns None from _drop_from_store_mode (mode
-        # was already set to DROP before the nested pop)
-        n_mode = jnp.where(c_empty, _MODE_STORE, n_mode)
-        n_has_ie = jnp.where(any_empty, False, n_has_ie)
-
-        # deliver outcomes
-        deliver = c_deliver | a_deliver | c_drop_deliver | d_deliver
-        consume = consume | deliver
-        rec_status = jnp.where(deliver, STATUS_DELIVERED, rec_status)
-        pop_done = pop_done | deliver
-        n_mode = jnp.where(c_deliver, _MODE_STORE, n_mode)
-        n_mode = jnp.where(d_deliver, mode_upd, n_mode)
-        n_dn = jnp.where(d_deliver, dn_upd, n_dn)
-
-        # store-mode drop: drop entry now; count bookkeeping; enter phase 1
-        consume = consume | c_store_drop
-        rec_status = jnp.where(c_store_drop, STATUS_DROPPED, rec_status)
-        recently = has_dn & ((jnp.maximum(0, now - dn)) < INTERVAL * 16)
-        delta = cur - prev
-        new_cur = jnp.where(recently & (delta > 1), delta, 1)
-        n_cur = jnp.where(c_store_drop, new_cur, n_cur)
-        n_prev = jnp.where(c_store_drop, new_cur, n_prev)
-        n_dn = jnp.where(c_store_drop, ctrl(now, new_cur), n_dn)
-        n_has_dn = jnp.where(c_store_drop, True, n_has_dn)
-        n_mode = jnp.where(c_store_drop, _MODE_DROP, n_mode)
-        n_phase = jnp.where(c_store_drop, PH_AFTER_STORE_DROP, n_phase)
-
-        # drop-mode drop (from PH_START): drop entry, count++, enter loop
-        consume = consume | c_drop_again
-        rec_status = jnp.where(c_drop_again, STATUS_DROPPED, rec_status)
-        n_cur = jnp.where(c_drop_again, cur + 1, n_cur)
-        n_phase = jnp.where(c_drop_again, PH_DROP_LOOP, n_phase)
-
-        # drop-loop continued drop: entry dropped, count++, stay in loop
-        consume = consume | d_drop
-        rec_status = jnp.where(d_drop, STATUS_DROPPED, rec_status)
-        n_cur = jnp.where(d_drop, cur + 1, n_cur)
-        n_dn = jnp.where(d_drop, dn_upd, n_dn)
-
-        # completing any pop resets the phase
-        n_phase = jnp.where(pop_done, PH_START, n_phase)
+        scalars, (consume, rec_status, pop_done, _any_empty, _deliver) = \
+            _codel_pop_step(phase, mode, has_ie, ie, has_dn, dn, cur, prev,
+                            now, empty, arrival[e], size[e], total_after)
+        (n_mode, n_has_ie, n_ie, n_has_dn, n_dn, n_cur, n_prev,
+         n_phase) = scalars
+        # trace replay: completing any pop restarts at the next pop time
+        n_phase = jnp.where(pop_done, _PH_START, n_phase)
 
         # gate everything on `active` (pops exhausted = this host is done)
         consume = consume & active
@@ -253,7 +270,7 @@ def _drain_one_host(arrival, size, pops, n_pops, st: CodelState):
             sel(n_has_dn, has_dn), sel(n_dn, dn), sel(n_cur, cur),
             sel(n_prev, prev),
             jnp.where(consume, eidx + 1, eidx),
-            jnp.where(consume, cbytes + e_size, cbytes),
+            jnp.where(consume, cbytes + size[e], cbytes),
             jnp.where(consume & (rec_status == STATUS_DROPPED),
                       dropped + 1, dropped),
             jnp.where(pop_done, pidx + 1, pidx),
@@ -266,7 +283,7 @@ def _drain_one_host(arrival, size, pops, n_pops, st: CodelState):
     carry = (
         st.mode, st.has_interval_end, st.interval_end, st.has_drop_next,
         st.drop_next, st.cur_count, st.prev_count, st.entry_idx,
-        st.consumed_bytes, st.dropped, jnp.int32(0), jnp.int32(PH_START),
+        st.consumed_bytes, st.dropped, jnp.int32(0), jnp.int32(_PH_START),
         status0, deliver0,
     )
     # bound: every micro-step consumes an entry or completes a pop
@@ -279,6 +296,281 @@ def _drain_one_host(arrival, size, pops, n_pops, st: CodelState):
         entry_idx=eidx, consumed_bytes=cbytes, dropped=dropped,
     )
     return st_out, status, deliver_t
+
+
+# -- integrated router: CoDel + down-bandwidth relay ----------------------
+#
+# The window_step ingress pipeline (`host.rs:810-865`: router CoDel ->
+# inet-in relay -> interface). Unlike `codel_drain`, pop times are DERIVED,
+# not given: every arrival starts a pop chain at its arrival time (the CPU
+# plane's route_incoming_packet -> relay.notify -> delay-0 task), the chain
+# pops until the queue empties or the down-bandwidth token bucket runs dry,
+# and a non-conforming packet is CACHED in the relay (already consumed from
+# the CoDel queue, `relay/mod.rs` Forwarding->Idle with _next_packet) with a
+# resume scheduled exactly at the refill boundary that affords it.
+
+STATUS_TAKEN = 3  # consumed from the queue, cached in the relay at window end
+
+_PH_IDLE = 3  # no active pop chain (extends the PH_* codes in _drain_one_host)
+
+
+class RouterDownState(NamedTuple):
+    """Per-host scalar state of the integrated router+relay, axis 0 = host."""
+
+    # CoDel scalars (same meaning as CodelState)
+    mode: jax.Array
+    has_interval_end: jax.Array
+    interval_end: jax.Array
+    has_drop_next: jax.Array
+    drop_next: jax.Array
+    cur_count: jax.Array
+    prev_count: jax.Array
+    # down-bandwidth token bucket (`relay/token_bucket.rs`)
+    dn_balance: jax.Array  # int32 token bytes
+    dn_last_refill: jax.Array  # int32 rel ns of the last refill boundary
+    # relay-cached packet (popped from CoDel, waiting for tokens)
+    has_cached: jax.Array  # bool
+    cached_src: jax.Array  # int32 identity carried across windows
+    cached_seq: jax.Array
+    cached_bytes: jax.Array
+    resume: jax.Array  # int32 rel ns the relay resumes (valid iff has_cached)
+    dropped: jax.Array  # int32 cumulative router drops
+
+
+def make_router_state(n_hosts: int,
+                      dn_cap: jax.Array | None = None) -> RouterDownState:
+    z = lambda: jnp.zeros((n_hosts,), jnp.int32)
+    f = lambda: jnp.zeros((n_hosts,), bool)
+    return RouterDownState(
+        mode=z(), has_interval_end=f(), interval_end=z(),
+        has_drop_next=f(), drop_next=z(), cur_count=z(), prev_count=z(),
+        dn_balance=(jnp.asarray(dn_cap, jnp.int32) if dn_cap is not None
+                    else z()),
+        dn_last_refill=z(), has_cached=f(), cached_src=z(), cached_seq=z(),
+        cached_bytes=z(), resume=z(), dropped=z(),
+    )
+
+
+def rebase_router_state(st: RouterDownState, shift_ns, dn_rate,
+                        dn_cap) -> RouterDownState:
+    """Rebase stored times by the window shift AND re-anchor the token
+    bucket: apply every refill boundary that has passed up to the new
+    window start (elapsed clamped before multiplying, as everywhere).
+    Without the re-anchoring, dn_last_refill only ever decreases and wraps
+    int32 after ~2.1 s of inbound-idle sim time, corrupting all later
+    bucket math for the host."""
+    shift = jnp.int32(shift_ns)
+    interval_ms = jnp.int32(simtime.MILLISECOND)
+    lref = st.dn_last_refill - shift
+    span = jnp.maximum(-lref, 0)  # ns from last refill to the new t=0
+    num = span // interval_ms
+    headroom = jnp.maximum(dn_cap - st.dn_balance, 0)
+    need = (headroom + dn_rate - 1) // dn_rate
+    balance = jnp.minimum(
+        st.dn_balance + dn_rate * jnp.minimum(num, need), dn_cap
+    )
+    lref = lref + num * interval_ms  # now in (-1 ms, 0] (or small positive)
+    return st._replace(
+        interval_end=jnp.where(st.has_interval_end,
+                               st.interval_end - shift, st.interval_end),
+        drop_next=jnp.where(st.has_drop_next, st.drop_next - shift,
+                            st.drop_next),
+        dn_balance=balance,
+        dn_last_refill=lref,
+        resume=jnp.where(st.has_cached, st.resume - shift, st.resume),
+    )
+
+
+def _route_one_host(arrival, size, window_ns, dn_rate, dn_cap, st):
+    """Run one host's router (CoDel + down relay) over one window.
+
+    arrival [K] int32 ascending (I32_MAX padding), size [K]. `st` holds this
+    host's scalars. Returns (scalars', status [K], deliver_t [K], co_mask,
+    co_t, cached_idx) where co_* report the delivery of a packet cached in a
+    PREVIOUS window (identity lives in the state scalars) and cached_idx >= 0
+    names the row entry left cached at window end (-1: none, or the cached
+    packet is the carried-over one)."""
+    K = arrival.shape[0]
+    interval_ms = jnp.int32(simtime.MILLISECOND)
+    pushed_bytes = jnp.cumsum(size * (arrival < I32_MAX))
+    n_valid = (arrival < I32_MAX).sum().astype(jnp.int32)
+
+    PH_START = 0
+    PH_AFTER_STORE_DROP = 1
+    PH_DROP_LOOP = 2
+
+    def refill(bal, lref, now):
+        """Lazy 1ms refill, elapsed clamped BEFORE multiplying so the
+        arithmetic stays inside int32 for any rate (cf. window_step's
+        token-bucket refill)."""
+        span = jnp.maximum(now - lref, 0)
+        num = span // interval_ms
+        headroom = jnp.maximum(dn_cap - bal, 0)
+        need = (headroom + dn_rate - 1) // dn_rate
+        bal2 = jnp.minimum(bal + dn_rate * jnp.minimum(num, need), dn_cap)
+        return bal2, lref + num * interval_ms
+
+    def micro_step(_, carry):
+        (mode, has_ie, ie, has_dn, dn, cur, prev, bal, lref, has_c, c_size,
+         c_idx, resume, dropped, eidx, cbytes, T, phase, halted, co_mask,
+         co_t, status, deliver_t) = carry
+
+        # ---- event selection while no pop chain is active ----------------
+        idle = (phase == _PH_IDLE) & ~halted
+        resume_ok = idle & has_c & (resume < window_ns)
+        head_arr = arrival[jnp.minimum(eidx, K - 1)]
+        head_ok = (idle & ~has_c & (eidx < n_valid)
+                   & (head_arr < window_ns))
+        halt_now = idle & ~resume_ok & ~head_ok
+        halted = halted | halt_now
+
+        def wait_until(now, required, lref_now):
+            """Resume time of a token-blocked packet: the refill boundary
+            that affords `required` more bytes. Saturates just below
+            I32_MAX on int32 overflow; rebasing brings it down across
+            windows and the resume-time conformance RE-CHECK below turns a
+            too-early (saturated) firing into a recomputation instead of a
+            premature delivery."""
+            n_refills = (required + dn_rate - 1) // dn_rate
+            w = (interval_ms - (now - lref_now)
+                 + (n_refills - 1) * interval_ms)
+            r = now + w
+            return jnp.where(r < now, I32_MAX - interval_ms, r)
+
+        # cached resume: refill + conformance re-check. A wait computed
+        # exactly conforms at its boundary; a saturated one fires early,
+        # fails the check, and re-blocks with the remaining wait.
+        rT = resume
+        r_bal, r_lref = refill(bal, lref, rT)
+        r_conform = c_size <= r_bal
+        r_fwd = resume_ok & r_conform
+        r_again = resume_ok & ~r_conform
+        bal = jnp.where(r_fwd, r_bal - c_size,
+                        jnp.where(r_again, r_bal, bal))
+        lref = jnp.where(resume_ok, r_lref, lref)
+        row_cached = c_idx >= 0
+        ci = jnp.minimum(jnp.maximum(c_idx, 0), K - 1)
+        status = status.at[ci].set(
+            jnp.where(r_fwd & row_cached, STATUS_DELIVERED, status[ci]),
+            mode="drop")
+        deliver_t = deliver_t.at[ci].set(
+            jnp.where(r_fwd & row_cached, rT, deliver_t[ci]), mode="drop")
+        co_mask = co_mask | (r_fwd & ~row_cached)
+        co_t = jnp.where(r_fwd & ~row_cached, rT, co_t)
+        has_c = jnp.where(r_fwd, False, has_c)
+        c_idx = jnp.where(r_fwd, -1, c_idx)
+        resume = jnp.where(r_again, wait_until(rT, c_size - r_bal, r_lref),
+                           resume)
+        T = jnp.where(r_fwd, rT, T)
+        phase = jnp.where(r_fwd, _PH_START, phase)
+
+        # idle chain start at the head entry's arrival (notify -> delay-0
+        # relay task)
+        T = jnp.where(head_ok, head_arr, T)
+        phase = jnp.where(head_ok, _PH_START, phase)
+
+        # ---- one CoDel pop micro-step at chain time T --------------------
+        in_chain = ((phase != _PH_IDLE) & ~halted & ~resume_ok & ~head_ok)
+        now = T
+        n_pushed = jnp.searchsorted(arrival, now,
+                                    side="right").astype(jnp.int32)
+        empty = eidx >= n_pushed
+        e = jnp.minimum(eidx, K - 1)
+        e_size = size[e]
+        total_after = pushed_bytes[jnp.minimum(n_pushed - 1, K - 1)] * (
+            n_pushed > 0
+        ) - cbytes - e_size
+
+        scalars, (consume, rec_status, _pop_done, any_empty, deliver) = \
+            _codel_pop_step(phase, mode, has_ie, ie, has_dn, dn, cur, prev,
+                            now, empty, arrival[e], e_size, total_after)
+        (n_mode, n_has_ie, n_ie, n_has_dn, n_dn, n_cur, n_prev,
+         n_phase) = scalars
+
+        # deliver candidate -> relay token gate (the one divergence from
+        # the trace-replay kernel: a candidate the bucket can't afford is
+        # TAKEN into the relay cache instead of delivered)
+        g_bal, g_lref = refill(bal, lref, now)
+        conform = e_size <= g_bal
+        fwd = deliver & conform
+        blocked = deliver & ~conform
+        rec_status = jnp.where(blocked, STATUS_TAKEN, rec_status)
+        bal = jnp.where(in_chain & deliver,
+                        jnp.where(conform, g_bal - e_size, g_bal), bal)
+        lref = jnp.where(in_chain & deliver, g_lref, lref)
+        has_c = jnp.where(in_chain & blocked, True, has_c)
+        c_size = jnp.where(in_chain & blocked, e_size, c_size)
+        c_idx = jnp.where(in_chain & blocked, e, c_idx)
+        resume = jnp.where(in_chain & blocked,
+                           wait_until(now, e_size - g_bal, g_lref), resume)
+
+        # chain control: empty queue or token block idles the relay; a
+        # forwarded pop restarts the chain at the same instant
+        n_phase = jnp.where(any_empty | blocked, _PH_IDLE, n_phase)
+        n_phase = jnp.where(fwd, _PH_START, n_phase)
+
+        gate = in_chain
+        status = status.at[e].set(
+            jnp.where(gate & consume, rec_status, status[e]), mode="drop")
+        deliver_t = deliver_t.at[e].set(
+            jnp.where(gate & consume & (rec_status == STATUS_DELIVERED), now,
+                      deliver_t[e]), mode="drop")
+
+        def sel(new, old):
+            return jnp.where(gate, new, old)
+
+        return (
+            sel(n_mode, mode), sel(n_has_ie, has_ie), sel(n_ie, ie),
+            sel(n_has_dn, has_dn), sel(n_dn, dn), sel(n_cur, cur),
+            sel(n_prev, prev), bal, lref, has_c, c_size, c_idx, resume,
+            jnp.where(gate & consume & (rec_status == STATUS_DROPPED),
+                      dropped + 1, dropped),
+            jnp.where(gate & consume, eidx + 1, eidx),
+            jnp.where(gate & consume, cbytes + e_size, cbytes),
+            T, sel(n_phase, phase), halted, co_mask, co_t, status, deliver_t,
+        )
+
+    status0 = jnp.zeros((K,), jnp.int32)
+    deliver0 = jnp.full((K,), I32_MAX, jnp.int32)
+    carry = (
+        st.mode, st.has_interval_end, st.interval_end, st.has_drop_next,
+        st.drop_next, st.cur_count, st.prev_count, st.dn_balance,
+        st.dn_last_refill, st.has_cached, st.cached_bytes, jnp.int32(-1),
+        st.resume, st.dropped, jnp.int32(0), jnp.int32(0), jnp.int32(0),
+        jnp.int32(_PH_IDLE), jnp.bool_(False), jnp.bool_(False),
+        jnp.int32(0), status0, deliver0,
+    )
+    # bound: every micro-step consumes an entry, completes an empty pop,
+    # delivers (or re-blocks) a cached packet, starts a chain, or halts
+    carry = jax.lax.fori_loop(0, 4 * K + 16, micro_step, carry)
+    (mode, has_ie, ie, has_dn, dn, cur, prev, bal, lref, has_c, c_size,
+     c_idx, resume, dropped, _eidx, _cbytes, _T, _phase, _halted, co_mask,
+     co_t, status, deliver_t) = carry
+    st_out = RouterDownState(
+        mode=mode, has_interval_end=has_ie, interval_end=ie,
+        has_drop_next=has_dn, drop_next=dn, cur_count=cur, prev_count=prev,
+        dn_balance=bal, dn_last_refill=lref, has_cached=has_c,
+        cached_src=st.cached_src, cached_seq=st.cached_seq,
+        cached_bytes=c_size, resume=resume, dropped=dropped,
+    )
+    return st_out, status, deliver_t, co_mask, co_t, c_idx
+
+
+def router_drain(arrival: jax.Array, size: jax.Array, window_ns,
+                 dn_rate: jax.Array, dn_cap: jax.Array,
+                 state: RouterDownState):
+    """Vmapped integrated router step: per-host CoDel + down-bw relay.
+
+    arrival/size: [N, K], arrival ascending per row with I32_MAX padding.
+    Returns (state', status [N, K], deliver_t [N, K], co_mask [N],
+    co_t [N], cached_idx [N]). The caller owns identity bookkeeping:
+    cached_idx >= 0 means row entry cached at window end (gather its
+    src/seq into the state scalars); co_mask means the PREVIOUS window's
+    cached packet (identity in the pre-step state scalars) was delivered
+    at co_t."""
+    return jax.vmap(
+        _route_one_host, in_axes=(0, 0, None, 0, 0, 0)
+    )(arrival, size, jnp.int32(window_ns), dn_rate, dn_cap, state)
 
 
 def codel_drain(arrival: jax.Array, size: jax.Array, pops: jax.Array,

@@ -42,7 +42,7 @@ def param_shardings(mesh: Mesh) -> NetPlaneParams:
     row = NamedSharding(mesh, P(HOST_AXIS, None))
     vec = NamedSharding(mesh, P(HOST_AXIS))
     return NetPlaneParams(latency_ns=row, loss=row, tb_rate=vec, tb_cap=vec,
-                          qdisc_rr=vec)
+                          qdisc_rr=vec, dn_rate=vec, dn_cap=vec)
 
 
 def shard_state(state: NetPlaneState, params: NetPlaneParams, mesh: Mesh):

@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import codel
+
 I32_MAX = np.int32(2**31 - 1)
 # Bounded per-host socket-slot space for the round-robin qdisc's fairness
 # counters; socket ids hash in with `% RR_SOCK_SLOTS` (collisions merge
@@ -56,6 +58,8 @@ class NetPlaneParams(NamedTuple):
     tb_rate: jax.Array  # [N] int32 — egress bytes per millisecond (up-bw)
     tb_cap: jax.Array  # [N] int32 — bucket capacity (rate/ms + 1 MTU burst)
     qdisc_rr: jax.Array  # [N] bool — per-host qdisc: round-robin vs FIFO
+    dn_rate: jax.Array  # [N] int32 — ingress bytes per millisecond (down-bw)
+    dn_cap: jax.Array  # [N] int32 — down bucket capacity (rate/ms + 1 MTU)
 
 
 class NetPlaneState(NamedTuple):
@@ -86,6 +90,9 @@ class NetPlaneState(NamedTuple):
     # floored to the active minimum so idle sockets re-join at the current
     # virtual time instead of monopolizing on return)
     rr_sent: jax.Array
+    # destination-side router (CoDel AQM + down-bw relay) scalars; active
+    # only when window_step compiles with router_aqm=True
+    router: codel.RouterDownState
     # counters (per host, int32)
     n_sent: jax.Array
     n_loss_dropped: jax.Array
@@ -95,13 +102,18 @@ class NetPlaneState(NamedTuple):
 
 def make_params(latency_ns: np.ndarray, loss: np.ndarray, up_bw_bps: np.ndarray,
                 mtu: int = 1500,
-                qdisc_rr: np.ndarray | None = None) -> NetPlaneParams:
+                qdisc_rr: np.ndarray | None = None,
+                down_bw_bps: np.ndarray | None = None) -> NetPlaneParams:
     """Build params from the routing matrices (`RoutingInfo.latency_ns/loss`
     mapped host→node) and per-host up-bandwidths in bits/sec.
 
     `qdisc_rr` [N] bool selects the per-host queuing discipline
     (`QDiscMode` in `configuration.rs:961`): False = FIFO by packet
-    priority, True = round-robin across emitting sockets. Default FIFO."""
+    priority, True = round-robin across emitting sockets. Default FIFO.
+
+    `down_bw_bps` [N] feeds the destination-side router's down-bandwidth
+    relay bucket (active only when window_step runs with router_aqm=True);
+    None = transparent (max rate)."""
     # cap the per-ms rate at 2^30 - mtu so the refill arithmetic in
     # window_step (balance + rate*elapsed_eff <= cap + rate <= 2*rate + mtu)
     # can never overflow int32; 2^30 B/ms ~ 8.6 Tbit/s, beyond any modeled NIC
@@ -109,6 +121,13 @@ def make_params(latency_ns: np.ndarray, loss: np.ndarray, up_bw_bps: np.ndarray,
         np.maximum(1, (up_bw_bps // 8) // 1000), 2**30 - mtu
     ).astype(np.int32)  # B/ms
     n = np.asarray(latency_ns).shape[0]
+    if down_bw_bps is None:
+        dn_rate = np.full(n, 2**30 - mtu, np.int32)
+    else:
+        dn_rate = np.minimum(
+            np.maximum(1, (np.asarray(down_bw_bps) // 8) // 1000),
+            2**30 - mtu,
+        ).astype(np.int32)
     return NetPlaneParams(
         latency_ns=jnp.asarray(latency_ns, jnp.int32),
         loss=jnp.asarray(loss, jnp.float32),
@@ -116,11 +135,21 @@ def make_params(latency_ns: np.ndarray, loss: np.ndarray, up_bw_bps: np.ndarray,
         tb_cap=jnp.asarray(rate + mtu, jnp.int32),
         qdisc_rr=(jnp.asarray(qdisc_rr, bool) if qdisc_rr is not None
                   else jnp.zeros(n, bool)),
+        dn_rate=jnp.asarray(dn_rate),
+        dn_cap=jnp.asarray(dn_rate + mtu, jnp.int32),
     )
 
 
 def make_state(n_hosts: int, egress_cap: int = 32, ingress_cap: int = 64,
-               initial_tokens: np.ndarray | None = None) -> NetPlaneState:
+               initial_tokens: np.ndarray | None = None,
+               initial_dn_tokens: np.ndarray | None = None,
+               params: NetPlaneParams | None = None) -> NetPlaneState:
+    """`params` (or an explicit `initial_dn_tokens`) starts the down-bw
+    bucket at full capacity like the CPU TokenBucket — REQUIRED for parity
+    whenever window_step runs with router_aqm=True (a zero-token start
+    would delay every host's first inbound delivery to the 1 ms refill)."""
+    if initial_dn_tokens is None and params is not None:
+        initial_dn_tokens = np.asarray(params.dn_cap)
     N, CE, CI = n_hosts, egress_cap, ingress_cap
     z = lambda shape: jnp.zeros(shape, jnp.int32)
     return NetPlaneState(
@@ -143,6 +172,9 @@ def make_state(n_hosts: int, egress_cap: int = 32, ingress_cap: int = 64,
         tb_rem_ns=z((N,)),
         rng_counter=z((N,)),
         rr_sent=z((N, RR_SOCK_SLOTS)),
+        # CPU TokenBucket starts at full capacity; callers running with
+        # router_aqm should pass the dn_cap array here for parity
+        router=codel.make_router_state(N, initial_dn_tokens),
         n_sent=z((N,)),
         n_loss_dropped=z((N,)),
         n_overflow_dropped=z((N,)),
@@ -242,7 +274,7 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
 
 def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Array,
                 shift_ns: jax.Array, window_ns: jax.Array, *,
-                rr_enabled: bool = True):
+                rr_enabled: bool = True, router_aqm: bool = False):
     """Advance one scheduling round [t, t + window_ns).
 
     `rr_enabled` is a static (trace-time) switch: False compiles the
@@ -251,6 +283,16 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     where the CPU NIC owns qdisc ordering). The RR path materializes
     [N, CE, CE] pairwise tensors, which DOMINATE the per-window cost
     whenever N < CE^2; callers with all-FIFO configs should pass False.
+
+    `router_aqm` (static) switches the destination side from direct
+    due-release to the full inbound pipeline (`host.rs:810-865`): router
+    CoDel -> down-bandwidth relay -> delivery, via the fused micro-step
+    kernel in `tpu.codel.router_drain`. In this mode a packet's stored
+    time is its ARRIVAL at the destination router; delivery happens when
+    the relay forwards it (same instant when tokens allow, later when the
+    down-bw bucket or CoDel interferes), and CoDel may drop it instead
+    (counted in state.router.dropped). The CPU relay's bootstrap-period
+    rate-limit bypass is not modeled on device.
 
     `shift_ns` = this window's start minus the previous window's start;
     stored relative times are rebased by it. Returns
@@ -282,6 +324,8 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     balance = jnp.minimum(
         state.tb_balance + params.tb_rate * elapsed_eff, params.tb_cap
     )
+    rt = codel.rebase_router_state(state.router, shift_ns, params.dn_rate,
+                                   params.dn_cap)
 
     # --- 2. egress: qdisc order, token-bucket gate ----------------------
     # Two qdiscs (`network_interface.c:205-303`, `QDiscMode`): FIFO sends
@@ -410,24 +454,77 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     # arrivals flip their slot valid
     in_valid_m = scatter(in_valid_c, jnp.ones_like(ok))
 
-    # --- 5b. deliver everything due in this window from the MERGED set ---
-    in_deliver_key = jnp.where(in_valid_m, in_deliver_m, I32_MAX)
-    due = in_valid_m & (in_deliver_key < window_ns)
-    # one sort serves both purposes: not-due first keyed by deliver time
-    # keeps the surviving entries front-packed; the due block lands at the
-    # row tail in deterministic (deliver_t, src, seq) presentation order
-    is_due = due.astype(jnp.int32)
-    _, d_t, d_src, d_seq, d_bytes, d_due, d_valid = _row_sort(
-        is_due, jnp.where(in_valid_m, in_deliver_m, I32_MAX), in_src_m,
-        in_seq_m, in_bytes_m, due, in_valid_m, keys=4,
-    )
-    delivered = {
-        "mask": d_due, "src": d_src, "seq": d_seq, "bytes": d_bytes,
-        "deliver_rel": d_t,
-    }
-    in_valid_new = d_valid & ~d_due
-    in_deliver_new = jnp.where(in_valid_new, d_t, I32_MAX)
-    in_src_new, in_seq_new, in_bytes_new = d_src, d_seq, d_bytes
+    # --- 5b. destination side: release what this window hands the hosts --
+    if router_aqm:
+        # Full inbound pipeline: stored times are router-arrival times.
+        # FIFO order at the router = (arrival, src, seq), the same order
+        # the CPU plane's event queue feeds route_incoming_packet.
+        inv_m = (~in_valid_m).astype(jnp.int32)
+        arr_key = jnp.where(in_valid_m, in_deliver_m, I32_MAX)
+        (_, arr_s, src_s2, seq_s2, bytes_s2, valid_s2) = _row_sort(
+            inv_m, arr_key, in_src_m, in_seq_m, in_bytes_m, in_valid_m,
+            keys=4,
+        )
+        rt2, rstatus, r_dt, co_mask, co_t, c_idx = codel.router_drain(
+            arr_s, bytes_s2, window_ns, params.dn_rate, params.dn_cap, rt,
+        )
+        # a row entry cached at window end leaves the queue: its identity
+        # moves into the router scalars until the relay resumes
+        new_cached = c_idx >= 0
+        ci = jnp.clip(c_idx, 0, CI - 1)[:, None]
+        take = lambda a: jnp.take_along_axis(a, ci, axis=1)[:, 0]
+        rt2 = rt2._replace(
+            cached_src=jnp.where(new_cached, take(src_s2), rt.cached_src),
+            cached_seq=jnp.where(new_cached, take(seq_s2), rt.cached_seq),
+        )
+        # delivered = forwarded row entries + (maybe) the prior window's
+        # relay-cached packet, presented in (deliver_t, src, seq) order
+        fwd_rows = rstatus == codel.STATUS_DELIVERED
+        d_mask0 = jnp.concatenate([fwd_rows, co_mask[:, None]], axis=1)
+        d_src0 = jnp.concatenate([src_s2, rt.cached_src[:, None]], axis=1)
+        d_seq0 = jnp.concatenate([seq_s2, rt.cached_seq[:, None]], axis=1)
+        d_bytes0 = jnp.concatenate([bytes_s2, rt.cached_bytes[:, None]],
+                                   axis=1)
+        d_t0 = jnp.concatenate(
+            [jnp.where(fwd_rows, r_dt, I32_MAX),
+             jnp.where(co_mask, co_t, I32_MAX)[:, None]], axis=1)
+        (_, d_t, d_src, d_seq, d_bytes, d_due) = _row_sort(
+            (~d_mask0).astype(jnp.int32), d_t0, d_src0, d_seq0, d_bytes0,
+            d_mask0, keys=4,
+        )
+        delivered = {
+            "mask": d_due, "src": d_src, "seq": d_seq, "bytes": d_bytes,
+            "deliver_rel": d_t,
+        }
+        due = d_due  # for the n_delivered counter
+        # surviving queue = the untouched FIFO suffix, re-front-packed
+        keep = valid_s2 & (rstatus == codel.STATUS_QUEUED)
+        (_, in_deliver_new, in_src_new, in_seq_new, in_bytes_new,
+         in_valid_new) = _row_sort(
+            (~keep).astype(jnp.int32), jnp.where(keep, arr_s, I32_MAX),
+            src_s2, seq_s2, bytes_s2, keep, keys=2,
+        )
+        rt_out = rt2
+    else:
+        in_deliver_key = jnp.where(in_valid_m, in_deliver_m, I32_MAX)
+        due = in_valid_m & (in_deliver_key < window_ns)
+        # one sort serves both purposes: not-due first keyed by deliver time
+        # keeps the surviving entries front-packed; the due block lands at
+        # the row tail in deterministic (deliver_t, src, seq) presentation
+        # order
+        is_due = due.astype(jnp.int32)
+        _, d_t, d_src, d_seq, d_bytes, d_due, d_valid = _row_sort(
+            is_due, jnp.where(in_valid_m, in_deliver_m, I32_MAX), in_src_m,
+            in_seq_m, in_bytes_m, due, in_valid_m, keys=4,
+        )
+        delivered = {
+            "mask": d_due, "src": d_src, "seq": d_seq, "bytes": d_bytes,
+            "deliver_rel": d_t,
+        }
+        in_valid_new = d_valid & ~d_due
+        in_deliver_new = jnp.where(in_valid_new, d_t, I32_MAX)
+        in_src_new, in_seq_new, in_bytes_new = d_src, d_seq, d_bytes
+        rt_out = rt
 
     # --- 6. compact leftover egress so rows stay front-packed for ingest
     eg_prio_left = jnp.where(eg_valid_left, eg_prio, I32_MAX)
@@ -438,19 +535,25 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     )
 
     # --- 7. stats + next-event reduction --------------------------------
+    per_host_in_next = jnp.where(in_valid_new, in_deliver_new,
+                                 I32_MAX).min(axis=1)
+    if router_aqm:
+        # a relay-cached packet blocks its whole row until the resume fires
+        per_host_in_next = jnp.where(rt_out.has_cached, rt_out.resume,
+                                     per_host_in_next)
     next_event = jnp.minimum(
-        jnp.where(in_valid_new, in_deliver_new, I32_MAX).min(axis=1).min(),
+        per_host_in_next.min(),
         jnp.where(eg_valid_c.any(), window_ns, I32_MAX),
     )
 
-    new_state = NetPlaneState(
+    new_state = state._replace(
         eg_dst=eg_dst_c, eg_bytes=eg_bytes_c, eg_prio=eg_prio_c,
         eg_seq=eg_seq_c, eg_ctrl=eg_ctrl_c, eg_tsend=eg_tsend_c,
         eg_clamp=eg_clamp_c, eg_sock=eg_sock_c, eg_valid=eg_valid_c,
         in_src=in_src_new, in_bytes=in_bytes_new, in_seq=in_seq_new,
         in_deliver_rel=in_deliver_new, in_valid=in_valid_new,
         tb_balance=balance, tb_rem_ns=tb_rem_ns, rng_counter=rng_counter,
-        rr_sent=rr_sent,
+        rr_sent=rr_sent, router=rt_out,
         n_sent=state.n_sent + sent.sum(axis=1, dtype=jnp.int32),
         n_loss_dropped=state.n_loss_dropped + lost.sum(axis=1, dtype=jnp.int32),
         n_overflow_dropped=state.n_overflow_dropped + overflowed,

@@ -83,6 +83,7 @@ class DeviceTransport:
         self._prev_start: Optional[int] = None
         self.next_pending_abs: Optional[int] = None
         self._overflow_seen = 0
+        self._overflow_prev = np.zeros(n, np.int64)
         self._batch_pad = 64
 
     # -- capture (called from Worker.send_packet, any worker thread) -----
@@ -166,6 +167,14 @@ class DeviceTransport:
                 total_overflow - self._overflow_seen,
             )
             self._overflow_seen = total_overflow
+            # surface device-side drops in the per-host tracker counters
+            # (the packet objects never reach a CPU interface, so no
+            # status-trace hook fires for them)
+            deltas = overflow.astype(np.int64) - self._overflow_prev
+            for i in np.nonzero(deltas > 0)[0]:
+                for tracker in getattr(self.hosts[i], "trackers", []):
+                    tracker.counters.packets_dropped += int(deltas[i])
+            self._overflow_prev += np.maximum(deltas, 0)
 
         rows, cols = np.nonzero(mask)
         for i, j in zip(rows.tolist(), cols.tolist()):
