@@ -377,6 +377,8 @@ class Manager:
             self.scheduler.join()
 
             self.stats.sim_time_ns = self.config.general.stop_time
+            self.stats.events_executed = sum(
+                h.n_events_executed for h in self._host_order)
             self.stats.packets_sent = int(self.routing.packet_counters.sum())
             self.stats.packets_dropped = self.shared.packet_drop_count
             self.stats.wall_seconds = _walltime.monotonic() - wall_start

@@ -65,6 +65,7 @@ class Host:
         self._local_event_id = 0
         self._packet_event_id = 0
         self._packet_priority = 0
+        self.n_events_executed = 0  # summed into SimStats at teardown
         # virtual PID allocation base (process.FIRST_PID; not imported to
         # keep host free of process-plane dependencies)
         self._next_pid = 1000
@@ -205,6 +206,7 @@ class Host:
                 event = self.event_queue.pop()
 
             self._now = event.time
+            self.n_events_executed += 1
             if self._worker is not None:
                 self._worker.current_time = event.time
 

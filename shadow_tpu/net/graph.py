@@ -293,6 +293,11 @@ class RoutingInfo:
         i, j = self._pos[src_node], self._pos[dst_node]
         return PathProperties(int(self.latency_ns[i, j]), float(self.packet_loss[i, j]))
 
+    def node_index(self, node_id: int) -> int:
+        """Row/col index of a node id in the dense matrices (used_ids
+        order) — the same index the TPU plane's host_node map uses."""
+        return self._pos[node_id]
+
     def increment_packet_count(self, src_node: int, dst_node: int, n: int = 1) -> None:
         self.packet_counters[self._pos[src_node], self._pos[dst_node]] += n
 

@@ -8,13 +8,15 @@ Payload bytes never leave the host: the (src, seq) pair correlates delivered
 metadata back to payloads buffered CPU-side.
 """
 
-from .plane import NetPlaneParams, NetPlaneState, ingest, make_params, make_state, window_step
+from .plane import (NetPlaneParams, NetPlaneState, ingest, ingest_rows,
+                    make_params, make_state, window_step)
 from .mesh import host_sharding, make_mesh, shard_state
 
 __all__ = [
     "NetPlaneParams",
     "NetPlaneState",
     "ingest",
+    "ingest_rows",
     "make_params",
     "make_state",
     "window_step",

@@ -8,9 +8,10 @@ partitioner to on-mesh collectives — the moral equivalent of the reference's
 cross-thread `push_packet_to_host` (`worker.rs:629-639`) riding ICI instead
 of a mutex.
 
-Routing matrices are row-sharded ([N, N]: rows = sending host, so each
-shard holds its own hosts' outbound path data); scalar/stat arrays shard on
-their only axis.
+Path tables are node-level ([M, M], M = graph nodes) and small, so they are
+replicated to every device along with the [N] host->node map (destination
+lookups index any host's node); per-host scalar/stat arrays shard on their
+only axis.
 """
 
 from __future__ import annotations
@@ -39,10 +40,15 @@ def host_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def param_shardings(mesh: Mesh) -> NetPlaneParams:
-    row = NamedSharding(mesh, P(HOST_AXIS, None))
+    # node-level path tables are small ([M, M], M = graph nodes) and every
+    # shard gathers arbitrary (src, dst) pairs from them: replicate — and
+    # host_node too, since destination lookups index ANY host's node; the
+    # per-host vectors shard with the host axis
+    rep = NamedSharding(mesh, P())
     vec = NamedSharding(mesh, P(HOST_AXIS))
-    return NetPlaneParams(latency_ns=row, loss=row, tb_rate=vec, tb_cap=vec,
-                          qdisc_rr=vec, dn_rate=vec, dn_cap=vec)
+    return NetPlaneParams(latency_ns=rep, loss=rep, host_node=rep,
+                          tb_rate=vec, tb_cap=vec, qdisc_rr=vec,
+                          dn_rate=vec, dn_cap=vec)
 
 
 def shard_state(state: NetPlaneState, params: NetPlaneParams, mesh: Mesh):

@@ -51,10 +51,19 @@ NO_CLAMP = np.int32(-(2**30))
 
 
 class NetPlaneParams(NamedTuple):
-    """Static per-simulation data (replicated or row-sharded over the mesh)."""
+    """Static per-simulation data.
 
-    latency_ns: jax.Array  # [N, N] int32 — path latency between hosts
-    loss: jax.Array  # [N, N] float32 — path loss probability
+    Path properties are NODE-level ([M, M] with a [N] host→node map), the
+    shape the GML graph actually has (`net/graph.py` RoutingInfo): real
+    topologies have far fewer graph nodes than hosts, so the latency/loss
+    tables stay small enough for VMEM residency — a [N, N] host-pair
+    gather at 16k hosts would be a 1 GiB HBM table with ~30 ns per random
+    lookup dominating the window step. Host-pair matrices still work:
+    pass host_node=arange(N) (the make_params default)."""
+
+    latency_ns: jax.Array  # [M, M] int32 — path latency between nodes
+    loss: jax.Array  # [M, M] float32 — path loss probability
+    host_node: jax.Array  # [N] int32 — graph node index of each host
     tb_rate: jax.Array  # [N] int32 — egress bytes per millisecond (up-bw)
     tb_cap: jax.Array  # [N] int32 — bucket capacity (rate/ms + 1 MTU burst)
     qdisc_rr: jax.Array  # [N] bool — per-host qdisc: round-robin vs FIFO
@@ -103,9 +112,13 @@ class NetPlaneState(NamedTuple):
 def make_params(latency_ns: np.ndarray, loss: np.ndarray, up_bw_bps: np.ndarray,
                 mtu: int = 1500,
                 qdisc_rr: np.ndarray | None = None,
-                down_bw_bps: np.ndarray | None = None) -> NetPlaneParams:
-    """Build params from the routing matrices (`RoutingInfo.latency_ns/loss`
-    mapped host→node) and per-host up-bandwidths in bits/sec.
+                down_bw_bps: np.ndarray | None = None,
+                host_node: np.ndarray | None = None) -> NetPlaneParams:
+    """Build params from the routing matrices (`RoutingInfo.latency_ns/loss`,
+    node-level [M, M]) and per-host up-bandwidths in bits/sec.
+
+    `host_node` [N] maps each host to its graph-node index; None means the
+    matrices are host-pair ([N, N]) and the identity map is used.
 
     `qdisc_rr` [N] bool selects the per-host queuing discipline
     (`QDiscMode` in `configuration.rs:961`): False = FIFO by packet
@@ -118,19 +131,25 @@ def make_params(latency_ns: np.ndarray, loss: np.ndarray, up_bw_bps: np.ndarray,
     # window_step (balance + rate*elapsed_eff <= cap + rate <= 2*rate + mtu)
     # can never overflow int32; 2^30 B/ms ~ 8.6 Tbit/s, beyond any modeled NIC
     rate = np.minimum(
-        np.maximum(1, (up_bw_bps // 8) // 1000), 2**30 - mtu
+        np.maximum(1, (np.asarray(up_bw_bps) // 8) // 1000), 2**30 - mtu
     ).astype(np.int32)  # B/ms
-    n = np.asarray(latency_ns).shape[0]
+    if host_node is None:
+        host_node = np.arange(np.asarray(latency_ns).shape[0], dtype=np.int32)
+    # host count: the host->node map defines it; a scalar bandwidth must
+    # broadcast to N (not M — the node tables can be smaller than the fleet)
+    n = np.asarray(host_node).shape[0]
+    rate = np.broadcast_to(rate, (n,))
     if down_bw_bps is None:
         dn_rate = np.full(n, 2**30 - mtu, np.int32)
     else:
-        dn_rate = np.minimum(
+        dn_rate = np.broadcast_to(np.minimum(
             np.maximum(1, (np.asarray(down_bw_bps) // 8) // 1000),
             2**30 - mtu,
-        ).astype(np.int32)
+        ).astype(np.int32), (n,))
     return NetPlaneParams(
         latency_ns=jnp.asarray(latency_ns, jnp.int32),
         loss=jnp.asarray(loss, jnp.float32),
+        host_node=jnp.asarray(host_node, jnp.int32),
         tb_rate=jnp.asarray(rate),
         tb_cap=jnp.asarray(rate + mtu, jnp.int32),
         qdisc_rr=(jnp.asarray(qdisc_rr, bool) if qdisc_rr is not None
@@ -188,6 +207,33 @@ def _row_sort(*arrays, keys: int):
     return jax.lax.sort(arrays, dimension=1, is_stable=True, num_keys=keys)
 
 
+def _pkt_uniform(rng_root: jax.Array, host: jax.Array,
+                 counter: jax.Array) -> jax.Array:
+    """Counter-based uniform [0,1) draw per (host, counter) slot.
+
+    One batched threefry_2x32 block cipher over all slots: the (host,
+    counter) pair IS the cipher's counter block, so the stream depends
+    only on (root_key, host, counter) — identical under any
+    vectorization, sharding, or queue occupancy (the determinism
+    contract) — while lowering to a single fused elementwise kernel.
+    (The per-slot `fold_in` formulation computed 2 full hashes per slot
+    through vmap and dominated the whole window step: 40 ms vs 0.1 ms
+    for this at 65k slots on a v5e.)
+    """
+    from jax.extend import random as jex_random
+
+    shape = host.shape
+    kd = jax.random.key_data(rng_root).astype(jnp.uint32)
+    count = jnp.concatenate([
+        host.reshape(-1).astype(jnp.uint32),
+        counter.reshape(-1).astype(jnp.uint32),
+    ])
+    bits = jex_random.threefry_2x32(kd, count)[: host.size].reshape(shape)
+    # 24 high-entropy bits -> float32 [0,1) (loss thresholds don't need
+    # more resolution than the CPU plane's Python float comparison)
+    return (bits >> 8).astype(jnp.float32) * np.float32(1.0 / (1 << 24))
+
+
 def _scatter_append(group, in_order_rank_src, n_valid, cap, n_groups):
     """Deterministic append-slot allocation for grouped scatter.
 
@@ -197,8 +243,14 @@ def _scatter_append(group, in_order_rank_src, n_valid, cap, n_groups):
     (flat_idx [B] into a [n_groups, cap] buffer with out-of-bounds for
     dropped/overflowed items, ok mask, overflow count per group).
     """
-    first = jnp.searchsorted(group, group, side="left")
-    rank = jnp.arange(group.shape[0], dtype=jnp.int32) - first.astype(jnp.int32)
+    # rank within group = i - first-occurrence(group[i]); group is sorted,
+    # so first-occurrence is a running cummax over segment starts (O(B),
+    # vs the O(B log B) searchsorted(group, group) that cost 9.5 ms at 65k)
+    idx = jnp.arange(group.shape[0], dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), group[1:] != group[:-1]])
+    first = jax.lax.cummax(jnp.where(is_start, idx, 0))
+    rank = idx - first
     in_range = group < n_groups
     slot = jnp.where(
         in_range, n_valid[jnp.clip(group, 0, n_groups - 1)] + rank, cap
@@ -239,12 +291,14 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
         clamp_rel = jnp.full_like(seq, NO_CLAMP)
     if sock is None:
         sock = jnp.zeros_like(seq)
-    # rank of each packet within its src group, deterministic by (src, seq)
-    order = jnp.lexsort((seq, src))
-    src_s, dst_s = src[order], dst[order]
-    bytes_s, prio_s = nbytes[order], prio[order]
-    seq_s, ctrl_s, tsend_s = seq[order], ctrl[order], send_rel[order]
-    clamp_s, sock_s = clamp_rel[order], sock[order]
+    # rank of each packet within its src group, deterministic by (src, seq);
+    # one variadic sort carries every payload column (see window_step's
+    # routing sort for why this beats lexsort + per-column gathers)
+    (src_s, seq_s, dst_s, bytes_s, prio_s, ctrl_s, tsend_s, clamp_s,
+     sock_s) = jax.lax.sort(
+        (src, seq, dst, nbytes, prio, ctrl, send_rel, clamp_rel, sock),
+        dimension=0, is_stable=True, num_keys=2,
+    )
 
     n_valid = state.eg_valid.sum(axis=1).astype(jnp.int32)  # [N]
     # rows are front-compacted (window_step re-sorts), so slot placement is
@@ -272,9 +326,52 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
     )
 
 
+def ingest_rows(state: NetPlaneState, dst: jax.Array, nbytes: jax.Array,
+                prio: jax.Array, seq: jax.Array, ctrl: jax.Array,
+                valid: jax.Array, send_rel: jax.Array | None = None,
+                clamp_rel: jax.Array | None = None,
+                sock: jax.Array | None = None) -> NetPlaneState:
+    """Append per-host batches ([N, K] arrays, row = emitting host) to the
+    egress queues. The row-shaped twin of `ingest` for producers that are
+    already host-major (on-device respawn loops, per-host socket emitters):
+    no flat cross-host sort is needed — one row-wise merge sort appends
+    each row's valid entries after the existing ones, in column order."""
+    N, CE = state.eg_dst.shape
+    if send_rel is None:
+        send_rel = jnp.zeros_like(seq)
+    if clamp_rel is None:
+        clamp_rel = jnp.full_like(seq, NO_CLAMP)
+    if sock is None:
+        sock = jnp.zeros_like(seq)
+
+    cat = lambda a, b: jnp.concatenate([a, b], axis=1)
+    inv = (~cat(state.eg_valid, valid)).astype(jnp.int32)
+    # stable sort by validity alone: existing entries (columns < CE, front-
+    # packed) stay ahead of the new ones, new entries keep column order
+    (_, dst_m, bytes_m, prio_m, seq_m, ctrl_m, tsend_m, clamp_m, sock_m,
+     valid_m) = _row_sort(
+        inv, cat(state.eg_dst, dst), cat(state.eg_bytes, nbytes),
+        cat(state.eg_prio, prio), cat(state.eg_seq, seq),
+        cat(state.eg_ctrl, ctrl), cat(state.eg_tsend, send_rel),
+        cat(state.eg_clamp, clamp_rel), cat(state.eg_sock, sock),
+        cat(state.eg_valid, valid), keys=1,
+    )
+    overflow = jnp.maximum(
+        valid_m.sum(axis=1, dtype=jnp.int32) - CE, 0)
+    return state._replace(
+        eg_dst=dst_m[:, :CE], eg_bytes=bytes_m[:, :CE],
+        eg_prio=prio_m[:, :CE], eg_seq=seq_m[:, :CE],
+        eg_ctrl=ctrl_m[:, :CE], eg_tsend=tsend_m[:, :CE],
+        eg_clamp=clamp_m[:, :CE], eg_sock=sock_m[:, :CE],
+        eg_valid=valid_m[:, :CE],
+        n_overflow_dropped=state.n_overflow_dropped + overflow,
+    )
+
+
 def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Array,
                 shift_ns: jax.Array, window_ns: jax.Array, *,
-                rr_enabled: bool = True, router_aqm: bool = False):
+                rr_enabled: bool = True, router_aqm: bool = False,
+                no_loss: bool = False):
     """Advance one scheduling round [t, t + window_ns).
 
     `rr_enabled` is a static (trace-time) switch: False compiles the
@@ -293,6 +390,11 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     down-bw bucket or CoDel interferes), and CoDel may drop it instead
     (counted in state.router.dropped). The CPU relay's bootstrap-period
     rate-limit bypass is not modeled on device.
+
+    `no_loss` (static) compiles out the loss draw + loss-table gather for
+    callers whose loss matrix is all zero (the integrated DeviceTransport,
+    where the CPU drew loss at capture). rng_counter still advances so
+    state stays bitwise-comparable with a loss-enabled run.
 
     `shift_ns` = this window's start minus the previous window's start;
     stored relative times are rebased by it. Returns
@@ -389,21 +491,30 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
         rr_sent = state.rr_sent
 
     # --- 3. loss sampling + latency lookup ------------------------------
+    # node-level tables: host -> node (VMEM-resident [N]) then the [M, M]
+    # path matrices — vs a [N, N] host-pair gather whose per-element HBM
+    # cost dominated the step at 4k+ hosts
     host_idx = jnp.arange(N, dtype=jnp.int32)[:, None]
-    counter = state.rng_counter[:, None] + jnp.arange(CE, dtype=jnp.int32)
-    pkt_key = jax.vmap(jax.vmap(
-        lambda h, c: jax.random.fold_in(jax.random.fold_in(rng_root, h), c)
-    ))(jnp.broadcast_to(host_idx, (N, CE)), counter)
-    u = jax.vmap(jax.vmap(jax.random.uniform))(pkt_key)
     dst_clipped = jnp.clip(eg_dst, 0, N - 1)
-    p_loss = params.loss[jnp.broadcast_to(host_idx, (N, CE)), dst_clipped]
-    lost = sendable & (u < p_loss) & ~eg_ctrl
-    sent = sendable & ~lost
+    node_src = params.host_node[:, None]  # [N, 1]
+    node_dst = params.host_node[dst_clipped]  # [N, CE]
+    if no_loss:
+        # transport mode: the loss draw happened on the CPU at capture
+        # (loss matrix is all zero) — skip the gather and the RNG entirely
+        lost = jnp.zeros_like(sendable)
+        sent = sendable
+    else:
+        counter = state.rng_counter[:, None] + jnp.arange(CE, dtype=jnp.int32)
+        u = _pkt_uniform(rng_root, jnp.broadcast_to(host_idx, (N, CE)),
+                         counter)
+        p_loss = params.loss[jnp.broadcast_to(node_src, (N, CE)), node_dst]
+        lost = sendable & (u < p_loss) & ~eg_ctrl
+        sent = sendable & ~lost
     # draws consumed only for slots that attempted transmission, keeping the
     # stream independent of queue occupancy beyond the sendable prefix
     rng_counter = state.rng_counter + sendable.sum(axis=1, dtype=jnp.int32)
 
-    latency = params.latency_ns[jnp.broadcast_to(host_idx, (N, CE)), dst_clipped]
+    latency = params.latency_ns[jnp.broadcast_to(node_src, (N, CE)), node_dst]
     # send time + latency, but no earlier than the round barrier the packet
     # was sent under (`worker.rs:396-399`); NO_CLAMP means "this window's
     # end" (pure-device mode, where ingest and step share the window)
@@ -435,20 +546,24 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     flat_seq = eg_seq.reshape(-1)
     flat_bytes = eg_bytes.reshape(-1)
 
-    # deterministic insertion order per destination
-    order = jnp.lexsort((flat_seq, flat_src, flat_deliver, flat_dst))
-    o_dst = flat_dst[order]
-    o_sent = flat_sent[order]
+    # deterministic insertion order per destination: ONE variadic sort
+    # moves the payload columns through the sorting network — applying a
+    # lexsort permutation with per-column gathers costs ~0.5 ms per
+    # column at 65k slots on TPU (arbitrary-index gathers are DMA-bound)
+    (o_dst, o_deliver, o_src, o_seq, o_bytes, o_sent) = jax.lax.sort(
+        (flat_dst, flat_deliver, flat_src, flat_seq, flat_bytes, flat_sent),
+        dimension=0, is_stable=True, num_keys=4,
+    )
     flat_idx, ok, overflowed = _scatter_append(o_dst, o_sent, n_valid_in, CI, N)
 
     def scatter(buf, vals):
         return buf.reshape(-1).at[flat_idx].set(vals, mode="drop").reshape(N, CI)
 
-    in_src_m = scatter(in_src_c, flat_src[order])
-    in_seq_m = scatter(in_seq_c, flat_seq[order])
-    in_bytes_m = scatter(in_bytes_c, flat_bytes[order])
+    in_src_m = scatter(in_src_c, o_src)
+    in_seq_m = scatter(in_seq_c, o_seq)
+    in_bytes_m = scatter(in_bytes_c, o_bytes)
     in_deliver_m = scatter(
-        jnp.where(in_valid_c, in_deliver_c, I32_MAX), flat_deliver[order]
+        jnp.where(in_valid_c, in_deliver_c, I32_MAX), o_deliver
     )
     # non-ok slots carry an out-of-bounds flat_idx, so only accepted
     # arrivals flip their slot valid

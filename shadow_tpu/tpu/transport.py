@@ -53,26 +53,30 @@ class DeviceTransport:
         n = len(self.hosts)
         assert [h.host_id for h in self.hosts] == list(range(1, n + 1))
 
-        lat = np.zeros((n, n), np.int64)
-        for i, a in enumerate(self.hosts):
-            for j, b in enumerate(self.hosts):
-                props = routing.path(a.node_id, b.node_id)
-                lat[i, j] = props.latency_ns
-        if lat.max() >= I32_MAX:
+        # node-level tables straight from the routing plane ([M, M], M =
+        # graph nodes actually used) + a host->node map; no O(N^2) host
+        # pair materialization
+        node_lat = np.asarray(routing.latency_ns)
+        if node_lat.size and node_lat.max() >= I32_MAX:
             raise ValueError("path latency exceeds the int32 device budget")
+        host_node = np.asarray(
+            [routing.node_index(h.node_id) for h in self.hosts], np.int32)
+        m = node_lat.shape[0]
         self.params = plane.make_params(
-            lat.astype(np.int32),
-            np.zeros((n, n), np.float32),  # loss drawn at capture, on CPU
+            node_lat.astype(np.int32),
+            np.zeros((m, m), np.float32),  # loss drawn at capture, on CPU
             np.full(n, 8e12),  # transparent bucket: relays already paced
+            host_node=host_node,
         )
         self.state = plane.make_state(n, egress_cap, ingress_cap,
                                       initial_tokens=np.full(
                                           n, I32_MAX // 2, np.int32))
         self._rng_root = jax.random.PRNGKey(0)  # unused: loss matrix is 0
-        # qdisc ordering happened on the CPU NIC before capture, so the
-        # device plane compiles the FIFO-only path
+        # qdisc ordering happened on the CPU NIC before capture (FIFO-only
+        # compile) and loss was drawn there too (no_loss compiles out the
+        # draw + table gather)
         self._step = jax.jit(
-            lambda *a: plane.window_step(*a, rr_enabled=False))
+            lambda *a: plane.window_step(*a, rr_enabled=False, no_loss=True))
         self._ingest = jax.jit(plane.ingest)
         self._ingress_cap = ingress_cap
 

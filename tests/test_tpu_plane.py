@@ -52,6 +52,61 @@ def test_ingest_places_packet():
     assert int(state.eg_seq[0, 0]) == 7
 
 
+def test_ingest_rows_matches_flat_ingest():
+    """The row-shaped twin must land packets in exactly the state the flat
+    ingest produces (slot-for-slot), including appends after existing
+    entries and per-row overflow counting."""
+    from shadow_tpu.tpu import ingest_rows
+
+    state_a, params = simple_world()
+    state_b, _ = simple_world()
+    # pre-existing entry on host 1 in both
+    state_a = send_one(state_a, 1, 0, seq=99)
+    state_b = send_one(state_b, 1, 0, seq=99)
+
+    # flat batch: host 0 sends 2, host 1 sends 1 (in (src, seq) order)
+    state_a = ingest(
+        state_a,
+        jnp.array([0, 0, 1], jnp.int32), jnp.array([2, 3, 2], jnp.int32),
+        jnp.array([100, 200, 300], jnp.int32),
+        jnp.array([5, 6, 7], jnp.int32), jnp.array([5, 6, 7], jnp.int32),
+        jnp.array([False, True, False]),
+    )
+    # same packets as [N, K] rows
+    N, K = state_b.eg_dst.shape[0], 2
+    dst = jnp.full((N, K), -1, jnp.int32)
+    dst = dst.at[0, 0].set(2).at[0, 1].set(3).at[1, 0].set(2)
+    nbytes = jnp.zeros((N, K), jnp.int32)
+    nbytes = nbytes.at[0, 0].set(100).at[0, 1].set(200).at[1, 0].set(300)
+    pr = jnp.zeros((N, K), jnp.int32)
+    pr = pr.at[0, 0].set(5).at[0, 1].set(6).at[1, 0].set(7)
+    ctrl = jnp.zeros((N, K), bool).at[0, 1].set(True)
+    valid = jnp.zeros((N, K), bool)
+    valid = valid.at[0, 0].set(True).at[0, 1].set(True).at[1, 0].set(True)
+    state_b = ingest_rows(state_b, dst, nbytes, pr, pr, ctrl, valid)
+
+    for a, b in zip(jax.tree.leaves(state_a), jax.tree.leaves(state_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ingest_rows_overflow_counted():
+    from shadow_tpu.tpu import ingest_rows
+
+    state, params = simple_world()
+    CE = state.eg_dst.shape[1]
+    K = CE + 3
+    N = state.eg_dst.shape[0]
+    shape = (N, K)
+    valid = jnp.zeros(shape, bool).at[2, :].set(True)  # host 2 floods
+    state = ingest_rows(
+        state, jnp.zeros(shape, jnp.int32), jnp.full(shape, 10, jnp.int32),
+        jnp.zeros(shape, jnp.int32), jnp.zeros(shape, jnp.int32),
+        jnp.zeros(shape, bool), valid,
+    )
+    assert int(state.n_overflow_dropped[2]) == 3
+    assert int(state.eg_valid[2].sum()) == CE
+
+
 def test_packet_travels_with_latency():
     state, params = simple_world(latency_ms=10)
     key = jax.random.key(0)

@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""BASELINE.md benchmark ladder, rungs 2-3: end-to-end CPU-plane runs.
+
+Rung 2: tgen traffic mesh, 100 hosts, single-vertex graph (1_gbit_switch) —
+        BASELINE.md row 2, reference `src/test/tgen/` shape.
+Rung 3: 1k-host tgen over an Atlas-style GML with latency + loss —
+        BASELINE.md row 3, `docs/network_graph_overview.md` shape.
+
+Reports sim-sec/wall-sec, absolute event rate, and packet counts per rung as
+JSON lines. These are the HONEST end-to-end numbers (full syscall + network
+object planes), distinct from bench.py's device-plane PHOLD throughput.
+
+Usage: python tools/bench_ladder.py [2|3|all]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.manager import Manager
+
+MS = 1_000_000
+
+
+def run_rung(name: str, cfg_text: str) -> dict:
+    cfg = load_config_str(cfg_text)
+    mgr = Manager(cfg)
+    t0 = time.monotonic()
+    stats = mgr.run()
+    wall = time.monotonic() - t0
+    out = {
+        "rung": name,
+        "sim_seconds": stats.sim_time_ns / 1e9,
+        "wall_seconds": round(wall, 3),
+        "sim_per_wall": round(stats.sim_time_ns / 1e9 / wall, 3),
+        "events_per_sec": round(stats.events_executed / wall, 1),
+        "events": stats.events_executed,
+        "packets": stats.packets_sent,
+        "failures": len(stats.process_failures),
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def rung2(n_hosts: int = 100, size: int = 1_048_576) -> dict:
+    """100-host tgen mesh: one server, 99 clients each pulling 1 MiB."""
+    hosts = ["  server:\n    network_node_id: 0\n    processes:\n"
+             "    - {path: tgen-server, args: ['8888'], start_time: 1s,\n"
+             "       expected_final_state: running}"]
+    for i in range(n_hosts - 1):
+        hosts.append(
+            f"  client{i}:\n    network_node_id: 0\n    processes:\n"
+            f"    - {{path: tgen-client, args: ['server', '8888', "
+            f"'{size}', '1'], start_time: 2s}}"
+        )
+    cfg = ("general: {stop_time: 60s, seed: 1}\n"
+           "network:\n  graph:\n    type: 1_gbit_switch\n"
+           "hosts:\n" + "\n".join(hosts))
+    return run_rung("rung2_tgen_mesh_100", cfg)
+
+
+def rung3(n_hosts: int = 1000, n_nodes: int = 40,
+          size: int = 262_144) -> dict:
+    """1k hosts spread over an Atlas-style GML: full node mesh with
+    20-200 ms latencies and 0.1-1% loss; 25 tgen servers, 975 clients."""
+    rng = np.random.default_rng(7)
+    lines = ["graph [", "  directed 0"]
+    for i in range(n_nodes):
+        lines.append(f"  node [ id {i} host_bandwidth_up \"1 Gbit\""
+                     f" host_bandwidth_down \"1 Gbit\" ]")
+    for i in range(n_nodes):
+        for j in range(i, n_nodes):
+            lat = int(rng.integers(20, 200)) if i != j else 5
+            loss = float(rng.uniform(0.001, 0.01)) if i != j else 0.0
+            lines.append(f"  edge [ source {i} target {j} latency"
+                         f" \"{lat} ms\" packet_loss {loss:.4f} ]")
+    lines.append("]")
+    gml = "\n".join("      " + ln for ln in lines)
+
+    n_servers = 25
+    hosts = []
+    for s in range(n_servers):
+        hosts.append(
+            f"  server{s}:\n    network_node_id: {s % n_nodes}\n"
+            f"    processes:\n"
+            f"    - {{path: tgen-server, args: ['8888'], start_time: 1s,\n"
+            f"       expected_final_state: running}}"
+        )
+    for i in range(n_hosts - n_servers):
+        server = f"server{i % n_servers}"
+        hosts.append(
+            f"  client{i}:\n    network_node_id: {i % n_nodes}\n"
+            f"    processes:\n"
+            f"    - {{path: tgen-client, args: ['{server}', '8888', "
+            f"'{size}', '1'], start_time: {2 + (i % 10)}s}}"
+        )
+    cfg = ("general: {stop_time: 120s, seed: 1}\n"
+           "network:\n  graph:\n    type: gml\n    inline: |\n" + gml +
+           "\nhosts:\n" + "\n".join(hosts))
+    return run_rung("rung3_tgen_atlas_1k", cfg)
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("2", "all"):
+        rung2()
+    if which in ("3", "all"):
+        rung3()
+
+
+if __name__ == "__main__":
+    main()
