@@ -323,6 +323,14 @@ class Manager:
                 )
         return failures
 
+    def _min_host_event(self):
+        """Earliest pending event time across all hosts (None = all idle)."""
+        return min(
+            (t for t in (h.next_event_time() for h in self._host_order)
+             if t is not None),
+            default=None,
+        )
+
     def run(self) -> SimStats:
         wall_start = _walltime.monotonic()
         try:
@@ -333,17 +341,22 @@ class Manager:
                 tracker.start()
 
             # the scheduling loop (`manager.rs:392-478`)
-            min_next = min(
-                (t for t in (h.next_event_time() for h in self.hosts) if t is not None),
-                default=None,
-            )
+            min_next = self._min_host_event()
             window = self.controller.next_window(min_next)
             while window is not None:
                 start, end = window
                 if self.transport is not None:
                     # release device-held packets due in this window into
-                    # host event queues before anyone executes
-                    self.transport.release(start, end)
+                    # host event queues before anyone executes; the device
+                    # chains straight through delivery-free windows up to
+                    # the earliest CPU-side event (host queues are
+                    # quiescent here, so that horizon is exact)
+                    host_min = self._min_host_event()
+                    self.transport.release(
+                        start, end, horizon_ns=host_min,
+                        runahead_ns=self.runahead.get(),
+                        stop_ns=self.controller.stop_time,
+                    )
                 min_next = self.scheduler.run_round(self._host_order, end)
                 if self.transport is not None:
                     # barrier: ship this round's captured egress to device

@@ -326,6 +326,64 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
     )
 
 
+def chain_windows(state: NetPlaneState, params: NetPlaneParams,
+                  rng_root: jax.Array, shift0, window0_ns, runahead_ns,
+                  horizon_rel, stop_rel, max_windows: int = 64, *,
+                  rr_enabled: bool = True, router_aqm: bool = False,
+                  no_loss: bool = False):
+    """Advance consecutive scheduling windows ON DEVICE until one delivers.
+
+    The device-resident analogue of the controller's window chain
+    (`controller.rs:87-113`): the first window ([shift0-rebased start,
+    +window0_ns)) runs unconditionally; afterwards, while a window
+    delivered nothing and the device's next event stays below both
+    `horizon_rel` (the earliest CPU-side event) and `stop_rel` (simulation
+    end), the next window opens at that next event with length
+    min(runahead_ns, stop_rel - start) — exactly the boundaries the CPU
+    controller would pick, since runahead only changes at capture time and
+    nothing is captured during an idle chain. One `lax.while_loop`, zero
+    host round trips for delivery-free windows.
+
+    `horizon_rel`/`stop_rel` are relative to the first window's start and
+    must be pre-clamped to <= I32_MAX // 2 by the caller (the chain simply
+    stops at the clamp and Python takes over).
+
+    Returns (state, delivered, off, next_rel, n_windows): `off` is the
+    LAST window's start relative to the first window's start — `delivered`
+    times and `next_rel` are relative to that last window's start.
+    """
+    def step(st, shift, window_ns):
+        return window_step(st, params, rng_root, shift, window_ns,
+                           rr_enabled=rr_enabled, router_aqm=router_aqm,
+                           no_loss=no_loss)
+
+    hs = jnp.minimum(jnp.int32(horizon_rel), jnp.int32(stop_rel))
+
+    state, delivered, next_ev = step(state, jnp.int32(shift0),
+                                     jnp.int32(window0_ns))
+
+    def keep_going(delivered, off, next_ev):
+        # hs - off > 0 and both < I32_MAX//2, so no overflow anywhere
+        return (~delivered["mask"].any()) & (next_ev < hs - off)
+
+    def cond(c):
+        _state, delivered, off, next_ev, n = c
+        return keep_going(delivered, off, next_ev) & (n < max_windows)
+
+    def body(c):
+        st, _delivered, off, next_ev, n = c
+        off2 = off + next_ev
+        window = jnp.minimum(jnp.int32(runahead_ns),
+                             jnp.int32(stop_rel) - off2)
+        st, delivered, next2 = step(st, next_ev, window)
+        return (st, delivered, off2, next2, n + 1)
+
+    state, delivered, off, next_ev, n = jax.lax.while_loop(
+        cond, body, (state, delivered, jnp.int32(0), next_ev, jnp.int32(1)),
+    )
+    return state, delivered, off, next_ev, n
+
+
 def ingest_rows(state: NetPlaneState, dst: jax.Array, nbytes: jax.Array,
                 prio: jax.Array, seq: jax.Array, ctrl: jax.Array,
                 valid: jax.Array, send_rel: jax.Array | None = None,

@@ -77,6 +77,11 @@ class DeviceTransport:
         # draw + table gather)
         self._step = jax.jit(
             lambda *a: plane.window_step(*a, rr_enabled=False, no_loss=True))
+        # the device-resident window chain (delivery-free rounds never
+        # leave the device); static_argnums: max_windows via default
+        self._chain = jax.jit(
+            lambda *a: plane.chain_windows(*a, rr_enabled=False,
+                                           no_loss=True))
         self._ingest = jax.jit(plane.ingest)
         self._ingress_cap = ingress_cap
 
@@ -121,14 +126,19 @@ class DeviceTransport:
         while pad < b:
             pad *= 2
         self._batch_pad = pad
+        # times go in relative to the DEVICE base (= this round's start,
+        # except when a window chain overshot a cross-thread post and the
+        # base sits ahead of the round — negative send_rel is fine, the
+        # arithmetic is all offsets)
+        base_ns = self._prev_start if self._prev_start is not None else start_ns
         arr = np.zeros((8, pad), np.int64)
         arr[0, b:] = len(self.hosts)  # pad slots: out-of-range src
-        arr[7, b:] = start_ns  # harmless clamp for dead slots
+        arr[7, b:] = base_ns  # harmless clamp for dead slots
         for i, row in enumerate(batch):
             for k in range(8):
                 arr[k, i] = int(row[k])
-        send_rel = arr[6] - start_ns
-        clamp_rel = arr[7] - start_ns  # the send-round's end
+        send_rel = arr[6] - base_ns
+        clamp_rel = arr[7] - base_ns  # the send-round's end
         self.state = self._ingest(
             self.state,
             jnp.asarray(arr[0], jnp.int32), jnp.asarray(arr[1], jnp.int32),
@@ -142,8 +152,19 @@ class DeviceTransport:
 
     # -- round start: release everything due in [start, end) -------------
 
-    def release(self, start_ns: int, end_ns: int) -> None:
-        """Run the window step and push due deliveries into host queues."""
+    def release(self, start_ns: int, end_ns: int,
+                horizon_ns: Optional[int] = None,
+                runahead_ns: Optional[int] = None,
+                stop_ns: Optional[int] = None) -> None:
+        """Run the window step and push due deliveries into host queues.
+
+        With `runahead_ns`/`stop_ns` given (the Manager's round loop), the
+        device chains through consecutive delivery-free windows in one
+        `lax.while_loop` — window boundaries identical to the ones the CPU
+        controller would pick — and only returns to Python when a window
+        delivers or the next device event reaches `horizon_ns` (the
+        earliest CPU-side event). Without them: one window (direct
+        callers, e.g. the bitwise parity tests)."""
         if not self._packets:
             # nothing on device: skip the step; rebasing is irrelevant
             # because every slot is invalid
@@ -151,12 +172,37 @@ class DeviceTransport:
             self.next_pending_abs = None
             return
         shift = 0 if self._prev_start is None else start_ns - self._prev_start
-        assert 0 <= shift < I32_MAX, "window shift exceeds int32 ns budget"
-        self._prev_start = start_ns
-        self.state, delivered, next_rel = self._step(
-            self.state, self.params, self._rng_root,
-            self._jnp.int32(shift), self._jnp.int32(end_ns - start_ns),
-        )
+        if shift < 0:
+            # A previous chain advanced the device base past this window's
+            # start (a cross-thread post — e.g. a managed-process death —
+            # scheduled an earlier CPU event after the chain ran). The
+            # device holds nothing before its base, so only [base, end)
+            # needs releasing; a window entirely behind the base has
+            # nothing on device at all.
+            if end_ns <= self._prev_start:
+                return
+            start_ns = self._prev_start
+            shift = 0
+        assert shift < I32_MAX, "window shift exceeds int32 ns budget"
+        jnp = self._jnp
+        if runahead_ns is not None and stop_ns is not None:
+            clamp = I32_MAX // 2
+            horizon_rel = min((horizon_ns if horizon_ns is not None
+                               else stop_ns) - start_ns, clamp)
+            stop_rel = min(stop_ns - start_ns, clamp)
+            self.state, delivered, off, next_rel, _n = self._chain(
+                self.state, self.params, self._rng_root, jnp.int32(shift),
+                jnp.int32(end_ns - start_ns), jnp.int32(runahead_ns),
+                jnp.int32(horizon_rel), jnp.int32(stop_rel),
+            )
+            base_ns = start_ns + int(off)
+        else:
+            self.state, delivered, next_rel = self._step(
+                self.state, self.params, self._rng_root,
+                jnp.int32(shift), jnp.int32(end_ns - start_ns),
+            )
+            base_ns = start_ns
+        self._prev_start = base_ns
         import jax
 
         mask, src, seq, d_t, overflow = jax.device_get((
@@ -180,14 +226,21 @@ class DeviceTransport:
                     tracker.counters.packets_dropped += int(deltas[i])
             self._overflow_prev += np.maximum(deltas, 0)
 
+        # deliveries are relative to the LAST window's start (base_ns =
+        # start_ns when no chaining happened)
         rows, cols = np.nonzero(mask)
-        for i, j in zip(rows.tolist(), cols.tolist()):
-            s, q, t = int(src[i, j]), int(seq[i, j]), int(d_t[i, j])
-            packet = self._packets.pop((s, q), None)
-            if packet is None:
-                continue  # overflow-dropped at ingest (already counted)
-            self.hosts[i].push_packet_event(packet, start_ns + t, s + 1, q)
+        if rows.size:
+            srcs = src[rows, cols].tolist()
+            seqs = seq[rows, cols].tolist()
+            times = d_t[rows, cols].tolist()
+            pop = self._packets.pop
+            hosts = self.hosts
+            for i, s, q, t in zip(rows.tolist(), srcs, seqs, times):
+                packet = pop((s, q), None)
+                if packet is None:
+                    continue  # overflow-dropped at ingest (already counted)
+                hosts[i].push_packet_event(packet, base_ns + t, s + 1, q)
 
         self.next_pending_abs = (
-            start_ns + int(next_rel) if int(next_rel) < I32_MAX else None
+            base_ns + int(next_rel) if int(next_rel) < I32_MAX else None
         )

@@ -208,6 +208,78 @@ def test_token_bucket_paces_egress():
     assert int(state.eg_valid.sum()) == 0
 
 
+def test_chain_windows_matches_manual_loop():
+    """The device-resident window chain must land in the bitwise-identical
+    state a Python loop applying the controller policy produces, stop at
+    the first delivering window, and report that window's offset."""
+    from shadow_tpu.tpu.plane import chain_windows
+
+    def build():
+        state, params = simple_world(n=4)
+        # two packets with different latencies: several delivery-free
+        # windows pass before the first arrival (simple_world latency
+        # between distinct hosts; send at t=0)
+        state = send_one(state, 0, 1, seq=1)
+        state = send_one(state, 2, 3, seq=2)
+        return state, params
+
+    key = jax.random.key(0)
+    W = MS  # 1 ms windows; simple_world latency is 10 ms
+    runahead = MS
+    horizon = 200 * MS
+    stop = 400 * MS
+
+    # manual controller loop: first window [0, W), then jump to next event
+    state_m, params = build()
+    off_m = 0
+    shift = 0
+    window = W
+    n_windows = 0
+    while True:
+        state_m, delivered_m, next_ev = window_step(
+            state_m, params, key, jnp.int32(shift), jnp.int32(window))
+        n_windows += 1
+        nxt = int(next_ev)
+        if bool(delivered_m["mask"].any()) or off_m + nxt >= min(horizon, stop):
+            break
+        off_m += nxt
+        shift = nxt
+        window = min(runahead, stop - off_m)
+
+    state_c, _ = build()
+    state_c, delivered_c, off_c, next_c, n_c = chain_windows(
+        state_c, params, key, 0, W, runahead, horizon, stop)
+
+    assert int(off_c) == off_m
+    assert int(n_c) == n_windows
+    assert bool(delivered_c["mask"].any())  # stopped BECAUSE it delivered
+    for a, b in zip(jax.tree.leaves(state_m), jax.tree.leaves(state_c)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in delivered_m:
+        np.testing.assert_array_equal(np.asarray(delivered_m[k]),
+                                      np.asarray(delivered_c[k]))
+    # both packets still in flight? no — seq 1 delivered; seq 2 from a
+    # different pair keeps the chain honest about per-window next events
+    assert int(delivered_c["mask"].sum()) >= 1
+
+
+def test_chain_windows_respects_horizon():
+    """A CPU-side event before the next device event must stop the chain
+    even with no deliveries produced."""
+    from shadow_tpu.tpu.plane import chain_windows
+
+    state, params = simple_world(n=2)
+    state = send_one(state, 0, 1, seq=5)
+    key = jax.random.key(0)
+    # horizon right after the first window: chain must stop at 1 window
+    state, delivered, off, next_rel, n = chain_windows(
+        state, params, key, 0, MS, MS, 2 * MS, 400 * MS)
+    assert int(n) == 1
+    assert int(off) == 0
+    assert not bool(delivered["mask"].any())
+    assert int(next_rel) < I32_MAX  # the packet is still coming
+
+
 def test_priority_orders_egress_under_contention():
     state, params = simple_world(bw_bps=8_000_000)  # 1000B/ms
     key = jax.random.key(0)
