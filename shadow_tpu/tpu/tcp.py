@@ -1,0 +1,981 @@
+"""TCP-on-TPU: the connection state machine as SoA arrays (phase C).
+
+Parity: `shadow_tpu/tcp/connection.py` (itself modeled on the reference's
+dependency-injected `TcpState`, `src/lib/tcp/src/lib.rs:238`) — every
+scalar of the CPU machine becomes a [C] array and one vmapped kernel steps
+C connections per event tick. Payload BYTES never live here: like the
+network plane, this is a metadata machine (offsets, lengths, windows,
+deadlines); the byte buffers stay host-side keyed by connection id.
+
+What is modeled bitwise-identically to the CPU machine (asserted by
+tests/test_tpu_tcp.py on recorded traces):
+- wire-sequence arithmetic (uint32 wrap), unwrapped int32 stream offsets
+- the full FSM: handshake (active/passive/simultaneous), ESTABLISHED,
+  FIN/CLOSE states, TIME_WAIT, RST paths, error codes
+- Reno congestion (slow start / avoidance / NewReno fast recovery with
+  partial-ack retransmits), RFC 6298 RTT/RTO in integer milliseconds
+- RTO/persist timers as per-connection generation counters + absolute
+  millisecond DEADLINE arrays (`rto_deadline_ms`), go-back-N timeout
+  recovery, zero-window probing
+- out-of-order reassembly as fixed-capacity (offset, len) range slots
+  (REASS_SLOTS per connection; coverage math only, no bytes)
+
+Event model (the CPU machine's API surface, one event per connection per
+step): OPEN_ACTIVE/OPEN_PASSIVE, WRITE(n)/READ(n), CLOSE/ABORT, SEG(hdr),
+PULL (= next_segment: emits segment metadata or none), TIMER_*(gen).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tcp.cong import INITIAL_WINDOW as INITIAL_CWND
+from ..tcp.cong import _SSTHRESH_INF as SSTHRESH_INF
+from ..tcp.connection import (DATA_RETRIES, MAX_WSCALE, MSS, SYN_RETRIES,
+                              TIME_WAIT_NS, TcpConfig)
+from ..tcp.rtt import RTO_INIT_MS, RTO_MAX_MS, RTO_MIN_MS
+
+# shared with the CPU machine so the bitwise-parity contract can't drift
+TIME_WAIT_MS = TIME_WAIT_NS // 1_000_000
+_CFG = TcpConfig()
+SEND_BUFFER = _CFG.send_buffer
+RECV_BUFFER = _CFG.recv_buffer
+
+REASS_SLOTS = 128  # >= recv_buffer/MSS: as many ranges as the window admits
+
+# TcpFlags (bit-identical to the CPU enum)
+FIN, SYN, RST, PSH, ACK, URG = 1, 2, 4, 8, 16, 32
+
+# TcpState (bit-identical)
+(CLOSED, LISTEN, SYN_SENT, SYN_RCVD, ESTABLISHED, FIN_WAIT_1, FIN_WAIT_2,
+ CLOSING, TIME_WAIT, CLOSE_WAIT, LAST_ACK) = range(11)
+
+# congestion phases
+PH_SLOW_START, PH_AVOIDANCE, PH_RECOVERY = 0, 1, 2
+
+# event kinds
+(EV_NONE, EV_OPEN_ACTIVE, EV_OPEN_PASSIVE, EV_WRITE, EV_READ, EV_CLOSE,
+ EV_ABORT, EV_SEG, EV_PULL, EV_TIMER_RTO, EV_TIMER_PERSIST,
+ EV_TIMER_TW) = range(12)
+
+N_FIELDS = 8  # per-event int32 args
+
+I32_MAX = np.int32(2**31 - 1)
+
+
+class TcpPlane(NamedTuple):
+    """Per-connection scalars, axis 0 = connection. u32 = wire values."""
+
+    state: jax.Array  # int32 TcpState
+    error: jax.Array  # int32 errno, 0 = none
+    error_consumed: jax.Array  # bool
+    # send side (int32 stream offsets; 0 = first payload byte)
+    iss: jax.Array  # uint32
+    snd_una: jax.Array
+    snd_nxt: jax.Array
+    snd_wnd: jax.Array
+    stream_len: jax.Array
+    snd_max: jax.Array
+    fin_requested: jax.Array  # bool
+    fin_sent: jax.Array  # bool
+    fin_acked: jax.Array  # bool
+    syn_outstanding: jax.Array  # bool
+    syn_sends: jax.Array
+    syn_acked: jax.Array  # bool
+    retx_pending: jax.Array  # bool
+    probe_pending: jax.Array  # bool
+    recover: jax.Array
+    gbn_high: jax.Array
+    rst_pending: jax.Array  # bool
+    # receive side
+    irs: jax.Array  # uint32
+    rcv_nxt: jax.Array
+    ordered_bytes: jax.Array
+    reass_bytes: jax.Array
+    fin_received: jax.Array  # bool
+    has_fin_offset: jax.Array  # bool
+    fin_offset: jax.Array
+    ack_pending: jax.Array  # bool
+    # options
+    my_wscale: jax.Array
+    peer_wscale: jax.Array
+    wscale_ok: jax.Array  # bool
+    last_ts_recv: jax.Array  # uint32
+    # RTT (integer ms, RFC 6298)
+    srtt_ms: jax.Array
+    rttvar_ms: jax.Array
+    rto_ms: jax.Array
+    backoff_count: jax.Array
+    # Reno
+    cwnd: jax.Array
+    ssthresh: jax.Array
+    phase: jax.Array
+    dup_acks: jax.Array
+    avoid_acked: jax.Array
+    # timers: generation counters + absolute-ms deadline arrays
+    rto_gen: jax.Array
+    rto_armed: jax.Array  # bool
+    rto_deadline_ms: jax.Array
+    persist_gen: jax.Array
+    persist_armed: jax.Array  # bool
+    persist_deadline_ms: jax.Array
+    retransmit_count: jax.Array
+    last_retx: jax.Array  # bool — last pulled segment was a retransmission
+    # reassembly ranges [C, REASS_SLOTS] (len 0 = free slot)
+    reass_off: jax.Array
+    reass_len: jax.Array
+
+
+def make_tcp_plane(n_conns: int) -> TcpPlane:
+    z = lambda: jnp.zeros((n_conns,), jnp.int32)
+    u = lambda: jnp.zeros((n_conns,), jnp.uint32)
+    f = lambda: jnp.zeros((n_conns,), bool)
+    # my_wscale from recv_buffer like TcpConnection.__init__ (scaling on)
+    ws = 0
+    while (RECV_BUFFER >> ws) > 0xFFFF and ws < MAX_WSCALE:
+        ws += 1
+    return TcpPlane(
+        state=z(), error=z(), error_consumed=f(),
+        iss=u(), snd_una=z(), snd_nxt=z(),
+        snd_wnd=jnp.full((n_conns,), MSS, jnp.int32),
+        stream_len=z(), snd_max=z(), fin_requested=f(), fin_sent=f(),
+        fin_acked=f(), syn_outstanding=f(), syn_sends=z(), syn_acked=f(),
+        retx_pending=f(), probe_pending=f(), recover=z(), gbn_high=z(),
+        rst_pending=f(),
+        irs=u(), rcv_nxt=z(), ordered_bytes=z(), reass_bytes=z(),
+        fin_received=f(), has_fin_offset=f(), fin_offset=z(),
+        ack_pending=f(),
+        my_wscale=jnp.full((n_conns,), ws, jnp.int32), peer_wscale=z(),
+        wscale_ok=f(), last_ts_recv=u(),
+        srtt_ms=z(), rttvar_ms=z(),
+        rto_ms=jnp.full((n_conns,), RTO_INIT_MS, jnp.int32),
+        backoff_count=z(),
+        cwnd=jnp.full((n_conns,), INITIAL_CWND, jnp.int32),
+        ssthresh=jnp.full((n_conns,), SSTHRESH_INF, jnp.int32),
+        phase=z(), dup_acks=z(), avoid_acked=z(),
+        rto_gen=z(), rto_armed=f(), rto_deadline_ms=z(),
+        persist_gen=z(), persist_armed=f(), persist_deadline_ms=z(),
+        retransmit_count=z(), last_retx=f(),
+        reass_off=jnp.zeros((n_conns, REASS_SLOTS), jnp.int32),
+        reass_len=jnp.zeros((n_conns, REASS_SLOTS), jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# scalar helpers (everything below runs per-connection under vmap)
+# ---------------------------------------------------------------------------
+
+def _u32(x):
+    return x.astype(jnp.uint32) if hasattr(x, "astype") else jnp.uint32(x)
+
+
+def _wire_seq(s, off):
+    return s.iss + _u32(1 + off)
+
+
+def _wire_ack(s):
+    off = s.rcv_nxt + jnp.where(s.fin_received, 1, 0)
+    return s.irs + _u32(1 + off)
+
+
+def _wire_rcv_nxt(s):
+    return s.irs + _u32(1 + s.rcv_nxt)
+
+
+def _recv_space(s):
+    used = s.ordered_bytes + s.reass_bytes
+    return jnp.maximum(0, RECV_BUFFER - used)
+
+
+def _advertised_window(s, for_syn):
+    space = _recv_space(s)
+    shift = jnp.where(for_syn | ~s.wscale_ok, 0, s.my_wscale)
+    return jnp.minimum(space >> shift, 0xFFFF)
+
+
+def _send_space(s):
+    return jnp.maximum(0, SEND_BUFFER - (s.stream_len - s.snd_una))
+
+
+def _set_rto(s, ms):
+    return s._replace(rto_ms=jnp.clip(ms, RTO_MIN_MS, RTO_MAX_MS))
+
+
+def _rtt_update(s, rtt_ms):
+    """RttEstimator.update (callers gate on backoff_count == 0)."""
+    rtt_ms = jnp.maximum(1, rtt_ms)
+    first = s.srtt_ms == 0
+    rttvar = jnp.where(
+        first, rtt_ms // 2,
+        (3 * s.rttvar_ms) // 4 + jnp.abs(s.srtt_ms - rtt_ms) // 4)
+    srtt = jnp.where(first, rtt_ms, (7 * s.srtt_ms) // 8 + rtt_ms // 8)
+    s = s._replace(srtt_ms=srtt, rttvar_ms=rttvar, backoff_count=jnp.int32(0))
+    return _set_rto(s, srtt + 4 * rttvar)
+
+
+def _rtt_backoff(s):
+    s = s._replace(backoff_count=s.backoff_count + 1)
+    return _set_rto(s, s.rto_ms * 2)
+
+
+def _rtt_reset_backoff(s):
+    had = s.backoff_count > 0
+    s2 = s._replace(backoff_count=jnp.int32(0))
+    s2 = _set_rto(s2, jnp.where(s.srtt_ms > 0,
+                                s.srtt_ms + 4 * s.rttvar_ms, RTO_INIT_MS))
+    return _sel(had, s2, s)
+
+
+def _sel(pred, a: TcpPlane, b: TcpPlane) -> TcpPlane:
+    """Per-field select: pred ? a : b (pred is a scalar bool here)."""
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+# -- Reno ------------------------------------------------------------------
+
+def _avoid_tick(cwnd, acked, n):
+    acked = acked + n
+
+    def cond(c):
+        a, w = c
+        return a >= w
+
+    def body(c):
+        a, w = c
+        return a - w, w + 1
+
+    acked, cwnd = jax.lax.while_loop(cond, body, (acked, cwnd))
+    return cwnd, acked
+
+
+def _cong_new_ack(s, n):
+    s0 = s._replace(dup_acks=jnp.int32(0))
+    # recovery: deflate to ssthresh, enter avoidance carrying n
+    cw_r, aa_r = _avoid_tick(s0.ssthresh, jnp.int32(0), n)
+    rec = s0._replace(cwnd=cw_r, phase=jnp.int32(PH_AVOIDANCE),
+                      avoid_acked=aa_r)
+    # slow start
+    new_cwnd = s0.cwnd + n
+    reach = new_cwnd >= s0.ssthresh
+    cw_s, aa_s = _avoid_tick(s0.ssthresh, jnp.int32(0),
+                             jnp.maximum(new_cwnd - s0.ssthresh, 0))
+    ss_reach = s0._replace(cwnd=cw_s, phase=jnp.int32(PH_AVOIDANCE),
+                           avoid_acked=aa_s)
+    ss_stay = s0._replace(cwnd=new_cwnd)
+    ss = _sel(reach, ss_reach, ss_stay)
+    # avoidance
+    cw_a, aa_a = _avoid_tick(s0.cwnd, s0.avoid_acked, n)
+    av = s0._replace(cwnd=cw_a, avoid_acked=aa_a)
+    return _sel(s.phase == PH_RECOVERY, rec,
+                _sel(s.phase == PH_SLOW_START, ss, av))
+
+
+def _cong_dup_ack(s):
+    """Returns (state', fast_retransmit_now)."""
+    in_rec = s.phase == PH_RECOVERY
+    inflated = s._replace(cwnd=s.cwnd + 1)
+    bumped = s._replace(dup_acks=s.dup_acks + 1)
+    third = bumped.dup_acks == 3
+    ssthresh = s.cwnd // 2 + 1
+    entered = bumped._replace(ssthresh=ssthresh, cwnd=ssthresh + 3,
+                              phase=jnp.int32(PH_RECOVERY))
+    out = _sel(in_rec, inflated, _sel(third, entered, bumped))
+    return out, (~in_rec) & third
+
+
+def _cong_partial_ack(s, n):
+    return s._replace(cwnd=jnp.maximum(1, s.cwnd - n + 1))
+
+
+def _cong_timeout(s):
+    return s._replace(dup_acks=jnp.int32(0), ssthresh=s.cwnd // 2 + 1,
+                      cwnd=jnp.int32(INITIAL_CWND),
+                      phase=jnp.int32(PH_SLOW_START))
+
+
+# -- timers ----------------------------------------------------------------
+
+def _arm_rto(s, now_ms):
+    return s._replace(rto_gen=s.rto_gen + 1, rto_armed=jnp.bool_(True),
+                      rto_deadline_ms=now_ms + s.rto_ms)
+
+
+def _disarm_rto(s):
+    return s._replace(rto_gen=s.rto_gen + 1, rto_armed=jnp.bool_(False))
+
+
+def _arm_persist(s, now_ms):
+    armed = s._replace(persist_gen=s.persist_gen + 1,
+                       persist_armed=jnp.bool_(True),
+                       persist_deadline_ms=now_ms + s.rto_ms)
+    return _sel(s.persist_armed, s, armed)
+
+
+# -- reassembly (coverage math over fixed (off, len) slots) ----------------
+
+def _reass_insert(s, off, length):
+    """_Reassembly.insert: keep the longer of same-offset entries; claim a
+    free slot otherwise (slot exhaustion drops the range — the peer will
+    retransmit; counted nowhere, exactly like a recv-buffer trim)."""
+    same = (s.reass_len > 0) & (s.reass_off == off)
+    has_same = same.any()
+    longer = length > jnp.where(same, s.reass_len, -1)
+    upd_len = jnp.where(same & longer, length, s.reass_len)
+    # free slot: first with len == 0
+    free = s.reass_len == 0
+    first_free = jnp.argmax(free)
+    any_free = free.any()
+    ins_off = s.reass_off.at[first_free].set(
+        jnp.where(~has_same & any_free, off, s.reass_off[first_free]))
+    ins_len = s.reass_len.at[first_free].set(
+        jnp.where(~has_same & any_free, length, s.reass_len[first_free]))
+    off_out = jnp.where(has_same, s.reass_off, ins_off)
+    len_out = jnp.where(has_same, upd_len, ins_len)
+    bytes_out = (jnp.where(len_out > 0, len_out, 0).sum()
+                 .astype(jnp.int32))
+    return s._replace(reass_off=off_out, reass_len=len_out,
+                      reass_bytes=bytes_out)
+
+
+def _reass_drain(s):
+    """_Reassembly.drain_from(rcv_nxt): advance through contiguous
+    coverage, drop consumed/stale slots. Returns (state', advanced)."""
+    off0 = s.rcv_nxt
+
+    def body(_, off):
+        covering = (s.reass_len > 0) & (s.reass_off <= off) \
+            & (off < s.reass_off + s.reass_len)
+        end = jnp.where(covering, s.reass_off + s.reass_len, off).max()
+        return jnp.maximum(off, end)
+
+    off = jax.lax.fori_loop(0, REASS_SLOTS, body, off0)
+    keep = (s.reass_len > 0) & (s.reass_off + s.reass_len > off)
+    new_len = jnp.where(keep, s.reass_len, 0)
+    new_bytes = new_len.sum().astype(jnp.int32)
+    adv = off - off0
+    return s._replace(
+        rcv_nxt=off, reass_len=new_len, reass_bytes=new_bytes,
+        ordered_bytes=s.ordered_bytes + adv,
+    ), adv
+
+
+# ---------------------------------------------------------------------------
+# event handlers (scalar; mirror TcpConnection method-for-method)
+# ---------------------------------------------------------------------------
+
+def _enter_closed(s, errno):
+    """errno 0 = none."""
+    s2 = _disarm_rto(s._replace(state=jnp.int32(CLOSED)))
+    s2 = s2._replace(
+        error=jnp.where((errno != 0) & (s.error == 0), errno, s.error),
+        persist_gen=s2.persist_gen + 1,
+    )
+    return s2
+
+
+def _enter_time_wait(s, now_ms):
+    s2 = _disarm_rto(s._replace(state=jnp.int32(TIME_WAIT)))
+    # the TIME_WAIT timer rides the rto generation (connection.py:867-874)
+    return s2._replace(rto_deadline_ms=now_ms + TIME_WAIT_MS)
+
+
+def _ev_open_active(s, f, now_ms):
+    s = s._replace(iss=f[0].astype(jnp.uint32),
+                   state=jnp.int32(SYN_SENT))
+    return _arm_rto(s, now_ms)
+
+
+def _ev_open_passive(s, f, now_ms):
+    # f: iss, syn_seq, syn_window, wscale(-1 none), ts, ts_echo
+    has_ws = f[3] >= 0
+    s = s._replace(
+        iss=f[0].astype(jnp.uint32), irs=f[1].astype(jnp.uint32),
+        rcv_nxt=jnp.int32(0),
+        peer_wscale=jnp.where(has_ws, jnp.minimum(f[3], MAX_WSCALE),
+                              s.peer_wscale),
+        wscale_ok=has_ws,
+        my_wscale=jnp.where(has_ws, s.my_wscale, 0),
+        snd_wnd=f[2],
+        last_ts_recv=jnp.where(f[4] != 0, f[4].astype(jnp.uint32),
+                               s.last_ts_recv),
+        state=jnp.int32(SYN_RCVD),
+    )
+    return _arm_rto(s, now_ms)
+
+
+def _ev_write(s, f, now_ms):
+    """Returns (state', accepted-or-negative-errno)."""
+    err = s.error != 0
+    notconn = (s.state == CLOSED) | (s.state == LISTEN)
+    pipe = s.fin_requested
+    n = jnp.minimum(_send_space(s), f[0])
+    accepted = s._replace(stream_len=s.stream_len + n)
+    accepted = _sel(
+        (n > 0) & (s.snd_wnd == 0) & (s.state >= ESTABLISHED),
+        _arm_persist(accepted, now_ms), accepted)
+    bad = err | notconn | pipe
+    ret = jnp.where(err, -s.error,
+                    jnp.where(notconn, -107, jnp.where(pipe, -32, n)))
+    return _sel(bad, s, accepted), ret
+
+
+def _ev_read(s, f):
+    """Returns (state', got-or-negative-errno)."""
+    err_path = (s.error != 0) & (s.ordered_bytes == 0)
+    eof = s.error_consumed
+    raise_now = err_path & ~eof
+    got = jnp.minimum(f[0], s.ordered_bytes)
+    drained = s._replace(
+        ordered_bytes=s.ordered_bytes - got,
+        ack_pending=s.ack_pending | (got > 0),
+    )
+    out = _sel(err_path, s._replace(error_consumed=jnp.bool_(True)), drained)
+    ret = jnp.where(raise_now, -s.error, jnp.where(err_path, 0, got))
+    return out, ret
+
+
+def _ev_close(s):
+    st = s.state
+    trivially = (st == CLOSED) | (st == LISTEN)
+    syn_sent = st == SYN_SENT
+    already = s.fin_requested
+    nxt = jnp.where(
+        (st == ESTABLISHED) | (st == SYN_RCVD), FIN_WAIT_1,
+        jnp.where(st == CLOSE_WAIT, LAST_ACK, st))
+    closed = s._replace(state=jnp.int32(CLOSED))
+    requested = s._replace(fin_requested=jnp.bool_(True),
+                           state=nxt.astype(jnp.int32))
+    return _sel(trivially, closed,
+                _sel(syn_sent, _enter_closed(s, jnp.int32(0)),
+                     _sel(already, s, requested)))
+
+
+def _ev_abort(s):
+    st = s.state
+    trivially = (st == CLOSED) | (st == LISTEN) | (st == TIME_WAIT)
+    return _sel(trivially, s._replace(state=jnp.int32(CLOSED)),
+                s._replace(rst_pending=jnp.bool_(True)))
+
+
+# -- segment ingress -------------------------------------------------------
+
+def _unwrap_ack(s, wire_ack_u):
+    """Returns (ignore, adv, is_eq): ignore = RFC 793 never-sent ack;
+    adv = forward stream-bytes acked (0 when backward/equal); is_eq =
+    ack sits exactly at snd_una."""
+    base = _wire_seq(s, s.snd_una)
+    delta = (wire_ack_u - base).astype(jnp.uint32)
+    is_fwd = delta < jnp.uint32(1 << 31)
+    limit = (jnp.maximum(s.snd_nxt, s.snd_max) - s.snd_una).astype(jnp.uint32)
+    fwd_valid = is_fwd & (delta <= limit)
+    ignore = is_fwd & ~fwd_valid
+    adv = jnp.where(fwd_valid, delta.astype(jnp.int32), 0)
+    is_eq = fwd_valid & (delta == 0)
+    return ignore, adv, is_eq
+
+
+def _process_ack(s, f, now_ms):
+    wire_ack = f[2].astype(jnp.uint32)
+    paylen, wnd = f[4], f[3]
+    ts_echo = f[7]
+    ignore, adv, is_eq = _unwrap_ack(s, wire_ack)
+    ack_off = s.snd_una + adv
+
+    # SYN_RCVD completing ACK: any FORWARD-valid ack (ack_off >= 0,
+    # connection.py:644) — a stale backward ack must NOT complete it
+    fwd_valid = is_eq | (adv > 0)
+    complete = (s.state == SYN_RCVD) & fwd_valid
+    s_hs = s._replace(syn_acked=jnp.bool_(True),
+                      state=jnp.int32(ESTABLISHED))
+    s_hs = _disarm_rto(s_hs)
+    s_hs = _sel((ts_echo != 0) & (s_hs.backoff_count == 0),
+                _rtt_update(s_hs, now_ms - ts_echo), s_hs)
+    s = _sel(complete, s_hs, s)
+
+    fin_off = s.stream_len + 1
+    new_window = (wnd << jnp.where(s.wscale_ok, s.peer_wscale, 0)) \
+        .astype(jnp.int32)
+
+    # --- new data acked -------------------------------------------------
+    newly = adv > 0
+    acked_bytes = jnp.minimum(ack_off, s.stream_len) - s.snd_una
+    a = s._replace(snd_una=jnp.minimum(ack_off, s.stream_len))
+    ack_covers_fin = s.fin_sent & (ack_off >= fin_off)
+    a = a._replace(
+        fin_acked=a.fin_acked | ack_covers_fin,
+        snd_una=jnp.where(ack_covers_fin, a.stream_len, a.snd_una))
+    a = a._replace(snd_nxt=jnp.maximum(a.snd_nxt, a.snd_una))
+    n_seg = (acked_bytes + MSS - 1) // MSS
+    partial = (a.phase == PH_RECOVERY) & (ack_off < a.recover)
+    a_partial = _cong_partial_ack(a, n_seg)._replace(
+        retx_pending=jnp.bool_(True))
+    a_full = _cong_new_ack(a, n_seg)._replace(retx_pending=jnp.bool_(False))
+    a = _sel(acked_bytes > 0, _sel(partial, a_partial, a_full),
+             a._replace(retx_pending=jnp.bool_(False)))
+    a = _sel((ts_echo != 0) & (a.backoff_count == 0),
+             _rtt_update(a, now_ms - ts_echo), a)
+    a = _rtt_reset_backoff(a)
+    in_flight = (a.snd_nxt > a.snd_una) | (a.fin_sent & ~a.fin_acked)
+    a = _sel(in_flight, _arm_rto(a, now_ms), _disarm_rto(a))
+    # FIN-acked transitions
+    fw1 = a.state == FIN_WAIT_1
+    closing = a.state == CLOSING
+    last = a.state == LAST_ACK
+    a = _sel(a.fin_acked & fw1, a._replace(state=jnp.int32(FIN_WAIT_2)),
+             _sel(a.fin_acked & closing, _enter_time_wait(a, now_ms),
+                  _sel(a.fin_acked & last, _enter_closed(a, jnp.int32(0)),
+                       a)))
+
+    # --- duplicate ack --------------------------------------------------
+    dup = (is_eq & (paylen == 0) & (s.snd_nxt > s.snd_una)
+           & (new_window == s.snd_wnd) & (new_window > 0))
+    d, fast = _cong_dup_ack(s)
+    d = _sel(fast, d._replace(retx_pending=jnp.bool_(True),
+                              recover=d.snd_nxt), d)
+
+    out = _sel(newly, a, _sel(dup, d, s))
+    out = out._replace(snd_wnd=new_window)
+    out = _sel((out.snd_wnd == 0) & (out.stream_len > out.snd_nxt),
+               _arm_persist(out, now_ms), out)
+    return _sel(ignore, s, out)
+
+
+def _process_payload(s, f, now_ms):
+    seq_u, paylen = f[1].astype(jnp.uint32), f[4]
+    tw = s.state == TIME_WAIT
+    base = _wire_rcv_nxt(s)
+    delta = (seq_u - base).astype(jnp.uint32)
+    is_fwd = delta < jnp.uint32(1 << 31)
+    back = (base - seq_u).astype(jnp.int32)  # valid when ~is_fwd
+
+    # backward: trim left by `back`; forward: starts `delta` into the space
+    pure_dup = ~is_fwd & (back >= paylen)
+    space = _recv_space(s)
+    beyond = is_fwd & (delta.astype(jnp.int32) >= space)
+    eff_off = jnp.where(is_fwd, s.rcv_nxt + delta.astype(jnp.int32),
+                        s.rcv_nxt)
+    raw_len = jnp.where(is_fwd, paylen, paylen - back)
+    # right-trim to the receive window in both cases
+    avail = space - (eff_off - s.rcv_nxt)
+    eff_len = jnp.minimum(raw_len, avail)
+    ok = ~tw & ~pure_dup & ~beyond & (eff_len > 0)
+
+    ins = _reass_insert(s, eff_off, eff_len)
+    ins, _adv = _reass_drain(ins)
+    out = _sel(ok, ins, s)
+    out = out._replace(ack_pending=jnp.bool_(True))
+    return _sel(tw | pure_dup | beyond, out,
+                _maybe_apply_fin_t(out, now_ms))
+
+
+def _maybe_apply_fin_t(s, now_ms):
+    """_maybe_apply_pending_fin (clock only feeds TIME_WAIT's deadline)."""
+    applies = (~s.fin_received & s.has_fin_offset
+               & (s.fin_offset <= s.rcv_nxt))
+    a = s._replace(fin_received=jnp.bool_(True))
+    est = a.state == ESTABLISHED
+    fw1 = a.state == FIN_WAIT_1
+    fw2 = a.state == FIN_WAIT_2
+    a = _sel(est, a._replace(state=jnp.int32(CLOSE_WAIT)),
+             _sel(fw1 & a.fin_acked, _enter_time_wait(a, now_ms),
+                  _sel(fw1, a._replace(state=jnp.int32(CLOSING)),
+                       _sel(fw2, _enter_time_wait(a, now_ms), a))))
+    return _sel(applies, a, s)
+
+
+def _process_fin(s, f, now_ms):
+    seq_u, paylen = f[1].astype(jnp.uint32), f[4]
+    end = seq_u + _u32(paylen)
+    base = _wire_rcv_nxt(s)
+    delta = (end - base).astype(jnp.uint32)
+    is_fwd = delta < jnp.uint32(1 << 31)
+    # clamp bogus-huge forward offsets below int32 overflow; they can
+    # never apply (fin_offset > rcv_nxt forever), matching the CPU
+    dd = jnp.minimum(delta.astype(jnp.int32) & 0x7FFFFFFF, 1 << 30)
+    fin_off = jnp.where(is_fwd, s.rcv_nxt + dd, s.rcv_nxt)
+    s = s._replace(
+        fin_offset=jnp.where(s.has_fin_offset, s.fin_offset, fin_off),
+        has_fin_offset=jnp.bool_(True),
+        ack_pending=jnp.bool_(True),
+    )
+    return _maybe_apply_fin_t(s, now_ms)
+
+
+def _on_segment_syn_sent(s, f, now_ms):
+    flags = f[0]
+    is_rst = (flags & RST) != 0
+    is_syn = (flags & SYN) != 0
+    is_ack = (flags & ACK) != 0
+    ack_u = f[2].astype(jnp.uint32)
+    expect = s.iss + jnp.uint32(1)
+    refused = is_rst & is_ack & (ack_u == expect)
+    r = _sel(refused, _enter_closed(s, jnp.int32(111)), s)
+
+    has_ws = f[5] >= 0
+    # SYN|ACK
+    bad_ack = ack_u != expect
+    sa = s._replace(
+        irs=f[1].astype(jnp.uint32), rcv_nxt=jnp.int32(0),
+        syn_acked=jnp.bool_(True), syn_outstanding=jnp.bool_(False),
+        peer_wscale=jnp.where(has_ws, jnp.minimum(f[5], MAX_WSCALE),
+                              s.peer_wscale),
+        wscale_ok=has_ws,
+        my_wscale=jnp.where(has_ws, s.my_wscale, 0),
+        snd_wnd=f[3], state=jnp.int32(ESTABLISHED),
+        ack_pending=jnp.bool_(True),
+    )
+    sa = _disarm_rto(sa)
+    sa = _sel((f[7] != 0) & (sa.backoff_count == 0),
+              _rtt_update(sa, now_ms - f[7]), sa)
+    sa = _sel(bad_ack, s._replace(rst_pending=jnp.bool_(True)), sa)
+    # simultaneous open (SYN, no ACK)
+    so = s._replace(
+        irs=f[1].astype(jnp.uint32), rcv_nxt=jnp.int32(0),
+        peer_wscale=jnp.where(has_ws, jnp.minimum(f[5], MAX_WSCALE),
+                              s.peer_wscale),
+        wscale_ok=has_ws, snd_wnd=f[3], state=jnp.int32(SYN_RCVD),
+        syn_outstanding=jnp.bool_(False), syn_sends=jnp.int32(0),
+    )
+    return _sel(is_rst, r,
+                _sel(is_syn & is_ack, sa, _sel(is_syn, so, s)))
+
+
+def _ev_segment(s, f, now_ms):
+    closed = s.state == CLOSED
+    # record peer timestamp to echo (f[6] = ts)
+    s1 = s._replace(last_ts_recv=jnp.where(
+        f[6] != 0, f[6].astype(jnp.uint32), s.last_ts_recv))
+
+    syn_sent = s1.state == SYN_SENT
+    ss = _on_segment_syn_sent(s1, f, now_ms)
+
+    flags = f[0]
+    # RST in any synchronized state
+    is_rst = (flags & RST) != 0
+    tw = s1.state == TIME_WAIT
+    r = _sel(tw, _enter_closed(s1, jnp.int32(0)),
+             _enter_closed(s1, jnp.int32(104)))
+
+    # SYN outside handshake
+    is_syn = (flags & SYN) != 0
+    dup_syn = (s1.state == SYN_RCVD) & (f[1].astype(jnp.uint32) == s1.irs)
+    syn_dup = s1._replace(syn_outstanding=jnp.bool_(False))
+    syn_other = _sel(tw, s1, s1._replace(rst_pending=jnp.bool_(True)))
+    sy = _sel(dup_syn, syn_dup, syn_other)
+
+    # normal path
+    n = s1
+    n = _sel((flags & ACK) != 0, _process_ack(n, f, now_ms), n)
+    n = _sel(f[4] > 0, _process_payload(n, f, now_ms), n)
+    n = _sel((flags & FIN) != 0, _process_fin(n, f, now_ms), n)
+
+    out = _sel(syn_sent, ss,
+               _sel(is_rst, r, _sel(is_syn, sy, n)))
+    return _sel(closed, s, out)
+
+
+# -- timers ----------------------------------------------------------------
+
+def _ev_timer_rto(s, f, now_ms):
+    gen = f[0]
+    stale = (gen != s.rto_gen) | (s.state == CLOSED)
+
+    a = s._replace(rto_armed=jnp.bool_(False))
+    in_flight = ((a.snd_nxt > a.snd_una) | (a.fin_sent & ~a.fin_acked)
+                 | (a.state == SYN_SENT) | (a.state == SYN_RCVD))
+    handshake = (a.state == SYN_SENT) | (a.state == SYN_RCVD)
+    limit = jnp.where(handshake, SYN_RETRIES, DATA_RETRIES)
+    give_up = a.backoff_count >= limit
+    gu = _enter_closed(a, jnp.int32(110))
+
+    b = _rtt_backoff(a)
+    b = _cong_timeout(b)
+    hs = b._replace(syn_outstanding=jnp.bool_(False))
+    gbn = b._replace(
+        gbn_high=jnp.maximum(b.gbn_high, b.snd_nxt),
+        snd_nxt=b.snd_una, retx_pending=jnp.bool_(False),
+        fin_sent=b.fin_sent & b.fin_acked,
+    )
+    gbn = _sel((gbn.snd_wnd == 0) & (gbn.stream_len > gbn.snd_nxt),
+               _arm_persist(gbn, now_ms), gbn)
+    b = _sel(handshake, hs, gbn)
+    b = _arm_rto(b, now_ms)
+    fired = _sel(give_up, gu, b)
+    return _sel(stale, s, _sel(in_flight, fired, a))
+
+
+def _ev_timer_tw(s, f, now_ms):
+    gen = f[0]
+    ok = gen == s.rto_gen
+    return _sel(ok, _enter_closed(s, jnp.int32(0)), s)
+
+
+def _ev_timer_persist(s, f, now_ms):
+    gen = f[0]
+    stale = (gen != s.persist_gen) | (s.state == CLOSED)
+    a = s._replace(persist_armed=jnp.bool_(False))
+    due = (a.snd_wnd == 0) & (a.stream_len > a.snd_nxt)
+    b = a._replace(probe_pending=jnp.bool_(True))
+    b = _rtt_backoff(b)
+    b = b._replace(persist_gen=b.persist_gen + 1,
+                   persist_armed=jnp.bool_(True),
+                   persist_deadline_ms=now_ms + b.rto_ms)
+    return _sel(stale, s, _sel(due, b, a))
+
+
+# -- egress (PULL = next_segment) ------------------------------------------
+
+K_NONE, K_RST, K_SYN, K_RETX, K_PROBE, K_DATA, K_FIN, K_ACK = range(8)
+
+
+def _next_kind(s):
+    hs = (s.state == SYN_SENT) | (s.state == SYN_RCVD)
+    can_data = (
+        ((s.state == ESTABLISHED) | (s.state == CLOSE_WAIT)
+         | (s.state == FIN_WAIT_1) | (s.state == CLOSING)
+         | (s.state == LAST_ACK))
+        & (s.snd_nxt < s.stream_len)
+        & (s.snd_nxt - s.snd_una
+           < jnp.minimum(s.cwnd * MSS, s.snd_wnd))
+    )
+    should_fin = (
+        s.fin_requested & ~s.fin_sent & (s.snd_nxt >= s.stream_len)
+        & ((s.state == FIN_WAIT_1) | (s.state == LAST_ACK)
+           | (s.state == CLOSING))
+    )
+    return jnp.where(
+        s.rst_pending, K_RST,
+        jnp.where(hs & ~s.syn_outstanding, K_SYN,
+        jnp.where(s.state == SYN_SENT, K_NONE,
+        jnp.where(s.retx_pending & (s.snd_nxt > s.snd_una), K_RETX,
+        jnp.where(s.probe_pending & (s.stream_len > s.snd_nxt), K_PROBE,
+        jnp.where(can_data, K_DATA,
+        jnp.where(should_fin, K_FIN,
+        jnp.where(s.ack_pending & (s.state != CLOSED), K_ACK,
+                  K_NONE)))))))).astype(jnp.int32)
+
+
+def _ev_pull(s, now_ms):
+    """next_segment(): returns (state', out[10]):
+    out = (has, flags, seq(u32 bits), ack, window, paylen, wscale(-1),
+           ts, ts_echo, retransmit)."""
+    kind = _next_kind(s)
+    before_nxt = s.snd_nxt
+    zero = jnp.int32(0)
+
+    def stamp(ts_out):
+        return now_ms & 0x7FFFFFFF, s.last_ts_recv.astype(jnp.int32)
+
+    # --- syn ---
+    syn_state = s._replace(syn_outstanding=jnp.bool_(True),
+                           syn_sends=s.syn_sends + 1)
+    syn_retx = syn_state.syn_sends > 1
+    syn_state = syn_state._replace(
+        retransmit_count=syn_state.retransmit_count
+        + jnp.where(syn_retx, 1, 0),
+        ack_pending=jnp.bool_(False))
+    syn_is_sent = s.state == SYN_SENT
+    syn_flags = jnp.where(syn_is_sent, SYN, SYN | ACK)
+    syn_ack = jnp.where(syn_is_sent, jnp.uint32(0), _wire_ack(s))
+    syn_out = (jnp.int32(1), syn_flags, s.iss.astype(jnp.int32),
+               syn_ack.astype(jnp.int32),
+               _advertised_window(s, jnp.bool_(True)), zero,
+               s.my_wscale, *stamp(0), syn_retx.astype(jnp.int32))
+
+    # --- data ---
+    off = s.snd_nxt
+    in_flight = off - s.snd_una
+    window = jnp.minimum(s.cwnd * MSS, s.snd_wnd)
+    n_data = jnp.minimum(jnp.minimum(MSS, s.stream_len - off),
+                         window - in_flight)
+    d_state = s._replace(snd_nxt=off + n_data,
+                         snd_max=jnp.maximum(s.snd_max, off + n_data),
+                         ack_pending=jnp.bool_(False))
+    d_state = _sel(d_state.rto_armed, d_state, _arm_rto(d_state, now_ms))
+    d_flags = jnp.where(d_state.snd_nxt >= s.stream_len, ACK | PSH, ACK)
+    data_gbn = before_nxt < s.gbn_high
+    d_state = d_state._replace(
+        retransmit_count=d_state.retransmit_count
+        + jnp.where(data_gbn, 1, 0))
+    d_out = (jnp.int32(1), d_flags, _wire_seq(s, off).astype(jnp.int32),
+             _wire_ack(s).astype(jnp.int32),
+             _advertised_window(s, jnp.bool_(False)), n_data,
+             jnp.int32(-1), *stamp(0), data_gbn.astype(jnp.int32))
+
+    # --- retransmit (n>0 data at snd_una; else FIN-retx or bare ack) ---
+    r_state0 = s._replace(retx_pending=jnp.bool_(False),
+                          retransmit_count=s.retransmit_count + 1)
+    r_n = jnp.minimum(MSS, s.stream_len - s.snd_una)
+    r_has_data = r_n > 0
+    r_data = _sel(r_state0.rto_armed, r_state0, _arm_rto(r_state0, now_ms))
+    r_data_out = (jnp.int32(1), jnp.int32(ACK),
+                  _wire_seq(s, s.snd_una).astype(jnp.int32),
+                  _wire_ack(s).astype(jnp.int32),
+                  _advertised_window(s, jnp.bool_(False)), r_n,
+                  jnp.int32(-1), *stamp(0), jnp.int32(1))
+    # FIN retransmit branch (fin_sent & no data)
+    rf_state = r_state0._replace(ack_pending=jnp.bool_(False))
+    rf_state = _sel(rf_state.rto_armed, rf_state, _arm_rto(rf_state, now_ms))
+    rf_out = (jnp.int32(1), jnp.int32(FIN | ACK),
+              _wire_seq(s, s.stream_len).astype(jnp.int32),
+              _wire_ack(s).astype(jnp.int32),
+              _advertised_window(s, jnp.bool_(False)), zero,
+              jnp.int32(-1), *stamp(0), jnp.int32(1))
+    # bare-ack branch
+    ra_state = r_state0._replace(ack_pending=jnp.bool_(False))
+    ra_seq = jnp.minimum(s.snd_nxt,
+                         s.stream_len + jnp.where(s.fin_sent, 1, 0))
+    ra_out = (jnp.int32(1), jnp.int32(ACK),
+              _wire_seq(s, ra_seq).astype(jnp.int32),
+              _wire_ack(s).astype(jnp.int32),
+              _advertised_window(s, jnp.bool_(False)), zero,
+              jnp.int32(-1), *stamp(0), jnp.int32(1))
+
+    # --- probe (1 byte past the window) ---
+    p_state = s._replace(probe_pending=jnp.bool_(False),
+                         snd_nxt=s.snd_nxt + 1,
+                         snd_max=jnp.maximum(s.snd_max, s.snd_nxt + 1))
+    p_state = _sel(p_state.rto_armed, p_state, _arm_rto(p_state, now_ms))
+    p_out = (jnp.int32(1), jnp.int32(ACK),
+             _wire_seq(s, s.snd_nxt).astype(jnp.int32),
+             _wire_ack(s).astype(jnp.int32),
+             _advertised_window(s, jnp.bool_(False)), jnp.int32(1),
+             jnp.int32(-1), *stamp(0), jnp.int32(1))
+
+    # --- fin ---
+    f_state = s._replace(fin_sent=jnp.bool_(True),
+                         snd_nxt=s.stream_len + 1,
+                         snd_max=jnp.maximum(s.snd_max, s.stream_len + 1),
+                         ack_pending=jnp.bool_(False))
+    f_state = _sel(f_state.rto_armed, f_state, _arm_rto(f_state, now_ms))
+    fin_gbn = before_nxt < s.gbn_high
+    f_state = f_state._replace(
+        retransmit_count=f_state.retransmit_count
+        + jnp.where(fin_gbn, 1, 0))
+    f_out = (jnp.int32(1), jnp.int32(FIN | ACK),
+             _wire_seq(s, s.stream_len).astype(jnp.int32),
+             _wire_ack(s).astype(jnp.int32),
+             _advertised_window(s, jnp.bool_(False)), zero,
+             jnp.int32(-1), *stamp(0), fin_gbn.astype(jnp.int32))
+
+    # --- ack ---
+    a_state = s._replace(ack_pending=jnp.bool_(False))
+    a_seq = jnp.minimum(s.snd_nxt,
+                        s.stream_len + jnp.where(s.fin_sent, 1, 0))
+    a_out = (jnp.int32(1), jnp.int32(ACK),
+             _wire_seq(s, a_seq).astype(jnp.int32),
+             _wire_ack(s).astype(jnp.int32),
+             _advertised_window(s, jnp.bool_(False)), zero,
+             jnp.int32(-1), *stamp(0), jnp.int32(0))
+
+    # --- rst ---
+    rst_seq = jnp.minimum(s.snd_nxt, s.stream_len)
+    # _build_rst is the one builder the CPU does NOT _stamp
+    rst_out = (jnp.int32(1), jnp.int32(RST | ACK),
+               _wire_seq(s, rst_seq).astype(jnp.int32),
+               _wire_ack(s).astype(jnp.int32), zero, zero,
+               jnp.int32(-1), zero, zero, jnp.int32(0))
+    rst_state = _enter_closed(s._replace(rst_pending=jnp.bool_(False)),
+                              jnp.int32(104))
+
+    none_out = tuple(jnp.int32(0) for _ in range(10))
+
+    # merge: the retransmit kind has three sub-shapes
+    retx_state = _sel(r_has_data, r_data,
+                      _sel(s.fin_sent, rf_state, ra_state))
+    retx_out = jax.tree.map(
+        lambda x, y, z: jnp.where(r_has_data, x,
+                                  jnp.where(s.fin_sent, y, z)),
+        r_data_out, rf_out, ra_out)
+
+    def pick(*pairs):
+        state_out, seg_out = pairs[-1]
+        for k, st, sg in reversed(pairs[:-1]):
+            state_out = _sel(kind == k, st, state_out)
+            seg_out = jax.tree.map(
+                lambda x, y, k=k: jnp.where(kind == k, x, y), sg, seg_out)
+        return state_out, seg_out
+
+    out_state, out_seg = pick(
+        (K_RST, rst_state, rst_out),
+        (K_SYN, syn_state, syn_out),
+        (K_RETX, retx_state, retx_out),
+        (K_PROBE, p_state, p_out),
+        (K_DATA, d_state, d_out),
+        (K_FIN, f_state, f_out),
+        (K_ACK, a_state, a_out),
+        (s, none_out),
+    )
+    out_state = out_state._replace(
+        last_retx=(out_seg[9] > 0) & (kind != K_NONE))
+    return out_state, jnp.stack(out_seg)
+
+
+# ---------------------------------------------------------------------------
+# the event-step kernel
+# ---------------------------------------------------------------------------
+
+def _event_step_one(s: TcpPlane, kind, f, now_ms):
+    """One event for one connection. Returns (state', out[10], ret)."""
+    zero_out = jnp.zeros((10,), jnp.int32)
+    ret = jnp.int32(0)
+
+    s_oa = _ev_open_active(s, f, now_ms)
+    s_op = _ev_open_passive(s, f, now_ms)
+    s_wr, wr_ret = _ev_write(s, f, now_ms)
+    s_rd, rd_ret = _ev_read(s, f)
+    s_cl = _ev_close(s)
+    s_ab = _ev_abort(s)
+    s_sg = _ev_segment(s, f, now_ms)
+    s_pl, pull_out = _ev_pull(s, now_ms)
+    s_tr = _ev_timer_rto(s, f, now_ms)
+    s_tp = _ev_timer_persist(s, f, now_ms)
+    s_tw = _ev_timer_tw(s, f, now_ms)
+
+    out_state = s
+    for k, st in ((EV_OPEN_ACTIVE, s_oa), (EV_OPEN_PASSIVE, s_op),
+                  (EV_WRITE, s_wr), (EV_READ, s_rd), (EV_CLOSE, s_cl),
+                  (EV_ABORT, s_ab), (EV_SEG, s_sg), (EV_PULL, s_pl),
+                  (EV_TIMER_RTO, s_tr), (EV_TIMER_PERSIST, s_tp),
+                  (EV_TIMER_TW, s_tw)):
+        out_state = _sel(kind == k, st, out_state)
+    out = jnp.where(kind == EV_PULL, pull_out, zero_out)
+    ret = jnp.where(kind == EV_WRITE, wr_ret,
+                    jnp.where(kind == EV_READ, rd_ret, ret))
+    return out_state, out, ret
+
+
+_event_step = jax.vmap(_event_step_one, in_axes=(0, 0, 0, 0))
+
+
+def tcp_event_step(plane: TcpPlane, kind: jax.Array, fields: jax.Array,
+                   now_ms: jax.Array):
+    """Step C connections, one event each.
+
+    kind [C] int32 EV_*, fields [C, 8] int32, now_ms [C] int32.
+    Returns (plane', out [C, 10], ret [C]) — `out` is the PULL segment
+    metadata (has, flags, seq, ack, window, paylen, wscale, ts, ts_echo,
+    retx), `ret` the WRITE/READ return value."""
+    return _event_step(plane, kind, fields, now_ms)
+
+
+def tcp_replay(plane: TcpPlane, kinds: jax.Array, fields: jax.Array,
+               now_ms: jax.Array):
+    """Replay [C, T] event streams with one lax.scan over T.
+
+    Returns (plane', outs [T, C, 10], rets [T, C])."""
+    def step(p, ev):
+        k, f, t = ev
+        p, out, ret = tcp_event_step(p, k, f, t)
+        return p, (out, ret)
+
+    plane, (outs, rets) = jax.lax.scan(
+        step, plane,
+        (jnp.moveaxis(kinds, 1, 0), jnp.moveaxis(fields, 1, 0),
+         jnp.moveaxis(now_ms, 1, 0)),
+    )
+    return plane, outs, rets

@@ -1,0 +1,429 @@
+"""TCP-on-TPU bitwise parity: record full event traces from the CPU
+TcpConnection (pairs joined by a lossy latency wire) and replay them
+through the vectorized device kernel — every next_segment() output, every
+write/read return, and the final scalar state must match exactly.
+VERDICT round-2 item #4's criterion, at >= 1k concurrent connections.
+"""
+
+import heapq
+import itertools
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from shadow_tpu.tcp import TcpConnection, TcpError, TcpFlags
+from shadow_tpu.tpu import tcp as dtcp
+
+MS = 1_000_000
+
+
+def u32_bits(x):
+    return int(np.int32(np.uint32(x)))
+
+
+class Recorder:
+    def __init__(self):
+        self.events = []  # (now_ns, kind, fields[8], expected)
+
+    def add(self, now, kind, fields=(), expected=None):
+        f = list(fields) + [0] * (8 - len(fields))
+        self.events.append((now, kind, f, expected))
+
+
+class RecDeps:
+    """FakeDeps + event recording; timer callbacks are classified by
+    introspecting the closure (kind from co_names, generation from the
+    captured int) so replays can feed the device the same (kind, gen)."""
+
+    def __init__(self, world, rec, seed):
+        self.world = world
+        self.rec = rec
+        self._rng = seed
+
+    def now(self):
+        return self.world.time
+
+    def set_timer(self, delay_ns, callback):
+        names = callback.__code__.co_names
+        if "_on_rto_fire" in names:
+            kind = dtcp.EV_TIMER_RTO
+        elif "_on_persist_fire" in names:
+            kind = dtcp.EV_TIMER_PERSIST
+        else:
+            kind = dtcp.EV_TIMER_TW
+        gen = next(c.cell_contents for c in (callback.__closure__ or ())
+                   if isinstance(c.cell_contents, int))
+        heapq.heappush(
+            self.world.timers,
+            (self.world.time + delay_ns, next(self.world.counter),
+             self.rec, kind, gen, callback),
+        )
+
+    def random_u32(self):
+        self._rng = (self._rng * 6364136223846793005
+                     + 1442695040888963407) % (1 << 64)
+        return self._rng >> 32
+
+    def notify(self):
+        pass
+
+
+def seg_fields(seg):
+    return [int(seg.flags), u32_bits(seg.seq), u32_bits(seg.ack),
+            seg.window, len(seg.payload),
+            -1 if seg.window_scale is None else seg.window_scale,
+            u32_bits(seg.timestamp), u32_bits(seg.timestamp_echo)]
+
+
+class RecordedConn:
+    """A TcpConnection plus its event trace."""
+
+    def __init__(self, world, seed):
+        self.rec = Recorder()
+        self.deps = RecDeps(world, self.rec, seed)
+        self.conn = TcpConnection(self.deps)
+        self.world = world
+
+    def open_active(self):
+        # record the ISS the CPU machine draws
+        iss_preview = RecDeps(self.world, None, self.deps._rng).random_u32()
+        self.conn.open_active()
+        assert self.conn.iss == iss_preview & 0xFFFFFFFF
+        self.rec.add(self.world.time, dtcp.EV_OPEN_ACTIVE,
+                     [u32_bits(self.conn.iss)])
+
+    def open_passive(self, syn):
+        self.conn.open_passive(syn)
+        self.rec.add(
+            self.world.time, dtcp.EV_OPEN_PASSIVE,
+            [u32_bits(self.conn.iss), u32_bits(syn.seq), syn.window,
+             -1 if syn.window_scale is None else syn.window_scale,
+             u32_bits(syn.timestamp), u32_bits(syn.timestamp_echo)])
+
+    def write(self, n):
+        try:
+            ret = self.conn.write(b"x" * n)
+        except TcpError as e:
+            ret = -e.errno
+        self.rec.add(self.world.time, dtcp.EV_WRITE, [n], ret)
+        return ret
+
+    def read(self, n):
+        try:
+            ret = len(self.conn.read(n))
+        except TcpError as e:
+            ret = -e.errno
+        self.rec.add(self.world.time, dtcp.EV_READ, [n], ret)
+        return ret
+
+    def close(self):
+        self.conn.close()
+        self.rec.add(self.world.time, dtcp.EV_CLOSE)
+
+    def abort(self):
+        self.conn.abort()
+        self.rec.add(self.world.time, dtcp.EV_ABORT)
+
+    def on_segment(self, seg):
+        self.rec.add(self.world.time, dtcp.EV_SEG, seg_fields(seg))
+        self.conn.on_segment(seg)
+
+    def pull(self):
+        seg = self.conn.next_segment()
+        expected = None
+        if seg is not None:
+            expected = seg_fields(seg) + [
+                1 if self.conn.last_segment_retransmit else 0]
+        self.rec.add(self.world.time, dtcp.EV_PULL, [], expected)
+        return seg
+
+
+class Wire:
+    """Two recorded connections joined by a latency wire with scripted
+    data-segment drops (a->b)."""
+
+    def __init__(self, latency_ns=1 * MS, seed=1234, drop_at=()):
+        self.time = 0
+        self.timers = []
+        self.counter = itertools.count()
+        self.latency = latency_ns
+        self.in_flight = []
+        self.a = RecordedConn(self, seed)
+        self.b = RecordedConn(self, seed + 77)
+        self.drop_at = set(drop_at)  # indices of a->b data segments to drop
+        self._a_data_segs = 0
+
+    def _pump(self, rc, peer):
+        seg = rc.pull()
+        if seg is None:
+            return False
+        if rc is self.a and seg.payload:
+            idx = self._a_data_segs
+            self._a_data_segs += 1
+            if idx in self.drop_at:
+                return True
+        heapq.heappush(self.in_flight,
+                       (self.time + self.latency, next(self.counter),
+                        peer, seg))
+        return True
+
+    def run(self, until_ns, app=None, max_iters=200_000):
+        for _ in range(max_iters):
+            if app is not None:
+                app(self)
+            progressed = False
+            while self._pump(self.a, self.b):
+                progressed = True
+            while self._pump(self.b, self.a):
+                progressed = True
+            if progressed:
+                continue
+            nxt = []
+            if self.in_flight:
+                nxt.append(self.in_flight[0][0])
+            if self.timers:
+                nxt.append(self.timers[0][0])
+            if not nxt or min(nxt) > until_ns:
+                self.time = until_ns
+                return
+            self.time = min(nxt)
+            while self.in_flight and self.in_flight[0][0] <= self.time:
+                _, _, dst, seg = heapq.heappop(self.in_flight)
+                dst.on_segment(seg)
+            while self.timers and self.timers[0][0] <= self.time:
+                _, _, rec, kind, gen, cb = heapq.heappop(self.timers)
+                rec.add(self.time, kind, [gen])
+                cb()
+        raise AssertionError("wire did not converge")
+
+
+def transfer_scenario(latency_ns, seed, size, chunk, drop_at=(),
+                      abort_at_ns=None, b_writes=0):
+    """One end-to-end life: handshake, a->b transfer (+ optional b->a),
+    loss, orderly close (or abort). Returns the two RecordedConns."""
+    w = Wire(latency_ns=latency_ns, seed=seed, drop_at=drop_at)
+    w.a.open_active()
+    syn = w.a.pull()
+    assert syn is not None and syn.flags & TcpFlags.SYN
+    w.time += w.latency  # the SYN travels the wire by hand
+    w.b.open_passive(syn)
+
+    progress = {"written": 0, "b_written": 0, "a_closed": False,
+                "b_closed": False, "aborted": False}
+
+    def app(wire):
+        t = wire.time
+        if abort_at_ns is not None and t >= abort_at_ns \
+                and not progress["aborted"]:
+            progress["aborted"] = True
+            wire.a.abort()
+            return
+        if progress["aborted"]:
+            # peer drains and closes after the reset surfaces
+            if wire.b.conn.readable_bytes():
+                wire.b.read(1 << 20)
+            return
+        a, b = wire.a, wire.b
+        if a.conn.is_established() and progress["written"] < size:
+            n = a.write(min(chunk, size - progress["written"]))
+            if n > 0:
+                progress["written"] += n
+        if b.conn.is_established() and progress["b_written"] < b_writes:
+            n = b.write(min(chunk, b_writes - progress["b_written"]))
+            if n > 0:
+                progress["b_written"] += n
+        if b.conn.readable_bytes():
+            b.read(1 << 20)
+        if a.conn.readable_bytes():
+            a.read(1 << 20)
+        if (progress["written"] >= size and not progress["a_closed"]
+                and a.conn.is_established()):
+            progress["a_closed"] = True
+            a.close()
+        if (b.conn.at_eof() and not progress["b_closed"]
+                and progress["b_written"] >= b_writes
+                and b.conn.state != 0):
+            progress["b_closed"] = True
+            b.close()
+
+    w.run(90_000 * MS, app=app)
+    return w.a, w.b
+
+
+def replay_and_compare(recorded):
+    """Replay every connection's trace on device; assert all PULL outputs,
+    write/read returns, and final states match the CPU machines."""
+    C = len(recorded)
+    T = max(len(rc.rec.events) for rc in recorded)
+    kinds = np.zeros((C, T), np.int32)
+    fields = np.zeros((C, T, dtcp.N_FIELDS), np.int32)
+    now_ms = np.zeros((C, T), np.int32)
+    for i, rc in enumerate(recorded):
+        for j, (t, kind, f, _exp) in enumerate(rc.rec.events):
+            kinds[i, j] = kind
+            fields[i, j] = f
+            now_ms[i, j] = t // MS
+
+    plane = dtcp.make_tcp_plane(C)
+    replay = jax.jit(dtcp.tcp_replay)
+    plane, outs, rets = replay(plane, jnp.asarray(kinds),
+                               jnp.asarray(fields), jnp.asarray(now_ms))
+    outs = np.asarray(jax.device_get(outs))  # [T, C, 10]
+    rets = np.asarray(jax.device_get(rets))  # [T, C]
+
+    mismatches = []
+    for i, rc in enumerate(recorded):
+        for j, (t, kind, f, exp) in enumerate(rc.rec.events):
+            if kind == dtcp.EV_PULL:
+                got = outs[j, i]
+                if exp is None:
+                    if got[0] != 0:
+                        mismatches.append((i, j, "pull none", got.tolist()))
+                else:
+                    want = [1] + exp
+                    if got.tolist() != want:
+                        mismatches.append((i, j, want, got.tolist()))
+            elif kind in (dtcp.EV_WRITE, dtcp.EV_READ):
+                if int(rets[j, i]) != exp:
+                    mismatches.append((i, j, ("ret", exp), int(rets[j, i])))
+            if len(mismatches) > 5:
+                break
+        if len(mismatches) > 5:
+            break
+    assert not mismatches, mismatches[:5]
+
+    # final-state comparison
+    dev = jax.device_get(plane)
+    bad = []
+    for i, rc in enumerate(recorded):
+        c = rc.conn
+        want = {
+            "state": int(c.state), "error": c.error or 0,
+            "snd_una": c.snd_una, "snd_nxt": c.snd_nxt,
+            "snd_wnd": c.snd_wnd, "stream_len": c.stream_len,
+            "snd_max": c.snd_max, "rcv_nxt": c.rcv_nxt,
+            "ordered_bytes": c._ordered_bytes,
+            "reass_bytes": c._reassembly.byte_count(),
+            "fin_requested": c.fin_requested, "fin_sent": c.fin_sent,
+            "fin_acked": c.fin_acked, "fin_received": c.fin_received,
+            "cwnd": c.cong.cwnd, "ssthresh": c.cong.ssthresh,
+            "phase": c.cong.phase, "dup_acks": c.cong.dup_acks,
+            "avoid_acked": c.cong._avoid_acked,
+            "srtt_ms": c.rtt.srtt_ms, "rttvar_ms": c.rtt.rttvar_ms,
+            "rto_ms": c.rtt.rto_ms, "backoff_count": c.rtt.backoff_count,
+            "retransmit_count": c.retransmit_count,
+            "rto_gen": c._rto_gen, "persist_gen": c._persist_gen,
+            "rto_armed": c._rto_armed, "persist_armed": c._persist_armed,
+            "iss": u32_bits(c.iss), "irs": u32_bits(c.irs),
+        }
+        got = {
+            "state": int(dev.state[i]), "error": int(dev.error[i]),
+            "snd_una": int(dev.snd_una[i]), "snd_nxt": int(dev.snd_nxt[i]),
+            "snd_wnd": int(dev.snd_wnd[i]),
+            "stream_len": int(dev.stream_len[i]),
+            "snd_max": int(dev.snd_max[i]), "rcv_nxt": int(dev.rcv_nxt[i]),
+            "ordered_bytes": int(dev.ordered_bytes[i]),
+            "reass_bytes": int(dev.reass_bytes[i]),
+            "fin_requested": bool(dev.fin_requested[i]),
+            "fin_sent": bool(dev.fin_sent[i]),
+            "fin_acked": bool(dev.fin_acked[i]),
+            "fin_received": bool(dev.fin_received[i]),
+            "cwnd": int(dev.cwnd[i]), "ssthresh": int(dev.ssthresh[i]),
+            "phase": int(dev.phase[i]), "dup_acks": int(dev.dup_acks[i]),
+            "avoid_acked": int(dev.avoid_acked[i]),
+            "srtt_ms": int(dev.srtt_ms[i]),
+            "rttvar_ms": int(dev.rttvar_ms[i]),
+            "rto_ms": int(dev.rto_ms[i]),
+            "backoff_count": int(dev.backoff_count[i]),
+            "retransmit_count": int(dev.retransmit_count[i]),
+            "rto_gen": int(dev.rto_gen[i]),
+            "persist_gen": int(dev.persist_gen[i]),
+            "rto_armed": bool(dev.rto_armed[i]),
+            "persist_armed": bool(dev.persist_armed[i]),
+            "iss": int(np.int32(np.uint32(dev.iss[i]))),
+            "irs": int(np.int32(np.uint32(dev.irs[i]))),
+        }
+        diff = {k: (want[k], got[k]) for k in want if want[k] != got[k]}
+        if diff:
+            bad.append((i, diff))
+        if len(bad) > 3:
+            break
+    assert not bad, bad[:3]
+
+
+def test_clean_transfer_pair():
+    a, b = transfer_scenario(1 * MS, 1, size=200_000, chunk=8192)
+    assert a.conn.state in (0, 8)  # CLOSED or TIME_WAIT
+    replay_and_compare([a, b])
+
+
+def test_lossy_transfer_pair():
+    a, b = transfer_scenario(2 * MS, 3, size=300_000, chunk=16384,
+                             drop_at=(5, 6, 40, 41, 42, 90))
+    assert a.conn.retransmit_count > 0
+    replay_and_compare([a, b])
+
+
+def test_abort_pair():
+    a, b = transfer_scenario(1 * MS, 9, size=50_000, chunk=4096,
+                             abort_at_ns=30 * MS)
+    replay_and_compare([a, b])
+
+
+def test_bidirectional_pair():
+    a, b = transfer_scenario(3 * MS, 21, size=60_000, chunk=8192,
+                             b_writes=40_000)
+    replay_and_compare([a, b])
+
+
+def test_rto_deadline_array_matches_timer_schedule():
+    """The device's per-connection RTO deadline array must equal the ms
+    time the CPU timer actually fires at (valid generations only)."""
+    a, b = transfer_scenario(2 * MS, 5, size=40_000, chunk=8192,
+                             drop_at=(1, 2, 3, 4, 5, 6, 7, 8))
+    # replay a's trace step by step; whenever a gen-valid RTO fire event
+    # arrives, the deadline recorded on device must equal its time
+    rc = a
+    C = 1
+    plane = dtcp.make_tcp_plane(C)
+    step = jax.jit(dtcp.tcp_event_step)
+    checked = 0
+    for (t, kind, f, _e) in rc.rec.events:
+        if kind == dtcp.EV_TIMER_RTO:
+            gen_ok = int(plane.rto_gen[0]) == f[0]
+            if gen_ok and bool(plane.rto_armed[0]):
+                assert int(plane.rto_deadline_ms[0]) == t // MS
+                checked += 1
+        plane, _o, _r = step(
+            plane, jnp.asarray([kind], jnp.int32),
+            jnp.asarray([f], jnp.int32),
+            jnp.asarray([t // MS], jnp.int32))
+    assert checked > 0  # the scenario really exercised RTO fires
+
+
+@pytest.mark.slow
+def test_thousand_connections_bitwise():
+    """>= 1k concurrent connections (512 pairs), randomized scenarios:
+    sizes, chunks, latencies, loss bursts, aborts, bidirectional traffic —
+    one device replay kernel, bitwise outputs + state."""
+    rng = np.random.default_rng(42)
+    recorded = []
+    for p in range(512):
+        size = int(rng.integers(2_000, 120_000))
+        chunk = int(rng.choice([1460, 4096, 8192, 16384]))
+        latency = int(rng.integers(1, 8)) * MS
+        drops = ()
+        if p % 3 == 0:
+            start = int(rng.integers(0, 30))
+            drops = tuple(range(start, start + int(rng.integers(1, 4))))
+        abort_at = 25 * MS if p % 17 == 0 else None
+        b_writes = int(rng.integers(0, 30_000)) if p % 5 == 0 else 0
+        a, b = transfer_scenario(latency, 1000 + p, size=size, chunk=chunk,
+                                 drop_at=drops, abort_at_ns=abort_at,
+                                 b_writes=b_writes)
+        recorded.extend([a, b])
+    assert len(recorded) == 1024
+    replay_and_compare(recorded)
