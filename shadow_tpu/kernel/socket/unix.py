@@ -1,0 +1,308 @@
+"""AF_UNIX sockets in the simulated kernel.
+
+Parity: reference `src/main/host/descriptor/socket/unix.rs` — stream and
+dgram families, a per-host path namespace (filesystem + abstract names are
+one flat map here; the simulated filesystem is virtual anyway), connected
+pairs moving bytes directly between buffers (no network plane: unix
+traffic never leaves the host), listener backlogs, socketpair, EOF/EPIPE
+semantics, and SHUT_RD/SHUT_WR.
+
+Design: a connected stream peer writes straight into this socket's receive
+buffer (bounded by CAPACITY for backpressure); dgram sockets queue bounded
+(data, src_path) messages at the receiver. All readiness goes through
+FileState bits so poll/select/epoll and the blocking-syscall conditions
+compose unchanged.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+from .. import errors
+from ..status import FileSignal, FileState, StatefulFile
+
+CAPACITY = 212992  # Linux default wmem for unix sockets
+DGRAM_QUEUE_MAX = 256
+DEFAULT_BACKLOG = 128
+
+UNIX_ADDR_FAMILY = "unix"  # marker in ("unix", path) sockaddr tuples
+
+
+def unix_namespace(host) -> dict:
+    ns = getattr(host, "unix_ns", None)
+    if ns is None:
+        ns = {}
+        host.unix_ns = ns
+    return ns
+
+
+class UnixSocket(StatefulFile):
+    """One AF_UNIX endpoint (stream or dgram)."""
+
+    def __init__(self, host, stream: bool):
+        super().__init__(FileState.ACTIVE)
+        self.host = host
+        self.stream = stream
+        self.nonblocking = False
+        self.bound_path: Optional[str] = None
+        self.listening = False
+        self._backlog_cap = DEFAULT_BACKLOG
+        self._accept_queue: deque[UnixSocket] = deque()
+        self.peer: Optional[UnixSocket] = None
+        self.connected_path: Optional[str] = None  # dgram default dst
+        self._recv: deque = deque()  # stream: bytes; dgram: (data, src)
+        self._recv_bytes = 0
+        self._eof = False  # peer closed / shut down its write side
+        self._shut_wr = False
+        self._shut_rd = False
+        self._closed = False
+        self._refresh()
+
+    # -- address plumbing ------------------------------------------------
+
+    def getsockname(self):
+        return (UNIX_ADDR_FAMILY, self.bound_path or "")
+
+    def getpeername(self):
+        if self.stream:
+            if self.peer is None:
+                return None
+            return (UNIX_ADDR_FAMILY, self.peer.bound_path or "")
+        if self.connected_path is None:
+            return None
+        return (UNIX_ADDR_FAMILY, self.connected_path)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def bind(self, addr) -> None:
+        fam, path = addr
+        if fam != UNIX_ADDR_FAMILY:
+            raise errors.SyscallError(errors.EINVAL)
+        if self.bound_path is not None:
+            raise errors.SyscallError(errors.EINVAL)
+        ns = unix_namespace(self.host)
+        if path in ns:
+            raise errors.SyscallError(errors.EADDRINUSE)
+        ns[path] = self
+        self.bound_path = path
+
+    def listen(self, backlog: int = DEFAULT_BACKLOG) -> None:
+        if not self.stream:
+            raise errors.SyscallError(errors.EOPNOTSUPP)
+        if self.bound_path is None:
+            raise errors.SyscallError(errors.EINVAL)
+        self.listening = True
+        self._backlog_cap = max(1, backlog)
+        self._refresh()
+
+    def connect(self, addr) -> None:
+        fam, path = addr
+        if fam != UNIX_ADDR_FAMILY:
+            raise errors.SyscallError(errors.EINVAL)
+        ns = unix_namespace(self.host)
+        if not self.stream:
+            if path not in ns:
+                raise errors.SyscallError(errors.ECONNREFUSED)
+            self.connected_path = path
+            return
+        if self.peer is not None:
+            raise errors.SyscallError(errors.EISCONN)
+        listener = ns.get(path)
+        if listener is None or not listener.listening or listener._closed:
+            raise errors.SyscallError(errors.ECONNREFUSED)
+        if len(listener._accept_queue) >= listener._backlog_cap:
+            raise errors.SyscallError(errors.ECONNREFUSED)
+        child = UnixSocket(self.host, stream=True)
+        child.bound_path = listener.bound_path  # children share the name
+        link(self, child)
+        listener._accept_queue.append(child)
+        listener._refresh()
+
+    def accept(self) -> "UnixSocket":
+        if not self.listening:
+            raise errors.SyscallError(errors.EINVAL)
+        if not self._accept_queue:
+            if self.nonblocking:
+                raise errors.SyscallError(errors.EWOULDBLOCK)
+            raise errors.Blocked(self, FileState.READABLE)
+        child = self._accept_queue.popleft()
+        self._refresh()
+        return child
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.bound_path is not None:
+            ns = unix_namespace(self.host)
+            if ns.get(self.bound_path) is self:
+                del ns[self.bound_path]
+        for child in self._accept_queue:
+            child.close()
+        self._accept_queue.clear()
+        if self.peer is not None:
+            # sever BOTH directions: the survivor keeps reading buffered
+            # bytes but its sends must fail with EPIPE, not black-hole
+            # into this dead socket's buffer
+            peer, self.peer = self.peer, None
+            peer._eof = True
+            peer.peer = None
+            peer._refresh()
+        self.update_state(
+            FileState.ACTIVE | FileState.READABLE | FileState.WRITABLE
+            | FileState.CLOSED,
+            FileState.CLOSED,
+        )
+
+    def shutdown(self, rd: bool, wr: bool) -> None:
+        if wr and not self._shut_wr:
+            self._shut_wr = True
+            if self.peer is not None:
+                self.peer._eof = True
+                self.peer._refresh()
+        if rd:
+            self._shut_rd = True
+        self._refresh()
+
+    # -- data ------------------------------------------------------------
+
+    def send(self, data: bytes) -> int:
+        if self._closed:
+            raise errors.SyscallError(errors.EBADF)
+        if not self.stream:
+            return self.sendto(data, None)
+        if self.peer is None:
+            raise errors.SyscallError(
+                errors.EPIPE if self._eof or self._shut_wr
+                else errors.ENOTCONN)
+        if self._shut_wr:
+            raise errors.SyscallError(errors.EPIPE)
+        room = CAPACITY - self.peer._recv_bytes
+        n = min(len(data), room)
+        if n <= 0:
+            if self.nonblocking:
+                raise errors.SyscallError(errors.EWOULDBLOCK)
+            raise errors.Blocked(self, FileState.WRITABLE)
+        self.peer._push(bytes(data[:n]))
+        self._refresh()
+        return n
+
+    def sendto(self, data: bytes, addr) -> int:
+        path = addr[1] if addr is not None else self.connected_path
+        if path is None:
+            raise errors.SyscallError(errors.ENOTCONN)
+        dst = unix_namespace(self.host).get(path)
+        if dst is None or dst._closed or dst.stream:
+            raise errors.SyscallError(errors.ECONNREFUSED)
+        if len(dst._recv) >= DGRAM_QUEUE_MAX:
+            if self.nonblocking:
+                raise errors.SyscallError(errors.EWOULDBLOCK)
+            # park on the RECEIVER's space bit: the sender's own WRITABLE
+            # is statically on for dgram and would wake immediately
+            raise errors.Blocked(dst, FileState.DGRAM_SPACE)
+        dst._recv.append((bytes(data), self.bound_path or ""))
+        dst._recv_bytes += len(data)
+        dst._refresh()
+        dst.emit_signal(FileSignal.READ_BUFFER_GREW)
+        return len(data)
+
+    def recv(self, max_bytes: int = 1 << 20) -> bytes:
+        data, _src = self.recvfrom(max_bytes)
+        return data
+
+    def recvfrom(self, max_bytes: int = 1 << 20):
+        if self._closed:
+            raise errors.SyscallError(errors.EBADF)
+        if self.stream:
+            if not self._recv:
+                if self._eof or self._shut_rd:
+                    return b"", self.getpeername()
+                if self.peer is None:
+                    raise errors.SyscallError(errors.ENOTCONN)
+                if self.nonblocking:
+                    raise errors.SyscallError(errors.EWOULDBLOCK)
+                raise errors.Blocked(self, FileState.READABLE)
+            out = []
+            need = max_bytes
+            while need > 0 and self._recv:
+                chunk = self._recv[0]
+                if len(chunk) <= need:
+                    out.append(chunk)
+                    self._recv.popleft()
+                    need -= len(chunk)
+                else:
+                    out.append(chunk[:need])
+                    self._recv[0] = chunk[need:]
+                    need = 0
+            got = b"".join(out)
+            self._recv_bytes -= len(got)
+            self._refresh()
+            if self.peer is not None:
+                self.peer._refresh()  # our drain reopened their window
+            return got, self.getpeername()
+        # dgram
+        if not self._recv:
+            if self.nonblocking:
+                raise errors.SyscallError(errors.EWOULDBLOCK)
+            raise errors.Blocked(self, FileState.READABLE)
+        data, src = self._recv.popleft()
+        self._recv_bytes -= len(data)
+        self._refresh()
+        return data[:max_bytes], (UNIX_ADDR_FAMILY, src)
+
+    # -- internals -------------------------------------------------------
+
+    def _push(self, data: bytes) -> None:
+        self._recv.append(data)
+        self._recv_bytes += len(data)
+        self._refresh()
+        self.emit_signal(FileSignal.READ_BUFFER_GREW)
+
+    def _refresh(self) -> None:
+        if self._closed:
+            return
+        readable = bool(self._recv) or self._eof or self._shut_rd \
+            or bool(self._accept_queue)
+        if self.stream:
+            writable = (self.peer is not None and not self._shut_wr
+                        and self.peer._recv_bytes < CAPACITY) or self._eof
+            space = False
+        else:
+            writable = True
+            space = len(self._recv) < DGRAM_QUEUE_MAX
+        value = FileState.ACTIVE
+        if readable:
+            value |= FileState.READABLE
+        if writable:
+            value |= FileState.WRITABLE
+        if space:
+            value |= FileState.DGRAM_SPACE
+        self.update_state(
+            FileState.ACTIVE | FileState.READABLE | FileState.WRITABLE
+            | FileState.DGRAM_SPACE,
+            value,
+        )
+
+
+def link(a: UnixSocket, b: UnixSocket) -> None:
+    """Join two stream sockets as peers (connect / socketpair)."""
+    a.peer, b.peer = b, a
+    a._refresh()
+    b._refresh()
+
+
+def make_socketpair(host, stream: bool = True):
+    a, b = UnixSocket(host, stream), UnixSocket(host, stream)
+    if stream:
+        link(a, b)
+    else:
+        # dgram socketpair: autobind both to hidden names and cross-connect
+        ns = unix_namespace(host)
+        for i, s in enumerate((a, b)):
+            name = f"\x00socketpair.{id(a):x}.{i}"
+            ns[name] = s
+            s.bound_path = name
+        a.connected_path = b.bound_path
+        b.connected_path = a.bound_path
+    return a, b

@@ -35,6 +35,10 @@ class FileState(enum.IntFlag):
     # of 1 won't block" meaning). Wakeups may be spurious for larger
     # waiters — they must retry and re-block — but are never missed.
     EVENTFD_WRITE_SPACE = 1 << 7
+    # unix-dgram-internal: the RECEIVER's queue has room. A blocked dgram
+    # sender parks on the destination socket's bit (its own WRITABLE is
+    # static for dgram and would livelock the condition).
+    DGRAM_SPACE = 1 << 8
 
 
 class FileSignal(enum.IntFlag):

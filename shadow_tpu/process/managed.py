@@ -306,8 +306,8 @@ class ManagedThread:
     """
 
     __slots__ = ("process", "ipc", "native_tid", "parked_condition",
-                 "park_deadline", "futex_waiter", "wait_epoll", "ctid_addr",
-                 "dead", "is_main")
+                 "park_deadline", "park_call", "futex_waiter", "wait_epoll",
+                 "ctid_addr", "dead", "is_main")
 
     def __init__(self, process, ipc, is_main: bool = False):
         self.process = process
@@ -315,6 +315,7 @@ class ManagedThread:
         self.native_tid: Optional[int] = None
         self.parked_condition = None
         self.park_deadline: Optional[int] = None
+        self.park_call = None  # (nr, args) of the blocked syscall
         self.futex_waiter = None
         self.wait_epoll = None
         self.ctid_addr = 0
@@ -509,6 +510,97 @@ class ManagedSimProcess:
         self._close_descriptors()
         self._cleanup()
         self._notify_parent()
+
+    # -- virtual signal delivery (`process.rs:1309`, shim/src/syscall.rs) --
+
+    # syscalls Linux restarts under SA_RESTART (signal(7)); the rest
+    # return EINTR after the handler runs
+    _RESTARTABLE = frozenset((
+        0, 1, 19, 20, 43, 42, 44, 45, 46, 47, 61, 247, 288,  # io + wait
+    ))
+
+    def deliver_signal(self, sig: int, self_directed: bool = False) -> None:
+        """Deliver `sig` at simulated time, under simulator control:
+
+        - ignored (explicitly or by default): nothing happens;
+        - default-terminate: the process is stopped through the process
+          plane at the current sim instant (state KILLED, kill_signal =
+          sig — `expected_final_state: signaled` checks see exactly this,
+          with no native-kill/death-watcher race);
+        - handler installed: the native signal is forwarded (the app's
+          real handler runs inside the shim's blocked recv loop), after
+          which parked syscalls either restart (SA_RESTART + restartable
+          class) or complete with -EINTR.
+
+        Effects on ANOTHER process run as a delay-0 host task so the
+        SENDER's syscall completes first (delivery must not re-enter the
+        target's resume loop from the sender's stack). A SELF-directed
+        signal must act before the caller executes another instruction
+        (`kill -9 $$` may never reach its own exit), so it forwards
+        natively right away — the death/handler lands at the caller's own
+        kill() call, a precise simulated instant."""
+        if self.state != ProcessState.RUNNING:
+            return
+        kind, sa_restart = self.handler.signal_disposition(sig)
+        # SIGCONT's job control is unmodeled, but an INSTALLED handler for
+        # it still runs (common resume-detection idiom)
+        if kind == "ignore" or (sig == 18 and kind != "handler"):
+            return
+        if self_directed:
+            native = self.server.native_pid
+            if native:
+                try:
+                    os.kill(native, sig)
+                except ProcessLookupError:
+                    pass
+            return
+        if kind == "default" or sig == 9:
+            self.host.schedule_task_with_delay(
+                TaskRef(lambda h: self.stop(sig), "signal-terminate"), 0)
+            return
+        self.host.schedule_task_with_delay(
+            TaskRef(lambda h: self._deliver_handled(sig, sa_restart),
+                    "signal-deliver"), 0)
+
+    def _deliver_handled(self, sig: int, sa_restart: bool) -> None:
+        if self.state != ProcessState.RUNNING:
+            return
+        native = self.server.native_pid
+        if not native:
+            return
+        try:
+            # pending BEFORE any EINTR completion: the kernel delivers it
+            # when the shim's blocked futex recv restarts, so the app's
+            # handler has run by the time its syscall returns EINTR
+            os.kill(native, sig)
+        except ProcessLookupError:
+            return
+        for t in list(self.threads):
+            if t.parked_condition is None or t.dead:
+                continue
+            cond, t.parked_condition = t.parked_condition, None
+            cond.cancel()
+            self.handler._drop_wait_epoll(t)
+            nr, pargs = t.park_call or (0, ())
+            if sa_restart and nr in self._RESTARTABLE:
+                # restart as if freshly issued (usually re-parks)
+                if not self._handle_syscall_event(t, nr, list(pargs)):
+                    self._resume(t)
+            else:
+                import errno as _errno
+
+                # a futex waiter must leave the table or a later WAKE
+                # would be consumed by this dead entry and strand a real
+                # waiter (mirror _sys_futex's timeout cleanup)
+                w, t.futex_waiter = t.futex_waiter, None
+                if w is not None and not (w.state & FileState.FUTEX_WAKEUP):
+                    self.handler.futexes.remove_waiter(w)
+                    self._reply_complete(t, -_errno.EINTR)
+                elif w is not None:
+                    self._reply_complete(t, 0)  # the wake already counted it
+                else:
+                    self._reply_complete(t, -_errno.EINTR)
+                self._resume(t)
 
     def _cancel_all_parks(self) -> None:
         for t in self.threads:
@@ -811,6 +903,7 @@ class ManagedSimProcess:
         if blocked.timeout_ns is not None:
             timeout_at = self.host.now() + blocked.timeout_ns
         thread.park_deadline = timeout_at
+        thread.park_call = (nr, tuple(args))
 
         def wakeup(reason, thread=thread, nr=nr, args=tuple(args)):
             self._unpark(thread, nr, list(args), reason)

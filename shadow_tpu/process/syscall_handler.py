@@ -43,6 +43,7 @@ from ..kernel.eventfd import EventFd
 from ..kernel.pipe import PipeReader, PipeWriter, make_pipe
 from ..kernel.socket.tcp import TcpSocket
 from ..kernel.socket.udp import UdpSocket
+from ..kernel.socket.unix import UnixSocket, make_socketpair
 from ..kernel.status import FileState
 from ..kernel.timerfd import TimerFd
 
@@ -86,6 +87,7 @@ SYS_dup = 32
 SYS_dup2 = 33
 SYS_nanosleep = 35
 SYS_socket = 41
+SYS_socketpair = 53
 SYS_connect = 42
 SYS_accept = 43
 SYS_sendto = 44
@@ -122,6 +124,7 @@ AF_INET6 = 10
 SOCK_STREAM = 1
 SOCK_DGRAM = 2
 SOCK_TYPE_MASK = 0xF
+SOCK_SEQPACKET = 5
 SOCK_NONBLOCK = 0o4000
 SOCK_CLOEXEC = 0o2000000
 
@@ -227,6 +230,10 @@ class SyscallHandler:
         self._wait_epoll: Optional[Epoll] = None
         # emulated futexes, shared by all threads of the process
         self.futexes = kfutex.FutexTable()
+        # signal dispositions recorded from rt_sigaction: sig -> (kind,
+        # sa_restart) with kind in {'default','ignore','handler'}
+        # (`process.rs:1309` signal virtualization)
+        self.sig_actions: dict[int, tuple[str, bool]] = {}
         # per-syscall dispatch tally for sim-stats (first dispatches only;
         # condition-wakeup re-dispatches of the same call don't re-count)
         self.syscall_counts: dict[int, int] = {}
@@ -276,11 +283,23 @@ class SyscallHandler:
     # -- sockaddr codec ------------------------------------------------
 
     def _read_sockaddr(self, addr: int, addrlen: int) -> tuple[str, int]:
-        if addrlen < 8:
+        if addrlen < 2:
             raise errors.SyscallError(errors.EINVAL)
-        raw = self.mem.read(addr, min(addrlen, 16))
+        raw = self.mem.read(addr, min(addrlen, 110))
         (family,) = struct.unpack_from("<H", raw, 0)
-        if family != AF_INET:
+        if family == AF_UNIX:
+            from ..kernel.socket.unix import UNIX_ADDR_FAMILY
+
+            # sockaddr_un: sun_path is addrlen-2 bytes; pathname names end
+            # at the first NUL, abstract names (leading NUL) keep their
+            # full length (unix(7))
+            path_bytes = raw[2:addrlen]
+            if path_bytes[:1] == b"\x00":
+                path = path_bytes.decode("latin-1")
+            else:
+                path = path_bytes.split(b"\x00", 1)[0].decode("latin-1")
+            return UNIX_ADDR_FAMILY, path
+        if family != AF_INET or addrlen < 8:
             raise errors.SyscallError(errors.EAFNOSUPPORT)
         port = struct.unpack_from(">H", raw, 2)[0]
         ip = ".".join(str(b) for b in raw[4:8])
@@ -288,6 +307,12 @@ class SyscallHandler:
 
     @staticmethod
     def _pack_sockaddr(sockaddr: Optional[tuple[str, int]]) -> bytes:
+        from ..kernel.socket.unix import UNIX_ADDR_FAMILY
+
+        if sockaddr is not None and sockaddr[0] == UNIX_ADDR_FAMILY:
+            path = sockaddr[1].encode("latin-1")
+            return struct.pack("<H", AF_UNIX) + path + (
+                b"" if path[:1] == b"\x00" else b"\x00")
         ip, port = sockaddr if sockaddr is not None else (UNSPECIFIED, 0)
         return struct.pack("<H", AF_INET) + struct.pack(">H", port) + bytes(
             int(p) for p in ip.split(".")
@@ -296,6 +321,12 @@ class SyscallHandler:
     def _write_sockaddr(self, addr: int, addrlen_ptr: int,
                         sockaddr: Optional[tuple[str, int]]) -> None:
         if not addr or not addrlen_ptr:
+            return
+        if sockaddr is None:
+            # no source address to report (e.g. recvfrom on a stream
+            # socket whose peer is gone): report length 0, never an
+            # AF_INET-shaped placeholder into an AF_UNIX buffer
+            self.mem.write(addrlen_ptr, struct.pack("<I", 0))
             return
         raw = self._pack_sockaddr(sockaddr)
         (cap,) = struct.unpack("<I", self.mem.read(addrlen_ptr, 4))
@@ -331,7 +362,12 @@ class SyscallHandler:
     def _sys_socket(self, args, ctx) -> int:
         domain, type_, _proto = _i32(args[0]), _i32(args[1]), _i32(args[2])
         if domain == AF_UNIX:
-            raise NativeSyscall()  # intra-host IPC: no simulated semantics
+            kind = type_ & SOCK_TYPE_MASK
+            if kind not in (SOCK_STREAM, SOCK_DGRAM, SOCK_SEQPACKET):
+                raise errors.SyscallError(errors.EPROTONOSUPPORT)
+            sock = UnixSocket(self.host, stream=kind != SOCK_DGRAM)
+            sock.nonblocking = bool(type_ & SOCK_NONBLOCK)
+            return self._vfd(sock, cloexec=bool(type_ & SOCK_CLOEXEC))
         if domain == AF_INET6:
             # v4-only simulated internet; apps fall back (`inet/mod.rs`)
             raise errors.SyscallError(errors.EAFNOSUPPORT)
@@ -355,15 +391,31 @@ class SyscallHandler:
 
     def _sys_listen(self, args, ctx) -> int:
         sock = self._file(args[0])
-        if not isinstance(sock, TcpSocket):
+        if not isinstance(sock, (TcpSocket, UnixSocket)):
             raise errors.SyscallError(errors.EOPNOTSUPP)
         backlog = _i32(args[1])
         sock.listen(backlog if backlog > 0 else 1)
         return 0
 
+    def _sys_socketpair(self, args, ctx) -> int:
+        domain, type_ = _i32(args[0]), _i32(args[1])
+        if domain != AF_UNIX:
+            raise errors.SyscallError(errors.EAFNOSUPPORT)
+        kind = type_ & SOCK_TYPE_MASK
+        if kind not in (SOCK_STREAM, SOCK_DGRAM, SOCK_SEQPACKET):
+            raise errors.SyscallError(errors.EPROTONOSUPPORT)
+        a, b = make_socketpair(self.host, stream=kind != SOCK_DGRAM)
+        a.nonblocking = b.nonblocking = bool(type_ & SOCK_NONBLOCK)
+        cloexec = bool(type_ & SOCK_CLOEXEC)
+        fds = (self._vfd(a, cloexec), self._vfd(b, cloexec))
+        self.mem.write(args[3], struct.pack("<ii", *fds))
+        return 0
+
     def _sys_connect(self, args, ctx) -> int:
         sock = self._file(args[0])
-        if isinstance(sock, UdpSocket):
+        if isinstance(sock, (UdpSocket, UnixSocket)):
+            # both connect without a handshake round trip (unix pairs
+            # rendezvous instantly: same host, no network plane)
             addr = self._read_sockaddr(args[1], _i32(args[2]))
             sock.connect(addr)
             return 0
@@ -382,7 +434,7 @@ class SyscallHandler:
 
     def _sys_accept(self, args, ctx, flags: int = 0) -> int:
         listener = self._file(args[0])
-        if not isinstance(listener, TcpSocket):
+        if not isinstance(listener, (TcpSocket, UnixSocket)):
             raise errors.SyscallError(errors.EOPNOTSUPP)
         child = listener.accept()  # raises Blocked when queue empty
         child.nonblocking = bool(flags & SOCK_NONBLOCK)
@@ -404,6 +456,10 @@ class SyscallHandler:
             if how in (SHUT_WR, SHUT_RDWR) and not sock.conn.fin_requested:
                 sock.conn.close()
                 sock._pump_out()
+        else:
+            if isinstance(sock, UnixSocket):
+                sock.shutdown(rd=how in (SHUT_RD, SHUT_RDWR),
+                              wr=how in (SHUT_WR, SHUT_RDWR))
         return 0
 
     def _sys_getsockname(self, args, ctx) -> int:
@@ -462,6 +518,8 @@ class SyscallHandler:
             if isinstance(sock, UdpSocket):
                 data, src = sock.recvfrom()
                 data = data[:n]  # datagram truncation
+            elif isinstance(sock, UnixSocket) and not sock.stream:
+                data, src = sock.recvfrom(n)
             else:
                 data = sock.recv(n)
                 src = sock.getpeername()
@@ -521,7 +579,8 @@ class SyscallHandler:
         if dontwait:
             sock.nonblocking = True
         try:
-            if isinstance(sock, UdpSocket):
+            if isinstance(sock, UdpSocket) or (
+                    isinstance(sock, UnixSocket) and not sock.stream):
                 dst = None
                 if args[4]:
                     dst = self._read_sockaddr(args[4], _i32(args[5]))
@@ -963,13 +1022,43 @@ class SyscallHandler:
     # to protect its signals, `shim/src/lib.rs`).
     _SHIM_OWNED_SIGNALS = (11, 31)  # SIGSEGV, SIGSYS
 
+    SA_RESTART = 0x10000000
+    _SIG_UNBLOCKABLE = (9, 19)  # SIGKILL, SIGSTOP
+
     def _sys_rt_sigaction(self, args, ctx) -> int:
         signum = _i32(args[0])
         if signum in self._SHIM_OWNED_SIGNALS and args[1]:
             # pretend success without replacing the shim's handler; reads
             # (act==NULL) still pass through natively
             return 0
+        if args[1] and signum not in self._SIG_UNBLOCKABLE:
+            # record the disposition for virtual delivery (the native
+            # install still happens below, so the handler really runs in
+            # the managed process when we forward the signal)
+            handler_ptr, flags = struct.unpack(
+                "<QQ", self.mem.read(args[1], 16))
+            if handler_ptr == 0:
+                kind = "default"
+            elif handler_ptr == 1:
+                kind = "ignore"
+            else:
+                kind = "handler"
+            self.sig_actions[signum] = (kind,
+                                        bool(flags & self.SA_RESTART))
         raise NativeSyscall()
+
+    # default-ignore dispositions (signal(7)); stop/continue job control
+    # (SIGSTOP/SIGTSTP/SIGTTIN/SIGTTOU/SIGCONT) is not modeled — treated
+    # as ignore rather than terminate
+    _SIG_DEFAULT_IGNORE = (17, 18, 19, 20, 21, 22, 23, 28)
+
+    def signal_disposition(self, sig: int) -> tuple[str, bool]:
+        rec = self.sig_actions.get(sig)
+        if rec is not None:
+            return rec
+        if sig in self._SIG_DEFAULT_IGNORE:
+            return "ignore", False
+        return "default", False
 
     def _sys_getrandom(self, args, ctx) -> int:
         bufp, n = args[0], min(args[1], 1 << 20)
@@ -1153,43 +1242,40 @@ class SyscallHandler:
         return 1
 
     def _sys_kill_family(self, args, ctx, nr: int) -> int:
-        """kill/tkill/tgkill with virtual-pid translation: processes only
-        know virtual pids (`process.rs:1309`); native tids pass through
-        (this rebuild keeps thread ids native — see managed.py)."""
+        """kill/tkill/tgkill with virtual-pid translation and VIRTUAL
+        delivery (`process.rs:1309`): the signal's effect happens at
+        simulated time under simulator control — a default-terminate
+        signal kills the target deterministically through the process
+        plane (no native-kill race with the death watcher), a handled
+        signal is forwarded natively (so the app's handler really runs)
+        after interrupting any parked syscalls per SA_RESTART."""
         if nr == SYS_kill:
             target, sig = _i64(args[0]), _i32(args[1])
-            native = self._native_pid_for(target)
-            if native is None:
-                raise errors.SyscallError(errors.ESRCH)
-            try:
-                import os as _os
-
-                _os.kill(native, sig)
-            except ProcessLookupError:
-                raise errors.SyscallError(errors.ESRCH) from None
-            except PermissionError:
-                raise errors.SyscallError(errors.EPERM) from None
+        else:  # tgkill(tgid, tid, sig): process-granularity delivery
+            target, sig = _i64(args[0]), _i32(args[2])
+        victim = self._target_process(target)
+        if victim is None:
+            raise errors.SyscallError(errors.ESRCH)
+        if sig == 0:
+            return 0  # existence probe
+        deliver = getattr(victim, "deliver_signal", None)
+        if deliver is not None:  # managed native process
+            deliver(sig, self_directed=victim is self.process)
             return 0
-        if nr == SYS_tgkill:
-            tgid, tid, sig = _i64(args[0]), _i64(args[1]), _i32(args[2])
-            native = self._native_pid_for(tgid)
-            if native is None:
-                raise errors.SyscallError(errors.ESRCH)
-            rc = _libc_syscall(SYS_tgkill, native, tid, sig)
-            if rc < 0:
-                raise errors.SyscallError(-rc)
+        stop = getattr(victim, "stop", None)
+        if stop is not None:  # coroutine SimProcess: no handlers to run
+            if sig not in self._SIG_DEFAULT_IGNORE:
+                stop(sig)
             return 0
-        # tkill: native tid, no pid translation needed
-        raise NativeSyscall()
+        raise errors.SyscallError(errors.ESRCH)
 
-    def _native_pid_for(self, vpid: int) -> Optional[int]:
+    def _target_process(self, vpid: int):
         proc = self.process
         if vpid in (proc.pid, 0, -proc.pid):
-            return proc.server.native_pid
+            return proc
         for other in getattr(self.host, "processes", []):
             if getattr(other, "pid", None) == abs(vpid) and other.is_alive:
-                return getattr(other.server, "native_pid", None) \
-                    if hasattr(other, "server") else None
+                return other
         return None
 
     def _sys_kill(self, args, ctx) -> int:
@@ -1267,6 +1353,7 @@ class SyscallHandler:
 
     _HANDLERS = {
         SYS_socket: _sys_socket,
+        SYS_socketpair: _sys_socketpair,
         SYS_bind: _sys_bind,
         SYS_listen: _sys_listen,
         SYS_connect: _sys_connect,
