@@ -269,6 +269,8 @@ class Manager:
                     proc = ManagedSimProcess(
                         h, proc_name, [popt.path, *popt.args],
                         output_dir=out_dir,
+                        strace_mode=self.config.experimental
+                        .strace_logging_mode,
                     )
                 cell["proc"] = proc
                 proc.spawn()

@@ -307,7 +307,7 @@ class ManagedThread:
 
     __slots__ = ("process", "ipc", "native_tid", "parked_condition",
                  "park_deadline", "park_call", "futex_waiter", "wait_epoll",
-                 "ctid_addr", "dead", "is_main")
+                 "ctid_addr", "dead", "is_main", "tindex")
 
     def __init__(self, process, ipc, is_main: bool = False):
         self.process = process
@@ -321,6 +321,9 @@ class ManagedThread:
         self.ctid_addr = 0
         self.dead = False
         self.is_main = is_main
+        # stable per-process ordinal (creation order, which is
+        # sim-deterministic — native tids are NOT, so strace prints this)
+        self.tindex = process._next_tindex()
 
 
 class ManagedSimProcess:
@@ -360,10 +363,13 @@ class ManagedSimProcess:
         self._death_seen = False
         self._output_dir = output_dir
         self._stdout = self._stderr = None
+        self._tindex_counter = 0
+        self.strace = None  # StraceLogger when strace_logging_mode is on
         # threads (main first); clone in flight between ADD_THREAD_REQ and
         # ADD_THREAD_RES parks here
         self.threads: list[ManagedThread] = []
         self._pending_clone = None
+        self._pending_clone_call = None
         # fork/wait bookkeeping (`handler/wait.rs`): children + the file
         # wait4 blocks on; parent links back for getppid
         self.children: list["ManagedSimProcess"] = []
@@ -377,12 +383,22 @@ class ManagedSimProcess:
         self._ipc_lock = threading.Lock()
         host.processes.append(self)
 
+    def _next_tindex(self) -> int:
+        t = self._tindex_counter
+        self._tindex_counter += 1
+        return t
+
     def __init__(self, host, name: str, argv: list[str],
-                 output_dir: Optional[str] = None):
+                 output_dir: Optional[str] = None,
+                 strace_mode: str = "off"):
         self._init_common(host, name, argv, output_dir)
         self.state = ProcessState.PENDING
         # the simulated-kernel dispatch table (network, readiness, sleep)
         self.handler = SyscallHandler(self)
+        from .strace import make_logger
+
+        self.strace = make_logger(output_dir, name, strace_mode)
+        self._strace_mode = strace_mode
 
     @classmethod
     def forked(cls, parent: "ManagedSimProcess") -> "ManagedSimProcess":
@@ -391,12 +407,21 @@ class ManagedSimProcess:
         The native child is created by the parent's shim; `_finish_fork`
         wires its pid in once the clone returns."""
         self = cls.__new__(cls)
-        self._init_common(parent.host,
-                          f"{parent.name}.fork{len(parent.children)}",
-                          parent.argv)
+        # monotone fork ordinal (len(children) would reuse a name after an
+        # aborted fork and truncate the earlier child's output files)
+        parent._fork_counter = getattr(parent, "_fork_counter", 0)
+        fork_ix = parent._fork_counter
+        parent._fork_counter += 1
+        self._init_common(parent.host, f"{parent.name}.fork{fork_ix}",
+                          parent.argv, output_dir=parent._output_dir)
         self.state = ProcessState.RUNNING  # the native child exists shortly
         self.handler = SyscallHandler(
             self, table=parent.handler._table.fork_into())
+        from .strace import make_logger
+
+        self._strace_mode = getattr(parent, "_strace_mode", "off")
+        self.strace = make_logger(self._output_dir, self.name,
+                                  self._strace_mode)
         # fast path stays disabled (proc_clock None): the clock block would
         # be shared with the parent
         self.ipc = IpcChannel.create()
@@ -637,9 +662,11 @@ class ManagedSimProcess:
             args = [int(ev.u.syscall.args[i]) for i in range(6)]
 
             if nr == SYS_exit_group:
+                self._strace(thread, nr, args, "<noreturn>")
                 self._handle_exit_group(thread, args)
                 return
             if nr == SYS_exit:
+                self._strace(thread, nr, args, "<noreturn>")
                 if self._handle_thread_exit(thread, args):
                     return  # thread (or process) left the running set
                 continue
@@ -665,6 +692,7 @@ class ManagedSimProcess:
         with self._ipc_lock:  # threads is read by the death watcher
             self.threads.append(child)
         self._pending_clone = child
+        self._pending_clone_call = (SYS_clone, tuple(args))
         reply = ShimEvent()
         reply.kind = EVENT_ADD_THREAD_REQ
         reply.u.add_thread_req.ipc_handle = child_ipc.block.serialize().encode()
@@ -677,6 +705,7 @@ class ManagedSimProcess:
     def _begin_fork(self, thread: ManagedThread, nr: int, args) -> None:
         child = ManagedSimProcess.forked(self)
         self._pending_clone = child
+        self._pending_clone_call = (nr, tuple(args))
         reply = ShimEvent()
         reply.kind = EVENT_ADD_THREAD_REQ
         reply.u.add_thread_req.ipc_handle = child.ipc.block.serialize().encode()
@@ -688,6 +717,14 @@ class ManagedSimProcess:
 
     def _finish_clone(self, thread: ManagedThread, native_tid: int) -> None:
         pending, self._pending_clone = self._pending_clone, None
+        call, self._pending_clone_call = (
+            getattr(self, "_pending_clone_call", None), None)
+        if call is not None:
+            retval = native_tid
+            if native_tid >= 0 and not isinstance(pending, ManagedThread) \
+                    and pending is not None:
+                retval = pending.pid  # the app sees the virtual child pid
+            self._strace(thread, call[0], call[1], retval)
         if pending is None:
             self._reply_complete(thread, -kerrors.EINVAL)
             return
@@ -873,14 +910,18 @@ class ManagedSimProcess:
             except OSError:
                 ret2 = None  # memory gone (racing exit): run it natively
             if ret2 is None:
+                self._strace(thread, nr, args, "<native>")
                 self._reply_native(thread)
             else:
+                self._strace(thread, nr, args, ret2)
                 self._reply_complete(thread, ret2)
             return False
         except kerrors.SyscallError as e:
+            self._strace(thread, nr, args, -e.errno)
             self._reply_complete(thread, -e.errno)
             return False
         except kerrors.Blocked as b:
+            # logged at completion, when the re-dispatch returns a result
             self._park(thread, nr, args, b)
             return True
         except OSError:
@@ -891,10 +932,16 @@ class ManagedSimProcess:
             # gone and the reply lands nowhere anyway.
             import errno as _errno
 
+            self._strace(thread, nr, args, -_errno.EFAULT)
             self._reply_complete(thread, -_errno.EFAULT)
             return False
+        self._strace(thread, nr, args, ret)
         self._reply_complete(thread, ret)
         return False
+
+    def _strace(self, thread: ManagedThread, nr: int, args, result) -> None:
+        if self.strace is not None:
+            self.strace.log(self.host.now(), thread.tindex, nr, args, result)
 
     def _park(self, thread: ManagedThread, nr: int, args, blocked) -> None:
         """Arm a SysCallCondition for a blocked syscall; the shim stays in
@@ -1126,3 +1173,5 @@ class ManagedSimProcess:
             if fh is not None:
                 fh.close()
         self._stdout = self._stderr = None
+        if self.strace is not None:
+            self.strace.close()

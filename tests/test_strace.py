@@ -1,0 +1,144 @@
+"""strace logging (deterministic mode): per-process .strace files whose
+bytes are identical across runs — the reference's determinism CI diffs
+exactly this artifact (`syscall-logger/src/lib.rs`, determinism CMake
+harness). VERDICT round-2 item #9.
+"""
+
+import shutil
+import subprocess
+
+import pytest
+
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.manager import Manager
+
+CC = shutil.which("gcc") or shutil.which("cc")
+
+pytestmark = pytest.mark.skipif(CC is None, reason="no C compiler")
+
+APP_C = r"""
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+int main(void) {
+    int sv[2];
+    if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv)) return 1;
+    pid_t pid = fork();
+    if (pid == 0) {
+        if (write(sv[1], "abc", 3) != 3) _exit(9);
+        _exit(0);
+    }
+    char buf[8];
+    if (read(sv[0], buf, sizeof buf) != 3) return 3;
+    int st;
+    waitpid(pid, &st, 0);
+    usleep(2000);
+    close(sv[0]);
+    close(sv[1]);
+    return 0;
+}
+"""
+
+
+def _compile(tmp_path):
+    c = tmp_path / "app.c"
+    c.write_text(APP_C)
+    binary = tmp_path / "app"
+    subprocess.run([CC, "-O1", "-o", str(binary), str(c)], check=True)
+    return str(binary)
+
+
+def _run(tmp_path, binary, data_name, mode):
+    data = tmp_path / data_name
+    cfg = load_config_str(f"""
+general: {{stop_time: 5s, seed: 4, data_directory: {data}}}
+experimental: {{strace_logging_mode: {mode}}}
+network:
+  graph: {{type: 1_gbit_switch}}
+hosts:
+  box:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, start_time: 1s, expected_final_state: {{exited: 0}}}}
+""")
+    mgr = Manager(cfg)
+    mgr.data_dir = str(data)
+    stats = mgr.run()
+    assert stats.process_failures == []
+    strace = data / "hosts" / "box" / "box.app.0.strace"
+    assert strace.exists(), "no .strace written"
+    child = data / "hosts" / "box" / "box.app.0.fork0.strace"
+    assert child.exists(), "forked child has no .strace"
+    return strace.read_bytes() + b"--fork--\n" + child.read_bytes()
+
+
+def test_deterministic_strace_is_byte_identical(tmp_path):
+    binary = _compile(tmp_path)
+    a = _run(tmp_path, binary, "d1", "deterministic")
+    b = _run(tmp_path, binary, "d2", "deterministic")
+    assert a == b, "deterministic strace differs across identical runs"
+    text = a.decode()
+    # the emulated syscalls show up with simulated timestamps + stable
+    # thread ordinals; pointer args are masked; fork + the child's own
+    # trace (after --fork--) are present
+    for needle in ("socketpair(", "clone(", "write(", "read(", "close(",
+                   "wait4(", "exit_group(", "[t0]", "<ptr>", "--fork--"):
+        assert needle in text, f"{needle!r} missing from:\n{text[:800]}"
+    assert text.splitlines()[0].startswith("00:00:01.")
+
+
+def test_strace_identical_across_scheduler_matrix(tmp_path):
+    """The full syscall trace must be byte-identical across schedulers and
+    parallelism (the reference determinism CI's strongest check: event
+    ORDER, not just end-state counters, is schedule-independent)."""
+    import os
+    import sys
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    binary = _compile(tmp_path)
+    cfg = tmp_path / "strace-matrix.yaml"
+    cfg.write_text(f"""
+general: {{stop_time: 5s, seed: 4}}
+experimental: {{strace_logging_mode: deterministic}}
+network:
+  graph: {{type: 1_gbit_switch}}
+hosts:
+  box1:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, start_time: 1s}}
+  box2:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, start_time: 2s}}
+""")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "compare_runs.py"),
+         str(cfg), "--matrix"],
+        env=env, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "DETERMINISTIC" in proc.stdout
+
+
+def test_off_mode_writes_nothing(tmp_path):
+    binary = _compile(tmp_path)
+    data = tmp_path / "off"
+    cfg = load_config_str(f"""
+general: {{stop_time: 5s, seed: 4, data_directory: {data}}}
+network:
+  graph: {{type: 1_gbit_switch}}
+hosts:
+  box:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, start_time: 1s}}
+""")
+    mgr = Manager(cfg)
+    mgr.data_dir = str(data)
+    mgr.run()
+    assert not list((data / "hosts" / "box").glob("*.strace"))

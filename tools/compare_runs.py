@@ -6,8 +6,11 @@ Parity: reference determinism CI (`src/test/determinism/CMakeLists.txt` —
 determinism1a/1b run identical sims twice and diff; determinism2 repeats
 with `--scheduler thread-per-host` to prove event order is independent of
 the parallelization strategy). Here the deterministic artifacts are
-sim-stats.json (minus wall_seconds) and the per-host pcap captures, which
-encode exact packet timing and content.
+sim-stats.json (minus wall_seconds), the per-host pcap captures (exact
+packet timing and content), process stdout/stderr, and — when the config
+sets `experimental.strace_logging_mode: deterministic` — every managed
+process's full .strace syscall trace, the reference CI's own diff target
+(every hosts/ file is hashed, so strace coverage is automatic).
 
 Usage:
   python tools/compare_runs.py <config.yaml> [--runs 2]       # repeat-diff
@@ -45,7 +48,13 @@ def run_once(config: str, data_dir: str,
         raise SystemExit(f"run failed (exit {proc.returncode})")
     with open(os.path.join(data_dir, "sim-stats.json")) as fh:
         stats = json.load(fh)
-    stats.pop("wall_seconds", None)  # the one legitimately nondeterministic field
+    stats.pop("wall_seconds", None)  # legitimately nondeterministic
+    # the round COUNT is loop progress, not simulation state: a managed
+    # process death is posted by the wall-clock watcher thread, so the
+    # round boundary it drains at may differ while every simulated
+    # observable (packets, syscalls, strace bytes, final states) is
+    # identical
+    stats.pop("rounds", None)
     digest = {"sim-stats": stats}
     hosts_dir = os.path.join(data_dir, "hosts")
     if os.path.isdir(hosts_dir):
