@@ -260,6 +260,13 @@ def _coerce(name: str, value: Any, default: Any) -> Any:
         if isinstance(value, bool) or not isinstance(value, int):
             raise ConfigError(f"{name}: expected an integer, got {value!r}")
     elif isinstance(default, str):
+        if isinstance(value, bool) and name == "strace_logging_mode":
+            # YAML 1.1 parses a bare `off` as boolean False; the reference
+            # accepts `strace_logging_mode: off` literally, so map it back
+            if value is False:
+                return "off"
+            raise ConfigError(
+                f"{name}: expected off|standard|deterministic, got true")
         if not isinstance(value, str):
             raise ConfigError(f"{name}: expected a string, got {value!r}")
     return value

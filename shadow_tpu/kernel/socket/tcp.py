@@ -46,6 +46,8 @@ def packet_to_segment(packet: Packet) -> Segment:
         window_scale=h.window_scale,
         timestamp=h.timestamp,
         timestamp_echo=h.timestamp_echo,
+        sack_permitted=h.sack_permitted,
+        sack=tuple(h.sel_acks),
     )
 
 
@@ -60,6 +62,8 @@ def segment_to_packet(
         window_scale=seg.window_scale,
         timestamp=seg.timestamp,
         timestamp_echo=seg.timestamp_echo,
+        sel_acks=tuple(seg.sack),
+        sack_permitted=seg.sack_permitted,
     )
     return Packet(
         Protocol.TCP, src, dst, payload=seg.payload, header=header, priority=priority

@@ -70,6 +70,7 @@ class TcpHeader:
     timestamp: int = 0
     timestamp_echo: int = 0
     sel_acks: tuple = ()  # selective-ack ranges ((start, end), ...)
+    sack_permitted: bool = False  # RFC 2018 option on SYN
 
 
 class Packet:

@@ -95,6 +95,11 @@ class TcpConfig:
     recv_buffer: int = 174760
     window_scaling: bool = True
     nagle: bool = False  # reference disables Nagle's algorithm
+    sack: bool = True  # RFC 2018 selective acknowledgment
+
+
+SACK_SLOTS = 16  # sender scoreboard capacity (tcp_retransmit_tally.cc)
+SACK_WIRE_BLOCKS = 3  # blocks carried per segment (RFC 2018 w/ timestamps)
 
 
 @dataclass
@@ -110,6 +115,8 @@ class Segment:
     window_scale: Optional[int] = None  # SYN only
     timestamp: int = 0
     timestamp_echo: int = 0
+    sack_permitted: bool = False  # SYN only (RFC 2018 option)
+    sack: tuple = ()  # ((wire_start, wire_end), ...) end exclusive
 
 
 class _Reassembly:
@@ -147,6 +154,70 @@ class _Reassembly:
 
     def byte_count(self) -> int:
         return sum(len(d) for d in self.segments.values())
+
+
+class _SackScoreboard:
+    """Sender-side tally of peer-held (SACKed) ranges, unwrapped stream
+    offsets (`tcp_retransmit_tally.cc`).
+
+    A FIXED slot algorithm, deliberately branch-simple so the device
+    kernel (`tpu/tcp.py`) mirrors it slot-for-slot: `insert` clips to the
+    cumulative ack, skips contained duplicates, extends the FIRST
+    overlapping-or-touching slot once (no cascade merging), else takes
+    the first empty slot (all full = drop the block); `prune` clips every
+    slot to the advancing ack; `next_unsacked` walks chained ranges to
+    the first hole and reports the distance to the next range above."""
+
+    __slots__ = ("s", "e")
+
+    INF = 1 << 62
+
+    def __init__(self):
+        self.s = [0] * SACK_SLOTS
+        self.e = [0] * SACK_SLOTS
+
+    def insert(self, start: int, end: int, una: int) -> None:
+        start = max(start, una)
+        if start >= end:
+            return
+        for i in range(SACK_SLOTS):  # contained in an existing range?
+            if self.e[i] > self.s[i] and self.s[i] <= start \
+                    and end <= self.e[i]:
+                return
+        for i in range(SACK_SLOTS):  # extend the first overlap/touch
+            if self.e[i] > self.s[i] and start <= self.e[i] \
+                    and self.s[i] <= end:
+                self.s[i] = min(self.s[i], start)
+                self.e[i] = max(self.e[i], end)
+                return
+        for i in range(SACK_SLOTS):  # first empty slot
+            if self.e[i] <= self.s[i]:
+                self.s[i], self.e[i] = start, end
+                return
+
+    def prune(self, una: int) -> None:
+        for i in range(SACK_SLOTS):
+            if self.e[i] > self.s[i]:
+                self.s[i] = max(self.s[i], una)
+                if self.s[i] >= self.e[i]:
+                    self.s[i] = self.e[i] = 0
+
+    def next_unsacked(self, off: int) -> tuple[int, int]:
+        """(off', cap): first unsacked offset >= off; bytes until the next
+        range above (INF when none)."""
+        for _ in range(SACK_SLOTS):
+            moved = False
+            for i in range(SACK_SLOTS):
+                if self.e[i] > self.s[i] and self.s[i] <= off < self.e[i]:
+                    off = self.e[i]
+                    moved = True
+            if not moved:
+                break
+        cap = self.INF
+        for i in range(SACK_SLOTS):
+            if self.e[i] > self.s[i] and self.s[i] > off:
+                cap = min(cap, self.s[i] - off)
+        return off, cap
 
 
 class TcpConnection:
@@ -198,6 +269,11 @@ class TcpConnection:
             self.my_wscale = ws
         self._last_ts_recv = 0  # peer timestamp to echo
 
+        # --- SACK (RFC 2018; `tcp_retransmit_tally.cc`) --------------------
+        self._sack_ok = False  # negotiated on the handshake
+        self._sacked = _SackScoreboard()
+        self.retransmitted_bytes = 0
+
         # --- timers / control ---------------------------------------------
         self.rtt = RttEstimator()
         self.cong = RenoCongestion()
@@ -230,6 +306,7 @@ class TcpConnection:
             self._wscale_ok = True
         else:
             self.my_wscale = 0
+        self._sack_ok = syn.sack_permitted and self.config.sack
         self.snd_wnd = syn.window  # unscaled on SYN
         self._last_ts_recv = syn.timestamp
         self.state = TcpState.SYN_RCVD
@@ -345,6 +422,8 @@ class TcpConnection:
         gbn_resend = kind in ("data", "fin") and before_nxt < self._gbn_high
         if gbn_resend:
             self.retransmit_count += 1
+        if gbn_resend or kind == "retransmit":
+            self.retransmitted_bytes += len(seg.payload)
         self.last_segment_retransmit = (
             kind in ("retransmit", "probe")
             or (kind == "syn" and self._syn_sends > 1)
@@ -423,6 +502,30 @@ class TcpConnection:
         seg.timestamp_echo = self._last_ts_recv
         return seg
 
+    def _sack_blocks(self) -> tuple:
+        """Receiver SACK blocks from the reassembly store: the ranges
+        NEAREST the ack point first (lowest start), merged when touching.
+        Deterministic (stable across schedulers) and maximally useful to
+        the sender, whose retransmissions fill the lowest holes first —
+        as they fill, the 3-block window slides up the held ranges."""
+        if not self._sack_ok or not self._reassembly.segments:
+            return ()
+        ranges = sorted(
+            (start, start + len(data))
+            for start, data in self._reassembly.segments.items()
+        )
+        merged: list[list[int]] = []
+        for s, e in ranges:
+            if merged and s <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], e)
+            else:
+                merged.append([s, e])
+        base = seqmod.add(self.irs, 1)
+        return tuple(
+            (seqmod.add(base, s), seqmod.add(base, e))
+            for s, e in merged[:SACK_WIRE_BLOCKS]
+        )
+
     def _build_syn(self) -> Segment:
         self._syn_outstanding = True
         self._syn_sends += 1
@@ -440,15 +543,26 @@ class TcpConnection:
                 ack=ack,
                 window=self._advertised_window(for_syn=True),
                 window_scale=self.my_wscale if self.config.window_scaling else None,
+                sack_permitted=self.config.sack,
             )
         )
 
     def _build_data(self) -> Segment:
         off = self.snd_nxt
+        # never (re)send bytes the peer already SACKed: jump the send
+        # cursor over held ranges, cap the chunk at the next held range
+        off2, cap = self._sacked.next_unsacked(off)
+        if off2 != off:
+            self.snd_nxt = off2
+            self.snd_max = max(self.snd_max, off2)
+            off = off2
         in_flight = off - self.snd_una
         window = min(self.cong.cwnd * self.config.mss, self.snd_wnd)
-        n = min(self.config.mss, self.stream_len - off, window - in_flight)
-        assert n > 0
+        n = min(self.config.mss, self.stream_len - off, window - in_flight,
+                cap)
+        if n <= 0:
+            # everything in reach is already held by the peer
+            return self._build_ack()
         payload = bytes(self.snd_buf[off - self.snd_una : off - self.snd_una + n])
         self.snd_nxt = off + n
         self.snd_max = max(self.snd_max, self.snd_nxt)
@@ -465,6 +579,7 @@ class TcpConnection:
                 ack=self._wire_ack(),
                 window=self._advertised_window(False),
                 payload=payload,
+                sack=self._sack_blocks(),
             )
         )
 
@@ -472,8 +587,10 @@ class TcpConnection:
         self._retx_pending = False
         self.retransmit_count += 1
         off = self.snd_una
-        # only payload bytes live in the buffer; the FIN slot retransmits as a FIN
-        n = min(self.config.mss, self.stream_len - off)
+        # only payload bytes live in the buffer; the FIN slot retransmits
+        # as a FIN. SACK: the hole ends where the peer's held data starts.
+        _, cap = self._sacked.next_unsacked(off)
+        n = min(self.config.mss, self.stream_len - off, cap)
         if n <= 0:
             if self.fin_sent:
                 return self._build_fin(retransmit=True)
@@ -488,6 +605,7 @@ class TcpConnection:
                 ack=self._wire_ack(),
                 window=self._advertised_window(False),
                 payload=payload,
+                sack=self._sack_blocks(),
             )
         )
 
@@ -507,6 +625,7 @@ class TcpConnection:
                 ack=self._wire_ack(),
                 window=self._advertised_window(False),
                 payload=payload,
+                sack=self._sack_blocks(),
             )
         )
 
@@ -524,6 +643,7 @@ class TcpConnection:
                 seq=self._wire_seq(self.stream_len),
                 ack=self._wire_ack(),
                 window=self._advertised_window(False),
+                sack=self._sack_blocks(),
             )
         )
 
@@ -535,6 +655,7 @@ class TcpConnection:
                 seq=self._wire_seq(min(self.snd_nxt, self.stream_len + (1 if self.fin_sent else 0))),
                 ack=self._wire_ack(),
                 window=self._advertised_window(False),
+                sack=self._sack_blocks(),
             )
         )
 
@@ -615,6 +736,7 @@ class TcpConnection:
                 self._wscale_ok = True
             else:
                 self.my_wscale = 0
+            self._sack_ok = seg.sack_permitted and self.config.sack
             self.snd_wnd = seg.window  # unscaled on SYN
             self.state = TcpState.ESTABLISHED
             self._ack_pending = True
@@ -628,6 +750,7 @@ class TcpConnection:
             if seg.window_scale is not None and self.config.window_scaling:
                 self.peer_wscale = min(seg.window_scale, MAX_WSCALE)
                 self._wscale_ok = True
+            self._sack_ok = seg.sack_permitted and self.config.sack
             self.snd_wnd = seg.window
             self.state = TcpState.SYN_RCVD
             self._syn_outstanding = False  # rebuild as SYN|ACK
@@ -648,6 +771,16 @@ class TcpConnection:
             if seg.timestamp_echo and self.rtt.backoff_count == 0:
                 self.rtt.update(self._now_ms() - seg.timestamp_echo)
 
+        if self._sack_ok and seg.sack:
+            base = self._wire_seq(0)  # wire value of stream offset 0
+            limit = max(self.snd_nxt, self.snd_max)
+            for ws, we in seg.sack[:SACK_WIRE_BLOCKS]:
+                s_off = seqmod.sub(ws, base)
+                e_off = seqmod.sub(we, base)
+                if s_off < (1 << 31) and e_off < (1 << 31) \
+                        and s_off < e_off and e_off <= limit:
+                    self._sacked.insert(s_off, e_off, self.snd_una)
+
         sent_end = self.snd_nxt
         fin_off = self.stream_len + 1 if self.fin_sent else None
         new_window = seg.window << (self.peer_wscale if self._wscale_ok else 0)
@@ -661,6 +794,7 @@ class TcpConnection:
                 self.snd_una = self.stream_len
             if self.snd_nxt < self.snd_una:
                 self.snd_nxt = self.snd_una
+            self._sacked.prune(self.snd_una)
             if acked_bytes > 0:
                 n_seg = (acked_bytes + self.config.mss - 1) // self.config.mss
                 if self.cong.in_fast_recovery and ack_off < self._recover:

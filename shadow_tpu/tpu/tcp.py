@@ -46,6 +46,9 @@ SEND_BUFFER = _CFG.send_buffer
 RECV_BUFFER = _CFG.recv_buffer
 
 REASS_SLOTS = 128  # >= recv_buffer/MSS: as many ranges as the window admits
+SACK_SLOTS = 16  # sender scoreboard capacity (mirrors _SackScoreboard)
+SACK_WIRE_BLOCKS = 3
+SB_INF = np.int32(1 << 30)  # scoreboard hole-cap sentinel (> any chunk)
 
 # TcpFlags (bit-identical to the CPU enum)
 FIN, SYN, RST, PSH, ACK, URG = 1, 2, 4, 8, 16, 32
@@ -62,7 +65,7 @@ PH_SLOW_START, PH_AVOIDANCE, PH_RECOVERY = 0, 1, 2
  EV_ABORT, EV_SEG, EV_PULL, EV_TIMER_RTO, EV_TIMER_PERSIST,
  EV_TIMER_TW) = range(12)
 
-N_FIELDS = 8  # per-event int32 args
+N_FIELDS = 16  # per-event int32 args (8 base + SACK)
 
 I32_MAX = np.int32(2**31 - 1)
 
@@ -124,7 +127,13 @@ class TcpPlane(NamedTuple):
     persist_armed: jax.Array  # bool
     persist_deadline_ms: jax.Array
     retransmit_count: jax.Array
+    retransmitted_bytes: jax.Array
     last_retx: jax.Array  # bool — last pulled segment was a retransmission
+    # SACK (RFC 2018): negotiated flag + the sender scoreboard, the
+    # slot-for-slot mirror of connection.py's _SackScoreboard
+    sack_ok: jax.Array  # bool
+    sacked_s: jax.Array  # [C, SACK_SLOTS]
+    sacked_e: jax.Array
     # reassembly ranges [C, REASS_SLOTS] (len 0 = free slot)
     reass_off: jax.Array
     reass_len: jax.Array
@@ -159,7 +168,10 @@ def make_tcp_plane(n_conns: int) -> TcpPlane:
         phase=z(), dup_acks=z(), avoid_acked=z(),
         rto_gen=z(), rto_armed=f(), rto_deadline_ms=z(),
         persist_gen=z(), persist_armed=f(), persist_deadline_ms=z(),
-        retransmit_count=z(), last_retx=f(),
+        retransmit_count=z(), retransmitted_bytes=z(), last_retx=f(),
+        sack_ok=f(),
+        sacked_s=jnp.zeros((n_conns, SACK_SLOTS), jnp.int32),
+        sacked_e=jnp.zeros((n_conns, SACK_SLOTS), jnp.int32),
         reass_off=jnp.zeros((n_conns, REASS_SLOTS), jnp.int32),
         reass_len=jnp.zeros((n_conns, REASS_SLOTS), jnp.int32),
     )
@@ -363,6 +375,88 @@ def _reass_drain(s):
     ), adv
 
 
+# -- SACK scoreboard (slot-for-slot mirror of _SackScoreboard) -------------
+
+def _sb_insert(ss, se, start, end, una):
+    start = jnp.maximum(start, una)
+    valid = start < end
+    live = se > ss
+    contained = (live & (ss <= start) & (end <= se)).any()
+    overlap = live & (start <= se) & (ss <= end)
+    has_ov = overlap.any()
+    first_ov = jnp.argmax(overlap)
+    ext_s = ss.at[first_ov].set(jnp.minimum(ss[first_ov], start))
+    ext_e = se.at[first_ov].set(jnp.maximum(se[first_ov], end))
+    empty = ~live
+    has_empty = empty.any()
+    first_empty = jnp.argmax(empty)
+    ins_s = ss.at[first_empty].set(start)
+    ins_e = se.at[first_empty].set(end)
+    do_ext = valid & ~contained & has_ov
+    do_ins = valid & ~contained & ~has_ov & has_empty
+    out_s = jnp.where(do_ext, ext_s, jnp.where(do_ins, ins_s, ss))
+    out_e = jnp.where(do_ext, ext_e, jnp.where(do_ins, ins_e, se))
+    return out_s, out_e
+
+
+def _sb_prune(ss, se, una):
+    live = se > ss
+    s2 = jnp.where(live, jnp.maximum(ss, una), ss)
+    dead = live & (s2 >= se)
+    return (jnp.where(dead, 0, s2), jnp.where(dead, 0, se))
+
+
+def _sb_next(ss, se, off):
+    """(off', cap): first unsacked offset >= off; bytes to the next range
+    above (SB_INF when none)."""
+    def body(_, o):
+        covering = (se > ss) & (ss <= o) & (o < se)
+        return jnp.maximum(o, jnp.where(covering, se, o).max())
+
+    off = jax.lax.fori_loop(0, SACK_SLOTS, body, off)
+    above = (se > ss) & (ss > off)
+    cap = jnp.where(above, ss - off, SB_INF).min()
+    return off, cap
+
+
+def _recv_sack_blocks(s):
+    """Receiver SACK blocks (mirror of _sack_blocks): reassembly ranges
+    sorted ascending, touching ranges merged, lowest 3 reported. Returns
+    (nsack, [3] wire starts, [3] wire ends) as int32 wire-bit values."""
+    live = s.reass_len > 0
+    starts = jnp.where(live, s.reass_off, I32_MAX)
+    ends = jnp.where(live, s.reass_off + s.reass_len, 0)
+    starts, ends = jax.lax.sort((starts, ends), dimension=0, is_stable=True,
+                                num_keys=1)
+
+    def body(i, carry):
+        m_s, m_e, cnt = carry
+        st, en = starts[i], ends[i]
+        valid = st < I32_MAX
+        last = jnp.maximum(cnt - 1, 0)
+        merge = valid & (cnt > 0) & (st <= m_e[last])
+        app = valid & ~merge
+        m_e = jnp.where(merge,
+                        m_e.at[last].set(jnp.maximum(m_e[last], en)), m_e)
+        m_s = jnp.where(app, m_s.at[cnt].set(st, mode="drop"), m_s)
+        m_e = jnp.where(app, m_e.at[cnt].set(en, mode="drop"), m_e)
+        return m_s, m_e, cnt + app.astype(jnp.int32)
+
+    z = jnp.zeros((REASS_SLOTS,), jnp.int32)
+    m_s, m_e, cnt = jax.lax.fori_loop(0, REASS_SLOTS, body, (z, z,
+                                                             jnp.int32(0)))
+    n = jnp.minimum(cnt, SACK_WIRE_BLOCKS)
+    base = s.irs + jnp.uint32(1)
+    idx = jnp.arange(SACK_WIRE_BLOCKS)
+    sel = idx < n
+    ws = jnp.where(sel, (base + m_s[idx].astype(jnp.uint32))
+                   .astype(jnp.int32), 0)
+    we = jnp.where(sel, (base + m_e[idx].astype(jnp.uint32))
+                   .astype(jnp.int32), 0)
+    has = s.sack_ok & (cnt > 0)
+    return jnp.where(has, n, 0), jnp.where(has, ws, 0), jnp.where(has, we, 0)
+
+
 # ---------------------------------------------------------------------------
 # event handlers (scalar; mirror TcpConnection method-for-method)
 # ---------------------------------------------------------------------------
@@ -402,6 +496,7 @@ def _ev_open_passive(s, f, now_ms):
         snd_wnd=f[2],
         last_ts_recv=jnp.where(f[4] != 0, f[4].astype(jnp.uint32),
                                s.last_ts_recv),
+        sack_ok=f[6] != 0,  # peer offered AND config.sack (always on)
         state=jnp.int32(SYN_RCVD),
     )
     return _arm_rto(s, now_ms)
@@ -496,6 +591,24 @@ def _process_ack(s, f, now_ms):
                 _rtt_update(s_hs, now_ms - ts_echo), s_hs)
     s = _sel(complete, s_hs, s)
 
+    # SACK blocks -> scoreboard (connection.py inserts before the ack
+    # advance, with the PRE-advance snd_una as the clip)
+    base0 = _wire_seq(s, jnp.int32(0))
+    limit = jnp.maximum(s.snd_nxt, s.snd_max)
+    ss, se = s.sacked_s, s.sacked_e
+    nsack = f[9]
+    for _k in range(SACK_WIRE_BLOCKS):
+        bws = f[10 + 2 * _k].astype(jnp.uint32)
+        bwe = f[11 + 2 * _k].astype(jnp.uint32)
+        s_off = (bws - base0).astype(jnp.int32)
+        e_off = (bwe - base0).astype(jnp.int32)
+        bval = (s.sack_ok & ~ignore & (_k < nsack) & (s_off >= 0)
+                & (e_off >= 0) & (s_off < e_off) & (e_off <= limit))
+        ns, ne = _sb_insert(ss, se, s_off, e_off, s.snd_una)
+        ss = jnp.where(bval, ns, ss)
+        se = jnp.where(bval, ne, se)
+    s = s._replace(sacked_s=ss, sacked_e=se)
+
     fin_off = s.stream_len + 1
     new_window = (wnd << jnp.where(s.wscale_ok, s.peer_wscale, 0)) \
         .astype(jnp.int32)
@@ -509,6 +622,8 @@ def _process_ack(s, f, now_ms):
         fin_acked=a.fin_acked | ack_covers_fin,
         snd_una=jnp.where(ack_covers_fin, a.stream_len, a.snd_una))
     a = a._replace(snd_nxt=jnp.maximum(a.snd_nxt, a.snd_una))
+    pr_s, pr_e = _sb_prune(a.sacked_s, a.sacked_e, a.snd_una)
+    a = a._replace(sacked_s=pr_s, sacked_e=pr_e)
     n_seg = (acked_bytes + MSS - 1) // MSS
     partial = (a.phase == PH_RECOVERY) & (ack_off < a.recover)
     a_partial = _cong_partial_ack(a, n_seg)._replace(
@@ -625,6 +740,7 @@ def _on_segment_syn_sent(s, f, now_ms):
                               s.peer_wscale),
         wscale_ok=has_ws,
         my_wscale=jnp.where(has_ws, s.my_wscale, 0),
+        sack_ok=f[8] != 0,
         snd_wnd=f[3], state=jnp.int32(ESTABLISHED),
         ack_pending=jnp.bool_(True),
     )
@@ -637,7 +753,8 @@ def _on_segment_syn_sent(s, f, now_ms):
         irs=f[1].astype(jnp.uint32), rcv_nxt=jnp.int32(0),
         peer_wscale=jnp.where(has_ws, jnp.minimum(f[5], MAX_WSCALE),
                               s.peer_wscale),
-        wscale_ok=has_ws, snd_wnd=f[3], state=jnp.int32(SYN_RCVD),
+        wscale_ok=has_ws, sack_ok=f[8] != 0, snd_wnd=f[3],
+        state=jnp.int32(SYN_RCVD),
         syn_outstanding=jnp.bool_(False), syn_sends=jnp.int32(0),
     )
     return _sel(is_rst, r,
@@ -760,15 +877,21 @@ def _next_kind(s):
 
 
 def _ev_pull(s, now_ms):
-    """next_segment(): returns (state', out[10]):
+    """next_segment(): returns (state', out[18]):
     out = (has, flags, seq(u32 bits), ack, window, paylen, wscale(-1),
-           ts, ts_echo, retransmit)."""
+           ts, ts_echo, retransmit, sack_permitted, nsack, s1, e1, s2,
+           e2, s3, e3)."""
     kind = _next_kind(s)
     before_nxt = s.snd_nxt
     zero = jnp.int32(0)
 
     def stamp(ts_out):
         return now_ms & 0x7FFFFFFF, s.last_ts_recv.astype(jnp.int32)
+
+    nb_blk, ws_blk, we_blk = _recv_sack_blocks(s)
+    sack_tail = (zero, nb_blk, ws_blk[0], we_blk[0], ws_blk[1], we_blk[1],
+                 ws_blk[2], we_blk[2])
+    no_sack_tail = (zero,) * 8
 
     # --- syn ---
     syn_state = s._replace(syn_outstanding=jnp.bool_(True),
@@ -784,39 +907,62 @@ def _ev_pull(s, now_ms):
     syn_out = (jnp.int32(1), syn_flags, s.iss.astype(jnp.int32),
                syn_ack.astype(jnp.int32),
                _advertised_window(s, jnp.bool_(True)), zero,
-               s.my_wscale, *stamp(0), syn_retx.astype(jnp.int32))
+               s.my_wscale, *stamp(0), syn_retx.astype(jnp.int32),
+               jnp.int32(1), *((zero,) * 7))
 
     # --- data ---
-    off = s.snd_nxt
+    off0 = s.snd_nxt
+    # never (re)send SACKed bytes: jump over held ranges, cap at the next
+    off, d_cap = _sb_next(s.sacked_s, s.sacked_e, off0)
     in_flight = off - s.snd_una
     window = jnp.minimum(s.cwnd * MSS, s.snd_wnd)
-    n_data = jnp.minimum(jnp.minimum(MSS, s.stream_len - off),
-                         window - in_flight)
-    d_state = s._replace(snd_nxt=off + n_data,
-                         snd_max=jnp.maximum(s.snd_max, off + n_data),
-                         ack_pending=jnp.bool_(False))
-    d_state = _sel(d_state.rto_armed, d_state, _arm_rto(d_state, now_ms))
+    n_data = jnp.minimum(
+        jnp.minimum(jnp.minimum(MSS, s.stream_len - off),
+                    window - in_flight), d_cap)
+    d_has = n_data > 0
+    n_eff = jnp.maximum(n_data, 0)
+    d_state = s._replace(
+        snd_nxt=jnp.where(d_has, off + n_eff, jnp.maximum(off, off0)),
+        snd_max=jnp.maximum(s.snd_max,
+                            jnp.where(d_has, off + n_eff, off)),
+        ack_pending=jnp.bool_(False))
+    d_state = _sel(d_state.rto_armed | ~d_has, d_state,
+                   _arm_rto(d_state, now_ms))
     d_flags = jnp.where(d_state.snd_nxt >= s.stream_len, ACK | PSH, ACK)
     data_gbn = before_nxt < s.gbn_high
     d_state = d_state._replace(
         retransmit_count=d_state.retransmit_count
-        + jnp.where(data_gbn, 1, 0))
-    d_out = (jnp.int32(1), d_flags, _wire_seq(s, off).astype(jnp.int32),
+        + jnp.where(data_gbn, 1, 0),
+        retransmitted_bytes=d_state.retransmitted_bytes
+        + jnp.where(data_gbn & d_has, n_eff, 0))
+    # n <= 0 (everything in reach already held): _build_data falls back to
+    # _build_ack, with the jumped snd_nxt already applied
+    d_ack_seq = jnp.minimum(d_state.snd_nxt,
+                            s.stream_len + jnp.where(s.fin_sent, 1, 0))
+    d_out = (jnp.int32(1),
+             jnp.where(d_has, d_flags, ACK),
+             jnp.where(d_has, _wire_seq(s, off).astype(jnp.int32),
+                       _wire_seq(s, d_ack_seq).astype(jnp.int32)),
              _wire_ack(s).astype(jnp.int32),
-             _advertised_window(s, jnp.bool_(False)), n_data,
-             jnp.int32(-1), *stamp(0), data_gbn.astype(jnp.int32))
+             _advertised_window(s, jnp.bool_(False)),
+             jnp.where(d_has, n_data, 0),
+             jnp.int32(-1), *stamp(0), data_gbn.astype(jnp.int32),
+             *sack_tail)
 
     # --- retransmit (n>0 data at snd_una; else FIN-retx or bare ack) ---
     r_state0 = s._replace(retx_pending=jnp.bool_(False),
                           retransmit_count=s.retransmit_count + 1)
-    r_n = jnp.minimum(MSS, s.stream_len - s.snd_una)
+    _, r_cap = _sb_next(s.sacked_s, s.sacked_e, s.snd_una)
+    r_n = jnp.minimum(jnp.minimum(MSS, s.stream_len - s.snd_una), r_cap)
     r_has_data = r_n > 0
-    r_data = _sel(r_state0.rto_armed, r_state0, _arm_rto(r_state0, now_ms))
+    r_data = r_state0._replace(
+        retransmitted_bytes=r_state0.retransmitted_bytes + r_n)
+    r_data = _sel(r_data.rto_armed, r_data, _arm_rto(r_data, now_ms))
     r_data_out = (jnp.int32(1), jnp.int32(ACK),
                   _wire_seq(s, s.snd_una).astype(jnp.int32),
                   _wire_ack(s).astype(jnp.int32),
                   _advertised_window(s, jnp.bool_(False)), r_n,
-                  jnp.int32(-1), *stamp(0), jnp.int32(1))
+                  jnp.int32(-1), *stamp(0), jnp.int32(1), *sack_tail)
     # FIN retransmit branch (fin_sent & no data)
     rf_state = r_state0._replace(ack_pending=jnp.bool_(False))
     rf_state = _sel(rf_state.rto_armed, rf_state, _arm_rto(rf_state, now_ms))
@@ -824,7 +970,7 @@ def _ev_pull(s, now_ms):
               _wire_seq(s, s.stream_len).astype(jnp.int32),
               _wire_ack(s).astype(jnp.int32),
               _advertised_window(s, jnp.bool_(False)), zero,
-              jnp.int32(-1), *stamp(0), jnp.int32(1))
+              jnp.int32(-1), *stamp(0), jnp.int32(1), *sack_tail)
     # bare-ack branch
     ra_state = r_state0._replace(ack_pending=jnp.bool_(False))
     ra_seq = jnp.minimum(s.snd_nxt,
@@ -833,7 +979,7 @@ def _ev_pull(s, now_ms):
               _wire_seq(s, ra_seq).astype(jnp.int32),
               _wire_ack(s).astype(jnp.int32),
               _advertised_window(s, jnp.bool_(False)), zero,
-              jnp.int32(-1), *stamp(0), jnp.int32(1))
+              jnp.int32(-1), *stamp(0), jnp.int32(1), *sack_tail)
 
     # --- probe (1 byte past the window) ---
     p_state = s._replace(probe_pending=jnp.bool_(False),
@@ -844,7 +990,7 @@ def _ev_pull(s, now_ms):
              _wire_seq(s, s.snd_nxt).astype(jnp.int32),
              _wire_ack(s).astype(jnp.int32),
              _advertised_window(s, jnp.bool_(False)), jnp.int32(1),
-             jnp.int32(-1), *stamp(0), jnp.int32(1))
+             jnp.int32(-1), *stamp(0), jnp.int32(1), *sack_tail)
 
     # --- fin ---
     f_state = s._replace(fin_sent=jnp.bool_(True),
@@ -860,7 +1006,8 @@ def _ev_pull(s, now_ms):
              _wire_seq(s, s.stream_len).astype(jnp.int32),
              _wire_ack(s).astype(jnp.int32),
              _advertised_window(s, jnp.bool_(False)), zero,
-             jnp.int32(-1), *stamp(0), fin_gbn.astype(jnp.int32))
+             jnp.int32(-1), *stamp(0), fin_gbn.astype(jnp.int32),
+             *sack_tail)
 
     # --- ack ---
     a_state = s._replace(ack_pending=jnp.bool_(False))
@@ -870,7 +1017,7 @@ def _ev_pull(s, now_ms):
              _wire_seq(s, a_seq).astype(jnp.int32),
              _wire_ack(s).astype(jnp.int32),
              _advertised_window(s, jnp.bool_(False)), zero,
-             jnp.int32(-1), *stamp(0), jnp.int32(0))
+             jnp.int32(-1), *stamp(0), jnp.int32(0), *sack_tail)
 
     # --- rst ---
     rst_seq = jnp.minimum(s.snd_nxt, s.stream_len)
@@ -878,11 +1025,11 @@ def _ev_pull(s, now_ms):
     rst_out = (jnp.int32(1), jnp.int32(RST | ACK),
                _wire_seq(s, rst_seq).astype(jnp.int32),
                _wire_ack(s).astype(jnp.int32), zero, zero,
-               jnp.int32(-1), zero, zero, jnp.int32(0))
+               jnp.int32(-1), zero, zero, jnp.int32(0), *no_sack_tail)
     rst_state = _enter_closed(s._replace(rst_pending=jnp.bool_(False)),
                               jnp.int32(104))
 
-    none_out = tuple(jnp.int32(0) for _ in range(10))
+    none_out = tuple(jnp.int32(0) for _ in range(18))
 
     # merge: the retransmit kind has three sub-shapes
     retx_state = _sel(r_has_data, r_data,
@@ -920,8 +1067,8 @@ def _ev_pull(s, now_ms):
 # ---------------------------------------------------------------------------
 
 def _event_step_one(s: TcpPlane, kind, f, now_ms):
-    """One event for one connection. Returns (state', out[10], ret)."""
-    zero_out = jnp.zeros((10,), jnp.int32)
+    """One event for one connection. Returns (state', out[18], ret)."""
+    zero_out = jnp.zeros((18,), jnp.int32)
     ret = jnp.int32(0)
 
     s_oa = _ev_open_active(s, f, now_ms)
@@ -956,10 +1103,11 @@ def tcp_event_step(plane: TcpPlane, kind: jax.Array, fields: jax.Array,
                    now_ms: jax.Array):
     """Step C connections, one event each.
 
-    kind [C] int32 EV_*, fields [C, 8] int32, now_ms [C] int32.
-    Returns (plane', out [C, 10], ret [C]) — `out` is the PULL segment
+    kind [C] int32 EV_*, fields [C, 16] int32, now_ms [C] int32.
+    Returns (plane', out [C, 18], ret [C]) — `out` is the PULL segment
     metadata (has, flags, seq, ack, window, paylen, wscale, ts, ts_echo,
-    retx), `ret` the WRITE/READ return value."""
+    retx, sack_permitted, nsack, 3x(start, end)), `ret` the WRITE/READ
+    return value."""
     return _event_step(plane, kind, fields, now_ms)
 
 

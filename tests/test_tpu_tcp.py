@@ -26,10 +26,10 @@ def u32_bits(x):
 
 class Recorder:
     def __init__(self):
-        self.events = []  # (now_ns, kind, fields[8], expected)
+        self.events = []  # (now_ns, kind, fields[N_FIELDS], expected)
 
     def add(self, now, kind, fields=(), expected=None):
-        f = list(fields) + [0] * (8 - len(fields))
+        f = list(fields) + [0] * (dtcp.N_FIELDS - len(fields))
         self.events.append((now, kind, f, expected))
 
 
@@ -72,10 +72,16 @@ class RecDeps:
 
 
 def seg_fields(seg):
+    blocks = list(seg.sack[:3])
+    flat = []
+    for ws, we in blocks:
+        flat += [u32_bits(ws), u32_bits(we)]
+    flat += [0] * (6 - len(flat))
     return [int(seg.flags), u32_bits(seg.seq), u32_bits(seg.ack),
             seg.window, len(seg.payload),
             -1 if seg.window_scale is None else seg.window_scale,
-            u32_bits(seg.timestamp), u32_bits(seg.timestamp_echo)]
+            u32_bits(seg.timestamp), u32_bits(seg.timestamp_echo),
+            1 if seg.sack_permitted else 0, len(blocks), *flat]
 
 
 class RecordedConn:
@@ -101,7 +107,8 @@ class RecordedConn:
             self.world.time, dtcp.EV_OPEN_PASSIVE,
             [u32_bits(self.conn.iss), u32_bits(syn.seq), syn.window,
              -1 if syn.window_scale is None else syn.window_scale,
-             u32_bits(syn.timestamp), u32_bits(syn.timestamp_echo)])
+             u32_bits(syn.timestamp), u32_bits(syn.timestamp_echo),
+             1 if syn.sack_permitted else 0])
 
     def write(self, n):
         try:
@@ -135,8 +142,11 @@ class RecordedConn:
         seg = self.conn.next_segment()
         expected = None
         if seg is not None:
-            expected = seg_fields(seg) + [
-                1 if self.conn.last_segment_retransmit else 0]
+            sf = seg_fields(seg)
+            # device out layout: 8 base fields, retx flag, then the SACK
+            # tail (sack_permitted, nsack, 3 blocks)
+            expected = sf[:8] + [
+                1 if self.conn.last_segment_retransmit else 0] + sf[8:]
         self.rec.add(self.world.time, dtcp.EV_PULL, [], expected)
         return seg
 
@@ -271,7 +281,7 @@ def replay_and_compare(recorded):
     replay = jax.jit(dtcp.tcp_replay)
     plane, outs, rets = replay(plane, jnp.asarray(kinds),
                                jnp.asarray(fields), jnp.asarray(now_ms))
-    outs = np.asarray(jax.device_get(outs))  # [T, C, 10]
+    outs = np.asarray(jax.device_get(outs))  # [T, C, 18]
     rets = np.asarray(jax.device_get(rets))  # [T, C]
 
     mismatches = []
@@ -315,6 +325,10 @@ def replay_and_compare(recorded):
             "srtt_ms": c.rtt.srtt_ms, "rttvar_ms": c.rtt.rttvar_ms,
             "rto_ms": c.rtt.rto_ms, "backoff_count": c.rtt.backoff_count,
             "retransmit_count": c.retransmit_count,
+            "retransmitted_bytes": c.retransmitted_bytes,
+            "sack_ok": c._sack_ok,
+            "sacked": sorted((a, b) for a, b in zip(c._sacked.s,
+                                                    c._sacked.e) if b > a),
             "rto_gen": c._rto_gen, "persist_gen": c._persist_gen,
             "rto_armed": c._rto_armed, "persist_armed": c._persist_armed,
             "iss": u32_bits(c.iss), "irs": u32_bits(c.irs),
@@ -339,6 +353,11 @@ def replay_and_compare(recorded):
             "rto_ms": int(dev.rto_ms[i]),
             "backoff_count": int(dev.backoff_count[i]),
             "retransmit_count": int(dev.retransmit_count[i]),
+            "retransmitted_bytes": int(dev.retransmitted_bytes[i]),
+            "sack_ok": bool(dev.sack_ok[i]),
+            "sacked": sorted(
+                (int(a), int(b)) for a, b in zip(dev.sacked_s[i],
+                                                 dev.sacked_e[i]) if b > a),
             "rto_gen": int(dev.rto_gen[i]),
             "persist_gen": int(dev.persist_gen[i]),
             "rto_armed": bool(dev.rto_armed[i]),
@@ -402,6 +421,53 @@ def test_rto_deadline_array_matches_timer_schedule():
             jnp.asarray([f], jnp.int32),
             jnp.asarray([t // MS], jnp.int32))
     assert checked > 0  # the scenario really exercised RTO fires
+
+
+def test_tracker_retransmitted_counter_on_lossy_link():
+    """End-to-end through the Manager: a tgen transfer over a lossy link
+    must surface SACK-era retransmissions in the tracker's `retransmitted`
+    counter (stamped via SND_TCP_RETRANSMITTED at the socket wrapper) —
+    the VERDICT #10 validation criterion."""
+    from shadow_tpu.core.config import load_config_str
+    from shadow_tpu.core.manager import Manager
+
+    cfg = load_config_str("""
+general: {stop_time: 60s, seed: 31}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        edge [ source 0 target 0 latency "20 ms" packet_loss 0.02 ]
+      ]
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - {path: tgen-server, args: ['8888'], start_time: 1s,
+       expected_final_state: running}
+  client:
+    network_node_id: 0
+    processes:
+    - {path: tgen-client, args: ['server', '8888', '524288', '1'],
+       start_time: 2s}
+""")
+    mgr = Manager(cfg)
+    stats = mgr.run()
+    assert stats.process_failures == [], stats.process_failures
+    retrans = sum(t.counters.retransmitted for t in mgr.trackers.values())
+    assert retrans > 0, "2% loss on 360 packets must retransmit something"
+    # SACK must have actually negotiated over the REAL packet path (the
+    # header carries sack_permitted + blocks), not just in unit harnesses
+    sack_conns = [
+        sock for host in mgr.hosts
+        for iface in (host.netns.localhost, host.netns.internet)
+        for sock in iface._associations.values()
+        if getattr(getattr(sock, "conn", None), "_sack_ok", False)
+    ]
+    assert sack_conns, "no socket negotiated SACK through the packet layer"
 
 
 @pytest.mark.slow
