@@ -31,6 +31,7 @@ EINPROGRESS = _errno.EINPROGRESS
 EPIPE = _errno.EPIPE
 ETIMEDOUT = _errno.ETIMEDOUT
 EOPNOTSUPP = _errno.EOPNOTSUPP
+ENOBUFS = _errno.ENOBUFS
 EPROTONOSUPPORT = _errno.EPROTONOSUPPORT
 EAFNOSUPPORT = _errno.EAFNOSUPPORT
 ENFILE = _errno.ENFILE

@@ -1,0 +1,300 @@
+"""AF_NETLINK route sockets in the simulated kernel.
+
+Parity: reference `src/main/host/descriptor/socket/netlink.rs` (1,285 LoC)
+— NETLINK_ROUTE sockets answering RTM_GETLINK / RTM_GETADDR dump requests
+with the host's simulated interfaces (lo + eth0), which is what
+`getifaddrs(3)` and `ip addr`-style queries speak. Other request types get
+an NLMSG_ERROR(-EOPNOTSUPP) reply, like the reference's catch-all.
+
+Replies are queued as datagrams at request time (the kernel's netlink dumps
+are synchronous from the requester's point of view): one NLM_F_MULTI
+datagram carrying every entry, then one NLMSG_DONE datagram. Receive
+supports MSG_PEEK / MSG_TRUNC because glibc's __netlink_recvmsg sizes its
+buffer with a PEEK|TRUNC probe before the real read.
+"""
+
+from __future__ import annotations
+
+import struct
+from collections import deque
+from typing import Optional
+
+from .. import errors
+from ..status import FileSignal, FileState, StatefulFile
+
+AF_NETLINK = 16
+NETLINK_ROUTE = 0
+
+# nlmsghdr types
+NLMSG_NOOP = 1
+NLMSG_ERROR = 2
+NLMSG_DONE = 3
+
+# nlmsghdr flags
+NLM_F_REQUEST = 0x01
+NLM_F_MULTI = 0x02
+NLM_F_ACK = 0x04
+NLM_F_ROOT = 0x100
+NLM_F_MATCH = 0x200
+NLM_F_DUMP = NLM_F_ROOT | NLM_F_MATCH
+
+# rtnetlink message types
+RTM_NEWLINK = 16
+RTM_GETLINK = 18
+RTM_NEWADDR = 20
+RTM_GETADDR = 22
+
+AF_INET = 2
+AF_UNSPEC = 0
+
+# ifinfomsg
+ARPHRD_ETHER = 1
+ARPHRD_LOOPBACK = 772
+IFF_UP = 0x1
+IFF_BROADCAST = 0x2
+IFF_LOOPBACK = 0x8
+IFF_RUNNING = 0x40
+IFF_MULTICAST = 0x1000
+IFLA_ADDRESS = 1
+IFLA_BROADCAST = 2
+IFLA_IFNAME = 3
+IFLA_MTU = 4
+
+# ifaddrmsg
+IFA_ADDRESS = 1
+IFA_LOCAL = 2
+IFA_LABEL = 3
+IFA_BROADCAST = 4
+RT_SCOPE_UNIVERSE = 0
+RT_SCOPE_HOST = 254
+
+RECV_QUEUE_MAX = 64
+MTU_LO = 65536
+MTU_ETH = 1500
+
+
+def _align4(n: int) -> int:
+    return (n + 3) & ~3
+
+
+def _rtattr(rta_type: int, payload: bytes) -> bytes:
+    ln = 4 + len(payload)
+    return struct.pack("<HH", ln, rta_type) + payload + b"\x00" * (
+        _align4(ln) - ln)
+
+
+def _nlmsg(msg_type: int, flags: int, seq: int, pid: int,
+           payload: bytes) -> bytes:
+    ln = 16 + len(payload)
+    return struct.pack("<IHHII", ln, msg_type, flags, seq, pid) + payload + \
+        b"\x00" * (_align4(ln) - ln)
+
+
+def _ip_bytes(ip: str) -> bytes:
+    return bytes(int(p) for p in ip.split("."))
+
+
+class _Iface:
+    __slots__ = ("index", "name", "ip", "prefix", "arphrd", "flags",
+                 "mtu", "scope")
+
+    def __init__(self, index, name, ip, prefix, arphrd, flags, mtu, scope):
+        self.index = index
+        self.name = name
+        self.ip = ip
+        self.prefix = prefix
+        self.arphrd = arphrd
+        self.flags = flags
+        self.mtu = mtu
+        self.scope = scope
+
+
+def host_interfaces(host) -> list[_Iface]:
+    """The two simulated interfaces every host owns (`namespace.rs`)."""
+    public_ip = host.netns.public_ip
+    return [
+        _Iface(1, "lo", "127.0.0.1", 8, ARPHRD_LOOPBACK,
+               IFF_UP | IFF_LOOPBACK | IFF_RUNNING, MTU_LO, RT_SCOPE_HOST),
+        _Iface(2, "eth0", public_ip, 24, ARPHRD_ETHER,
+               IFF_UP | IFF_BROADCAST | IFF_RUNNING | IFF_MULTICAST,
+               MTU_ETH, RT_SCOPE_UNIVERSE),
+    ]
+
+
+def _link_entry(iface: _Iface, seq: int, pid: int) -> bytes:
+    # struct ifinfomsg: u8 family, u8 pad, u16 type, i32 index, u32 flags,
+    # u32 change
+    body = struct.pack("<BxHiII", AF_UNSPEC, iface.arphrd, iface.index,
+                       iface.flags, 0)
+    body += _rtattr(IFLA_IFNAME, iface.name.encode() + b"\x00")
+    body += _rtattr(IFLA_MTU, struct.pack("<I", iface.mtu))
+    mac = b"\x00" * 6 if iface.arphrd == ARPHRD_LOOPBACK else \
+        b"\x02" + _ip_bytes(iface.ip)[:4] + b"\x01"
+    body += _rtattr(IFLA_ADDRESS, mac)
+    return _nlmsg(RTM_NEWLINK, NLM_F_MULTI, seq, pid, body)
+
+
+def _addr_entry(iface: _Iface, seq: int, pid: int) -> bytes:
+    # struct ifaddrmsg: u8 family, u8 prefixlen, u8 flags, u8 scope,
+    # u32 index
+    body = struct.pack("<BBBBI", AF_INET, iface.prefix, 0, iface.scope,
+                       iface.index)
+    body += _rtattr(IFA_ADDRESS, _ip_bytes(iface.ip))
+    body += _rtattr(IFA_LOCAL, _ip_bytes(iface.ip))
+    body += _rtattr(IFA_LABEL, iface.name.encode() + b"\x00")
+    if iface.arphrd == ARPHRD_ETHER:
+        parts = iface.ip.split(".")
+        bcast = ".".join(parts[:3]) + ".255"
+        body += _rtattr(IFA_BROADCAST, _ip_bytes(bcast))
+    return _nlmsg(RTM_NEWADDR, NLM_F_MULTI, seq, pid, body)
+
+
+class NetlinkSocket(StatefulFile):
+    """One NETLINK_ROUTE endpoint."""
+
+    def __init__(self, host, protocol: int = NETLINK_ROUTE):
+        if protocol != NETLINK_ROUTE:
+            raise errors.SyscallError(errors.EPROTONOSUPPORT)
+        super().__init__(FileState.ACTIVE | FileState.WRITABLE)
+        self.host = host
+        self.nonblocking = False
+        self.pid: Optional[int] = None  # netlink port id, not process pid
+        self.groups = 0
+        self._recv: deque[bytes] = deque()
+        self._overflow = False  # a reply was dropped; next recv -> ENOBUFS
+        self._closed = False
+
+    # -- address plumbing ------------------------------------------------
+
+    def _autobind(self) -> None:
+        if self.pid is None:
+            counter = getattr(self.host, "_netlink_pid_counter", 0) + 1
+            self.host._netlink_pid_counter = counter
+            self.pid = counter
+
+    def bind(self, addr) -> None:
+        # addr is ("netlink", pid, groups); pid 0 = kernel-assigned
+        _fam, pid, groups = addr
+        if self.pid is not None and pid not in (0, self.pid):
+            raise errors.SyscallError(errors.EINVAL)
+        if pid:
+            self.pid = pid
+        else:
+            self._autobind()
+        self.groups = groups
+
+    def getsockname(self):
+        return ("netlink", self.pid or 0, self.groups)
+
+    def getpeername(self):
+        return ("netlink", 0, 0)  # the "kernel"
+
+    def connect(self, addr) -> None:
+        # connect(2) on netlink just pins the peer (normally pid 0, the
+        # kernel); all our replies come from the kernel anyway
+        if addr[0] != "netlink":
+            raise errors.SyscallError(errors.EINVAL)
+        self._autobind()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._recv.clear()
+        self.update_state(
+            FileState.ACTIVE | FileState.READABLE | FileState.WRITABLE
+            | FileState.CLOSED,
+            FileState.CLOSED,
+        )
+
+    # -- request processing ---------------------------------------------
+
+    def send(self, data: bytes) -> int:
+        return self.sendto(data, None)
+
+    def sendto(self, data: bytes, addr) -> int:
+        if self._closed:
+            raise errors.SyscallError(errors.EBADF)
+        self._autobind()
+        off = 0
+        n = len(data)
+        while off + 16 <= n:
+            ln, msg_type, flags, seq, _pid = struct.unpack_from(
+                "<IHHII", data, off)
+            if ln < 16 or off + ln > n:
+                break
+            self._handle_request(msg_type, flags, seq,
+                                 data[off + 16:off + ln])
+            off += _align4(ln)
+        return n
+
+    def _handle_request(self, msg_type: int, flags: int, seq: int,
+                        payload: bytes) -> None:
+        if not flags & NLM_F_REQUEST or msg_type < RTM_NEWLINK:
+            return  # NOOP/DONE/ERROR from userspace: ignored, like Linux
+        pid = self.pid or 0
+        if msg_type in (RTM_GETLINK, RTM_GETADDR) and flags & NLM_F_DUMP:
+            # One multipart datagram with every entry, then DONE — the
+            # same framing the reference emits (netlink.rs dump path).
+            make = _link_entry if msg_type == RTM_GETLINK else _addr_entry
+            parts = [make(i, seq, pid) for i in host_interfaces(self.host)]
+            self._push(b"".join(parts))
+            self._push(_nlmsg(NLMSG_DONE, NLM_F_MULTI, seq, pid,
+                              struct.pack("<i", 0)))
+            return
+        # Unsupported request (including non-dump GETLINK/GETADDR):
+        # NLMSG_ERROR carrying -EOPNOTSUPP and the offending header — an
+        # honest failure rather than an empty ACK claiming success.
+        echo = struct.pack("<IHHII", 16 + len(payload), msg_type, flags,
+                           seq, pid)
+        self._push(_nlmsg(NLMSG_ERROR, 0, seq, pid,
+                          struct.pack("<i", -errors.EOPNOTSUPP) + echo))
+
+    # -- receive ---------------------------------------------------------
+
+    def recvfrom(self, max_bytes: int, peek: bool = False):
+        """Returns (data, src, full_len): `data` is the datagram clipped to
+        the buffer, `full_len` the datagram's real length so the caller can
+        apply MSG_TRUNC return-value and msg_flags semantics."""
+        if self._closed:
+            raise errors.SyscallError(errors.EBADF)
+        if self._overflow:
+            # a reply was dropped at queue-full: fail like Linux so the
+            # caller can resync, instead of hanging for a DONE that was
+            # never queued
+            self._overflow = False
+            raise errors.SyscallError(errors.ENOBUFS)
+        if not self._recv:
+            if self.nonblocking:
+                raise errors.SyscallError(errors.EWOULDBLOCK)
+            raise errors.Blocked(self, FileState.READABLE)
+        dgram = self._recv[0] if peek else self._recv.popleft()
+        if not peek:
+            self._refresh()
+        return dgram[:max_bytes], ("netlink", 0, 0), len(dgram)
+
+    def recv(self, max_bytes: int = 1 << 20) -> bytes:
+        data, _src, _ln = self.recvfrom(max_bytes)
+        return data
+
+    # -- internals -------------------------------------------------------
+
+    def _push(self, dgram: bytes) -> None:
+        if len(self._recv) >= RECV_QUEUE_MAX:
+            self._overflow = True  # surfaced as ENOBUFS on the next recv
+            self._refresh()
+            return
+        self._recv.append(dgram)
+        self._refresh()
+        self.emit_signal(FileSignal.READ_BUFFER_GREW)
+
+    def _refresh(self) -> None:
+        if self._closed:
+            return
+        value = FileState.ACTIVE | FileState.WRITABLE
+        if self._recv or self._overflow:
+            value |= FileState.READABLE  # overflow: wake reader for ENOBUFS
+        self.update_state(
+            FileState.ACTIVE | FileState.READABLE | FileState.WRITABLE,
+            value,
+        )

@@ -55,6 +55,9 @@ class _MessageBuffer:
         self.bytes -= item[2]
         return item
 
+    def peek(self):
+        return self.queue[0] if self.queue else None
+
     def __len__(self) -> int:
         return len(self.queue)
 
@@ -144,16 +147,17 @@ class UdpSocket(StatefulFile):
     def send(self, data: bytes) -> int:
         return self.sendto(data, None)
 
-    def recvfrom(self) -> tuple[bytes, tuple[str, int]]:
+    def recvfrom(self, peek: bool = False) -> tuple[bytes, tuple[str, int]]:
         if self.is_closed():
             raise errors.SyscallError(errors.EBADF)
-        entry = self._recv_buffer.pop()
+        entry = self._recv_buffer.peek() if peek else self._recv_buffer.pop()
         if entry is None:
             if self.nonblocking:
                 raise errors.SyscallError(errors.EWOULDBLOCK)
             raise errors.Blocked(self, FileState.READABLE)
         data, (src, _dst, _prio), _size = entry
-        self._refresh_readable_writable(None)
+        if not peek:
+            self._refresh_readable_writable(None)
         return data, src
 
     def recv(self) -> bytes:

@@ -43,6 +43,7 @@ from ..kernel.eventfd import EventFd
 from ..kernel.pipe import PipeReader, PipeWriter, make_pipe
 from ..kernel.socket.tcp import TcpSocket
 from ..kernel.socket.udp import UdpSocket
+from ..kernel.socket.netlink import NETLINK_ROUTE, NetlinkSocket
 from ..kernel.socket.unix import UnixSocket, make_socketpair
 from ..kernel.status import FileState
 from ..kernel.timerfd import TimerFd
@@ -121,8 +122,10 @@ SYS_getrandom = 318
 AF_UNIX = 1
 AF_INET = 2
 AF_INET6 = 10
+AF_NETLINK = 16
 SOCK_STREAM = 1
 SOCK_DGRAM = 2
+SOCK_RAW = 3
 SOCK_TYPE_MASK = 0xF
 SOCK_SEQPACKET = 5
 SOCK_NONBLOCK = 0o4000
@@ -135,6 +138,8 @@ SO_ERROR = 4
 SO_SNDBUF = 7
 SO_RCVBUF = 8
 
+MSG_PEEK = 0x02
+MSG_TRUNC = 0x20
 MSG_DONTWAIT = 0x40
 
 O_NONBLOCK = 0o4000
@@ -299,6 +304,12 @@ class SyscallHandler:
             else:
                 path = path_bytes.split(b"\x00", 1)[0].decode("latin-1")
             return UNIX_ADDR_FAMILY, path
+        if family == AF_NETLINK:
+            # sockaddr_nl: u16 family, u16 pad, u32 pid, u32 groups
+            if addrlen < 12:
+                raise errors.SyscallError(errors.EINVAL)
+            pid, groups = struct.unpack_from("<II", raw, 4)
+            return ("netlink", pid, groups)
         if family != AF_INET or addrlen < 8:
             raise errors.SyscallError(errors.EAFNOSUPPORT)
         port = struct.unpack_from(">H", raw, 2)[0]
@@ -313,6 +324,9 @@ class SyscallHandler:
             path = sockaddr[1].encode("latin-1")
             return struct.pack("<H", AF_UNIX) + path + (
                 b"" if path[:1] == b"\x00" else b"\x00")
+        if sockaddr is not None and sockaddr[0] == "netlink":
+            _fam, pid, groups = sockaddr
+            return struct.pack("<HHII", AF_NETLINK, 0, pid, groups)
         ip, port = sockaddr if sockaddr is not None else (UNSPECIFIED, 0)
         return struct.pack("<H", AF_INET) + struct.pack(">H", port) + bytes(
             int(p) for p in ip.split(".")
@@ -366,6 +380,13 @@ class SyscallHandler:
             if kind not in (SOCK_STREAM, SOCK_DGRAM, SOCK_SEQPACKET):
                 raise errors.SyscallError(errors.EPROTONOSUPPORT)
             sock = UnixSocket(self.host, stream=kind != SOCK_DGRAM)
+            sock.nonblocking = bool(type_ & SOCK_NONBLOCK)
+            return self._vfd(sock, cloexec=bool(type_ & SOCK_CLOEXEC))
+        if domain == AF_NETLINK:
+            kind = type_ & SOCK_TYPE_MASK
+            if kind not in (SOCK_RAW, SOCK_DGRAM):
+                raise errors.SyscallError(errors.EPROTONOSUPPORT)
+            sock = NetlinkSocket(self.host, protocol=_i32(args[2]))
             sock.nonblocking = bool(type_ & SOCK_NONBLOCK)
             return self._vfd(sock, cloexec=bool(type_ & SOCK_CLOEXEC))
         if domain == AF_INET6:
@@ -516,8 +537,18 @@ class SyscallHandler:
             sock.nonblocking = True
         try:
             if isinstance(sock, UdpSocket):
-                data, src = sock.recvfrom()
+                data, src = sock.recvfrom(peek=bool(flags & MSG_PEEK))
+                full = len(data)
                 data = data[:n]  # datagram truncation
+                if data:
+                    self.mem.write(bufp, data)
+                return (full if flags & MSG_TRUNC else len(data)), src
+            elif isinstance(sock, NetlinkSocket):
+                data, src, full = sock.recvfrom(
+                    n, peek=bool(flags & MSG_PEEK))
+                if data:
+                    self.mem.write(bufp, data)
+                return (full if flags & MSG_TRUNC else len(data)), src
             elif isinstance(sock, UnixSocket) and not sock.stream:
                 data, src = sock.recvfrom(n)
             else:
@@ -643,27 +674,48 @@ class SyscallHandler:
         sock = self._file(args[0])
         name, namelen, iovs = self._parse_msghdr(args[1])
         total = sum(ln for _, ln in iovs)
-        dontwait = bool(_i32(args[2]) & MSG_DONTWAIT)
+        flags_ = _i32(args[2])
+        dontwait = bool(flags_ & MSG_DONTWAIT)
         saved = sock.nonblocking
         if dontwait:
             sock.nonblocking = True
+        ret = None
+        msg_flags_out = 0
         try:
             if isinstance(sock, UdpSocket):
-                data, src = sock.recvfrom()
+                data, src = sock.recvfrom(peek=bool(flags_ & MSG_PEEK))
+                full = len(data)
                 data = data[:total]
+                if full > total:
+                    msg_flags_out = MSG_TRUNC
+                if flags_ & MSG_TRUNC:
+                    ret = full
+            elif isinstance(sock, NetlinkSocket):
+                data, src, full = sock.recvfrom(
+                    total, peek=bool(flags_ & MSG_PEEK))
+                if full > total:
+                    # datagram clipped: Linux flags MSG_TRUNC in msg_flags
+                    # on ANY truncating read; the MSG_TRUNC input flag only
+                    # switches the return value to the full length (glibc's
+                    # PEEK|TRUNC length probe relies on both)
+                    msg_flags_out = MSG_TRUNC
+                if flags_ & MSG_TRUNC:
+                    ret = full
             else:
                 data = sock.recv(total)
                 src = sock.getpeername()
         finally:
             sock.nonblocking = saved
         self._scatter(iovs, data)
+        # msg_flags writeback (offset 48 in msghdr)
+        self.mem.write(args[1] + 48, struct.pack("<i", msg_flags_out))
         # msg_name writeback, capped at the caller's msg_namelen; the
         # written length lands in msg_namelen (offset 8 in msghdr)
         if name and src is not None:
             raw = self._pack_sockaddr(src)
             self.mem.write(name, raw[: min(namelen, len(raw))])
             self.mem.write(args[1] + 8, struct.pack("<I", len(raw)))
-        return len(data)
+        return ret if ret is not None else len(data)
 
     # -- descriptor ops ------------------------------------------------
 

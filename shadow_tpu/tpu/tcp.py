@@ -129,8 +129,10 @@ class TcpPlane(NamedTuple):
     retransmit_count: jax.Array
     retransmitted_bytes: jax.Array
     last_retx: jax.Array  # bool — last pulled segment was a retransmission
-    # SACK (RFC 2018): negotiated flag + the sender scoreboard, the
+    # SACK (RFC 2018): config gate (mirrors TcpConfig.sack per
+    # connection), negotiated flag, and the sender scoreboard — the
     # slot-for-slot mirror of connection.py's _SackScoreboard
+    sack_on: jax.Array  # bool — config.sack for this connection
     sack_ok: jax.Array  # bool
     sacked_s: jax.Array  # [C, SACK_SLOTS]
     sacked_e: jax.Array
@@ -139,7 +141,7 @@ class TcpPlane(NamedTuple):
     reass_len: jax.Array
 
 
-def make_tcp_plane(n_conns: int) -> TcpPlane:
+def make_tcp_plane(n_conns: int, sack: bool = _CFG.sack) -> TcpPlane:
     z = lambda: jnp.zeros((n_conns,), jnp.int32)
     u = lambda: jnp.zeros((n_conns,), jnp.uint32)
     f = lambda: jnp.zeros((n_conns,), bool)
@@ -169,6 +171,7 @@ def make_tcp_plane(n_conns: int) -> TcpPlane:
         rto_gen=z(), rto_armed=f(), rto_deadline_ms=z(),
         persist_gen=z(), persist_armed=f(), persist_deadline_ms=z(),
         retransmit_count=z(), retransmitted_bytes=z(), last_retx=f(),
+        sack_on=jnp.full((n_conns,), bool(sack)),
         sack_ok=f(),
         sacked_s=jnp.zeros((n_conns, SACK_SLOTS), jnp.int32),
         sacked_e=jnp.zeros((n_conns, SACK_SLOTS), jnp.int32),
@@ -496,7 +499,7 @@ def _ev_open_passive(s, f, now_ms):
         snd_wnd=f[2],
         last_ts_recv=jnp.where(f[4] != 0, f[4].astype(jnp.uint32),
                                s.last_ts_recv),
-        sack_ok=f[6] != 0,  # peer offered AND config.sack (always on)
+        sack_ok=(f[6] != 0) & s.sack_on,  # peer offered AND config.sack
         state=jnp.int32(SYN_RCVD),
     )
     return _arm_rto(s, now_ms)
@@ -740,7 +743,7 @@ def _on_segment_syn_sent(s, f, now_ms):
                               s.peer_wscale),
         wscale_ok=has_ws,
         my_wscale=jnp.where(has_ws, s.my_wscale, 0),
-        sack_ok=f[8] != 0,
+        sack_ok=(f[8] != 0) & s.sack_on,
         snd_wnd=f[3], state=jnp.int32(ESTABLISHED),
         ack_pending=jnp.bool_(True),
     )
@@ -753,7 +756,7 @@ def _on_segment_syn_sent(s, f, now_ms):
         irs=f[1].astype(jnp.uint32), rcv_nxt=jnp.int32(0),
         peer_wscale=jnp.where(has_ws, jnp.minimum(f[5], MAX_WSCALE),
                               s.peer_wscale),
-        wscale_ok=has_ws, sack_ok=f[8] != 0, snd_wnd=f[3],
+        wscale_ok=has_ws, sack_ok=(f[8] != 0) & s.sack_on, snd_wnd=f[3],
         state=jnp.int32(SYN_RCVD),
         syn_outstanding=jnp.bool_(False), syn_sends=jnp.int32(0),
     )
@@ -908,7 +911,7 @@ def _ev_pull(s, now_ms):
                syn_ack.astype(jnp.int32),
                _advertised_window(s, jnp.bool_(True)), zero,
                s.my_wscale, *stamp(0), syn_retx.astype(jnp.int32),
-               jnp.int32(1), *((zero,) * 7))
+               s.sack_on.astype(jnp.int32), *((zero,) * 7))
 
     # --- data ---
     off0 = s.snd_nxt

@@ -1,0 +1,209 @@
+"""Netlink route sockets: unit-level dump framing plus a managed C binary
+running getifaddrs() against the simulated interfaces.
+
+Parity: reference `src/main/host/descriptor/socket/netlink.rs` (RTM_GETLINK
+/ RTM_GETADDR dumps) and `src/test/netlink` / `src/test/ifaddrs`.
+"""
+
+import shutil
+import struct
+import subprocess
+
+import pytest
+
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.manager import Manager
+from shadow_tpu.kernel import errors
+from shadow_tpu.kernel.socket.netlink import (NLM_F_ACK, NLM_F_DUMP,
+                                              NLM_F_MULTI, NLM_F_REQUEST,
+                                              NLMSG_DONE, NLMSG_ERROR,
+                                              RTM_GETADDR, RTM_GETLINK,
+                                              RTM_NEWADDR, RTM_NEWLINK,
+                                              NetlinkSocket)
+
+CONFIG = """
+general:
+  stop_time: 1s
+  seed: 7
+network:
+  graph:
+    type: 1_gbit_switch
+hosts:
+  alpha:
+    network_node_id: 0
+    ip_addr: 11.0.0.1
+"""
+
+
+def _host():
+    return Manager(load_config_str(CONFIG)).hosts[0]
+
+
+def _req(msg_type: int, flags: int, seq: int) -> bytes:
+    # empty ifinfomsg/ifaddrmsg payloads are what glibc sends for dumps
+    payload = b"\x00" * 16
+    return struct.pack("<IHHII", 16 + len(payload), msg_type,
+                       NLM_F_REQUEST | flags, seq, 0) + payload
+
+
+def _parse_msgs(dgram: bytes):
+    msgs = []
+    off = 0
+    while off + 16 <= len(dgram):
+        ln, t, fl, seq, pid = struct.unpack_from("<IHHII", dgram, off)
+        msgs.append((t, fl, seq, pid, dgram[off + 16:off + ln]))
+        off += (ln + 3) & ~3
+    return msgs
+
+
+def _parse_rtattrs(payload: bytes, fixed: int):
+    attrs = {}
+    off = fixed
+    while off + 4 <= len(payload):
+        ln, t = struct.unpack_from("<HH", payload, off)
+        if ln < 4:
+            break
+        attrs[t] = payload[off + 4:off + ln]
+        off += (ln + 3) & ~3
+    return attrs
+
+
+def test_getlink_dump_lists_lo_and_eth0():
+    sock = NetlinkSocket(_host())
+    sock.sendto(_req(RTM_GETLINK, NLM_F_DUMP, 101), None)
+    part, _src, _ln = sock.recvfrom(1 << 16)
+    msgs = _parse_msgs(part)
+    assert [m[0] for m in msgs] == [RTM_NEWLINK, RTM_NEWLINK]
+    names = []
+    for t, fl, seq, pid, payload in msgs:
+        assert fl & NLM_F_MULTI
+        assert seq == 101
+        attrs = _parse_rtattrs(payload, 16)
+        names.append(attrs[3].rstrip(b"\x00").decode())  # IFLA_IFNAME
+    assert names == ["lo", "eth0"]
+    done, _src, _ln = sock.recvfrom(1 << 16)
+    assert _parse_msgs(done)[0][0] == NLMSG_DONE
+
+
+def test_getaddr_dump_carries_simulated_ips():
+    sock = NetlinkSocket(_host())
+    sock.sendto(_req(RTM_GETADDR, NLM_F_DUMP, 7), None)
+    part, _src, _ln = sock.recvfrom(1 << 16)
+    msgs = _parse_msgs(part)
+    assert [m[0] for m in msgs] == [RTM_NEWADDR, RTM_NEWADDR]
+    ips = []
+    for _t, _fl, _seq, _pid, payload in msgs:
+        attrs = _parse_rtattrs(payload, 8)
+        ips.append(".".join(str(b) for b in attrs[1]))  # IFA_ADDRESS
+    assert ips == ["127.0.0.1", "11.0.0.1"]
+
+
+def test_unsupported_request_gets_nlmsg_error():
+    sock = NetlinkSocket(_host())
+    RTM_GETROUTE = 26
+    sock.sendto(_req(RTM_GETROUTE, NLM_F_DUMP | NLM_F_ACK, 9), None)
+    part, _src, _ln = sock.recvfrom(1 << 16)
+    t, _fl, seq, _pid, payload = _parse_msgs(part)[0]
+    assert t == NLMSG_ERROR
+    assert seq == 9
+    (code,) = struct.unpack_from("<i", payload, 0)
+    assert code == -errors.EOPNOTSUPP
+
+
+def test_peek_and_trunc_semantics():
+    """glibc sizes its buffer with a MSG_PEEK|MSG_TRUNC probe: the probe
+    must report the full datagram length without consuming it."""
+    sock = NetlinkSocket(_host())
+    sock.sendto(_req(RTM_GETLINK, NLM_F_DUMP, 1), None)
+    _data, _src, full = sock.recvfrom(1, peek=True)
+    assert full > 16
+    data, _src, ln = sock.recvfrom(1 << 16)
+    assert len(data) == full == ln
+    # queue still has the DONE datagram
+    done, _src, _ln = sock.recvfrom(1 << 16)
+    assert _parse_msgs(done)[0][0] == NLMSG_DONE
+    with pytest.raises(errors.Blocked):
+        sock.recvfrom(1 << 16)
+
+
+def test_queue_overflow_surfaces_enobufs():
+    """When the reply queue overflows (a DONE terminator may have been
+    dropped), the next recv must fail with ENOBUFS rather than leave the
+    reader hanging for a terminator that never comes."""
+    sock = NetlinkSocket(_host())
+    for i in range(40):  # 2 datagrams per dump > RECV_QUEUE_MAX=64
+        sock.sendto(_req(RTM_GETLINK, NLM_F_DUMP, i), None)
+    # like Linux's sk_err, the pending error surfaces before queued data
+    with pytest.raises(errors.SyscallError) as e:
+        sock.recvfrom(1 << 16)
+    assert e.value.errno == errors.ENOBUFS
+    drained = 0
+    with pytest.raises(errors.Blocked):
+        for _ in range(200):
+            sock.recvfrom(1 << 16)
+            drained += 1
+    assert drained == 64
+    # after the error the socket is usable again
+    sock.sendto(_req(RTM_GETADDR, NLM_F_DUMP, 99), None)
+    part, _src, _ln = sock.recvfrom(1 << 16)
+    assert _parse_msgs(part)[0][0] == RTM_NEWADDR
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a managed native binary calls getifaddrs()
+# ---------------------------------------------------------------------------
+
+CC = shutil.which("gcc") or shutil.which("cc")
+
+IFADDRS_C = r"""
+#include <arpa/inet.h>
+#include <ifaddrs.h>
+#include <netinet/in.h>
+#include <string.h>
+#include <sys/socket.h>
+
+int main(int argc, char **argv) {
+    const char *want = argv[1]; /* the host's simulated public IP */
+    struct ifaddrs *ifa0, *ifa;
+    if (getifaddrs(&ifa0)) return 50;
+    int saw_lo = 0, saw_eth = 0;
+    for (ifa = ifa0; ifa; ifa = ifa->ifa_next) {
+        if (!ifa->ifa_addr || ifa->ifa_addr->sa_family != AF_INET)
+            continue;
+        char ip[INET_ADDRSTRLEN];
+        struct sockaddr_in *sa = (struct sockaddr_in *)ifa->ifa_addr;
+        inet_ntop(AF_INET, &sa->sin_addr, ip, sizeof ip);
+        if (!strcmp(ifa->ifa_name, "lo") && !strcmp(ip, "127.0.0.1"))
+            saw_lo = 1;
+        if (!strcmp(ifa->ifa_name, "eth0") && !strcmp(ip, want))
+            saw_eth = 1;
+    }
+    freeifaddrs(ifa0);
+    if (!saw_lo) return 51;
+    if (!saw_eth) return 52;
+    return 0;
+}
+"""
+
+
+@pytest.mark.skipif(CC is None, reason="no C compiler")
+def test_managed_getifaddrs_sees_simulated_interfaces(tmp_path):
+    c = tmp_path / "ifaddrs.c"
+    c.write_text(IFADDRS_C)
+    binary = tmp_path / "ifaddrs"
+    subprocess.run([CC, "-O1", "-o", str(binary), str(c)], check=True)
+    cfg = load_config_str(f"""
+general: {{stop_time: 5s, seed: 3}}
+network:
+  graph:
+    type: 1_gbit_switch
+hosts:
+  alpha:
+    network_node_id: 0
+    ip_addr: 11.0.0.5
+    processes:
+    - {{path: {binary}, args: ["11.0.0.5"], start_time: 1s,
+       expected_final_state: {{exited: 0}}}}
+""")
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures

@@ -87,10 +87,10 @@ def seg_fields(seg):
 class RecordedConn:
     """A TcpConnection plus its event trace."""
 
-    def __init__(self, world, seed):
+    def __init__(self, world, seed, config=None):
         self.rec = Recorder()
         self.deps = RecDeps(world, self.rec, seed)
-        self.conn = TcpConnection(self.deps)
+        self.conn = TcpConnection(self.deps, config)
         self.world = world
 
     def open_active(self):
@@ -155,14 +155,15 @@ class Wire:
     """Two recorded connections joined by a latency wire with scripted
     data-segment drops (a->b)."""
 
-    def __init__(self, latency_ns=1 * MS, seed=1234, drop_at=()):
+    def __init__(self, latency_ns=1 * MS, seed=1234, drop_at=(),
+                 config=None):
         self.time = 0
         self.timers = []
         self.counter = itertools.count()
         self.latency = latency_ns
         self.in_flight = []
-        self.a = RecordedConn(self, seed)
-        self.b = RecordedConn(self, seed + 77)
+        self.a = RecordedConn(self, seed, config)
+        self.b = RecordedConn(self, seed + 77, config)
         self.drop_at = set(drop_at)  # indices of a->b data segments to drop
         self._a_data_segs = 0
 
@@ -211,10 +212,11 @@ class Wire:
 
 
 def transfer_scenario(latency_ns, seed, size, chunk, drop_at=(),
-                      abort_at_ns=None, b_writes=0):
+                      abort_at_ns=None, b_writes=0, config=None):
     """One end-to-end life: handshake, a->b transfer (+ optional b->a),
     loss, orderly close (or abort). Returns the two RecordedConns."""
-    w = Wire(latency_ns=latency_ns, seed=seed, drop_at=drop_at)
+    w = Wire(latency_ns=latency_ns, seed=seed, drop_at=drop_at,
+             config=config)
     w.a.open_active()
     syn = w.a.pull()
     assert syn is not None and syn.flags & TcpFlags.SYN
@@ -263,7 +265,7 @@ def transfer_scenario(latency_ns, seed, size, chunk, drop_at=(),
     return w.a, w.b
 
 
-def replay_and_compare(recorded):
+def replay_and_compare(recorded, sack=True):
     """Replay every connection's trace on device; assert all PULL outputs,
     write/read returns, and final states match the CPU machines."""
     C = len(recorded)
@@ -277,7 +279,7 @@ def replay_and_compare(recorded):
             fields[i, j] = f
             now_ms[i, j] = t // MS
 
-    plane = dtcp.make_tcp_plane(C)
+    plane = dtcp.make_tcp_plane(C, sack=sack)
     replay = jax.jit(dtcp.tcp_replay)
     plane, outs, rets = replay(plane, jnp.asarray(kinds),
                                jnp.asarray(fields), jnp.asarray(now_ms))
@@ -493,3 +495,16 @@ def test_thousand_connections_bitwise():
         recorded.extend([a, b])
     assert len(recorded) == 1024
     replay_and_compare(recorded)
+
+
+def test_sack_disabled_parity():
+    """With TcpConfig(sack=False) the device must mirror the CPU machine
+    bitwise too: no sack_permitted on SYNs, no SACK blocks, go-back-N
+    recovery — the config gate is per-connection state (`sack_on`), not a
+    baked-in constant."""
+    from shadow_tpu.tcp.connection import TcpConfig
+
+    a, b = transfer_scenario(2 * MS, 91, size=40_000, chunk=8192,
+                             drop_at=(1, 3, 4), config=TcpConfig(sack=False))
+    assert not a.conn._sack_ok and not b.conn._sack_ok
+    replay_and_compare([a, b], sack=False)

@@ -234,3 +234,22 @@ def test_closed_socket_raises_ebadf():
         with pytest.raises(errors.SyscallError) as e:
             fn()
         assert e.value.errno == errors.EBADF
+
+
+def test_recvfrom_peek_leaves_datagram_queued():
+    """MSG_PEEK at the socket layer: a peeked datagram must stay queued
+    and be returned again by the consuming read (recvfrom(2) semantics
+    the syscall handler relies on for MSG_PEEK support)."""
+    mgr = _manager()
+    host = mgr.hosts[0]
+    s = UdpSocket(host)
+    s.bind((host.ip, 7700))
+    s._recv_buffer.push(b"hello", (("11.0.0.9", 1234), (host.ip, 7700), 0),
+                        5)
+    s._refresh_readable_writable(None)
+    data, src = s.recvfrom(peek=True)
+    assert data == b"hello" and src == ("11.0.0.9", 1234)
+    assert len(s._recv_buffer) == 1  # still there
+    data2, _ = s.recvfrom()
+    assert data2 == b"hello"
+    assert len(s._recv_buffer) == 0
