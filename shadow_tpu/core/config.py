@@ -129,6 +129,12 @@ class ExperimentalOptions:
     socket_recv_buffer: int = 174760
     socket_recv_autotune: bool = True
     use_cpu_pinning: bool = True
+    # CPU oversubscription model (`sim_config.rs:173-174,248-249`): events
+    # are deferred once unapplied native-execution delay exceeds the
+    # threshold. None disables the model (the reference default); enabling
+    # it trades determinism for realism since charges are wall-time based.
+    cpu_threshold: Optional[int] = None
+    cpu_precision: Optional[int] = 200  # ns, `sim_config.rs:249`
     use_worker_spinning: bool = True
     use_memory_manager: bool = False
     use_new_tcp: bool = False

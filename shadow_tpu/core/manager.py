@@ -15,6 +15,7 @@ import time as _walltime
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..host.cpu import Cpu
 from ..host.host import Host
 from ..net import graph as netgraph
 from ..net.dns import Dns
@@ -56,6 +57,24 @@ def _tracker_dispatch(packet, status):
         return
     for tracker in getattr(host, "trackers", ()):
         tracker.on_packet_status(packet, status)
+
+
+def _raw_cpu_frequency_khz() -> int:
+    """The machine's raw CPU frequency (`manager.rs:826-830`), with a
+    /proc/cpuinfo fallback and a 1 GHz default when neither is readable."""
+    try:
+        with open("/sys/devices/system/cpu/cpu0/cpufreq/cpuinfo_max_freq") as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        pass
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("cpu mhz"):
+                    return int(float(line.split(":", 1)[1]) * 1000)
+    except (OSError, ValueError, IndexError):
+        pass
+    return 1_000_000  # 1 GHz
 
 
 class Manager:
@@ -107,6 +126,7 @@ class Manager:
         # --- hosts -----------------------------------------------------------
         ip_to_host: dict[str, Host] = {}
         ip_to_node: dict[str, int] = {}
+        raw_freq_khz = _raw_cpu_frequency_khz()
         for host_id, (name, opts, ip, seed) in enumerate(host_plans, start=1):
             node = self.graph.node_by_id(opts.network_node_id)
             bw_down = opts.bandwidth_down or node.bandwidth_down
@@ -118,7 +138,16 @@ class Manager:
                 )
             host_opts = config.host_defaults.merged_with(opts.host_options).resolved()
             pcap_factory = self._make_pcap_factory(name, host_opts)
+            # sim freq == native freq, like `manager.rs:565` passing the
+            # machine's raw frequency as the host frequency; threshold None
+            # keeps the model (and its wall-time nondeterminism) off
+            cpu = Cpu(
+                raw_freq_khz, raw_freq_khz,
+                config.experimental.cpu_threshold,
+                config.experimental.cpu_precision,
+            )
             host = Host(
+                cpu=cpu,
                 host_id=host_id,
                 name=name,
                 ip=ip,

@@ -1,9 +1,11 @@
 """Simulated-CPU time accounting.
 
-Parity: reference `src/main/host/cpu.rs:8-40` — native execution time spent
-by managed code is charged to a simulated CPU at a configured frequency; when
-accumulated unapplied delay exceeds a threshold, event execution is pushed
-into the future (rounded up to a precision), modelling an oversubscribed CPU.
+Parity: reference `src/main/host/cpu.rs:8-95` — native execution time spent
+by managed code is charged to a simulated CPU at a configured frequency
+ratio; when the accumulated unapplied delay exceeds a threshold, event
+execution is pushed into the future, modelling an oversubscribed CPU.
+Charged delays are rounded to the configured precision (nearest, up at the
+midpoint — `cpu.rs:62-76`); the reported delay is the raw backlog.
 """
 
 from __future__ import annotations
@@ -12,7 +14,8 @@ from typing import Optional
 
 
 class Cpu:
-    __slots__ = ("_sim_freq_khz", "_native_freq_khz", "_threshold", "_precision", "_now", "_time_cursor")
+    __slots__ = ("_sim_freq_khz", "_native_freq_khz", "threshold",
+                 "_precision", "_now", "_time_cursor")
 
     def __init__(
         self,
@@ -21,9 +24,11 @@ class Cpu:
         threshold_ns: Optional[int],
         precision_ns: Optional[int],
     ):
+        if precision_ns is not None:
+            assert precision_ns > 0
         self._sim_freq_khz = sim_frequency_khz
         self._native_freq_khz = native_frequency_khz
-        self._threshold = threshold_ns
+        self.threshold = threshold_ns  # None = model disabled (`cpu.rs:83`)
         self._precision = precision_ns
         self._now = 0
         # The simulated-CPU "busy until" cursor; delay = cursor - now.
@@ -35,18 +40,22 @@ class Cpu:
             self._time_cursor = now
 
     def add_delay(self, native_ns: int) -> None:
-        """Charge native execution time, scaled by the frequency ratio."""
+        """Charge native execution time, scaled by the frequency ratio and
+        rounded to the precision (nearest, ties up — `cpu.rs:62-76`)."""
         scaled = native_ns * self._native_freq_khz // max(1, self._sim_freq_khz)
+        if self._precision:
+            rem = scaled % self._precision
+            scaled -= rem
+            if rem * 2 >= self._precision:
+                scaled += self._precision
         self._time_cursor += scaled
 
     def delay(self) -> int:
-        """Outstanding delay to apply, 0 if below threshold. Rounded up to the
-        configured precision so events don't splinter into ns-grade wakeups."""
-        if self._threshold is None:
+        """Outstanding delay to apply; 0 when disabled or below threshold
+        (`cpu.rs:81-95`)."""
+        if self.threshold is None:
             return 0
         raw = self._time_cursor - self._now
-        if raw <= self._threshold:
+        if raw <= self.threshold:
             return 0
-        if self._precision:
-            raw = -(-raw // self._precision) * self._precision
         return raw

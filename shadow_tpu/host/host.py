@@ -212,7 +212,7 @@ class Host:
 
             # CPU oversubscription can push the event into the future
             # (`host.rs:821-849`).
-            if self.cpu is not None:
+            if self.cpu is not None and self.cpu.threshold is not None:
                 self.cpu.update_time(event.time)
                 delay = self.cpu.delay()
                 if delay > 0:

@@ -216,21 +216,23 @@ class TcpSocket(StatefulFile):
         self._refresh_state()
         return n
 
-    def recv(self, max_bytes: int = 1 << 20) -> bytes:
+    def recv(self, max_bytes: int = 1 << 20, peek: bool = False) -> bytes:
         if self.is_closed():
             raise errors.SyscallError(errors.EBADF)
         if self.conn is None:
             raise errors.SyscallError(errors.ENOTCONN)
         try:
-            data = self.conn.read(max_bytes)
+            data = (self.conn.peek(max_bytes) if peek
+                    else self.conn.read(max_bytes))
         except TcpError as e:
             raise errors.SyscallError(e.errno) from None
         if not data and not self.conn.at_eof():
             if self.nonblocking:
                 raise errors.SyscallError(errors.EWOULDBLOCK)
             raise errors.Blocked(self, FileState.READABLE)
-        self._pump_out()  # reads can reopen the advertised window
-        self._refresh_state()
+        if not peek:
+            self._pump_out()  # reads can reopen the advertised window
+            self._refresh_state()
         return data
 
     def close(self) -> None:

@@ -207,11 +207,11 @@ class UnixSocket(StatefulFile):
         dst.emit_signal(FileSignal.READ_BUFFER_GREW)
         return len(data)
 
-    def recv(self, max_bytes: int = 1 << 20) -> bytes:
-        data, _src = self.recvfrom(max_bytes)
+    def recv(self, max_bytes: int = 1 << 20, peek: bool = False) -> bytes:
+        data, _src = self.recvfrom(max_bytes, peek)
         return data
 
-    def recvfrom(self, max_bytes: int = 1 << 20):
+    def recvfrom(self, max_bytes: int = 1 << 20, peek: bool = False):
         if self._closed:
             raise errors.SyscallError(errors.EBADF)
         if self.stream:
@@ -223,6 +223,15 @@ class UnixSocket(StatefulFile):
                 if self.nonblocking:
                     raise errors.SyscallError(errors.EWOULDBLOCK)
                 raise errors.Blocked(self, FileState.READABLE)
+            if peek:
+                out = []
+                need = max_bytes
+                for chunk in self._recv:
+                    if need <= 0:
+                        break
+                    out.append(chunk[:need])
+                    need -= min(need, len(chunk))
+                return b"".join(out), self.getpeername()
             out = []
             need = max_bytes
             while need > 0 and self._recv:
@@ -246,9 +255,12 @@ class UnixSocket(StatefulFile):
             if self.nonblocking:
                 raise errors.SyscallError(errors.EWOULDBLOCK)
             raise errors.Blocked(self, FileState.READABLE)
-        data, src = self._recv.popleft()
-        self._recv_bytes -= len(data)
-        self._refresh()
+        if peek:
+            data, src = self._recv[0]
+        else:
+            data, src = self._recv.popleft()
+            self._recv_bytes -= len(data)
+            self._refresh()
         return data[:max_bytes], (UNIX_ADDR_FAMILY, src)
 
     # -- internals -------------------------------------------------------

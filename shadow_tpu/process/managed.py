@@ -27,6 +27,7 @@ import os
 import struct
 import subprocess
 import threading
+import time as _time
 from typing import Callable, Optional
 
 from ..core import simtime
@@ -639,8 +640,20 @@ class ManagedSimProcess:
         """Service ONE managed thread until it blocks, exits, or dies (runs
         on the worker thread currently executing this host, like the
         reference `managed_thread.rs:185-322` resume loop)."""
+        # CPU model: the wall time between handing control to the shim and
+        # its next event is native execution; charge it to the simulated
+        # CPU (`process.rs:465-482` cpu-delay timer). Only measured when
+        # the model is on — the charges are wall-time based and therefore
+        # nondeterministic by design.
+        cpu = self.host.cpu
+        charge = cpu is not None and cpu.threshold is not None
         while True:
-            ev = thread.ipc.recv_from_shim()
+            if charge:
+                t0 = _time.monotonic_ns()
+                ev = thread.ipc.recv_from_shim()
+                cpu.add_delay(_time.monotonic_ns() - t0)
+            else:
+                ev = thread.ipc.recv_from_shim()
             if ev is None:
                 self._reap()
                 return

@@ -550,10 +550,22 @@ class SyscallHandler:
                     self.mem.write(bufp, data)
                 return (full if flags & MSG_TRUNC else len(data)), src
             elif isinstance(sock, UnixSocket) and not sock.stream:
-                data, src = sock.recvfrom(n)
+                # take the whole datagram so MSG_TRUNC can report its real
+                # size (supported on AF_UNIX dgram since Linux 3.4)
+                data, src = sock.recvfrom(1 << 20,
+                                          peek=bool(flags & MSG_PEEK))
+                full = len(data)
+                data = data[:n]
+                if data:
+                    self.mem.write(bufp, data)
+                return (full if flags & MSG_TRUNC else len(data)), src
             else:
-                data = sock.recv(n)
+                # TCP / unix-stream: MSG_PEEK honored; MSG_TRUNC on a
+                # stream means read-and-discard (no buffer copy)
+                data = sock.recv(n, peek=bool(flags & MSG_PEEK))
                 src = sock.getpeername()
+                if flags & MSG_TRUNC:
+                    return len(data), src
         finally:
             sock.nonblocking = saved
         if data:
@@ -701,8 +713,17 @@ class SyscallHandler:
                     msg_flags_out = MSG_TRUNC
                 if flags_ & MSG_TRUNC:
                     ret = full
+            elif isinstance(sock, UnixSocket) and not sock.stream:
+                data, src = sock.recvfrom(1 << 20,
+                                          peek=bool(flags_ & MSG_PEEK))
+                full = len(data)
+                data = data[:total]
+                if full > total:
+                    msg_flags_out = MSG_TRUNC
+                if flags_ & MSG_TRUNC:
+                    ret = full
             else:
-                data = sock.recv(total)
+                data = sock.recv(total, peek=bool(flags_ & MSG_PEEK))
                 src = sock.getpeername()
         finally:
             sock.nonblocking = saved

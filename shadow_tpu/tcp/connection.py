@@ -332,13 +332,21 @@ class TcpConnection:
                 self._arm_persist()
         return n
 
+    def _raise_pending_error(self) -> bool:
+        """Shared by read/peek: surface a pending error once when the
+        ordered queue is drained; afterwards reads see EOF, like Linux.
+        Returns True when the caller should return b"" (post-consumption)."""
+        if self.error is None or self._ordered:
+            return False
+        if self._error_consumed:
+            return True
+        self._error_consumed = True
+        raise TcpError(self.error)
+
     def read(self, max_bytes: int) -> bytes:
         """Pop in-order received bytes; b"" at EOF. Raises when unreadable."""
-        if self.error is not None and not self._ordered:
-            if self._error_consumed:
-                return b""  # post-reset reads see EOF, like Linux
-            self._error_consumed = True
-            raise TcpError(self.error)
+        if self._raise_pending_error():
+            return b""
         out = []
         need = max_bytes
         while need > 0 and self._ordered:
@@ -358,6 +366,22 @@ class TcpConnection:
             self._ack_pending = True
             self.deps.notify()
         return got
+
+    def peek(self, max_bytes: int) -> bytes:
+        """Non-consuming read of in-order bytes (recv MSG_PEEK): no queue
+        mutation, no window-update side effects. Pending errors are still
+        consumed-once, like Linux sk_err under MSG_PEEK."""
+        if self._raise_pending_error():
+            return b""
+        out = []
+        need = max_bytes
+        for chunk in self._ordered:
+            if need <= 0:
+                break
+            take = chunk[:need]
+            out.append(take)
+            need -= len(take)
+        return b"".join(out)
 
     def close(self) -> None:
         """Orderly close of the send direction (app close())."""

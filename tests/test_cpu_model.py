@@ -1,0 +1,143 @@
+"""The simulated-CPU oversubscription model.
+
+Parity: reference `src/main/host/cpu.rs:8-95` (frequency scaling, precision
+rounding nearest-ties-up, threshold gating) wired through `Host::execute`'s
+event-deferral path (`host.rs:821-849`) and constructed per host by the
+Manager with the machine's raw frequency (`manager.rs:565,826-830`).
+"""
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.event import TaskRef
+from shadow_tpu.core.manager import Manager, _raw_cpu_frequency_khz
+from shadow_tpu.host.cpu import Cpu
+
+MS = simtime.MILLISECOND
+US = simtime.MICROSECOND
+
+CONFIG = """
+general:
+  stop_time: 1s
+  seed: 7
+network:
+  graph:
+    type: 1_gbit_switch
+hosts:
+  alpha:
+    network_node_id: 0
+"""
+
+
+def test_disabled_model_reports_zero_delay():
+    cpu = Cpu(1_000_000, 1_000_000, None, 200)
+    cpu.update_time(0)
+    cpu.add_delay(50 * MS)
+    assert cpu.delay() == 0  # threshold None = model off (`cpu.rs:83`)
+
+
+def test_threshold_gates_delay():
+    cpu = Cpu(1_000_000, 1_000_000, 10 * US, None)
+    cpu.update_time(0)
+    cpu.add_delay(9 * US)
+    assert cpu.delay() == 0  # below threshold
+    cpu.add_delay(2 * US)
+    assert cpu.delay() == 11 * US  # raw backlog once over threshold
+    # time advancing consumes the backlog
+    cpu.update_time(11 * US)
+    assert cpu.delay() == 0
+
+
+def test_frequency_ratio_scales_charges():
+    # native CPU twice as fast as the simulated one: native time doubles
+    cpu = Cpu(1_000_000, 2_000_000, 0, None)
+    cpu.update_time(0)
+    cpu.add_delay(5 * US)
+    assert cpu.delay() == 10 * US
+
+
+def test_precision_rounds_nearest_ties_up():
+    cpu = Cpu(1_000_000, 1_000_000, 0, 200)
+    cpu.update_time(0)
+    cpu.add_delay(299)  # 299 % 200 = 99 < 100 -> down to 200
+    assert cpu.delay() == 200
+    cpu.add_delay(100)  # 100 * 2 == 200 -> ties round up to 200
+    assert cpu.delay() == 400
+
+
+def test_manager_wires_cpu_into_hosts():
+    mgr = Manager(load_config_str(CONFIG))
+    host = mgr.hosts[0]
+    assert host.cpu is not None
+    assert host.cpu.threshold is None  # default: model off, deterministic
+
+
+def test_config_knobs_reach_the_host():
+    cfg = load_config_str(CONFIG + """
+experimental:
+  cpu_threshold: 10000
+  cpu_precision: 500
+""")
+    host = Manager(cfg).hosts[0]
+    assert host.cpu.threshold == 10000
+    assert host.cpu._precision == 500
+
+
+def test_oversubscribed_cpu_defers_events():
+    """`host.rs:821-849`: with unapplied delay over the threshold, a due
+    event is pushed into the future instead of executing now."""
+    mgr = Manager(load_config_str(CONFIG + """
+experimental:
+  cpu_threshold: 1000000
+"""))
+    host = mgr.hosts[0]
+    fired = []
+    host.schedule_task_at(TaskRef(lambda h: fired.append(h.now()), "probe"),
+                          1 * MS)
+    host.cpu.update_time(0)
+    host.cpu.add_delay(5 * MS)  # way over the 1ms threshold
+    host.execute(2 * MS)
+    assert fired == []  # deferred past the window
+    host.execute(10 * MS)
+    assert len(fired) == 1
+    assert fired[0] >= 5 * MS  # ran only after the backlog drained
+
+
+def test_raw_frequency_detection_positive():
+    assert _raw_cpu_frequency_khz() > 0
+
+
+def test_managed_binary_charges_cpu_time(tmp_path):
+    """End-to-end: native execution time of a managed binary lands on the
+    simulated CPU when the model is enabled (`process.rs:465-482`)."""
+    import shutil
+    import subprocess
+
+    cc = shutil.which("gcc") or shutil.which("cc")
+    if cc is None:
+        import pytest
+        pytest.skip("no C compiler")
+    c = tmp_path / "burn.c"
+    c.write_text(
+        "volatile long x; int main(void){"
+        "for (long i = 0; i < 20000000; i++) x += i; return 0; }")
+    binary = tmp_path / "burn"
+    subprocess.run([cc, "-O0", "-o", str(binary), str(c)], check=True)
+    cfg = load_config_str(f"""
+general: {{stop_time: 5s, seed: 3}}
+experimental:
+  cpu_threshold: 1000000
+network:
+  graph: {{type: 1_gbit_switch}}
+hosts:
+  alpha:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, args: [], start_time: 1s,
+       expected_final_state: {{exited: 0}}}}
+""")
+    mgr = Manager(cfg)
+    stats = mgr.run()
+    assert stats.process_failures == [], stats.process_failures
+    host = mgr.hosts[0]
+    # the busy loop's native wall time was charged to the simulated CPU
+    assert host.cpu._time_cursor > 0

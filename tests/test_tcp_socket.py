@@ -310,3 +310,19 @@ def test_connect_ephemeral_ports_deterministic():
         results.append(ports)
     assert results[0] == results[1]
     assert len(set(results[0])) == 3
+
+
+def test_recv_peek_does_not_consume():
+    """MSG_PEEK semantics: TcpConnection.peek returns in-order bytes
+    without consuming them or touching window state (recv(2) MSG_PEEK)."""
+    from tests.test_tcp_connection import World, connect
+
+    w = World()
+    connect(w)
+    w.a.write(b"peekaboo")
+    w.run(w.time + 50 * MS)
+    assert w.b.peek(4) == b"peek"
+    assert w.b.peek(100) == b"peekaboo"  # still all there
+    assert w.b.readable_bytes() == 8
+    assert w.b.read(100) == b"peekaboo"  # consuming read
+    assert w.b.peek(100) == b""

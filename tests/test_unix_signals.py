@@ -315,3 +315,4 @@ def test_expected_final_state_signaled(tmp_path):
     deterministic at sim time, no native-kill race."""
     binary = _compile(tmp_path, "self-term", SELF_SIGNALED_C)
     _run_one(tmp_path, binary, final_state="{signaled: 15}")
+

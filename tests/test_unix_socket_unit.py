@@ -1,0 +1,49 @@
+"""AF_UNIX socket unit tests that need no C compiler (the end-to-end
+managed-binary coverage lives in test_unix_signals.py).
+
+Parity: reference `descriptor/socket/unix.rs` buffer/peek semantics.
+"""
+
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.manager import Manager
+from shadow_tpu.kernel.socket.unix import make_socketpair
+
+CONFIG = """
+general: {stop_time: 1s, seed: 5}
+network:
+  graph: {type: 1_gbit_switch}
+hosts:
+  alpha: {network_node_id: 0}
+"""
+
+
+def _host():
+    return Manager(load_config_str(CONFIG)).hosts[0]
+
+
+def test_unix_peek_stream():
+    """MSG_PEEK: peeked stream bytes stay queued for the consuming read."""
+    a, b = make_socketpair(_host(), stream=True)
+    a.send(b"streamdata")
+    assert b.recv(6, peek=True) == b"stream"
+    assert b.recv(100, peek=True) == b"streamdata"
+    assert b.recv(100) == b"streamdata"
+
+
+def test_unix_peek_dgram():
+    """MSG_PEEK: a peeked datagram stays queued, with its sender."""
+    da, db = make_socketpair(_host(), stream=False)
+    da.send(b"gram")
+    data, src = db.recvfrom(100, peek=True)
+    assert data == b"gram"
+    data2, src2 = db.recvfrom(100)
+    assert data2 == b"gram" and src2 == src
+
+
+def test_unix_dgram_full_datagram_available_for_trunc():
+    """The syscall handler learns a clipped datagram's real size by taking
+    the whole datagram and clipping itself (MSG_TRUNC support)."""
+    da, db = make_socketpair(_host(), stream=False)
+    da.send(b"0123456789")
+    data, _src = db.recvfrom(1 << 20)
+    assert data == b"0123456789"  # untruncated at the socket layer
