@@ -143,6 +143,11 @@ class ExperimentalOptions:
     unblocked_vdso_latency: int = 10 * simtime.NANOSECOND
     host_heartbeat_interval: Optional[int] = simtime.SECOND
     strace_logging_mode: str = "off"  # off | standard | deterministic
+    # perf timers (reference cargo feature `perf_timers`, `host.rs:142-143,
+    # 722-730` + `handler/mod.rs:84-89`): wall-clock instrumentation of
+    # host execution and per-syscall handler time; off by default since the
+    # measured values are inherently nondeterministic
+    use_perf_timers: bool = False
     scheduler: str = "thread-per-core"  # thread-per-core | thread-per-host | serial
     use_tpu_net_plane: bool = True  # offload router/relay/latency/loss to TPU
     tpu_devices: Optional[int] = None  # None = all visible devices

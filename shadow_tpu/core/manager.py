@@ -10,7 +10,10 @@ next-event time (`controller.rs:80-113`).
 
 from __future__ import annotations
 
+import json
+import logging
 import os
+import sys
 import time as _walltime
 from dataclasses import dataclass, field
 from typing import Optional
@@ -19,12 +22,15 @@ from ..host.cpu import Cpu
 from ..host.host import Host
 from ..net import graph as netgraph
 from ..net.dns import Dns
+from . import resource_usage, simtime
 from .config import ConfigOptions, FinalState
 from .controller import Controller, Runahead
 from .rng import Xoshiro256pp, host_seed_for
 from .scheduler import make_scheduler
 from . import worker as worker_mod
 from .worker import WorkerShared
+
+log = logging.getLogger("shadow_tpu.manager")
 
 
 @dataclass
@@ -207,6 +213,17 @@ class Manager:
 
         self.stats = SimStats()
 
+        # manager heartbeat + resource watchdogs + status printer state
+        # (`manager.rs:380-388,439-453`, `controller.rs:116-168`)
+        self._heartbeat_interval = config.general.heartbeat_interval
+        self._last_heartbeat = 0
+        self._check_fd_usage = True
+        self._check_mem_usage = True
+        self._last_resource_check = 0.0
+        self._progress_enabled = config.general.progress
+        self._last_progress = 0.0
+        self._wall_start = 0.0
+
         # Per-host trackers dispatch off the packet status-trace stream —
         # only when something consumes them (heartbeats or stats output),
         # so library runs with heartbeats disabled pay nothing per packet.
@@ -337,13 +354,7 @@ class Manager:
             if proc is None:
                 failures.append((proc_name, "never spawned"))
                 continue
-            if exp.kind == FinalState.RUNNING:
-                ok = proc.state in (ProcessState.RUNNING,)
-            elif exp.kind == FinalState.EXITED:
-                ok = proc.state == ProcessState.EXITED and proc.exit_status == exp.value
-            else:  # SIGNALED
-                ok = proc.state == ProcessState.KILLED and proc.kill_signal == exp.value
-            if not ok:
+            if not self._final_state_ok(proc, exp):
                 failures.append(
                     (
                         proc_name,
@@ -362,8 +373,120 @@ class Manager:
             default=None,
         )
 
+    # -- heartbeat / watchdogs / progress (`manager.rs:675-793`) --------
+
+    def _log_heartbeat(self, now_ns: int) -> None:
+        """The tornettools-contract rusage line + a meminfo JSON line.
+        Format is contractually stable (`manager.rs:692-717`)."""
+        ru = resource_usage.rusage_self()
+        log.info(
+            "Process resource usage at simtime %d reported by getrusage(): "
+            "ru_maxrss=%.03f GiB, ru_utime=%.03f minutes, "
+            "ru_stime=%.03f minutes, ru_nvcsw=%d, ru_nivcsw=%d",
+            now_ns,
+            ru.ru_maxrss / 1048576.0,  # KiB -> GiB
+            ru.ru_utime / 60.0,
+            ru.ru_stime / 60.0,
+            ru.ru_nvcsw,
+            ru.ru_nivcsw,
+        )
+        try:
+            mem = resource_usage.meminfo()
+        except OSError as e:
+            log.warning("unable to read /proc/meminfo: %s", e)
+            return
+        log.info(
+            "System memory usage in bytes at simtime %d ns reported by "
+            "/proc/meminfo: %s",
+            now_ns,
+            json.dumps(mem),
+        )
+
+    def _check_resource_usage(self) -> None:
+        """Warn-once watchdogs: fd usage >90%%, free memory <500 MiB
+        (`manager.rs:719-751`)."""
+        if self._check_fd_usage:
+            try:
+                usage, limit = resource_usage.fd_usage()
+                if usage > limit * 90 // 100:
+                    log.warning(
+                        "Using more than 90%% (%d/%d) of available file "
+                        "descriptors", usage, limit)
+                    self._check_fd_usage = False
+            except OSError as e:
+                log.warning("Unable to check fd usage: %s", e)
+                self._check_fd_usage = False
+        if self._check_mem_usage:
+            try:
+                remaining = resource_usage.memory_remaining()
+                if remaining < 500 * 1024 * 1024:
+                    log.warning("Only %d MiB of memory available",
+                                remaining // 1024 // 1024)
+                    self._check_mem_usage = False
+            except OSError as e:
+                log.warning("Unable to check memory usage: %s", e)
+                self._check_mem_usage = False
+
+    @staticmethod
+    def _final_state_ok(proc, exp) -> bool:
+        """The expected_final_state predicate, shared by the end-of-run
+        check and the live progress counter (`worker.rs:589-604`)."""
+        from ..process.process import ProcessState
+
+        if exp.kind == FinalState.RUNNING:
+            return proc.state == ProcessState.RUNNING
+        if exp.kind == FinalState.EXITED:
+            return (proc.state == ProcessState.EXITED
+                    and proc.exit_status == exp.value)
+        return (proc.state == ProcessState.KILLED
+                and proc.kill_signal == exp.value)
+
+    def _live_failures(self) -> int:
+        """Processes already finished in a state that contradicts their
+        expected_final_state (the status bar's failed counter)."""
+        from ..process.process import ProcessState
+
+        n = 0
+        for _name, popt, cell in getattr(self, "_spawned", []):
+            proc = cell.get("proc")
+            if proc is None or proc.state == ProcessState.RUNNING:
+                continue  # still running = not failed yet
+            if not self._final_state_ok(proc, popt.expected_final_state):
+                n += 1
+        return n
+
+    def _print_progress(self, now_ns: int) -> None:
+        """`controller.rs:123-142` status line, at most once per wall
+        second, to stderr (the non-TTY "printer" flavor)."""
+        stop = max(1, self.config.general.stop_time)
+        frac = min(100, round(100 * now_ns / stop))
+        wall = _walltime.monotonic() - self._wall_start
+        print(
+            f"{frac}% — simulated: {simtime.fmt(now_ns)}/"
+            f"{simtime.fmt(stop)}, realtime: {wall:.1f}s, "
+            f"processes failed: {self._live_failures()}",
+            file=sys.stderr, flush=True,
+        )
+
+    def _round_upkeep(self, window_start: int) -> None:
+        """Per-round heartbeat/watchdog/progress pass (`manager.rs:439-453`)."""
+        if (self._heartbeat_interval
+                and window_start >= self._last_heartbeat
+                + self._heartbeat_interval):
+            self._last_heartbeat = window_start
+            self._log_heartbeat(window_start)
+        wall = _walltime.monotonic()
+        if wall - self._last_resource_check >= 30.0:
+            self._last_resource_check = wall
+            self._check_resource_usage()
+        if self._progress_enabled and wall - self._last_progress >= 1.0:
+            self._last_progress = wall
+            self._print_progress(window_start)
+
     def run(self) -> SimStats:
         wall_start = _walltime.monotonic()
+        self._wall_start = wall_start
+        self._last_resource_check = wall_start
         try:
             # round 0: boot all hosts (schedules application-start tasks)
             for host in self._host_order:
@@ -376,6 +499,7 @@ class Manager:
             window = self.controller.next_window(min_next)
             while window is not None:
                 start, end = window
+                self._round_upkeep(start)
                 if self.transport is not None:
                     # release device-held packets due in this window into
                     # host event queues before anyone executes; the device
@@ -443,8 +567,25 @@ class Manager:
                 packet_mod.status_trace_hook = None
 
     def host_stats(self) -> dict:
-        """Per-host tracker counters for sim-stats.json."""
-        return {name: t.counters.as_dict() for name, t in self.trackers.items()}
+        """Per-host tracker counters for sim-stats.json, plus perf-timer
+        readings when experimental.use_perf_timers is on."""
+        out = {name: t.counters.as_dict() for name, t in self.trackers.items()}
+        if self.config.experimental.use_perf_timers:
+            for host in self.hosts:
+                # every handler ever created on the host registers itself
+                # (incl. fork children already reaped) — see
+                # SyscallHandler.__init__'s perf_handlers registry
+                agg: dict[int, int] = {}
+                for handler in getattr(host, "perf_handlers", []):
+                    for nr, ns in handler.syscall_ns.items():
+                        agg[nr] = agg.get(nr, 0) + ns
+                entry = out.setdefault(host.name, {})
+                entry["perf"] = {
+                    "execution_ns": host.execution_ns,
+                    "syscall_ns": {str(nr): ns
+                                   for nr, ns in sorted(agg.items())},
+                }
+        return out
 
 
 def run_simulation(config: ConfigOptions) -> SimStats:

@@ -24,8 +24,8 @@ class Cpu:
         threshold_ns: Optional[int],
         precision_ns: Optional[int],
     ):
-        if precision_ns is not None:
-            assert precision_ns > 0
+        if precision_ns is not None and precision_ns < 0:
+            raise ValueError("cpu_precision must be >= 0 (0 = no rounding)")
         self._sim_freq_khz = sim_frequency_khz
         self._native_freq_khz = native_frequency_khz
         self.threshold = threshold_ns  # None = model disabled (`cpu.rs:83`)

@@ -13,6 +13,7 @@ router's CoDel queue and wake the inet-in relay; local events run their task.
 from __future__ import annotations
 
 import threading
+from time import perf_counter_ns as _perf_ns
 from typing import Callable, Optional
 
 from ..core.config import QDiscMode
@@ -66,6 +67,11 @@ class Host:
         self._packet_event_id = 0
         self._packet_priority = 0
         self.n_events_executed = 0  # summed into SimStats at teardown
+        # perf timers (`host.rs:142-143,722-730`): wall ns spent in
+        # execute(), accumulated only when the experimental knob is on
+        self._perf_enabled = bool(experimental is not None and getattr(
+            experimental, "use_perf_timers", False))
+        self.execution_ns = 0
         # virtual PID allocation base (process.FIRST_PID; not imported to
         # keep host free of process-plane dependencies)
         self._next_pid = 1000
@@ -198,6 +204,16 @@ class Host:
     # -- the inner hot loop (`host.rs:810-865`) ------------------------------
 
     def execute(self, until_ns: int) -> None:
+        if self._perf_enabled:
+            t0 = _perf_ns()
+            try:
+                self._execute(until_ns)
+            finally:
+                self.execution_ns += _perf_ns() - t0
+        else:
+            self._execute(until_ns)
+
+    def _execute(self, until_ns: int) -> None:
         while True:
             with self._queue_lock:
                 nxt = self.event_queue.next_time()
@@ -206,7 +222,6 @@ class Host:
                 event = self.event_queue.pop()
 
             self._now = event.time
-            self.n_events_executed += 1
             if self._worker is not None:
                 self._worker.current_time = event.time
 
@@ -234,6 +249,9 @@ class Host:
                             )
                     continue
 
+            # counted here, after the deferral check, so a CPU-deferred
+            # event is not tallied twice
+            self.n_events_executed += 1
             if event.is_packet:
                 self.router.route_incoming_packet(event.payload)
                 self.notify_router_has_packets()

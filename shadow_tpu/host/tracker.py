@@ -9,6 +9,7 @@ packet status-trace stream, the same instrumentation point the reference's
 
 from __future__ import annotations
 
+import json
 import logging
 from dataclasses import dataclass, field
 
@@ -78,9 +79,11 @@ class Tracker:
             c.retransmitted += 1
 
     def _heartbeat(self, host) -> None:
+        # JSON payload so parse_shadow.py can consume the line directly
         log.info(
             "heartbeat host=%s time_ns=%d %s",
-            self.host.name, self.host.now(), self.counters.as_dict(),
+            self.host.name, self.host.now(),
+            json.dumps(self.counters.as_dict()),
         )
         if self._interval:
             self.host.schedule_task_with_delay(

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import ctypes
 import struct
+from time import perf_counter_ns as _perf_ns
 from typing import Optional
 
 from ..core import simtime
@@ -242,6 +243,19 @@ class SyscallHandler:
         # per-syscall dispatch tally for sim-stats (first dispatches only;
         # condition-wakeup re-dispatches of the same call don't re-count)
         self.syscall_counts: dict[int, int] = {}
+        # perf timers (`handler/mod.rs:84-89`): wall ns per syscall number,
+        # only accumulated when experimental.use_perf_timers is on
+        self._perf_enabled = bool(getattr(
+            self.host.config_experimental, "use_perf_timers", False))
+        self.syscall_ns: dict[int, int] = {}
+        if self._perf_enabled:
+            # host-level registry so aggregation sees every handler ever
+            # created — including fork()ed children that exit (and are
+            # unlinked from their parent) before stats are collected
+            handlers = getattr(self.host, "perf_handlers", None)
+            if handlers is None:
+                handlers = self.host.perf_handlers = []
+            handlers.append(self)
 
     # -- descriptor plumbing -------------------------------------------
 
@@ -369,6 +383,13 @@ class SyscallHandler:
         handler = self._HANDLERS.get(nr)
         if handler is None:
             raise NativeSyscall()
+        if self._perf_enabled:
+            t0 = _perf_ns()
+            try:
+                return handler(self, args, ctx)
+            finally:
+                self.syscall_ns[nr] = (self.syscall_ns.get(nr, 0)
+                                       + _perf_ns() - t0)
         return handler(self, args, ctx)
 
     # -- socket family -------------------------------------------------
@@ -725,6 +746,11 @@ class SyscallHandler:
             else:
                 data = sock.recv(total, peek=bool(flags_ & MSG_PEEK))
                 src = sock.getpeername()
+                if flags_ & MSG_TRUNC:
+                    # stream MSG_TRUNC = read-and-discard, same as the
+                    # recvfrom path (Linux tcp_recvmsg serves both)
+                    ret = len(data)
+                    data = b""
         finally:
             sock.nonblocking = saved
         self._scatter(iovs, data)

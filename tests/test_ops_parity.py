@@ -1,0 +1,209 @@
+"""Ops-parity subsystems: manager rusage heartbeat (tornettools contract),
+resource watchdogs, status printer, perf timers, and the parse/plot tools.
+
+Parity: reference `manager.rs:675-793` (heartbeat + watchdogs),
+`controller.rs:116-168` (status), `host.rs:722-730` + `handler/mod.rs:84-89`
+(perf timers), `src/tools/parse-shadow.py` / `plot-shadow.py`.
+"""
+
+import json
+import logging
+import sys
+
+import pytest
+
+sys.path.insert(0, ".")
+
+from shadow_tpu.core import resource_usage, simtime
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.event import TaskRef
+from shadow_tpu.core.manager import Manager
+from tools.parse_shadow import HEARTBEAT_RE, MEMINFO_RE, RUSAGE_RE, \
+    parse_stream
+
+MS = simtime.MILLISECOND
+
+BASE = """
+general: {{stop_time: 5s, seed: 7, heartbeat_interval: {hb}}}
+network:
+  graph: {{type: 1_gbit_switch}}
+hosts:
+  alpha: {{network_node_id: 0}}
+  beta: {{network_node_id: 0}}
+"""
+
+
+def _busy_config(extra=""):
+    # a PHOLD-ish pair so rounds actually advance across 5s of sim time
+    # (bare ints in time fields are seconds, so spell the unit out)
+    return load_config_str(BASE.format(hb="1s") + extra)
+
+
+def _add_ticker(mgr):
+    """Keep the event loop busy so windows progress through sim time."""
+    def tick(host):
+        host.schedule_task_with_delay(TaskRef(tick, "tick"), 100 * MS)
+    for host in mgr.hosts:
+        host.add_application(0, lambda h: tick(h))
+
+
+# ---------------------------------------------------------------------------
+# resource probes
+# ---------------------------------------------------------------------------
+
+
+def test_meminfo_parses_to_bytes():
+    info = resource_usage.meminfo()
+    assert info["MemTotal"] > 1 << 20  # bytes, not KiB
+    assert "MemAvailable" in info
+
+
+def test_fd_usage_sane():
+    usage, limit = resource_usage.fd_usage()
+    assert 0 < usage < limit
+
+
+def test_memory_remaining_positive():
+    assert resource_usage.memory_remaining() > 0
+
+
+# ---------------------------------------------------------------------------
+# manager heartbeat + watchdogs + progress
+# ---------------------------------------------------------------------------
+
+
+def test_rusage_heartbeat_matches_tornettools_contract(caplog):
+    mgr = Manager(_busy_config())
+    _add_ticker(mgr)
+    with caplog.at_level(logging.INFO, logger="shadow_tpu.manager"):
+        mgr.run()
+    rusage_lines = [r.getMessage() for r in caplog.records
+                    if "getrusage" in r.getMessage()]
+    assert len(rusage_lines) >= 4  # ~1 per simulated second
+    m = RUSAGE_RE.search(rusage_lines[0])
+    assert m, rusage_lines[0]
+    meminfo_lines = [r.getMessage() for r in caplog.records
+                     if "/proc/meminfo" in r.getMessage()]
+    assert meminfo_lines
+    m2 = MEMINFO_RE.search(meminfo_lines[0])
+    assert m2
+    assert json.loads(m2.group(2))["MemTotal"] > 0
+
+
+def test_progress_printer_emits_status_lines(capsys):
+    cfg = load_config_str(BASE.format(hb="null"))
+    cfg.general.progress = True
+    mgr = Manager(cfg)
+    _add_ticker(mgr)
+    mgr._last_progress = -10.0  # force at least one line immediately
+    mgr.run()
+    err = capsys.readouterr().err
+    assert "simulated:" in err and "processes failed: 0" in err
+
+
+def test_watchdogs_warn_once(caplog, monkeypatch):
+    mgr = Manager(_busy_config())
+    monkeypatch.setattr(resource_usage, "fd_usage", lambda: (95, 100))
+    monkeypatch.setattr(resource_usage, "memory_remaining",
+                        lambda: 100 * 1024 * 1024)
+    with caplog.at_level(logging.WARNING, logger="shadow_tpu.manager"):
+        mgr._check_resource_usage()
+        mgr._check_resource_usage()  # second pass must not re-warn
+    fd_warns = [r for r in caplog.records if "file descriptors" in
+                r.getMessage()]
+    mem_warns = [r for r in caplog.records if "MiB of memory" in
+                 r.getMessage()]
+    assert len(fd_warns) == 1 and len(mem_warns) == 1
+
+
+# ---------------------------------------------------------------------------
+# perf timers
+# ---------------------------------------------------------------------------
+
+
+def test_perf_timers_accumulate_and_surface():
+    mgr = Manager(_busy_config("experimental: {use_perf_timers: true}\n"))
+    _add_ticker(mgr)
+    mgr.run()
+    assert all(h.execution_ns > 0 for h in mgr.hosts)
+    stats = mgr.host_stats()
+    assert stats["alpha"]["perf"]["execution_ns"] > 0
+
+
+def test_perf_timers_off_by_default():
+    mgr = Manager(_busy_config())
+    _add_ticker(mgr)
+    mgr.run()
+    assert all(h.execution_ns == 0 for h in mgr.hosts)
+    assert "perf" not in mgr.host_stats().get("alpha", {})
+
+
+# ---------------------------------------------------------------------------
+# parse + plot tools
+# ---------------------------------------------------------------------------
+
+
+def test_parse_stream_extracts_all_series(caplog):
+    mgr = Manager(_busy_config())
+    _add_ticker(mgr)
+    with caplog.at_level(logging.INFO):
+        mgr.run()
+    log_text = "\n".join(r.getMessage() for r in caplog.records)
+    stats = parse_stream(log_text.splitlines())
+    assert set(stats["nodes"]) == {"alpha", "beta"}
+    alpha = stats["nodes"]["alpha"]
+    assert len(alpha["time_ns"]) >= 4  # per-second tracker heartbeats
+    assert "packets_out" in alpha["counters"][0]
+    assert len(stats["rusage"]) >= 4
+    assert stats["meminfo"] and stats["meminfo"][0]["MemTotal"] > 0
+
+
+def test_heartbeat_line_is_json_parseable(caplog):
+    mgr = Manager(_busy_config())
+    _add_ticker(mgr)
+    with caplog.at_level(logging.INFO, logger="shadow_tpu.tracker"):
+        mgr.run()
+    hb = [r.getMessage() for r in caplog.records
+          if r.getMessage().startswith("heartbeat ")]
+    assert hb
+    m = HEARTBEAT_RE.search(hb[0])
+    assert m
+    assert "packets_in" in json.loads(m.group(3))
+
+
+def test_plot_tool_writes_figures(tmp_path, caplog):
+    pytest.importorskip("matplotlib")
+    from tools import plot_shadow
+
+    mgr = Manager(_busy_config())
+    _add_ticker(mgr)
+    with caplog.at_level(logging.INFO):
+        mgr.run()
+    stats = parse_stream(
+        "\n".join(r.getMessage() for r in caplog.records).splitlines())
+    data = tmp_path / "stats.shadow.json"
+    data.write_text(json.dumps(stats))
+    prefix = str(tmp_path / "plots")
+    rc = plot_shadow.main(["-d", str(data), "run1", "-p", prefix,
+                           "--format", "png"])
+    assert rc == 0
+    assert (tmp_path / "plots.bytes_out.png").exists()
+
+
+def test_strip_log_for_compare_removes_wall_lines():
+    from tools.strip_log_for_compare import strip
+
+    lines = [
+        "00:01 [INFO] [-] m: Process resource usage at simtime 5 "
+        "reported by getrusage(): ru_maxrss=0.1 GiB\n",
+        "00:01 [INFO] [-] m: System memory usage in bytes at simtime 5 ns "
+        "reported by /proc/meminfo: {}\n",
+        "2026-07-30 12:00:00,123 00:01 [INFO] [alpha] t: heartbeat "
+        "host=alpha time_ns=5 {}\n",
+        "00:01 [INFO] [alpha] x: simulated content\n",
+    ]
+    out = list(strip(lines))
+    assert out == [
+        "00:01 [INFO] [alpha] t: heartbeat host=alpha time_ns=5 {}\n",
+        "00:01 [INFO] [alpha] x: simulated content\n",
+    ]

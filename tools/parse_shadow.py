@@ -1,0 +1,112 @@
+#!/usr/bin/env python3
+"""Parse a shadow_tpu simulation log into plottable JSON.
+
+Parity: reference `src/tools/parse-shadow.py` — extracts per-host tracker
+heartbeats and manager rusage/meminfo heartbeats from the log stream and
+writes `stats.shadow.json`, without ever materialising a decompressed log
+on disk (xz input and stdin are supported).
+
+Usage:
+  python tools/parse_shadow.py shadow.log          # or shadow.log.xz
+  cat shadow.log | python tools/parse_shadow.py -
+  python tools/parse_shadow.py shadow.log -p outdir
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+OUTPUT_NAME = "stats.shadow.json"
+
+# tracker heartbeats: "... heartbeat host=alpha time_ns=1000000000 {json}"
+HEARTBEAT_RE = re.compile(
+    r"heartbeat host=(\S+) time_ns=(\d+) (\{.*\})\s*$")
+# the tornettools-contract rusage line (`manager.rs:692-707`)
+RUSAGE_RE = re.compile(
+    r"Process resource usage at simtime (\d+) reported by getrusage\(\): "
+    r"ru_maxrss=([\d.]+) GiB, ru_utime=([\d.]+) minutes, "
+    r"ru_stime=([\d.]+) minutes, ru_nvcsw=(\d+), ru_nivcsw=(\d+)")
+MEMINFO_RE = re.compile(
+    r"System memory usage in bytes at simtime (\d+) ns reported by "
+    r"/proc/meminfo: (\{.*\})\s*$")
+
+
+def open_log(path: str):
+    if path == "-":
+        return sys.stdin
+    if path.endswith(".xz"):
+        import lzma
+
+        return lzma.open(path, "rt")
+    return open(path)
+
+
+def parse_stream(stream) -> dict:
+    nodes: dict[str, dict] = {}
+    rusage: list[dict] = []
+    meminfo: list[dict] = []
+    for line in stream:
+        m = HEARTBEAT_RE.search(line)
+        if m:
+            host, time_ns, payload = m.group(1), int(m.group(2)), m.group(3)
+            try:
+                counters = json.loads(payload)
+            except json.JSONDecodeError:
+                continue
+            entry = nodes.setdefault(host, {"time_ns": [], "counters": []})
+            entry["time_ns"].append(time_ns)
+            entry["counters"].append(counters)
+            continue
+        m = RUSAGE_RE.search(line)
+        if m:
+            rusage.append({
+                "time_ns": int(m.group(1)),
+                "maxrss_gib": float(m.group(2)),
+                "utime_minutes": float(m.group(3)),
+                "stime_minutes": float(m.group(4)),
+                "nvcsw": int(m.group(5)),
+                "nivcsw": int(m.group(6)),
+            })
+            continue
+        m = MEMINFO_RE.search(line)
+        if m:
+            try:
+                fields = json.loads(m.group(2))
+            except json.JSONDecodeError:
+                continue
+            meminfo.append({"time_ns": int(m.group(1)), **fields})
+    return {"nodes": nodes, "rusage": rusage, "meminfo": meminfo}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logpath", metavar="PATH",
+                    help="shadow log file, '.xz' for compressed, '-' for stdin")
+    ap.add_argument("-p", "--prefix", default=".",
+                    help="output directory for " + OUTPUT_NAME)
+    args = ap.parse_args(argv)
+
+    stream = open_log(args.logpath)
+    try:
+        stats = parse_stream(stream)
+    finally:
+        if stream is not sys.stdin:
+            stream.close()
+
+    os.makedirs(args.prefix, exist_ok=True)
+    out_path = os.path.join(args.prefix, OUTPUT_NAME)
+    with open(out_path, "w") as fh:
+        json.dump(stats, fh, indent=2)
+    n_hosts = len(stats["nodes"])
+    n_ticks = sum(len(v["time_ns"]) for v in stats["nodes"].values())
+    print(f"wrote {out_path}: {n_hosts} hosts, {n_ticks} heartbeats, "
+          f"{len(stats['rusage'])} rusage samples")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""Plot stats.shadow.json files produced by parse_shadow.py.
+
+Parity: reference `src/tools/plot-shadow.py` — per-host throughput over
+simulated time and simulator rusage over time, one page per metric,
+multiple datasets overlaid for comparisons.
+
+Usage:
+  python tools/plot_shadow.py -d run1/stats.shadow.json run1 \
+                              -d run2/stats.shadow.json run2 \
+                              -p comparison
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _throughput_series(node: dict, key: str):
+    """Per-interval deltas of a cumulative counter, in bytes/sec."""
+    times, values = node["time_ns"], node["counters"]
+    xs, ys = [], []
+    prev_t, prev_v = None, None
+    for t, c in zip(times, values):
+        v = c.get(key, 0)
+        if prev_t is not None and t > prev_t:
+            xs.append(t / 1e9)
+            ys.append((v - prev_v) / ((t - prev_t) / 1e9))
+        prev_t, prev_v = t, v
+    return xs, ys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-d", "--data", nargs=2, action="append", required=True,
+                    metavar=("PATH", "LABEL"),
+                    help="stats.shadow.json and a label; repeatable")
+    ap.add_argument("-p", "--prefix", default="shadow.plot",
+                    help="output file prefix")
+    ap.add_argument("--format", default="pdf", choices=("pdf", "png"))
+    args = ap.parse_args(argv)
+
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        print("matplotlib is not available; install it to plot",
+              file=sys.stderr)
+        return 1
+
+    datasets = []
+    for path, label in args.data:
+        with open(path) as fh:
+            datasets.append((label, json.load(fh)))
+
+    pages = [
+        ("bytes_out", "sent bytes/s"),
+        ("bytes_in", "received bytes/s"),
+        ("packets_dropped", "cumulative dropped packets"),
+    ]
+    for key, title in pages:
+        fig, ax = plt.subplots(figsize=(8, 5))
+        for label, stats in datasets:
+            for host, node in sorted(stats["nodes"].items()):
+                if key.startswith("bytes"):
+                    xs, ys = _throughput_series(node, key)
+                else:
+                    xs = [t / 1e9 for t in node["time_ns"]]
+                    ys = [c.get(key, 0) for c in node["counters"]]
+                ax.plot(xs, ys, label=f"{label}:{host}", alpha=0.8)
+        ax.set_xlabel("simulated seconds")
+        ax.set_ylabel(title)
+        ax.set_title(title)
+        if sum(len(s["nodes"]) for _l, s in datasets) <= 12:
+            ax.legend(fontsize=7)
+        out = f"{args.prefix}.{key}.{args.format}"
+        fig.savefig(out, bbox_inches="tight")
+        plt.close(fig)
+        print("wrote", out)
+
+    # simulator resource usage over simulated time
+    fig, ax = plt.subplots(figsize=(8, 5))
+    plotted = False
+    for label, stats in datasets:
+        ru = stats.get("rusage", [])
+        if not ru:
+            continue
+        ax.plot([r["time_ns"] / 1e9 for r in ru],
+                [r["maxrss_gib"] for r in ru], label=label)
+        plotted = True
+    if plotted:
+        ax.set_xlabel("simulated seconds")
+        ax.set_ylabel("ru_maxrss (GiB)")
+        ax.set_title("simulator memory usage")
+        ax.legend(fontsize=8)
+        out = f"{args.prefix}.rusage.{args.format}"
+        fig.savefig(out, bbox_inches="tight")
+        print("wrote", out)
+    plt.close(fig)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,51 @@
+#!/usr/bin/env python3
+"""Strip wall-clock-dependent lines from a shadow_tpu log so two runs of
+the same config can be diffed byte-for-byte.
+
+Parity: reference `src/tools/strip_log_for_compare.py`, used by the
+determinism CMake harness before diffing. Removed content: the manager's
+getrusage/meminfo heartbeats (real resource readings), wall-seconds
+summaries, and any leading wall-clock timestamp the non-deterministic log
+format prepends.
+
+Usage:  python tools/strip_log_for_compare.py shadow.log > stripped.log
+        diff <(... run1) <(... run2)
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+# lines whose content is real-time, not simulated-time
+DROP = (
+    "reported by getrusage()",
+    "reported by /proc/meminfo",
+    "simulation finished:",  # carries "%.2fs wall"
+    "Unable to check",  # watchdog probe errors are environment-dependent
+)
+# WALL_FORMAT prepends "YYYY-MM-DD HH:MM:SS,mmm " before the sim timestamp
+ASCTIME_RE = re.compile(r"^\d{4}-\d{2}-\d{2} \d{2}:\d{2}:\d{2},\d{3} ")
+
+
+def strip(lines):
+    for line in lines:
+        if any(marker in line for marker in DROP):
+            continue
+        yield ASCTIME_RE.sub("", line)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    stream = open(argv[0]) if argv else sys.stdin
+    try:
+        for line in strip(stream):
+            sys.stdout.write(line)
+    finally:
+        if stream is not sys.stdin:
+            stream.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
