@@ -575,7 +575,10 @@ class Manager:
                 # every handler ever created on the host registers itself
                 # (incl. fork children already reaped) — see
                 # SyscallHandler.__init__'s perf_handlers registry
-                agg: dict[int, int] = {}
+                # closed handlers folded their durations into the host
+                # aggregate; live ones still hold their own dicts
+                agg: dict[int, int] = dict(
+                    getattr(host, "perf_syscall_ns", {}))
                 for handler in getattr(host, "perf_handlers", []):
                     for nr, ns in handler.syscall_ns.items():
                         agg[nr] = agg.get(nr, 0) + ns

@@ -71,9 +71,13 @@ class Blocked(Exception):
         *,
         timeout_ns: Optional[int] = None,
         restartable: bool = True,
+        forever: bool = False,
     ):
         self.file = file
         self.state_mask = state_mask
         self.timeout_ns = timeout_ns
         self.restartable = restartable
+        # opt-in signal-only park (pause/sigsuspend): no file and no
+        # timeout trigger; only signal delivery (or teardown) unparks
+        self.forever = forever
         super().__init__(f"blocked on {state_mask!r}")

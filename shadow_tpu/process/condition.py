@@ -32,12 +32,14 @@ class SysCallCondition:
         state_mask: FileState = FileState.NONE,
         timeout_at_ns: Optional[int] = None,
         wakeup: Callable[[str], None],
+        allow_forever: bool = False,
     ):
         self._host = host
         self._file = file
         self._state_mask = state_mask
         self._timeout_at = timeout_at_ns
         self._wakeup = wakeup
+        self._allow_forever = allow_forever
         self._fired = False
         self._listener_handle: Optional[int] = None
 
@@ -60,7 +62,8 @@ class SysCallCondition:
                 TaskRef(lambda h: self._fire("timeout"), "condition-timeout"),
                 delay,
             )
-        if not (self._file is not None and self._state_mask) and self._timeout_at is None:
+        if not (self._file is not None and self._state_mask) \
+                and self._timeout_at is None and not self._allow_forever:
             raise ValueError("condition with no trigger would park forever")
 
     def cancel(self) -> None:

@@ -307,7 +307,8 @@ class ManagedThread:
     """
 
     __slots__ = ("process", "ipc", "native_tid", "parked_condition",
-                 "park_deadline", "park_call", "futex_waiter", "wait_epoll",
+                 "park_deadline", "park_call", "park_restartable",
+                 "futex_waiter", "wait_epoll",
                  "ctid_addr", "dead", "is_main", "tindex")
 
     def __init__(self, process, ipc, is_main: bool = False):
@@ -317,6 +318,7 @@ class ManagedThread:
         self.parked_condition = None
         self.park_deadline: Optional[int] = None
         self.park_call = None  # (nr, args) of the blocked syscall
+        self.park_restartable = True  # SA_RESTART eligibility of the park
         self.futex_waiter = None
         self.wait_epoll = None
         self.ctid_addr = 0
@@ -608,7 +610,8 @@ class ManagedSimProcess:
             cond.cancel()
             self.handler._drop_wait_epoll(t)
             nr, pargs = t.park_call or (0, ())
-            if sa_restart and nr in self._RESTARTABLE:
+            if sa_restart and nr in self._RESTARTABLE \
+                    and getattr(t, "park_restartable", True):
                 # restart as if freshly issued (usually re-parks)
                 if not self._handle_syscall_event(t, nr, list(pargs)):
                     self._resume(t)
@@ -964,6 +967,10 @@ class ManagedSimProcess:
             timeout_at = self.host.now() + blocked.timeout_ns
         thread.park_deadline = timeout_at
         thread.park_call = (nr, tuple(args))
+        # SA_RESTART eligibility of THIS park (e.g. pause() and a
+        # connect() past its first block are never restartable even when
+        # the interrupting handler sets SA_RESTART)
+        thread.park_restartable = blocked.restartable
 
         def wakeup(reason, thread=thread, nr=nr, args=tuple(args)):
             self._unpark(thread, nr, list(args), reason)
@@ -974,6 +981,7 @@ class ManagedSimProcess:
             state_mask=blocked.state_mask,
             timeout_at_ns=timeout_at,
             wakeup=wakeup,
+            allow_forever=blocked.forever,
         )
         thread.parked_condition = cond
         cond.arm()

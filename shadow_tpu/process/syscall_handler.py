@@ -71,6 +71,13 @@ SYS_set_tid_address = 218
 SYS_tgkill = 234
 SYS_waitid = 247
 SYS_set_robust_list = 273
+SYS_pause = 34
+SYS_getitimer = 36
+SYS_alarm = 37
+SYS_setitimer = 38
+SYS_times = 100
+SYS_sched_setaffinity = 203
+SYS_clock_getres = 229
 SYS_timerfd_create = 283
 SYS_eventfd = 284
 SYS_timerfd_settime = 286
@@ -156,6 +163,9 @@ FIONBIO = 0x5421
 
 SHUT_RD, SHUT_WR, SHUT_RDWR = 0, 1, 2
 
+ITIMER_REAL = 0
+SIGALRM = 14
+
 O_CLOEXEC = 0o2000000
 EFD_SEMAPHORE = 1
 TFD_TIMER_ABSTIME = 1
@@ -240,6 +250,11 @@ class SyscallHandler:
         # sa_restart) with kind in {'default','ignore','handler'}
         # (`process.rs:1309` signal virtualization)
         self.sig_actions: dict[int, tuple[str, bool]] = {}
+        # ITIMER_REAL state (`handler/time.rs`): per-process, generation-
+        # guarded so disarm/rearm invalidates in-flight expiry tasks
+        self._itimer_deadline: Optional[int] = None
+        self._itimer_interval = 0
+        self._itimer_gen = 0
         # per-syscall dispatch tally for sim-stats (first dispatches only;
         # condition-wakeup re-dispatches of the same call don't re-count)
         self.syscall_counts: dict[int, int] = {}
@@ -290,6 +305,20 @@ class SyscallHandler:
     def close_all(self) -> None:
         self._table.close_all()
         self._drop_wait_epoll()
+        self._itimer_disarm()  # a dead process's timer must not re-arm
+        if self._perf_enabled:
+            # fold our durations into the host aggregate and drop the
+            # registry reference so reaped fork children don't pin their
+            # whole object graph until teardown
+            agg = getattr(self.host, "perf_syscall_ns", None)
+            if agg is None:
+                agg = self.host.perf_syscall_ns = {}
+            for nr, ns in self.syscall_ns.items():
+                agg[nr] = agg.get(nr, 0) + ns
+            self.syscall_ns = {}
+            handlers = getattr(self.host, "perf_handlers", None)
+            if handlers is not None and self in handlers:
+                handlers.remove(self)
 
     def _drop_wait_epoll(self, thread=None) -> None:
         if thread is not None and getattr(thread, "wait_epoll", None) is not None:
@@ -1240,6 +1269,132 @@ class SyscallHandler:
         self._write_itimerspec(args[1], interval, rem)
         return 0
 
+    # -- itimers / alarm (`handler/time.rs:31-100`: ITIMER_REAL only,
+    # SIGALRM in simulated time; per-process, not inherited on fork) -----
+
+    def _itimer_arm(self, deadline_ns: int, interval_ns: int) -> None:
+        from ..core.event import TaskRef
+
+        self._itimer_gen += 1
+        gen = self._itimer_gen
+        self._itimer_deadline = deadline_ns
+        self._itimer_interval = interval_ns
+        self.host.schedule_task_at(
+            TaskRef(lambda h: self._itimer_fire(gen), "itimer-real"),
+            deadline_ns)
+
+    def _itimer_fire(self, gen: int) -> None:
+        if gen != self._itimer_gen:
+            return  # disarmed or re-armed since
+        from .process import ProcessState
+
+        if self.process.state != ProcessState.RUNNING:
+            # process gone: drop the timer instead of re-arming forever
+            self._itimer_disarm()
+            return
+        if self._itimer_interval > 0:
+            self._itimer_arm(self.host.now() + self._itimer_interval,
+                             self._itimer_interval)
+        else:
+            self._itimer_deadline = None
+        self.process.deliver_signal(SIGALRM)
+
+    def _itimer_disarm(self) -> tuple[int, int]:
+        """Returns (remaining_ns, interval_ns) of the old timer."""
+        rem = 0
+        if self._itimer_deadline is not None:
+            rem = max(0, self._itimer_deadline - self.host.now())
+        old_interval = self._itimer_interval
+        self._itimer_gen += 1
+        self._itimer_deadline = None
+        self._itimer_interval = 0
+        return rem, old_interval
+
+    def _read_itimerval(self, addr: int) -> tuple[int, int]:
+        """(interval_ns, value_ns) from struct itimerval (timevals)."""
+        isec, iusec, vsec, vusec = struct.unpack(
+            "<qqqq", self.mem.read(addr, 32))
+        if min(isec, iusec, vsec, vusec) < 0 or max(iusec, vusec) >= 10**6:
+            raise errors.SyscallError(errors.EINVAL)
+        return (isec * simtime.SECOND + iusec * 1000,
+                vsec * simtime.SECOND + vusec * 1000)
+
+    def _write_itimerval(self, addr: int, interval_ns: int,
+                         value_ns: int) -> None:
+        self.mem.write(addr, struct.pack(
+            "<qqqq",
+            interval_ns // simtime.SECOND,
+            (interval_ns % simtime.SECOND) // 1000,
+            value_ns // simtime.SECOND,
+            (value_ns % simtime.SECOND) // 1000))
+
+    def _itimer_current(self) -> tuple[int, int]:
+        rem = 0
+        if self._itimer_deadline is not None:
+            rem = max(0, self._itimer_deadline - self.host.now())
+        return self._itimer_interval, rem
+
+    def _sys_pause(self, args, ctx) -> int:
+        """pause(2): park until a signal delivery unparks us; the EINTR
+        completion after the handler runs IS the contract (never
+        restartable, `signal(7)`)."""
+        raise errors.Blocked(None, FileState.NONE, restartable=False,
+                             forever=True)
+
+    def _sys_getitimer(self, args, ctx) -> int:
+        if _i32(args[0]) != ITIMER_REAL:
+            raise errors.SyscallError(errors.EINVAL)
+        interval, rem = self._itimer_current()
+        self._write_itimerval(args[1], interval, rem)
+        return 0
+
+    def _sys_setitimer(self, args, ctx) -> int:
+        if _i32(args[0]) != ITIMER_REAL:
+            raise errors.SyscallError(errors.EINVAL)
+        old_interval, old_rem = self._itimer_current()
+        interval_ns, value_ns = self._read_itimerval(args[1])
+        if args[2]:
+            self._write_itimerval(args[2], old_interval, old_rem)
+        if value_ns == 0:
+            self._itimer_disarm()
+        else:
+            self._itimer_arm(self.host.now() + value_ns, interval_ns)
+        return 0
+
+    def _sys_alarm(self, args, ctx) -> int:
+        """alarm(2): seconds-granular ITIMER_REAL; returns whole seconds
+        remaining of the previous alarm (rounded up, like Linux)."""
+        seconds = args[0] & 0xFFFFFFFF
+        old_rem, _old_int = self._itimer_disarm()
+        if seconds:
+            self._itimer_arm(self.host.now() + seconds * simtime.SECOND, 0)
+        return -(-old_rem // simtime.SECOND)  # ceil to seconds
+
+    def _sys_times(self, args, ctx) -> int:
+        """times(2): returns elapsed sim time in clock ticks (100/s);
+        the tms CPU-time fields mirror the simulated-CPU charge."""
+        ticks = self.host.now() * 100 // simtime.SECOND
+        cpu_ticks = 0
+        if self.host.cpu is not None:
+            cpu_ticks = (self.host.cpu._time_cursor * 100) // simtime.SECOND
+        if args[0]:
+            self.mem.write(args[0], struct.pack(
+                "<qqqq", cpu_ticks, 0, 0, 0))
+        return ticks
+
+    def _sys_clock_getres(self, args, ctx) -> int:
+        clock_id = _i32(args[0])
+        if clock_id < 0 or clock_id > 11:
+            raise errors.SyscallError(errors.EINVAL)
+        if args[1]:
+            self.mem.write(args[1], struct.pack("<qq", 0, 1))  # 1 ns
+        return 0
+
+    def _sys_sched_setaffinity(self, args, ctx) -> int:
+        # accepted and ignored: managed threads are pinned by the
+        # scheduler, not the app (`sched.rs` does the same)
+        return 0
+
     # -- futex (`futex.c`, `handler/futex.rs`) ---------------------------
 
     def _sys_futex(self, args, ctx) -> int:
@@ -1501,6 +1656,13 @@ class SyscallHandler:
         SYS_timerfd_create: _sys_timerfd_create,
         SYS_timerfd_settime: _sys_timerfd_settime,
         SYS_timerfd_gettime: _sys_timerfd_gettime,
+        SYS_pause: _sys_pause,
+        SYS_getitimer: _sys_getitimer,
+        SYS_alarm: _sys_alarm,
+        SYS_setitimer: _sys_setitimer,
+        SYS_times: _sys_times,
+        SYS_clock_getres: _sys_clock_getres,
+        SYS_sched_setaffinity: _sys_sched_setaffinity,
         SYS_futex: _sys_futex,
         SYS_wait4: _sys_wait4,
         SYS_waitid: _sys_waitid,

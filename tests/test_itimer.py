@@ -1,0 +1,162 @@
+"""ITIMER_REAL / alarm(2) in simulated time for managed binaries.
+
+Parity: reference `handler/time.rs:31-100` (ITIMER_REAL only, SIGALRM on
+expiry, remaining-time reporting) + `src/test/signal`-style alarm tests.
+"""
+
+import shutil
+import subprocess
+
+import pytest
+
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.manager import Manager
+
+CC = shutil.which("gcc") or shutil.which("cc")
+
+pytestmark = pytest.mark.skipif(CC is None, reason="no C compiler")
+
+
+def _compile(tmp_path, name, src):
+    c = tmp_path / f"{name}.c"
+    c.write_text(src)
+    binary = tmp_path / name
+    subprocess.run([CC, "-O1", "-o", str(binary), str(c)], check=True)
+    return str(binary)
+
+
+def _run(binary, args=(), stop="30s"):
+    arglist = ", ".join(f'"{a}"' for a in args)
+    cfg = load_config_str(f"""
+general: {{stop_time: {stop}, seed: 3}}
+network:
+  graph: {{type: 1_gbit_switch}}
+hosts:
+  alpha:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, args: [{arglist}], start_time: 1s,
+       expected_final_state: {{exited: 0}}}}
+""")
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
+
+
+ALARM_C = r"""
+#include <signal.h>
+#include <time.h>
+#include <unistd.h>
+
+static volatile sig_atomic_t fired;
+static void on_alarm(int sig) { (void)sig; fired = 1; }
+
+static long long now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+int main(void) {
+    struct sigaction sa = {0};
+    sa.sa_handler = on_alarm;
+    if (sigaction(SIGALRM, &sa, 0)) return 60;
+    long long t0 = now_ns();
+    alarm(2);
+    /* a second alarm() must report the remaining seconds of the first */
+    unsigned prev = alarm(5);
+    if (prev == 0 || prev > 2) return 61;
+    /* pause until SIGALRM: in simulated time this is exactly 5s away */
+    while (!fired) pause();
+    long long dt = now_ns() - t0;
+    if (dt < 4900000000LL) return 62;  /* fired too early */
+    if (dt > 20000000000LL) return 63; /* or virtual time ran away */
+    return 0;
+}
+"""
+
+
+SETITIMER_C = r"""
+#include <signal.h>
+#include <string.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+static volatile sig_atomic_t ticks;
+static void on_alarm(int sig) { (void)sig; ticks++; }
+
+int main(void) {
+    struct sigaction sa = {0};
+    sa.sa_handler = on_alarm;
+    if (sigaction(SIGALRM, &sa, 0)) return 70;
+    struct itimerval it;
+    memset(&it, 0, sizeof it);
+    it.it_value.tv_usec = 250000;    /* first fire at 250ms */
+    it.it_interval.tv_usec = 250000; /* then every 250ms */
+    if (setitimer(ITIMER_REAL, &it, 0)) return 71;
+    /* getitimer must see a pending value <= 250ms */
+    struct itimerval cur;
+    if (getitimer(ITIMER_REAL, &cur)) return 72;
+    if (cur.it_value.tv_sec != 0 || cur.it_value.tv_usec > 250000) return 73;
+    if (cur.it_interval.tv_usec != 250000) return 74;
+    while (ticks < 4) pause();
+    /* disarm and confirm */
+    memset(&it, 0, sizeof it);
+    if (setitimer(ITIMER_REAL, &it, 0)) return 75;
+    if (getitimer(ITIMER_REAL, &cur)) return 76;
+    if (cur.it_value.tv_sec || cur.it_value.tv_usec) return 77;
+    return 0;
+}
+"""
+
+
+TIMES_C = r"""
+#include <sys/times.h>
+#include <unistd.h>
+
+int main(void) {
+    struct tms t;
+    clock_t a = times(&t);
+    if (a == (clock_t)-1) return 80;
+    sleep(2); /* 2 simulated seconds */
+    clock_t b = times(&t);
+    long dt = (long)(b - a);
+    /* 2 sim seconds at 100 ticks/s, allowing syscall-latency slack */
+    if (dt < 195 || dt > 400) return 81;
+    return 0;
+}
+"""
+
+
+def test_alarm_interrupts_pause_in_sim_time(tmp_path):
+    _run(_compile(tmp_path, "talarm", ALARM_C))
+
+
+def test_setitimer_interval_ticks(tmp_path):
+    _run(_compile(tmp_path, "titimer", SETITIMER_C))
+
+
+def test_times_returns_sim_ticks(tmp_path):
+    _run(_compile(tmp_path, "ttimes", TIMES_C))
+
+
+def test_alarm_default_disposition_terminates(tmp_path):
+    """No handler installed: SIGALRM's default action kills the process
+    at the simulated expiry instant."""
+    src = r"""
+#include <unistd.h>
+int main(void) { alarm(1); for (;;) pause(); }
+"""
+    binary = _compile(tmp_path, "talarmdie", src)
+    cfg = load_config_str(f"""
+general: {{stop_time: 30s, seed: 3}}
+network:
+  graph: {{type: 1_gbit_switch}}
+hosts:
+  alpha:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, args: [], start_time: 1s,
+       expected_final_state: {{signaled: 14}}}}
+""")
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
