@@ -148,6 +148,9 @@ class ExperimentalOptions:
     # host execution and per-syscall handler time; off by default since the
     # measured values are inherently nondeterministic
     use_perf_timers: bool = False
+    # shadow libcrypto's RAND entry points with the deterministic
+    # simulated-getrandom stream (`src/lib/preload-openssl/rng.c`)
+    use_preload_openssl_rng: bool = True
     scheduler: str = "thread-per-core"  # thread-per-core | thread-per-host | serial
     use_tpu_net_plane: bool = True  # offload router/relay/latency/loss to TPU
     tpu_devices: Optional[int] = None  # None = all visible devices

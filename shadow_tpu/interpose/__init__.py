@@ -105,6 +105,7 @@ _lib: Optional[ctypes.CDLL] = None
 
 SHIM_LIB_PATH = os.path.join(_DIR, "libshadow_shim.so")
 PRELOAD_LIBC_LIB_PATH = os.path.join(_DIR, "libshadow_preload_libc.so")
+PRELOAD_OPENSSL_LIB_PATH = os.path.join(_DIR, "libshadow_preload_openssl.so")
 
 
 def build(force: bool = False) -> str:
@@ -114,6 +115,7 @@ def build(force: bool = False) -> str:
         or not os.path.exists(_LIB_PATH)
         or not os.path.exists(SHIM_LIB_PATH)
         or not os.path.exists(PRELOAD_LIBC_LIB_PATH)
+        or not os.path.exists(PRELOAD_OPENSSL_LIB_PATH)
     ):
         subprocess.run(
             ["make", "-C", _DIR], check=True, capture_output=True, text=True

@@ -1,0 +1,125 @@
+/* Deterministic OpenSSL RNG preload.
+ *
+ * Parity: reference `src/lib/preload-openssl/rng.c` — libcrypto seeds its
+ * DRBGs from entropy sources the simulator cannot trap (RDRAND, jitter
+ * entropy), so managed TLS apps would diverge run-to-run. This library
+ * shadows the libcrypto RAND entry points and routes every byte request
+ * through the getrandom(2) syscall, which the seccomp filter traps and the
+ * simulated kernel answers from the host's seeded RNG stream
+ * (syscall_handler getrandom emulation). Written against the public
+ * OpenSSL RAND API surface (openssl/rand.h), independent implementation.
+ *
+ * Enabled by default for managed processes; gate with
+ * experimental.use_preload_openssl_rng.
+ */
+
+#include <stddef.h>
+
+#ifndef SYS_getrandom
+#define SYS_getrandom 318
+#endif
+
+/* Raw syscall: must not depend on libc's wrapper (ordering within the
+ * preload chain is not guaranteed). The seccomp filter traps this and the
+ * simulator fills the buffer deterministically. */
+static long raw_getrandom(void *buf, unsigned long n) {
+    long ret;
+    register long r10 __asm__("r10") = 0;
+    __asm__ volatile("syscall"
+                     : "=a"(ret)
+                     : "a"((long)SYS_getrandom), "D"(buf), "S"(n), "d"(0L),
+                       "r"(r10)
+                     : "rcx", "r11", "memory");
+    return ret;
+}
+
+static int fill_deterministic(unsigned char *buf, long n) {
+    long off = 0;
+    if (n < 0)
+        return 0; /* libcrypto fails negative lengths; so do we */
+    while (off < n) {
+        long got = raw_getrandom(buf + off, (unsigned long)(n - off));
+        if (got <= 0)
+            return 0; /* OpenSSL failure convention */
+        off += got;
+    }
+    return 1;
+}
+
+/* ---- the classic RAND API ------------------------------------------- */
+
+int RAND_bytes(unsigned char *buf, int num) {
+    return fill_deterministic(buf, num);
+}
+
+int RAND_priv_bytes(unsigned char *buf, int num) {
+    return fill_deterministic(buf, num);
+}
+
+int RAND_pseudo_bytes(unsigned char *buf, int num) {
+    return fill_deterministic(buf, num);
+}
+
+/* Seeding becomes a no-op: the simulated stream is already seeded. */
+void RAND_seed(const void *buf, int num) { (void)buf; (void)num; }
+void RAND_add(const void *buf, int num, double entropy) {
+    (void)buf; (void)num; (void)entropy;
+}
+int RAND_poll(void) { return 1; }
+int RAND_status(void) { return 1; }
+void RAND_cleanup(void) {}
+
+/* ---- DRBG entry points (OpenSSL 1.1.1) ------------------------------ */
+
+int RAND_DRBG_bytes(void *drbg, unsigned char *out, size_t outlen) {
+    (void)drbg;
+    return fill_deterministic(out, (long)outlen);
+}
+
+int RAND_DRBG_generate(void *drbg, unsigned char *out, size_t outlen,
+                       int prediction_resistance, const unsigned char *adin,
+                       size_t adinlen) {
+    (void)drbg; (void)prediction_resistance; (void)adin; (void)adinlen;
+    return fill_deterministic(out, (long)outlen);
+}
+
+/* ---- method-table accessors ----------------------------------------- */
+
+/* Apps (and libssl itself) may fetch the method table and call through
+ * it, bypassing our global symbols — hand back a table of our own
+ * functions. Layout matches openssl/rand.h RAND_METHOD. Callback return
+ * types drifted across OpenSSL versions (void vs int); returning int is
+ * ABI-safe on x86-64 since rax is caller-saved either way. */
+typedef struct {
+    int (*seed)(const void *buf, int num);
+    int (*bytes)(unsigned char *buf, int num);
+    void (*cleanup)(void);
+    int (*add)(const void *buf, int num, double entropy);
+    int (*pseudorand)(unsigned char *buf, int num);
+    int (*status)(void);
+} rand_method_t;
+
+static int method_seed(const void *buf, int num) {
+    (void)buf; (void)num;
+    return 1;
+}
+
+static int method_add(const void *buf, int num, double entropy) {
+    (void)buf; (void)num; (void)entropy;
+    return 1;
+}
+
+static const rand_method_t deterministic_method = {
+    method_seed,     RAND_bytes, RAND_cleanup,
+    method_add,      RAND_pseudo_bytes, RAND_status,
+};
+
+const void *RAND_get_rand_method(void) { return &deterministic_method; }
+const void *RAND_OpenSSL(void) { return &deterministic_method; }
+const void *RAND_SSLeay(void) { return &deterministic_method; }
+
+/* Refuse swaps back to an entropy-based method. */
+int RAND_set_rand_method(const void *meth) {
+    (void)meth;
+    return 1;
+}

@@ -55,15 +55,22 @@ _DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                     "interpose")
 SHIM_PATH = os.path.join(_DIR, "libshadow_shim.so")
 PRELOAD_LIBC_PATH = os.path.join(_DIR, "libshadow_preload_libc.so")
+PRELOAD_OPENSSL_PATH = os.path.join(_DIR, "libshadow_preload_openssl.so")
 
 
-def _preload_chain() -> str:
+def _preload_chain(openssl_rng: bool = False) -> str:
     """LD_PRELOAD value: libc wrappers first (so application symbol lookups
     hit them before libc), then the shim they call into
-    (`inject_preloads`, `managed_thread.rs:546-640`)."""
+    (`inject_preloads`, `managed_thread.rs:546-640`). With `openssl_rng`,
+    the deterministic libcrypto RAND shadow goes first of all — its
+    symbols must beat any libssl the app links."""
+    parts = []
+    if openssl_rng and os.path.exists(PRELOAD_OPENSSL_PATH):
+        parts.append(PRELOAD_OPENSSL_PATH)
     if os.path.exists(PRELOAD_LIBC_PATH):
-        return PRELOAD_LIBC_PATH + " " + SHIM_PATH
-    return SHIM_PATH
+        parts.append(PRELOAD_LIBC_PATH)
+    parts.append(SHIM_PATH)
+    return " ".join(parts)
 
 # x86_64 syscall numbers the server emulates
 SYS_write = 1
@@ -475,7 +482,11 @@ class ManagedSimProcess:
         self.threads = [ManagedThread(self, self.ipc, is_main=True)]
         env = dict(os.environ)
         preload = env.get("LD_PRELOAD", "")
-        env["LD_PRELOAD"] = _preload_chain() + (" " + preload if preload else "")
+        use_ssl_rng = bool(getattr(
+            getattr(self.host, "config_experimental", None),
+            "use_preload_openssl_rng", True))
+        env["LD_PRELOAD"] = _preload_chain(use_ssl_rng) + (
+            " " + preload if preload else "")
         env["SHADOW_TPU_IPC_HANDLE"] = self.ipc.block.serialize()
         # shared clock block: the shim answers clock_gettime/gettimeofday/
         # time locally from it, zero IPC round trips (`shim_sys.c:25-80`)
@@ -603,7 +614,12 @@ class ManagedSimProcess:
             os.kill(native, sig)
         except ProcessLookupError:
             return
-        for t in list(self.threads):
+        # A process-directed signal interrupts exactly ONE thread, like the
+        # kernel picking a single recipient (signal(7)); lowest tindex =
+        # deterministic "main thread preferred" choice. Without this, a
+        # periodic ITIMER_REAL would EINTR every blocked syscall in a
+        # multithreaded process on every tick.
+        for t in sorted(self.threads, key=lambda th: th.tindex):
             if t.parked_condition is None or t.dead:
                 continue
             cond, t.parked_condition = t.parked_condition, None
@@ -630,6 +646,7 @@ class ManagedSimProcess:
                 else:
                     self._reply_complete(t, -_errno.EINTR)
                 self._resume(t)
+            break
 
     def _cancel_all_parks(self) -> None:
         for t in self.threads:

@@ -160,3 +160,53 @@ hosts:
 """)
     stats = Manager(cfg).run()
     assert stats.process_failures == [], stats.process_failures
+
+
+MT_SIGNAL_C = r"""
+#include <errno.h>
+#include <pthread.h>
+#include <signal.h>
+#include <time.h>
+#include <unistd.h>
+
+static volatile sig_atomic_t fired;
+static volatile int worker_eintr;
+static void on_alarm(int sig) { (void)sig; fired = 1; }
+
+static void *worker(void *arg) {
+    (void)arg;
+    struct timespec ts = {3, 0};
+    /* must NOT be interrupted: the signal goes to one thread only */
+    if (nanosleep(&ts, 0) == -1 && errno == EINTR) worker_eintr = 1;
+    return 0;
+}
+
+int main(void) {
+    struct sigaction sa = {0};
+    sa.sa_handler = on_alarm; /* no SA_RESTART */
+    if (sigaction(SIGALRM, &sa, 0)) return 100;
+    pthread_t t;
+    if (pthread_create(&t, 0, worker, 0)) return 101;
+    alarm(1);
+    struct timespec ts = {10, 0};
+    int rc = nanosleep(&ts, 0);
+    /* main (lowest tindex) is the deterministic recipient: EINTR here */
+    if (!(rc == -1 && errno == EINTR)) return 102;
+    if (!fired) return 103;
+    if (pthread_join(t, 0)) return 104;
+    if (worker_eintr) return 105; /* exactly one thread interrupted */
+    return 0;
+}
+"""
+
+
+def test_signal_interrupts_exactly_one_thread(tmp_path):
+    """A process-directed SIGALRM must EINTR a single parked thread
+    (deterministically the lowest tindex), not every blocked syscall in
+    the process — signal(7) one-recipient semantics."""
+    c = tmp_path / "mtsig.c"
+    c.write_text(MT_SIGNAL_C)
+    binary = tmp_path / "mtsig"
+    subprocess.run([CC, "-O1", "-pthread", "-o", str(binary), str(c)],
+                   check=True)
+    _run(str(binary))
