@@ -35,6 +35,7 @@ from ..core.event import TaskRef
 from ..kernel import errors as kerrors
 from ..kernel.status import FileState, StatefulFile
 from .condition import SysCallCondition
+from .memory import MAPPING_SYSCALLS, MemoryRegions
 from .process import ProcessState
 from .syscall_handler import DispatchCtx, NativeSyscall, SyscallHandler
 
@@ -117,7 +118,9 @@ class MemoryCopier:
             self.pid, ctypes.byref(local), 1, ctypes.byref(remote), 1, 0
         )
         if got != n:
-            raise OSError(ctypes.get_errno(), "process_vm_readv failed")
+            # third arg = faulting address, for region diagnostics
+            raise OSError(ctypes.get_errno(), "process_vm_readv failed",
+                          hex(remote_addr))
         return buf.raw
 
     def write(self, remote_addr: int, data: bytes) -> None:
@@ -128,7 +131,8 @@ class MemoryCopier:
             self.pid, ctypes.byref(local), 1, ctypes.byref(remote), 1, 0
         )
         if got != len(data):
-            raise OSError(ctypes.get_errno(), "process_vm_writev failed")
+            raise OSError(ctypes.get_errno(), "process_vm_writev failed",
+                          hex(remote_addr))
 
 
 class SyscallServer:
@@ -316,7 +320,8 @@ class ManagedThread:
     __slots__ = ("process", "ipc", "native_tid", "parked_condition",
                  "park_deadline", "park_call", "park_restartable",
                  "futex_waiter", "wait_epoll",
-                 "ctid_addr", "dead", "is_main", "tindex")
+                 "ctid_addr", "dead", "is_main", "tindex", "sig_blocked",
+                 "sigwait_set", "sigwait_info_ptr", "suspend_saved")
 
     def __init__(self, process, ipc, is_main: bool = False):
         self.process = process
@@ -326,6 +331,10 @@ class ManagedThread:
         self.park_deadline: Optional[int] = None
         self.park_call = None  # (nr, args) of the blocked syscall
         self.park_restartable = True  # SA_RESTART eligibility of the park
+        self.sig_blocked = 0  # virtualized blocked-signal mask
+        self.sigwait_set = 0  # nonzero while parked in rt_sigtimedwait
+        self.sigwait_info_ptr = 0  # its siginfo output pointer
+        self.suspend_saved = None  # pre-sigsuspend mask to restore
         self.futex_waiter = None
         self.wait_epoll = None
         self.ctid_addr = 0
@@ -375,6 +384,8 @@ class ManagedSimProcess:
         self._stdout = self._stderr = None
         self._tindex_counter = 0
         self.strace = None  # StraceLogger when strace_logging_mode is on
+        self.regions: Optional[MemoryRegions] = None  # set at spawn
+        self._pending_signals: set[int] = set()  # blocked-everywhere sigs
         # threads (main first); clone in flight between ADD_THREAD_REQ and
         # ADD_THREAD_RES parks here
         self.threads: list[ManagedThread] = []
@@ -457,6 +468,7 @@ class ManagedSimProcess:
         """Parent's ADD_THREAD_RES arrived: the native child exists."""
         self.server.mem = MemoryCopier(native_pid)
         self.server.native_pid = native_pid
+        self.regions = MemoryRegions(native_pid)
         self.threads[0].native_tid = native_pid
         from .pidwatcher import get_watcher
 
@@ -514,6 +526,9 @@ class ManagedSimProcess:
         )
         self.server.mem = MemoryCopier(self.proc.pid)
         self.server.native_pid = self.proc.pid
+        # region bookkeeping (`memory_manager/mod.rs:616-709`): seeded from
+        # /proc/<pid>/maps, invalidated by mapping syscalls in dispatch
+        self.regions = MemoryRegions(self.proc.pid)
         self.state = ProcessState.RUNNING
         # Liveness guarantee (`childpid_watcher.rs`): if the child dies
         # without the shim destructor running (SIGKILL, segfault), close
@@ -580,16 +595,48 @@ class ManagedSimProcess:
         kill() call, a precise simulated instant."""
         if self.state != ProcessState.RUNNING:
             return
+        # a parked sigwait consumes the signal without running a handler
+        # (`rt_sigtimedwait(2)`) — checked before disposition since
+        # sigwait catches ignored and default-disposition signals alike,
+        # and before the mask gate since sigwait'd signals are blocked
+        bit = 1 << (sig - 1)
+        for t in sorted(self.threads, key=lambda th: th.tindex):
+            if t.dead or t.parked_condition is None:
+                continue
+            if getattr(t, "sigwait_set", 0) & bit:
+                # delay-0 task, like every other delivery effect: resuming
+                # the waiter inline on the SENDER's stack would block the
+                # sender's worker in the target's resume loop
+                self.host.schedule_task_with_delay(
+                    TaskRef(lambda h: self._sigwait_deliver(sig),
+                            "sigwait-deliver"), 0)
+                return
         kind, sa_restart = self.handler.signal_disposition(sig)
         # SIGCONT's job control is unmodeled, but an INSTALLED handler for
         # it still runs (common resume-detection idiom)
         if kind == "ignore" or (sig == 18 and kind != "handler"):
             return
+        # every live thread blocks it (virtual masks are authoritative):
+        # the signal stays pending until rt_sigprocmask unblocks it
+        # (SIGKILL is unmaskable). This holds for raise()/self-kill too —
+        # a self-directed blocked signal pends, like Linux.
+        if sig != 9:
+            live = [t for t in self.threads if not t.dead]
+            if live and all(t.sig_blocked & bit for t in live):
+                self._pending_signals.add(sig)
+                return
         if self_directed:
-            native = self.server.native_pid
-            if native:
+            # target a mask-eligible native thread (tgkill), not the
+            # process: a process-directed kill would let the native kernel
+            # run the handler on a virtually-masked thread
+            live = [t for t in sorted(self.threads,
+                                      key=lambda th: th.tindex)
+                    if not t.dead and not t.sig_blocked & bit]
+            if live:
+                self._signal_native_thread(live[0], sig)
+            elif self.server.native_pid:  # SIGKILL with all masked
                 try:
-                    os.kill(native, sig)
+                    os.kill(self.server.native_pid, sig)
                 except ProcessLookupError:
                     pass
             return
@@ -601,31 +648,100 @@ class ManagedSimProcess:
             TaskRef(lambda h: self._deliver_handled(sig, sa_restart),
                     "signal-deliver"), 0)
 
+    def _sigwait_deliver(self, sig: int) -> None:
+        """Deferred half of a sigwait consumption: re-scan (the waiter may
+        have unparked since) and complete, or fall back to a fresh
+        delivery decision."""
+        if self.state != ProcessState.RUNNING:
+            return
+        bit = 1 << (sig - 1)
+        for t in sorted(self.threads, key=lambda th: th.tindex):
+            if t.dead or t.parked_condition is None:
+                continue
+            if t.sigwait_set & bit:
+                self._complete_sigwait(t, sig)
+                return
+        self.deliver_signal(sig)  # nobody waiting anymore: normal path
+
+    def _complete_sigwait(self, thread: ManagedThread, sig: int) -> None:
+        """A parked rt_sigtimedwait consumes `sig`: complete with the
+        signal number, write minimal siginfo, run no handler."""
+        # pop the sigwait claim BEFORE cancel(): the condition's cancel
+        # wakeup runs _unpark, which clears these fields as stale
+        info_ptr, thread.sigwait_info_ptr = thread.sigwait_info_ptr, 0
+        thread.sigwait_set = 0
+        cond, thread.parked_condition = thread.parked_condition, None
+        if cond is not None:
+            cond.cancel()
+        self.handler._drop_wait_epoll(thread)
+        if info_ptr:
+            try:
+                self.handler.write_siginfo(info_ptr, sig)
+            except OSError:
+                pass
+        nr, pargs = thread.park_call or (0, ())
+        self._strace(thread, nr, pargs, sig)
+        self._reply_complete(thread, sig)
+        self._resume(thread)
+
+    def signals_unblocked(self, bits: int) -> None:
+        """A thread's rt_sigprocmask just unblocked `bits`: re-deliver any
+        matching pending process-directed signals (signal(7) pending-set
+        semantics)."""
+        for sig in sorted(self._pending_signals):
+            if bits & (1 << (sig - 1)):
+                self._pending_signals.discard(sig)
+                self.deliver_signal(sig)
+
+    def _signal_native_thread(self, thread, sig: int) -> bool:
+        """tgkill the chosen recipient's native thread so the app handler
+        runs on exactly the thread the virtual mask selection picked (a
+        process-directed os.kill would let the native kernel pick any
+        thread, including virtually-masked ones)."""
+        native_pid = self.server.native_pid
+        tid = thread.native_tid or native_pid
+        if not native_pid or not tid:
+            return False
+        SYS_tgkill_nr = 234
+        rc = _libc.syscall(SYS_tgkill_nr, native_pid, tid, sig)
+        return rc == 0
+
     def _deliver_handled(self, sig: int, sa_restart: bool) -> None:
         if self.state != ProcessState.RUNNING:
             return
-        native = self.server.native_pid
-        if not native:
+        # A process-directed signal interrupts exactly ONE thread, like
+        # the kernel picking a single recipient (signal(7)); the lowest
+        # tindex whose virtual mask admits the signal, parked threads
+        # preferred (they're the ones whose syscalls must EINTR). Without
+        # this, a periodic ITIMER_REAL would EINTR every blocked syscall
+        # in a multithreaded process on every tick.
+        bit = 1 << (sig - 1)
+        eligible = [t for t in sorted(self.threads,
+                                      key=lambda th: th.tindex)
+                    if not t.dead and not t.sig_blocked & bit]
+        if not eligible:
+            self._pending_signals.add(sig)  # raced with a mask change
             return
-        try:
-            # pending BEFORE any EINTR completion: the kernel delivers it
-            # when the shim's blocked futex recv restarts, so the app's
-            # handler has run by the time its syscall returns EINTR
-            os.kill(native, sig)
-        except ProcessLookupError:
+        recipient = next((t for t in eligible
+                          if t.parked_condition is not None), eligible[0])
+        # pending BEFORE any EINTR completion: the kernel delivers it
+        # when the shim's blocked futex recv restarts, so the app's
+        # handler has run by the time its syscall returns EINTR
+        if not self._signal_native_thread(recipient, sig):
             return
-        # A process-directed signal interrupts exactly ONE thread, like the
-        # kernel picking a single recipient (signal(7)); lowest tindex =
-        # deterministic "main thread preferred" choice. Without this, a
-        # periodic ITIMER_REAL would EINTR every blocked syscall in a
-        # multithreaded process on every tick.
-        for t in sorted(self.threads, key=lambda th: th.tindex):
+        for t in (recipient,):
             if t.parked_condition is None or t.dead:
                 continue
             cond, t.parked_condition = t.parked_condition, None
             cond.cancel()
             self.handler._drop_wait_epoll(t)
+            t.sigwait_set = 0  # this park is over; drop any stale
+            t.sigwait_info_ptr = 0  # sigwait claim on future parks
             nr, pargs = t.park_call or (0, ())
+            if t.suspend_saved is not None:
+                # leaving a sigsuspend park: the pre-suspend mask comes
+                # back before the EINTR completes (`sigsuspend(2)`)
+                t.sig_blocked, t.suspend_saved = t.suspend_saved, None
             if sa_restart and nr in self._RESTARTABLE \
                     and getattr(t, "park_restartable", True):
                 # restart as if freshly issued (usually re-parks)
@@ -720,6 +836,7 @@ class ManagedSimProcess:
         native clone + trampoline (`managed_thread.rs:349-428`)."""
         child_ipc = IpcChannel.create()
         child = ManagedThread(self, child_ipc)
+        child.sig_blocked = thread.sig_blocked  # mask inherits at clone
         if args[0] & CLONE_CHILD_CLEARTID:
             child.ctid_addr = args[3]
         with self._ipc_lock:  # threads is read by the death watcher
@@ -737,6 +854,7 @@ class ManagedSimProcess:
 
     def _begin_fork(self, thread: ManagedThread, nr: int, args) -> None:
         child = ManagedSimProcess.forked(self)
+        child.threads[0].sig_blocked = thread.sig_blocked  # fork inherits
         self._pending_clone = child
         self._pending_clone_call = (nr, tuple(args))
         reply = ShimEvent()
@@ -933,6 +1051,10 @@ class ManagedSimProcess:
         parked (the shim gets its reply when the condition fires)."""
         ctx = DispatchCtx(wake, thread.park_deadline if wake else None,
                           thread)
+        if nr in MAPPING_SYSCALLS and self.regions is not None:
+            # the mapping mutates natively; re-parse the region table on
+            # its next query (`memory_manager/mod.rs:616-709`)
+            self.regions.mark_dirty()
         try:
             ret = self.handler.dispatch(nr, args, ctx)
         except NativeSyscall:
@@ -957,7 +1079,7 @@ class ManagedSimProcess:
             # logged at completion, when the re-dispatch returns a result
             self._park(thread, nr, args, b)
             return True
-        except OSError:
+        except OSError as e:
             # A process_vm read/write failed mid-handler. For a live
             # process that's a bad pointer: report EFAULT (never re-run a
             # simulated-kernel syscall natively — simulated side effects
@@ -965,6 +1087,13 @@ class ManagedSimProcess:
             # gone and the reply lands nowhere anyway.
             import errno as _errno
 
+            if self.regions is not None and e.filename:
+                try:
+                    where = self.regions.describe(int(e.filename, 16))
+                    log.debug("%s: syscall %d EFAULT at %s",
+                              self.name, nr, where)
+                except (ValueError, OSError):
+                    pass
             self._strace(thread, nr, args, -_errno.EFAULT)
             self._reply_complete(thread, -_errno.EFAULT)
             return False
@@ -1006,6 +1135,10 @@ class ManagedSimProcess:
     def _unpark(self, thread: ManagedThread, nr: int, args,
                 reason: str) -> None:
         thread.parked_condition = None
+        # the park is over either way; a timeout re-dispatch of
+        # rt_sigtimedwait answers EAGAIN without re-reading these
+        thread.sigwait_set = 0
+        thread.sigwait_info_ptr = 0
         if self.state != ProcessState.RUNNING or thread.dead \
                 or reason == "cancel":
             return

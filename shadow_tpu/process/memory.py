@@ -1,0 +1,160 @@
+"""Managed-process memory-region bookkeeping.
+
+Parity: reference `src/main/host/memory_manager/mod.rs:616-709` — the
+memory manager tracks every mapping (heap, stack, anonymous, file-backed)
+in an interval map, updated on the brk/mmap/munmap/mprotect/mremap
+syscalls, as the foundation for pointer validation and the zero-copy
+MemoryMapper (`memory_mapper.rs`).
+
+This rebuild keeps syscall argument access on process_vm_readv/writev
+(`MemoryCopier`), so exact mutation-by-mutation replay of the reference's
+bookkeeping isn't load-bearing; instead the region table is parsed from
+/proc/<pid>/maps (the kernel's own authoritative interval map, the same
+source the reference seeds from — `proc_maps.rs`) and invalidated when a
+mapping syscall passes through the dispatch path. Queries re-parse at most
+once per invalidation.
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass
+from typing import Optional
+
+# mapping-mutating syscalls (x86_64) that invalidate the table
+SYS_mmap = 9
+SYS_mprotect = 10
+SYS_munmap = 11
+SYS_brk = 12
+SYS_mremap = 25
+SYS_shmat = 30
+SYS_shmdt = 67
+
+MAPPING_SYSCALLS = frozenset((
+    SYS_mmap, SYS_mprotect, SYS_munmap, SYS_brk, SYS_mremap,
+    SYS_shmat, SYS_shmdt,
+))
+
+
+@dataclass(frozen=True)
+class Region:
+    """One mapping, `[start, end)` (`memory_manager/mod.rs` Region)."""
+
+    start: int
+    end: int
+    read: bool
+    write: bool
+    execute: bool
+    private: bool
+    path: str  # "", "[heap]", "[stack]", "/lib/...", ...
+
+    @property
+    def kind(self) -> str:
+        if self.path == "[heap]":
+            return "heap"
+        if self.path.startswith("[stack"):
+            return "stack"
+        if self.path.startswith("["):
+            return "special"  # vdso/vvar/vsyscall
+        return "file" if self.path else "anonymous"
+
+    def __len__(self) -> int:
+        return self.end - self.start
+
+
+class MemoryRegions:
+    """Interval map over a live process's mappings, lazily refreshed."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self._regions: list[Region] = []
+        self._starts: list[int] = []
+        self._dirty = True
+        self.invalidations = 0  # observed mapping syscalls (stats/tests)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def mark_dirty(self) -> None:
+        """A mapping syscall passed through dispatch; re-parse on the
+        next query (`mod.rs:616-709` handle_brk/mmap/... analogue)."""
+        self._dirty = True
+        self.invalidations += 1
+
+    def refresh(self) -> None:
+        regions = []
+        try:
+            with open(f"/proc/{self.pid}/maps") as fh:
+                for line in fh:
+                    parts = line.split(maxsplit=5)
+                    if len(parts) < 5:
+                        continue
+                    span, perms = parts[0], parts[1]
+                    lo, _, hi = span.partition("-")
+                    regions.append(Region(
+                        start=int(lo, 16),
+                        end=int(hi, 16),
+                        read=perms[0] == "r",
+                        write=perms[1] == "w",
+                        execute=perms[2] == "x",
+                        private=perms[3] == "p",
+                        path=parts[5].strip() if len(parts) > 5 else "",
+                    ))
+        except OSError:
+            regions = []  # process gone; empty table
+        self._regions = regions
+        self._starts = [r.start for r in regions]
+        self._dirty = False
+
+    def _table(self) -> list[Region]:
+        if self._dirty:
+            self.refresh()
+        return self._regions
+
+    # -- queries ---------------------------------------------------------
+
+    def region_at(self, addr: int) -> Optional[Region]:
+        table = self._table()
+        i = bisect.bisect_right(self._starts, addr) - 1
+        if i >= 0 and table[i].start <= addr < table[i].end:
+            return table[i]
+        return None
+
+    def regions(self) -> list[Region]:
+        return list(self._table())
+
+    def heap(self) -> Optional[Region]:
+        return next((r for r in self._table() if r.kind == "heap"), None)
+
+    def stack(self) -> Optional[Region]:
+        return next((r for r in self._table() if r.kind == "stack"), None)
+
+    def _span_ok(self, addr: int, n: int, need_write: bool) -> bool:
+        """True when [addr, addr+n) is fully covered by mappings with the
+        required permission (contiguous regions compose)."""
+        if n <= 0:
+            return n == 0
+        end = addr + n
+        pos = addr
+        while pos < end:
+            r = self.region_at(pos)
+            if r is None or not r.read or (need_write and not r.write):
+                return False
+            pos = r.end
+        return True
+
+    def is_readable(self, addr: int, n: int) -> bool:
+        return self._span_ok(addr, n, need_write=False)
+
+    def is_writable(self, addr: int, n: int) -> bool:
+        return self._span_ok(addr, n, need_write=True)
+
+    def describe(self, addr: int) -> str:
+        """Human-readable locator for fault diagnostics."""
+        r = self.region_at(addr)
+        if r is None:
+            return f"0x{addr:x} (unmapped)"
+        perms = "".join((
+            "r" if r.read else "-", "w" if r.write else "-",
+            "x" if r.execute else "-"))
+        where = r.path or r.kind
+        return f"0x{addr:x} ({perms} {where} 0x{r.start:x}-0x{r.end:x})"

@@ -71,6 +71,9 @@ SYS_set_tid_address = 218
 SYS_tgkill = 234
 SYS_waitid = 247
 SYS_set_robust_list = 273
+SYS_rt_sigprocmask = 14
+SYS_rt_sigtimedwait = 128
+SYS_rt_sigsuspend = 130
 SYS_pause = 34
 SYS_getitimer = 36
 SYS_alarm = 37
@@ -122,6 +125,9 @@ SYS_pselect6 = 270
 SYS_ppoll = 271
 SYS_epoll_pwait = 281
 SYS_accept4 = 288
+SYS_recvmmsg = 299
+SYS_sendmmsg = 307
+SYS_statx = 332
 SYS_epoll_create1 = 291
 SYS_dup3 = 292
 SYS_getrandom = 318
@@ -255,6 +261,8 @@ class SyscallHandler:
         self._itimer_deadline: Optional[int] = None
         self._itimer_interval = 0
         self._itimer_gen = 0
+        # stable st_ino assignment for virtual descriptors
+        self._ino_map: dict[int, int] = {}
         # per-syscall dispatch tally for sim-stats (first dispatches only;
         # condition-wakeup re-dispatches of the same call don't re-count)
         self.syscall_counts: dict[int, int] = {}
@@ -830,12 +838,35 @@ class SyscallHandler:
             raise errors.SyscallError(errors.EINVAL)
         return self._sys_dup2(args, ctx, flags=_i32(args[2]))
 
+    def _vfd_stat_identity(self, file) -> tuple[int, int]:
+        """(st_mode, st_ino) for a virtual descriptor — shared by fstat
+        and statx so the two never disagree about the same fd. Inodes are
+        per-process creation ordinals: deterministic across runs, unlike
+        a heap address."""
+        from ..kernel.pipe import PipeReader as _PR, PipeWriter as _PW
+
+        ino = self._ino_map.get(id(file))
+        if ino is None:
+            ino = self._ino_map[id(file)] = len(self._ino_map) + 1
+        if isinstance(file, (_PR, _PW)):
+            return 0o010600, ino  # S_IFIFO
+        return 0o140777, ino  # S_IFSOCK
+
+    def write_siginfo(self, ptr: int, sig: int) -> None:
+        """Minimal siginfo_t (si_signo; zero si_errno/si_code/payload) —
+        the one serialization shared by every sigwait completion path."""
+        if ptr:
+            self.mem.write(ptr, struct.pack("<iii", sig, 0, 0)
+                           + b"\x00" * 116)
+
     def _sys_fstat(self, args, ctx) -> int:
-        self._file(args[0])  # EBADF check / native routing
-        # minimal S_IFSOCK stat (layout: x86_64 struct stat, st_mode at 24)
+        file = self._file(args[0])  # EBADF check / native routing
+        # minimal stat (layout: x86_64 struct stat; ino at 8, mode at 24)
+        mode, ino = self._vfd_stat_identity(file)
         st = bytearray(144)
-        struct.pack_into("<I", st, 24, 0o140777)
+        struct.pack_into("<Q", st, 8, ino)
         struct.pack_into("<Q", st, 16, 1)  # st_nlink
+        struct.pack_into("<I", st, 24, mode)
         self.mem.write(args[1], bytes(st))
         return 0
 
@@ -1269,6 +1300,175 @@ class SyscallHandler:
         self._write_itimerspec(args[1], interval, rem)
         return 0
 
+    # -- multi-message send/recv (`recvmmsg(2)`/`sendmmsg(2)`) -----------
+
+    MMSGHDR_SIZE = 64  # msghdr (56) + u32 msg_len + 4 pad
+
+    def _sys_recvmmsg(self, args, ctx) -> int:
+        """Loop of recvmsg: the first message may block, later ones stop
+        at EWOULDBLOCK with the partial count (Linux semantics; the
+        timeout argument is only honored between datagrams there, and we
+        match the common timeout=NULL shape)."""
+        fd, vecp, vlen = args[0], args[1], args[2] & 0xFFFFFFFF
+        flags = _i32(args[3])
+        vlen = min(vlen, 1024)
+        if vlen == 0:
+            return 0
+        done = 0
+        while done < vlen:
+            msgp = vecp + done * self.MMSGHDR_SIZE
+            # only the FIRST datagram may block; later ones stop the loop
+            sub_flags = flags if done == 0 else flags | MSG_DONTWAIT
+            sub = [fd, msgp, sub_flags, 0, 0, 0]
+            try:
+                got = self._sys_recvmsg(sub, ctx)
+            except errors.Blocked:
+                if done == 0:
+                    raise
+                break
+            except errors.SyscallError:
+                if done == 0:
+                    raise
+                break  # partial count now; the error surfaces next call
+            self.mem.write(msgp + 56, struct.pack("<I", got & 0xFFFFFFFF))
+            done += 1
+            ctx = DispatchCtx(None, None, ctx.thread)  # later msgs: fresh
+        return done
+
+    def _sys_sendmmsg(self, args, ctx) -> int:
+        """Known divergence: Linux blocks inside EACH sendmsg on a
+        blocking socket; re-dispatching a partially-sent batch after a
+        park would duplicate the messages already sent, so only the first
+        message may block here — later would-blocks return the partial
+        count (the API contract callers must handle anyway). Persistent
+        socket errors surface on the caller's next syscall from socket
+        state, like sk_err."""
+        fd, vecp, vlen = args[0], args[1], args[2] & 0xFFFFFFFF
+        vlen = min(vlen, 1024)
+        if vlen == 0:
+            return 0
+        done = 0
+        while done < vlen:
+            msgp = vecp + done * self.MMSGHDR_SIZE
+            sub = [fd, msgp, args[3], 0, 0, 0]
+            try:
+                sent = self._sys_sendmsg(sub, ctx)
+            except (errors.Blocked, errors.SyscallError):
+                if done == 0:
+                    raise
+                break
+            self.mem.write(msgp + 56, struct.pack("<I", sent & 0xFFFFFFFF))
+            done += 1
+        return done
+
+    # -- statx on simulated descriptors ----------------------------------
+
+    AT_EMPTY_PATH = 0x1000
+    STATX_BASIC_STATS = 0x7FF
+
+    def _sys_statx(self, args, ctx) -> int:
+        """statx(2) for virtual fds via AT_EMPTY_PATH; path-based forms
+        stay native (regular files are native in this design)."""
+        dirfd, flags = _i32(args[0]), _i32(args[2])
+        if not flags & self.AT_EMPTY_PATH or dirfd < self.VFD_BASE:
+            raise NativeSyscall()
+        file = self._file(dirfd)
+        mode, ino = self._vfd_stat_identity(file)
+        # struct statx: mask(4) blksize(4) attributes(8) nlink(4) uid(4)
+        # gid(4) mode(2) pad(2) ino(8) size(8) blocks(8) ...
+        buf = bytearray(256)
+        struct.pack_into("<IIQIIIHH", buf, 0, self.STATX_BASIC_STATS, 4096,
+                         0, 1, 0, 0, mode, 0)
+        struct.pack_into("<QQQ", buf, 32, ino, 0, 0)
+        self.mem.write(args[4], bytes(buf))
+        return 0
+
+    # -- signal-mask virtualization (`handler/signal.rs` rt_sigprocmask) --
+
+    def _sys_rt_sigprocmask(self, args, ctx) -> int:
+        """Fully virtualized blocked-signal mask. A native execution would
+        run inside the shim's SIGSYS handler, where the kernel restores
+        uc_sigmask at sigreturn and silently undoes the change — so the
+        simulator's per-thread mask is the single authority: it selects
+        the delivery recipient and holds process-wide signals pending
+        while every thread blocks them (reference: shim-shmem
+        blocked_signals, `shim_shmem.rs:139-404`)."""
+        SIG_BLOCK, SIG_UNBLOCK, SIG_SETMASK = 0, 1, 2
+        how, setp, oldp = _i32(args[0]), args[1], args[2]
+        if args[3] != 8:  # sigsetsize must be 64-bit
+            raise errors.SyscallError(errors.EINVAL)
+        thread = ctx.thread
+        if thread is None:
+            raise NativeSyscall()
+        old = getattr(thread, "sig_blocked", 0)
+        if oldp:
+            self.mem.write(oldp, struct.pack("<Q", old))
+        if setp:
+            (mask,) = struct.unpack("<Q", self.mem.read(setp, 8))
+            if how == SIG_BLOCK:
+                thread.sig_blocked = old | mask
+            elif how == SIG_UNBLOCK:
+                thread.sig_blocked = old & ~mask
+            elif how == SIG_SETMASK:
+                thread.sig_blocked = mask
+            else:
+                raise errors.SyscallError(errors.EINVAL)
+            unblocked = old & ~thread.sig_blocked
+            if unblocked:
+                self.process.signals_unblocked(unblocked)
+        return 0
+
+    def _sys_rt_sigsuspend(self, args, ctx) -> int:
+        """sigsuspend(2): swap in the given mask, park until a signal
+        delivery unparks us (always EINTR), restore the old mask on the
+        way out (`_deliver_handled` handles the restore since delivery
+        completes the park without a re-dispatch)."""
+        thread = ctx.thread
+        if thread is None:
+            raise NativeSyscall()
+        if args[1] != 8:
+            raise errors.SyscallError(errors.EINVAL)
+        (mask,) = struct.unpack("<Q", self.mem.read(args[0], 8))
+        opened = thread.sig_blocked & ~mask
+        thread.suspend_saved = thread.sig_blocked
+        thread.sig_blocked = mask
+        if opened:
+            self.process.signals_unblocked(opened)
+        raise errors.Blocked(None, FileState.NONE, restartable=False,
+                             forever=True)
+
+    def _sys_rt_sigtimedwait(self, args, ctx) -> int:
+        """sigwait/sigtimedwait: consume a pending (or next-delivered)
+        signal from the set without running its handler. Delivery
+        completes the park via `_complete_sigwait`; this body only
+        handles entry and timeout."""
+        thread = ctx.thread
+        if thread is None:
+            raise NativeSyscall()
+        if ctx.wake == "timeout":
+            thread.sigwait_set = 0
+            thread.sigwait_info_ptr = 0
+            raise errors.SyscallError(errors.EAGAIN)
+        (waitset,) = struct.unpack("<Q", self.mem.read(args[0], 8))
+        # SIGKILL/SIGSTOP can't be waited for (Linux silently drops them)
+        waitset &= ~((1 << 8) | (1 << 18))
+        # already-pending process signal in the set: consume right away
+        for sig in sorted(self.process._pending_signals):
+            if waitset & (1 << (sig - 1)):
+                self.process._pending_signals.discard(sig)
+                self.write_siginfo(args[1], sig)
+                return sig
+        timeout_ns = None
+        if args[2]:
+            sec, nsec = struct.unpack("<qq", self.mem.read(args[2], 16))
+            timeout_ns = sec * simtime.SECOND + nsec
+            if timeout_ns == 0:
+                raise errors.SyscallError(errors.EAGAIN)
+        thread.sigwait_set = waitset
+        thread.sigwait_info_ptr = args[1]
+        raise errors.Blocked(None, FileState.NONE, timeout_ns=timeout_ns,
+                             restartable=False, forever=timeout_ns is None)
+
     # -- itimers / alarm (`handler/time.rs:31-100`: ITIMER_REAL only,
     # SIGALRM in simulated time; per-process, not inherited on fork) -----
 
@@ -1657,6 +1857,12 @@ class SyscallHandler:
         SYS_timerfd_settime: _sys_timerfd_settime,
         SYS_timerfd_gettime: _sys_timerfd_gettime,
         SYS_pause: _sys_pause,
+        SYS_rt_sigprocmask: _sys_rt_sigprocmask,
+        SYS_rt_sigsuspend: _sys_rt_sigsuspend,
+        SYS_rt_sigtimedwait: _sys_rt_sigtimedwait,
+        SYS_recvmmsg: _sys_recvmmsg,
+        SYS_sendmmsg: _sys_sendmmsg,
+        SYS_statx: _sys_statx,
         SYS_getitimer: _sys_getitimer,
         SYS_alarm: _sys_alarm,
         SYS_setitimer: _sys_setitimer,

@@ -1,0 +1,117 @@
+"""Memory-region bookkeeping for managed processes.
+
+Parity: reference `src/main/host/memory_manager/mod.rs:616-709` (region
+interval map maintained across brk/mmap/munmap/mprotect) seeded from
+/proc/<pid>/maps (`proc_maps.rs`).
+"""
+
+import ctypes
+import mmap
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from shadow_tpu.process.memory import (MAPPING_SYSCALLS, MemoryRegions,
+                                       SYS_mmap)
+
+
+def test_parse_own_maps_finds_heap_and_stack():
+    regions = MemoryRegions(os.getpid())
+    assert regions.heap() is not None
+    assert regions.stack() is not None
+    all_regions = regions.regions()
+    assert len(all_regions) > 10
+    assert all(r.start < r.end for r in all_regions)
+    # sorted and non-overlapping, like the kernel's own table
+    for a, b in zip(all_regions, all_regions[1:]):
+        assert a.end <= b.start
+
+
+def test_region_queries_on_live_buffer():
+    regions = MemoryRegions(os.getpid())
+    buf = ctypes.create_string_buffer(4096)
+    addr = ctypes.addressof(buf)
+    r = regions.region_at(addr)
+    assert r is not None and r.read and r.write
+    assert regions.is_readable(addr, 4096)
+    assert regions.is_writable(addr, 4096)
+    # an address far past any mapping is unmapped
+    assert regions.region_at(1 << 47) is None
+    assert not regions.is_readable(1 << 47, 1)
+    assert "unmapped" in regions.describe(1 << 47)
+
+
+def test_dirty_refresh_sees_new_mapping():
+    regions = MemoryRegions(os.getpid())
+    regions.regions()  # force a parse
+    m = mmap.mmap(-1, 1 << 20)
+    addr = ctypes.addressof(ctypes.c_char.from_buffer(m))
+    # stale table may or may not cover it; after mark_dirty it must
+    regions.mark_dirty()
+    r = regions.region_at(addr)
+    # CPython's anonymous mmap may surface as "/dev/zero (deleted)"
+    assert r is not None and r.kind in ("anonymous", "file")
+    assert regions.is_writable(addr, 1 << 20)
+    del r
+    m.close()
+    regions.mark_dirty()
+    assert regions.region_at(addr) is None
+
+
+def test_spans_compose_across_contiguous_regions():
+    regions = MemoryRegions(os.getpid())
+    # read-only + read-write adjacent pair: find any two contiguous
+    # readable regions and span them
+    table = [r for r in regions.regions() if r.read]
+    pair = next(((a, b) for a, b in zip(table, table[1:])
+                 if a.end == b.start and b.read), None)
+    if pair is None:
+        pytest.skip("no contiguous readable pair in this process")
+    a, b = pair
+    assert regions.is_readable(a.end - 8, 16)  # crosses the boundary
+
+
+CC = shutil.which("gcc") or shutil.which("cc")
+
+
+@pytest.mark.skipif(CC is None, reason="no C compiler")
+def test_managed_mmap_invalidates_region_table(tmp_path):
+    """End-to-end: a managed binary's mmap/munmap passes through dispatch
+    and invalidates the process's region table."""
+    from shadow_tpu.core.config import load_config_str
+    from shadow_tpu.core.manager import Manager
+
+    c = tmp_path / "mapper.c"
+    c.write_text(r"""
+#include <sys/mman.h>
+int main(void) {
+    void *p = mmap(0, 1 << 20, PROT_READ | PROT_WRITE,
+                   MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (p == MAP_FAILED) return 110;
+    if (munmap(p, 1 << 20)) return 111;
+    return 0;
+}
+""")
+    binary = tmp_path / "mapper"
+    subprocess.run([CC, "-O1", "-o", str(binary), str(c)], check=True)
+    cfg = load_config_str(f"""
+general: {{stop_time: 5s, seed: 3}}
+network:
+  graph: {{type: 1_gbit_switch}}
+hosts:
+  alpha:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, args: [], start_time: 1s,
+       expected_final_state: {{exited: 0}}}}
+""")
+    mgr = Manager(cfg)
+    stats = mgr.run()
+    assert stats.process_failures == [], stats.process_failures
+    (proc,) = [cell.get("proc") for _n, _p, cell in mgr._spawned]
+    assert proc.regions is not None
+    # at least the test's own mmap + munmap, plus loader/libc mappings
+    assert proc.regions.invalidations >= 2
+    assert SYS_mmap in MAPPING_SYSCALLS
