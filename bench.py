@@ -28,8 +28,8 @@ import numpy as np
 
 MS = 1_000_000
 
-N_HOSTS = int(os.environ.get("BENCH_HOSTS", "16384"))
-ROUNDS = int(os.environ.get("BENCH_ROUNDS", "256"))
+N_HOSTS = int(os.environ.get("BENCH_HOSTS", "32768"))
+ROUNDS = int(os.environ.get("BENCH_ROUNDS", "192"))
 N_NODES = int(os.environ.get("BENCH_NODES", "64"))  # graph nodes (GML-like)
 EGRESS_CAP = 16
 INGRESS_CAP = 32

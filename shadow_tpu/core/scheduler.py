@@ -30,16 +30,22 @@ import threading
 from typing import Callable, List, Optional, Sequence
 
 from .worker import Worker, WorkerShared
+from .worker import set_current_cpu as worker_mod_set_cpu
 
 
-def _pin_to_cpu(index: int) -> None:
+def _pin_to_cpu(index: int) -> Optional[int]:
     """Best-effort CPU pinning (`affinity_getGoodWorkerAffinity`): worker i
-    gets core i mod n_cores. No-op where unsupported."""
+    gets core i mod n_cores. Returns the chosen cpu (None = unsupported)
+    and records it thread-locally so managed native threads can be
+    migrated to follow their worker (`managed_thread.rs:533-544`)."""
     try:
         cpus = sorted(os.sched_getaffinity(0))
-        os.sched_setaffinity(0, {cpus[index % len(cpus)]})
+        cpu = cpus[index % len(cpus)]
+        os.sched_setaffinity(0, {cpu})
     except (AttributeError, OSError):
-        pass
+        return None
+    worker_mod_set_cpu(cpu)
+    return cpu
 
 
 class SerialScheduler:

@@ -34,6 +34,15 @@ def current_host():
     return getattr(_active, "host", None)
 
 
+def current_cpu():
+    """The CPU this scheduler thread is pinned to (None = unpinned)."""
+    return getattr(_active, "cpu", None)
+
+
+def set_current_cpu(cpu) -> None:
+    _active.cpu = cpu
+
+
 class WorkerShared:
     """Global state shared by all workers; read-mostly after setup."""
 

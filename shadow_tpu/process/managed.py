@@ -31,13 +31,15 @@ import time as _time
 from typing import Callable, Optional
 
 from ..core import simtime
+from ..core import worker as worker_mod
 from ..core.event import TaskRef
 from ..kernel import errors as kerrors
 from ..kernel.status import FileState, StatefulFile
 from .condition import SysCallCondition
 from .memory import MAPPING_SYSCALLS, MemoryRegions
 from .process import ProcessState
-from .syscall_handler import DispatchCtx, NativeSyscall, SyscallHandler
+from .syscall_handler import (SYS_tgkill, DispatchCtx, NativeSyscall,
+                              SyscallHandler, _libc_syscall)
 
 log = logging.getLogger("shadow_tpu.process")
 from ..interpose import (
@@ -321,7 +323,8 @@ class ManagedThread:
                  "park_deadline", "park_call", "park_restartable",
                  "futex_waiter", "wait_epoll",
                  "ctid_addr", "dead", "is_main", "tindex", "sig_blocked",
-                 "sigwait_set", "sigwait_info_ptr", "suspend_saved")
+                 "sigwait_set", "sigwait_info_ptr", "suspend_saved",
+                 "pinned_cpu")
 
     def __init__(self, process, ipc, is_main: bool = False):
         self.process = process
@@ -335,6 +338,7 @@ class ManagedThread:
         self.sigwait_set = 0  # nonzero while parked in rt_sigtimedwait
         self.sigwait_info_ptr = 0  # its siginfo output pointer
         self.suspend_saved = None  # pre-sigsuspend mask to restore
+        self.pinned_cpu = None  # last CPU this native thread was pinned to
         self.futex_waiter = None
         self.wait_epoll = None
         self.ctid_addr = 0
@@ -628,13 +632,13 @@ class ManagedSimProcess:
         if self_directed:
             # target a mask-eligible native thread (tgkill), not the
             # process: a process-directed kill would let the native kernel
-            # run the handler on a virtually-masked thread
+            # run the handler on a virtually-masked thread. Fall back to
+            # the process when no tgkill lands (stale/unknown tids).
             live = [t for t in sorted(self.threads,
                                       key=lambda th: th.tindex)
                     if not t.dead and not t.sig_blocked & bit]
-            if live:
-                self._signal_native_thread(live[0], sig)
-            elif self.server.native_pid:  # SIGKILL with all masked
+            if not any(self._signal_native_thread(t, sig) for t in live) \
+                    and self.server.native_pid:
                 try:
                     os.kill(self.server.native_pid, sig)
                 except ProcessLookupError:
@@ -699,12 +703,10 @@ class ManagedSimProcess:
         process-directed os.kill would let the native kernel pick any
         thread, including virtually-masked ones)."""
         native_pid = self.server.native_pid
-        tid = thread.native_tid or native_pid
+        tid = thread.native_tid
         if not native_pid or not tid:
             return False
-        SYS_tgkill_nr = 234
-        rc = _libc.syscall(SYS_tgkill_nr, native_pid, tid, sig)
-        return rc == 0
+        return _libc_syscall(SYS_tgkill, native_pid, tid, sig) == 0
 
     def _deliver_handled(self, sig: int, sa_restart: bool) -> None:
         if self.state != ProcessState.RUNNING:
@@ -722,13 +724,30 @@ class ManagedSimProcess:
         if not eligible:
             self._pending_signals.add(sig)  # raced with a mask change
             return
-        recipient = next((t for t in eligible
-                          if t.parked_condition is not None), eligible[0])
-        # pending BEFORE any EINTR completion: the kernel delivers it
-        # when the shim's blocked futex recv restarts, so the app's
-        # handler has run by the time its syscall returns EINTR
-        if not self._signal_native_thread(recipient, sig):
-            return
+        # parked threads first (their syscalls must EINTR), then running
+        # ones; a failed tgkill (stale tid racing native death) falls
+        # through to the next candidate, then to a process-directed kill
+        # so a handled signal is never silently dropped
+        ordered = sorted(eligible,
+                         key=lambda t: (t.parked_condition is None,
+                                        t.tindex))
+        recipient = None
+        for cand in ordered:
+            # pending BEFORE any EINTR completion: the kernel delivers it
+            # when the shim's blocked futex recv restarts, so the app's
+            # handler has run by the time its syscall returns EINTR
+            if self._signal_native_thread(cand, sig):
+                recipient = cand
+                break
+        if recipient is None:
+            native = self.server.native_pid
+            if not native:
+                return
+            try:
+                os.kill(native, sig)
+            except ProcessLookupError:
+                return  # process is gone; nothing to interrupt
+            recipient = ordered[0]
         for t in (recipient,):
             if t.parked_condition is None or t.dead:
                 continue
@@ -776,6 +795,17 @@ class ManagedSimProcess:
         """Service ONE managed thread until it blocks, exits, or dies (runs
         on the worker thread currently executing this host, like the
         reference `managed_thread.rs:185-322` resume loop)."""
+        # managed threads follow their worker's CPU pin so host-affine
+        # cache state stays warm across control transfers
+        # (`managed_thread.rs:533-544`, affinity.c migration)
+        wcpu = worker_mod.current_cpu()
+        if wcpu is not None and thread.native_tid \
+                and thread.pinned_cpu != wcpu:
+            try:
+                os.sched_setaffinity(thread.native_tid, {wcpu})
+                thread.pinned_cpu = wcpu
+            except OSError:
+                thread.pinned_cpu = wcpu  # don't retry a dead/foreign tid
         # CPU model: the wall time between handing control to the shim and
         # its next event is native execution; charge it to the simulated
         # CPU (`process.rs:465-482` cpu-delay timer). Only measured when

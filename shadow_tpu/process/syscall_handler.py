@@ -262,7 +262,7 @@ class SyscallHandler:
         self._itimer_interval = 0
         self._itimer_gen = 0
         # stable st_ino assignment for virtual descriptors
-        self._ino_map: dict[int, int] = {}
+        self._ino_counter = 0
         # per-syscall dispatch tally for sim-stats (first dispatches only;
         # condition-wakeup re-dispatches of the same call don't re-count)
         self.syscall_counts: dict[int, int] = {}
@@ -841,13 +841,15 @@ class SyscallHandler:
     def _vfd_stat_identity(self, file) -> tuple[int, int]:
         """(st_mode, st_ino) for a virtual descriptor — shared by fstat
         and statx so the two never disagree about the same fd. Inodes are
-        per-process creation ordinals: deterministic across runs, unlike
-        a heap address."""
+        stat-order ordinals stamped ON the file object (stable across
+        dup()s, immune to id() reuse after GC, deterministic across
+        runs)."""
         from ..kernel.pipe import PipeReader as _PR, PipeWriter as _PW
 
-        ino = self._ino_map.get(id(file))
+        ino = getattr(file, "st_ino", None)
         if ino is None:
-            ino = self._ino_map[id(file)] = len(self._ino_map) + 1
+            self._ino_counter += 1
+            ino = file.st_ino = self._ino_counter
         if isinstance(file, (_PR, _PW)):
             return 0o010600, ino  # S_IFIFO
         return 0o140777, ino  # S_IFSOCK
@@ -1305,25 +1307,35 @@ class SyscallHandler:
     MMSGHDR_SIZE = 64  # msghdr (56) + u32 msg_len + 4 pad
 
     def _sys_recvmmsg(self, args, ctx) -> int:
-        """Loop of recvmsg: the first message may block, later ones stop
-        at EWOULDBLOCK with the partial count (Linux semantics; the
-        timeout argument is only honored between datagrams there, and we
-        match the common timeout=NULL shape)."""
+        """Loop of recvmsg: the first message may block (honoring the
+        timeout argument), later ones stop at EWOULDBLOCK with the
+        partial count (Linux semantics)."""
         fd, vecp, vlen = args[0], args[1], args[2] & 0xFFFFFFFF
         flags = _i32(args[3])
         vlen = min(vlen, 1024)
         if vlen == 0:
             return 0
+        if ctx.wake == "timeout":
+            raise errors.SyscallError(errors.EWOULDBLOCK)
+        timeout_ns = None
+        if args[4]:
+            sec, nsec = struct.unpack("<qq", self.mem.read(args[4], 16))
+            timeout_ns = sec * simtime.SECOND + nsec
         done = 0
+        sub_ctx = DispatchCtx(None, None, ctx.thread)
         while done < vlen:
             msgp = vecp + done * self.MMSGHDR_SIZE
             # only the FIRST datagram may block; later ones stop the loop
             sub_flags = flags if done == 0 else flags | MSG_DONTWAIT
             sub = [fd, msgp, sub_flags, 0, 0, 0]
             try:
-                got = self._sys_recvmsg(sub, ctx)
-            except errors.Blocked:
+                got = self._sys_recvmsg(sub, sub_ctx)
+            except errors.Blocked as b:
                 if done == 0:
+                    if timeout_ns is not None:
+                        raise errors.Blocked(
+                            b.file, b.state_mask, timeout_ns=timeout_ns,
+                            restartable=b.restartable) from None
                     raise
                 break
             except errors.SyscallError:
@@ -1332,7 +1344,6 @@ class SyscallHandler:
                 break  # partial count now; the error surfaces next call
             self.mem.write(msgp + 56, struct.pack("<I", got & 0xFFFFFFFF))
             done += 1
-            ctx = DispatchCtx(None, None, ctx.thread)  # later msgs: fresh
         return done
 
     def _sys_sendmmsg(self, args, ctx) -> int:
@@ -1397,6 +1408,10 @@ class SyscallHandler:
         how, setp, oldp = _i32(args[0]), args[1], args[2]
         if args[3] != 8:  # sigsetsize must be 64-bit
             raise errors.SyscallError(errors.EINVAL)
+        # validate BEFORE any user-memory write: the kernel leaves oldset
+        # untouched on EINVAL
+        if setp and how not in (SIG_BLOCK, SIG_UNBLOCK, SIG_SETMASK):
+            raise errors.SyscallError(errors.EINVAL)
         thread = ctx.thread
         if thread is None:
             raise NativeSyscall()
@@ -1409,10 +1424,8 @@ class SyscallHandler:
                 thread.sig_blocked = old | mask
             elif how == SIG_UNBLOCK:
                 thread.sig_blocked = old & ~mask
-            elif how == SIG_SETMASK:
+            else:  # SIG_SETMASK
                 thread.sig_blocked = mask
-            else:
-                raise errors.SyscallError(errors.EINVAL)
             unblocked = old & ~thread.sig_blocked
             if unblocked:
                 self.process.signals_unblocked(unblocked)

@@ -91,3 +91,45 @@ def test_serial_when_parallelism_one():
     shared = make_shared()
     assert isinstance(make_scheduler("thread-per-core", shared, 1), SerialScheduler)
     assert isinstance(make_scheduler("serial", shared, 8), SerialScheduler)
+
+
+def test_managed_threads_follow_worker_pin(tmp_path):
+    """Managed native threads are migrated to their worker's CPU
+    (`managed_thread.rs:533-544` + affinity.c); requires a parallel,
+    pinned scheduler (on a 1-core box both workers share cpu 0)."""
+    import os
+    import shutil
+
+    if not hasattr(os, "sched_setaffinity"):
+        import pytest
+
+        pytest.skip("no sched_setaffinity")
+    if shutil.which("sleep") is None:
+        import pytest
+
+        pytest.skip("no sleep binary")
+    from shadow_tpu.core.config import load_config_str
+    from shadow_tpu.core.manager import Manager
+
+    cfg = load_config_str("""
+general: {stop_time: 3s, seed: 3, parallelism: 2}
+network:
+  graph: {type: 1_gbit_switch}
+hosts:
+  alpha:
+    network_node_id: 0
+    processes:
+    - {path: /bin/sleep, args: ["1"], start_time: 1s,
+       expected_final_state: {exited: 0}}
+  beta:
+    network_node_id: 0
+    processes:
+    - {path: /bin/sleep, args: ["1"], start_time: 1s,
+       expected_final_state: {exited: 0}}
+""")
+    mgr = Manager(cfg)
+    stats = mgr.run()
+    assert stats.process_failures == [], stats.process_failures
+    pins = [c.get("proc").threads[0].pinned_cpu
+            for _n, _p, c in mgr._spawned]
+    assert all(p is not None for p in pins), pins
