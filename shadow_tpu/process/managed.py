@@ -375,6 +375,12 @@ class ManagedSimProcess:
         self.name = name
         self.argv = argv
         self.pid = host.next_pid()
+        # process groups / sessions (`process.rs:1092-1094`): top-level
+        # processes live in init's group and session (pgid=sid=1, like
+        # the reference's ProcessId::INIT), so setsid()/setpgid(0,0)
+        # daemonization works; fork inherits
+        self.pgid = 1
+        self.sid = 1
         self.exit_status: Optional[int] = None
         self.kill_signal: Optional[int] = None
         self.server = SyscallServer(virtual_pid=self.pid,
@@ -442,6 +448,8 @@ class ManagedSimProcess:
         self.state = ProcessState.RUNNING  # the native child exists shortly
         self.handler = SyscallHandler(
             self, table=parent.handler._table.fork_into())
+        # fork(2) inherits signal dispositions
+        self.handler.sig_actions = dict(parent.handler.sig_actions)
         from .strace import make_logger
 
         self._strace_mode = getattr(parent, "_strace_mode", "off")
@@ -452,6 +460,8 @@ class ManagedSimProcess:
         self.ipc = IpcChannel.create()
         self.threads = [ManagedThread(self, self.ipc, is_main=True)]
         self.parent = parent
+        self.pgid = parent.pgid  # fork inherits group and session
+        self.sid = parent.sid
         parent.children.append(self)
         return self
 

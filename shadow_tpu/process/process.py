@@ -57,6 +57,8 @@ class SimProcess:
         self.host = host
         self.name = name
         self.pid = pid if pid is not None else host.next_pid()
+        self.pgid = 1  # init's group/session (`process.rs:1092-1094`)
+        self.sid = 1
         self.state = ProcessState.PENDING
         self.exit_status: Optional[int] = None
         self.kill_signal: Optional[int] = None

@@ -79,6 +79,11 @@ SYS_getitimer = 36
 SYS_alarm = 37
 SYS_setitimer = 38
 SYS_times = 100
+SYS_setpgid = 109
+SYS_getpgrp = 111
+SYS_setsid = 112
+SYS_getpgid = 121
+SYS_getsid = 124
 SYS_sched_setaffinity = 203
 SYS_clock_getres = 229
 SYS_timerfd_create = 283
@@ -1715,33 +1720,79 @@ class SyscallHandler:
         signal kills the target deterministically through the process
         plane (no native-kill race with the death watcher), a handled
         signal is forwarded natively (so the app's handler really runs)
-        after interrupting any parked syscalls per SA_RESTART."""
+        after interrupting any parked syscalls per SA_RESTART.
+
+        kill(2) group forms: 0 = the caller's process group, -pgid = that
+        group, -1 = every process on the host (`kill(2)`)."""
         if nr == SYS_kill:
             target, sig = _i64(args[0]), _i32(args[1])
         else:  # tgkill(tgid, tid, sig): process-granularity delivery
             target, sig = _i64(args[0]), _i32(args[2])
+        if nr == SYS_kill and target <= 0:
+            # group forms — including -pid of a group leader, which
+            # addresses the whole group (fork children included), not
+            # just the leader
+            victims = self._group_targets(target)
+            if not victims:
+                raise errors.SyscallError(errors.ESRCH)
+            if sig == 0:
+                return 0
+            # deterministic order; the caller last so its own death (or
+            # EINTR) doesn't cut the group delivery short
+            victims.sort(key=lambda p: (p is self.process, p.pid))
+            for v in victims:
+                self._deliver_to(v, sig)
+            return 0
         victim = self._target_process(target)
         if victim is None:
             raise errors.SyscallError(errors.ESRCH)
         if sig == 0:
             return 0  # existence probe
+        self._deliver_to(victim, sig)
+        return 0
+
+    def _deliver_to(self, victim, sig: int) -> None:
         deliver = getattr(victim, "deliver_signal", None)
         if deliver is not None:  # managed native process
             deliver(sig, self_directed=victim is self.process)
-            return 0
+            return
         stop = getattr(victim, "stop", None)
         if stop is not None:  # coroutine SimProcess: no handlers to run
             if sig not in self._SIG_DEFAULT_IGNORE:
                 stop(sig)
-            return 0
+            return
         raise errors.SyscallError(errors.ESRCH)
 
+    def _group_targets(self, target: int) -> list:
+        """Alive processes matched by a kill(2) group form."""
+        if target == 0:
+            pgid = getattr(self.process, "pgid", self.process.pid)
+        elif target == -1:
+            pgid = None  # broadcast
+        else:
+            pgid = -target
+        out = []
+        for proc in getattr(self.host, "processes", []):
+            if not getattr(proc, "is_alive", False):
+                continue
+            if pgid is None:
+                # kill(-1) broadcasts to everyone EXCEPT the caller
+                if proc is not self.process:
+                    out.append(proc)
+            elif getattr(proc, "pgid", proc.pid) == pgid:
+                out.append(proc)
+        return out
+
     def _target_process(self, vpid: int):
+        """Positive-pid lookup (kill's <=0 group forms route through
+        _group_targets; tgkill with tgid <= 0 is an error)."""
         proc = self.process
-        if vpid in (proc.pid, 0, -proc.pid):
+        if vpid <= 0:
+            return None
+        if vpid == proc.pid:
             return proc
         for other in getattr(self.host, "processes", []):
-            if getattr(other, "pid", None) == abs(vpid) and other.is_alive:
+            if getattr(other, "pid", None) == vpid and other.is_alive:
                 return other
         return None
 
@@ -1750,6 +1801,74 @@ class SyscallHandler:
 
     def _sys_tgkill(self, args, ctx) -> int:
         return self._sys_kill_family(args, ctx, SYS_tgkill)
+
+    # -- process groups / sessions (`process.rs` groups, `setpgid(2)`) ---
+
+    def _proc_by_vpid(self, vpid: int):
+        if vpid == 0 or vpid == self.process.pid:
+            return self.process
+        for other in getattr(self.host, "processes", []):
+            if getattr(other, "pid", None) == vpid \
+                    and getattr(other, "is_alive", False):
+                return other
+        return None
+
+    def _sys_getpgrp(self, args, ctx) -> int:
+        return getattr(self.process, "pgid", self.process.pid)
+
+    def _sys_getpgid(self, args, ctx) -> int:
+        proc = self._proc_by_vpid(_i32(args[0]))
+        if proc is None:
+            raise errors.SyscallError(errors.ESRCH)
+        return getattr(proc, "pgid", proc.pid)
+
+    def _sys_setpgid(self, args, ctx) -> int:
+        pid, pgid = _i32(args[0]), _i32(args[1])
+        if pgid < 0:
+            raise errors.SyscallError(errors.EINVAL)
+        proc = self._proc_by_vpid(pid)
+        if proc is None:
+            raise errors.SyscallError(errors.ESRCH)
+        # POSIX: only self or our children may be moved, and a session
+        # leader's group may never change
+        if proc is not self.process \
+                and getattr(proc, "parent", None) is not self.process:
+            raise errors.SyscallError(errors.EPERM)
+        if getattr(proc, "sid", proc.pid) == proc.pid:
+            raise errors.SyscallError(errors.EPERM)
+        target_pgid = pgid or proc.pid
+        if target_pgid != proc.pid:
+            # joining a group: it must exist in the caller's session
+            owner = next(
+                (p for p in getattr(self.host, "processes", [])
+                 if getattr(p, "pgid", p.pid) == target_pgid
+                 and getattr(p, "is_alive", False)), None)
+            if owner is None or getattr(owner, "sid", owner.pid) != \
+                    getattr(proc, "sid", proc.pid):
+                raise errors.SyscallError(errors.EPERM)
+        proc.pgid = target_pgid
+        return 0
+
+    def _sys_setsid(self, args, ctx) -> int:
+        proc = self.process
+        if getattr(proc, "pgid", proc.pid) == proc.pid:
+            # a group leader can't start a session (`setsid(2)`)
+            raise errors.SyscallError(errors.EPERM)
+        # ...nor may a group with our pid already exist elsewhere (groups
+        # never span sessions)
+        for other in getattr(self.host, "processes", []):
+            if other is not proc and getattr(other, "is_alive", False) \
+                    and getattr(other, "pgid", other.pid) == proc.pid:
+                raise errors.SyscallError(errors.EPERM)
+        proc.pgid = proc.pid
+        proc.sid = proc.pid
+        return proc.pid
+
+    def _sys_getsid(self, args, ctx) -> int:
+        proc = self._proc_by_vpid(_i32(args[0]))
+        if proc is None:
+            raise errors.SyscallError(errors.ESRCH)
+        return getattr(proc, "sid", proc.pid)
 
     def _sys_set_tid_address(self, args, ctx) -> int:
         if ctx.thread is not None:
@@ -1880,6 +1999,11 @@ class SyscallHandler:
         SYS_alarm: _sys_alarm,
         SYS_setitimer: _sys_setitimer,
         SYS_times: _sys_times,
+        SYS_setpgid: _sys_setpgid,
+        SYS_getpgrp: _sys_getpgrp,
+        SYS_setsid: _sys_setsid,
+        SYS_getpgid: _sys_getpgid,
+        SYS_getsid: _sys_getsid,
         SYS_clock_getres: _sys_clock_getres,
         SYS_sched_setaffinity: _sys_sched_setaffinity,
         SYS_futex: _sys_futex,

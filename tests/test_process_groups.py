@@ -1,0 +1,119 @@
+"""Process groups and sessions for managed binaries.
+
+Parity: reference `src/main/host/process.rs` (process groups/sessions)
+and `kill(2)` group forms (0, -pgid, -1).
+"""
+
+import shutil
+import subprocess
+
+import pytest
+
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.manager import Manager
+
+CC = shutil.which("gcc") or shutil.which("cc")
+
+pytestmark = pytest.mark.skipif(CC is None, reason="no C compiler")
+
+
+def _run(tmp_path, name, src, stop="30s"):
+    c = tmp_path / f"{name}.c"
+    c.write_text(src)
+    binary = tmp_path / name
+    subprocess.run([CC, "-O1", "-o", str(binary), str(c)], check=True)
+    cfg = load_config_str(f"""
+general: {{stop_time: {stop}, seed: 3}}
+network:
+  graph: {{type: 1_gbit_switch}}
+hosts:
+  alpha:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, args: [], start_time: 1s,
+       expected_final_state: {{exited: 0}}}}
+""")
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
+
+
+SESSIONS_C = r"""
+#include <errno.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+int main(void) {
+    pid_t me = getpid();
+    /* top-level processes live in init's group+session (pgid=sid=1) */
+    if (getpgrp() != 1) return 180;
+    if (getsid(0) != 1) return 181;
+    /* a non-leader daemonizes: new session + group, both led by us */
+    if (setsid() != me) return 182;
+    if (getpgrp() != me || getsid(0) != me) return 183;
+    /* now we ARE a session (and group) leader: both ops must fail */
+    if (setsid() != -1 || errno != EPERM) return 184;
+    if (setpgid(0, 0) != -1 || errno != EPERM) return 185;
+    pid_t child = fork();
+    if (child < 0) return 186;
+    if (child == 0) {
+        /* fork inherits the parent's (new) group and session */
+        if (getpgrp() != getppid()) _exit(90);
+        if (getsid(0) != getppid()) _exit(91);
+        /* a non-leader child may itself daemonize */
+        if (setsid() != getpid()) _exit(92);
+        if (getpgrp() != getpid() || getsid(0) != getpid()) _exit(93);
+        /* ...after which it is a group leader: setsid again fails */
+        if (setsid() != -1 || errno != EPERM) _exit(94);
+        _exit(0);
+    }
+    int status;
+    if (waitpid(child, &status, 0) != child) return 187;
+    if (!WIFEXITED(status) || WEXITSTATUS(status) != 0)
+        return 100 + WEXITSTATUS(status);
+    return 0;
+}
+"""
+
+
+GROUP_KILL_C = r"""
+#include <errno.h>
+#include <signal.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+static volatile sig_atomic_t poked;
+static void on_usr1(int sig) { (void)sig; poked = 1; }
+
+int main(void) {
+    struct sigaction sa = {0};
+    sa.sa_handler = on_usr1;
+    if (sigaction(SIGUSR1, &sa, 0)) return 190;
+    pid_t child = fork();
+    if (child < 0) return 191;
+    if (child == 0) {
+        /* same group as the parent; wait for the group signal */
+        struct timespec ts = {5, 0};
+        while (!poked && nanosleep(&ts, &ts) == -1 && errno == EINTR) {}
+        _exit(poked ? 0 : 95);
+    }
+    struct timespec settle = {0, 200000000};
+    nanosleep(&settle, 0);
+    /* kill(0): every process in the caller's group, both of us */
+    if (kill(0, SIGUSR1)) return 192;
+    int status;
+    if (waitpid(child, &status, 0) != child) return 193;
+    if (!WIFEXITED(status) || WEXITSTATUS(status) != 0)
+        return 100 + (WIFEXITED(status) ? WEXITSTATUS(status) : 99);
+    if (!poked) return 194; /* the caller is part of its own group */
+    return 0;
+}
+"""
+
+
+def test_sessions_and_group_inheritance(tmp_path):
+    _run(tmp_path, "tsess", SESSIONS_C)
+
+
+def test_kill_zero_signals_whole_group(tmp_path):
+    _run(tmp_path, "tgkill0", GROUP_KILL_C)
