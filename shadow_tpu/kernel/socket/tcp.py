@@ -21,6 +21,7 @@ The wrapper converts between wire `Packet`s (addressed) and protocol
 
 from __future__ import annotations
 
+import dataclasses
 from collections import deque
 from typing import Optional
 
@@ -94,16 +95,37 @@ class _ConnDeps:
 
 
 class TcpSocket(StatefulFile):
+    # Linux sysctl ceilings the reference hardcodes (`definitions.h:32-37`)
+    RMEM_MAX = 6291456
+    WMEM_MAX = 4194304
+    SND_UNIT = 2404  # per-segment send-mem estimate (`tcp.c` autotune)
+
     def __init__(self, host, config: Optional[TcpConfig] = None):
         super().__init__(FileState.ACTIVE)
         self._host = host
+        exp = getattr(host, "config_experimental", None)
         if config is None:
-            exp = getattr(host, "config_experimental", None)
             config = TcpConfig(
                 send_buffer=getattr(exp, "socket_send_buffer", 131072),
                 recv_buffer=getattr(exp, "socket_recv_buffer", 174760),
             )
+        else:
+            # never mutate a caller-supplied template (the listener
+            # passes its _config to every accepted child)
+            config = dataclasses.replace(config)
         self._config = config
+        # dynamic buffer autotuning (`tcp.c:587-649`): receive buffers
+        # track 2x the bytes the app drains per smoothed RTT; send
+        # buffers track the congestion window. setsockopt SO_RCVBUF/
+        # SO_SNDBUF disables the respective direction, like Linux.
+        self.autotune_recv = bool(getattr(exp, "socket_recv_autotune", True))
+        self.autotune_send = bool(getattr(exp, "socket_send_autotune", True))
+        self._at_bytes_copied = 0
+        self._at_space = 0
+        self._at_last_adjust: Optional[int] = None
+        if self.autotune_recv and config.wscale_buffer is None:
+            # wscale must cover where autotune may take the buffer
+            config.wscale_buffer = self.RMEM_MAX
         self.conn: Optional[TcpConnection] = None  # None while unconnected/listening
         self.bound_addr: Optional[tuple[str, int]] = None
         self.peer_addr: Optional[tuple[str, int]] = None
@@ -190,7 +212,10 @@ class TcpSocket(StatefulFile):
         # exact 4-tuple association: replies route straight to this socket
         self._host.netns.associate(self, Protocol.TCP, self.bound_addr[0],
                                    self.bound_addr[1], peer=addr)
-        self.conn = TcpConnection(_ConnDeps(self), self._config)
+        # per-connection config copy: autotune growth must not leak into
+        # sibling sockets sharing the template
+        self.conn = TcpConnection(_ConnDeps(self),
+                                  dataclasses.replace(self._config))
         self.conn.open_active()
         self._pump_out()
         if self.nonblocking:
@@ -204,6 +229,8 @@ class TcpSocket(StatefulFile):
             raise errors.SyscallError(errors.EBADF)
         if self.conn is None:
             raise errors.SyscallError(errors.ENOTCONN)
+        if self.autotune_send:
+            self._autotune_send()
         try:
             n = self.conn.write(data)
         except TcpError as e:
@@ -231,9 +258,55 @@ class TcpSocket(StatefulFile):
                 raise errors.SyscallError(errors.EWOULDBLOCK)
             raise errors.Blocked(self, FileState.READABLE)
         if not peek:
+            if data and self.autotune_recv:
+                self._autotune_recv(len(data))
             self._pump_out()  # reads can reopen the advertised window
             self._refresh_state()
         return data
+
+    # -- buffer autotuning (`tcp.c:587-649`) ---------------------------
+
+    def _autotune_recv(self, bytes_copied: int) -> None:
+        """Input buffer tracks 2x the bytes the app drains per smoothed
+        RTT: fast drains grow the window toward RMEM_MAX."""
+        conn = self.conn
+        self._at_bytes_copied += bytes_copied
+        space = 2 * self._at_bytes_copied
+        if space > self._at_space:
+            self._at_space = space
+            new = min(space, self.RMEM_MAX)
+            if new > conn.config.recv_buffer:
+                conn.config.recv_buffer = new
+        now = self._host.now()
+        if self._at_last_adjust is None:
+            self._at_last_adjust = now
+        elif conn.rtt.srtt_ms > 0 and \
+                now - self._at_last_adjust > conn.rtt.srtt_ms * 1_000_000:
+            self._at_last_adjust = now
+            self._at_bytes_copied = 0
+
+    def _autotune_send(self) -> None:
+        """Output buffer tracks the congestion window (`tcp.c`'s
+        2404-bytes-per-demanded-segment estimate)."""
+        conn = self.conn
+        demanded = max(conn.cong.cwnd, 1)
+        new = min(self.SND_UNIT * 2 * demanded, self.WMEM_MAX)
+        if new > conn.config.send_buffer:
+            conn.config.send_buffer = new
+
+    def set_buffer_size(self, direction: str, size: int) -> None:
+        """SO_SNDBUF/SO_RCVBUF: Linux clamps the request to the sysctl
+        ceiling as a u32 (so -1 means "the max"), doubles it, and pins it
+        (disabling that direction's autotuning)."""
+        cap = self.RMEM_MAX if direction == "recv" else self.WMEM_MAX
+        size = max(4096, min(size & 0xFFFFFFFF, cap) * 2)
+        target = self.conn.config if self.conn is not None else self._config
+        if direction == "recv":
+            self.autotune_recv = False
+            self._config.recv_buffer = target.recv_buffer = size
+        else:
+            self.autotune_send = False
+            self._config.send_buffer = target.send_buffer = size
 
     def close(self) -> None:
         if self._app_closed:
@@ -312,8 +385,13 @@ class TcpSocket(StatefulFile):
         child.bound_addr = local
         child.peer_addr = key
         child._listener = self
+        # Linux copies the buffer-lock flags to accepted sockets: an
+        # explicit SO_*BUF pin on the listener binds its children too
+        child.autotune_recv = self.autotune_recv
+        child.autotune_send = self.autotune_send
         self._host.netns.associate(child, Protocol.TCP, local[0], local[1], peer=key)
-        child.conn = TcpConnection(_ConnDeps(child), self._config)
+        child.conn = TcpConnection(_ConnDeps(child),
+                                   dataclasses.replace(self._config))
         child.conn.open_passive(seg)
         self._pending_children[key] = child
         child._pump_out()

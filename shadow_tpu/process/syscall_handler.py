@@ -560,8 +560,17 @@ class SyscallHandler:
         return 0
 
     def _sys_setsockopt(self, args, ctx) -> int:
-        self._file(args[0])  # EBADF check
-        # SO_REUSEADDR / TCP_NODELAY / buffer sizes: accepted, not modeled
+        sock = self._file(args[0])  # EBADF check
+        level, optname = _i32(args[1]), _i32(args[2])
+        if level == SOL_SOCKET and optname in (SO_SNDBUF, SO_RCVBUF) \
+                and args[3] and args[4] >= 4:
+            # read as the kernel does (u32 comparison against the
+            # ceiling): -1 is the "give me the max" idiom, not an error
+            (value,) = struct.unpack("<I", self.mem.read(args[3], 4))
+            setter = getattr(sock, "set_buffer_size", None)
+            if setter is not None:  # TCP: pins size, disables autotune
+                setter("send" if optname == SO_SNDBUF else "recv", value)
+        # SO_REUSEADDR / TCP_NODELAY / the rest: accepted, not modeled
         return 0
 
     def _sys_getsockopt(self, args, ctx) -> int:
@@ -576,7 +585,13 @@ class SyscallHandler:
             self._write_int_opt(optval, optlen_ptr, err)
             return 0
         if level == SOL_SOCKET and optname in (SO_SNDBUF, SO_RCVBUF):
-            self._write_int_opt(optval, optlen_ptr, 131072)
+            value = 131072
+            cfg = getattr(getattr(sock, "conn", None), "config", None) \
+                or getattr(sock, "_config", None)
+            if cfg is not None:
+                value = (cfg.send_buffer if optname == SO_SNDBUF
+                         else cfg.recv_buffer)
+            self._write_int_opt(optval, optlen_ptr, value)
             return 0
         self._write_int_opt(optval, optlen_ptr, 0)
         return 0
@@ -1728,6 +1743,8 @@ class SyscallHandler:
             target, sig = _i64(args[0]), _i32(args[1])
         else:  # tgkill(tgid, tid, sig): process-granularity delivery
             target, sig = _i64(args[0]), _i32(args[2])
+            if target <= 0:
+                raise errors.SyscallError(errors.EINVAL)
         if nr == SYS_kill and target <= 0:
             # group forms — including -pid of a group leader, which
             # addresses the whole group (fork children included), not
@@ -1785,16 +1802,8 @@ class SyscallHandler:
 
     def _target_process(self, vpid: int):
         """Positive-pid lookup (kill's <=0 group forms route through
-        _group_targets; tgkill with tgid <= 0 is an error)."""
-        proc = self.process
-        if vpid <= 0:
-            return None
-        if vpid == proc.pid:
-            return proc
-        for other in getattr(self.host, "processes", []):
-            if getattr(other, "pid", None) == vpid and other.is_alive:
-                return other
-        return None
+        _group_targets; tgkill rejects tgid <= 0 before this)."""
+        return None if vpid <= 0 else self._proc_by_vpid(vpid)
 
     def _sys_kill(self, args, ctx) -> int:
         return self._sys_kill_family(args, ctx, SYS_kill)
@@ -1829,11 +1838,11 @@ class SyscallHandler:
         proc = self._proc_by_vpid(pid)
         if proc is None:
             raise errors.SyscallError(errors.ESRCH)
-        # POSIX: only self or our children may be moved, and a session
-        # leader's group may never change
+        # POSIX: only self or our children may be moved (ESRCH for an
+        # unrelated pid), and a session leader's group may never change
         if proc is not self.process \
                 and getattr(proc, "parent", None) is not self.process:
-            raise errors.SyscallError(errors.EPERM)
+            raise errors.SyscallError(errors.ESRCH)
         if getattr(proc, "sid", proc.pid) == proc.pid:
             raise errors.SyscallError(errors.EPERM)
         target_pgid = pgid or proc.pid

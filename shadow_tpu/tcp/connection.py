@@ -93,6 +93,10 @@ class TcpConfig:
     mss: int = MSS
     send_buffer: int = 131072
     recv_buffer: int = 174760
+    # wscale is fixed at SYN time; when buffers may grow later (socket
+    # autotuning), this names the ceiling the scale should cover (None =
+    # recv_buffer, the static-buffer behavior)
+    wscale_buffer: Optional[int] = None
     window_scaling: bool = True
     nagle: bool = False  # reference disables Nagle's algorithm
     sack: bool = True  # RFC 2018 selective acknowledgment
@@ -264,7 +268,8 @@ class TcpConnection:
         self._wscale_ok = False  # both sides negotiated scaling
         if self.config.window_scaling:
             ws = 0
-            while (self.config.recv_buffer >> ws) > 0xFFFF and ws < MAX_WSCALE:
+            cover = self.config.wscale_buffer or self.config.recv_buffer
+            while (cover >> ws) > 0xFFFF and ws < MAX_WSCALE:
                 ws += 1
             self.my_wscale = ws
         self._last_ts_recv = 0  # peer timestamp to echo

@@ -326,3 +326,52 @@ def test_recv_peek_does_not_consume():
     assert w.b.readable_bytes() == 8
     assert w.b.read(100) == b"peekaboo"  # consuming read
     assert w.b.peek(100) == b""
+
+
+def test_recv_buffer_autotunes_toward_rmem_max():
+    """`tcp.c:587-614`: an app draining data quickly grows its receive
+    buffer (2x bytes-copied-per-RTT), advertising bigger windows."""
+    cfg = load_config_str(SWITCH_CONFIG.format(stop="20s", seed=9))
+    payload = bytes(i % 251 for i in range(2_000_000))
+    server, client, stats = run_transfer(cfg, payload)
+    assert bytes(server.received) == payload
+    conn = server.accepted.conn
+    assert conn.config.recv_buffer > 174760  # grew past the default
+    assert conn.config.recv_buffer <= TcpSocket.RMEM_MAX
+    # wscale was negotiated to cover autotune headroom, not just the
+    # initial buffer
+    assert conn.my_wscale >= 7  # covers 6 MiB
+
+
+def test_send_buffer_autotunes_with_cwnd():
+    cfg = load_config_str(SWITCH_CONFIG.format(stop="20s", seed=9))
+    payload = bytes(i % 17 for i in range(2_000_000))
+    _server, client, _stats = run_transfer(cfg, payload)
+    conn = client.sock.conn
+    assert conn.config.send_buffer > 131072
+    assert conn.config.send_buffer <= TcpSocket.WMEM_MAX
+
+
+def test_autotune_disabled_keeps_buffers_static():
+    cfg = load_config_str(SWITCH_CONFIG.format(stop="20s", seed=9) + """
+experimental:
+  socket_recv_autotune: false
+  socket_send_autotune: false
+""")
+    payload = bytes(i % 251 for i in range(1_000_000))
+    server, client, stats = run_transfer(cfg, payload)
+    assert bytes(server.received) == payload
+    assert server.accepted.conn.config.recv_buffer == 174760
+    assert client.sock.conn.config.send_buffer == 131072
+
+
+def test_setsockopt_pins_buffer_and_disables_autotune():
+    mgr = Manager(load_config_str(SWITCH_CONFIG.format(stop="1s", seed=9)))
+    host = mgr.hosts[0]
+    s = TcpSocket(host)
+    s.set_buffer_size("recv", 65536)
+    assert s.autotune_recv is False
+    assert s._config.recv_buffer == 131072  # Linux doubles the request
+    s.set_buffer_size("send", 32768)
+    assert s.autotune_send is False
+    assert s._config.send_buffer == 65536
