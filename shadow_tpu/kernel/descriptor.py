@@ -83,6 +83,14 @@ class DescriptorTable:
             except errors.SyscallError:
                 pass
 
+    def close_cloexec(self) -> None:
+        """execve(2): drop every descriptor opened with CLOEXEC."""
+        for fd in [f for f, e in self._table.items() if e.cloexec]:
+            try:
+                self.close(fd)
+            except errors.SyscallError:
+                pass
+
     def fork_into(self) -> "DescriptorTable":
         """fork(2) semantics: the child gets its own fd table whose entries
         reference the same open files (shared offsets/state)."""

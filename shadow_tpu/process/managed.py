@@ -34,6 +34,7 @@ from ..core import simtime
 from ..core import worker as worker_mod
 from ..core.event import TaskRef
 from ..kernel import errors as kerrors
+from ..kernel import futex as kfutex
 from ..kernel.status import FileState, StatefulFile
 from .condition import SysCallCondition
 from .memory import MAPPING_SYSCALLS, MemoryRegions
@@ -81,6 +82,7 @@ SYS_getpid = 39
 SYS_nanosleep = 35
 SYS_clone = 56
 SYS_fork = 57
+SYS_execve = 59
 SYS_exit = 60
 SYS_kill = 62
 SYS_gettimeofday = 96
@@ -498,15 +500,19 @@ class ManagedSimProcess:
 
     # -- lifecycle ------------------------------------------------------
 
-    def spawn(self) -> None:
-        assert self.state == ProcessState.PENDING
+    def _launch_native(self, argv: list[str],
+                       app_env: Optional[dict] = None,
+                       executable: Optional[str] = None) -> None:
+        """Start (or restart, for execve) the native process with the
+        shim environment: fresh IPC channel, main thread, clock block,
+        memory/region plumbing, and the death watcher."""
         if not os.path.exists(SHIM_PATH):
             from .. import interpose
 
             interpose.build()
         self.ipc = IpcChannel.create()
         self.threads = [ManagedThread(self, self.ipc, is_main=True)]
-        env = dict(os.environ)
+        env = dict(os.environ) if app_env is None else dict(app_env)
         preload = env.get("LD_PRELOAD", "")
         use_ssl_rng = bool(getattr(
             getattr(self.host, "config_experimental", None),
@@ -527,14 +533,14 @@ class ManagedSimProcess:
             simtime.EMUTIME_SIMULATION_START_UNIX_NS, latency
         )
         env["SHADOW_TPU_SHMEM_HANDLE"] = self.proc_clock.serialize()
-        if self._output_dir:
+        if self._output_dir and self._stdout is None:
             os.makedirs(self._output_dir, exist_ok=True)
             self._stdout = open(os.path.join(self._output_dir,
                                              f"{self.name}.stdout"), "wb")
             self._stderr = open(os.path.join(self._output_dir,
                                              f"{self.name}.stderr"), "wb")
         self.proc = subprocess.Popen(
-            self.argv, env=env,
+            argv, env=env, executable=executable,
             stdout=self._stdout or subprocess.DEVNULL,
             stderr=self._stderr or subprocess.DEVNULL,
         )
@@ -551,6 +557,10 @@ class ManagedSimProcess:
         from .pidwatcher import get_watcher
 
         get_watcher().watch(self.proc.pid, self._on_child_death)
+
+    def spawn(self) -> None:
+        assert self.state == ProcessState.PENDING
+        self._launch_native(self.argv)
         self._resume(self.threads[0])
 
     def stop(self, signal_nr: int = 15) -> None:
@@ -865,9 +875,158 @@ class ManagedSimProcess:
             if nr in (SYS_fork, SYS_clone):
                 self._begin_fork(thread, nr, args)
                 continue
+            if nr == SYS_execve:
+                if self._begin_exec(thread, args):
+                    return  # old incarnation retired; new one resumed
+                continue
 
             if self._handle_syscall_event(thread, nr, args):
                 return  # parked on a condition; no reply yet
+
+    # -- execve ----------------------------------------------------------
+
+    def _read_cstr(self, addr: int, cap: int = 4096) -> bytes:
+        """NUL-terminated string from process memory, chunk-read so a
+        string near an unmapped page boundary still resolves."""
+        out = b""
+        chunk = 256
+        while len(out) < cap:
+            take = min(chunk, cap - len(out))
+            try:
+                data = self.handler.mem.read(addr + len(out), take)
+            except OSError:
+                if chunk > 1:
+                    chunk = 1
+                    continue
+                raise
+            nul = data.find(b"\x00")
+            if nul >= 0:
+                return out + data[:nul]
+            out += data
+        return out
+
+    def _read_cstr_array(self, addr: int, cap: int = 1024) -> list[bytes]:
+        out = []
+        for i in range(cap):
+            (ptr,) = struct.unpack(
+                "<Q", self.handler.mem.read(addr + 8 * i, 8))
+            if ptr == 0:
+                return out
+            out.append(self._read_cstr(ptr))
+        return out
+
+    def _begin_exec(self, thread: ManagedThread, args) -> bool:
+        """execve(2): replace this process's native image while keeping
+        its simulator identity — pid/pgid/sid, descriptor table (minus
+        CLOEXEC), itimers, and the blocked-signal mask survive; caught
+        signal dispositions reset to default; sibling threads die
+        (`handler/unistd.rs:777` execve_common). Returns True when the
+        old incarnation is retired (exec never returns on success)."""
+        import errno as _errno
+
+        def fail(err: int) -> bool:
+            self._strace(thread, SYS_execve, args, -err)
+            self._reply_complete(thread, -err)
+            return False
+
+        try:
+            path = self._read_cstr(args[0]).decode("utf-8", "surrogateescape")
+            # NULL argv/envp are legal (empty vectors, `execve(2)`)
+            argv = [a.decode("utf-8", "surrogateescape")
+                    for a in self._read_cstr_array(args[1])] \
+                if args[1] else []
+            envp = [e.decode("utf-8", "surrogateescape")
+                    for e in self._read_cstr_array(args[2])] \
+                if args[2] else []
+        except OSError:
+            return fail(_errno.EFAULT)
+        # validate fully BEFORE retiring the old image — after the kill
+        # there is no process left to return an errno to
+        if os.path.isdir(path):
+            return fail(_errno.EISDIR)
+        if not os.path.exists(path):
+            return fail(_errno.ENOENT)
+        if not os.access(path, os.X_OK):
+            return fail(_errno.EACCES)
+        try:
+            with open(path, "rb") as fh:
+                magic = fh.read(4)
+        except OSError:
+            return fail(_errno.EACCES)
+        if not (magic.startswith(b"\x7fELF") or magic.startswith(b"#!")):
+            return fail(_errno.ENOEXEC)
+        self._strace(thread, SYS_execve, args, "<noreturn>")
+        saved_mask = thread.sig_blocked  # the exec'ing thread's mask
+
+        # retire the old native incarnation: no more death callbacks for
+        # the old pid, no replies to its shim — just kill and reap it
+        old_pid = self.server.native_pid
+        old_proc, self.proc = self.proc, None
+        from .pidwatcher import get_watcher
+
+        if old_pid:
+            get_watcher().unwatch(old_pid)
+        self._abort_pending_clone()  # a mid-handshake clone dies with us
+        self._cancel_all_parks()
+        with self._ipc_lock:
+            for t in self.threads:
+                t.dead = True
+                if t.ipc is not None:
+                    # the shim is about to be SIGKILLed and no worker is
+                    # mid-recv on these mappings: free, don't just close
+                    t.ipc.close()
+                    t.ipc.block.free()
+                    t.ipc = None
+        old_clock, self.proc_clock = self.proc_clock, None
+        if old_clock is not None:
+            old_clock.free()
+        if old_proc is not None:
+            old_proc.kill()
+            try:
+                old_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+        elif old_pid:  # forked child: not our direct native child
+            try:
+                os.kill(old_pid, 9)
+            except ProcessLookupError:
+                pass
+
+        # exec-time kernel state transitions
+        self.handler._table.close_cloexec()
+        self.handler.sig_actions = {
+            sig: act for sig, act in self.handler.sig_actions.items()
+            if act[0] == "ignore"  # ignores survive; handlers reset
+        }
+        self.handler.futexes = kfutex.FutexTable()  # fresh address space
+
+        # the app's envp, with the shim plumbing overlaid by _launch_native
+        app_env = {}
+        for entry in envp:
+            key, _, value = entry.partition("=")
+            if key:
+                app_env[key] = value
+        try:
+            self._launch_native(argv or [path], app_env=app_env,
+                                executable=path)
+        except OSError as e:
+            # residual exec failure past the preflight (e.g. wrong-arch
+            # ELF): the old image is already gone, so the process dies
+            # like a child whose exec failed post-fork
+            log.warning("%s: execve(%s) failed at spawn: %s",
+                        self.name, path, e)
+            self._exit_code = 127
+            self.exit_status = 127
+            self.state = ProcessState.EXITED
+            for t in self.threads:
+                t.dead = True
+            self._close_descriptors()
+            self._cleanup()
+            self._notify_parent()
+            return True
+        self.threads[0].sig_blocked = saved_mask  # mask survives exec
+        self._resume(self.threads[0])
+        return True
 
     # -- clone / fork handshakes ----------------------------------------
 

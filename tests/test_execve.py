@@ -1,0 +1,197 @@
+"""execve(2) for managed processes: image replacement with simulator
+identity preserved.
+
+Parity: reference `handler/unistd.rs:777` execve_common — pid and fd
+table survive, CLOEXEC descriptors drop, caught dispositions reset,
+exec'd code runs under the same interposition plane.
+"""
+
+import shutil
+import subprocess
+
+import pytest
+
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.manager import Manager
+
+CC = shutil.which("gcc") or shutil.which("cc")
+
+pytestmark = pytest.mark.skipif(CC is None, reason="no C compiler")
+
+
+def _compile(tmp_path, name, src):
+    c = tmp_path / f"{name}.c"
+    c.write_text(src)
+    binary = tmp_path / name
+    subprocess.run([CC, "-O1", "-o", str(binary), str(c)], check=True)
+    return str(binary)
+
+
+def _run(binary, args=(), expect="{exited: 0}", stop="30s"):
+    arglist = ", ".join(f'"{a}"' for a in args)
+    cfg = load_config_str(f"""
+general: {{stop_time: {stop}, seed: 3}}
+network:
+  graph: {{type: 1_gbit_switch}}
+hosts:
+  alpha:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, args: [{arglist}], start_time: 1s,
+       expected_final_state: {expect}}}
+""")
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
+
+
+HELPER_C = r"""
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    /* argv[1]: expected env marker; argv[2] (optional): inherited fd */
+    const char *marker = getenv("SHADOW_EXEC_MARKER");
+    if (!marker || strcmp(marker, argv[1])) return 60;
+    if (argc > 2) {
+        /* the pre-exec UDP socket must still exist, still bound */
+        int fd = atoi(argv[2]);
+        struct sockaddr_in a;
+        socklen_t alen = sizeof a;
+        if (getsockname(fd, (struct sockaddr *)&a, &alen)) return 61;
+        if (ntohs(a.sin_port) != 7200) return 62;
+    }
+    /* and the simulated clock keeps ticking for the new image */
+    struct timespec ts = {0, 50000000};
+    nanosleep(&ts, 0);
+    return 7;
+}
+"""
+
+
+EXEC_C = r"""
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <signal.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    /* a bound UDP socket without CLOEXEC must survive the exec */
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    struct sockaddr_in a;
+    memset(&a, 0, sizeof a);
+    a.sin_family = AF_INET;
+    a.sin_port = htons(7200);
+    a.sin_addr.s_addr = INADDR_ANY;
+    if (bind(fd, (struct sockaddr *)&a, sizeof a)) return 70;
+    char fdbuf[16];
+    snprintf(fdbuf, sizeof fdbuf, "%d", fd);
+    char *args[] = {argv[1], "42", fdbuf, 0};
+    char *envp[] = {"SHADOW_EXEC_MARKER=42", 0};
+    execve(argv[1], args, envp);
+    return 71; /* exec returned: failure */
+}
+"""
+
+
+FORK_EXEC_C = r"""
+#include <string.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    pid_t child = fork();
+    if (child < 0) return 75;
+    if (child == 0) {
+        char *args[] = {argv[1], "m1", 0};
+        char *envp[] = {"SHADOW_EXEC_MARKER=m1", 0};
+        execve(argv[1], args, envp);
+        _exit(76);
+    }
+    int status;
+    if (waitpid(child, &status, 0) != child) return 77;
+    if (!WIFEXITED(status) || WEXITSTATUS(status) != 7)
+        return 100 + (WIFEXITED(status) ? WEXITSTATUS(status) : 99);
+    return 0;
+}
+"""
+
+
+BAD_EXEC_C = r"""
+#include <errno.h>
+#include <unistd.h>
+
+int main(void) {
+    char *args[] = {"nope", 0};
+    char *envp[] = {0};
+    if (execve("/nonexistent/binary", args, envp) != -1 || errno != ENOENT)
+        return 78;
+    return 0; /* exec failure returns and the process continues */
+}
+"""
+
+
+def test_execve_replaces_image_keeps_identity(tmp_path):
+    """The exec'd image runs under the sim with the same virtual process:
+    env passes through, the non-CLOEXEC socket survives with its binding,
+    and the final state reflects the NEW image's exit."""
+    helper = _compile(tmp_path, "xhelper", HELPER_C)
+    execer = _compile(tmp_path, "xexec", EXEC_C)
+    _run(execer, args=[helper], expect="{exited: 7}")
+
+
+def test_fork_then_exec_waitpid_roundtrip(tmp_path):
+    """fork + execve + waitpid — THE process-spawning idiom."""
+    helper = _compile(tmp_path, "xhelper2", HELPER_C)
+    forker = _compile(tmp_path, "xforker", FORK_EXEC_C)
+    _run(forker, args=[helper])
+
+
+def test_execve_failure_returns_enoent(tmp_path):
+    _run(_compile(tmp_path, "xbad", BAD_EXEC_C))
+
+
+def test_execve_enoexec_returns_to_caller(tmp_path):
+    """A file with the exec bit but no valid format (no ELF magic, no
+    shebang) must fail with ENOEXEC BEFORE the old image is torn down —
+    the caller continues."""
+    import os
+
+    junk = tmp_path / "junk"
+    junk.write_text("just text, no shebang\n")
+    os.chmod(junk, 0o755)
+    src = r"""
+#include <errno.h>
+#include <unistd.h>
+int main(int argc, char **argv) {
+    char *args[] = {argv[1], 0};
+    char *envp[] = {0};
+    if (execve(argv[1], args, envp) != -1 || errno != ENOEXEC) return 79;
+    return 0;
+}
+"""
+    binary = _compile(tmp_path, "xjunk", src)
+    _run(binary, args=[str(junk)])
+
+
+def test_execve_null_argv_envp(tmp_path):
+    """execve(path, NULL, NULL) is legal on Linux: empty vectors."""
+    helper = _compile(tmp_path, "xnull_t", r"""
+int main(void) { return 7; }
+""")
+    src = r"""
+#include <unistd.h>
+int main(int argc, char **argv) {
+    execve(argv[1], 0, 0);
+    return 71;
+}
+"""
+    binary = _compile(tmp_path, "xnull", src)
+    _run(binary, args=[helper], expect="{exited: 7}")
