@@ -582,9 +582,12 @@ extern "C" long shadow_tpu_api_syscall(long nr, long a, long b, long c,
                         (uint64_t)d, (uint64_t)e, (uint64_t)f};
     long fast;
     if (shim_try_time_fastpath(nr, args, &fast)) return fast;
-    if (nr == SYS_fork || (nr == SYS_clone && !(args[0] & CLONE_VM)))
-        return shim_handle_fork(nr, args);
-    if (nr == SYS_clone || nr == SYS_clone3 || nr == SYS_vfork)
+    if (nr == SYS_fork || nr == SYS_vfork
+        || (nr == SYS_clone && !(args[0] & CLONE_VM)))
+        /* vfork-as-fork is POSIX-legal; vfork-safe children only
+         * exec/_exit, so copy semantics are indistinguishable here */
+        return shim_handle_fork(nr == SYS_vfork ? SYS_fork : nr, args);
+    if (nr == SYS_clone || nr == SYS_clone3)
         return -38; /* thread clone needs the trapped registers: ENOSYS */
     return shim_emulate_syscall(nr, args);
 }
@@ -604,8 +607,8 @@ static void shim_sigsys_handler(int sig, siginfo_t *info, void *ucontext) {
         regs[REG_RAX] = fast_ret;
         return;
     }
-    if (nr == SYS_clone3 || nr == SYS_vfork) {
-        /* ENOSYS: glibc falls back to plain clone / fork semantics */
+    if (nr == SYS_clone3) {
+        /* ENOSYS: glibc falls back to plain clone */
         regs[REG_RAX] = -38;
         return;
     }
@@ -613,8 +616,11 @@ static void shim_sigsys_handler(int sig, siginfo_t *info, void *ucontext) {
         regs[REG_RAX] = shim_handle_clone_thread(args, regs);
         return;
     }
-    if (nr == SYS_fork || nr == SYS_clone) {
-        regs[REG_RAX] = shim_handle_fork(nr, args);
+    if (nr == SYS_fork || nr == SYS_vfork || nr == SYS_clone) {
+        /* vfork-as-fork (POSIX-legal: vfork-safe children only
+         * exec/_exit before the parent observes anything) */
+        regs[REG_RAX] = shim_handle_fork(
+            nr == SYS_vfork ? SYS_fork : nr, args);
         return;
     }
     regs[REG_RAX] = shim_emulate_syscall(nr, args);

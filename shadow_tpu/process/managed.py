@@ -92,6 +92,7 @@ SYS_clock_nanosleep = 230
 SYS_exit_group = 231
 
 CLONE_VM = 0x100
+CLONE_VFORK = 0x4000
 CLONE_CHILD_CLEARTID = 0x200000
 
 
@@ -326,7 +327,7 @@ class ManagedThread:
                  "futex_waiter", "wait_epoll",
                  "ctid_addr", "dead", "is_main", "tindex", "sig_blocked",
                  "sigwait_set", "sigwait_info_ptr", "suspend_saved",
-                 "pinned_cpu")
+                 "pinned_cpu", "vfork_child")
 
     def __init__(self, process, ipc, is_main: bool = False):
         self.process = process
@@ -341,6 +342,10 @@ class ManagedThread:
         self.sigwait_info_ptr = 0  # its siginfo output pointer
         self.suspend_saved = None  # pre-sigsuspend mask to restore
         self.pinned_cpu = None  # last CPU this native thread was pinned to
+        # posix_spawn/system(3): the VM-sharing helper "thread" is really
+        # a vfork child-to-be; this is the placeholder process its
+        # execve (or _exit) materializes/finalizes
+        self.vfork_child: Optional["ManagedSimProcess"] = None
         self.futex_waiter = None
         self.wait_epoll = None
         self.ctid_addr = 0
@@ -466,6 +471,42 @@ class ManagedSimProcess:
         self.sid = parent.sid
         parent.children.append(self)
         return self
+
+    @classmethod
+    def vfork_placeholder(cls, parent: "ManagedSimProcess") \
+            -> "ManagedSimProcess":
+        """The simulator-side identity of a posix_spawn/system(3) helper:
+        a child process that exists from the app's point of view (clone
+        returned its pid) but whose own image only arrives at execve.
+        Until then its syscalls run through the PARENT's handler (shared
+        VM and fd table — true vfork semantics)."""
+        self = cls.__new__(cls)
+        parent._fork_counter = getattr(parent, "_fork_counter", 0)
+        ix = parent._fork_counter
+        parent._fork_counter += 1
+        self._init_common(parent.host, f"{parent.name}.spawn{ix}",
+                          parent.argv, output_dir=parent._output_dir)
+        self.state = ProcessState.RUNNING
+        self.handler = None  # materialized at exec (fd snapshot then)
+        self.pgid = parent.pgid
+        self.sid = parent.sid
+        self.parent = parent
+        self._vfork_parent_wait = None  # (thread, retval) once suspended
+        from .strace import make_logger
+
+        self._strace_mode = getattr(parent, "_strace_mode", "off")
+        self.strace = make_logger(self._output_dir, self.name,
+                                  self._strace_mode)
+        parent.children.append(self)
+        return self
+
+    def _erase_placeholder(self) -> None:
+        """A vfork clone that failed natively: the placeholder was never
+        observable (clone returned an error), so remove every trace."""
+        if self.parent is not None and self in self.parent.children:
+            self.parent.children.remove(self)
+        if self in self.host.processes:
+            self.host.processes.remove(self)
 
     def _abort_fork(self) -> None:
         """The native fork failed: erase the phantom child entirely —
@@ -617,8 +658,8 @@ class ManagedSimProcess:
         (`kill -9 $$` may never reach its own exit), so it forwards
         natively right away — the death/handler lands at the caller's own
         kill() call, a precise simulated instant."""
-        if self.state != ProcessState.RUNNING:
-            return
+        if self.state != ProcessState.RUNNING or self.handler is None:
+            return  # handler None: vfork placeholder awaiting its exec
         # a parked sigwait consumes the signal without running a handler
         # (`rt_sigtimedwait(2)`) — checked before disposition since
         # sigwait catches ignored and default-disposition signals alike,
@@ -841,6 +882,12 @@ class ManagedSimProcess:
             else:
                 ev = thread.ipc.recv_from_shim()
             if ev is None:
+                if thread.vfork_child is not None:
+                    # only the spawn helper's native process died, not
+                    # ours: finalize the vfork child, keep the parent
+                    self._finalize_vfork_helper(thread, None,
+                                                kill_signal=9)
+                    return
                 self._reap()
                 return
             if ev.kind == EVENT_START_RES:
@@ -852,8 +899,9 @@ class ManagedSimProcess:
                 self._death_seen = True
                 continue
             if ev.kind == EVENT_ADD_THREAD_RES:
-                self._finish_clone(
-                    thread, int(ev.u.add_thread_res.child_native_tid))
+                if self._finish_clone(
+                        thread, int(ev.u.add_thread_res.child_native_tid)):
+                    return  # vfork: parent parked until child exec/exit
                 continue
             if ev.kind != EVENT_SYSCALL:
                 continue
@@ -876,6 +924,10 @@ class ManagedSimProcess:
                 self._begin_fork(thread, nr, args)
                 continue
             if nr == SYS_execve:
+                if thread.vfork_child is not None:
+                    if self._exec_vfork_child(thread, args):
+                        return  # helper retired; child process launched
+                    continue
                 if self._begin_exec(thread, args):
                     return  # old incarnation retired; new one resumed
                 continue
@@ -915,19 +967,12 @@ class ManagedSimProcess:
             out.append(self._read_cstr(ptr))
         return out
 
-    def _begin_exec(self, thread: ManagedThread, args) -> bool:
-        """execve(2): replace this process's native image while keeping
-        its simulator identity — pid/pgid/sid, descriptor table (minus
-        CLOEXEC), itimers, and the blocked-signal mask survive; caught
-        signal dispositions reset to default; sibling threads die
-        (`handler/unistd.rs:777` execve_common). Returns True when the
-        old incarnation is retired (exec never returns on success)."""
+    def _read_exec_request(self, thread: ManagedThread, args):
+        """Read and validate an execve request from process memory.
+        Returns (path, argv, app_env) on success, or an int errno; the
+        validation happens fully BEFORE any image teardown — after a
+        kill there is no process left to return an errno to."""
         import errno as _errno
-
-        def fail(err: int) -> bool:
-            self._strace(thread, SYS_execve, args, -err)
-            self._reply_complete(thread, -err)
-            return False
 
         try:
             path = self._read_cstr(args[0]).decode("utf-8", "surrogateescape")
@@ -939,22 +984,40 @@ class ManagedSimProcess:
                     for e in self._read_cstr_array(args[2])] \
                 if args[2] else []
         except OSError:
-            return fail(_errno.EFAULT)
-        # validate fully BEFORE retiring the old image — after the kill
-        # there is no process left to return an errno to
+            return _errno.EFAULT
         if os.path.isdir(path):
-            return fail(_errno.EISDIR)
+            return _errno.EISDIR
         if not os.path.exists(path):
-            return fail(_errno.ENOENT)
+            return _errno.ENOENT
         if not os.access(path, os.X_OK):
-            return fail(_errno.EACCES)
+            return _errno.EACCES
         try:
             with open(path, "rb") as fh:
                 magic = fh.read(4)
         except OSError:
-            return fail(_errno.EACCES)
+            return _errno.EACCES
         if not (magic.startswith(b"\x7fELF") or magic.startswith(b"#!")):
-            return fail(_errno.ENOEXEC)
+            return _errno.ENOEXEC
+        app_env = {}
+        for entry in envp:
+            key, _, value = entry.partition("=")
+            if key:
+                app_env[key] = value
+        return path, argv, app_env
+
+    def _begin_exec(self, thread: ManagedThread, args) -> bool:
+        """execve(2): replace this process's native image while keeping
+        its simulator identity — pid/pgid/sid, descriptor table (minus
+        CLOEXEC), itimers, and the blocked-signal mask survive; caught
+        signal dispositions reset to default; sibling threads die
+        (`handler/unistd.rs:777` execve_common). Returns True when the
+        old incarnation is retired (exec never returns on success)."""
+        req = self._read_exec_request(thread, args)
+        if isinstance(req, int):
+            self._strace(thread, SYS_execve, args, -req)
+            self._reply_complete(thread, -req)
+            return False
+        path, argv, app_env = req
         self._strace(thread, SYS_execve, args, "<noreturn>")
         saved_mask = thread.sig_blocked  # the exec'ing thread's mask
 
@@ -1000,12 +1063,6 @@ class ManagedSimProcess:
         }
         self.handler.futexes = kfutex.FutexTable()  # fresh address space
 
-        # the app's envp, with the shim plumbing overlaid by _launch_native
-        app_env = {}
-        for entry in envp:
-            key, _, value = entry.partition("=")
-            if key:
-                app_env[key] = value
         try:
             self._launch_native(argv or [path], app_env=app_env,
                                 executable=path)
@@ -1028,6 +1085,72 @@ class ManagedSimProcess:
         self._resume(self.threads[0])
         return True
 
+    def _exec_vfork_child(self, thread: ManagedThread, args) -> bool:
+        """execve from a posix_spawn/system(3) helper: the placeholder
+        child process materializes with the new image; the parent is
+        untouched. Readers go through the PARENT's memory (the helper
+        shares our VM)."""
+        req = self._read_exec_request(thread, args)
+        if isinstance(req, int):
+            self._strace(thread, SYS_execve, args, -req)
+            self._reply_complete(thread, -req)
+            return False
+        path, argv, app_env = req
+        self._strace(thread, SYS_execve, args, "<noreturn>")
+
+        child, thread.vfork_child = thread.vfork_child, None
+        # exec snapshot: the child's fd table is the parent's at this
+        # instant, minus CLOEXEC; handlers reset, ignores survive
+        child.handler = SyscallHandler(
+            child, table=self.handler._table.fork_into())
+        child.handler._table.close_cloexec()
+        child.handler.sig_actions = {
+            sig: act for sig, act in self.handler.sig_actions.items()
+            if act[0] == "ignore"
+        }
+
+        # retire the native helper (its own native process, shared VM)
+        helper_tid = thread.native_tid
+        thread.dead = True
+        with self._ipc_lock:
+            if thread.ipc is not None and thread.ipc is not self.ipc:
+                thread.ipc.close()
+                thread.ipc.block.free()
+                thread.ipc = None
+            if not thread.is_main and thread in self.threads:
+                self.threads.remove(thread)
+        if helper_tid:
+            from .pidwatcher import get_watcher
+
+            get_watcher().unwatch(helper_tid)
+            try:
+                os.kill(helper_tid, 9)
+            except ProcessLookupError:
+                pass
+
+        try:
+            child._launch_native(argv or [path], app_env=app_env,
+                                 executable=path)
+        except OSError as e:
+            log.warning("%s: spawn exec(%s) failed: %s",
+                        child.name, path, e)
+            child._exit_code = 127
+            child.exit_status = 127
+            child.state = ProcessState.EXITED
+            # drop the snapshot's file refs and any partially-created
+            # IPC/clock blocks — same teardown _begin_exec's twin does
+            child._close_descriptors()
+            child._cleanup()
+            child._notify_parent()
+            self._release_vfork_parent(child)
+            return True
+        child.threads[0].sig_blocked = thread.sig_blocked
+        self._release_vfork_parent(child)  # exec happened: parent resumes
+        self.host.schedule_task_with_delay(
+            TaskRef(lambda h: child._resume(child.threads[0]),
+                    "vfork-exec-start"), 0)
+        return True
+
     # -- clone / fork handshakes ----------------------------------------
 
     def _begin_clone_thread(self, thread: ManagedThread, args) -> None:
@@ -1036,6 +1159,13 @@ class ManagedSimProcess:
         child_ipc = IpcChannel.create()
         child = ManagedThread(self, child_ipc)
         child.sig_blocked = thread.sig_blocked  # mask inherits at clone
+        if args[0] & CLONE_VFORK:
+            # posix_spawn/system: natively a VM-sharing helper process
+            # (the shim strips VFORK and runs it like a thread); in the
+            # simulation it is a child PROCESS whose image arrives at its
+            # execve. Allocate its virtual pid now — that's the value the
+            # app's clone returns and later waitpid()s on.
+            child.vfork_child = ManagedSimProcess.vfork_placeholder(self)
         if args[0] & CLONE_CHILD_CLEARTID:
             child.ctid_addr = args[3]
         with self._ipc_lock:  # threads is read by the death watcher
@@ -1065,19 +1195,25 @@ class ManagedSimProcess:
         except OSError:
             pass
 
-    def _finish_clone(self, thread: ManagedThread, native_tid: int) -> None:
+    def _finish_clone(self, thread: ManagedThread, native_tid: int) -> bool:
+        """Returns True when the CALLER (the cloning thread) must park:
+        vfork semantics suspend the parent until the child execs or
+        exits — glibc's posix_spawn keeps the spawn args on the parent's
+        stack frame and relies on that suspension."""
         pending, self._pending_clone = self._pending_clone, None
         call, self._pending_clone_call = (
             getattr(self, "_pending_clone_call", None), None)
         if call is not None:
             retval = native_tid
-            if native_tid >= 0 and not isinstance(pending, ManagedThread) \
-                    and pending is not None:
-                retval = pending.pid  # the app sees the virtual child pid
+            if native_tid >= 0 and pending is not None:
+                if not isinstance(pending, ManagedThread):
+                    retval = pending.pid  # app sees the virtual child pid
+                elif pending.vfork_child is not None:
+                    retval = pending.vfork_child.pid  # vfork child's vpid
             self._strace(thread, call[0], call[1], retval)
         if pending is None:
             self._reply_complete(thread, -kerrors.EINVAL)
-            return
+            return False
         if isinstance(pending, ManagedThread):
             if native_tid < 0:  # native clone failed
                 with self._ipc_lock:  # vs the death watcher's close sweep
@@ -1085,24 +1221,58 @@ class ManagedSimProcess:
                     pending.ipc.close()
                     pending.ipc.block.free()
                     pending.ipc = None
+                if pending.vfork_child is not None:
+                    pending.vfork_child._erase_placeholder()
                 self._reply_complete(thread, native_tid)
-                return
+                return False
             pending.native_tid = native_tid
             self.host.schedule_task_with_delay(
                 TaskRef(lambda h, c=pending: self._start_thread(c),
                         "thread-start"), 0,
             )
-            # native tids stay visible to the app (glibc already stored
-            # this value in its pthread struct via CLONE_PARENT_SETTID)
+            if pending.vfork_child is not None:
+                # the vfork helper is natively its own process; the
+                # PARENT thread stays suspended (no reply) until the
+                # child execs or exits — true vfork semantics. Watch the
+                # helper's native process: a silent death (segfault,
+                # external kill) must not wedge the recv loop.
+                pending.vfork_child.server.native_pid = native_tid
+                pending.vfork_child._vfork_parent_wait = (
+                    thread, pending.vfork_child.pid)
+                from .pidwatcher import get_watcher
+
+                get_watcher().watch(
+                    native_tid,
+                    lambda t=pending: self._on_vfork_helper_death(t))
+                return True
+            # native tids stay visible to the app (glibc already
+            # stored this value via CLONE_PARENT_SETTID)
             self._reply_complete(thread, native_tid)
+            return False
         else:  # forked child process
             if native_tid < 0:
                 pending._abort_fork()
                 self._reply_complete(thread, native_tid)
-                return
+                return False
             pending._finish_fork(native_tid)
             # the app sees the VIRTUAL child pid (wait4/kill use it)
             self._reply_complete(thread, pending.pid)
+            return False
+
+    def _release_vfork_parent(self, child: "ManagedSimProcess") -> None:
+        """The vfork child exec'd or exited: wake the suspended parent
+        thread with the child's pid as the clone retval."""
+        waiter = getattr(child, "_vfork_parent_wait", None)
+        child._vfork_parent_wait = None
+        if waiter is None:
+            return
+        parent_thread, retval = waiter
+        if parent_thread.dead or self.state != ProcessState.RUNNING:
+            return
+        self._reply_complete(parent_thread, retval)
+        self.host.schedule_task_with_delay(
+            TaskRef(lambda h: self._resume(parent_thread),
+                    "vfork-parent-resume"), 0)
 
     def _start_thread(self, child: ManagedThread) -> None:
         """Host task: first resume of a cloned thread (or forked child's
@@ -1139,9 +1309,57 @@ class ManagedSimProcess:
 
     # -- exits -----------------------------------------------------------
 
+    def _finalize_vfork_helper(self, thread: ManagedThread,
+                               exit_code: Optional[int],
+                               kill_signal: Optional[int] = None) -> None:
+        """A posix_spawn helper left WITHOUT exec (its _exit(127) after a
+        failed exec arrives as exit_group, or it died natively): only the
+        vfork CHILD dies; the parent process is untouched."""
+        child, thread.vfork_child = thread.vfork_child, None
+        if kill_signal is not None:
+            child.kill_signal = kill_signal
+            child.state = ProcessState.KILLED
+        else:
+            child._exit_code = _i32_exit(exit_code or 0)
+            child.exit_status = child._exit_code
+            child.state = ProcessState.EXITED
+        child._notify_parent()
+        self._release_vfork_parent(child)
+        thread.dead = True
+        if thread.native_tid:
+            from .pidwatcher import get_watcher
+
+            get_watcher().unwatch(thread.native_tid)
+        with self._ipc_lock:
+            if thread.ipc is not None and thread.ipc is not self.ipc:
+                thread.ipc.close()
+                thread.ipc.block.free()
+                thread.ipc = None
+            if not thread.is_main and thread in self.threads:
+                self.threads.remove(thread)
+
+    def _on_vfork_helper_death(self, thread: ManagedThread) -> None:
+        """Watcher-thread callback: the helper's native process died
+        without an event (segfault/external kill). Close its channel so
+        a blocked recv returns, and finalize from a worker task."""
+        with self._ipc_lock:
+            if thread.ipc is not None:
+                thread.ipc.close()
+        self.host.post_cross_thread_task(TaskRef(
+            lambda h: (self._finalize_vfork_helper(thread, None,
+                                                   kill_signal=9)
+                       if thread.vfork_child is not None else None),
+            "vfork-helper-reap"))
+
     def _handle_exit_group(self, thread: ManagedThread, args) -> None:
         """exit_group: close simulated descriptors (FINs go out, ports
         free), record the exit code, and let the native exit run."""
+        if thread.vfork_child is not None:
+            # a spawn helper's _exit (exec failed in __spawni_child):
+            # the vfork CHILD exits; the parent lives on
+            self._finalize_vfork_helper(thread, args[0])
+            self._reply_native(thread)  # its native exit tears down only
+            return  # the helper's own process
         self._exit_code = _i32_exit(args[0])
         for t in self.threads:
             if t is not thread:
@@ -1156,6 +1374,12 @@ class ManagedSimProcess:
         """SYS_exit: one thread leaves. Returns True when the caller's
         resume loop should stop (always — the thread is gone; if it was the
         last one the process is reaped)."""
+        if thread.vfork_child is not None:
+            # a posix_spawn helper that exits WITHOUT exec (exec failed
+            # in __spawni_child): only the vfork child dies
+            self._finalize_vfork_helper(thread, args[0])
+            self._reply_native(thread)
+            return True
         thread.dead = True
         self._reply_native(thread)
         # The emulated cleartid wake must not fire before the native thread

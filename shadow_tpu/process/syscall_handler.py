@@ -1695,7 +1695,9 @@ class SyscallHandler:
     # -- process family (`handler/{wait,clone,unistd}.rs`) ---------------
 
     def _sys_wait4(self, args, ctx) -> int:
-        pid, options = _i64(args[0]), _i32(args[2])
+        # pid_t is 32-bit: the register may carry -1 zero-extended
+        # (0xFFFFFFFF), which _i64 would misread as 4294967295
+        pid, options = _i32(args[0]), _i32(args[2])
         proc = self.process
         children = getattr(proc, "children", [])
 
@@ -1739,10 +1741,12 @@ class SyscallHandler:
 
         kill(2) group forms: 0 = the caller's process group, -pgid = that
         group, -1 = every process on the host (`kill(2)`)."""
+        # pid_t is 32-bit: decode as i32 so a zero-extended -1/-pgid in
+        # the register reads correctly (same hazard as wait4)
         if nr == SYS_kill:
-            target, sig = _i64(args[0]), _i32(args[1])
+            target, sig = _i32(args[0]), _i32(args[1])
         else:  # tgkill(tgid, tid, sig): process-granularity delivery
-            target, sig = _i64(args[0]), _i32(args[2])
+            target, sig = _i32(args[0]), _i32(args[2])
             if target <= 0:
                 raise errors.SyscallError(errors.EINVAL)
         if nr == SYS_kill and target <= 0:

@@ -195,3 +195,44 @@ int main(int argc, char **argv) {
 """
     binary = _compile(tmp_path, "xnull", src)
     _run(binary, args=[helper], expect="{exited: 7}")
+
+
+def test_system3_shells_out(tmp_path):
+    """system(3) = fork + execve("/bin/sh", "-c", ...) + waitpid: the
+    whole chain runs under the simulation, including a shell script
+    child (shebang exec)."""
+    script = tmp_path / "hello.sh"
+    script.write_text("#!/bin/sh\nexit 5\n")
+    import os
+
+    os.chmod(script, 0o755)
+    src = r"""
+#include <stdlib.h>
+#include <sys/wait.h>
+
+int main(int argc, char **argv) {
+    int rc = system(argv[1]);
+    if (!WIFEXITED(rc) || WEXITSTATUS(rc) != 5) return 96;
+    rc = system("exit 3");
+    if (!WIFEXITED(rc) || WEXITSTATUS(rc) != 3) return 97;
+    return 0;
+}
+"""
+    binary = _compile(tmp_path, "xsystem", src)
+    _run(binary, args=[str(script)])
+
+
+def test_system3_of_nonexistent_returns_127(tmp_path):
+    """system("/nonexistent") must return 127<<8 (the shell's exec
+    failure) without harming the calling process."""
+    src = r"""
+#include <stdlib.h>
+#include <sys/wait.h>
+int main(void) {
+    int rc = system("/nonexistent/definitely-not-here");
+    if (!WIFEXITED(rc) || WEXITSTATUS(rc) != 127) return 98;
+    return 0;
+}
+"""
+    binary = _compile(tmp_path, "xsys404", src)
+    _run(binary)
