@@ -455,8 +455,12 @@ class ManagedSimProcess:
         self.state = ProcessState.RUNNING  # the native child exists shortly
         self.handler = SyscallHandler(
             self, table=parent.handler._table.fork_into())
-        # fork(2) inherits signal dispositions
+        # fork(2) inherits signal dispositions and stdio shadows (the
+        # fork_into table preserves slot numbering, so the low-fd
+        # override map transfers verbatim — each shadow needs its own
+        # ref in the child table)
         self.handler.sig_actions = dict(parent.handler.sig_actions)
+        self.handler._low_overrides = dict(parent.handler._low_overrides)
         from .strace import make_logger
 
         self._strace_mode = getattr(parent, "_strace_mode", "off")
@@ -478,8 +482,10 @@ class ManagedSimProcess:
         """The simulator-side identity of a posix_spawn/system(3) helper:
         a child process that exists from the app's point of view (clone
         returned its pid) but whose own image only arrives at execve.
-        Until then its syscalls run through the PARENT's handler (shared
-        VM and fd table — true vfork semantics)."""
+        vfork shares the VM but COPIES the fd table, so the helper's
+        syscalls (posix_spawn file_actions: dup2/close) dispatch against
+        its OWN handler from clone time — the parent's table stays
+        untouched. Memory and futexes stay shared with the parent."""
         self = cls.__new__(cls)
         parent._fork_counter = getattr(parent, "_fork_counter", 0)
         ix = parent._fork_counter
@@ -487,7 +493,12 @@ class ManagedSimProcess:
         self._init_common(parent.host, f"{parent.name}.spawn{ix}",
                           parent.argv, output_dir=parent._output_dir)
         self.state = ProcessState.RUNNING
-        self.handler = None  # materialized at exec (fd snapshot then)
+        self.handler = SyscallHandler(
+            self, table=parent.handler._table.fork_into())
+        self.handler._low_overrides = dict(parent.handler._low_overrides)
+        self.handler.sig_actions = dict(parent.handler.sig_actions)
+        self.handler.futexes = parent.handler.futexes  # shared VM
+        self.server.mem = parent.server.mem  # shared VM
         self.pgid = parent.pgid
         self.sid = parent.sid
         self.parent = parent
@@ -503,6 +514,8 @@ class ManagedSimProcess:
     def _erase_placeholder(self) -> None:
         """A vfork clone that failed natively: the placeholder was never
         observable (clone returned an error), so remove every trace."""
+        if self.handler is not None:
+            self.handler.close_all()
         if self.parent is not None and self in self.parent.children:
             self.parent.children.remove(self)
         if self in self.host.processes:
@@ -1099,15 +1112,16 @@ class ManagedSimProcess:
         self._strace(thread, SYS_execve, args, "<noreturn>")
 
         child, thread.vfork_child = thread.vfork_child, None
-        # exec snapshot: the child's fd table is the parent's at this
-        # instant, minus CLOEXEC; handlers reset, ignores survive
-        child.handler = SyscallHandler(
-            child, table=self.handler._table.fork_into())
+        # the child's handler exists since clone (its own fd-table copy,
+        # already mutated by any file_actions the helper ran); exec-time
+        # transitions: CLOEXEC drop, handler-dispositions reset, and a
+        # fresh futex namespace (the VM stops being shared now)
         child.handler._table.close_cloexec()
         child.handler.sig_actions = {
-            sig: act for sig, act in self.handler.sig_actions.items()
+            sig: act for sig, act in child.handler.sig_actions.items()
             if act[0] == "ignore"
         }
+        child.handler.futexes = kfutex.FutexTable()
 
         # retire the native helper (its own native process, shared VM)
         helper_tid = thread.native_tid
@@ -1323,6 +1337,8 @@ class ManagedSimProcess:
             child._exit_code = _i32_exit(exit_code or 0)
             child.exit_status = child._exit_code
             child.state = ProcessState.EXITED
+        if child.handler is not None:
+            child.handler.close_all()  # drop the copied table's refs
         child._notify_parent()
         self._release_vfork_parent(child)
         thread.dead = True
@@ -1356,9 +1372,10 @@ class ManagedSimProcess:
         free), record the exit code, and let the native exit run."""
         if thread.vfork_child is not None:
             # a spawn helper's _exit (exec failed in __spawni_child):
-            # the vfork CHILD exits; the parent lives on
-            self._finalize_vfork_helper(thread, args[0])
+            # the vfork CHILD exits; the parent lives on. Reply BEFORE
+            # finalize — finalize frees the thread's channel.
             self._reply_native(thread)  # its native exit tears down only
+            self._finalize_vfork_helper(thread, args[0])
             return  # the helper's own process
         self._exit_code = _i32_exit(args[0])
         for t in self.threads:
@@ -1376,9 +1393,10 @@ class ManagedSimProcess:
         last one the process is reaped)."""
         if thread.vfork_child is not None:
             # a posix_spawn helper that exits WITHOUT exec (exec failed
-            # in __spawni_child): only the vfork child dies
-            self._finalize_vfork_helper(thread, args[0])
+            # in __spawni_child): only the vfork child dies. Reply first;
+            # finalize frees the channel.
             self._reply_native(thread)
+            self._finalize_vfork_helper(thread, args[0])
             return True
         thread.dead = True
         self._reply_native(thread)
@@ -1478,8 +1496,12 @@ class ManagedSimProcess:
             # the mapping mutates natively; re-parse the region table on
             # its next query (`memory_manager/mod.rs:616-709`)
             self.regions.mark_dirty()
+        # a vfork helper's syscalls act on ITS copied fd table (and its
+        # own process identity: getppid, wait, kill-from), not ours
+        handler = self.handler if thread.vfork_child is None \
+            else thread.vfork_child.handler
         try:
-            ret = self.handler.dispatch(nr, args, ctx)
+            ret = handler.dispatch(nr, args, ctx)
         except NativeSyscall:
             # not simulated-kernel territory: time/identity emulation, then
             # native passthrough

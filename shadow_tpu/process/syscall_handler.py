@@ -57,6 +57,8 @@ SYS_write = 1
 SYS_close = 3
 SYS_fstat = 5
 SYS_poll = 7
+SYS_lseek = 8
+SYS_newfstatat = 262
 SYS_pipe = 22
 SYS_sched_yield = 24
 SYS_wait4 = 61
@@ -252,6 +254,9 @@ class SyscallHandler:
         # fd -> simulated file; offset table keeps vfds in our range.
         # fork passes the parent table's fork_into() clone.
         self._table = table if table is not None else DescriptorTable()
+        # low fd -> table slot: virtual files dup2'd onto stdio-range
+        # descriptors (subprocess pipe redirection); consulted by _file
+        self._low_overrides: dict[int, int] = {}
         # the one transient wait-epoll a parked poll/select holds (fallback
         # slot for single-context callers; threads park on their own)
         self._wait_epoll: Optional[Epoll] = None
@@ -297,7 +302,12 @@ class SyscallHandler:
     def _file(self, fd: int):
         fd = _i32(fd)
         if fd < self.VFD_BASE:
-            raise NativeSyscall()
+            # a low fd may SHADOW a virtual file (dup2 of a simulated
+            # pipe/socket onto stdio — subprocess/popen redirection)
+            slot = self._low_overrides.get(fd)
+            if slot is None:
+                raise NativeSyscall()
+            return self._table.get(slot)
         try:
             return self._table.get(fd - self.VFD_BASE)
         except errors.SyscallError:
@@ -308,7 +318,7 @@ class SyscallHandler:
     def has_vfd(self, fd: int) -> bool:
         fd = _i32(fd)
         if fd < self.VFD_BASE:
-            return False
+            return fd in self._low_overrides
         try:
             self._table.get(fd - self.VFD_BASE)
             return True
@@ -317,6 +327,7 @@ class SyscallHandler:
 
     def close_all(self) -> None:
         self._table.close_all()
+        self._low_overrides.clear()
         self._drop_wait_epoll()
         self._itimer_disarm()  # a dead process's timer must not re-arm
         if self._perf_enabled:
@@ -826,7 +837,13 @@ class SyscallHandler:
     def _sys_close(self, args, ctx) -> int:
         fd = _i32(args[0])
         if fd < self.VFD_BASE:
-            raise NativeSyscall()
+            slot = self._low_overrides.pop(fd, None)
+            if slot is not None:
+                try:
+                    self._table.close(slot)
+                except errors.SyscallError:
+                    pass
+            raise NativeSyscall()  # the kernel closes its side too
         try:
             self._table.close(fd - self.VFD_BASE)
         except errors.SyscallError:
@@ -835,22 +852,38 @@ class SyscallHandler:
 
     def _sys_dup(self, args, ctx) -> int:
         fd = _i32(args[0])
-        if fd < self.VFD_BASE:
+        if not self.has_vfd(fd):
             raise NativeSyscall()
-        self._file(fd)  # EBADF check
-        return self._table.dup(fd - self.VFD_BASE) + self.VFD_BASE
+        file = self._file(fd)  # resolves low-fd shadows too
+        return self._table.register(file) + self.VFD_BASE
 
     def _sys_dup2(self, args, ctx, flags: int = 0) -> int:
         oldfd, newfd = _i32(args[0]), _i32(args[1])
-        if oldfd < self.VFD_BASE and newfd < self.VFD_BASE:
+        old_virtual = oldfd >= self.VFD_BASE \
+            or oldfd in self._low_overrides
+        if not old_virtual:
+            if newfd >= self.VFD_BASE:
+                # native source replacing a virtual slot: drop the
+                # virtual file, then the kernel can't take over a fd in
+                # our reserved range — reject like a bad target
+                raise errors.SyscallError(errors.EBADF)
+            # native->native (possibly clearing a low shadow first)
+            slot = self._low_overrides.pop(newfd, None)
+            if slot is not None:
+                self._table.close(slot)
             raise NativeSyscall()
-        if oldfd < self.VFD_BASE or newfd < self.VFD_BASE:
-            # mixing planes (dup a socket onto stdin, ...): unsupported
-            raise errors.SyscallError(errors.EBADF)
         file = self._file(oldfd)
         if oldfd == newfd:
             return newfd
-        self._table.register_at(newfd - self.VFD_BASE, file)
+        if newfd >= self.VFD_BASE:
+            self._table.register_at(newfd - self.VFD_BASE, file)
+            return newfd
+        # virtual file onto a low fd (dup2(pipe, STDOUT_FILENO)): shadow
+        # the native descriptor — subsequent ops on newfd route virtually
+        slot = self._low_overrides.pop(newfd, None)
+        if slot is not None:
+            self._table.close(slot)
+        self._low_overrides[newfd] = self._table.register(file)
         return newfd
 
     def _sys_dup3(self, args, ctx) -> int:
@@ -892,9 +925,25 @@ class SyscallHandler:
         self.mem.write(args[1], bytes(st))
         return 0
 
+    def _sys_newfstatat(self, args, ctx) -> int:
+        """newfstatat(2): glibc implements fstat() as
+        newfstatat(fd, "", AT_EMPTY_PATH) — emulate that shape for
+        virtual descriptors; every path-based form stays native."""
+        dirfd, flags = _i32(args[0]), _i32(args[3])
+        if not flags & self.AT_EMPTY_PATH or not self.has_vfd(dirfd):
+            raise NativeSyscall()
+        return self._sys_fstat([dirfd, args[2]], ctx)
+
+    def _sys_lseek(self, args, ctx) -> int:
+        """lseek(2) on a virtual descriptor: pipes and sockets are not
+        seekable — ESPIPE, which io layers (CPython's io.open) use to
+        detect non-seekable streams. Native fds pass through."""
+        self._file(args[0])  # NativeSyscall for real fds, EBADF check
+        raise errors.SyscallError(errors.ESPIPE)
+
     def _sys_fcntl(self, args, ctx) -> int:
         fd = _i32(args[0])
-        if fd < self.VFD_BASE:
+        if not self.has_vfd(fd):
             raise NativeSyscall()
         file = self._file(fd)
         cmd, arg = _i32(args[1]), args[2]
@@ -906,12 +955,13 @@ class SyscallHandler:
         if cmd in (F_GETFD, F_SETFD):
             return 0
         if cmd in (F_DUPFD, F_DUPFD_CLOEXEC):
-            return self._table.dup(fd - self.VFD_BASE) + self.VFD_BASE
+            # `file` already resolved through any low-fd shadow
+            return self._table.register(file) + self.VFD_BASE
         raise errors.SyscallError(errors.EINVAL)
 
     def _sys_ioctl(self, args, ctx) -> int:
         fd = _i32(args[0])
-        if fd < self.VFD_BASE:
+        if not self.has_vfd(fd):
             raise NativeSyscall()
         file = self._file(fd)
         req = args[1]
@@ -1401,7 +1451,7 @@ class SyscallHandler:
         """statx(2) for virtual fds via AT_EMPTY_PATH; path-based forms
         stay native (regular files are native in this design)."""
         dirfd, flags = _i32(args[0]), _i32(args[2])
-        if not flags & self.AT_EMPTY_PATH or dirfd < self.VFD_BASE:
+        if not flags & self.AT_EMPTY_PATH or not self.has_vfd(dirfd):
             raise NativeSyscall()
         file = self._file(dirfd)
         mode, ino = self._vfd_stat_identity(file)
@@ -2001,6 +2051,8 @@ class SyscallHandler:
         SYS_timerfd_create: _sys_timerfd_create,
         SYS_timerfd_settime: _sys_timerfd_settime,
         SYS_timerfd_gettime: _sys_timerfd_gettime,
+        SYS_lseek: _sys_lseek,
+        SYS_newfstatat: _sys_newfstatat,
         SYS_pause: _sys_pause,
         SYS_rt_sigprocmask: _sys_rt_sigprocmask,
         SYS_rt_sigsuspend: _sys_rt_sigsuspend,

@@ -236,3 +236,90 @@ int main(void) {
 """
     binary = _compile(tmp_path, "xsys404", src)
     _run(binary)
+
+
+PY = shutil.which("python3")
+
+
+@pytest.mark.skipif(PY is None, reason="no python3")
+def test_python_subprocess_run_with_pipes(tmp_path):
+    """CPython's subprocess: vfork-based fork_exec, pipe redirection via
+    dup2-onto-stdio (low-fd shadowing), newfstatat/lseek probes on
+    virtual fds, waitpid(-1) — the whole popen stack in simulated time."""
+    script = tmp_path / "runner.py"
+    script.write_text(
+        "import subprocess, sys\n"
+        "r = subprocess.run(['/bin/echo', 'hello-child'],"
+        " capture_output=True, text=True)\n"
+        "assert r.returncode == 0 and r.stdout.strip() == 'hello-child',"
+        " (r.returncode, r.stdout)\n"
+        "r2 = subprocess.run(['/bin/sh', '-c', 'exit 4'])\n"
+        "assert r2.returncode == 4, r2.returncode\n"
+        "print('subprocess OK')\n")
+    cfg = load_config_str(f"""
+general: {{stop_time: 60s, seed: 3}}
+network:
+  graph: {{type: 1_gbit_switch}}
+hosts:
+  alpha:
+    network_node_id: 0
+    processes:
+    - {{path: {PY}, args: ["{script}"], start_time: 1s,
+       expected_final_state: {{exited: 0}}}}
+""")
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
+
+
+SPAWN_FA_C = r"""
+#include <errno.h>
+#include <spawn.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+extern char **environ;
+
+int main(void) {
+    int p[2];
+    if (pipe(p)) return 80;
+    posix_spawn_file_actions_t fa;
+    posix_spawn_file_actions_init(&fa);
+    posix_spawn_file_actions_adddup2(&fa, p[1], 1);
+    posix_spawn_file_actions_addclose(&fa, p[0]);
+    posix_spawn_file_actions_addclose(&fa, p[1]);
+    pid_t pid;
+    char *argv[] = {"echo", "spawned", 0};
+    if (posix_spawn(&pid, "/bin/echo", &fa, 0, argv, environ)) return 81;
+    /* the PARENT's pipe fds must be untouched by the child's actions */
+    close(p[1]);
+    char buf[64];
+    long n = read(p[0], buf, sizeof buf);
+    if (n <= 0) return 82; /* parent's read end died: table corrupted */
+    if (strncmp(buf, "spawned", 7)) return 83;
+    /* EOF after the child exits and all writers close */
+    n = read(p[0], buf, sizeof buf);
+    if (n != 0) return 84;
+    int status;
+    if (waitpid(pid, &status, 0) != pid) return 85;
+    if (!WIFEXITED(status) || WEXITSTATUS(status) != 0) return 86;
+    /* spawn failure: error reported via the spawn return, parent fine */
+    if (posix_spawn(&pid, "/nonexistent/xyz", 0, 0, argv, environ) == 0) {
+        if (waitpid(pid, &status, 0) != pid) return 87;
+        if (!WIFEXITED(status) || WEXITSTATUS(status) != 127) return 88;
+    }
+    return 0;
+}
+"""
+
+
+def test_posix_spawn_file_actions(tmp_path):
+    """posix_spawn with adddup2/addclose file actions: the helper's fd
+    mutations land on ITS copied table (vfork copies the fd table), the
+    parent's pipe survives, the child's stdout is captured through the
+    simulated pipe, and a failed spawn reports 127 via waitpid."""
+    c = tmp_path / "spawnfa.c"
+    c.write_text(SPAWN_FA_C)
+    binary = tmp_path / "spawnfa"
+    subprocess.run([CC, "-O1", "-o", str(binary), str(c)], check=True)
+    _run(str(binary))
