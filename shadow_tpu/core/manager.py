@@ -175,6 +175,24 @@ class Manager:
             self.dns.register(name, ip)
             self._wire_processes(host, name, opts)
 
+        # the simulation's /etc/hosts view, consumed by the addrinfo
+        # preload so managed binaries resolve simulated hostnames
+        # (`shim_api_addrinfo.c` + the reference's mounted hosts file)
+        import tempfile
+
+        if self.data_dir:
+            os.makedirs(self.data_dir, exist_ok=True)
+            hosts_path = os.path.join(self.data_dir, "etc-hosts")
+        else:
+            fd, hosts_path = tempfile.mkstemp(prefix="shadow-hosts-")
+            os.close(fd)
+            self._hosts_file_temp = True  # unlinked at run() teardown
+        with open(hosts_path, "w") as fh:
+            fh.write(self.dns.hosts_file())
+        self.hosts_file_path = hosts_path
+        for host in self.hosts:
+            host.hosts_file_path = hosts_path
+
         self.shared = WorkerShared(
             dns=self.dns,
             routing=self.routing,

@@ -108,18 +108,21 @@ PRELOAD_LIBC_LIB_PATH = os.path.join(_DIR, "libshadow_preload_libc.so")
 PRELOAD_OPENSSL_LIB_PATH = os.path.join(_DIR, "libshadow_preload_openssl.so")
 
 
+_built_this_process = False
+
+
 def build(force: bool = False) -> str:
-    """Build the native libraries with make; returns the IPC lib path."""
-    if (
-        force
-        or not os.path.exists(_LIB_PATH)
-        or not os.path.exists(SHIM_LIB_PATH)
-        or not os.path.exists(PRELOAD_LIBC_LIB_PATH)
-        or not os.path.exists(PRELOAD_OPENSSL_LIB_PATH)
-    ):
+    """Build the native libraries with make; returns the IPC lib path.
+
+    Runs make once per process even when the .so files exist — make's
+    dependency check is what detects STALE libraries after a source edit
+    (an exists()-only check shipped checkouts with outdated preloads)."""
+    global _built_this_process
+    if force or not _built_this_process:
         subprocess.run(
             ["make", "-C", _DIR], check=True, capture_output=True, text=True
         )
+        _built_this_process = True
     return _LIB_PATH
 
 

@@ -1,0 +1,314 @@
+/* Simulated-DNS name resolution for managed processes.
+ *
+ * Parity: reference `src/lib/preload-libc/shim_api_addrinfo.c` —
+ * getaddrinfo/freeaddrinfo resolved against the SIMULATION's hosts view
+ * instead of the real resolver, so `curl http://server:8000/` works with
+ * the simulated names. The Manager writes the hosts table (one
+ * "IP name..." line per host) to a file named by SHADOW_TPU_HOSTS_FILE.
+ *
+ * Design: the resolver never falls through to glibc — the managed world
+ * is fully simulated, names outside it don't exist (EAI_NONAME), exactly
+ * the reference's posture. Numeric nodes, NULL/AI_PASSIVE, and numeric
+ * services are handled inline. freeaddrinfo only ever sees our layout
+ * (one malloc block per result: addrinfo + sockaddr_in back-to-back).
+ */
+
+#define _GNU_SOURCE 1
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <errno.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <strings.h>
+#include <sys/socket.h>
+
+struct blk {
+    struct addrinfo ai;
+    struct sockaddr_in sa;
+    char canon[64];
+};
+
+static int parse_port(const char *service, int *port_out) {
+    if (!service || !*service) {
+        *port_out = 0;
+        return 0;
+    }
+    char *end = NULL;
+    long p = strtol(service, &end, 10);
+    if (end && *end == '\0' && p >= 0 && p <= 65535) {
+        *port_out = (int)p;
+        return 0;
+    }
+    /* common symbolic services, no NSS machinery in a preload */
+    if (!strcasecmp(service, "http")) { *port_out = 80; return 0; }
+    if (!strcasecmp(service, "https")) { *port_out = 443; return 0; }
+    if (!strcasecmp(service, "domain")) { *port_out = 53; return 0; }
+    return EAI_SERVICE;
+}
+
+static int lookup_hosts(const char *node, struct in_addr *out) {
+    const char *path = getenv("SHADOW_TPU_HOSTS_FILE");
+    if (!path)
+        return -1;
+    FILE *fh = fopen(path, "re");
+    if (!fh)
+        return -1;
+    char line[512];
+    int found = -1;
+    while (found < 0 && fgets(line, sizeof line, fh)) {
+        char *save = NULL;
+        char *ip = strtok_r(line, " \t\r\n", &save);
+        if (!ip || ip[0] == '#')
+            continue;
+        char *name;
+        while ((name = strtok_r(NULL, " \t\r\n", &save)) != NULL) {
+            if (!strcasecmp(name, node)) {
+                if (inet_aton(ip, out))
+                    found = 0;
+                break;
+            }
+        }
+    }
+    fclose(fh);
+    return found;
+}
+
+static struct addrinfo *make_result(struct in_addr addr, int port,
+                                    int socktype, int protocol,
+                                    const char *canon) {
+    struct blk *b = (struct blk *)calloc(1, sizeof(struct blk));
+    if (!b)
+        return NULL;
+    b->sa.sin_family = AF_INET;
+    b->sa.sin_port = htons((unsigned short)port);
+    b->sa.sin_addr = addr;
+    b->ai.ai_family = AF_INET;
+    b->ai.ai_socktype = socktype ? socktype : SOCK_STREAM;
+    b->ai.ai_protocol = protocol;
+    b->ai.ai_addrlen = sizeof(struct sockaddr_in);
+    b->ai.ai_addr = (struct sockaddr *)&b->sa;
+    if (canon) {
+        strncpy(b->canon, canon, sizeof(b->canon) - 1);
+        b->ai.ai_canonname = b->canon;
+    }
+    return &b->ai;
+}
+
+int getaddrinfo(const char *node, const char *service,
+                const struct addrinfo *hints, struct addrinfo **res) {
+    int port = 0;
+    int rc = parse_port(service, &port);
+    if (rc)
+        return rc;
+    int socktype = hints ? hints->ai_socktype : 0;
+    int protocol = hints ? hints->ai_protocol : 0;
+    int family = hints ? hints->ai_family : AF_UNSPEC;
+    if (family != AF_UNSPEC && family != AF_INET)
+        return EAI_FAMILY; /* the simulated internet is v4 */
+
+    struct in_addr addr;
+    if (!node || !*node) {
+        /* AI_PASSIVE: the wildcard; otherwise loopback (getaddrinfo(3)) */
+        addr.s_addr = (hints && (hints->ai_flags & AI_PASSIVE))
+                          ? htonl(INADDR_ANY)
+                          : htonl(INADDR_LOOPBACK);
+    } else if (inet_aton(node, &addr)) {
+        /* numeric: done */
+    } else if (hints && (hints->ai_flags & AI_NUMERICHOST)) {
+        return EAI_NONAME;
+    } else if (!strcasecmp(node, "localhost")) {
+        addr.s_addr = htonl(INADDR_LOOPBACK);
+    } else if (lookup_hosts(node, &addr) != 0) {
+        return EAI_NONAME; /* fully simulated: no real-resolver fallback */
+    }
+    struct addrinfo *ai = make_result(
+        addr, port, socktype, protocol,
+        (hints && (hints->ai_flags & AI_CANONNAME)) ? node : NULL);
+    if (!ai)
+        return EAI_MEMORY;
+    *res = ai;
+    return 0;
+}
+
+void freeaddrinfo(struct addrinfo *ai) {
+    while (ai) {
+        struct addrinfo *next = ai->ai_next;
+        free(ai); /* struct blk starts at the addrinfo */
+        ai = next;
+    }
+}
+
+/* getnameinfo: reverse view over the same table (numeric fallback). */
+int getnameinfo(const struct sockaddr *sa, socklen_t salen, char *host,
+                socklen_t hostlen, char *serv, socklen_t servlen,
+                int flags) {
+    if (!sa || salen < (socklen_t)sizeof(struct sockaddr_in)
+        || sa->sa_family != AF_INET)
+        return EAI_FAMILY;
+    const struct sockaddr_in *sin = (const struct sockaddr_in *)sa;
+    if (serv && servlen)
+        snprintf(serv, servlen, "%u", (unsigned)ntohs(sin->sin_port));
+    if (host && hostlen) {
+        char ip[INET_ADDRSTRLEN];
+        inet_ntop(AF_INET, &sin->sin_addr, ip, sizeof ip);
+        if (flags & NI_NUMERICHOST) {
+            snprintf(host, hostlen, "%s", ip);
+            return 0;
+        }
+        /* scan for a name owning this IP; fall back to numeric */
+        const char *path = getenv("SHADOW_TPU_HOSTS_FILE");
+        FILE *fh = path ? fopen(path, "re") : NULL;
+        int named = 0;
+        if (fh) {
+            char line[512];
+            while (!named && fgets(line, sizeof line, fh)) {
+                char *save = NULL;
+                char *lip = strtok_r(line, " \t\r\n", &save);
+                if (!lip || lip[0] == '#' || strcmp(lip, ip))
+                    continue;
+                char *name = strtok_r(NULL, " \t\r\n", &save);
+                if (name) {
+                    snprintf(host, hostlen, "%s", name);
+                    named = 1;
+                }
+            }
+            fclose(fh);
+        }
+        if (!named) {
+            if (flags & NI_NAMEREQD)
+                return EAI_NONAME; /* name required, none known */
+            snprintf(host, hostlen, "%s", ip);
+        }
+    }
+    return 0;
+}
+
+/* ---- classic gethostby* family ------------------------------------- */
+/* CPython's socketmodule and older apps use gethostbyname_r /
+ * gethostbyaddr_r; without interposition those walk glibc NSS into real
+ * DNS queries over the SIMULATED network (5s timeouts, wrong answers).
+ * All four resolve against the same hosts table, instantly. */
+
+static int fill_hostent(struct hostent *ret, char *buf, size_t buflen,
+                        const char *name, struct in_addr addr) {
+    /* layout in caller buffer: name string | addr bytes | ptr arrays;
+     * budget BOTH alignment pads at their 7-byte worst case */
+    size_t name_len = strlen(name) + 1;
+    size_t need = name_len + 7 + sizeof(struct in_addr) + 7
+                  + 3 * sizeof(char *);
+    if (buflen < need)
+        return ERANGE;
+    char *p = buf;
+    memcpy(p, name, name_len);
+    ret->h_name = p;
+    p += name_len;
+    p = (char *)(((uintptr_t)p + 7) & ~(uintptr_t)7);
+    memcpy(p, &addr, sizeof addr);
+    char *addr_bytes = p;
+    p += sizeof addr;
+    p = (char *)(((uintptr_t)p + 7) & ~(uintptr_t)7);
+    char **addr_list = (char **)p;
+    addr_list[0] = addr_bytes;
+    addr_list[1] = NULL;
+    p += 2 * sizeof(char *);
+    char **aliases = (char **)p;
+    aliases[0] = NULL;
+    ret->h_aliases = aliases;
+    ret->h_addrtype = AF_INET;
+    ret->h_length = sizeof(struct in_addr);
+    ret->h_addr_list = addr_list;
+    return 0;
+}
+
+int gethostbyname_r(const char *name, struct hostent *ret, char *buf,
+                    size_t buflen, struct hostent **result,
+                    int *h_errnop) {
+    *result = NULL;
+    struct in_addr addr;
+    if (inet_aton(name, &addr)) {
+        /* numeric */
+    } else if (!strcasecmp(name, "localhost")) {
+        addr.s_addr = htonl(INADDR_LOOPBACK);
+    } else if (lookup_hosts(name, &addr) != 0) {
+        if (h_errnop)
+            *h_errnop = HOST_NOT_FOUND;
+        return -1;
+    }
+    int rc = fill_hostent(ret, buf, buflen, name, addr);
+    if (rc)
+        return rc;
+    *result = ret;
+    return 0;
+}
+
+static int reverse_lookup(struct in_addr addr, char *name_out, size_t n) {
+    char ip[INET_ADDRSTRLEN];
+    inet_ntop(AF_INET, &addr, ip, sizeof ip);
+    const char *path = getenv("SHADOW_TPU_HOSTS_FILE");
+    FILE *fh = path ? fopen(path, "re") : NULL;
+    if (!fh)
+        return -1;
+    char line[512];
+    int found = -1;
+    while (found < 0 && fgets(line, sizeof line, fh)) {
+        char *save = NULL;
+        char *lip = strtok_r(line, " \t\r\n", &save);
+        if (!lip || lip[0] == '#' || strcmp(lip, ip))
+            continue;
+        char *nm = strtok_r(NULL, " \t\r\n", &save);
+        if (nm) {
+            snprintf(name_out, n, "%s", nm);
+            found = 0;
+        }
+    }
+    fclose(fh);
+    return found;
+}
+
+int gethostbyaddr_r(const void *addr, socklen_t len, int type,
+                    struct hostent *ret, char *buf, size_t buflen,
+                    struct hostent **result, int *h_errnop) {
+    *result = NULL;
+    if (type != AF_INET || len != sizeof(struct in_addr)) {
+        if (h_errnop)
+            *h_errnop = HOST_NOT_FOUND;
+        return -1;
+    }
+    struct in_addr a;
+    memcpy(&a, addr, sizeof a);
+    char name[256];
+    if (reverse_lookup(a, name, sizeof name) != 0) {
+        if (h_errnop)
+            *h_errnop = HOST_NOT_FOUND;
+        return -1; /* instant: no NSS walk, no simulated-net DNS query */
+    }
+    int rc = fill_hostent(ret, buf, buflen, name, a);
+    if (rc)
+        return rc;
+    *result = ret;
+    return 0;
+}
+
+static struct hostent static_he;
+static char static_he_buf[1024];
+
+struct hostent *gethostbyname(const char *name) {
+    struct hostent *res = NULL;
+    int herr = 0;
+    if (gethostbyname_r(name, &static_he, static_he_buf,
+                        sizeof static_he_buf, &res, &herr) != 0)
+        return NULL;
+    return res;
+}
+
+struct hostent *gethostbyaddr(const void *addr, socklen_t len, int type) {
+    struct hostent *res = NULL;
+    int herr = 0;
+    if (gethostbyaddr_r(addr, len, type, &static_he, static_he_buf,
+                        sizeof static_he_buf, &res, &herr) != 0)
+        return NULL;
+    return res;
+}

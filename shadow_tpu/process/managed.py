@@ -554,19 +554,42 @@ class ManagedSimProcess:
 
     # -- lifecycle ------------------------------------------------------
 
+    # accelerator-harness variables that must never leak into managed
+    # processes: a managed python importing an injected sitecustomize
+    # (PYTHONPATH site dirs) would initialize TPU runtime plumbing under
+    # the shim and abort ("event_loop.cc Invalid IPAddress")
+    _ENV_SCRUB_PREFIXES = ("PALLAS_AXON_", "AXON_", "JAX_", "TPU_",
+                           "LIBTPU", "XLA_")
+
+    @classmethod
+    def _scrub_env(cls, env: dict) -> dict:
+        out = {k: v for k, v in env.items()
+               if not k.startswith(cls._ENV_SCRUB_PREFIXES)}
+        pp = out.get("PYTHONPATH")
+        if pp:
+            kept = [p for p in pp.split(os.pathsep)
+                    if ".axon_site" not in p]
+            if kept:
+                out["PYTHONPATH"] = os.pathsep.join(kept)
+            else:
+                out.pop("PYTHONPATH", None)
+        return out
+
     def _launch_native(self, argv: list[str],
                        app_env: Optional[dict] = None,
                        executable: Optional[str] = None) -> None:
         """Start (or restart, for execve) the native process with the
         shim environment: fresh IPC channel, main thread, clock block,
         memory/region plumbing, and the death watcher."""
-        if not os.path.exists(SHIM_PATH):
-            from .. import interpose
+        from .. import interpose
 
-            interpose.build()
+        interpose.build()  # once per process; make no-ops when current
         self.ipc = IpcChannel.create()
         self.threads = [ManagedThread(self, self.ipc, is_main=True)]
-        env = dict(os.environ) if app_env is None else dict(app_env)
+        # scrub only the INHERITED environment: an execve-supplied envp is
+        # the app's explicit choice and must pass through verbatim
+        env = self._scrub_env(dict(os.environ)) if app_env is None \
+            else dict(app_env)
         preload = env.get("LD_PRELOAD", "")
         use_ssl_rng = bool(getattr(
             getattr(self.host, "config_experimental", None),
@@ -574,6 +597,9 @@ class ManagedSimProcess:
         env["LD_PRELOAD"] = _preload_chain(use_ssl_rng) + (
             " " + preload if preload else "")
         env["SHADOW_TPU_IPC_HANDLE"] = self.ipc.block.serialize()
+        hosts_path = getattr(self.host, "hosts_file_path", None)
+        if hosts_path:
+            env["SHADOW_TPU_HOSTS_FILE"] = hosts_path
         # shared clock block: the shim answers clock_gettime/gettimeofday/
         # time locally from it, zero IPC round trips (`shim_sys.c:25-80`)
         from ..interpose import ProcessClock

@@ -315,3 +315,40 @@ hosts:
     assert s1.process_failures == [] and s2.process_failures == []
     assert (s1.packets_sent, s1.packets_dropped) == \
         (s2.packets_sent, s2.packets_dropped)
+
+
+def test_curl_resolves_simulated_hostname(tmp_path):
+    """The addrinfo preload (`shim_api_addrinfo.c` parity): real curl
+    fetches by SIMULATED hostname ("http://server:8000/...") resolved
+    through the simulation's hosts view — no real-resolver fallback, no
+    gethostby* NSS walk into fake DNS queries."""
+    import shutil as _sh
+
+    py = _sh.which("python3")
+    curl = _sh.which("curl")
+    if py is None or curl is None:
+        pytest.skip("python3/curl not available")
+    payload = bytes(range(256)) * 64  # 16 KiB
+    (tmp_path / "data.bin").write_bytes(payload)
+    out = tmp_path / "fetched.bin"
+    cfg = load_config_str(f"""
+general: {{stop_time: 30s, seed: 5}}
+network:
+  graph: {{type: 1_gbit_switch}}
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - {{path: {py}, args: ["-m", "http.server", "8000", "--bind",
+        "0.0.0.0", "--directory", "{tmp_path}"], start_time: 1s,
+       expected_final_state: running}}
+  client:
+    network_node_id: 0
+    processes:
+    - {{path: {curl}, args: ["-s", "-f", "-o", "{out}",
+        "http://server:8000/data.bin"], start_time: 3s,
+       expected_final_state: {{exited: 0}}}}
+""")
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
+    assert out.read_bytes() == payload

@@ -107,8 +107,56 @@ def rung3(n_hosts: int = 1000, n_nodes: int = 40,
     return run_rung("rung3_tgen_atlas_1k", cfg)
 
 
+def rung1(size: int = 10 * 1024 * 1024) -> dict:
+    """BASELINE rung 1 with REAL binaries: python3 -m http.server serving
+    a 10 MiB file to two real curl clients over a 1 Gbit switch — the
+    reference's literal getting-started example
+    (`examples/docs/basic-file-transfer/shadow.yaml`)."""
+    import shutil
+    import tempfile
+
+    py = shutil.which("python3")
+    curl = shutil.which("curl")
+    if py is None or curl is None:
+        print(json.dumps({"rung": "rung1_real_binaries",
+                          "skipped": "python3/curl missing"}))
+        return {}
+    tmp = tempfile.mkdtemp(prefix="rung1-")
+    with open(f"{tmp}/data.bin", "wb") as fh:
+        fh.write(bytes(range(256)) * (size // 256))
+    clients = "\n".join(
+        f"""  client{i}:
+    network_node_id: 0
+    processes:
+    - {{path: {curl}, args: ["-s", "-o", "{tmp}/out{i}.bin",
+        "http://server:8000/data.bin"], start_time: {3 + i}s,
+       expected_final_state: {{exited: 0}}}}"""
+        for i in range(2))
+    cfg = f"""
+general: {{stop_time: 120s, seed: 1}}
+network:
+  graph: {{type: 1_gbit_switch}}
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - {{path: {py}, args: ["-m", "http.server", "8000",
+        "--bind", "0.0.0.0", "--directory", "{tmp}"], start_time: 1s,
+       expected_final_state: running}}
+{clients}
+"""
+    out = run_rung("rung1_real_binaries", cfg)
+    for i in range(2):
+        with open(f"{tmp}/out{i}.bin", "rb") as fh:
+            got = fh.read()
+        assert len(got) == size, f"client{i} fetched {len(got)} != {size}"
+    return out
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("1", "all"):
+        rung1()
     if which in ("2", "all"):
         rung2()
     if which in ("3", "all"):
