@@ -260,9 +260,15 @@ class NetlinkSocket(StatefulFile):
             raise errors.SyscallError(errors.EBADF)
         if self._overflow:
             # a reply was dropped at queue-full: fail like Linux so the
-            # caller can resync, instead of hanging for a DONE that was
-            # never queued
+            # caller can resync instead of hanging for a DONE that was
+            # never queued. Ordering matches __skb_try_recv_datagram,
+            # which consumes sock_error() BEFORE dequeuing ("Caller is
+            # allowed not to check sk->sk_err before skb_recv_datagram()"
+            # — net/core/datagram.c), so the error surfaces ahead of any
+            # queued dump replies; libnl treats ENOBUFS as the immediate
+            # restart-the-dump signal.
             self._overflow = False
+            self._refresh()  # recompute READABLE now that sk_err is gone
             raise errors.SyscallError(errors.ENOBUFS)
         if not self._recv:
             if self.nonblocking:

@@ -1050,7 +1050,19 @@ class ManagedSimProcess:
         CLOEXEC), itimers, and the blocked-signal mask survive; caught
         signal dispositions reset to default; sibling threads die
         (`handler/unistd.rs:777` execve_common). Returns True when the
-        old incarnation is retired (exec never returns on success)."""
+        old incarnation is retired (exec never returns on success).
+
+        Known limitation (exec-as-respawn): NATIVE fd state that only
+        lives in the old image's fd table does not survive. A
+        posix_spawn file_actions dup2 of a native regular-file fd (e.g.
+        subprocess stdout=open('out.txt')) is performed in the vfork
+        helper, which is SIGKILLed here; the respawned image gets fresh
+        stdio wired to the sim's .stdout/.stderr logs, so the
+        redirection silently vanishes. Virtual-table fds and the low-fd
+        shadows are re-established; other non-CLOEXEC native fds are
+        not. Real execve(2) preserves all of these — fixing it would
+        mean snapshotting the helper's /proc/<pid>/fd and re-dup'ing
+        into the new incarnation at spawn."""
         req = self._read_exec_request(thread, args)
         if isinstance(req, int):
             self._strace(thread, SYS_execve, args, -req)

@@ -573,8 +573,14 @@ class SyscallHandler:
     def _sys_setsockopt(self, args, ctx) -> int:
         sock = self._file(args[0])  # EBADF check
         level, optname = _i32(args[1]), _i32(args[2])
-        if level == SOL_SOCKET and optname in (SO_SNDBUF, SO_RCVBUF) \
-                and args[3] and args[4] >= 4:
+        if level == SOL_SOCKET and optname in (SO_SNDBUF, SO_RCVBUF):
+            # int-valued options: Linux rejects optlen < sizeof(int)
+            # (including negative — optlen is an int) with EINVAL, then
+            # faults on a NULL optval, instead of silently succeeding
+            if _i32(args[4]) < 4:
+                raise errors.SyscallError(errors.EINVAL)
+            if not args[3]:
+                raise errors.SyscallError(errors.EFAULT)
             # read as the kernel does (u32 comparison against the
             # ceiling): -1 is the "give me the max" idiom, not an error
             (value,) = struct.unpack("<I", self.mem.read(args[3], 4))
@@ -1806,6 +1812,7 @@ class SyscallHandler:
             victims = self._group_targets(target)
             if not victims:
                 raise errors.SyscallError(errors.ESRCH)
+            self._check_signum(sig)
             if sig == 0:
                 return 0
             # deterministic order; the caller last so its own death (or
@@ -1817,10 +1824,22 @@ class SyscallHandler:
         victim = self._target_process(target)
         if victim is None:
             raise errors.SyscallError(errors.ESRCH)
+        self._check_signum(sig)
         if sig == 0:
             return 0  # existence probe
         self._deliver_to(victim, sig)
         return 0
+
+    @staticmethod
+    def _check_signum(sig: int) -> None:
+        """valid_signal(): EINVAL for sig outside [0, 64]. Linux checks
+        this AFTER the pid lookup (check_kill_permission runs on a found
+        task), so ESRCH for a bogus pid wins over EINVAL for a bogus
+        signal. Without this a guest kill(pid, -1) would reach
+        deliver_signal's 1 << (sig-1) and crash the worker with a
+        negative-shift ValueError."""
+        if sig < 0 or sig > 64:
+            raise errors.SyscallError(errors.EINVAL)
 
     def _deliver_to(self, victim, sig: int) -> None:
         deliver = getattr(victim, "deliver_signal", None)

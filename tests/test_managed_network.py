@@ -352,3 +352,47 @@ hosts:
     stats = Manager(cfg).run()
     assert stats.process_failures == [], stats.process_failures
     assert out.read_bytes() == payload
+
+
+BAD_OPTLEN_C = r"""
+#include <errno.h>
+#include <sys/socket.h>
+
+int main(void) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return 1;
+    int v = 8192;
+    /* Linux: optlen < sizeof(int) for int-valued options is EINVAL,
+       not a silent success that never pinned the buffer. */
+    if (setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &v, 2) != -1 ||
+        errno != EINVAL) return 2;
+    if (setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &v, -1) != -1 ||
+        errno != EINVAL) return 3;
+    /* short-optlen EINVAL wins over the NULL fault; NULL with a valid
+       length faults (Linux copy_from_sockptr order) */
+    if (setsockopt(fd, SOL_SOCKET, SO_RCVBUF, 0, 4) != -1 ||
+        errno != EFAULT) return 4;
+    if (setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &v, sizeof v) != 0) return 5;
+    return 0;
+}
+"""
+
+
+def test_setsockopt_short_optlen_is_einval(tmp_path):
+    """ADVICE r3 (low): SO_SNDBUF/SO_RCVBUF with optlen < 4 (or a NULL
+    optval) must fail EINVAL like Linux, not return 0 without pinning."""
+    binary = _compile(tmp_path, "badoptlen", BAD_OPTLEN_C)
+    cfg = load_config_str(f"""
+general: {{stop_time: 5s, seed: 11}}
+network:
+  graph:
+    type: 1_gbit_switch
+hosts:
+  box:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, start_time: 1s,
+       expected_final_state: {{exited: 0}}}}
+""")
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures

@@ -129,7 +129,10 @@ def test_peek_and_trunc_semantics():
 def test_queue_overflow_surfaces_enobufs():
     """When the reply queue overflows (a DONE terminator may have been
     dropped), the next recv must fail with ENOBUFS rather than leave the
-    reader hanging for a terminator that never comes."""
+    reader hanging for a terminator that never comes. Like Linux, the
+    pending sk_err surfaces BEFORE queued data (__skb_try_recv_datagram
+    consumes sock_error() ahead of the dequeue), which is what lets a
+    libnl-style dump loop restart immediately."""
     sock = NetlinkSocket(_host())
     for i in range(40):  # 2 datagrams per dump > RECV_QUEUE_MAX=64
         sock.sendto(_req(RTM_GETLINK, NLM_F_DUMP, i), None)

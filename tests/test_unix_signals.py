@@ -316,3 +316,32 @@ def test_expected_final_state_signaled(tmp_path):
     binary = _compile(tmp_path, "self-term", SELF_SIGNALED_C)
     _run_one(tmp_path, binary, final_state="{signaled: 15}")
 
+
+
+BAD_SIGNUM_C = r"""
+#include <errno.h>
+#include <signal.h>
+#include <unistd.h>
+
+int main(void) {
+    /* Linux rejects out-of-range signals with EINVAL before delivery
+       (kill(2)); a buggy sim would crash on the negative shift. */
+    if (kill(getpid(), -1) != -1 || errno != EINVAL) return 1;
+    if (kill(getpid(), 70) != -1 || errno != EINVAL) return 2;
+    if (kill(0, -7) != -1 || errno != EINVAL) return 3; /* own group */
+    /* pid lookup precedes signal validation (check_kill_permission runs
+       on a found task): bogus pid + bogus sig is ESRCH, not EINVAL */
+    if (kill(-getpid(), -7) != -1 || errno != ESRCH) return 4;
+    if (kill(999999, 70) != -1 || errno != ESRCH) return 5;
+    if (kill(getpid(), 0) != 0) return 6; /* probe still fine */
+    return 0;
+}
+"""
+
+
+def test_kill_out_of_range_signal_is_einval(tmp_path):
+    """ADVICE r3 (medium): kill(pid, -1) / kill(pid, 70) must return
+    EINVAL like Linux instead of crashing the worker via an unchecked
+    1 << (sig-1) in deliver_signal."""
+    binary = _compile(tmp_path, "bad-signum", BAD_SIGNUM_C)
+    _run_one(tmp_path, binary)
