@@ -157,8 +157,21 @@ class ExperimentalOptions:
     # route live inter-host transport through the device plane (one device
     # round trip per scheduling round); event order matches CPU transport
     use_tpu_transport: bool = False
+    # sync: the device is authoritative — the round loop blocks on its
+    #   released deliveries each window (right when the accelerator is
+    #   locally attached: D2H is microseconds).
+    # mirrored: the CPU pushes deliveries at capture (bitwise-identical to
+    #   CPU transport by construction) while the device runs the same
+    #   ingest/step sequence asynchronously and every window's released
+    #   set is verified against the CPU ledger a few rounds later — zero
+    #   blocking pulls, for links where a D2H pull costs milliseconds
+    #   (e.g. a tunneled/disaggregated TPU; measured ~100 ms per fresh
+    #   pull on the round-4 dev machine).
+    # auto: probe the D2H round-trip at transport init and pick.
+    tpu_transport_mode: str = "auto"  # auto | sync | mirrored
     tpu_egress_cap: int = 256  # per-host device egress slots
     tpu_ingress_cap: int = 256  # per-host device in-flight slots
+    tpu_compact_cap: int = 4096  # per-window compacted-delivery slots
 
 
 @dataclass

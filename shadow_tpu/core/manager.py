@@ -10,6 +10,7 @@ next-event time (`controller.rs:80-113`).
 
 from __future__ import annotations
 
+import heapq
 import json
 import logging
 import os
@@ -210,6 +211,8 @@ class Manager:
                 self.hosts, self.routing, ip_to_node,
                 egress_cap=config.experimental.tpu_egress_cap,
                 ingress_cap=config.experimental.tpu_ingress_cap,
+                mode=config.experimental.tpu_transport_mode,
+                compact_cap=config.experimental.tpu_compact_cap,
             )
             self.shared.device_transport = self.transport
 
@@ -222,6 +225,20 @@ class Manager:
         # iteration uses this fixed shuffled order
         self._host_order = list(self.hosts)
         self.global_rng.shuffle(self._host_order)
+
+        # Active-host heap: only hosts with an event before the round end
+        # are iterated each round. Hosts announce new events through the
+        # dirty sink (one append per host per round, under their queue
+        # lock); the Manager re-keys them at round barriers. At 1k+ hosts
+        # the old iterate-everyone round loop spent more wall time polling
+        # idle hosts than executing events.
+        self._host_heap: list[tuple[int, int]] = []  # (next_t, host_id)
+        self._dirty_hosts: list = []
+        self._cross_hosts: list = []
+        self._host_by_id = {h.host_id: h for h in self.hosts}
+        for host in self.hosts:
+            host._dirty_sink = self._dirty_hosts
+            host._cross_sink = self._cross_hosts
 
         self.scheduler = make_scheduler(
             config.experimental.scheduler, self.shared, par,
@@ -383,13 +400,57 @@ class Manager:
                 )
         return failures
 
+    def _rekey_hosts(self, hosts) -> None:
+        """Recompute next-event times and re-enter the heap. Called only
+        at round barriers (hosts quiescent). Stale heap entries are
+        dropped lazily at pop time by comparing against
+        host._cached_next."""
+        heap = self._host_heap
+        for host in hosts:
+            host._dirty = False
+            t = host.next_event_time()
+            if t != host._cached_next:
+                host._cached_next = t
+                if t is not None:
+                    heapq.heappush(heap, (t, host.host_id))
+
+    def _rekey_dirty(self) -> None:
+        """Round-barrier pass: every host that gained an event since the
+        last barrier re-enters the heap (the sink list object is shared
+        with the hosts, so it is drained in place)."""
+        if self._dirty_hosts:
+            dirty = self._dirty_hosts[:]
+            self._dirty_hosts.clear()
+            self._rekey_hosts(dirty)
+
     def _min_host_event(self):
-        """Earliest pending event time across all hosts (None = all idle)."""
-        return min(
-            (t for t in (h.next_event_time() for h in self._host_order)
-             if t is not None),
-            default=None,
-        )
+        """Earliest pending event time across all hosts (None = all idle);
+        lazily discards stale heap entries."""
+        self._rekey_dirty()
+        heap = self._host_heap
+        by_id = self._host_by_id
+        while heap:
+            t, hid = heap[0]
+            if by_id[hid]._cached_next == t:
+                return t
+            heapq.heappop(heap)
+        return None
+
+    def _pop_active(self, end_ns: int) -> list:
+        """Hosts with an event before `end_ns`, in deterministic
+        (next_t, host_id) order; they leave the heap (re-keyed after the
+        round runs)."""
+        self._rekey_dirty()
+        heap = self._host_heap
+        by_id = self._host_by_id
+        active = []
+        while heap and heap[0][0] < end_ns:
+            t, hid = heapq.heappop(heap)
+            host = by_id[hid]
+            if host._cached_next == t:
+                host._cached_next = None
+                active.append(host)
+        return active
 
     # -- heartbeat / watchdogs / progress (`manager.rs:675-793`) --------
 
@@ -530,21 +591,42 @@ class Manager:
                         runahead_ns=self.runahead.get(),
                         stop_ns=self.controller.stop_time,
                     )
-                min_next = self.scheduler.run_round(self._host_order, end)
+                # only hosts with an event in this window run; everyone
+                # else keeps their heap entry untouched
+                active = self._pop_active(end)
+                # sched_min matters in sync device mode: a packet captured
+                # this round lives on NEITHER a host queue nor the device
+                # yet (ingest happens at finish_round below) — only the
+                # sending worker's next_event_time knows its deliver time
+                # (`manager.rs:430-436`)
+                sched_min = self.scheduler.run_round(active, end)
                 if self.transport is not None:
                     # barrier: ship this round's captured egress to device
                     self.transport.finish_round(start, end)
-                    t = self.transport.next_pending_abs
-                    if t is not None:
-                        min_next = t if min_next is None else min(min_next, t)
                 # round boundary: absorb watcher-thread posts (managed
-                # process deaths) into the now-quiescent host queues
-                for host in self.hosts:
-                    t = host.drain_cross_thread_tasks()
+                # process deaths) into the now-quiescent host queues.
+                # pop() one at a time: a copy-then-clear would race the
+                # watcher thread's append between the two ops and lose
+                # the host's drain forever (its sink guard only re-arms
+                # once _cross_pending empties)
+                while self._cross_hosts:
+                    self._cross_hosts.pop().drain_cross_thread_tasks()
+                # ran hosts left the heap at _pop_active; dirty hosts
+                # (event pushes during the round) re-key alongside them
+                self._rekey_hosts(active)
+                self.stats.rounds += 1
+                min_next = self._min_host_event()
+                for t in (sched_min,
+                          None if self.transport is None
+                          else self.transport.next_pending_abs):
                     if t is not None:
                         min_next = t if min_next is None else min(min_next, t)
-                self.stats.rounds += 1
                 window = self.controller.next_window(min_next)
+
+            if self.transport is not None:
+                # mirrored mode: drain the lagged device-verification
+                # pipeline before declaring the run done
+                self.transport.finalize()
 
             # absorb any managed-process death the watcher reported too
             # late for a round-boundary drain

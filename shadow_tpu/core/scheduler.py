@@ -233,6 +233,7 @@ class ThreadPerHostScheduler:
                  parallelism: int, pin_cpus: bool = True):
         self.parallelism = max(1, parallelism)
         self._hosts = list(hosts)
+        self._known = set(map(id, self._hosts))  # hosts pinned for life
         n = len(self._hosts)
         self._workers = [Worker(shared, i) for i in range(n)]
         self._pool = _RoundPool(n, pin_cpus)
@@ -243,6 +244,12 @@ class ThreadPerHostScheduler:
     def _worker_round(self, index: int) -> None:
         worker = self._workers[index]
         host = self._hosts[index]
+        if id(host) not in self._active:
+            # not in this round's active set: the host has no event
+            # before the round end, nothing to do (its thread still
+            # exists — hosts are pinned for the simulation's lifetime)
+            self._results[index] = None
+            return
         min_next: Optional[int] = None
         with self._run_slots:
             worker.start_round(self._round_end)
@@ -259,11 +266,13 @@ class ThreadPerHostScheduler:
         self._results[index] = min_next
 
     def run_round(self, hosts, round_end: int) -> Optional[int]:
-        if list(hosts) != self._hosts:
+        known = self._known
+        if any(id(h) not in known for h in hosts):
             raise ValueError(
                 "thread-per-host hosts are pinned at construction; "
-                "run_round was given a different host list"
+                "run_round was given an unknown host"
             )
+        self._active = set(map(id, hosts))
         self._results = [None] * len(self._hosts)
         self._round_end = round_end
         self._pool.run(self._worker_round)

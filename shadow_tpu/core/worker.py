@@ -172,8 +172,12 @@ class Worker:
             # event-id allocation) already happened identically, so event
             # keys — and therefore event order — match the CPU path
             transport.capture(src_host, dst_host, packet, now, src_event_id,
-                              self.round_end_time)
-            return
+                              self.round_end_time, deliver_time)
+            if not transport.mirrored:
+                return
+            # mirrored mode: the CPU push below is authoritative (bitwise
+            # CPU-transport behavior); the device runs the same window
+            # asynchronously and is verified against it a few rounds later
         dst_host.push_packet_event(
             packet, deliver_time, src_host.host_id, src_event_id
         )

@@ -61,6 +61,15 @@ class Host:
         self._queue_lock = threading.Lock()  # cross-thread packet pushes
         self._cross_lock = threading.Lock()  # cross-thread task posts
         self._cross_pending: list[TaskRef] = []
+        # Active-host tracking (the Manager's round heap): any event push
+        # appends this host once per round to the dirty sink so the
+        # Manager re-keys it at the barrier; hosts with no events before
+        # the round end are never iterated at all (at 1k+ hosts the
+        # idle-poll loop used to dominate the round cost).
+        self._dirty = False
+        self._dirty_sink: Optional[list] = None
+        self._cross_sink: Optional[list] = None
+        self._cached_next: Optional[int] = None  # Manager heap key
 
         # Deterministic ordering counters (`host.rs:159-168`).
         self._local_event_id = 0
@@ -140,11 +149,18 @@ class Host:
 
     # -- scheduling ---------------------------------------------------------
 
+    def _mark_dirty(self) -> None:
+        """Caller holds _queue_lock (or the host is quiescent)."""
+        if not self._dirty and self._dirty_sink is not None:
+            self._dirty = True
+            self._dirty_sink.append(self)
+
     def schedule_task_at(self, task: TaskRef, time_ns: int) -> None:
         assert time_ns >= self._now, "cannot schedule into the past"
         self._local_event_id += 1
         with self._queue_lock:
             self.event_queue.push(Event.new_local(time_ns, task, self._local_event_id))
+            self._mark_dirty()
 
     def schedule_task_with_delay(self, task: TaskRef, delay_ns: int) -> None:
         self.schedule_task_at(task, self._now + delay_ns)
@@ -157,6 +173,7 @@ class Host:
             self.event_queue.push(
                 Event.new_packet(time_ns, packet, src_host_id, src_event_id)
             )
+            self._mark_dirty()
 
     def post_cross_thread_task(self, task: TaskRef) -> None:
         """Queue a task from a non-worker thread (the ChildPidWatcher
@@ -166,6 +183,8 @@ class Host:
         invariant — so the Manager drains them at the next round boundary
         (`drain_cross_thread_tasks`), when the host is quiescent."""
         with self._cross_lock:
+            if not self._cross_pending and self._cross_sink is not None:
+                self._cross_sink.append(self)
             self._cross_pending.append(task)
 
     def drain_cross_thread_tasks(self) -> Optional[int]:

@@ -8,9 +8,29 @@ Payload bytes never leave the host: the (src, seq) pair correlates delivered
 metadata back to payloads buffered CPU-side.
 """
 
+import os as _os
+
 from .plane import (NetPlaneParams, NetPlaneState, ingest, ingest_rows,
                     make_params, make_state, window_step)
 from .mesh import host_sharding, make_mesh, shard_state
+
+
+def enable_compilation_cache() -> None:
+    """Turn on JAX's persistent compilation cache (idempotent). On a
+    tunneled/disaggregated TPU a single window-step compile costs 10-20 s
+    of wall time; the cache makes every run after the first pay ~nothing
+    for unchanged kernels. Safe no-op if the config knob is missing."""
+    import jax
+
+    try:
+        if not jax.config.jax_compilation_cache_dir:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                _os.path.expanduser("~/.cache/shadow_tpu_xla"))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.5)
+    except Exception:  # pragma: no cover - knob renamed/removed upstream
+        pass
 
 __all__ = [
     "NetPlaneParams",

@@ -332,6 +332,7 @@ class RouterDownState(NamedTuple):
     has_cached: jax.Array  # bool
     cached_src: jax.Array  # int32 identity carried across windows
     cached_seq: jax.Array
+    cached_sock: jax.Array  # int32 payload tag (delivered["sock"])
     cached_bytes: jax.Array
     resume: jax.Array  # int32 rel ns the relay resumes (valid iff has_cached)
     dropped: jax.Array  # int32 cumulative router drops
@@ -347,7 +348,7 @@ def make_router_state(n_hosts: int,
         dn_balance=(jnp.asarray(dn_cap, jnp.int32) if dn_cap is not None
                     else z()),
         dn_last_refill=z(), has_cached=f(), cached_src=z(), cached_seq=z(),
-        cached_bytes=z(), resume=z(), dropped=z(),
+        cached_sock=z(), cached_bytes=z(), resume=z(), dropped=z(),
     )
 
 
@@ -551,7 +552,8 @@ def _route_one_host(arrival, size, window_ns, dn_rate, dn_cap, st):
         has_drop_next=has_dn, drop_next=dn, cur_count=cur, prev_count=prev,
         dn_balance=bal, dn_last_refill=lref, has_cached=has_c,
         cached_src=st.cached_src, cached_seq=st.cached_seq,
-        cached_bytes=c_size, resume=resume, dropped=dropped,
+        cached_sock=st.cached_sock, cached_bytes=c_size, resume=resume,
+        dropped=dropped,
     )
     return st_out, status, deliver_t, co_mask, co_t, c_idx
 

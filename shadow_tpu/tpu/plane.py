@@ -88,6 +88,7 @@ class NetPlaneState(NamedTuple):
     in_src: jax.Array  # int32 source host index
     in_bytes: jax.Array  # int32
     in_seq: jax.Array  # int32
+    in_sock: jax.Array  # int32 payload tag (socket id / pool slot)
     in_deliver_rel: jax.Array  # int32 ns relative to current window start
     in_valid: jax.Array  # bool
     # scalars per host: [N]
@@ -184,6 +185,7 @@ def make_state(n_hosts: int, egress_cap: int = 32, ingress_cap: int = 64,
         in_src=jnp.full((N, CI), -1, jnp.int32),
         in_bytes=z((N, CI)),
         in_seq=z((N, CI)),
+        in_sock=z((N, CI)),
         in_deliver_rel=jnp.full((N, CI), I32_MAX, jnp.int32),
         in_valid=jnp.zeros((N, CI), bool),
         tb_balance=(jnp.asarray(initial_tokens, jnp.int32)
@@ -382,6 +384,30 @@ def chain_windows(state: NetPlaneState, params: NetPlaneParams,
         cond, body, (state, delivered, jnp.int32(0), next_ev, jnp.int32(1)),
     )
     return state, delivered, off, next_ev, n
+
+
+def compact_delivered(delivered: dict, cap: int):
+    """Compress a [N, CI] delivered dict into fixed-[cap] columns for cheap
+    device->host transfer: (count, dst, src, seq, sock, deliver_rel).
+
+    The full delivered arrays are N*CI slots of which only a handful are
+    usually due per window; pulling them raw costs a whole-array D2H
+    transfer per round (the round-3 rung-3 timeout). A stable argsort on
+    ~mask front-packs the due slots in row-major order — dst recovered from
+    the flat index — so the host reads 5 short columns and a count. If
+    count > cap the tail was truncated: callers must detect that and fall
+    back to pulling the full arrays (it means ingress_cap-scale bursts;
+    raise the compact cap)."""
+    mask = delivered["mask"]
+    N, CI = mask.shape
+    flat = mask.reshape(-1)
+    n = flat.sum(dtype=jnp.int32)
+    idx = jnp.argsort(~flat, stable=True)[:cap]
+    take = lambda a: a.reshape(-1)[idx]
+    dst = (idx // CI).astype(jnp.int32)
+    dst = jnp.where(take(mask), dst, -1)  # mark dead slots
+    return (n, dst, take(delivered["src"]), take(delivered["seq"]),
+            take(delivered["sock"]), take(delivered["deliver_rel"]))
 
 
 def ingest_rows(state: NetPlaneState, dst: jax.Array, nbytes: jax.Array,
@@ -586,9 +612,10 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     # --- 4. compact surviving ingress (front-packed for the scatter) -----
     inv_in = (~state.in_valid).astype(jnp.int32)
     key_deliver = jnp.where(state.in_valid, in_deliver, I32_MAX)
-    _, in_deliver_c, in_src_c, in_seq_c, in_bytes_c, in_valid_c = _row_sort(
-        inv_in, key_deliver, state.in_src, state.in_seq, state.in_bytes,
-        state.in_valid, keys=2,
+    (_, in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
+     in_valid_c) = _row_sort(
+        inv_in, key_deliver, state.in_src, state.in_seq, state.in_sock,
+        state.in_bytes, state.in_valid, keys=2,
     )
     n_valid_in = in_valid_c.sum(axis=1).astype(jnp.int32)  # [N]
 
@@ -603,13 +630,15 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     flat_src = jnp.broadcast_to(host_idx, (N, CE)).reshape(-1)
     flat_seq = eg_seq.reshape(-1)
     flat_bytes = eg_bytes.reshape(-1)
+    flat_sock = eg_sock.reshape(-1)
 
     # deterministic insertion order per destination: ONE variadic sort
     # moves the payload columns through the sorting network — applying a
     # lexsort permutation with per-column gathers costs ~0.5 ms per
     # column at 65k slots on TPU (arbitrary-index gathers are DMA-bound)
-    (o_dst, o_deliver, o_src, o_seq, o_bytes, o_sent) = jax.lax.sort(
-        (flat_dst, flat_deliver, flat_src, flat_seq, flat_bytes, flat_sent),
+    (o_dst, o_deliver, o_src, o_seq, o_bytes, o_sock, o_sent) = jax.lax.sort(
+        (flat_dst, flat_deliver, flat_src, flat_seq, flat_bytes, flat_sock,
+         flat_sent),
         dimension=0, is_stable=True, num_keys=4,
     )
     flat_idx, ok, overflowed = _scatter_append(o_dst, o_sent, n_valid_in, CI, N)
@@ -619,6 +648,7 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
 
     in_src_m = scatter(in_src_c, o_src)
     in_seq_m = scatter(in_seq_c, o_seq)
+    in_sock_m = scatter(in_sock_c, o_sock)
     in_bytes_m = scatter(in_bytes_c, o_bytes)
     in_deliver_m = scatter(
         jnp.where(in_valid_c, in_deliver_c, I32_MAX), o_deliver
@@ -634,9 +664,9 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
         # the CPU plane's event queue feeds route_incoming_packet.
         inv_m = (~in_valid_m).astype(jnp.int32)
         arr_key = jnp.where(in_valid_m, in_deliver_m, I32_MAX)
-        (_, arr_s, src_s2, seq_s2, bytes_s2, valid_s2) = _row_sort(
-            inv_m, arr_key, in_src_m, in_seq_m, in_bytes_m, in_valid_m,
-            keys=4,
+        (_, arr_s, src_s2, seq_s2, sock_s2, bytes_s2, valid_s2) = _row_sort(
+            inv_m, arr_key, in_src_m, in_seq_m, in_sock_m, in_bytes_m,
+            in_valid_m, keys=4,
         )
         rt2, rstatus, r_dt, co_mask, co_t, c_idx = codel.router_drain(
             arr_s, bytes_s2, window_ns, params.dn_rate, params.dn_cap, rt,
@@ -649,6 +679,7 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
         rt2 = rt2._replace(
             cached_src=jnp.where(new_cached, take(src_s2), rt.cached_src),
             cached_seq=jnp.where(new_cached, take(seq_s2), rt.cached_seq),
+            cached_sock=jnp.where(new_cached, take(sock_s2), rt.cached_sock),
         )
         # delivered = forwarded row entries + (maybe) the prior window's
         # relay-cached packet, presented in (deliver_t, src, seq) order
@@ -656,26 +687,27 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
         d_mask0 = jnp.concatenate([fwd_rows, co_mask[:, None]], axis=1)
         d_src0 = jnp.concatenate([src_s2, rt.cached_src[:, None]], axis=1)
         d_seq0 = jnp.concatenate([seq_s2, rt.cached_seq[:, None]], axis=1)
+        d_sock0 = jnp.concatenate([sock_s2, rt.cached_sock[:, None]], axis=1)
         d_bytes0 = jnp.concatenate([bytes_s2, rt.cached_bytes[:, None]],
                                    axis=1)
         d_t0 = jnp.concatenate(
             [jnp.where(fwd_rows, r_dt, I32_MAX),
              jnp.where(co_mask, co_t, I32_MAX)[:, None]], axis=1)
-        (_, d_t, d_src, d_seq, d_bytes, d_due) = _row_sort(
-            (~d_mask0).astype(jnp.int32), d_t0, d_src0, d_seq0, d_bytes0,
-            d_mask0, keys=4,
+        (_, d_t, d_src, d_seq, d_sock, d_bytes, d_due) = _row_sort(
+            (~d_mask0).astype(jnp.int32), d_t0, d_src0, d_seq0, d_sock0,
+            d_bytes0, d_mask0, keys=4,
         )
         delivered = {
-            "mask": d_due, "src": d_src, "seq": d_seq, "bytes": d_bytes,
-            "deliver_rel": d_t,
+            "mask": d_due, "src": d_src, "seq": d_seq, "sock": d_sock,
+            "bytes": d_bytes, "deliver_rel": d_t,
         }
         due = d_due  # for the n_delivered counter
         # surviving queue = the untouched FIFO suffix, re-front-packed
         keep = valid_s2 & (rstatus == codel.STATUS_QUEUED)
-        (_, in_deliver_new, in_src_new, in_seq_new, in_bytes_new,
-         in_valid_new) = _row_sort(
+        (_, in_deliver_new, in_src_new, in_seq_new, in_sock_new,
+         in_bytes_new, in_valid_new) = _row_sort(
             (~keep).astype(jnp.int32), jnp.where(keep, arr_s, I32_MAX),
-            src_s2, seq_s2, bytes_s2, keep, keys=2,
+            src_s2, seq_s2, sock_s2, bytes_s2, keep, keys=2,
         )
         rt_out = rt2
     else:
@@ -686,17 +718,19 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
         # the row tail in deterministic (deliver_t, src, seq) presentation
         # order
         is_due = due.astype(jnp.int32)
-        _, d_t, d_src, d_seq, d_bytes, d_due, d_valid = _row_sort(
+        (_, d_t, d_src, d_seq, d_sock, d_bytes, d_due,
+         d_valid) = _row_sort(
             is_due, jnp.where(in_valid_m, in_deliver_m, I32_MAX), in_src_m,
-            in_seq_m, in_bytes_m, due, in_valid_m, keys=4,
+            in_seq_m, in_sock_m, in_bytes_m, due, in_valid_m, keys=4,
         )
         delivered = {
-            "mask": d_due, "src": d_src, "seq": d_seq, "bytes": d_bytes,
-            "deliver_rel": d_t,
+            "mask": d_due, "src": d_src, "seq": d_seq, "sock": d_sock,
+            "bytes": d_bytes, "deliver_rel": d_t,
         }
         in_valid_new = d_valid & ~d_due
         in_deliver_new = jnp.where(in_valid_new, d_t, I32_MAX)
         in_src_new, in_seq_new, in_bytes_new = d_src, d_seq, d_bytes
+        in_sock_new = d_sock
         rt_out = rt
 
     # --- 6. compact leftover egress so rows stay front-packed for ingest
@@ -724,7 +758,8 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
         eg_seq=eg_seq_c, eg_ctrl=eg_ctrl_c, eg_tsend=eg_tsend_c,
         eg_clamp=eg_clamp_c, eg_sock=eg_sock_c, eg_valid=eg_valid_c,
         in_src=in_src_new, in_bytes=in_bytes_new, in_seq=in_seq_new,
-        in_deliver_rel=in_deliver_new, in_valid=in_valid_new,
+        in_sock=in_sock_new, in_deliver_rel=in_deliver_new,
+        in_valid=in_valid_new,
         tb_balance=balance, tb_rem_ns=tb_rem_ns, rng_counter=rng_counter,
         rr_sent=rr_sent, router=rt_out,
         n_sent=state.n_sent + sent.sum(axis=1, dtype=jnp.int32),

@@ -65,8 +65,14 @@ def test_thread_per_host_pins_hosts():
     try:
         assert sched.run_round(hosts, 10**9) == 40
         assert all(h.executed == 1 for h in hosts)
+        # an active SUBSET runs only those hosts (the Manager's
+        # active-host heap hands the scheduler just the hosts with an
+        # event this round); pinned threads for the rest stay parked
+        assert sched.run_round(hosts[:2], 10**9) == 40
+        assert [h.executed for h in hosts] == [2, 2, 1]
+        # a host the scheduler was never constructed with is an error
         with pytest.raises(ValueError):
-            sched.run_round(hosts[:2], 10**9)
+            sched.run_round([FakeHost()], 10**9)
     finally:
         sched.join()
 

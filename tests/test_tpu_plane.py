@@ -535,3 +535,49 @@ def test_rr_survives_idle_window():
     assert order == [0, 3, 1, 4, 2, 5]
     # counters stay rebased near zero even after many windows
     assert int(np.asarray(state.rr_sent).max()) <= 64
+
+
+def test_compact_delivered_matches_mask():
+    """plane.compact_delivered front-packs exactly the delivered slots:
+    (dst, src, seq, sock, deliver) recovered from the compact columns must
+    equal the set read straight off the [N, CI] mask — the small-transfer
+    contract consumers (flow-engine result extraction) rely on."""
+    from shadow_tpu.tpu.plane import compact_delivered, ingest, window_step
+
+    n = 8
+    lat = np.full((n, n), 2 * MS, np.int64)
+    np.fill_diagonal(lat, MS)
+    params = make_params(lat, np.zeros((n, n), np.float32), np.full(n, 1e9))
+    state = make_state(n, initial_tokens=np.asarray(params.tb_cap))
+    key = jax.random.PRNGKey(0)
+    b = 12
+    state = ingest(
+        state,
+        jnp.arange(b, dtype=jnp.int32) % n,
+        (jnp.arange(b, dtype=jnp.int32) + 3) % n,
+        jnp.full(b, 500, jnp.int32), jnp.zeros(b, jnp.int32),
+        jnp.arange(b, dtype=jnp.int32), jnp.zeros(b, bool),
+        sock=jnp.arange(b, dtype=jnp.int32) + 100,
+    )
+    # window 1 sends (NO_CLAMP = deliveries clamp to this window's end);
+    # window 2 releases them
+    state, delivered, _ = window_step(
+        state, params, key, jnp.int32(0), jnp.int32(5 * MS))
+    state, delivered, _ = window_step(
+        state, params, key, jnp.int32(5 * MS), jnp.int32(5 * MS))
+    cnt, dst, src, seq, sock, d_t = jax.device_get(
+        compact_delivered(delivered, 16))
+    mask = np.asarray(delivered["mask"])
+    want = set()
+    rows, cols = np.nonzero(mask)
+    for r, c in zip(rows, cols):
+        want.add((int(r), int(np.asarray(delivered["src"])[r, c]),
+                  int(np.asarray(delivered["seq"])[r, c]),
+                  int(np.asarray(delivered["sock"])[r, c]),
+                  int(np.asarray(delivered["deliver_rel"])[r, c])))
+    got = {(int(dst[i]), int(src[i]), int(seq[i]), int(sock[i]),
+            int(d_t[i])) for i in range(int(cnt))}
+    assert int(cnt) == mask.sum() == len(want) > 0
+    assert got == want
+    # dead tail slots are marked with dst == -1
+    assert all(int(d) == -1 for d in dst[int(cnt):])

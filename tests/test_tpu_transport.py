@@ -83,7 +83,7 @@ hosts:
 """
 
 
-def _run_traced(cfg_text: str):
+def _run_traced(cfg_text: str, mode: str | None = None):
     """Run a config collecting the full packet-status event stream — a
     complete witness of packet event order and timing."""
     trace = []
@@ -98,6 +98,13 @@ def _run_traced(cfg_text: str):
             int(status), packet.src, packet.dst, packet.payload_size(),
         ))
 
+    if mode is not None:
+        # the experimental block is inline in these configs: splice the
+        # mode into it
+        assert "use_tpu_transport: true" in cfg_text
+        cfg_text = cfg_text.replace(
+            "use_tpu_transport: true",
+            f"use_tpu_transport: true, tpu_transport_mode: {mode}")
     cfg = load_config_str(cfg_text)
     mgr = Manager(cfg)
     old = packet_mod.status_trace_hook
@@ -107,14 +114,15 @@ def _run_traced(cfg_text: str):
     finally:
         packet_mod.status_trace_hook = old
     assert stats.process_failures == [], stats.process_failures
-    return stats, trace
+    return stats, trace, mgr
 
 
+@pytest.mark.parametrize("mode", ["sync", "mirrored"])
 @pytest.mark.parametrize("cfg", [BASIC, PHOLD, LOSSY],
                          ids=["basic-file-transfer", "phold", "lossy"])
-def test_device_transport_matches_cpu_bitwise(cfg):
-    s_cpu, t_cpu = _run_traced(cfg.format(device="false"))
-    s_dev, t_dev = _run_traced(cfg.format(device="true"))
+def test_device_transport_matches_cpu_bitwise(cfg, mode):
+    s_cpu, t_cpu, _ = _run_traced(cfg.format(device="false"))
+    s_dev, t_dev, mgr = _run_traced(cfg.format(device="true"), mode=mode)
     assert s_cpu.packets_sent == s_dev.packets_sent
     assert s_cpu.packets_dropped == s_dev.packets_dropped
     assert len(t_cpu) == len(t_dev)
@@ -122,10 +130,77 @@ def test_device_transport_matches_cpu_bitwise(cfg):
     # every host at the same simulated time in the same order
     for i, (a, b) in enumerate(zip(t_cpu, t_dev)):
         assert a == b, f"trace diverges at index {i}: cpu={a} device={b}"
+    if mode == "mirrored":
+        # the async device pipeline verified every window against the CPU
+        # ledger and found no divergence
+        t = mgr.transport
+        assert t.divergence_count == 0
+        assert t.verified_windows > 0
+        assert t.verified_packets > 0
+        assert t.in_flight == 0  # every tag came back and was freed
 
 
 def test_device_transport_deterministic_across_runs():
-    s1, t1 = _run_traced(PHOLD.format(device="true"))
-    s2, t2 = _run_traced(PHOLD.format(device="true"))
+    s1, t1, _ = _run_traced(PHOLD.format(device="true"))
+    s2, t2, _ = _run_traced(PHOLD.format(device="true"))
     assert t1 == t2
     assert (s1.rounds, s1.packets_sent) == (s2.rounds, s2.packets_sent)
+
+
+def test_mirrored_detects_divergence():
+    """The on-device verification is live: corrupt one expected deliver
+    time before upload and the device divergence counter must move."""
+    cfg = load_config_str(
+        PHOLD.format(device="true").replace(
+            "use_tpu_transport: true",
+            "use_tpu_transport: true, tpu_transport_mode: mirrored"))
+    mgr = Manager(cfg)
+    t = mgr.transport
+    orig = t._pop_expected
+    poisoned = {"done": False}
+
+    def poison(end_ns):
+        expected = orig(end_ns)
+        if not poisoned["done"] and expected:
+            deliver, tag = expected[0]
+            expected[0] = (deliver + 1, tag)  # ledger now off by 1 ns
+            poisoned["done"] = True
+        return expected
+
+    t._pop_expected = poison
+    mgr.run()
+    assert poisoned["done"], "no window with expected deliveries seen"
+    assert t.divergence_count >= 1
+
+
+def test_mirrored_survives_sparse_window_gaps():
+    """Windows driven by far-apart events (seconds of idle sim time
+    between rounds) must not overflow the int32 device shift: records
+    pending flush used to pin in_flight > 0, blocking the base teleport,
+    and the next record's shift wrapped (review r4 finding). The late
+    second client makes the controller jump ~50 simulated seconds after
+    the first exchange completes."""
+    cfg = load_config_str("""
+general: {stop_time: 60s, seed: 9}
+network: {graph: {type: 1_gbit_switch}}
+experimental: {use_tpu_transport: true, tpu_transport_mode: mirrored}
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - {path: udp-echo-server, args: ["9000"], start_time: 1s,
+       expected_final_state: running}
+  early:
+    network_node_id: 0
+    processes:
+    - {path: udp-client, args: ["server", "9000", "100", "3"], start_time: 2s}
+  late:
+    network_node_id: 0
+    processes:
+    - {path: udp-client, args: ["server", "9000", "100", "3"], start_time: 55s}
+""")
+    mgr = Manager(cfg)
+    stats = mgr.run()
+    assert stats.process_failures == [], stats.process_failures
+    assert mgr.transport.divergence_count == 0
+    assert mgr.transport.verified_windows > 0
