@@ -220,6 +220,12 @@ def _set_rto(s, ms):
     return s._replace(rto_ms=jnp.clip(ms, RTO_MIN_MS, RTO_MAX_MS))
 
 
+def _rto_from_estimate(srtt_ms, rttvar_ms):
+    """Device twin of rtt.py:_rto_from_estimate (Linux mdev floor);
+    change BOTH or the bitwise-parity contract breaks."""
+    return srtt_ms + 4 * jnp.maximum(rttvar_ms, RTO_MIN_MS // 4)
+
+
 def _rtt_update(s, rtt_ms):
     """RttEstimator.update (callers gate on backoff_count == 0)."""
     rtt_ms = jnp.maximum(1, rtt_ms)
@@ -229,7 +235,7 @@ def _rtt_update(s, rtt_ms):
         (3 * s.rttvar_ms) // 4 + jnp.abs(s.srtt_ms - rtt_ms) // 4)
     srtt = jnp.where(first, rtt_ms, (7 * s.srtt_ms) // 8 + rtt_ms // 8)
     s = s._replace(srtt_ms=srtt, rttvar_ms=rttvar, backoff_count=jnp.int32(0))
-    return _set_rto(s, srtt + 4 * rttvar)
+    return _set_rto(s, _rto_from_estimate(srtt, rttvar))
 
 
 def _rtt_backoff(s):
@@ -241,7 +247,8 @@ def _rtt_reset_backoff(s):
     had = s.backoff_count > 0
     s2 = s._replace(backoff_count=jnp.int32(0))
     s2 = _set_rto(s2, jnp.where(s.srtt_ms > 0,
-                                s.srtt_ms + 4 * s.rttvar_ms, RTO_INIT_MS))
+                                _rto_from_estimate(s.srtt_ms, s.rttvar_ms),
+                                RTO_INIT_MS))
     return _sel(had, s2, s)
 
 
