@@ -35,19 +35,40 @@ def _unref(file) -> None:
         file.close()
 
 
+# The canonical fd-geometry constants (syscall_handler and managed.py
+# import these — one definition, no comment-tied copies):
+# virtual fds live at VFD_BASE + slot; the limit the GUEST sees from
+# getrlimit/prlimit64 is VISIBLE_FD_LIMIT (it must cover the virtual
+# range — glibc validates fds against sysconf(_SC_OPEN_MAX)); the
+# kernel-enforced cap on the NATIVE table at spawn is VFD_BASE, so
+# native fds can never collide with virtual ones. Everything stays
+# below FD_SETSIZE so select() on virtual fds is legal.
+VFD_BASE = 700
+VISIBLE_FD_LIMIT = 1024
+assert VISIBLE_FD_LIMIT <= 1024  # FD_SETSIZE
+
+
 class DescriptorTable:
+    # allocation past the visible limit is EMFILE / EBADF, exactly what
+    # a process at its fd limit sees
+    CAPACITY = VISIBLE_FD_LIMIT - VFD_BASE
+
     def __init__(self):
         self._table: dict[int, Descriptor] = {}
 
     def register(self, file, cloexec: bool = False) -> int:
         fd = self._lowest_free()
+        if fd >= self.CAPACITY:
+            raise errors.SyscallError(errors.EMFILE)
         self._table[fd] = Descriptor(file, cloexec)
         _ref(file)
         return fd
 
     def register_at(self, fd: int, file, cloexec: bool = False) -> int:
-        """dup2-style: closes whatever occupied fd first."""
-        if fd < 0:
+        """dup2-style: closes whatever occupied fd first. A target past
+        the visible fd limit is EBADF like Linux's dup2 past
+        RLIMIT_NOFILE."""
+        if fd < 0 or fd >= self.CAPACITY:
             raise errors.SyscallError(errors.EBADF)
         if fd in self._table:
             self.close(fd)

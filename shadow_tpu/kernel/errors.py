@@ -38,6 +38,8 @@ ENFILE = _errno.ENFILE
 EMFILE = _errno.EMFILE
 EFAULT = _errno.EFAULT
 ESPIPE = _errno.ESPIPE
+ENODEV = _errno.ENODEV
+EACCES = _errno.EACCES
 ECHILD = _errno.ECHILD
 ESRCH = _errno.ESRCH
 EPERM = _errno.EPERM

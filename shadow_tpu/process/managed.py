@@ -24,6 +24,7 @@ import ctypes
 import ctypes.util
 import logging
 import os
+import resource
 import struct
 import subprocess
 import threading
@@ -398,6 +399,7 @@ class ManagedSimProcess:
         self.proc = None
         self._death_seen = False
         self._output_dir = output_dir
+        self._cwd: Optional[str] = None  # per-host data dir once spawned
         self._stdout = self._stderr = None
         self._tindex_counter = 0
         self.strace = None  # StraceLogger when strace_logging_mode is on
@@ -461,6 +463,9 @@ class ManagedSimProcess:
         # ref in the child table)
         self.handler.sig_actions = dict(parent.handler.sig_actions)
         self.handler._low_overrides = dict(parent.handler._low_overrides)
+        # fork(2) inherits rlimits and nice
+        self.handler._rlimits = dict(parent.handler._rlimits)
+        self.handler._nice = parent.handler._nice
         from .strace import make_logger
 
         self._strace_mode = getattr(parent, "_strace_mode", "off")
@@ -497,6 +502,8 @@ class ManagedSimProcess:
             self, table=parent.handler._table.fork_into())
         self.handler._low_overrides = dict(parent.handler._low_overrides)
         self.handler.sig_actions = dict(parent.handler.sig_actions)
+        self.handler._rlimits = dict(parent.handler._rlimits)
+        self.handler._nice = parent.handler._nice
         self.handler.futexes = parent.handler.futexes  # shared VM
         self.server.mem = parent.server.mem  # shared VM
         self.pgid = parent.pgid
@@ -619,8 +626,40 @@ class ManagedSimProcess:
                                              f"{self.name}.stdout"), "wb")
             self._stderr = open(os.path.join(self._output_dir,
                                              f"{self.name}.stderr"), "wb")
+        # Per-host filesystem view (`regular_file.c:277-329` + the
+        # reference's per-host data dirs): the process starts in ITS
+        # host's data directory, so two hosts writing the same relative
+        # filename land in separate per-host trees instead of colliding
+        # in the simulator's cwd. An execve re-spawn passes the old
+        # image's live cwd through self._cwd (chdir survives exec).
+        cwd = self._cwd
+        if cwd is None and self._output_dir:
+            cwd = self._cwd = os.path.abspath(self._output_dir)
+
+        # The virtual descriptor range starts at VFD_BASE (= 700, kept
+        # below FD_SETSIZE so select() works on virtual fds). Cap the
+        # NATIVE table so the kernel can never hand out an fd that
+        # collides with it — the process just sees EMFILE at 700 open
+        # files, like any rlimit-ed process. The VISIBLE limit is
+        # different: getrlimit/prlimit64 are virtualized to report 1024
+        # (the whole native+virtual range) because glibc validates fds
+        # against sysconf(_SC_OPEN_MAX) — e.g.
+        # posix_spawn_file_actions_adddup2 rejects any fd >= the soft
+        # limit with EBADF at ADD time, which would make every virtual
+        # fd unusable in file actions. The preexec closure runs
+        # post-fork: it must only make the one syscall (no imports, no
+        # allocation — resource is imported at module scope).
+        # clamp to the simulator's own hard limit: asking for (700, 700)
+        # under e.g. `ulimit -Hn 512` would raise EPERM in preexec and
+        # abort every spawn
+        _fd_cap = min(700, resource.getrlimit(resource.RLIMIT_NOFILE)[1])
+
+        def _limit_fds():
+            resource.setrlimit(resource.RLIMIT_NOFILE, (_fd_cap, _fd_cap))
+
         self.proc = subprocess.Popen(
-            argv, env=env, executable=executable,
+            argv, env=env, executable=executable, cwd=cwd,
+            preexec_fn=_limit_fds,
             stdout=self._stdout or subprocess.DEVNULL,
             stderr=self._stderr or subprocess.DEVNULL,
         )
@@ -1075,6 +1114,14 @@ class ManagedSimProcess:
         # retire the old native incarnation: no more death callbacks for
         # the old pid, no replies to its shim — just kill and reap it
         old_pid = self.server.native_pid
+        # cwd survives execve(2): snapshot the live incarnation's before
+        # it dies so a chdir()-then-exec sequence respawns in the right
+        # directory (exec-as-respawn would otherwise reset to the
+        # initial per-host dir)
+        try:
+            self._cwd = os.readlink(f"/proc/{old_pid}/cwd")
+        except OSError:
+            pass  # already gone: keep the previous cwd
         old_proc, self.proc = self.proc, None
         from .pidwatcher import get_watcher
 

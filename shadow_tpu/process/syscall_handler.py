@@ -38,7 +38,9 @@ from typing import Optional
 from ..core import simtime
 from ..kernel import errors
 from ..kernel import futex as kfutex
-from ..kernel.descriptor import DescriptorTable
+from ..kernel.descriptor import (VFD_BASE as _VFD_BASE,
+                                 VISIBLE_FD_LIMIT,
+                                 DescriptorTable)
 from ..kernel.epoll import Epoll, EpollEvents
 from ..kernel.eventfd import EventFd
 from ..kernel.pipe import PipeReader, PipeWriter, make_pipe
@@ -87,6 +89,50 @@ SYS_setsid = 112
 SYS_getpgid = 121
 SYS_getsid = 124
 SYS_sched_setaffinity = 203
+# memory-mapping family (region bookkeeping + validated passthrough)
+SYS_mmap = 9
+SYS_mprotect = 10
+SYS_munmap = 11
+SYS_brk = 12
+SYS_mremap = 25
+SYS_msync = 26
+SYS_madvise = 28
+SYS_mlock = 149
+SYS_munlock = 150
+SYS_mlockall = 151
+SYS_munlockall = 152
+# credentials (virtualized: deterministic simulated identity)
+SYS_getuid = 102
+SYS_getgid = 104
+SYS_setuid = 105
+SYS_setgid = 106
+SYS_geteuid = 107
+SYS_getegid = 108
+SYS_getgroups = 115
+SYS_setgroups = 116
+SYS_setresuid = 117
+SYS_getresuid = 118
+SYS_setresgid = 119
+SYS_getresgid = 120
+# resource limits / accounting (virtualized: deterministic)
+SYS_getrlimit = 97
+SYS_getrusage = 98
+SYS_setrlimit = 160
+SYS_prlimit64 = 302
+# scheduling / priority (virtualized: single deterministic CPU model)
+SYS_getpriority = 140
+SYS_setpriority = 141
+SYS_sched_getparam = 143
+SYS_sched_setscheduler = 144
+SYS_sched_getscheduler = 145
+# privileged operations (deterministic unprivileged denial)
+SYS_chroot = 161
+SYS_settimeofday = 164
+SYS_mount = 165
+SYS_umount2 = 166
+SYS_clock_settime = 227
+# zero-copy file->socket
+SYS_sendfile = 40
 SYS_clock_getres = 229
 SYS_timerfd_create = 283
 SYS_eventfd = 284
@@ -246,7 +292,7 @@ def _i64(v: int) -> int:
 class SyscallHandler:
     """One per managed process (`SyscallHandler` in `handler/mod.rs`)."""
 
-    VFD_BASE = 700  # above real fds, below FD_SETSIZE
+    VFD_BASE = _VFD_BASE  # above real fds, below FD_SETSIZE
 
     def __init__(self, process, table: Optional[DescriptorTable] = None):
         self.process = process
@@ -273,6 +319,10 @@ class SyscallHandler:
         self._itimer_gen = 0
         # stable st_ino assignment for virtual descriptors
         self._ino_counter = 0
+        # guest-set resource limits and nice value — fork(2) inherits
+        # both (copied by managed.forked()/vfork_placeholder())
+        self._rlimits: dict[int, tuple[int, int]] = {}
+        self._nice = 0
         # per-syscall dispatch tally for sim-stats (first dispatches only;
         # condition-wakeup re-dispatches of the same call don't re-count)
         self.syscall_counts: dict[int, int] = {}
@@ -2019,6 +2069,266 @@ class SyscallHandler:
 
     # -- table ----------------------------------------------------------
 
+    # -- simulated identity (`handler/uid.rs` moral equivalent) ----------
+    # Every managed process runs as the same deterministic unprivileged
+    # identity regardless of which real user runs the simulator — results
+    # must not depend on the invoking machine's uid.
+
+    SIM_UID = 1000
+    SIM_GID = 1000
+
+    def _sys_getuid(self, args, ctx) -> int:
+        return self.SIM_UID
+
+    _sys_geteuid = _sys_getuid
+
+    def _sys_getgid(self, args, ctx) -> int:
+        return self.SIM_GID
+
+    _sys_getegid = _sys_getgid
+
+    def _sys_setuid(self, args, ctx) -> int:
+        if _i32(args[0]) != self.SIM_UID:
+            raise errors.SyscallError(errors.EPERM)
+        return 0
+
+    def _sys_setgid(self, args, ctx) -> int:
+        if _i32(args[0]) != self.SIM_GID:
+            raise errors.SyscallError(errors.EPERM)
+        return 0
+
+    def _sys_setresuid(self, args, ctx) -> int:
+        # each of ruid/euid/suid must be -1 (keep) or the current id
+        for a in args[:3]:
+            if _i32(a) not in (-1, self.SIM_UID):
+                raise errors.SyscallError(errors.EPERM)
+        return 0
+
+    def _sys_setresgid(self, args, ctx) -> int:
+        for a in args[:3]:
+            if _i32(a) not in (-1, self.SIM_GID):
+                raise errors.SyscallError(errors.EPERM)
+        return 0
+
+    def _sys_getresuid(self, args, ctx) -> int:
+        for ptr in args[:3]:
+            if not ptr:
+                raise errors.SyscallError(errors.EFAULT)
+            self.mem.write(ptr, struct.pack("<I", self.SIM_UID))
+        return 0
+
+    def _sys_getresgid(self, args, ctx) -> int:
+        for ptr in args[:3]:
+            if not ptr:
+                raise errors.SyscallError(errors.EFAULT)
+            self.mem.write(ptr, struct.pack("<I", self.SIM_GID))
+        return 0
+
+    def _sys_getgroups(self, args, ctx) -> int:
+        size, ptr = _i32(args[0]), args[1]
+        if size == 0:
+            return 1
+        if size < 1:
+            raise errors.SyscallError(errors.EINVAL)
+        self.mem.write(ptr, struct.pack("<I", self.SIM_GID))
+        return 1
+
+    def _sys_setgroups(self, args, ctx) -> int:
+        raise errors.SyscallError(errors.EPERM)  # needs CAP_SETGID
+
+    # -- resource limits / accounting (deterministic) --------------------
+    # The VISIBLE fd limit (1024) deliberately exceeds the KERNEL limit
+    # on the native table (700, set at spawn): virtual fds live in
+    # [700, 1024) and glibc validates fds against sysconf(_SC_OPEN_MAX)
+    # — with the kernel value visible, posix_spawn_file_actions_adddup2
+    # would reject every virtual fd with EBADF at add time.
+
+    RLIM_INFINITY = 0xFFFFFFFFFFFFFFFF
+    RLIMIT_NOFILE = 7
+    RLIM_NOFILE = VISIBLE_FD_LIMIT
+
+    def _rlimit(self, resource_id: int) -> tuple[int, int]:
+        custom = self._rlimits.get(resource_id)
+        if custom is not None:
+            return custom
+        if resource_id == self.RLIMIT_NOFILE:
+            return (self.RLIM_NOFILE, self.RLIM_NOFILE)
+        return (self.RLIM_INFINITY, self.RLIM_INFINITY)
+
+    def _set_rlimit(self, resource_id: int, soft: int, hard: int) -> None:
+        if soft > hard:
+            raise errors.SyscallError(errors.EINVAL)
+        _old_soft, old_hard = self._rlimit(resource_id)
+        if hard > old_hard:
+            raise errors.SyscallError(errors.EPERM)  # raising needs CAP
+        self._rlimits[resource_id] = (soft, hard)
+
+    def _sys_getrlimit(self, args, ctx) -> int:
+        if _i32(args[0]) < 0 or _i32(args[0]) > 15:
+            raise errors.SyscallError(errors.EINVAL)
+        soft, hard = self._rlimit(_i32(args[0]))
+        self.mem.write(args[1], struct.pack("<QQ", soft, hard))
+        return 0
+
+    def _sys_setrlimit(self, args, ctx) -> int:
+        if _i32(args[0]) < 0 or _i32(args[0]) > 15:
+            raise errors.SyscallError(errors.EINVAL)
+        soft, hard = struct.unpack("<QQ", self.mem.read(args[1], 16))
+        self._set_rlimit(_i32(args[0]), soft, hard)
+        return 0
+
+    def _sys_prlimit64(self, args, ctx) -> int:
+        pid, res, new_ptr, old_ptr = (_i32(args[0]), _i32(args[1]),
+                                      args[2], args[3])
+        if pid not in (0, self.process.pid):
+            # cross-process limit surgery isn't modeled
+            raise errors.SyscallError(
+                errors.ESRCH if self._proc_by_vpid(pid) is None
+                else errors.EPERM)
+        if res < 0 or res > 15:
+            raise errors.SyscallError(errors.EINVAL)
+        old = self._rlimit(res)  # snapshot BEFORE applying the new value
+        if new_ptr:
+            soft, hard = struct.unpack("<QQ", self.mem.read(new_ptr, 16))
+            self._set_rlimit(res, soft, hard)
+        if old_ptr:
+            self.mem.write(old_ptr, struct.pack("<QQ", *old))
+        return 0
+
+    def _sys_getrusage(self, args, ctx) -> int:
+        who = _i32(args[0])
+        if who not in (0, -1, 1):  # SELF, CHILDREN, THREAD
+            raise errors.SyscallError(errors.EINVAL)
+        # deterministic: a fresh process's accounting (the CPU model
+        # charges simulated time, not rusage counters — reporting real
+        # rusage would leak wall-clock nondeterminism into the guest)
+        self.mem.write(args[1], bytes(144))
+        return 0
+
+    # -- scheduling / priority (single deterministic CPU model) ----------
+
+    def _sys_getpriority(self, args, ctx) -> int:
+        which, who = _i32(args[0]), _i32(args[1])
+        if which not in (0, 1, 2):
+            raise errors.SyscallError(errors.EINVAL)
+        # kernel ABI: returns 20 - nice (1..40)
+        return 20 - self._nice
+
+    def _sys_setpriority(self, args, ctx) -> int:
+        which, _who, prio = _i32(args[0]), _i32(args[1]), _i32(args[2])
+        if which not in (0, 1, 2):
+            raise errors.SyscallError(errors.EINVAL)
+        nice = max(-20, min(19, prio))
+        if nice < self._nice:
+            raise errors.SyscallError(errors.EACCES)  # lowering needs CAP
+        self._nice = nice
+        return 0
+
+    def _sys_sched_getscheduler(self, args, ctx) -> int:
+        return 0  # SCHED_OTHER
+
+    def _sys_sched_setscheduler(self, args, ctx) -> int:
+        if _i32(args[1]) != 0:  # only SCHED_OTHER without privilege
+            raise errors.SyscallError(errors.EPERM)
+        return 0
+
+    def _sys_sched_getparam(self, args, ctx) -> int:
+        if not args[1]:
+            raise errors.SyscallError(errors.EFAULT)
+        self.mem.write(args[1], struct.pack("<i", 0))  # sched_priority 0
+        return 0
+
+    # -- memory-mapping family -------------------------------------------
+    # The mappings THEMSELVES run natively (each managed process owns a
+    # real address space); the simulated kernel's job is validation the
+    # native kernel can't do — a virtual fd must never leak to a native
+    # mmap (the raw number would map some unrelated simulator fd) — and
+    # region-map bookkeeping (managed.py marks the region cache dirty on
+    # every MAPPING_SYSCALLS member before dispatch).
+
+    MAP_ANONYMOUS = 0x20
+
+    def _sys_mmap(self, args, ctx) -> int:
+        length, flags, fd = args[1], _i32(args[3]), _i32(args[4])
+        if length == 0:
+            raise errors.SyscallError(errors.EINVAL)
+        if not flags & self.MAP_ANONYMOUS and fd >= 0:
+            if fd >= self.VFD_BASE or fd in self._low_overrides:
+                # sockets/pipes aren't mmap-able (Linux: ENODEV)
+                self._file(fd)  # EBADF for a dead virtual fd
+                raise errors.SyscallError(errors.ENODEV)
+        raise NativeSyscall()
+
+    def _sys_munmap(self, args, ctx) -> int:
+        if args[0] & 0xFFF or args[1] == 0:
+            raise errors.SyscallError(errors.EINVAL)
+        raise NativeSyscall()
+
+    def _sys_mprotect(self, args, ctx) -> int:
+        if args[0] & 0xFFF:
+            raise errors.SyscallError(errors.EINVAL)
+        raise NativeSyscall()
+
+    def _sys_mremap(self, args, ctx) -> int:
+        if args[0] & 0xFFF or args[1] == 0:
+            raise errors.SyscallError(errors.EINVAL)
+        raise NativeSyscall()
+
+    def _sys_brk(self, args, ctx) -> int:
+        raise NativeSyscall()  # dispatched for the region-cache mark
+
+    def _sys_msync(self, args, ctx) -> int:
+        MS_ASYNC, MS_SYNC = 1, 4
+        flags = _i32(args[2])
+        if args[0] & 0xFFF or (flags & MS_ASYNC and flags & MS_SYNC):
+            raise errors.SyscallError(errors.EINVAL)
+        raise NativeSyscall()
+
+    def _sys_madvise(self, args, ctx) -> int:
+        if args[0] & 0xFFF:
+            raise errors.SyscallError(errors.EINVAL)
+        raise NativeSyscall()
+
+    def _sys_mlock_family(self, args, ctx) -> int:
+        # deterministic no-op success: real mlock can fail with ENOMEM
+        # under RLIMIT_MEMLOCK depending on the invoking machine, and
+        # pinning pages buys a simulated process nothing
+        return 0
+
+    _sys_mlock = _sys_mlock_family
+    _sys_munlock = _sys_mlock_family
+    _sys_mlockall = _sys_mlock_family
+    _sys_munlockall = _sys_mlock_family
+
+    # -- privileged operations: deterministic unprivileged denial --------
+
+    def _sys_eperm(self, args, ctx) -> int:
+        raise errors.SyscallError(errors.EPERM)
+
+    _sys_chroot = _sys_eperm
+    _sys_mount = _sys_eperm
+    _sys_umount2 = _sys_eperm
+    _sys_settimeofday = _sys_eperm
+    _sys_clock_settime = _sys_eperm
+
+    def _sys_sendfile(self, args, ctx) -> int:
+        out_fd, in_fd = _i32(args[0]), _i32(args[1])
+        out_virtual = out_fd >= self.VFD_BASE \
+            or out_fd in self._low_overrides
+        in_virtual = in_fd >= self.VFD_BASE or in_fd in self._low_overrides
+        if not out_virtual and not in_virtual:
+            raise NativeSyscall()  # file->file: the kernel handles it
+        if in_virtual:
+            self._file(in_fd)  # EBADF check
+            # sockets/pipes aren't pread-able sources (Linux: EINVAL)
+            raise errors.SyscallError(errors.EINVAL)
+        # native file -> virtual socket: refuse with EINVAL so the app
+        # takes its read/write fallback path (what nginx/libcurl do on
+        # sendfile EINVAL/ENOSYS); emulating it would need pidfd_getfd
+        # access to the guest's native fd
+        self._file(out_fd)  # EBADF check
+        raise errors.SyscallError(errors.EINVAL)
+
     _HANDLERS = {
         SYS_socket: _sys_socket,
         SYS_socketpair: _sys_socketpair,
@@ -2104,4 +2414,47 @@ class SyscallHandler:
         SYS_sched_getaffinity: _sys_sched_getaffinity,
         SYS_getcpu: _sys_getcpu,
         SYS_clone3: _sys_clone3,
+        # identity
+        SYS_getuid: _sys_getuid,
+        SYS_geteuid: _sys_geteuid,
+        SYS_getgid: _sys_getgid,
+        SYS_getegid: _sys_getegid,
+        SYS_setuid: _sys_setuid,
+        SYS_setgid: _sys_setgid,
+        SYS_setresuid: _sys_setresuid,
+        SYS_setresgid: _sys_setresgid,
+        SYS_getresuid: _sys_getresuid,
+        SYS_getresgid: _sys_getresgid,
+        SYS_getgroups: _sys_getgroups,
+        SYS_setgroups: _sys_setgroups,
+        # limits / accounting
+        SYS_getrlimit: _sys_getrlimit,
+        SYS_setrlimit: _sys_setrlimit,
+        SYS_prlimit64: _sys_prlimit64,
+        SYS_getrusage: _sys_getrusage,
+        # scheduling / priority
+        SYS_getpriority: _sys_getpriority,
+        SYS_setpriority: _sys_setpriority,
+        SYS_sched_getscheduler: _sys_sched_getscheduler,
+        SYS_sched_setscheduler: _sys_sched_setscheduler,
+        SYS_sched_getparam: _sys_sched_getparam,
+        # memory-mapping family
+        SYS_mmap: _sys_mmap,
+        SYS_munmap: _sys_munmap,
+        SYS_mprotect: _sys_mprotect,
+        SYS_mremap: _sys_mremap,
+        SYS_brk: _sys_brk,
+        SYS_msync: _sys_msync,
+        SYS_madvise: _sys_madvise,
+        SYS_mlock: _sys_mlock,
+        SYS_munlock: _sys_munlock,
+        SYS_mlockall: _sys_mlockall,
+        SYS_munlockall: _sys_munlockall,
+        # privileged-op denial
+        SYS_chroot: _sys_chroot,
+        SYS_mount: _sys_mount,
+        SYS_umount2: _sys_umount2,
+        SYS_settimeofday: _sys_settimeofday,
+        SYS_clock_settime: _sys_clock_settime,
+        SYS_sendfile: _sys_sendfile,
     }

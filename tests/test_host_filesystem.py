@@ -1,0 +1,194 @@
+"""Per-host filesystem view + the round-4 syscall-breadth batch.
+
+Parity targets: reference per-host data dirs (`regular_file.c:277-329`,
+host data dirs in `process.rs`) — managed processes start in THEIR
+host's data directory so relative paths are host-local — plus the
+virtualized identity/rlimit/scheduling families (deterministic results
+independent of the invoking machine) and virtual-fd guards on
+mmap/sendfile.
+"""
+
+import shutil
+import subprocess
+
+import pytest
+
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.manager import Manager
+
+CC = shutil.which("gcc") or shutil.which("cc")
+
+pytestmark = pytest.mark.skipif(CC is None, reason="no C compiler")
+
+
+def _compile(tmp_path, name, src):
+    c = tmp_path / f"{name}.c"
+    c.write_text(src)
+    binary = tmp_path / name
+    subprocess.run([CC, "-O1", "-o", str(binary), str(c)], check=True)
+    return str(binary)
+
+
+WRITER_C = r"""
+#include <stdio.h>
+#include <string.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    /* relative path: must land in THIS host's data dir */
+    FILE *f = fopen("collide.txt", "w");
+    if (!f) return 1;
+    fprintf(f, "%s\n", argv[1]);
+    fclose(f);
+    char cwd[4096];
+    if (!getcwd(cwd, sizeof cwd)) return 2;
+    /* the cwd must name this host's directory */
+    if (!strstr(cwd, argv[1])) return 3;
+    return 0;
+}
+"""
+
+
+def test_relative_paths_are_host_local(tmp_path):
+    """Two hosts writing the same relative filename do NOT collide: each
+    process starts in its own per-host data dir (VERDICT r3 item #4's
+    'done' criterion)."""
+    binary = _compile(tmp_path, "writer", WRITER_C)
+    data_dir = tmp_path / "shadow.data"
+    cfg = load_config_str(f"""
+general: {{stop_time: 5s, seed: 3}}
+network:
+  graph: {{type: 1_gbit_switch}}
+hosts:
+  alpha:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, args: ["alpha"], start_time: 1s,
+       expected_final_state: {{exited: 0}}}}
+  beta:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, args: ["beta"], start_time: 1s,
+       expected_final_state: {{exited: 0}}}}
+""")
+    stats = Manager(cfg, data_dir=str(data_dir)).run()
+    assert stats.process_failures == [], stats.process_failures
+    a = (data_dir / "hosts" / "alpha" / "collide.txt").read_text().strip()
+    b = (data_dir / "hosts" / "beta" / "collide.txt").read_text().strip()
+    assert (a, b) == ("alpha", "beta")
+
+
+SYSCALL_BATCH_C = r"""
+#include <errno.h>
+#include <sched.h>
+#include <stdio.h>
+#include <sys/mman.h>
+#include <sys/mount.h>
+#include <sys/resource.h>
+#include <sys/sendfile.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+int main(void) {
+    /* deterministic simulated identity: 1000/1000 regardless of the
+       real uid the simulator runs as (root in CI, a user elsewhere) */
+    if (getuid() != 1000 || geteuid() != 1000) return 10;
+    if (getgid() != 1000 || getegid() != 1000) return 11;
+    if (setuid(1000) != 0) return 12;
+    if (setuid(0) != -1 || errno != EPERM) return 13;
+    gid_t g[8];
+    if (getgroups(8, g) != 1 || g[0] != 1000) return 14;
+
+    /* visible fd limit covers the virtual range (1024), regardless of
+       the 700-fd kernel cap on the native table */
+    struct rlimit rl;
+    if (getrlimit(RLIMIT_NOFILE, &rl)) return 20;
+    if (rl.rlim_cur != 1024 || rl.rlim_max != 1024) return 21;
+    /* lowering is allowed, raising back above the hard limit is not */
+    rl.rlim_cur = 512;
+    if (setrlimit(RLIMIT_NOFILE, &rl)) return 22;
+    rl.rlim_cur = rl.rlim_max = 4096;
+    if (setrlimit(RLIMIT_NOFILE, &rl) != -1 || errno != EPERM) return 23;
+
+    /* scheduling: fixed nice 0, SCHED_OTHER (glibc getpriority converts
+       the kernel's 20-nice encoding back to the nice value) */
+    errno = 0;
+    int prio = getpriority(PRIO_PROCESS, 0);
+    if ((prio == -1 && errno) || prio != 0) return 30;
+    if (setpriority(PRIO_PROCESS, 0, 5)) return 32;      /* raise nice */
+    if (getpriority(PRIO_PROCESS, 0) != 5) return 33;
+    if (setpriority(PRIO_PROCESS, 0, 2) != -1 || errno != EACCES)
+        return 34;                                       /* lowering: CAP */
+    if (sched_getscheduler(0) != SCHED_OTHER) return 31;
+
+    /* privileged ops are deterministically denied */
+    if (chroot("/") != -1 || errno != EPERM) return 40;
+    struct timeval tv = {0, 0};
+    if (settimeofday(&tv, 0) != -1 || errno != EPERM) return 41;
+
+    /* a virtual fd (socket) must never reach a native mmap */
+    int s = socket(AF_INET, SOCK_STREAM, 0);
+    if (s < 0) return 50;
+    void *p = mmap(0, 4096, PROT_READ, MAP_SHARED, s, 0);
+    if (p != MAP_FAILED || errno != ENODEV) return 51;
+    /* sendfile into a virtual socket: EINVAL -> app fallback path */
+    if (sendfile(s, 0, 0, 16) != -1 || errno != EINVAL) return 52;
+    /* dup2 of a virtual fd past the visible limit: EBADF like Linux */
+    if (dup2(s, 5000) != -1 || errno != EBADF) return 53;
+    /* the lowered soft limit (512, set above) is inherited by fork */
+    pid_t pid = fork();
+    if (pid == 0) {
+        struct rlimit crl;
+        if (getrlimit(RLIMIT_NOFILE, &crl)) _exit(1);
+        _exit(crl.rlim_cur == 512 ? 0 : 2);
+    }
+    int st;
+    if (waitpid(pid, &st, 0) != pid) return 54;
+    if (!WIFEXITED(st) || WEXITSTATUS(st)) return 55;
+
+    /* mlock family: deterministic no-op success */
+    static char page[4096];
+    if (mlock(page, sizeof page)) return 60;
+    if (munlockall()) return 61;
+
+    /* anonymous mmap still works natively through the validated path */
+    p = mmap(0, 8192, PROT_READ | PROT_WRITE,
+             MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (p == MAP_FAILED) return 62;
+    ((char *)p)[100] = 7;
+    if (munmap(p, 8192)) return 63;
+    if (munmap((char *)p + 1, 4096) != -1 || errno != EINVAL) return 64;
+    return 0;
+}
+"""
+
+
+def test_syscall_breadth_batch(tmp_path):
+    """The round-4 handler batch end-to-end in one managed binary:
+    identity, rlimits, scheduling, privileged-op denial, virtual-fd mmap
+    and sendfile guards, mlock no-ops, mapping validation."""
+    binary = _compile(tmp_path, "sysbatch", SYSCALL_BATCH_C)
+    cfg = load_config_str(f"""
+general: {{stop_time: 5s, seed: 3}}
+network:
+  graph: {{type: 1_gbit_switch}}
+hosts:
+  box:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, start_time: 1s,
+       expected_final_state: {{exited: 0}}}}
+""")
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
+
+
+def test_dispatch_table_breadth():
+    """VERDICT r3 item #5's 'done' criterion: >= 120 dispatch-table
+    entries (the reference's table holds ~160,
+    `handler/mod.rs:357-496`)."""
+    from shadow_tpu.process.syscall_handler import SyscallHandler
+
+    assert len(SyscallHandler._HANDLERS) >= 120
