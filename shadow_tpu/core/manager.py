@@ -183,7 +183,10 @@ class Manager:
 
         if self.data_dir:
             os.makedirs(self.data_dir, exist_ok=True)
-            hosts_path = os.path.join(self.data_dir, "etc-hosts")
+            # absolute: the path is handed to managed processes whose cwd
+            # is their per-host data dir, not the simulator's
+            hosts_path = os.path.abspath(
+                os.path.join(self.data_dir, "etc-hosts"))
         else:
             fd, hosts_path = tempfile.mkstemp(prefix="shadow-hosts-")
             os.close(fd)

@@ -63,6 +63,128 @@ WRAPPERS = [
      ["int ep", "void *evs", "int maxev", "int timeout"]),
     # misc
     ("long", "getrandom", 318, ["void *buf", "unsigned long n", "unsigned fl"]),
+    # file family (per-host cwd makes relative paths host-local; these
+    # skip the SIGSYS trap on the way to the native kernel)
+    ("int", "unlink", 87, ["const char *p"]),
+    ("int", "unlinkat", 263, ["int dfd", "const char *p", "int flags"]),
+    ("int", "rename", 82, ["const char *a", "const char *b"]),
+    ("int", "renameat", 264,
+     ["int da", "const char *a", "int db", "const char *b"]),
+    ("int", "mkdir", 83, ["const char *p", "unsigned mode"]),
+    ("int", "mkdirat", 258, ["int dfd", "const char *p", "unsigned mode"]),
+    ("int", "rmdir", 84, ["const char *p"]),
+    ("int", "chdir", 80, ["const char *p"]),
+    ("int", "fchdir", 81, ["int fd"]),
+    ("int", "link", 86, ["const char *a", "const char *b"]),
+    ("int", "symlink", 88, ["const char *a", "const char *b"]),
+    ("long", "readlink", 89, ["const char *p", "char *buf",
+                              "unsigned long n"]),
+    ("int", "chmod", 90, ["const char *p", "unsigned mode"]),
+    ("int", "fchmod", 91, ["int fd", "unsigned mode"]),
+    ("int", "chown", 92, ["const char *p", "unsigned u", "unsigned g"]),
+    ("int", "fchown", 93, ["int fd", "unsigned u", "unsigned g"]),
+    ("int", "lchown", 94, ["const char *p", "unsigned u", "unsigned g"]),
+    ("int", "access", 21, ["const char *p", "int mode"]),
+    ("int", "faccessat", 269, ["int dfd", "const char *p", "int mode"]),
+    ("int", "truncate", 76, ["const char *p", "long n"]),
+    ("int", "ftruncate", 77, ["int fd", "long n"]),
+    ("int", "fsync", 74, ["int fd"]),
+    ("int", "fdatasync", 75, ["int fd"]),
+    ("int", "flock", 73, ["int fd", "int op"]),
+    ("long", "lseek", 8, ["int fd", "long off", "int whence"]),
+    ("long", "pread", 17, ["int fd", "void *buf", "unsigned long n",
+                           "long off"]),
+    ("long", "pwrite", 18, ["int fd", "const void *buf", "unsigned long n",
+                            "long off"]),
+    ("long", "preadv", 295, ["int fd", "const void *iov", "int cnt",
+                             "long off"]),
+    ("long", "pwritev", 296, ["int fd", "const void *iov", "int cnt",
+                              "long off"]),
+    ("long", "copy_file_range", 326,
+     ["int fin", "void *offin", "int fout", "void *offout",
+      "unsigned long n", "unsigned fl"]),
+    ("long", "sendfile", 40, ["int out", "int in", "void *off",
+                              "unsigned long n"]),
+    ("long", "getdents64", 217, ["int fd", "void *dirp", "unsigned long n"]),
+    ("int", "dup3", 292, ["int oldfd", "int newfd", "int flags"]),
+    ("int", "pipe", 22, ["int *fds"]),
+    ("int", "pipe2", 293, ["int *fds", "int flags"]),
+    ("int", "statfs", 137, ["const char *p", "void *buf"]),
+    ("int", "fstatfs", 138, ["int fd", "void *buf"]),
+    ("unsigned", "umask", 95, ["unsigned mask"]),
+    # descriptors / events
+    ("int", "eventfd", 290, ["unsigned init", "int flags"]),
+    ("int", "timerfd_create", 283, ["int clk", "int flags"]),
+    ("int", "timerfd_settime", 286,
+     ["int fd", "int flags", "const void *new", "void *old"]),
+    ("int", "timerfd_gettime", 287, ["int fd", "void *cur"]),
+    ("int", "inotify_init", 253, []),
+    ("int", "inotify_init1", 294, ["int flags"]),
+    ("int", "inotify_add_watch", 254,
+     ["int fd", "const char *p", "unsigned mask"]),
+    ("int", "inotify_rm_watch", 255, ["int fd", "int wd"]),
+    # memory
+    ("void *", "mmap", 9,
+     ["void *addr", "unsigned long n", "int prot", "int flags", "int fd",
+      "long off"]),
+    ("int", "munmap", 11, ["void *addr", "unsigned long n"]),
+    ("int", "mprotect", 10, ["void *addr", "unsigned long n", "int prot"]),
+    ("int", "madvise", 28, ["void *addr", "unsigned long n", "int adv"]),
+    ("int", "msync", 26, ["void *addr", "unsigned long n", "int flags"]),
+    ("int", "mlock", 149, ["const void *addr", "unsigned long n"]),
+    ("int", "munlock", 150, ["const void *addr", "unsigned long n"]),
+    ("int", "mlockall", 151, ["int flags"]),
+    ("int", "munlockall", 152, []),
+    # identity / process info (virtualized by the simulated kernel)
+    ("unsigned", "getuid", 102, []),
+    ("unsigned", "geteuid", 107, []),
+    ("unsigned", "getgid", 104, []),
+    ("unsigned", "getegid", 108, []),
+    ("int", "setuid", 105, ["unsigned u"]),
+    ("int", "setgid", 106, ["unsigned g"]),
+    ("int", "getgroups", 115, ["int n", "unsigned *list"]),
+    ("int", "getresuid", 118, ["unsigned *r", "unsigned *e", "unsigned *s"]),
+    ("int", "getresgid", 120, ["unsigned *r", "unsigned *e", "unsigned *s"]),
+    ("int", "getppid", 110, []),
+    ("int", "getpgid", 121, ["int pid"]),
+    ("int", "getpgrp", 111, []),
+    ("int", "setpgid", 109, ["int pid", "int pgid"]),
+    ("int", "getsid", 124, ["int pid"]),
+    ("int", "setsid", 112, []),
+    ("int", "gettid", 186, []),
+    ("int", "getrlimit", 97, ["int res", "void *rl"]),
+    ("int", "setrlimit", 160, ["int res", "const void *rl"]),
+    ("int", "prlimit64", 302,
+     ["int pid", "int res", "const void *new", "void *old"]),
+    ("int", "getrusage", 98, ["int who", "void *ru"]),
+    ("int", "sysinfo", 99, ["void *info"]),
+    ("int", "uname", 63, ["void *buf"]),
+    ("int", "sethostname", 170, ["const char *n", "unsigned long len"]),
+    # scheduling
+    ("int", "sched_yield", 24, []),
+    ("int", "sched_getscheduler", 145, ["int pid"]),
+    ("int", "sched_getparam", 143, ["int pid", "void *param"]),
+    # time
+    ("int", "clock_getres", 229, ["int clk", "void *res"]),
+    ("unsigned", "alarm", 37, ["unsigned sec"]),
+    ("int", "getitimer", 36, ["int which", "void *cur"]),
+    ("int", "setitimer", 38, ["int which", "const void *new", "void *old"]),
+    ("long", "times", 100, ["void *buf"]),
+    ("int", "pause", 34, []),
+    # signals / processes (thin-syscall symbols only: no fork/pthread —
+    # glibc bookkeeping — and no sigaction — kernel/libc struct skew)
+    ("int", "kill", 62, ["int pid", "int sig"]),
+    ("int", "waitid", 247,
+     ["int idtype", "unsigned id", "void *info", "int opts"]),
+    ("long", "wait4", 61,
+     ["int pid", "int *status", "int opts", "void *ru"]),
+    # sockets (batch calls)
+    ("int", "socketpair", 53,
+     ["int dom", "int type", "int proto", "int *sv"]),
+    ("int", "sendmmsg", 307,
+     ["int fd", "void *msgs", "unsigned n", "int flags"]),
+    ("int", "recvmmsg", 299,
+     ["int fd", "void *msgs", "unsigned n", "int flags", "void *timeout"]),
 ]
 
 # libc-only names forwarded to a different syscall with fixed extra args
@@ -72,7 +194,121 @@ ALIASES = [
                           "int flags"], ["fd", "buf", "n", "flags", "0", "0"]),
     ("long", "send", 44, ["int fd", "const void *buf", "unsigned long n",
                           "int flags"], ["fd", "buf", "n", "flags", "0", "0"]),
+    # LFS names: on x86_64 the plain syscalls already are 64-bit
+    ("long", "lseek64", 8, ["int fd", "long off", "int whence"], None),
+    ("long", "pread64", 17, ["int fd", "void *buf", "unsigned long n",
+                             "long off"], None),
+    ("long", "pwrite64", 18, ["int fd", "const void *buf",
+                              "unsigned long n", "long off"], None),
+    ("long", "preadv64", 295, ["int fd", "const void *iov", "int cnt",
+                               "long off"], None),
+    ("long", "pwritev64", 296, ["int fd", "const void *iov", "int cnt",
+                                "long off"], None),
+    ("int", "truncate64", 76, ["const char *p", "long n"], None),
+    ("int", "ftruncate64", 77, ["int fd", "long n"], None),
+    ("int", "statfs64", 137, ["const char *p", "void *buf"], None),
+    ("int", "fstatfs64", 138, ["int fd", "void *buf"], None),
+    ("void *", "mmap64", 9,
+     ["void *addr", "unsigned long n", "int prot", "int flags", "int fd",
+      "long off"], None),
+    # wait family over wait4
+    ("long", "wait", 61, ["int *status"],
+     ["-1", "status", "0", "0"]),
+    ("long", "waitpid", 61, ["int pid", "int *status", "int opts"],
+     ["pid", "status", "opts", "0"]),
+    # sigmask-taking variants: the kernel wants the sigset size (_NSIG/8)
+    ("int", "ppoll", 271,
+     ["void *fds", "unsigned long nfds", "const void *tmo",
+      "const void *sigmask"], ["fds", "nfds", "tmo", "sigmask", "8"]),
+    ("int", "epoll_pwait", 281,
+     ["int ep", "void *evs", "int maxev", "int timeout",
+      "const void *sigmask"],
+     ["ep", "evs", "maxev", "timeout", "sigmask", "8"]),
+    # creat(2) == open(O_CREAT|O_WRONLY|O_TRUNC)
+    ("int", "creat", 2, ["const char *p", "unsigned mode"],
+     ["p", "0x241", "mode"]),
+    ("int", "creat64", 2, ["const char *p", "unsigned mode"],
+     ["p", "0x241", "mode"]),
+    # stat family over newfstatat(AT_FDCWD=-100 / AT_SYMLINK_NOFOLLOW)
+    ("int", "stat", 262, ["const char *p", "void *buf"],
+     ["-100", "p", "buf", "0"]),
+    ("int", "stat64", 262, ["const char *p", "void *buf"],
+     ["-100", "p", "buf", "0"]),
+    ("int", "lstat", 262, ["const char *p", "void *buf"],
+     ["-100", "p", "buf", "0x100"]),
+    ("int", "lstat64", 262, ["const char *p", "void *buf"],
+     ["-100", "p", "buf", "0x100"]),
+    ("int", "fstat", 5, ["int fd", "void *buf"], None),
+    ("int", "fstat64", 5, ["int fd", "void *buf"], None),
+    ("int", "fstatat", 262,
+     ["int dfd", "const char *p", "void *buf", "int flags"], None),
+    ("int", "fstatat64", 262,
+     ["int dfd", "const char *p", "void *buf", "int flags"], None),
 ]
+
+# hand-written bodies: variadic signatures and non-errno return contracts
+CUSTOM = r"""
+#include <stdarg.h>
+
+int open(const char *p, int flags, ...) {
+    va_list ap; va_start(ap, flags);
+    long mode = (flags & 0100) ? va_arg(ap, long) : 0; /* O_CREAT */
+    va_end(ap);
+    return (int)xlate(shadow_tpu_api_syscall(2, (long)p, flags, mode,
+                                             0, 0, 0));
+}
+int open64(const char *p, int flags, ...) {
+    va_list ap; va_start(ap, flags);
+    long mode = (flags & 0100) ? va_arg(ap, long) : 0;
+    va_end(ap);
+    return (int)xlate(shadow_tpu_api_syscall(2, (long)p, flags, mode,
+                                             0, 0, 0));
+}
+int openat(int dfd, const char *p, int flags, ...) {
+    va_list ap; va_start(ap, flags);
+    long mode = (flags & 0100) ? va_arg(ap, long) : 0;
+    va_end(ap);
+    return (int)xlate(shadow_tpu_api_syscall(257, dfd, (long)p, flags,
+                                             mode, 0, 0));
+}
+int openat64(int dfd, const char *p, int flags, ...) {
+    va_list ap; va_start(ap, flags);
+    long mode = (flags & 0100) ? va_arg(ap, long) : 0;
+    va_end(ap);
+    return (int)xlate(shadow_tpu_api_syscall(257, dfd, (long)p, flags,
+                                             mode, 0, 0));
+}
+int fcntl(int fd, int cmd, ...) {
+    va_list ap; va_start(ap, cmd);
+    long arg = va_arg(ap, long);
+    va_end(ap);
+    return (int)xlate(shadow_tpu_api_syscall(72, fd, cmd, arg, 0, 0, 0));
+}
+int fcntl64(int fd, int cmd, ...) {
+    va_list ap; va_start(ap, cmd);
+    long arg = va_arg(ap, long);
+    va_end(ap);
+    return (int)xlate(shadow_tpu_api_syscall(72, fd, cmd, arg, 0, 0, 0));
+}
+int ioctl(int fd, unsigned long req, ...) {
+    va_list ap; va_start(ap, req);
+    long arg = va_arg(ap, long);
+    va_end(ap);
+    return (int)xlate(shadow_tpu_api_syscall(16, fd, (long)req, arg,
+                                             0, 0, 0));
+}
+int usleep(unsigned usec) {
+    struct { long s; long ns; } ts = { usec / 1000000u,
+                                       (long)(usec % 1000000u) * 1000 };
+    return (int)xlate(shadow_tpu_api_syscall(35, (long)&ts, 0, 0, 0, 0, 0));
+}
+/* clock_nanosleep returns the error POSITIVELY (no errno) */
+int clock_nanosleep(int clk, int flags, const void *req, void *rem) {
+    long r = shadow_tpu_api_syscall(230, clk, flags, (long)req, (long)rem,
+                                    0, 0);
+    return r < 0 ? (int)-r : 0;
+}
+"""
 
 HEADER = """\
 /* GENERATED by gen_libc_wrappers.py — do not edit.
@@ -105,10 +341,16 @@ def emit(ret, name, nr, params, fwd=None):
 
 def main():
     out = [HEADER]
+    names = set()
     for ret, name, nr, params in WRAPPERS:
+        assert name not in names, f"duplicate wrapper {name}"
+        names.add(name)
         out.append(emit(ret, name, nr, params))
     for ret, name, nr, params, fwd in ALIASES:
+        assert name not in names, f"duplicate wrapper {name}"
+        names.add(name)
         out.append(emit(ret, name, nr, params, fwd))
+    out.append(CUSTOM)
     print("\n".join(out))
 
 

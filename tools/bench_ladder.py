@@ -153,6 +153,55 @@ hosts:
     return out
 
 
+def rung_interpose(n_pairs: int = 50, size: int = 262_144) -> dict:
+    """Interposition-plane scale: 2*n_pairs REAL compiled binaries (the
+    TCP transfer pair from tests/test_managed_network.py), each under the
+    seccomp+LD_PRELOAD shim with its own IPC channel, futex-channel
+    shmem, and pidfd watcher — the reference's headline claim shape
+    ('thousands of network-connected processes', README.md:20-23),
+    previously exercised only at N<=3 in tests. Reports sim-sec/wall-sec
+    and peak simulator RSS."""
+    import re
+    import resource
+    import subprocess
+    import tempfile
+
+    src = open("tests/test_managed_network.py").read()
+    server_c = re.search(r'SERVER_C = r"""(.*?)"""', src, re.S).group(1)
+    client_c = re.search(r'CLIENT_C = r"""(.*?)"""', src, re.S).group(1)
+    tmp = tempfile.mkdtemp(prefix="interpose-bench-")
+    for name, code in (("server", server_c), ("client", client_c)):
+        with open(f"{tmp}/{name}.c", "w") as fh:
+            fh.write(code)
+        subprocess.run(["gcc", "-O1", "-o", f"{tmp}/{name}",
+                        f"{tmp}/{name}.c"], check=True)
+
+    hosts = []
+    for i in range(n_pairs):
+        hosts.append(
+            f"  srv{i}:\n    network_node_id: 0\n    ip_addr: 10.9.{i // 250}.{i % 250 + 1}\n"
+            f"    processes:\n"
+            f"    - {{path: {tmp}/server, args: ['9000', '{size}'],\n"
+            f"       start_time: 1s, expected_final_state: {{exited: 0}}}}"
+        )
+        hosts.append(
+            f"  cli{i}:\n    network_node_id: 0\n    ip_addr: 10.9.{i // 250 + 100}.{i % 250 + 1}\n"
+            f"    processes:\n"
+            f"    - {{path: {tmp}/client, args: ['10.9.{i // 250}.{i % 250 + 1}', "
+            f"'9000', '{size}'],\n"
+            f"       start_time: 2s, expected_final_state: {{exited: 0}}}}"
+        )
+    cfg = ("general: {stop_time: 30s, seed: 1}\n"
+           "network:\n  graph:\n    type: 1_gbit_switch\n"
+           "hosts:\n" + "\n".join(hosts))
+    out = run_rung(f"rung_interpose_{2 * n_pairs}_procs", cfg)
+    peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    out["peak_rss_mib"] = round(peak_kb / 1024, 1)
+    print(json.dumps({"rung": out["rung"],
+                      "peak_rss_mib": out["peak_rss_mib"]}), flush=True)
+    return out
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     if which in ("1", "all"):
@@ -161,6 +210,8 @@ def main():
         rung2()
     if which in ("3", "all"):
         rung3()
+    if which in ("interpose", "all"):
+        rung_interpose()
 
 
 if __name__ == "__main__":
