@@ -169,6 +169,13 @@ class ExperimentalOptions:
     #   pull on the round-4 dev machine).
     # auto: probe the D2H round-trip at transport init and pick.
     tpu_transport_mode: str = "auto"  # auto | sync | mirrored
+    # execute a tgen-shaped workload ENTIRELY on the device flow engine
+    # (tpu/floweng.py): both TCP endpoints, wire, timers, and app model
+    # advance inside lax.scan windows; completions reconcile into sim
+    # stats. Errors out (FlowPlanError) if the config contains anything
+    # but tgen-server/tgen-client processes — an explicit promise, not
+    # a heuristic. See core/flowplan.py for the fidelity contract.
+    use_flow_engine: bool = False
     tpu_egress_cap: int = 256  # per-host device egress slots
     tpu_ingress_cap: int = 256  # per-host device in-flight slots
     tpu_compact_cap: int = 4096  # per-window compacted-delivery slots

@@ -1,0 +1,241 @@
+"""Compile a YAML tgen workload into a device flow plan.
+
+The reference drives its throughput benchmarks with tgen processes
+talking through the full packet plane (`src/test/tgen/README.md:1-20`).
+This rebuild's flow engine (`tpu/floweng.py`) executes that workload
+class — fixed-size TCP transfers between host pairs — entirely on the
+TPU. This module is the bridge from config to device: it inspects a
+parsed `ConfigOptions`, verifies the workload is flow-engine-shaped
+(every process a built-in `tgen-server` / `tgen-client`, single
+transfer per client), resolves each client's server, path latency, and
+composed path loss through the routing tables, and emits the arrays
+`make_flow_world` consumes.
+
+Opt in with `experimental.use_flow_engine: true`; `Manager.run`
+delegates to `run_flow_simulation` below, which reconciles per-flow
+completions back into `SimStats` (failures for incomplete transfers,
+segment counts as the event/packet tallies). A config that is not
+flow-engine-shaped raises `FlowPlanError` naming the offending process
+— the flag is an explicit promise, not a heuristic.
+
+Fidelity contract (documented in BASELINE.md): fixed shortest-path
+latency per flow, segment-granular Bernoulli loss composed along the
+path (both directions), no shared-NIC queueing — at ladder shapes the
+NIC serialization time of a full transfer is ~two orders of magnitude
+under one path RTT, so completion times are RTT/loss-dominated.
+"""
+
+from __future__ import annotations
+
+import logging
+import time as _walltime
+from dataclasses import dataclass
+
+import numpy as np
+
+from . import simtime
+
+log = logging.getLogger("shadow.flowplan")
+
+
+class FlowPlanError(ValueError):
+    """The config is not a flow-engine-shaped workload."""
+
+
+@dataclass
+class FlowPlan:
+    client: list  # [F] client host name
+    server: list  # [F] server host name
+    size: np.ndarray  # [F] bytes the server streams to the client
+    start_us: np.ndarray  # [F] client connect time
+    latency_us: np.ndarray  # [F] client->server path latency
+    latency_back_us: np.ndarray  # [F] server->client (may differ on
+    # directed graphs)
+    loss: np.ndarray  # [F] client->server path loss probability
+    loss_back: np.ndarray  # [F] server->client
+    window_us: int
+    stop_us: int
+    seed: int
+
+
+def compile_flow_plan(config, routing, node_index_of_host=None) -> FlowPlan:
+    """Extract the flow plan from a parsed config. `routing` is the
+    Manager's `RoutingInfo`; `node_index_of_host` maps a host name to
+    its network node id (defaults to the config's network_node_id)."""
+    if node_index_of_host is None:
+        node_index_of_host = {
+            name: h.network_node_id for name, h in config.hosts.items()
+        }
+    servers: dict[str, tuple[str, int]] = {}  # name -> (port, node)
+    clients = []
+    for name, host in config.hosts.items():
+        for popt in host.processes:
+            if popt.path == "tgen-server":
+                port = popt.args[0] if popt.args else "8888"
+                servers[name] = (port, node_index_of_host[name])
+            elif popt.path == "tgen-client":
+                args = list(popt.args) + ["server", "8888", "1048576", "1"][
+                    len(popt.args):]
+                server, port, size, count = args[:4]
+                if int(count) != 1:
+                    raise FlowPlanError(
+                        f"host {name}: tgen-client count={count}; the "
+                        f"flow engine runs single transfers per client "
+                        f"(count=1)")
+                clients.append((name, node_index_of_host[name], server,
+                                port, int(size), popt.start_time))
+            else:
+                raise FlowPlanError(
+                    f"host {name}: process '{popt.path}' is not a tgen "
+                    f"app; experimental.use_flow_engine only accepts "
+                    f"tgen-server/tgen-client workloads")
+    if not clients:
+        raise FlowPlanError("no tgen-client processes in the config")
+
+    F = len(clients)
+    size = np.zeros(F, np.int64)
+    start_us = np.zeros(F, np.int64)
+    latency_us = np.zeros(F, np.int64)
+    latency_back_us = np.zeros(F, np.int64)
+    loss = np.zeros(F, np.float64)
+    loss_back = np.zeros(F, np.float64)
+    names_c, names_s = [], []
+    for f, (cname, cnode, server, port, sz, t0) in enumerate(clients):
+        if server not in servers:
+            raise FlowPlanError(
+                f"host {cname}: tgen-client targets '{server}' but no "
+                f"host runs a tgen-server")
+        sport, snode = servers[server]
+        if sport != port:
+            raise FlowPlanError(
+                f"host {cname}: port {port} != server port {sport}")
+        fwd = routing.path(cnode, snode)  # client -> server
+        back = routing.path(snode, cnode)  # server -> client (directed
+        # graphs may be asymmetric; each lane carries its own direction)
+        if fwd.latency_ns < simtime.MICROSECOND \
+                or back.latency_ns < simtime.MICROSECOND:
+            raise FlowPlanError(
+                f"host {cname}: path to '{server}' has sub-microsecond "
+                f"latency ({min(fwd.latency_ns, back.latency_ns)} ns); "
+                f"the flow engine's PDES window cannot go below 1 us")
+        size[f] = sz
+        start_us[f] = t0 // simtime.MICROSECOND
+        latency_us[f] = fwd.latency_ns // simtime.MICROSECOND
+        latency_back_us[f] = back.latency_ns // simtime.MICROSECOND
+        loss[f] = fwd.packet_loss
+        loss_back[f] = back.packet_loss
+        names_c.append(cname)
+        names_s.append(server)
+
+    stop_us = config.general.stop_time // simtime.MICROSECOND
+    # PDES lookahead: windows no wider than the narrowest flow's one-way
+    # latency (pairs are independent — only a pair's own latency bounds
+    # its window), clamped to keep per-window bursts inside the rings
+    window_us = int(min(latency_us.min(), int(latency_back_us.min()),
+                        25_000))
+    return FlowPlan(
+        client=names_c, server=names_s, size=size, start_us=start_us,
+        latency_us=latency_us, latency_back_us=latency_back_us,
+        loss=loss, loss_back=loss_back, window_us=window_us,
+        stop_us=int(stop_us), seed=config.general.seed,
+    )
+
+
+# window-width ladder for latency buckets: flows whose one-way latency
+# admits a wider window run in a separate world with that window — pairs
+# never interact, so partitioning by latency is exact PDES decomposition
+# (not an approximation), and it keeps fast-flow worlds from forcing
+# narrow windows on slow flows. A flow may always run NARROWER windows
+# than its latency admits, so the ladder is coarse (fewer, larger
+# buckets amortize per-dispatch and probe overhead better than exact
+# windows amortize step count). Padding each bucket to a power of two
+# maximizes XLA compile-cache hits across configs.
+_WINDOW_LADDER = (1_000, 2_000, 5_000, 20_000)
+
+
+def _bucket_window(lat_us: int) -> int:
+    w = min(lat_us, _WINDOW_LADDER[-1])
+    best = 0
+    for step in _WINDOW_LADDER:
+        if step <= w:
+            best = step
+    return best if best else int(w)  # sub-ladder latency: exact window
+
+
+def run_flow_simulation(config, routing, stats):
+    """Execute the config's tgen workload on the device flow engine and
+    fill `stats` (a `SimStats`) the way the round loop would: segments
+    as events/packets, wire drops as packet drops, incomplete transfers
+    as process failures against the clients' expected exit 0."""
+    from ..tpu import enable_compilation_cache, floweng
+
+    enable_compilation_cache()
+    wall0 = _walltime.monotonic()
+    plan = compile_flow_plan(config, routing)
+    F = len(plan.size)
+    buckets: dict[int, list[int]] = {}
+    for f in range(F):
+        lookahead = min(int(plan.latency_us[f]),
+                        int(plan.latency_back_us[f]))
+        buckets.setdefault(_bucket_window(lookahead), []).append(f)
+
+    complete_us = np.full(F, np.iinfo(np.int32).max, np.int64)
+    bytes_read = np.zeros(F, np.int64)
+    segments = wire_drops = queue_drops = retransmits = 0
+    rounds = 0
+    total_retries = 0
+    for window_us, idx in sorted(buckets.items(), reverse=True):
+        Fb = len(idx)
+        pad = max(8, 1 << (Fb - 1).bit_length()) - Fb
+        sel = np.asarray(idx)
+        lat = np.concatenate([plan.latency_us[sel],
+                              np.full(pad, window_us, np.int64)])
+        lat_b = np.concatenate([plan.latency_back_us[sel],
+                                np.full(pad, window_us, np.int64)])
+        size = np.concatenate([plan.size[sel], np.zeros(pad, np.int64)])
+        start = np.concatenate([plan.start_us[sel],
+                                np.full(pad, np.iinfo(np.int32).max,
+                                        np.int64)])
+        loss = np.concatenate([plan.loss[sel], np.zeros(pad)])
+        loss_b = np.concatenate([plan.loss_back[sel], np.zeros(pad)])
+        world = floweng.make_flow_world(
+            lat, size, start_us=start, loss=loss, seed=plan.seed,
+            server_writes=True, queue_slots=256,
+            latency_back_us=lat_b, loss_back=loss_b)
+        log.info("flow engine: bucket window %d us, %d flows (+%d pad)",
+                 window_us, Fb, pad)
+        chunk = max(1, 1_000_000 // window_us)  # ~1 sim-s per dispatch
+        world, sim_s, retries = floweng.run_to_completion(
+            world, window_us, max_sim_s=plan.stop_us / 1e6,
+            chunk_windows=chunk, probe_every=3)
+        world = floweng.finalize_to(world, plan.stop_us)
+        res = floweng.flow_results(world)
+        complete_us[sel] = res["complete_us"][:Fb]
+        bytes_read[sel] = res["bytes_read"][:Fb]
+        segments += res["segments"]
+        wire_drops += res["wire_drops"]
+        queue_drops += res["queue_drops"]
+        retransmits += res["retransmits"]
+        rounds += int(round(sim_s * 1e6 / window_us))
+        total_retries += retries
+
+    ok = bytes_read >= plan.size
+    for f in np.nonzero(~ok)[0]:
+        stats.process_failures.append((
+            f"{plan.client[f]}/tgen-client",
+            f"expected exited(0), got running (transfer "
+            f"{int(bytes_read[f])}/{int(plan.size[f])}"
+            f" bytes from {plan.server[f]})",
+        ))
+    if total_retries:
+        log.warning("flow engine re-ran %d time(s) after window "
+                    "saturation (final runs clean)", total_retries)
+    stats.rounds = rounds
+    stats.events_executed = segments
+    stats.packets_sent = segments
+    stats.packets_dropped = wire_drops + queue_drops
+    stats.sim_time_ns = config.general.stop_time
+    stats.wall_seconds = _walltime.monotonic() - wall0
+    stats.flow_complete_us = complete_us
+    stats.flow_retransmits = retransmits
+    return stats

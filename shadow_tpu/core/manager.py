@@ -109,6 +109,16 @@ class Manager:
             self.graph, used_nodes, config.network.use_shortest_path
         )
 
+        # --- flow-engine delegation ------------------------------------------
+        # a tgen workload bound for the device flow engine needs only the
+        # graph + routing built above: skip hosts, trackers, scheduler
+        # worker threads, and transport entirely (they would be built,
+        # pinned, and never used)
+        self.stats = SimStats()
+        self.trackers = {}
+        if config.experimental.use_flow_engine:
+            return
+
         # --- IP assignment + host seeds (config-declared order) -------------
         ips = netgraph.IpAssignment()
         host_plans = []
@@ -566,6 +576,14 @@ class Manager:
             self._print_progress(window_start)
 
     def run(self) -> SimStats:
+        if self.config.experimental.use_flow_engine:
+            # tgen-shaped workload on the device flow engine: the round
+            # loop never runs; flowplan reconciles completions into the
+            # same SimStats surface (failures, packets, sim time)
+            from . import flowplan
+
+            return flowplan.run_flow_simulation(
+                self.config, self.routing, self.stats)
         wall_start = _walltime.monotonic()
         self._wall_start = wall_start
         self._last_resource_check = wall_start

@@ -705,6 +705,14 @@ class TcpConnection:
 
     def on_segment(self, seg: Segment) -> None:
         if self.state == TcpState.CLOSED:
+            # RFC 793: a segment (other than RST) arriving at a closed
+            # connection elicits a RESET — without it, a peer stuck in
+            # CLOSING/LAST_ACK retransmits its FIN into a silent void
+            # until retry exhaustion (reachable once the wire is lossy;
+            # both twins fixed together round 5, tpu/tcp.py _ev_segment)
+            if not seg.flags & TcpFlags.RST:
+                self._rst_pending = True
+                self.deps.notify()
             return
         if seg.timestamp:
             self._last_ts_recv = seg.timestamp
@@ -732,6 +740,16 @@ class TcpConnection:
                 return
             if self.state == TcpState.TIME_WAIT:
                 return  # new-connection reuse unsupported; ignore
+            if seqmod.lt(seg.seq, seqmod.add(self.irs, 1 + self.rcv_nxt)):
+                # old duplicate SYN below the window — e.g. a
+                # retransmitted SYN|ACK when our handshake-completing
+                # ACK was lost. RFC 793 p.69 / RFC 5961: answer with an
+                # ACK (which completes the peer's handshake), never RST.
+                # Both twins fixed together round 5 (tpu/tcp.py
+                # _ev_segment); a lossy wire made this reachable.
+                self._ack_pending = True
+                self.deps.notify()
+                return
             self._rst_pending = True
             self.deps.notify()
             return

@@ -821,7 +821,17 @@ def _ev_segment(s, f, now_ms):
     is_syn = (flags & SYN) != 0
     dup_syn = (s1.state == SYN_RCVD) & (f[1].astype(jnp.uint32) == s1.irs)
     syn_dup = s1._replace(syn_outstanding=jnp.bool_(False))
-    syn_other = _sel(tw, s1, s1._replace(rst_pending=jnp.bool_(True)))
+    # old duplicate SYN below the window (e.g. a retransmitted SYN|ACK
+    # after our handshake-completing ACK was lost): RFC 793 p.69 /
+    # RFC 5961 — answer with an ACK, never RST (connection.py twin,
+    # fixed together round 5; reachable once the wire is lossy)
+    syn_delta = (_wire_rcv_nxt(s1) - f[1].astype(jnp.uint32)) \
+        .astype(jnp.uint32)
+    syn_is_old = (syn_delta != 0) & (syn_delta < jnp.uint32(1 << 31))
+    syn_old = s1._replace(ack_pending=jnp.bool_(True))
+    syn_other = _sel(tw, s1,
+                     _sel(syn_is_old, syn_old,
+                          s1._replace(rst_pending=jnp.bool_(True))))
     sy = _sel(dup_syn, syn_dup, syn_other)
 
     # normal path
@@ -832,7 +842,11 @@ def _ev_segment(s, f, now_ms):
 
     out = _sel(syn_sent, ss,
                _sel(is_rst, r, _sel(is_syn, sy, n)))
-    return _sel(closed, s, out)
+    # RFC 793: non-RST segment at a CLOSED connection elicits a RESET
+    # (connection.py twin fixed together round 5) — note the CPU twin
+    # returns before recording the timestamp, hence `s` not `s1`
+    closed_rst = s._replace(rst_pending=s.rst_pending | ~is_rst)
+    return _sel(closed, closed_rst, out)
 
 
 # -- timers ----------------------------------------------------------------
@@ -916,11 +930,17 @@ def _next_kind(s):
                   K_NONE)))))))).astype(jnp.int32)
 
 
-def _ev_pull(s, now_ms):
+def _ev_pull(s, now_ms, gso_segs: int = 1):
     """next_segment(): returns (state', out[18]):
     out = (has, flags, seq(u32 bits), ack, window, paylen, wscale(-1),
            ts, ts_echo, retransmit, sack_permitted, nsack, s1, e1, s2,
-           e2, s3, e3)."""
+           e2, s3, e3).
+
+    gso_segs > 1 emits one TSO/GSO-style macro-segment of up to
+    gso_segs*MSS contiguous payload per pull (the flow engine's wire
+    draws loss per MSS unit and truncates — floweng._pull_phase). The
+    CPU twin and the trace-replay contract always use gso_segs=1;
+    retransmissions stay single-MSS in both."""
     kind = _next_kind(s)
     before_nxt = s.snd_nxt
     zero = jnp.int32(0)
@@ -957,7 +977,7 @@ def _ev_pull(s, now_ms):
     in_flight = off - s.snd_una
     window = jnp.minimum(s.cwnd * MSS, s.snd_wnd)
     n_data = jnp.minimum(
-        jnp.minimum(jnp.minimum(MSS, s.stream_len - off),
+        jnp.minimum(jnp.minimum(MSS * gso_segs, s.stream_len - off),
                     window - in_flight), d_cap)
     d_has = n_data > 0
     n_eff = jnp.maximum(n_data, 0)
@@ -1137,6 +1157,42 @@ def _event_step_one(s: TcpPlane, kind, f, now_ms):
 
 
 _event_step = jax.vmap(_event_step_one, in_axes=(0, 0, 0, 0))
+
+
+def _sched_step_one(s: TcpPlane, kind, f, now_ms):
+    """One SCHEDULED event for one connection: the subset of kinds the
+    flow engine's fused step dispatches (segment arrivals, timers, and
+    opens). App-side kinds (WRITE/READ/CLOSE) and PULL are applied
+    inline/batched by the driver (`floweng._fused_step`), so this kernel
+    pays a 6-way merge instead of tcp_event_step's 11-way."""
+    s_oa = _ev_open_active(s, f, now_ms)
+    s_op = _ev_open_passive(s, f, now_ms)
+    s_sg = _ev_segment(s, f, now_ms)
+    s_tr = _ev_timer_rto(s, f, now_ms)
+    s_tp = _ev_timer_persist(s, f, now_ms)
+    s_tw = _ev_timer_tw(s, f, now_ms)
+    out = s
+    for k, st in ((EV_OPEN_ACTIVE, s_oa), (EV_OPEN_PASSIVE, s_op),
+                  (EV_SEG, s_sg), (EV_TIMER_RTO, s_tr),
+                  (EV_TIMER_PERSIST, s_tp), (EV_TIMER_TW, s_tw)):
+        out = _sel(kind == k, st, out)
+    return out
+
+
+tcp_sched_step = jax.vmap(_sched_step_one, in_axes=(0, 0, 0, 0))
+
+# batched PULL (= next_segment) over all connections
+def tcp_pull_step(plane: TcpPlane, now_ms, gso_segs: int = 1):
+    return jax.vmap(lambda s, n: _ev_pull(s, n, gso_segs))(plane, now_ms)
+
+
+def sel_batched(pred, a: TcpPlane, b: TcpPlane) -> TcpPlane:
+    """Per-field select with a [C] predicate (broadcast over trailing
+    per-slot axes)."""
+    def w(x, y):
+        p = pred.reshape(pred.shape + (1,) * (x.ndim - 1))
+        return jnp.where(p, x, y)
+    return jax.tree.map(w, a, b)
 
 
 def tcp_event_step(plane: TcpPlane, kind: jax.Array, fields: jax.Array,

@@ -1,0 +1,151 @@
+"""Manager -> device flow engine integration (`core/flowplan.py`,
+`experimental.use_flow_engine`): a YAML tgen workload compiles to a
+flow plan, executes on the flow engine (CPU backend here, same code
+path as TPU), and reconciles into SimStats. Cross-validated against
+the full CPU object plane on an identical config.
+
+Reference analogue: tgen throughput tests driven from shadow.yaml
+(`/root/reference/src/test/tgen/README.md:1-20`).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.flowplan import FlowPlanError, compile_flow_plan
+from shadow_tpu.core.manager import Manager
+
+GML = """\
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+        node [ id 1 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+        edge [ source 0 target 0 latency "5 ms" packet_loss 0.0 ]
+        edge [ source 0 target 1 latency "40 ms" packet_loss 0.002 ]
+        edge [ source 1 target 1 latency "5 ms" packet_loss 0.0 ]
+      ]
+"""
+
+
+def tgen_cfg(n_clients=3, size=50_000, use_flow_engine=True,
+             stop="30s") -> str:
+    hosts = ["  server:\n    network_node_id: 0\n    processes:\n"
+             "    - {path: tgen-server, args: ['8888'], start_time: 1s,\n"
+             "       expected_final_state: running}"]
+    for i in range(n_clients):
+        hosts.append(
+            f"  client{i}:\n    network_node_id: 1\n    processes:\n"
+            f"    - {{path: tgen-client, args: ['server', '8888', "
+            f"'{size}', '1'], start_time: {2 + i}s}}"
+        )
+    flag = ("experimental: {use_flow_engine: true}\n"
+            if use_flow_engine else "")
+    return (f"general: {{stop_time: {stop}, seed: 1}}\n" + flag +
+            "network:\n  graph:\n    type: gml\n    inline: |\n" + GML +
+            "hosts:\n" + "\n".join(hosts))
+
+
+def test_flow_plan_compiles():
+    cfg = load_config_str(tgen_cfg())
+    mgr = Manager(cfg)
+    plan = compile_flow_plan(cfg, mgr.routing)
+    assert len(plan.size) == 3
+    assert (plan.size == 50_000).all()
+    assert (plan.latency_us == 40_000).all()
+    assert np.allclose(plan.loss, 0.002)
+    assert plan.window_us <= 25_000
+    assert plan.start_us.tolist() == [2_000_000, 3_000_000, 4_000_000]
+
+
+def test_flow_plan_rejects_non_tgen():
+    cfg = load_config_str(
+        "general: {stop_time: 10s, seed: 1}\n"
+        "experimental: {use_flow_engine: true}\n"
+        "network:\n  graph: {type: 1_gbit_switch}\n"
+        "hosts:\n  h:\n    network_node_id: 0\n    processes:\n"
+        "    - {path: http-server, args: ['80'], start_time: 1s}\n")
+    mgr = Manager(cfg)
+    with pytest.raises(FlowPlanError, match="http-server"):
+        compile_flow_plan(cfg, mgr.routing)
+
+
+def test_manager_runs_on_flow_engine():
+    cfg = load_config_str(tgen_cfg())
+    stats = Manager(cfg).run()
+    assert stats.process_failures == []
+    assert stats.packets_sent > 3 * 50_000 // 1448  # at least the data segs
+    assert stats.sim_time_ns == 30_000_000_000
+    complete = stats.flow_complete_us
+    # transfers start at 2/3/4 s and need >= 2 RTTs of 80 ms
+    assert (complete > np.array([2, 3, 4]) * 1_000_000 + 160_000).all()
+    assert (complete < 30_000_000).all()
+
+
+def test_flow_engine_tracks_cpu_plane():
+    """Same YAML through the full CPU object plane: flow completion
+    times (server streams size bytes, client reads them) must land in
+    the same ballpark — the flow engine models the same TCP machine
+    over the same path latency, so completions should agree within 2x
+    of the transfer tail past connect."""
+    cfg_flow = load_config_str(tgen_cfg(n_clients=2, size=80_000))
+    s_flow = Manager(cfg_flow).run()
+    assert s_flow.process_failures == []
+
+    cfg_cpu = load_config_str(
+        tgen_cfg(n_clients=2, size=80_000, use_flow_engine=False))
+    s_cpu = Manager(cfg_cpu).run()
+    assert s_cpu.process_failures == []
+    # CPU plane records no per-flow completion; compare through packet
+    # economy instead: both planes moved the same payload, so segment
+    # counts sit within 2x (ack cadence and loss draws differ)
+    assert 0.5 < s_flow.packets_sent / max(s_cpu.packets_sent, 1) < 2.0
+
+
+def test_incomplete_flow_fails_run():
+    """A transfer that cannot finish by stop_time must surface as a
+    process failure (the client expected exited(0))."""
+    cfg = load_config_str(tgen_cfg(n_clients=1, size=50_000_000,
+                                   stop="3s"))
+    stats = Manager(cfg).run()
+    assert len(stats.process_failures) == 1
+    name, why = stats.process_failures[0]
+    assert "client0" in name and "transfer" in why
+
+
+def test_flow_plan_asymmetric_directed_paths():
+    """Directed graphs may price each direction differently; each lane
+    must carry its own direction's latency/loss (r5 review finding)."""
+    gml = """\
+      graph [
+        directed 1
+        node [ id 0 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+        node [ id 1 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+        edge [ source 0 target 0 latency "5 ms" packet_loss 0.0 ]
+        edge [ source 1 target 1 latency "5 ms" packet_loss 0.0 ]
+        edge [ source 0 target 1 latency "30 ms" packet_loss 0.001 ]
+        edge [ source 1 target 0 latency "90 ms" packet_loss 0.01 ]
+      ]
+"""
+    cfg_text = (
+        "general: {stop_time: 30s, seed: 1}\n"
+        "experimental: {use_flow_engine: true}\n"
+        "network:\n  graph:\n    type: gml\n    inline: |\n" + gml +
+        "hosts:\n"
+        "  server:\n    network_node_id: 0\n    processes:\n"
+        "    - {path: tgen-server, args: ['8888'], start_time: 1s,\n"
+        "       expected_final_state: running}\n"
+        "  client0:\n    network_node_id: 1\n    processes:\n"
+        "    - {path: tgen-client, args: ['server', '8888', '40000', '1'],"
+        " start_time: 2s}\n")
+    cfg = load_config_str(cfg_text)
+    mgr = Manager(cfg)
+    plan = compile_flow_plan(cfg, mgr.routing)
+    assert plan.latency_us.tolist() == [90_000]  # client(node1)->server
+    assert plan.latency_back_us.tolist() == [30_000]
+    assert np.allclose(plan.loss, 0.01)
+    assert np.allclose(plan.loss_back, 0.001)
+    # and the whole thing runs
+    stats = Manager(cfg).run()
+    assert stats.process_failures == []

@@ -453,3 +453,37 @@ def test_zero_window_then_write_arms_persist():
     # b sends with its real, open window) and unstick the transfer
     w.run(w.time + 10_000 * MS)
     assert w.b.read(1 << 20) == b"stuck?" * 100
+
+
+def test_lost_handshake_ack_survives_synack_retransmit():
+    """RFC 793 p.69 / RFC 5961: a retransmitted SYN|ACK arriving after
+    we reached ESTABLISHED (our handshake-completing ACK was lost) is an
+    old duplicate SYN below the window — the answer is an ACK that
+    completes the peer's handshake, never an RST. Round-4 behavior reset
+    the connection, killing any flow whose final handshake ACK hit loss
+    (surfaced by the flow engine's lossy wire; both twins fixed
+    together — device side in tpu/tcp.py _ev_segment)."""
+    w = World()
+    w.a.open_active()
+    syn = w.a.next_segment()
+    w.time += w.latency
+    w.b.open_passive(syn)
+    synack = w.b.next_segment()
+    assert synack.flags == TcpFlags.SYN | TcpFlags.ACK
+    w.time += w.latency
+    w.a.on_segment(synack)
+    assert w.a.state == TcpState.ESTABLISHED
+    ack = w.a.next_segment()  # the handshake-completing ACK: LOST
+    assert ack is not None and ack.flags & TcpFlags.ACK
+
+    # b times out and retransmits the identical SYN|ACK
+    w.time += 1_000 * MS
+    w.a.on_segment(synack)
+    assert w.a.state == TcpState.ESTABLISHED  # not reset
+    challenge = w.a.next_segment()
+    assert challenge is not None
+    assert challenge.flags & TcpFlags.ACK
+    assert not challenge.flags & TcpFlags.RST
+    w.time += w.latency
+    w.b.on_segment(challenge)
+    assert w.b.state == TcpState.ESTABLISHED

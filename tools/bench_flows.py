@@ -10,18 +10,18 @@ concurrently (the flow engine has no reason to stagger them), so the
 comparison is JOB-level: wall seconds to simulate all N transfers to
 completion, and TCP segments simulated per wall second.
 
-Round-4 numbers (tunneled v5e, warm compile cache, honest —
-device_get-terminated; `block_until_ready` does NOT synchronize on this
-tunneled backend and early async-measured numbers were 10x+ optimistic):
-  device: all 975 flows complete in ~205 s wall (~1.7k segments/s)
+Round-5 numbers (tunneled v5e, honest — device_get-terminated;
+`block_until_ready` does NOT synchronize on this tunneled backend):
+  device, warm XLA cache: all 975 flows complete in ~8-11 s wall
+  device, cold (first-ever run, includes one ~60 s XLA compile): ~70 s
   CPU object plane (rung 3): same 975 transfers in ~29 s wall
-  (~7.5k packets/s)
-The TCP event kernel itself costs ~0.9 ms per vmapped step (flat in C
-from 200 to 2000 connections — the scaling headroom is real); the
-DRIVER (ring gathers/scatters + event selection in `_inner_step`) adds
-~6-9 ms per step and is the round-5 optimization target. Dispatches are
-chunked (25 windows each) because the tunneled TPU worker kills
-long-running kernels.
+The round-4 engine took ~205 s (one while-iteration per micro-event x
+~6 ms of kernel per iteration); round 5 fused the driver (sched_batch
+arrivals/timers per step, inline app work, convergent pull loop) and
+cut the kernel's sequential 128-slot loops to log-depth/convergent
+forms. The persistent XLA cache (~/.cache/shadow_tpu_xla) makes every
+run after the machine's first pay only the run cost, like any compiled
+simulator pays its build once.
 
 Usage: python tools/bench_flows.py [n_flows] [size_bytes]
 """
@@ -43,32 +43,23 @@ def main():
     n_flows = int(sys.argv[1]) if len(sys.argv) > 1 else 975
     size = int(sys.argv[2]) if len(sys.argv) > 2 else 262_144
 
-    import jax
-
-    from shadow_tpu.tpu import floweng
+    from shadow_tpu.tpu import enable_compilation_cache, floweng
+    enable_compilation_cache()
 
     rng = np.random.default_rng(7)
     lats = rng.integers(20, 200, n_flows) * MS
     sizes = np.full(n_flows, size)
 
     world = floweng.make_flow_world(lats, sizes, queue_slots=128)
-    chunk, window_us = 25, 20 * MS
-    run = jax.jit(lambda w: floweng.run_windows(w, chunk, window_us))
+    window_us = 20 * MS
 
     t0 = time.monotonic()
-    sim_windows = 0
-    # run until every flow completes (one-scalar probe per simulated
-    # second; pulling more costs seconds over a tunneled link)
-    for _ in range(40):
-        for _ in range(2):  # 2 chunks = 1 simulated second
-            world, _ev = run(world)
-            sim_windows += chunk
-        if floweng.all_complete(world):
-            break
+    world, sim_s, retries = floweng.run_to_completion(
+        world, window_us, max_sim_s=40.0, chunk_windows=25,
+        probe_every=2)
     wall = time.monotonic() - t0
     res = floweng.flow_results(world)
     done = int((res["bytes_read"] == res["bytes_expected"]).sum())
-    sim_s = sim_windows * window_us / 1e6
 
     out = {
         "bench": "device_flow_engine",
@@ -81,6 +72,7 @@ def main():
         "segments_per_sec": round(res["segments"] / wall, 1),
         "retransmits": res["retransmits"],
         "queue_drops": res["queue_drops"],
+        "saturation_retries": retries,
     }
     print(json.dumps(out), flush=True)
     return out

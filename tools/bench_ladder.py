@@ -67,9 +67,11 @@ def rung2(n_hosts: int = 100, size: int = 1_048_576) -> dict:
 
 
 def rung3(n_hosts: int = 1000, n_nodes: int = 40,
-          size: int = 262_144) -> dict:
+          size: int = 262_144, use_flow_engine: bool = False) -> dict:
     """1k hosts spread over an Atlas-style GML: full node mesh with
-    20-200 ms latencies and 0.1-1% loss; 25 tgen servers, 975 clients."""
+    20-200 ms latencies and 0.1-1% loss; 25 tgen servers, 975 clients.
+    With use_flow_engine=True the identical YAML runs on the device
+    flow engine (`experimental.use_flow_engine`)."""
     rng = np.random.default_rng(7)
     lines = ["graph [", "  directed 0"]
     for i in range(n_nodes):
@@ -101,10 +103,13 @@ def rung3(n_hosts: int = 1000, n_nodes: int = 40,
             f"    - {{path: tgen-client, args: ['{server}', '8888', "
             f"'{size}', '1'], start_time: {2 + (i % 10)}s}}"
         )
-    cfg = ("general: {stop_time: 120s, seed: 1}\n"
+    flag = ("experimental: {use_flow_engine: true}\n"
+            if use_flow_engine else "")
+    cfg = ("general: {stop_time: 120s, seed: 1}\n" + flag +
            "network:\n  graph:\n    type: gml\n    inline: |\n" + gml +
            "\nhosts:\n" + "\n".join(hosts))
-    return run_rung("rung3_tgen_atlas_1k", cfg)
+    name = "rung3_tgen_atlas_1k" + ("_floweng" if use_flow_engine else "")
+    return run_rung(name, cfg)
 
 
 def rung1(size: int = 10 * 1024 * 1024) -> dict:
@@ -210,6 +215,8 @@ def main():
         rung2()
     if which in ("3", "all"):
         rung3()
+    if which in ("3f", "all"):
+        rung3(use_flow_engine=True)
     if which in ("interpose", "all"):
         rung_interpose()
 
