@@ -648,6 +648,17 @@ class Manager:
                 # mirrored mode: drain the lagged device-verification
                 # pipeline before declaring the run done
                 self.transport.finalize()
+                if self.transport.divergence_count:
+                    # a diverged mirror is a FAILED run (nonzero CLI
+                    # exit), not a log line — the device re-execution is
+                    # a correctness gate (VERDICT r4 #6)
+                    self.stats.process_failures.append((
+                        "device-transport",
+                        f"mirrored device transport diverged from the "
+                        f"CPU ledger in "
+                        f"{self.transport.divergence_count} window(s) "
+                        f"of {self.transport.verified_windows} verified",
+                    ))
 
             # absorb any managed-process death the watcher reported too
             # late for a round-boundary drain
@@ -657,8 +668,10 @@ class Manager:
                     if reap is not None:
                         reap()
 
-            # expected-final-state check happens before teardown kills everyone
-            self.stats.process_failures = self._check_final_states()
+            # expected-final-state check happens before teardown kills
+            # everyone (extend: a transport-divergence failure may
+            # already be recorded above)
+            self.stats.process_failures.extend(self._check_final_states())
 
             # teardown (`manager.rs:480-489`)
             for host in self._host_order:

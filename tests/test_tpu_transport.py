@@ -168,9 +168,14 @@ def test_mirrored_detects_divergence():
         return expected
 
     t._pop_expected = poison
-    mgr.run()
+    stats = mgr.run()
     assert poisoned["done"], "no window with expected deliveries seen"
     assert t.divergence_count >= 1
+    # divergence is a correctness gate: the RUN must fail (nonzero CLI
+    # exit comes from process_failures), not just tick a counter
+    assert any(name == "device-transport" and "diverged" in why
+               for name, why in stats.process_failures), \
+        stats.process_failures
 
 
 def test_mirrored_survives_sparse_window_gaps():
