@@ -284,10 +284,20 @@ class Manager:
                 h.name: Tracker(h, hb) for h in self.hosts
             }
             # per-instance wrapper so run()'s cleanup can tell OUR hook from
-            # one installed by a different Manager in the same process
-            self._status_hook = lambda packet, status: _tracker_dispatch(
-                packet, status
-            )
+            # one installed by a different Manager in the same process.
+            # Early-out on statuses no tracker reacts to BEFORE the
+            # current-host lookup: this hook fires on every status
+            # transition of every packet (~10 per packet), and only ~3
+            # of them move a counter
+            # the hook fires on every status transition (~10 per
+            # packet); early-out here on the ~3 statuses trackers react
+            # to. The filter lives in OUR closure, not the packet
+            # module, so a replacement full-stream tracer is unaffected
+            wanted = frozenset(
+                packet_mod.PacketStatus(s) for s in Tracker.WANTED)
+            self._status_hook = lambda packet, status: (
+                _tracker_dispatch(packet, status)
+                if status in wanted else None)
             packet_mod.status_trace_hook = self._status_hook
         else:
             self.trackers = {}

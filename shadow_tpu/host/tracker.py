@@ -57,25 +57,35 @@ class Tracker:
                 TaskRef(self._heartbeat, "tracker-heartbeat"), self._interval
             )
 
+    # statuses this tracker reacts to, as plain ints — everything else
+    # early-outs before touching the packet (this handler runs per
+    # status transition on the hottest path in the simulator; it was
+    # computing total_size() and a Protocol(...).name round-trip on all
+    # ~10 transitions of every packet before looking at the status)
+    _SENT = int(PacketStatus.SND_INTERFACE_SENT)
+    _RCVD = int(PacketStatus.RCV_INTERFACE_RECEIVED)
+    _RETX = int(PacketStatus.SND_TCP_RETRANSMITTED)
+    _DROPS = frozenset((
+        int(PacketStatus.INET_DROPPED), int(PacketStatus.ROUTER_DROPPED),
+        int(PacketStatus.RCV_SOCKET_DROPPED),
+        int(PacketStatus.RCV_INTERFACE_DROPPED),
+    ))
+    WANTED = frozenset({_SENT, _RCVD, _RETX} | _DROPS)
+
     def on_packet_status(self, packet: Packet, status: PacketStatus) -> None:
+        s = int(status)
         c = self.counters
-        size = packet.total_size()
-        proto = Protocol(packet.protocol).name
-        if status == PacketStatus.SND_INTERFACE_SENT:
+        if s == self._SENT:
             c.packets_out += 1
-            c.bytes_out += size
+            c.bytes_out += packet.total_size()
+            proto = Protocol(packet.protocol).name
             c.by_protocol[proto] = c.by_protocol.get(proto, 0) + 1
-        elif status == PacketStatus.RCV_INTERFACE_RECEIVED:
+        elif s == self._RCVD:
             c.packets_in += 1
-            c.bytes_in += size
-        elif status in (
-            PacketStatus.INET_DROPPED,
-            PacketStatus.ROUTER_DROPPED,
-            PacketStatus.RCV_SOCKET_DROPPED,
-            PacketStatus.RCV_INTERFACE_DROPPED,
-        ):
+            c.bytes_in += packet.total_size()
+        elif s in self._DROPS:
             c.packets_dropped += 1
-        elif status == PacketStatus.SND_TCP_RETRANSMITTED:
+        elif s == self._RETX:
             c.retransmitted += 1
 
     def _heartbeat(self, host) -> None:

@@ -31,6 +31,13 @@ from ...tcp.connection import Segment, TcpConfig, TcpConnection, TcpError, TcpFl
 from .. import errors
 from ..status import FileSignal, FileState, StatefulFile
 
+# int twins of the FileState combos _refresh_state recomputes per
+# packet (IntFlag | / & re-enter the enum machinery per op)
+_READABLE = int(FileState.READABLE)
+_WRITABLE = int(FileState.WRITABLE)
+_ALLOW_CONNECT = int(FileState.SOCKET_ALLOWING_CONNECT)
+_RWC = _READABLE | _WRITABLE | _ALLOW_CONNECT
+
 UNSPECIFIED = "0.0.0.0"
 LOCALHOST = "127.0.0.1"
 DEFAULT_BACKLOG = 128
@@ -39,7 +46,7 @@ DEFAULT_BACKLOG = 128
 def packet_to_segment(packet: Packet) -> Segment:
     h = packet.header or TcpHeader()
     return Segment(
-        flags=TcpFlags(h.flags),
+        flags=h.flags,  # plain int bits on the hot path
         seq=h.seq,
         ack=h.ack,
         window=h.window,
@@ -468,30 +475,24 @@ class TcpSocket(StatefulFile):
     def _refresh_state(self) -> None:
         if self.is_closed():
             return
-        values = FileState.NONE
+        values = 0
         if self._backlog is not None:
             if self._accept_queue:
-                values |= FileState.READABLE
-            self.update_state(FileState.READABLE, values)
+                values |= _READABLE
+            self.update_state(_READABLE, values)
             return
         conn = self.conn
         if conn is None:
-            self.update_state(
-                FileState.READABLE | FileState.WRITABLE | FileState.SOCKET_ALLOWING_CONNECT,
-                FileState.NONE,
-            )
+            self.update_state(_RWC, 0)
             return
         if conn.readable_bytes() > 0 or conn.at_eof() or conn.error is not None:
-            values |= FileState.READABLE
+            values |= _READABLE
         if conn.is_established() and conn.send_space() > 0 and not conn.fin_requested:
-            values |= FileState.WRITABLE
+            values |= _WRITABLE
         if conn.is_established() or conn.error is not None:
             # error included: blocked connect()s must wake to see ECONNREFUSED
-            values |= FileState.SOCKET_ALLOWING_CONNECT
-        self.update_state(
-            FileState.READABLE | FileState.WRITABLE | FileState.SOCKET_ALLOWING_CONNECT,
-            values,
-        )
+            values |= _ALLOW_CONNECT
+        self.update_state(_RWC, values)
 
     def _teardown(self) -> None:
         """Connection fully dead: release the port association."""

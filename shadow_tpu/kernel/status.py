@@ -41,6 +41,13 @@ class FileState(enum.IntFlag):
     DGRAM_SPACE = 1 << 8
 
 
+
+# plain-int twin of FileState.CLOSED for the hottest predicates —
+# IntFlag arithmetic re-enters the enum machinery per operation (see
+# tcp/connection.py's flag twins); state words flow through
+# StatusListener as plain ints and compare equal to FileState members
+_CLOSED_I = int(FileState.CLOSED)
+
 class FileSignal(enum.IntFlag):
     """Edge events that are not state-bit transitions (reference
     `FileSignals`): e.g. more bytes arriving while a file is already
@@ -215,9 +222,12 @@ class StatefulFile:
         """Set the bits selected by `mask` to `values`; notify listeners of
         any bits that actually changed. With no queue supplied, notifications
         run before this returns (a fresh queue is flushed)."""
-        assert values & ~mask == FileState.NONE, "values outside mask"
-        new_state = (self._state & ~mask) | values
-        changed = self._state ^ new_state
+        mask = int(mask)
+        values = int(values)
+        assert values & ~mask == 0, "values outside mask"
+        state = int(self._state)
+        new_state = (state & ~mask) | values
+        changed = state ^ new_state
         if not changed:
             return
         self._state = new_state
@@ -228,4 +238,4 @@ class StatefulFile:
             self._event_source.notify(new_state, changed, cb_queue)
 
     def is_closed(self) -> bool:
-        return bool(self._state & FileState.CLOSED)
+        return bool(int(self._state) & _CLOSED_I)

@@ -54,7 +54,10 @@ class PacketStatus(enum.IntEnum):
 
 
 # Optional global hook for packet tracing (the tracker/pcap layers register
-# here; kept module-level so Packet stays lean).
+# here; kept module-level so Packet stays lean). A hook that only reacts
+# to a few statuses should early-out itself (the Manager's tracker hook
+# does) — the module filters nothing, so a replacement full-stream
+# tracer sees every transition.
 status_trace_hook: Optional[Callable[["Packet", PacketStatus], None]] = None
 
 
@@ -88,6 +91,7 @@ class Packet:
         "header",
         "priority",
         "statuses",
+        "_total_size",
     )
 
     def __init__(
@@ -105,6 +109,7 @@ class Packet:
         self.payload = payload
         self.header = header
         self.priority = priority
+        self._total_size = len(payload) + self.header_size()
         self.statuses: list[PacketStatus] = []
         self.add_status(PacketStatus.SND_CREATED)
 
@@ -121,8 +126,9 @@ class Packet:
         return 0
 
     def total_size(self) -> int:
-        """Header + payload bytes, the unit of rate limiting."""
-        return self.header_size() + self.payload_size()
+        """Header + payload bytes, the unit of rate limiting (payload is
+        immutable after construction, so this is precomputed)."""
+        return self._total_size
 
     def is_control(self) -> bool:
         """Zero-payload control packets are never dropped by path loss

@@ -46,6 +46,17 @@ class TcpFlags(enum.IntFlag):
     URG = 32
 
 
+# plain-int twins for hot-path flag arithmetic: IntFlag's __and__/__or__
+# re-enter the enum machinery on every test (measured ~25% of rung-3
+# wall in enum internals); segments carry int flags at runtime and
+# IntFlag's int interop keeps every external `==`/`&` comparison working
+_FIN = int(TcpFlags.FIN)
+_SYN = int(TcpFlags.SYN)
+_RST = int(TcpFlags.RST)
+_PSH = int(TcpFlags.PSH)
+_ACK = int(TcpFlags.ACK)
+
+
 class TcpState(enum.IntEnum):
     """FSM states (`src/lib/tcp/src/states.rs:23-120`, `tcp.c:38-52`)."""
 
@@ -111,7 +122,7 @@ class Segment:
     """One outbound segment, protocol-level only (no addresses — the socket
     wrapper owns addressing)."""
 
-    flags: TcpFlags
+    flags: int  # TcpFlags bits (plain int on the hot path)
     seq: int  # 32-bit wire value
     ack: int
     window: int  # as advertised on the wire (already scaled down)
@@ -302,7 +313,7 @@ class TcpConnection:
         """Become the server side of a connection from a received SYN
         (the listener socket calls this on a fresh connection)."""
         assert self.state == TcpState.CLOSED
-        assert syn.flags & TcpFlags.SYN
+        assert syn.flags & _SYN
         self.iss = self.deps.random_u32() & 0xFFFFFFFF
         self.irs = syn.seq
         self.rcv_nxt = 0  # offset 0 == wire seq irs+1
@@ -561,9 +572,9 @@ class TcpConnection:
         if self._syn_sends > 1:
             self.retransmit_count += 1
         if self.state == TcpState.SYN_SENT:
-            flags, ack = TcpFlags.SYN, 0
+            flags, ack = _SYN, 0
         else:  # SYN_RCVD: SYN|ACK
-            flags, ack = TcpFlags.SYN | TcpFlags.ACK, self._wire_ack()
+            flags, ack = _SYN | _ACK, self._wire_ack()
         self._ack_pending = False
         return self._stamp(
             Segment(
@@ -598,9 +609,9 @@ class TcpConnection:
         self._ack_pending = False
         if not self._rto_armed:
             self._arm_rto()
-        flags = TcpFlags.ACK
+        flags = _ACK
         if self.snd_nxt >= self.stream_len:
-            flags |= TcpFlags.PSH
+            flags |= _PSH
         return self._stamp(
             Segment(
                 flags=flags,
@@ -629,7 +640,7 @@ class TcpConnection:
             self._arm_rto()
         return self._stamp(
             Segment(
-                flags=TcpFlags.ACK,
+                flags=_ACK,
                 seq=self._wire_seq(off),
                 ack=self._wire_ack(),
                 window=self._advertised_window(False),
@@ -649,7 +660,7 @@ class TcpConnection:
             self._arm_rto()
         return self._stamp(
             Segment(
-                flags=TcpFlags.ACK,
+                flags=_ACK,
                 seq=self._wire_seq(off),
                 ack=self._wire_ack(),
                 window=self._advertised_window(False),
@@ -668,7 +679,7 @@ class TcpConnection:
             self._arm_rto()
         return self._stamp(
             Segment(
-                flags=TcpFlags.FIN | TcpFlags.ACK,
+                flags=_FIN | _ACK,
                 seq=self._wire_seq(self.stream_len),
                 ack=self._wire_ack(),
                 window=self._advertised_window(False),
@@ -680,7 +691,7 @@ class TcpConnection:
         self._ack_pending = False
         return self._stamp(
             Segment(
-                flags=TcpFlags.ACK,
+                flags=_ACK,
                 seq=self._wire_seq(min(self.snd_nxt, self.stream_len + (1 if self.fin_sent else 0))),
                 ack=self._wire_ack(),
                 window=self._advertised_window(False),
@@ -691,7 +702,7 @@ class TcpConnection:
     def _build_rst(self) -> Segment:
         self._rst_pending = False
         seg = Segment(
-            flags=TcpFlags.RST | TcpFlags.ACK,
+            flags=_RST | _ACK,
             seq=self._wire_seq(min(self.snd_nxt, self.stream_len)),
             ack=self._wire_ack(),
             window=0,
@@ -710,7 +721,7 @@ class TcpConnection:
             # CLOSING/LAST_ACK retransmits its FIN into a silent void
             # until retry exhaustion (reachable once the wire is lossy;
             # both twins fixed together round 5, tpu/tcp.py _ev_segment)
-            if not seg.flags & TcpFlags.RST:
+            if not seg.flags & _RST:
                 self._rst_pending = True
                 self.deps.notify()
             return
@@ -723,7 +734,7 @@ class TcpConnection:
             return
 
         # --- RST (any synchronized state) ------------------------------
-        if seg.flags & TcpFlags.RST:
+        if seg.flags & _RST:
             if self.state == TcpState.TIME_WAIT:
                 self._enter_closed(None)
             else:
@@ -732,7 +743,7 @@ class TcpConnection:
             return
 
         # --- SYN handling outside handshake -----------------------------
-        if seg.flags & TcpFlags.SYN:
+        if seg.flags & _SYN:
             if self.state == TcpState.SYN_RCVD and seg.seq == self.irs:
                 # duplicate of the original SYN: re-send SYN|ACK
                 self._syn_outstanding = False
@@ -754,23 +765,23 @@ class TcpConnection:
             self.deps.notify()
             return
 
-        if seg.flags & TcpFlags.ACK:
+        if seg.flags & _ACK:
             self._process_ack(seg)
 
         if seg.payload:
             self._process_payload(seg)
 
-        if seg.flags & TcpFlags.FIN:
+        if seg.flags & _FIN:
             self._process_fin(seg)
 
         self.deps.notify()
 
     def _on_segment_syn_sent(self, seg: Segment) -> None:
-        if seg.flags & TcpFlags.RST:
-            if seg.flags & TcpFlags.ACK and seg.ack == seqmod.add(self.iss, 1):
+        if seg.flags & _RST:
+            if seg.flags & _ACK and seg.ack == seqmod.add(self.iss, 1):
                 self._enter_closed(111)  # ECONNREFUSED
             return
-        if seg.flags & TcpFlags.SYN and seg.flags & TcpFlags.ACK:
+        if seg.flags & _SYN and seg.flags & _ACK:
             if seg.ack != seqmod.add(self.iss, 1):
                 self._rst_pending = True
                 return
@@ -790,7 +801,7 @@ class TcpConnection:
             self._disarm_rto()
             if seg.timestamp_echo and self.rtt.backoff_count == 0:
                 self.rtt.update(self._now_ms() - seg.timestamp_echo)
-        elif seg.flags & TcpFlags.SYN:
+        elif seg.flags & _SYN:
             # simultaneous open
             self.irs = seg.seq
             self.rcv_nxt = 0
