@@ -176,6 +176,12 @@ class ExperimentalOptions:
     # but tgen-server/tgen-client processes — an explicit promise, not
     # a heuristic. See core/flowplan.py for the fidelity contract.
     use_flow_engine: bool = False
+    # per-host filesystem view for managed native processes: absolute
+    # non-system paths redirect under the host's data dir (read-through
+    # to the real path for base-layer files), so two hosts writing
+    # /tmp/shared.log never collide (reference file.c/fileat.c role,
+    # re-designed as namespace redirection; see BASELINE.md)
+    host_path_isolation: bool = True
     tpu_egress_cap: int = 256  # per-host device egress slots
     tpu_ingress_cap: int = 256  # per-host device in-flight slots
     tpu_compact_cap: int = 4096  # per-window compacted-delivery slots

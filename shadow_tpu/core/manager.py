@@ -179,6 +179,18 @@ class Manager:
                     config.general.model_unblocked_syscall_latency
                 ),
             )
+            if config.experimental.host_path_isolation:
+                # per-host filesystem view (file-family syscalls): the
+                # redirect root lives beside the host's output dir; a
+                # shared per-run temp root stands in when no data dir
+                # was given
+                host.vfs_enabled = True
+                # ABSOLUTE: the rewritten path is resolved by the GUEST
+                # against ITS cwd, and the simulator's own makedirs
+                # against ours — only an absolute root means the same dir
+                host.vfs_host_dir = os.path.abspath(os.path.join(
+                    self._vfs_data_root(), "hosts", name))
+                host.vfs_root = os.path.join(host.vfs_host_dir, "root")
             self.hosts.append(host)
             self.hosts_by_name[name] = host
             ip_to_host[ip] = host
@@ -334,6 +346,17 @@ class Manager:
             return hook
 
         return factory
+
+    def _vfs_data_root(self) -> str:
+        if self.data_dir:
+            return self.data_dir
+        root = getattr(self, "_tmp_data_root", None)
+        if root is None:
+            import tempfile
+
+            root = self._tmp_data_root = tempfile.mkdtemp(
+                prefix="shadow-tpu-vfs-")
+        return root
 
     def _wire_processes(self, host: Host, host_name: str, opts) -> None:
         """Schedule spawn (and optional shutdown-signal) tasks for each
@@ -698,6 +721,14 @@ class Manager:
                 writer.close()
             return self.stats
         finally:
+            # a data-dir-less run's per-host filesystem trees live in a
+            # private temp root: the caller never asked for persistence
+            tmp_root = getattr(self, "_tmp_data_root", None)
+            if tmp_root is not None:
+                import shutil
+
+                shutil.rmtree(tmp_root, ignore_errors=True)
+                self._tmp_data_root = None
             # drop the process-wide status hook so later Manager instances
             # in the same process don't pay per-packet dispatch to a stale
             # tracker set (only if it is still ours — a newer Manager may

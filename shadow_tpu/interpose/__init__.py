@@ -23,7 +23,7 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, "libshadow_ipc.so")
 
 SHMEM_HANDLE_MAX = 128
-SCCHANNEL_MSG_MAX = 480
+SCCHANNEL_MSG_MAX = 1088  # keep in lockstep with scchannel.h
 
 
 class ShMemBlock(ctypes.Structure):
@@ -70,9 +70,21 @@ class ShimAddThreadRes(ctypes.Structure):
     _fields_ = [("child_native_tid", ctypes.c_int64)]
 
 
+SHIM_REWRITE_PATH_MAX = 400
+
+
+class ShimSyscallRewrite(ctypes.Structure):
+    _fields_ = [
+        ("args", ctypes.c_uint64 * 6),
+        ("path_arg", ctypes.c_int32 * 2),
+        ("path", (ctypes.c_char * SHIM_REWRITE_PATH_MAX) * 2),
+    ]
+
+
 class _ShimEventUnion(ctypes.Union):
     _fields_ = [
         ("syscall", ShimSyscallArgs),
+        ("rewrite", ShimSyscallRewrite),
         ("complete", ShimSyscallComplete),
         ("start_req", ShimStartReq),
         ("add_thread_req", ShimAddThreadReq),
@@ -99,6 +111,7 @@ EVENT_START_RES = 5
 EVENT_SYSCALL = 6
 EVENT_ADD_THREAD_RES = 7
 EVENT_PROCESS_DEATH = 8
+EVENT_SYSCALL_DO_NATIVE_REWRITE = 9
 
 _lib: Optional[ctypes.CDLL] = None
 

@@ -40,12 +40,24 @@ enum ShimEventKind {
     SHIM_EVENT_SYSCALL = 6,
     SHIM_EVENT_ADD_THREAD_RES = 7,
     SHIM_EVENT_PROCESS_DEATH = 8,
+    /* shadow -> shim: execute natively with substituted pointer args
+     * (the simulator's per-host filesystem view rewrites path
+     * arguments; the shim stages the strings on its own stack) */
+    SHIM_EVENT_SYSCALL_DO_NATIVE_REWRITE = 9,
 };
 
 typedef struct ShimSyscallArgs {
     int64_t number;
     uint64_t args[6];
 } ShimSyscallArgs;
+
+#define SHIM_REWRITE_PATH_MAX 400
+
+typedef struct ShimSyscallRewrite {
+    uint64_t args[6];        /* full arg vector to execute with */
+    int32_t path_arg[2];     /* arg index each path replaces; -1 = unused */
+    char path[2][SHIM_REWRITE_PATH_MAX]; /* NUL-terminated */
+} ShimSyscallRewrite;
 
 typedef struct ShimSyscallComplete {
     int64_t retval;
@@ -79,6 +91,7 @@ typedef struct ShimEvent {
     uint64_t sim_time_ns;  /* shim-advanced clock rides along each event */
     union {
         ShimSyscallArgs syscall;
+        ShimSyscallRewrite rewrite;
         ShimSyscallComplete complete;
         ShimStartReq start_req;
         ShimAddThreadReq add_thread_req;

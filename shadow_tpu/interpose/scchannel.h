@@ -22,7 +22,8 @@
 extern "C" {
 #endif
 
-#define SCCHANNEL_MSG_MAX 480  /* fits IPCData in two cache-lined channels */
+#define SCCHANNEL_MSG_MAX 1088  /* fits ShimEvent incl. the path-rewrite
+                                   payload (two 400-byte paths) */
 
 enum {
     SCCHANNEL_EMPTY = 0,

@@ -220,6 +220,27 @@ static long shim_emulate_syscall(long nr, const uint64_t args[6]) {
         return shim_raw_syscall(nr, (long)args[0], (long)args[1], (long)args[2],
                                 (long)args[3], (long)args[4], (long)args[5]);
     }
+    if (reply.kind == SHIM_EVENT_SYSCALL_DO_NATIVE_REWRITE) {
+        /* per-host filesystem view: execute with substituted path args.
+         * Strings must live in THIS address space; stage on the stack. */
+        char p0[SHIM_REWRITE_PATH_MAX], p1[SHIM_REWRITE_PATH_MAX];
+        uint64_t a[6];
+        for (int i = 0; i < 6; i++) a[i] = reply.u.rewrite.args[i];
+        int i0 = reply.u.rewrite.path_arg[0];
+        int i1 = reply.u.rewrite.path_arg[1];
+        if (i0 >= 0 && i0 < 6) {
+            memcpy(p0, reply.u.rewrite.path[0], SHIM_REWRITE_PATH_MAX);
+            p0[SHIM_REWRITE_PATH_MAX - 1] = 0;
+            a[i0] = (uint64_t)p0;
+        }
+        if (i1 >= 0 && i1 < 6) {
+            memcpy(p1, reply.u.rewrite.path[1], SHIM_REWRITE_PATH_MAX);
+            p1[SHIM_REWRITE_PATH_MAX - 1] = 0;
+            a[i1] = (uint64_t)p1;
+        }
+        return shim_raw_syscall(nr, (long)a[0], (long)a[1], (long)a[2],
+                                (long)a[3], (long)a[4], (long)a[5]);
+    }
     return reply.u.complete.retval;
 }
 

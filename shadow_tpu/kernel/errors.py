@@ -37,6 +37,8 @@ EAFNOSUPPORT = _errno.EAFNOSUPPORT
 ENFILE = _errno.ENFILE
 EMFILE = _errno.EMFILE
 EFAULT = _errno.EFAULT
+ENOTDIR = _errno.ENOTDIR
+ENAMETOOLONG = _errno.ENAMETOOLONG
 ESPIPE = _errno.ESPIPE
 ENODEV = _errno.ENODEV
 EACCES = _errno.EACCES

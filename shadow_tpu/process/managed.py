@@ -41,6 +41,7 @@ from .condition import SysCallCondition
 from .memory import MAPPING_SYSCALLS, MemoryRegions
 from .process import ProcessState
 from .syscall_handler import (SYS_tgkill, DispatchCtx, NativeSyscall,
+                              NativeSyscallRewrite,
                               SyscallHandler, _libc_syscall)
 
 log = logging.getLogger("shadow_tpu.process")
@@ -657,12 +658,17 @@ class ManagedSimProcess:
         def _limit_fds():
             resource.setrlimit(resource.RLIMIT_NOFILE, (_fd_cap, _fd_cap))
 
+        inherit = getattr(self, "_inherit_stdio", None) or {}
         self.proc = subprocess.Popen(
             argv, env=env, executable=executable, cwd=cwd,
             preexec_fn=_limit_fds,
-            stdout=self._stdout or subprocess.DEVNULL,
-            stderr=self._stderr or subprocess.DEVNULL,
+            stdin=inherit.get(0, None),
+            stdout=inherit.get(1, self._stdout or subprocess.DEVNULL),
+            stderr=inherit.get(2, self._stderr or subprocess.DEVNULL),
         )
+        for fd in inherit.values():
+            os.close(fd)  # the child holds its own dups now
+        self._inherit_stdio = None
         self.server.mem = MemoryCopier(self.proc.pid)
         self.server.native_pid = self.proc.pid
         # region bookkeeping (`memory_manager/mod.rs:616-709`): seeded from
@@ -676,6 +682,52 @@ class ManagedSimProcess:
         from .pidwatcher import get_watcher
 
         get_watcher().watch(self.proc.pid, self._on_child_death)
+
+    _SYS_pidfd_getfd = 438
+
+    def _steal_stdio(self, old_pid: int) -> dict:
+        """Duplicate a dying incarnation's stdio fds into the simulator
+        (pidfd_getfd(2)) so they survive the exec-as-respawn. Default
+        log-file stdio (same inode as our .stdout/.stderr sinks) is left
+        to the normal wiring — only redirects travel."""
+        out: dict[int, int] = {}
+        defaults = {}
+        for sink, gfd in ((self._stdout, 1), (self._stderr, 2)):
+            if sink is not None:
+                try:
+                    st = os.fstat(sink.fileno())
+                    defaults[gfd] = (st.st_dev, st.st_ino)
+                except OSError:
+                    pass
+        try:
+            pidfd = os.pidfd_open(old_pid)
+        except OSError:
+            return out
+        try:
+            import ctypes
+
+            libc = ctypes.CDLL(None, use_errno=True)
+            for gfd in (0, 1, 2):
+                local = libc.syscall(self._SYS_pidfd_getfd, pidfd, gfd, 0)
+                if local < 0:
+                    continue
+                try:
+                    st = os.fstat(local)
+                    ident = (st.st_dev, st.st_ino)
+                    # stdin: only carry real redirects, not the tty/null
+                    import stat as _stat
+
+                    if gfd == 0 and _stat.S_ISCHR(st.st_mode):
+                        raise OSError
+                    if defaults.get(gfd) == ident:
+                        raise OSError  # default log sink: normal wiring
+                except OSError:
+                    os.close(local)
+                    continue
+                out[gfd] = local
+        finally:
+            os.close(pidfd)
+        return out
 
     def spawn(self) -> None:
         assert self.state == ProcessState.PENDING
@@ -1122,6 +1174,13 @@ class ManagedSimProcess:
             self._cwd = os.readlink(f"/proc/{old_pid}/cwd")
         except OSError:
             pass  # already gone: keep the previous cwd
+        # stdio survives execve(2) too: a shell's `cmd > file` opens the
+        # redirect in the parent and the exec'd child INHERITS fd 1.
+        # The respawn would rewire stdio to the .stdout/.stderr logs and
+        # silently swallow the redirect (this exact bug shipped rounds
+        # 2-4). Steal the dying image's stdio via pidfd_getfd and hand
+        # any NON-default fd to the new incarnation.
+        self._inherit_stdio = self._steal_stdio(old_pid)
         old_proc, self.proc = self.proc, None
         from .pidwatcher import get_watcher
 
@@ -1587,7 +1646,12 @@ class ManagedSimProcess:
             else thread.vfork_child.handler
         try:
             ret = handler.dispatch(nr, args, ctx)
-        except NativeSyscall:
+        except NativeSyscallRewrite as rw:
+            self._strace(thread, nr, args, "<native>",
+                         argstr=rw.strace_args)
+            self._reply_native_rewrite(thread, args, rw.path_args)
+            return False
+        except NativeSyscall as ns:
             # not simulated-kernel territory: time/identity emulation, then
             # native passthrough
             try:
@@ -1595,7 +1659,8 @@ class ManagedSimProcess:
             except OSError:
                 ret2 = None  # memory gone (racing exit): run it natively
             if ret2 is None:
-                self._strace(thread, nr, args, "<native>")
+                self._strace(thread, nr, args, "<native>",
+                             argstr=getattr(ns, "strace_args", None))
                 self._reply_native(thread)
             else:
                 self._strace(thread, nr, args, ret2)
@@ -1631,9 +1696,11 @@ class ManagedSimProcess:
         self._reply_complete(thread, ret)
         return False
 
-    def _strace(self, thread: ManagedThread, nr: int, args, result) -> None:
+    def _strace(self, thread: ManagedThread, nr: int, args, result,
+                argstr: Optional[str] = None) -> None:
         if self.strace is not None:
-            self.strace.log(self.host.now(), thread.tindex, nr, args, result)
+            self.strace.log(self.host.now(), thread.tindex, nr, args, result,
+                            argstr=argstr)
 
     def _park(self, thread: ManagedThread, nr: int, args, blocked) -> None:
         """Arm a SysCallCondition for a blocked syscall; the shim stays in
@@ -1718,6 +1785,28 @@ class ManagedSimProcess:
         self._publish_clock()
         reply = ShimEvent()
         reply.kind = EVENT_SYSCALL_DO_NATIVE
+        try:
+            thread.ipc.send_to_shim(reply)
+        except OSError:
+            pass
+
+    def _reply_native_rewrite(self, thread: ManagedThread, args,
+                              path_args: dict) -> None:
+        """Execute natively with substituted path arguments (the per-host
+        filesystem view): the shim stages each replacement string on its
+        own stack and runs the raw syscall."""
+        from ..interpose import EVENT_SYSCALL_DO_NATIVE_REWRITE
+
+        self._publish_clock()
+        reply = ShimEvent()
+        reply.kind = EVENT_SYSCALL_DO_NATIVE_REWRITE
+        for i in range(6):
+            reply.u.rewrite.args[i] = int(args[i]) & (2**64 - 1)
+        reply.u.rewrite.path_arg[0] = -1
+        reply.u.rewrite.path_arg[1] = -1
+        for slot, (idx, path) in enumerate(sorted(path_args.items())):
+            reply.u.rewrite.path_arg[slot] = idx
+            reply.u.rewrite.path[slot].value = path  # NUL-terminated
         try:
             thread.ipc.send_to_shim(reply)
         except OSError:

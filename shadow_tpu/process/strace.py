@@ -120,14 +120,20 @@ class StraceLogger:
             return "<ptr>"
         return hex(v) if v >= _PTR_FLOOR else str(v)
 
-    def log(self, now_ns: int, tindex: int, nr: int, args, result) -> None:
+    def log(self, now_ns: int, tindex: int, nr: int, args, result,
+            argstr=None) -> None:
         if self._fh is None:
             self._fh = open(self.path, "w", buffering=1 << 16)
         name = SYSCALL_NAMES.get(nr, f"syscall_{nr}")
         sec, rem = divmod(now_ns, simtime.SECOND)
         h, s = divmod(sec, 3600)
         m, s = divmod(s, 60)
-        if self.mode == "deterministic":
+        if argstr is not None:
+            # a handler supplied the guest-visible rendering (file-family
+            # syscalls print their PATH STRINGS — sim-deterministic —
+            # where the raw pointer args would be masked)
+            rendered = argstr
+        elif self.mode == "deterministic":
             if nr in _MEM_SYSCALLS:
                 rendered = "<mem>"
             else:

@@ -181,6 +181,67 @@ SYS_accept4 = 288
 SYS_recvmmsg = 299
 SYS_sendmmsg = 307
 SYS_statx = 332
+# file family (handler/file.c + fileat.c in the reference; here: path
+# virtualization + strace visibility, execution stays native)
+SYS_stat = 4
+SYS_open = 2
+SYS_creat = 85
+SYS_lstat = 6
+SYS_access = 21
+SYS_rename = 82
+SYS_mkdir = 83
+SYS_rmdir = 84
+SYS_link = 86
+SYS_unlink = 87
+SYS_symlink = 88
+SYS_readlink = 89
+SYS_chmod = 90
+SYS_chown = 92
+SYS_lchown = 94
+SYS_truncate = 76
+SYS_ftruncate = 77
+SYS_fsync = 74
+SYS_fdatasync = 75
+SYS_flock = 73
+SYS_getdents = 78
+SYS_getdents64 = 217
+SYS_getcwd = 79
+SYS_chdir = 80
+SYS_fchdir = 81
+SYS_fchmod = 91
+SYS_statfs = 137
+SYS_utime = 132
+SYS_utimes = 235
+SYS_openat = 257
+SYS_mkdirat = 258
+SYS_fchownat = 260
+SYS_unlinkat = 263
+SYS_renameat = 264
+SYS_linkat = 265
+SYS_symlinkat = 266
+SYS_readlinkat = 267
+SYS_fchmodat = 268
+SYS_faccessat = 269
+SYS_utimensat = 280
+SYS_fallocate = 285
+SYS_renameat2 = 316
+SYS_faccessat2 = 439
+SYS_mknod = 133
+SYS_mknodat = 259
+
+AT_FDCWD = -100
+O_WRONLY, O_RDWR, O_CREAT, O_TRUNC, O_APPEND = 0o1, 0o2, 0o100, 0o1000, 0o2000
+O_TMPFILE = 0o20200000
+# absolute prefixes served by the REAL filesystem: read-only system
+# resources every process legitimately shares. Everything else absolute
+# is per-host (redirected under host.vfs_root with read-through to the
+# real path for base files — a create/write-oriented overlay; deletions
+# of base-layer files are not whiteout-tracked, documented in BASELINE)
+VFS_SYSTEM_PREFIXES = (
+    b"/etc/", b"/usr/", b"/lib/", b"/lib64/", b"/bin/", b"/sbin/",
+    b"/proc/", b"/sys/", b"/dev/", b"/run/", b"/opt/", b"/nix/",
+)
+VFS_PATH_MAX = 399  # SHIM_REWRITE_PATH_MAX - NUL
 SYS_epoll_create1 = 291
 SYS_dup3 = 292
 SYS_getrandom = 318
@@ -249,7 +310,28 @@ MS = 1_000_000  # ns per millisecond
 
 
 class NativeSyscall(Exception):
-    """Handler verdict: execute this syscall natively in the shim."""
+    """Handler verdict: execute this syscall natively in the shim. An
+    optional `strace_args` carries a handler-rendered argument string
+    (file-family handlers print guest-visible paths where deterministic
+    strace would mask the raw pointers)."""
+
+    def __init__(self, strace_args=None):
+        super().__init__()
+        self.strace_args = strace_args
+
+
+class NativeSyscallRewrite(Exception):
+    """Handler verdict: execute natively with substituted pointer args —
+    the per-host filesystem view (`core/manager` assigns `host.vfs_root`;
+    reference parity: the file family of `handler/file.c:1-429` /
+    `fileat.c:1-508`, re-designed as path REDIRECTION because this
+    rebuild's managed fds are real kernel fds, not virtual file objects).
+    `path_args` maps arg index -> replacement path bytes (max 2)."""
+
+    def __init__(self, path_args: dict, strace_args=None):
+        super().__init__()
+        self.path_args = path_args
+        self.strace_args = strace_args
 
 
 class DispatchCtx:
@@ -984,11 +1066,12 @@ class SyscallHandler:
     def _sys_newfstatat(self, args, ctx) -> int:
         """newfstatat(2): glibc implements fstat() as
         newfstatat(fd, "", AT_EMPTY_PATH) — emulate that shape for
-        virtual descriptors; every path-based form stays native."""
+        virtual descriptors; path-based forms route through the per-host
+        filesystem view like stat(2)."""
         dirfd, flags = _i32(args[0]), _i32(args[3])
-        if not flags & self.AT_EMPTY_PATH or not self.has_vfd(dirfd):
-            raise NativeSyscall()
-        return self._sys_fstat([dirfd, args[2]], ctx)
+        if flags & self.AT_EMPTY_PATH and self.has_vfd(dirfd):
+            return self._sys_fstat([dirfd, args[2]], ctx)
+        return self._vfs_one_path(args, "newfstatat", 1, False)
 
     def _sys_lseek(self, args, ctx) -> int:
         """lseek(2) on a virtual descriptor: pipes and sockets are not
@@ -1505,10 +1588,11 @@ class SyscallHandler:
 
     def _sys_statx(self, args, ctx) -> int:
         """statx(2) for virtual fds via AT_EMPTY_PATH; path-based forms
-        stay native (regular files are native in this design)."""
+        route through the per-host filesystem view (regular files are
+        native in this design)."""
         dirfd, flags = _i32(args[0]), _i32(args[2])
         if not flags & self.AT_EMPTY_PATH or not self.has_vfd(dirfd):
-            raise NativeSyscall()
+            return self._vfs_one_path(args, "statx", 1, False)
         file = self._file(dirfd)
         mode, ino = self._vfd_stat_identity(file)
         # struct statx: mask(4) blksize(4) attributes(8) nlink(4) uid(4)
@@ -2329,7 +2413,335 @@ class SyscallHandler:
         self._file(out_fd)  # EBADF check
         raise errors.SyscallError(errors.EINVAL)
 
+
+    # ==================================================================
+    # file family: the per-host filesystem view (reference
+    # `handler/file.c:1-429` + `fileat.c:1-508`, re-designed as path
+    # REDIRECTION: managed fds are real kernel fds here, so execution
+    # stays native and the simulator virtualizes the NAMESPACE instead —
+    # absolute non-system paths land under `host.vfs_root`, with
+    # read-through to the real path for base-layer files. Deterministic
+    # strace prints the GUEST-visible path.)
+    # ==================================================================
+
+    def _read_path(self, addr) -> bytes:
+        """NUL-terminated guest string (path-sized). Chunks never cross
+        a page boundary: a string ending near the top of the last mapped
+        page must not drag the read into the unmapped neighbor
+        (process_vm_readv fails the WHOLE iovec on any fault)."""
+        addr = int(addr) & (2**64 - 1)
+        if addr == 0:
+            raise errors.SyscallError(errors.EFAULT)
+        out = b""
+        while len(out) < 4096:
+            pos = addr + len(out)
+            span = min(256, 4096 - (pos & 0xFFF))
+            chunk = self.mem.read(pos, span)
+            nul = chunk.find(0)
+            if nul >= 0:
+                return out + chunk[:nul]
+            out += chunk
+        raise errors.SyscallError(errors.ENAMETOOLONG)
+
+    def _vfs_root(self):
+        if not getattr(self.host, "vfs_enabled", False):
+            return None
+        root = getattr(self.host, "vfs_root", None)
+        if root is None:
+            return None
+        return root if isinstance(root, bytes) else root.encode()
+
+    def _vfs_resolve(self, path: bytes, write: bool,
+                     mirror_dir: bool = False):
+        """None = leave the path alone (relative, system prefix, already
+        host-local, or a base-layer read); else the redirected bytes."""
+        import os as _os
+
+        root = self._vfs_root()
+        if root is None or not path.startswith(b"/"):
+            return None
+        # collapse ".." BEFORE any prefix decision: "/tmp/../usr/x" IS
+        # /usr/x (system), and "/a/../../x" must not climb out of the
+        # per-host root. (Escapes via guest-created symlinks inside the
+        # virtual tree are not chased — documented limitation.)
+        norm = _os.path.normpath(path)
+        if norm.startswith(root):
+            return None  # app echoed a virtualized path back to us
+        if any(norm.startswith(p) or norm == p.rstrip(b"/")
+               for p in VFS_SYSTEM_PREFIXES):
+            return None
+        host_dir = getattr(self.host, "vfs_host_dir", None)
+        if host_dir and norm.startswith(
+                host_dir if isinstance(host_dir, bytes)
+                else host_dir.encode()):
+            return None  # the host data dir itself (cwd outputs)
+        virt = root + norm
+        if len(virt) > VFS_PATH_MAX:
+            # isolation would need a longer path than the rewrite event
+            # carries: fall back to the shared real path (logged) rather
+            # than failing a legal syscall
+            import logging as _logging
+
+            _logging.getLogger("shadow.vfs").warning(
+                "path too long for per-host redirect, passing through: "
+                "%r", path)
+            return None
+        if write:
+            parent = virt.rsplit(b"/", 1)[0]
+            try:
+                _os.makedirs(parent, exist_ok=True)
+            except OSError:
+                pass
+            if not _os.path.lexists(virt):
+                # copy-up: a write-class op on a BASE-layer file must see
+                # the base content (append, read-modify-write, rename);
+                # dirs mirror as empty nodes (chdir, O_TMPFILE targets)
+                try:
+                    if _os.path.isdir(norm):
+                        if mirror_dir:
+                            _os.makedirs(virt, exist_ok=True)
+                    elif _os.path.isfile(norm):
+                        import shutil as _shutil
+
+                        _shutil.copy2(norm, virt)
+                except OSError:
+                    pass
+            return virt
+        return virt if _os.path.lexists(virt) else None
+
+    @staticmethod
+    def _render_path(p: bytes) -> str:
+        return '"' + p.decode(errors="replace") + '"'
+
+    @staticmethod
+    def _render_small(v) -> str:
+        """fds/flags render as ints; anything address-sized masks (the
+        deterministic-strace contract: no ASLR-dependent values)."""
+        u = int(v) & (2**64 - 1)
+        s = u - 2**64 if u >= 2**63 else u
+        return str(s) if -4096 <= s < (1 << 24) else "<ptr>"
+
+    def _vfs_active(self) -> bool:
+        return self._vfs_root() is not None \
+            or getattr(self.process, "strace", None) is not None
+
+    def _vfs_one_path(self, args, name: str, arg_idx: int, write: bool,
+                      mirror_dir: bool = False, tail: str = ""):
+        """Shared shape: resolve the single path argument, raise the
+        native(-rewrite) verdict with a guest-visible strace line."""
+        if not self._vfs_active():
+            raise NativeSyscall()  # nothing to redirect, nobody to log to
+        path = self._read_path(args[arg_idx])
+        pre = ", ".join(self._render_small(args[i]) for i in range(arg_idx))
+        disp = (pre + ", " if pre else "") + self._render_path(path) + tail
+        red = self._vfs_resolve(path, write, mirror_dir=mirror_dir)
+        if red is None:
+            raise NativeSyscall(strace_args=disp)
+        raise NativeSyscallRewrite({arg_idx: red}, strace_args=disp)
+
+    def _vfs_two_paths(self, args, name: str, idx_a: int, idx_b: int):
+        """rename/link shapes: both paths are write-class."""
+        if not self._vfs_active():
+            raise NativeSyscall()
+        pa = self._read_path(args[idx_a])
+        pb = self._read_path(args[idx_b])
+        disp = f"{self._render_path(pa)}, {self._render_path(pb)}"
+        ra = self._vfs_resolve(pa, write=True)
+        rb = self._vfs_resolve(pb, write=True)
+        path_args = {}
+        if ra is not None:
+            path_args[idx_a] = ra
+        if rb is not None:
+            path_args[idx_b] = rb
+        if not path_args:
+            raise NativeSyscall(strace_args=disp)
+        raise NativeSyscallRewrite(path_args, strace_args=disp)
+
+    @staticmethod
+    def _open_is_write(flags: int) -> bool:
+        return bool(flags & (O_WRONLY | O_RDWR | O_CREAT | O_TRUNC
+                             | O_APPEND)) or \
+            (flags & O_TMPFILE) == O_TMPFILE
+
+    def _sys_open(self, args, ctx) -> int:
+        flags = _i32(args[1])
+        return self._vfs_one_path(
+            args, "open", 0, self._open_is_write(flags),
+            mirror_dir=(flags & O_TMPFILE) == O_TMPFILE,
+            tail=f", {flags:#o}")
+
+    def _sys_creat(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "creat", 0, True)
+
+    def _sys_openat(self, args, ctx) -> int:
+        flags = _i32(args[2])
+        return self._vfs_one_path(
+            args, "openat", 1, self._open_is_write(flags),
+            mirror_dir=(flags & O_TMPFILE) == O_TMPFILE,
+            tail=f", {flags:#o}")
+
+    def _sys_stat(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "stat", 0, False)
+
+    def _sys_lstat(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "lstat", 0, False)
+
+    def _sys_access(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "access", 0, False)
+
+    def _sys_faccessat(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "faccessat", 1, False)
+
+    def _sys_statfs(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "statfs", 0, False)
+
+    def _sys_readlink(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "readlink", 0, False)
+
+    def _sys_readlinkat(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "readlinkat", 1, False)
+
+    def _sys_utime_like(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "utime", 0, True)
+
+    def _sys_utimensat(self, args, ctx) -> int:
+        if int(args[1]) == 0:
+            raise NativeSyscall()  # NULL path: operates on dirfd itself
+        return self._vfs_one_path(args, "utimensat", 1, True)
+
+    def _sys_chdir(self, args, ctx) -> int:
+        # write-class with dir mirroring: entering a base-layer dir
+        # creates the per-host twin so later RELATIVE writes stay
+        # host-local (the whole point of the redirect)
+        return self._vfs_one_path(args, "chdir", 0, True,
+                                  mirror_dir=True)
+
+    def _sys_mkdir(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "mkdir", 0, True)
+
+    def _sys_mkdirat(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "mkdirat", 1, True)
+
+    def _sys_rmdir(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "rmdir", 0, True)
+
+    def _sys_unlink(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "unlink", 0, True)
+
+    def _sys_unlinkat(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "unlinkat", 1, True)
+
+    def _sys_chmod(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "chmod", 0, True)
+
+    def _sys_fchmodat(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "fchmodat", 1, True)
+
+    def _sys_chown_like(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "chown", 0, True)
+
+    def _sys_fchownat(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "fchownat", 1, True)
+
+    def _sys_truncate(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "truncate", 0, True)
+
+    def _sys_rename(self, args, ctx) -> int:
+        return self._vfs_two_paths(args, "rename", 0, 1)
+
+    def _sys_renameat(self, args, ctx) -> int:
+        return self._vfs_two_paths(args, "renameat", 1, 3)
+
+    def _sys_link(self, args, ctx) -> int:
+        return self._vfs_two_paths(args, "link", 0, 1)
+
+    def _sys_linkat(self, args, ctx) -> int:
+        return self._vfs_two_paths(args, "linkat", 1, 3)
+
+    def _sys_symlink(self, args, ctx) -> int:
+        # arg0 is the link CONTENT (never resolved); arg1 is the link
+        # path to create
+        return self._vfs_one_path(args, "symlink", 1, True)
+
+    def _sys_symlinkat(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "symlinkat", 2, True)
+
+    def _sys_mknod_like(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "mknod", 0, True)
+
+    def _sys_mknodat(self, args, ctx) -> int:
+        return self._vfs_one_path(args, "mknodat", 1, True)
+
+    # fd-only disk ops: a VIRTUAL descriptor (socket/pipe/timer) is not
+    # a disk file — EINVAL (ENOTDIR for the dents family, like Linux);
+    # real fds stay native
+    def _fd_only_native(self, args, errno_for_vfd: int) -> int:
+        fd = _i32(args[0])
+        if fd >= self.VFD_BASE or fd in self._low_overrides:
+            raise errors.SyscallError(errno_for_vfd)
+        raise NativeSyscall()
+
+    def _sys_fsync_like(self, args, ctx) -> int:
+        return self._fd_only_native(args, errors.EINVAL)
+
+    def _sys_getdents_like(self, args, ctx) -> int:
+        return self._fd_only_native(args, errors.ENOTDIR)
+
+    def _sys_fchdir(self, args, ctx) -> int:
+        return self._fd_only_native(args, errors.ENOTDIR)
+
+    def _sys_flock(self, args, ctx) -> int:
+        return self._fd_only_native(args, errors.EINVAL)
+
+    def _sys_getcwd(self, args, ctx) -> int:
+        raise NativeSyscall(strace_args="<buf>")
+
     _HANDLERS = {
+        SYS_open: _sys_open,
+        SYS_openat: _sys_openat,
+        SYS_creat: _sys_creat,
+        SYS_stat: _sys_stat,
+        SYS_lstat: _sys_lstat,
+        SYS_access: _sys_access,
+        SYS_faccessat: _sys_faccessat,
+        SYS_faccessat2: _sys_faccessat,
+        SYS_statfs: _sys_statfs,
+        SYS_readlink: _sys_readlink,
+        SYS_readlinkat: _sys_readlinkat,
+        SYS_chdir: _sys_chdir,
+        SYS_fchdir: _sys_fchdir,
+        SYS_getcwd: _sys_getcwd,
+        SYS_mkdir: _sys_mkdir,
+        SYS_mkdirat: _sys_mkdirat,
+        SYS_rmdir: _sys_rmdir,
+        SYS_unlink: _sys_unlink,
+        SYS_unlinkat: _sys_unlinkat,
+        SYS_rename: _sys_rename,
+        SYS_renameat: _sys_renameat,
+        SYS_renameat2: _sys_renameat,
+        SYS_link: _sys_link,
+        SYS_linkat: _sys_linkat,
+        SYS_symlink: _sys_symlink,
+        SYS_symlinkat: _sys_symlinkat,
+        SYS_chmod: _sys_chmod,
+        SYS_fchmod: _sys_fsync_like,
+        SYS_fchmodat: _sys_fchmodat,
+        SYS_chown: _sys_chown_like,
+        SYS_lchown: _sys_chown_like,
+        SYS_fchownat: _sys_fchownat,
+        SYS_truncate: _sys_truncate,
+        SYS_ftruncate: _sys_fsync_like,
+        SYS_fsync: _sys_fsync_like,
+        SYS_fdatasync: _sys_fsync_like,
+        SYS_fallocate: _sys_fsync_like,
+        SYS_flock: _sys_flock,
+        SYS_getdents: _sys_getdents_like,
+        SYS_getdents64: _sys_getdents_like,
+        SYS_mknod: _sys_mknod_like,
+        SYS_mknodat: _sys_mknodat,
+        SYS_utime: _sys_utime_like,
+        SYS_utimes: _sys_utime_like,
+        SYS_utimensat: _sys_utimensat,
         SYS_socket: _sys_socket,
         SYS_socketpair: _sys_socketpair,
         SYS_bind: _sys_bind,

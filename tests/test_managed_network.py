@@ -13,6 +13,7 @@ import shutil
 import subprocess
 
 import pytest
+from pathlib import Path
 
 from shadow_tpu.core.config import load_config_str
 from shadow_tpu.core.manager import Manager
@@ -349,9 +350,13 @@ hosts:
         "http://server:8000/data.bin"], start_time: 3s,
        expected_final_state: {{exited: 0}}}}
 """)
-    stats = Manager(cfg).run()
+    mgr = Manager(cfg, data_dir=str(tmp_path / "data"))
+    stats = mgr.run()
     assert stats.process_failures == [], stats.process_failures
-    assert out.read_bytes() == payload
+    # the client's absolute -o path lives in ITS per-host filesystem view
+    # (experimental.host_path_isolation, round 5)
+    vout = Path(mgr.hosts_by_name["client"].vfs_root + str(out))
+    assert vout.read_bytes() == payload
 
 
 BAD_OPTLEN_C = r"""

@@ -29,9 +29,9 @@ from shadow_tpu.core.manager import Manager
 MS = 1_000_000
 
 
-def run_rung(name: str, cfg_text: str) -> dict:
+def run_rung(name: str, cfg_text: str, data_dir: str | None = None) -> dict:
     cfg = load_config_str(cfg_text)
-    mgr = Manager(cfg)
+    mgr = Manager(cfg, data_dir=data_dir)
     t0 = time.monotonic()
     stats = mgr.run()
     wall = time.monotonic() - t0
@@ -150,9 +150,12 @@ hosts:
        expected_final_state: running}}
 {clients}
 """
-    out = run_rung("rung1_real_binaries", cfg)
+    out = run_rung("rung1_real_binaries", cfg, data_dir=f"{tmp}/data")
     for i in range(2):
-        with open(f"{tmp}/out{i}.bin", "rb") as fh:
+        # absolute -o paths live in each client's per-host filesystem
+        # view (experimental.host_path_isolation, round 5)
+        with open(f"{tmp}/data/hosts/client{i}/root{tmp}/out{i}.bin",
+                  "rb") as fh:
             got = fh.read()
         assert len(got) == size, f"client{i} fetched {len(got)} != {size}"
     return out
