@@ -193,9 +193,64 @@ def bench_cpu_baseline() -> float:
     return events[0] / wall
 
 
+def bench_compiled_baseline() -> float:
+    """Compiled-Shadow-class per-event floor: build and run the ~120-line
+    C++ PHOLD microbench (tools/phold_compiled.cc). Optimistic for the
+    reference (no sockets/qdiscs/refcounting), so vs_compiled can only
+    UNDERSTATE this rebuild. Returns events/s, or 0.0 when no g++."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    if shutil.which("g++") is None:
+        return 0.0
+    src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "tools", "phold_compiled.cc")
+    exe = os.path.join(tempfile.gettempdir(), "shadow_tpu_phold_compiled")
+    if not os.path.exists(exe) or             os.path.getmtime(exe) < os.path.getmtime(src):
+        subprocess.run(["g++", "-O2", "-o", exe, src], check=True,
+                       capture_output=True)
+    out = subprocess.run([exe, "64", "64", "20"], check=True,
+                         capture_output=True, text=True).stdout
+    return float(json.loads(out)["events_per_sec"])
+
+
+def _regression_guard(value: float):
+    """Compare against the newest recorded BENCH_r*.json (same shape
+    only): a silent -7% crept through round 4 unbisected; now any drop
+    past 20% is flagged in the output (tunnel noise stays quiet)."""
+    import glob
+    import re
+
+    best = None
+    for path in glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)", path)
+        if not m:
+            continue
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        rec = rec.get("parsed", rec)  # driver wraps the JSON line
+        if not rec or rec.get("hosts") != N_HOSTS:
+            continue
+        rnd = int(m.group(1))
+        if best is None or rnd > best[0]:
+            best = (rnd, float(rec.get("value", 0)))
+    if best is None or best[1] <= 0:
+        return None
+    ratio = value / best[1]
+    return {"vs_round": best[0], "ratio": round(ratio, 3),
+            "regressed": ratio < 0.8}
+
+
 def main():
     tpu_rate, events = bench_tpu()
     cpu_rate = bench_cpu_baseline()
+    compiled_rate = bench_compiled_baseline()
+    guard = _regression_guard(tpu_rate)
     print(
         json.dumps(
             {
@@ -203,11 +258,19 @@ def main():
                 "value": round(tpu_rate, 1),
                 "unit": "events/s",
                 "vs_baseline": round(tpu_rate / cpu_rate, 2),
+                "vs_compiled": (round(tpu_rate / compiled_rate, 3)
+                                if compiled_rate else None),
+                "compiled_events_per_sec": round(compiled_rate, 1),
                 "hosts": N_HOSTS,
+                "prior_round": guard,
                 "baseline": (
-                    "this repo's Python object plane (64-host PHOLD on the "
-                    "Host/EventQueue path), NOT the reference's compiled "
-                    "Rust/C hot path; see tools/bench_ladder.py for the "
+                    "vs_baseline: this repo's Python object plane (64-host "
+                    "PHOLD on the Host/EventQueue path). vs_compiled: the "
+                    "in-tree C++ PHOLD microbench (tools/phold_compiled.cc) "
+                    "pricing compiled-Shadow-class per-event cost on one "
+                    "core — an optimistic floor for the reference, so the "
+                    "ratio understates this rebuild; methodology in "
+                    "BASELINE.md. See tools/bench_ladder.py for the "
                     "end-to-end rung measurements"
                 ),
             }

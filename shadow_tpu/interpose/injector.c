@@ -1,0 +1,14 @@
+/* Constructor-only injector (reference src/lib/preload-injector/injector.c
+ * role): the combined preload library links the shim as a DT_NEEDED
+ * dependency, so the dynamic linker loads it without the shim's own
+ * symbols ever entering the interposition scope, and LD_PRELOAD stays at
+ * ONE entry. No poke is needed to force the load: the libc wrappers in
+ * the same link carry an undefined reference to shadow_tpu_api_syscall,
+ * which pins the dependency even under --as-needed; the shim does its
+ * own initialization in its constructor. This file exists to carry the
+ * design (and a home for any future pre-main injection work) — it
+ * deliberately defines NO interposable symbols. */
+
+__attribute__((constructor, used)) static void _injector_load(void) {
+    /* intentionally empty: see header comment */
+}

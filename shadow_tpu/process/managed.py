@@ -62,14 +62,24 @@ _DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 SHIM_PATH = os.path.join(_DIR, "libshadow_shim.so")
 PRELOAD_LIBC_PATH = os.path.join(_DIR, "libshadow_preload_libc.so")
 PRELOAD_OPENSSL_PATH = os.path.join(_DIR, "libshadow_preload_openssl.so")
+PRELOAD_COMBINED_PATH = os.path.join(_DIR, "libshadow_preload.so")
+PRELOAD_COMBINED_SSL_PATH = os.path.join(_DIR, "libshadow_preload_ssl.so")
 
 
 def _preload_chain(openssl_rng: bool = False) -> str:
-    """LD_PRELOAD value: libc wrappers first (so application symbol lookups
-    hit them before libc), then the shim they call into
-    (`inject_preloads`, `managed_thread.rs:546-640`). With `openssl_rng`,
-    the deterministic libcrypto RAND shadow goes first of all — its
-    symbols must beat any libssl the app links."""
+    """LD_PRELOAD value. Preferred: ONE combined library (wrappers +
+    injector constructor) that pulls the shim in as a DT_NEEDED
+    dependency — the reference's preload-injector design
+    (`src/lib/preload-injector/injector.c`): the shim loads without its
+    symbols ever entering the interposition scope, and the managed
+    namespace sees a single preload entry. The `openssl_rng` variant
+    additionally shadows libcrypto's RAND entry points. Falls back to
+    the legacy three-entry chain when the combined libs are absent
+    (mid-build checkouts)."""
+    combined = (PRELOAD_COMBINED_SSL_PATH if openssl_rng
+                else PRELOAD_COMBINED_PATH)
+    if os.path.exists(combined):
+        return combined
     parts = []
     if openssl_rng and os.path.exists(PRELOAD_OPENSSL_PATH):
         parts.append(PRELOAD_OPENSSL_PATH)

@@ -143,3 +143,24 @@ def test_send_on_closed_channel_fails_fast(lib):
     with pytest.raises(OSError):
         ipc.send_to_shim(ev)
     ipc.block.free()
+
+
+def test_preload_chain_is_single_entry():
+    """Reference preload-injector parity (`src/lib/preload-injector/`):
+    LD_PRELOAD lists ONE combined library; the shim rides in as a
+    DT_NEEDED dependency (its symbols never interpose), pulled by a
+    constructor-only injector."""
+    import subprocess
+
+    from shadow_tpu import interpose
+    from shadow_tpu.process.managed import _preload_chain
+
+    interpose.build()  # a clean checkout has no .so yet
+    for ssl in (False, True):
+        chain = _preload_chain(ssl)
+        assert " " not in chain, chain  # exactly one entry
+        out = subprocess.run(["ldd", chain], capture_output=True,
+                             text=True).stdout
+        shim_line = [ln for ln in out.splitlines()
+                     if "libshadow_shim.so" in ln]
+        assert shim_line and "=> /" in shim_line[0], out  # RESOLVES

@@ -1,0 +1,126 @@
+// Compiled PHOLD object-plane microbenchmark: an honest price for
+// "compiled-Shadow-class" per-event cost on THIS machine.
+//
+// The reference's hot loop (/root/reference/src/main/host/host.rs:810-865)
+// is compiled Rust: pop the next event, run its packet through
+// router/interface bookkeeping, draw randomness, schedule the successor.
+// This ~200-line C++ twin prices the same SHAPE of work — binary-heap
+// pop/push, xoshiro256++ draws (loss + destination + think time), a
+// node-level latency lookup, and a per-host FIFO hop — with none of the
+// reference's remaining overheads (no sockets, no syscalls, no qdisc
+// variants, no refcounting). It is therefore an OPTIMISTIC baseline: a
+// real compiled simulator pays MORE per event than this floor, so a
+// `vs_compiled` ratio against it understates the rebuild, never flatters
+// it. bench.py builds and runs this and reports the ratio alongside the
+// Python-object-plane one (methodology: BASELINE.md).
+//
+// Usage: phold_compiled [n_hosts] [n_nodes] [events_millions]
+// Output: one JSON line {"events": N, "wall_s": W, "events_per_sec": R}
+
+#include <ctime>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <queue>
+#include <vector>
+
+namespace {
+
+struct Xoshiro {
+    uint64_t s[4];
+    static uint64_t rotl(uint64_t x, int k) {
+        return (x << k) | (x >> (64 - k));
+    }
+    explicit Xoshiro(uint64_t seed) {
+        // splitmix64 init, like core/rng.py
+        uint64_t z = seed;
+        for (auto &w : s) {
+            z += 0x9e3779b97f4a7c15ULL;
+            uint64_t t = z;
+            t = (t ^ (t >> 30)) * 0xbf58476d1ce4e5b9ULL;
+            t = (t ^ (t >> 27)) * 0x94d049bb133111ebULL;
+            w = t ^ (t >> 31);
+        }
+    }
+    uint64_t next() {
+        uint64_t result = rotl(s[0] + s[3], 23) + s[0];
+        uint64_t t = s[1] << 17;
+        s[2] ^= s[0];
+        s[3] ^= s[1];
+        s[1] ^= s[2];
+        s[0] ^= s[3];
+        s[2] ^= t;
+        s[3] = rotl(s[3], 45);
+        return result;
+    }
+};
+
+struct Event {
+    int64_t time_ns;
+    uint64_t seq;  // FIFO tie-break, like core/event.py ordering
+    int32_t host;
+    bool operator>(const Event &o) const {
+        if (time_ns != o.time_ns) return time_ns > o.time_ns;
+        return seq > o.seq;
+    }
+};
+
+}  // namespace
+
+int main(int argc, char **argv) {
+    const int n_hosts = argc > 1 ? std::atoi(argv[1]) : 64;
+    const int n_nodes = argc > 2 ? std::atoi(argv[2]) : 64;
+    const int64_t target =
+        (argc > 3 ? std::atoll(argv[3]) : 20) * 1'000'000LL;
+
+    Xoshiro rng(1);
+    // node-level latency table, the shape the GML topologies have
+    std::vector<int32_t> lat(static_cast<size_t>(n_nodes) * n_nodes);
+    for (auto &v : lat) v = 1'000'000 + static_cast<int32_t>(rng.next() % 49'000'000);
+    std::vector<int32_t> host_node(n_hosts);
+    for (int i = 0; i < n_hosts; i++) host_node[i] = i % n_nodes;
+
+    // per-host RNG streams + in-flight FIFO depth (the interface hop)
+    std::vector<Xoshiro> host_rng;
+    host_rng.reserve(n_hosts);
+    for (int i = 0; i < n_hosts; i++) host_rng.emplace_back(1000 + i);
+    std::vector<int32_t> fifo_depth(n_hosts, 0);
+
+    std::priority_queue<Event, std::vector<Event>, std::greater<Event>> q;
+    uint64_t seq = 0;
+    for (int i = 0; i < n_hosts; i++)
+        for (int k = 0; k < 4; k++)
+            q.push({1'000'000, seq++, i});
+
+    int64_t events = 0;
+    uint64_t losses = 0;
+    struct timespec t0, t1;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    while (events < target) {
+        Event ev = q.top();
+        q.pop();
+        events++;
+        Xoshiro &r = host_rng[ev.host];
+        // loss draw (1%), like the worker's per-packet Bernoulli
+        if ((r.next() >> 11) < (uint64_t)(0.01 * (1ULL << 53))) {
+            losses++;
+            // lost packets respawn at the source so the population holds
+        }
+        // pick the successor destination + think time
+        int32_t dst = static_cast<int32_t>(r.next() % n_hosts);
+        int32_t l = lat[static_cast<size_t>(host_node[ev.host]) * n_nodes +
+                        host_node[dst]];
+        int32_t think = static_cast<int32_t>(r.next() % 1'000'000);
+        fifo_depth[ev.host] = (fifo_depth[ev.host] + 1) & 15;  // qdisc hop
+        q.push({ev.time_ns + l + think, seq++, dst});
+    }
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    double wall = (t1.tv_sec - t0.tv_sec) + (t1.tv_nsec - t0.tv_nsec) / 1e9;
+    // losses participates in output so the loss draw cannot be DCE'd
+    std::printf(
+        "{\"events\": %lld, \"wall_s\": %.3f, \"events_per_sec\": %.0f, "
+        "\"losses\": %llu}\n",
+        static_cast<long long>(events), wall, events / wall,
+        static_cast<unsigned long long>(losses));
+    return 0;
+}
