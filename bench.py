@@ -200,19 +200,25 @@ def bench_compiled_baseline() -> float:
     UNDERSTATE this rebuild. Returns events/s, or 0.0 when no g++."""
     import shutil
     import subprocess
-    import tempfile
 
-    if shutil.which("g++") is None:
+    try:
+        if shutil.which("g++") is None:
+            return 0.0
+        here = os.path.dirname(os.path.abspath(__file__))
+        src = os.path.join(here, "tools", "phold_compiled.cc")
+        # repo-local (self-owned) build target — never a fixed name in a
+        # shared world-writable tempdir
+        exe = os.path.join(here, "tools", ".phold_compiled")
+        if not os.path.exists(exe) or \
+                os.path.getmtime(exe) < os.path.getmtime(src):
+            subprocess.run(["g++", "-O2", "-o", exe, src], check=True,
+                           capture_output=True)
+        out = subprocess.run([exe, "64", "64", "20"], check=True,
+                             capture_output=True, text=True).stdout
+        return float(json.loads(out)["events_per_sec"])
+    except (OSError, subprocess.CalledProcessError, ValueError, KeyError):
+        # auxiliary baseline: never let it eat the primary metric
         return 0.0
-    src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "tools", "phold_compiled.cc")
-    exe = os.path.join(tempfile.gettempdir(), "shadow_tpu_phold_compiled")
-    if not os.path.exists(exe) or             os.path.getmtime(exe) < os.path.getmtime(src):
-        subprocess.run(["g++", "-O2", "-o", exe, src], check=True,
-                       capture_output=True)
-    out = subprocess.run([exe, "64", "64", "20"], check=True,
-                         capture_output=True, text=True).stdout
-    return float(json.loads(out)["events_per_sec"])
 
 
 def _regression_guard(value: float):

@@ -1,0 +1,69 @@
+"""Rung-4 shape at CI scale: REAL compiled onion relays doing layered
+store-and-forward over a latency GML, clients pushing payloads through
+3-hop circuits (tools/onion/{relay,client}.c). Reference analogue:
+the minimal Tor network test (`src/test/tor/minimal/tor-minimal.yaml`)
+— no tor binary exists on this image, so the SHAPE is rebuilt with
+purpose-built relays (BASELINE.md rung 4)."""
+
+import os
+import shutil
+import subprocess
+import tempfile
+
+import pytest
+
+from shadow_tpu.core.config import load_config_str
+from shadow_tpu.core.manager import Manager
+
+pytestmark = pytest.mark.skipif(shutil.which("gcc") is None,
+                                reason="no gcc")
+
+GML = """\
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+        node [ id 1 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+        edge [ source 0 target 0 latency "5 ms" packet_loss 0.0 ]
+        edge [ source 0 target 1 latency "30 ms" packet_loss 0.0 ]
+        edge [ source 1 target 1 latency "5 ms" packet_loss 0.0 ]
+      ]
+"""
+
+
+def test_onion_circuits_complete():
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tmp = tempfile.mkdtemp(prefix="onion-test-")
+    for name in ("relay", "client"):
+        subprocess.run(
+            ["gcc", "-O1", "-o", f"{tmp}/{name}",
+             os.path.join(here, "tools", "onion", f"{name}.c")],
+            check=True)
+
+    n_relays, n_clients = 6, 2
+    rip = lambda r: f"10.4.0.{r + 1}"
+    hosts = []
+    for r in range(n_relays):
+        hosts.append(
+            f"  relay{r}:\n    network_node_id: {r % 2}\n"
+            f"    ip_addr: {rip(r)}\n    processes:\n"
+            f"    - {{path: {tmp}/relay, args: ['7000'], start_time: 1s,\n"
+            f"       expected_final_state: running}}")
+    for c in range(n_clients):
+        g, m, e = c, (c + 2) % n_relays, (c + 4) % n_relays
+        hosts.append(
+            f"  client{c}:\n    network_node_id: {c % 2}\n"
+            f"    ip_addr: 10.5.0.{c + 1}\n    processes:\n"
+            f"    - {{path: {tmp}/client, args: ['{rip(g)}', '7000', "
+            f"'{rip(m)}', '7000', '{rip(e)}', '7000', '16384'], "
+            f"start_time: 2s,\n"
+            f"       expected_final_state: {{exited: 0}}}}")
+    cfg = load_config_str(
+        "general: {stop_time: 20s, seed: 1}\n"
+        "network:\n  graph:\n    type: gml\n    inline: |\n" + GML +
+        "hosts:\n" + "\n".join(hosts))
+    stats = Manager(cfg, data_dir=f"{tmp}/data").run()
+    assert stats.process_failures == [], stats.process_failures
+    for c in range(n_clients):
+        out = open(f"{tmp}/data/hosts/client{c}/"
+                   f"client{c}.client.0.stdout").read()
+        assert "circuit complete: 16384 bytes through 3 hops" in out

@@ -16,6 +16,7 @@ Usage: python tools/bench_ladder.py [2|3|all]
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -49,6 +50,24 @@ def run_rung(name: str, cfg_text: str, data_dir: str | None = None) -> dict:
     return out
 
 
+def make_gml(n_nodes: int, lat_lo: int, lat_hi: int, loss_lo: float,
+             loss_hi: float, seed: int) -> str:
+    """Full-mesh GML with per-edge latency/loss draws (rungs 3 and 4)."""
+    rng = np.random.default_rng(seed)
+    lines = ["graph [", "  directed 0"]
+    for i in range(n_nodes):
+        lines.append(f"  node [ id {i} host_bandwidth_up \"1 Gbit\""
+                     f" host_bandwidth_down \"1 Gbit\" ]")
+    for i in range(n_nodes):
+        for j in range(i, n_nodes):
+            lat = int(rng.integers(lat_lo, lat_hi)) if i != j else 5
+            loss = float(rng.uniform(loss_lo, loss_hi)) if i != j else 0.0
+            lines.append(f"  edge [ source {i} target {j} latency"
+                         f" \"{lat} ms\" packet_loss {loss:.4f} ]")
+    lines.append("]")
+    return "\n".join("      " + ln for ln in lines)
+
+
 def rung2(n_hosts: int = 100, size: int = 1_048_576) -> dict:
     """100-host tgen mesh: one server, 99 clients each pulling 1 MiB."""
     hosts = ["  server:\n    network_node_id: 0\n    processes:\n"
@@ -72,19 +91,7 @@ def rung3(n_hosts: int = 1000, n_nodes: int = 40,
     20-200 ms latencies and 0.1-1% loss; 25 tgen servers, 975 clients.
     With use_flow_engine=True the identical YAML runs on the device
     flow engine (`experimental.use_flow_engine`)."""
-    rng = np.random.default_rng(7)
-    lines = ["graph [", "  directed 0"]
-    for i in range(n_nodes):
-        lines.append(f"  node [ id {i} host_bandwidth_up \"1 Gbit\""
-                     f" host_bandwidth_down \"1 Gbit\" ]")
-    for i in range(n_nodes):
-        for j in range(i, n_nodes):
-            lat = int(rng.integers(20, 200)) if i != j else 5
-            loss = float(rng.uniform(0.001, 0.01)) if i != j else 0.0
-            lines.append(f"  edge [ source {i} target {j} latency"
-                         f" \"{lat} ms\" packet_loss {loss:.4f} ]")
-    lines.append("]")
-    gml = "\n".join("      " + ln for ln in lines)
+    gml = make_gml(n_nodes, 20, 200, 0.001, 0.01, seed=7)
 
     n_servers = 25
     hosts = []
@@ -161,6 +168,100 @@ hosts:
     return out
 
 
+def rung4(n_relays: int = 66, n_clients: int = 33, n_nodes: int = 10,
+          size: int = 32_768) -> dict:
+    """Rung 4, the Tor-SHAPED workload (BASELINE ladder row 4; reference
+    `src/test/tor/minimal/tor-minimal.yaml` — no tor binary exists on
+    this image, so the shape is rebuilt): ~100 REAL compiled processes —
+    onion relays doing layered store-and-forward over a latency+loss
+    GML — each client pushing a payload through a 3-hop circuit
+    (guard -> middle -> exit) and waiting for the ack to ride back.
+    The run's log is fed through tools/parse_shadow.py to verify the
+    tornettools heartbeat contract end-to-end."""
+    import logging
+    import subprocess
+    import tempfile
+
+
+    tmp = tempfile.mkdtemp(prefix="rung4-onion-")
+    here = os.path.dirname(os.path.abspath(__file__))
+    for name in ("relay", "client"):
+        subprocess.run(["gcc", "-O1", "-o", f"{tmp}/{name}",
+                        os.path.join(here, "onion", f"{name}.c")],
+                       check=True)
+
+    gml = make_gml(n_nodes, 20, 80, 0.0005, 0.003, seed=11)
+
+    relay_ip = lambda r: f"10.4.{r // 200}.{r % 200 + 1}"
+    client_ip = lambda c: f"10.5.{c // 200}.{c % 200 + 1}"
+    hosts = []
+    for r in range(n_relays):
+        hosts.append(
+            f"  relay{r}:\n    network_node_id: {r % n_nodes}\n"
+            f"    ip_addr: {relay_ip(r)}\n    processes:\n"
+            f"    - {{path: {tmp}/relay, args: ['7000'], start_time: 1s,\n"
+            f"       expected_final_state: running}}"
+        )
+    third = n_relays // 3
+    for c in range(n_clients):
+        # Tor-style role partition (guards / middles / exits in disjoint
+        # thirds): forward edges only ever cross guard->middle->exit, so
+        # the circuit graph is ACYCLIC — the single-threaded blocking
+        # relays cannot form a circular wait (r5 review finding)
+        g = c % third
+        m = third + (c % third)
+        e = 2 * third + (c % (n_relays - 2 * third))
+        hosts.append(
+            f"  client{c}:\n    network_node_id: {c % n_nodes}\n"
+            f"    ip_addr: {client_ip(c)}\n    processes:\n"
+            f"    - {{path: {tmp}/client, args: ['{relay_ip(g)}', '7000',"
+            f" '{relay_ip(m)}', '7000', '{relay_ip(e)}', '7000',"
+            f" '{size}'], start_time: {2 + (c % 5)}s,\n"
+            f"       expected_final_state: {{exited: 0}}}}"
+        )
+    cfg = ("general: {stop_time: 30s, seed: 1}\n"
+           "network:\n  graph:\n    type: gml\n    inline: |\n" + gml +
+           "\nhosts:\n" + "\n".join(hosts))
+
+    # capture the log stream so parse_shadow.py can verify the
+    # tornettools heartbeat contract on this very run
+    log_path = f"{tmp}/shadow.log"
+    handler = logging.FileHandler(log_path)
+    handler.setFormatter(logging.Formatter("%(message)s"))
+    # scope to the simulator's loggers (tracker heartbeats live under
+    # "shadow_tpu.*" and the rusage/meminfo lines under the manager's) —
+    # never the ROOT level, which would flood any console handler the
+    # caller configured (r5 review finding)
+    targets = [logging.getLogger("shadow_tpu"),
+               logging.getLogger("shadow")]
+    saved = [(t, t.level) for t in targets]
+    for t in targets:
+        t.addHandler(handler)
+        t.setLevel(logging.INFO)
+    try:
+        out = run_rung(f"rung4_onion_{n_relays + n_clients}_procs", cfg,
+                       data_dir=f"{tmp}/data")
+    finally:
+        for t, lvl in saved:
+            t.removeHandler(handler)
+            t.setLevel(lvl)
+        handler.close()
+    parsed = subprocess.run(
+        [sys.executable, os.path.join(here, "parse_shadow.py"), log_path,
+         "-p", tmp],
+        check=True, capture_output=True, text=True).stdout
+    with open(f"{tmp}/stats.shadow.json") as fh:
+        stats = json.load(fh)
+    n_hb_hosts = len(stats.get("nodes", {}))
+    assert n_hb_hosts >= n_relays + n_clients, \
+        f"heartbeat contract: {n_hb_hosts} hosts in parse_shadow output"
+    out["heartbeat_hosts"] = n_hb_hosts
+    print(json.dumps({"rung": out["rung"],
+                      "heartbeat_hosts": n_hb_hosts,
+                      "parse_shadow": parsed.strip()}), flush=True)
+    return out
+
+
 def rung_interpose(n_pairs: int = 50, size: int = 262_144) -> dict:
     """Interposition-plane scale: 2*n_pairs REAL compiled binaries (the
     TCP transfer pair from tests/test_managed_network.py), each under the
@@ -220,6 +321,8 @@ def main():
         rung3()
     if which in ("3f", "all"):
         rung3(use_flow_engine=True)
+    if which in ("4", "all"):
+        rung4()
     if which in ("interpose", "all"):
         rung_interpose()
 

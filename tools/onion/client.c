@@ -1,0 +1,63 @@
+/* Onion client for the rung-4 Tor-shaped workload: builds a layered
+ * frame for a 3-hop circuit (guard -> middle -> exit) and sends the
+ * payload through it, waiting for the ack to ride back. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+static int write_full(int fd, const void *buf, size_t n) {
+    const char *p = buf;
+    while (n) {
+        ssize_t r = write(fd, p, n);
+        if (r <= 0) return -1;
+        p += r; n -= (size_t)r;
+    }
+    return 0;
+}
+
+static size_t wrap(unsigned char *dst, uint32_t ip_net, uint16_t port_net,
+                   const unsigned char *inner, size_t inner_len) {
+    uint32_t len_be = htonl((uint32_t)inner_len);
+    memcpy(dst, &ip_net, 4);
+    memcpy(dst + 4, &port_net, 2);
+    memcpy(dst + 6, &len_be, 4);
+    memcpy(dst + 10, inner, inner_len);
+    return inner_len + 10;
+}
+
+int main(int argc, char **argv) {
+    /* argv: g_ip g_port m_ip m_port e_ip e_port payload_bytes */
+    if (argc < 8) return 2;
+    struct in_addr g, m, e;
+    if (!inet_aton(argv[1], &g) || !inet_aton(argv[3], &m)
+            || !inet_aton(argv[5], &e)) return 2;
+    uint16_t gp = htons((uint16_t)atoi(argv[2]));
+    uint16_t mp = htons((uint16_t)atoi(argv[4]));
+    uint16_t ep = htons((uint16_t)atoi(argv[6]));
+    size_t payload = (size_t)atol(argv[7]);
+    static unsigned char a[1 << 20], b[1 << 20];
+    if (payload > sizeof a - 64) return 2;
+    memset(a, 0x5a, payload);
+    size_t n = wrap(b, 0, 0, a, payload);          /* exit layer */
+    n = wrap(a, e.s_addr, ep, b, n);               /* middle -> exit */
+    n = wrap(b, m.s_addr, mp, a, n);               /* guard -> middle */
+
+    int s = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in dst = {0};
+    dst.sin_family = AF_INET;
+    dst.sin_addr = g;
+    dst.sin_port = gp;
+    if (connect(s, (struct sockaddr *)&dst, sizeof dst)) {
+        perror("client connect");
+        return 1;
+    }
+    if (write_full(s, b, n)) return 1;
+    unsigned char ack;
+    ssize_t r = read(s, &ack, 1);
+    if (r != 1 || ack != 'A') return 1;
+    printf("circuit complete: %zu bytes through 3 hops\n", payload);
+    return 0;
+}

@@ -1,0 +1,86 @@
+/* Onion-style store-and-forward relay for the rung-4 Tor-shaped
+ * workload (BASELINE.md ladder; reference analogue:
+ * src/test/tor/minimal/tor-minimal.yaml, which this image cannot run —
+ * no tor binary exists here, so the SHAPE is rebuilt: real compiled
+ * relay processes doing layered store-and-forward over a latency/loss
+ * GML, with acks riding the circuit back).
+ *
+ * Protocol per connection (all big-endian):
+ *   [4B next_ip][2B next_port][4B len][len bytes inner frame]
+ * next_ip == 0 marks the exit: consume the payload, send 1-byte ack.
+ * Otherwise STORE the whole inner frame, then FORWARD it to the next
+ * hop, wait for its ack, and relay the ack backward. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+static int read_full(int fd, void *buf, size_t n) {
+    char *p = buf;
+    while (n) {
+        ssize_t r = read(fd, p, n);
+        if (r <= 0) return -1;
+        p += r; n -= (size_t)r;
+    }
+    return 0;
+}
+
+static int write_full(int fd, const void *buf, size_t n) {
+    const char *p = buf;
+    while (n) {
+        ssize_t r = write(fd, p, n);
+        if (r <= 0) return -1;
+        p += r; n -= (size_t)r;
+    }
+    return 0;
+}
+
+int main(int argc, char **argv) {
+    if (argc < 2) return 2;
+    int port = atoi(argv[1]);
+    int circuits = argc > 2 ? atoi(argv[2]) : -1; /* -1: serve forever */
+    int lst = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(lst, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    struct sockaddr_in a = {0};
+    a.sin_family = AF_INET;
+    a.sin_addr.s_addr = INADDR_ANY;
+    a.sin_port = htons((uint16_t)port);
+    if (bind(lst, (struct sockaddr *)&a, sizeof a) || listen(lst, 64)) {
+        perror("relay bind/listen");
+        return 1;
+    }
+    static char buf[1 << 20];
+    for (int served = 0; circuits < 0 || served < circuits; served++) {
+        int c = accept(lst, 0, 0);
+        if (c < 0) return 1;
+        unsigned char hdr[10];
+        if (read_full(c, hdr, 10)) { close(c); continue; }
+        uint32_t ip; uint16_t nport; uint32_t len;
+        memcpy(&ip, hdr, 4);
+        memcpy(&nport, hdr + 4, 2);
+        memcpy(&len, hdr + 6, 4);
+        len = ntohl(len);
+        if (len > sizeof buf || read_full(c, buf, len)) { close(c); continue; }
+        unsigned char ack = 'A';
+        if (ip == 0) { /* exit node: payload consumed */
+            if (write_full(c, &ack, 1)) { close(c); continue; }
+        } else {
+            int n = socket(AF_INET, SOCK_STREAM, 0);
+            struct sockaddr_in nx = {0};
+            nx.sin_family = AF_INET;
+            nx.sin_addr.s_addr = ip; /* already network order */
+            nx.sin_port = nport;
+            if (connect(n, (struct sockaddr *)&nx, sizeof nx)
+                    || write_full(n, buf, len)
+                    || read_full(n, &ack, 1)) { close(n); close(c); continue; }
+            close(n);
+            write_full(c, &ack, 1); /* ack rides the circuit back */
+        }
+        close(c);
+    }
+    return 0;
+}
