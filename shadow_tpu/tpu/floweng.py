@@ -114,6 +114,9 @@ class FlowWorld(NamedTuple):
     t_start: jax.Array  # [C] us — active opener's start time
     latency_us: jax.Array  # [C] one-way wire latency toward PEER
     loss_u32: jax.Array  # [C] uint32 Bernoulli threshold toward PEER
+    lane_id: jax.Array  # [C] GLOBAL lane index — keys the wire-loss
+    # hash, so a device shard draws the same losses as the unsharded
+    # world (local arange would diverge under pmap)
     iss: jax.Array  # [C] int32 — initial send sequence (u32 bits)
     # progress
     conn_t: jax.Array  # [C] us — local clock (last processed event)
@@ -191,6 +194,7 @@ def make_flow_world(latency_us: np.ndarray, size_bytes: np.ndarray,
         t_start=jnp.asarray(t_start, jnp.int32),
         latency_us=jnp.asarray(lat, jnp.int32),
         loss_u32=jnp.asarray(loss_u32),
+        lane_id=jnp.arange(C, dtype=jnp.int32),
         iss=jnp.asarray(iss),
         conn_t=zc(),
         complete_us=jnp.full((C,), I32_MAX, jnp.int32),
@@ -414,7 +418,6 @@ def _pull_phase(w: FlowWorld, ack_every: int, pull_cap: int,
     C = w.conn_t.shape[0]
     Q = w.q_time.shape[1]
     peer = jnp.arange(C, dtype=jnp.int32) ^ 1
-    lane = jnp.arange(C, dtype=jnp.int32)
     kk = jnp.arange(gso_segs, dtype=jnp.int32)
 
     def cond(c):
@@ -431,7 +434,8 @@ def _pull_phase(w: FlowWorld, ack_every: int, pull_cap: int,
         paylen = out[:, 5]
         units = jnp.maximum((paylen + dtcp.MSS - 1) // dtcp.MSS, 1)
         draws = _wire_draw(
-            lane[:, None], w.n_segments[:, None] * gso_segs + kk[None, :])
+            w.lane_id[:, None],
+            w.n_segments[:, None] * gso_segs + kk[None, :])
         unit_lost = ((w.loss_u32 > 0)[:, None]
                      & (draws < w.loss_u32[:, None])
                      & (kk[None, :] < units[:, None]))
@@ -678,3 +682,71 @@ def run_to_completion(world: FlowWorld, window_us: int,
         jit_run = None  # recompile with the doubled cap
     raise RuntimeError(
         f"flow engine still saturating after 6 cap doublings (cap={cap})")
+
+
+# ---------------------------------------------------------------------------
+# multichip: flow pairs never interact, so the world is EMBARRASSINGLY
+# parallel over the pair axis — each device runs its slice of flows with
+# the identical window kernel and zero collectives (the sharded analogue
+# of the reference scaling tgen load across worker threads). split/merge
+# preserve per-lane identity (iss, loss counters hash by ORIGINAL lane
+# index), so a sharded run is BITWISE-identical to the single-device run
+# on the same world — asserted by __graft_entry__.dryrun_multichip.
+# ---------------------------------------------------------------------------
+
+def split_flow_world(world: FlowWorld, n_shards: int):
+    """[C]-leaved world -> [n_shards, C/n_shards]-leaved world, split on
+    whole pairs (C must be divisible by 2*n_shards)."""
+    C = world.conn_t.shape[0]
+    if C % (2 * n_shards):
+        raise ValueError(f"{C} lanes not divisible into {n_shards} "
+                         f"pair-aligned shards")
+
+    def split(x):
+        x = np.asarray(x)
+        if x.ndim == 0:  # clock/saturation scalars replicate
+            return jnp.full((n_shards,), jnp.asarray(x))
+        return jnp.asarray(x).reshape((n_shards, C // n_shards)
+                                      + x.shape[1:])
+
+    return jax.tree.map(split, world)
+
+
+def merge_flow_world(sharded: FlowWorld) -> FlowWorld:
+    """Inverse of split_flow_world; scalar leaves take shard 0 except
+    n_saturated, which sums (any shard's saturation poisons the run)."""
+
+    def merge(x):
+        x = np.asarray(x)
+        if x.ndim == 1:  # replicated scalar
+            return jnp.asarray(x[0])
+        return jnp.asarray(x).reshape((-1,) + x.shape[2:])
+
+    out = jax.tree.map(merge, sharded)
+    return out._replace(
+        n_saturated=jnp.asarray(np.asarray(sharded.n_saturated).sum()))
+
+
+_sharded_run_cache: dict = {}
+
+
+def run_windows_sharded(world: FlowWorld, n_windows: int, window_us: int,
+                        n_shards: int | None = None, **opts):
+    """run_windows over every visible device via pmap (one world shard
+    per device, no cross-device communication — pairs are independent).
+    Returns (merged world, [n_shards, n_windows] step counts). The
+    pmapped callable caches per parameter set (mirroring
+    run_to_completion's jit_run) so repeated calls don't retrace."""
+    import functools
+
+    if n_shards is None:
+        n_shards = jax.local_device_count()
+    sharded = split_flow_world(world, n_shards)
+    key = (n_windows, window_us, n_shards, tuple(sorted(opts.items())))
+    run = _sharded_run_cache.get(key)
+    if run is None:
+        run = _sharded_run_cache[key] = jax.pmap(
+            functools.partial(run_windows, n_windows=n_windows,
+                              window_us=window_us, **opts))
+    sharded, steps = run(sharded)
+    return merge_flow_world(sharded), steps

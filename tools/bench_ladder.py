@@ -1,16 +1,20 @@
 #!/usr/bin/env python3
-"""BASELINE.md benchmark ladder, rungs 2-3: end-to-end CPU-plane runs.
+"""BASELINE.md benchmark ladder: end-to-end runs, rungs 1-4.
 
+Rung 1: REAL binaries (python http.server + curl) over a 1 Gbit switch.
 Rung 2: tgen traffic mesh, 100 hosts, single-vertex graph (1_gbit_switch) —
         BASELINE.md row 2, reference `src/test/tgen/` shape.
 Rung 3: 1k-host tgen over an Atlas-style GML with latency + loss —
-        BASELINE.md row 3, `docs/network_graph_overview.md` shape.
+        BASELINE.md row 3 (`3f` = identical YAML on the device flow engine).
+Rung 4: Tor-SHAPED workload — 99 real onion-relay processes, 3-hop
+        circuits over a lossy GML, heartbeats verified via parse_shadow.
+interpose: N real compiled processes under the seccomp+preload shim.
 
 Reports sim-sec/wall-sec, absolute event rate, and packet counts per rung as
 JSON lines. These are the HONEST end-to-end numbers (full syscall + network
 object planes), distinct from bench.py's device-plane PHOLD throughput.
 
-Usage: python tools/bench_ladder.py [2|3|all]
+Usage: python tools/bench_ladder.py [1|2|3|3f|4|interpose|all]
 """
 
 from __future__ import annotations
