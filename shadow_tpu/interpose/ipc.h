@@ -16,6 +16,7 @@
 #include <stdint.h>
 
 #include "scchannel.h"
+#include "vasi.h"
 #include "shmem.h"
 
 #define SHMEM_HANDLE_MAX_IPC SHMEM_HANDLE_MAX
@@ -125,12 +126,14 @@ uint64_t shim_event_sizeof(void);
 #ifdef __cplusplus
 }
 
-static_assert(std::is_standard_layout<ShimEvent>::value &&
-                  std::is_trivially_copyable<ShimEvent>::value,
-              "ShimEvent must be address-space independent");
-static_assert(std::is_standard_layout<IPCData>::value &&
-                  std::is_trivially_copyable<IPCData>::value,
-              "IPCData must be address-space independent");
+SHADOW_TPU_ASSERT_VASI(ShimEvent);
+SHADOW_TPU_ASSERT_VASI(ShimSyscallArgs);
+SHADOW_TPU_ASSERT_VASI(ShimSyscallRewrite);
+SHADOW_TPU_ASSERT_VASI(ShimSyscallComplete);
+SHADOW_TPU_ASSERT_VASI(ShimStartReq);
+SHADOW_TPU_ASSERT_VASI(ShimAddThreadReq);
+SHADOW_TPU_ASSERT_VASI(ShimAddThreadRes);
+SHADOW_TPU_ASSERT_VASI(IPCData);
 static_assert(sizeof(ShimEvent) <= SCCHANNEL_MSG_MAX,
               "ShimEvent must fit one channel message");
 #endif

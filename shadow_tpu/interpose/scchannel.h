@@ -18,6 +18,8 @@
 #include <stddef.h>
 #include <stdint.h>
 
+#include "vasi.h"
+
 #ifdef __cplusplus
 extern "C" {
 #endif
@@ -63,5 +65,8 @@ int scchannel_writer_closed(const SelfContainedChannel *ch);
 
 #ifdef __cplusplus
 }
+
+SHADOW_TPU_ASSERT_VASI(SelfContainedChannel);
 #endif
-#endif
+
+#endif /* SHADOW_TPU_SCCHANNEL_H */

@@ -21,8 +21,9 @@
 
 #include <stdint.h>
 
+#include "vasi.h"
+
 #ifdef __cplusplus
-#include <type_traits>
 extern "C" {
 #endif
 
@@ -44,8 +45,6 @@ typedef struct ProcessShmem {
 
 #ifdef __cplusplus
 }
-static_assert(std::is_standard_layout<ProcessShmem>::value &&
-                  std::is_trivially_copyable<ProcessShmem>::value,
-              "ProcessShmem must be address-space independent");
+SHADOW_TPU_ASSERT_VASI(ProcessShmem);
 #endif
 #endif

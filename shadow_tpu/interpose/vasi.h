@@ -1,0 +1,26 @@
+/* Virtual-Address-Space-Independence marker (reference src/lib/vasi:
+ * a derive macro asserting a type is safe to share across address
+ * spaces). The C++ equivalent: standard layout (no vtables, predictable
+ * member order), trivial copyability (memcpy-safe across processes),
+ * and — enforced by review, not the compiler — no pointer members.
+ * Apply SHADOW_TPU_ASSERT_VASI to EVERY type that crosses a process
+ * boundary through shmem: the shim event vocabulary (ipc.h), the
+ * channels (scchannel.h), and the clock/process blocks (shim_shmem.h)
+ * all carry it. */
+#ifndef SHADOW_TPU_VASI_H
+#define SHADOW_TPU_VASI_H
+
+#ifdef __cplusplus
+#include <type_traits>
+
+#define SHADOW_TPU_ASSERT_VASI(T)                                        \
+    static_assert(std::is_standard_layout<T>::value &&                   \
+                      std::is_trivially_copyable<T>::value,              \
+                  #T " must be virtual-address-space independent "       \
+                     "(standard layout + trivially copyable, "           \
+                     "no pointers)")
+#else
+#define SHADOW_TPU_ASSERT_VASI(T)
+#endif
+
+#endif /* SHADOW_TPU_VASI_H */

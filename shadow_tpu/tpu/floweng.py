@@ -696,20 +696,25 @@ def run_to_completion(world: FlowWorld, window_us: int,
 
 def split_flow_world(world: FlowWorld, n_shards: int):
     """[C]-leaved world -> [n_shards, C/n_shards]-leaved world, split on
-    whole pairs (C must be divisible by 2*n_shards)."""
+    whole pairs (C must be divisible by 2*n_shards). Pure device-side
+    reshapes — the multi-MB segment rings never round-trip through host
+    memory. The accumulated saturation counter rides on shard 0 only,
+    so a split -> run -> merge cycle adds per-shard contributions
+    without multiplying the prior total by n_shards."""
     C = world.conn_t.shape[0]
     if C % (2 * n_shards):
         raise ValueError(f"{C} lanes not divisible into {n_shards} "
                          f"pair-aligned shards")
 
     def split(x):
-        x = np.asarray(x)
-        if x.ndim == 0:  # clock/saturation scalars replicate
-            return jnp.full((n_shards,), jnp.asarray(x))
-        return jnp.asarray(x).reshape((n_shards, C // n_shards)
-                                      + x.shape[1:])
+        x = jnp.asarray(x)
+        if x.ndim == 0:  # clock scalar replicates
+            return jnp.full((n_shards,), x)
+        return x.reshape((n_shards, C // n_shards) + x.shape[1:])
 
-    return jax.tree.map(split, world)
+    out = jax.tree.map(split, world)
+    sat0 = jnp.zeros((n_shards,), jnp.int32).at[0].set(world.n_saturated)
+    return out._replace(n_saturated=sat0)
 
 
 def merge_flow_world(sharded: FlowWorld) -> FlowWorld:
@@ -717,14 +722,13 @@ def merge_flow_world(sharded: FlowWorld) -> FlowWorld:
     n_saturated, which sums (any shard's saturation poisons the run)."""
 
     def merge(x):
-        x = np.asarray(x)
+        x = jnp.asarray(x)
         if x.ndim == 1:  # replicated scalar
-            return jnp.asarray(x[0])
-        return jnp.asarray(x).reshape((-1,) + x.shape[2:])
+            return x[0]
+        return x.reshape((-1,) + x.shape[2:])
 
     out = jax.tree.map(merge, sharded)
-    return out._replace(
-        n_saturated=jnp.asarray(np.asarray(sharded.n_saturated).sum()))
+    return out._replace(n_saturated=jnp.asarray(sharded.n_saturated).sum())
 
 
 _sharded_run_cache: dict = {}
@@ -737,8 +741,6 @@ def run_windows_sharded(world: FlowWorld, n_windows: int, window_us: int,
     Returns (merged world, [n_shards, n_windows] step counts). The
     pmapped callable caches per parameter set (mirroring
     run_to_completion's jit_run) so repeated calls don't retrace."""
-    import functools
-
     if n_shards is None:
         n_shards = jax.local_device_count()
     sharded = split_flow_world(world, n_shards)
