@@ -184,7 +184,10 @@ def make_flow_world(latency_us: np.ndarray, size_bytes: np.ndarray,
     Q = queue_slots
     zc = lambda: jnp.zeros((C,), jnp.int32)
     return FlowWorld(
-        plane=dtcp.make_tcp_plane(C),
+        # GSO macro-segment wires produce few disjoint OOO ranges: 32
+        # slots (vs the per-MSS default 128) cover bursts while the
+        # SACK-block sort — the kernel's heaviest op — scans 4x less
+        plane=dtcp.make_tcp_plane(C, reass_slots=32),
         q_time=jnp.full((C, Q), I32_MAX, jnp.int32),
         q_fields=jnp.zeros((C, Q, dtcp.N_FIELDS), jnp.int32),
         q_head=zc(), q_count=zc(), q_dropped=zc(),

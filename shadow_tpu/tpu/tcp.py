@@ -141,7 +141,17 @@ class TcpPlane(NamedTuple):
     reass_len: jax.Array
 
 
-def make_tcp_plane(n_conns: int, sack: bool = _CFG.sack) -> TcpPlane:
+def make_tcp_plane(n_conns: int, sack: bool = _CFG.sack,
+                   reass_slots: int = REASS_SLOTS) -> TcpPlane:
+    """reass_slots sizes the out-of-order range store. The default
+    (recv_buffer/MSS) admits every window byte arriving as its own
+    disjoint range — the worst case per-MSS wires can produce. Wires
+    that deliver GSO macro-segments (the flow engine) produce FEW
+    disjoint ranges, and the [C, reass_slots] arrays are the heaviest
+    per-step operands in the event kernel (the SACK-block sort scans
+    them every pull), so those callers pass a small capacity; slot
+    exhaustion degrades to a dropped range recovered by retransmit,
+    never to corruption."""
     z = lambda: jnp.zeros((n_conns,), jnp.int32)
     u = lambda: jnp.zeros((n_conns,), jnp.uint32)
     f = lambda: jnp.zeros((n_conns,), bool)
@@ -175,8 +185,8 @@ def make_tcp_plane(n_conns: int, sack: bool = _CFG.sack) -> TcpPlane:
         sack_ok=f(),
         sacked_s=jnp.zeros((n_conns, SACK_SLOTS), jnp.int32),
         sacked_e=jnp.zeros((n_conns, SACK_SLOTS), jnp.int32),
-        reass_off=jnp.zeros((n_conns, REASS_SLOTS), jnp.int32),
-        reass_len=jnp.zeros((n_conns, REASS_SLOTS), jnp.int32),
+        reass_off=jnp.zeros((n_conns, reass_slots), jnp.int32),
+        reass_len=jnp.zeros((n_conns, reass_slots), jnp.int32),
     )
 
 
@@ -340,23 +350,37 @@ def _arm_persist(s, now_ms):
 # -- reassembly (coverage math over fixed (off, len) slots) ----------------
 
 def _reass_insert(s, off, length):
-    """_Reassembly.insert: keep the longer of same-offset entries; claim a
-    free slot otherwise (slot exhaustion drops the range — the peer will
-    retransmit; counted nowhere, exactly like a recv-buffer trim)."""
-    same = (s.reass_len > 0) & (s.reass_off == off)
-    has_same = same.any()
-    longer = length > jnp.where(same, s.reass_len, -1)
-    upd_len = jnp.where(same & longer, length, s.reass_len)
+    """_Reassembly.insert, with extend-on-touch coalescing: a range that
+    overlaps or touches an existing slot EXTENDS it (union), so live
+    slots are bounded by the number of HOLES in the receive window (one
+    per in-flight loss), not by delivered segment count — which is what
+    makes the flow engine's small reass_slots capacity safe. Coverage
+    semantics are identical to per-segment storage (the drain walks
+    coverage, and both twins' SACK blocks merge touching ranges before
+    reporting); same-offset-keep-longer remains a special case of
+    extend. Slot exhaustion (now only reachable with more holes than
+    slots) drops the range — the peer retransmits."""
+    end = off + length
+    live = s.reass_len > 0
+    touch = live & (s.reass_off <= end) & (off <= s.reass_off + s.reass_len)
+    has_touch = touch.any()
+    first_touch = jnp.argmax(touch)
+    new_off = jnp.minimum(s.reass_off[first_touch], off)
+    new_end = jnp.maximum(
+        s.reass_off[first_touch] + s.reass_len[first_touch], end)
+    ext_off = s.reass_off.at[first_touch].set(new_off)
+    ext_len = s.reass_len.at[first_touch].set(new_end - new_off)
     # free slot: first with len == 0
     free = s.reass_len == 0
     first_free = jnp.argmax(free)
     any_free = free.any()
+    do_ins = ~has_touch & any_free
     ins_off = s.reass_off.at[first_free].set(
-        jnp.where(~has_same & any_free, off, s.reass_off[first_free]))
+        jnp.where(do_ins, off, s.reass_off[first_free]))
     ins_len = s.reass_len.at[first_free].set(
-        jnp.where(~has_same & any_free, length, s.reass_len[first_free]))
-    off_out = jnp.where(has_same, s.reass_off, ins_off)
-    len_out = jnp.where(has_same, upd_len, ins_len)
+        jnp.where(do_ins, length, s.reass_len[first_free]))
+    off_out = jnp.where(has_touch, ext_off, ins_off)
+    len_out = jnp.where(has_touch, ext_len, ins_len)
     bytes_out = (jnp.where(len_out > 0, len_out, 0).sum()
                  .astype(jnp.int32))
     return s._replace(reass_off=off_out, reass_len=len_out,
