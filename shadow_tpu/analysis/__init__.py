@@ -1,0 +1,44 @@
+"""Static analysis for the determinism + jit-cache contracts.
+
+Two passes (driven by ``tools/shadowlint.py``):
+
+- ``astlint`` — AST determinism rules (SL1xx) over the whole package:
+  wall-clock reads, global randomness, unordered iteration feeding
+  event scheduling, mutable default arguments, Python branches on
+  traced values in kernels.
+- ``jaxpr_audit`` — jaxpr rules (SL2xx) over the jitted ``tpu/`` entry
+  points: x64 leaks, convert churn, host callbacks, transfers inside
+  loop bodies, baked constants.
+
+Plus ``recompile`` — the jit-cache-miss counter harness swept over the
+bench-ladder shapes.
+
+Rule IDs, invariants, and the suppression syntax live in ``rules`` and
+are documented in ``docs/determinism.md``.
+"""
+
+from .astlint import lint_file, lint_source, rule_applies
+from .jaxpr_audit import (AuditEntry, audit_all, audit_entry, audit_jaxpr,
+                          default_entries)
+from .recompile import (CompileCounter, LadderShape, ladder_shapes,
+                        sweep_window_step)
+from .rules import RULES, Finding, RuleInfo, parse_suppressions
+
+__all__ = [
+    "RULES",
+    "Finding",
+    "RuleInfo",
+    "parse_suppressions",
+    "lint_source",
+    "lint_file",
+    "rule_applies",
+    "AuditEntry",
+    "audit_all",
+    "audit_entry",
+    "audit_jaxpr",
+    "default_entries",
+    "CompileCounter",
+    "LadderShape",
+    "ladder_shapes",
+    "sweep_window_step",
+]

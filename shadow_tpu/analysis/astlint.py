@@ -1,0 +1,383 @@
+"""shadowlint pass 1: AST determinism rules over the whole package.
+
+Each checker resolves names through the file's import table (so
+``import time as _walltime`` and ``from time import perf_counter_ns as
+_perf_ns`` are both seen as the ``time`` module), then walks the AST
+once collecting findings. Rules scope by repo-relative path:
+
+- SL101 (wall-clock) applies to ``shadow_tpu/`` only — ``tools/``
+  benchmarks measure wall time on purpose.
+- SL102 (global randomness) applies everywhere except ``core/rng.py``,
+  the one sanctioned randomness module.
+- SL103 (unordered iteration) applies where iteration order can feed
+  event scheduling: ``core/``, ``net/``, ``host/``, ``kernel/``,
+  ``process/``, ``tcp/``, ``apps/``.
+- SL104 (mutable default args) applies everywhere.
+- SL105 (traced-value branching) applies to ``shadow_tpu/tpu/`` kernel
+  modules.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .rules import Finding, parse_suppressions
+
+__all__ = ["lint_source", "lint_file", "rule_applies"]
+
+# time/datetime entry points that read the real clock
+_WALL_CLOCK = {
+    "time.time", "time.time_ns",
+    "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "time.clock_gettime", "time.clock_gettime_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+
+# np.random attributes that are fine: explicitly seeded generator
+# construction, not draws from the hidden global stream
+_NP_RANDOM_OK = {
+    "default_rng", "Generator", "SeedSequence", "BitGenerator",
+    "PCG64", "PCG64DXSM", "MT19937", "Philox", "SFC64",
+}
+
+# builtins that preserve (lack of) ordering of a set argument
+_ORDER_PRESERVING = {"list", "tuple", "iter", "enumerate", "reversed"}
+
+# jax entry points that are *intentional* host syncs, not kernel branches
+_SYNC_OK = {"jax.device_get", "jax.block_until_ready"}
+
+_REDUCTION_METHODS = {"any", "all", "sum", "min", "max", "item",
+                      "argmax", "argmin"}
+
+
+def rule_applies(rule: str, relpath: str) -> bool:
+    """Path scoping for pass-1 rules; `relpath` is repo-relative with
+    forward slashes (e.g. ``shadow_tpu/core/scheduler.py``)."""
+    p = relpath.replace("\\", "/")
+    if rule == "SL101":
+        return p.startswith("shadow_tpu/")
+    if rule == "SL102":
+        return not p.endswith("core/rng.py")
+    if rule == "SL103":
+        return any(
+            p.startswith(f"shadow_tpu/{d}/")
+            for d in ("core", "net", "host", "kernel", "process",
+                      "tcp", "apps")
+        )
+    if rule == "SL104":
+        return True
+    if rule == "SL105":
+        return p.startswith("shadow_tpu/tpu/")
+    return False
+
+
+@dataclass
+class _Imports:
+    """Resolved import table: local name -> dotted module/object path."""
+
+    names: dict[str, str] = field(default_factory=dict)
+
+    def add_import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            self.names[alias.asname or alias.name.split(".")[0]] = (
+                alias.name if alias.asname else alias.name.split(".")[0]
+            )
+
+    def add_from(self, node: ast.ImportFrom) -> None:
+        if node.level or not node.module:
+            return  # relative imports stay package-local
+        for alias in node.names:
+            self.names[alias.asname or alias.name] = (
+                f"{node.module}.{alias.name}"
+            )
+
+    def resolve(self, node: ast.expr) -> str | None:
+        """Dotted path of a Name/Attribute chain through the table,
+        e.g. ``np.random.rand`` -> ``numpy.random.rand``."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.names.get(node.id)
+        if root is None:
+            if parts:
+                # attribute access on a non-imported name (a local,
+                # parameter, or self) — not a module path; resolving it
+                # to the bare name would mistake e.g. a parameter named
+                # `random` for the stdlib module
+                return None
+            root = node.id  # bare builtins: list(), set(), ...
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+
+class _SetTracker:
+    """Flow-insensitive local inference: which names are set-typed.
+
+    Tracks ``x = set(...)`` / ``x = {a, b}`` / ``x = a | b`` (of sets)
+    assignments per scope so ``for h in x`` can be flagged."""
+
+    def __init__(self) -> None:
+        self._scopes: list[set[str]] = [set()]
+
+    def push(self) -> None:
+        self._scopes.append(set())
+
+    def pop(self) -> None:
+        self._scopes.pop()
+
+    def mark(self, name: str) -> None:
+        self._scopes[-1].add(name)
+
+    def unmark(self, name: str) -> None:
+        for scope in self._scopes:
+            scope.discard(name)
+
+    def is_set(self, name: str) -> bool:
+        return any(name in scope for scope in self._scopes)
+
+
+def _is_set_expr(node: ast.expr, sets: _SetTracker) -> bool:
+    """True when `node` statically evaluates to a set/frozenset (after
+    peeling order-preserving wrappers like list()/enumerate())."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Name):
+        return sets.is_set(node.id)
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.BitOr, ast.BitAnd, ast.BitXor, ast.Sub)
+    ):
+        return _is_set_expr(node.left, sets) or _is_set_expr(node.right, sets)
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            if fn.id in ("set", "frozenset"):
+                return True
+            if fn.id in _ORDER_PRESERVING and node.args:
+                return _is_set_expr(node.args[0], sets)
+            return False
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in ("union", "intersection", "difference",
+                           "symmetric_difference", "copy"):
+                return _is_set_expr(fn.value, sets)
+    return False
+
+
+def _calls_outside_sync(node: ast.AST, imports: _Imports):
+    """Yield every Call in `node` that is not nested inside a _SYNC_OK
+    call — reads routed through jax.device_get are intentional syncs,
+    but only for that subexpression, not for the whole test."""
+    if isinstance(node, ast.Call):
+        if imports.resolve(node.func) in _SYNC_OK:
+            return
+        yield node
+    for child in ast.iter_child_nodes(node):
+        yield from _calls_outside_sync(child, imports)
+
+
+def _contains_traced_read(node: ast.expr, imports: _Imports,
+                          host_arrays: _SetTracker) -> bool:
+    """True when the expression contains a jnp/lax call or an array
+    reduction method — the signature of branching on a traced value.
+    Exempt: subexpressions routed through jax.device_get (an
+    intentional sync) and reductions on locals inferred to be host-side
+    numpy arrays (assigned from a resolved ``numpy.*`` call)."""
+    for sub in _calls_outside_sync(node, imports):
+        resolved = imports.resolve(sub.func)
+        if resolved and resolved.startswith(("jax.numpy.", "jax.lax.")):
+            return True
+        if isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in _REDUCTION_METHODS:
+            # method reductions on resolvable *module* attrs (np.sum is
+            # host-side numpy) or numpy-derived locals don't count;
+            # bare `x.any()` on anything else does
+            recv_node = sub.func.value
+            recv = imports.resolve(recv_node)
+            if recv in ("numpy", "math", "builtins"):
+                continue
+            if isinstance(recv_node, ast.Name) \
+                    and host_arrays.is_set(recv_node.id):
+                continue
+            return True
+    return False
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, relpath: str, imports: _Imports):
+        self.relpath = relpath
+        self.imports = imports
+        self.sets = _SetTracker()
+        self.host_arrays = _SetTracker()  # locals assigned from numpy.*
+        self.findings: list[Finding] = []
+
+    # -- bookkeeping -----------------------------------------------------
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        if rule_applies(rule, self.relpath):
+            self.findings.append(Finding(
+                rule, self.relpath, node.lineno, node.col_offset, message
+            ))
+
+    def visit_Import(self, node: ast.Import) -> None:
+        self.imports.add_import(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        self.imports.add_from(node)
+
+    def _visit_scope(self, node) -> None:
+        self._check_defaults(node)
+        self.sets.push()
+        self.host_arrays.push()
+        self.generic_visit(node)
+        self.host_arrays.pop()
+        self.sets.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_scope(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_scope(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        is_set = _is_set_expr(node.value, self.sets)
+        value_src = None
+        if isinstance(node.value, ast.Call):
+            value_src = self.imports.resolve(node.value.func)
+        is_np = bool(value_src) and value_src.startswith("numpy.")
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                (self.sets.mark if is_set else self.sets.unmark)(target.id)
+                (self.host_arrays.mark if is_np
+                 else self.host_arrays.unmark)(target.id)
+        self.generic_visit(node)
+
+    # -- SL101 / SL102: calls --------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        resolved = self.imports.resolve(node.func)
+        if resolved in _WALL_CLOCK:
+            self._emit("SL101", node,
+                       f"wall-clock read `{resolved}` in simulation code; "
+                       "simulated time must come from the event clock")
+        elif resolved and resolved.startswith("random."):
+            self._emit("SL102", node,
+                       f"global-stream randomness `{resolved}`; draw from "
+                       "the seeded streams in core/rng.py instead")
+        elif resolved and resolved.startswith("numpy.random."):
+            leaf = resolved.rsplit(".", 1)[1]
+            if leaf not in _NP_RANDOM_OK:
+                self._emit(
+                    "SL102", node,
+                    f"legacy global `{resolved}`; use a seeded "
+                    "np.random.default_rng(...) or core/rng.py")
+        self.generic_visit(node)
+
+    # -- SL103: unordered iteration --------------------------------------
+
+    def _check_iter(self, node: ast.AST, iter_expr: ast.expr) -> None:
+        if _is_set_expr(iter_expr, self.sets):
+            self._emit("SL103", node,
+                       "iteration over a set: order is insertion/"
+                       "hash-dependent; sort it (or use a list/dict) "
+                       "before it can feed event scheduling")
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_iter(node, node.iter)
+        self.generic_visit(node)
+
+    def visit_comprehension_generators(self, node) -> None:
+        for gen in node.generators:
+            self._check_iter(node, gen.iter)
+        self.generic_visit(node)
+
+    visit_ListComp = visit_comprehension_generators
+    visit_DictComp = visit_comprehension_generators
+    visit_GeneratorExp = visit_comprehension_generators
+
+    # building a set is fine; only iterating one is hazardous, so
+    # SetComp gets the same generator check as the other comprehensions
+    visit_SetComp = visit_comprehension_generators
+
+    # -- SL104: mutable defaults -----------------------------------------
+
+    def _check_defaults(self, node) -> None:
+        args = node.args
+        for default in list(args.defaults) + [
+            d for d in args.kw_defaults if d is not None
+        ]:
+            bad = isinstance(default, (ast.List, ast.Dict, ast.Set,
+                                       ast.ListComp, ast.DictComp,
+                                       ast.SetComp))
+            if isinstance(default, ast.Call):
+                callee = self.imports.resolve(default.func)
+                bad = callee in ("list", "dict", "set",
+                                 "collections.defaultdict",
+                                 "collections.deque",
+                                 "collections.OrderedDict")
+            if bad:
+                self._emit("SL104", default,
+                           "mutable default argument; default to None "
+                           "and construct inside the function")
+
+    # -- SL105: traced-value branching -----------------------------------
+
+    def _check_branch(self, node: ast.AST, test: ast.expr,
+                      what: str) -> None:
+        if _contains_traced_read(test, self.imports, self.host_arrays):
+            self._emit("SL105", node,
+                       f"Python {what} on a traced/device value; use "
+                       "lax.cond/select or jax.device_get at an explicit "
+                       "sync point")
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node, node.test, "`if`")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node, node.test, "`while`")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._check_branch(node, node.test, "conditional expression")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._check_branch(node, node.test, "`assert`")
+        self.generic_visit(node)
+
+
+def lint_source(source: str, relpath: str,
+                suppressions=None) -> list[Finding]:
+    """Lint one file's text under the scoping rules for `relpath`.
+
+    Returns ALL findings, with suppressed ones marked (so reports can
+    show suppression coverage); malformed disable comments (missing the
+    ``-- justification``) leave their findings unsuppressed. Pass a
+    pre-parsed ``Suppressions`` to avoid re-scanning the source when the
+    caller already needs it (e.g. for malformed-comment reporting).
+    """
+    tree = ast.parse(source, filename=relpath)
+    linter = _Linter(relpath, _Imports())
+    linter.visit(tree)
+    sup = suppressions if suppressions is not None \
+        else parse_suppressions(source)
+    for f in linter.findings:
+        just = sup.lookup(f.rule, f.line)
+        if just is not None:
+            f.suppressed = True
+            f.justification = just
+    return sorted(linter.findings, key=lambda f: (f.path, f.line, f.col))
+
+
+def lint_file(path: str, relpath: str | None = None) -> list[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        return lint_source(fh.read(), relpath or path)

@@ -1,0 +1,438 @@
+"""shadowlint pass 2: jaxpr audit of the jitted ``tpu/`` entry points.
+
+Abstract-evals each registered kernel entry (small representative
+shapes — the graph structure, primitives, and dtypes are shape-
+independent) and walks the closed jaxpr plus every nested sub-jaxpr
+(pjit / while_loop / scan / cond / custom_* bodies) flagging:
+
+- SL201: 64-bit dtypes anywhere in the graph (x64 leak).
+- SL202: redundant ``convert_element_type`` chains — a convert whose
+  input is itself a single-use convert output and whose composite is a
+  dtype round-trip (a -> b -> a). This is the jaxpr signature of
+  weak-type churn, the classic silent-recompile trigger.
+- SL203: host-callback primitives in the graph.
+- SL204: callbacks or device transfers inside a while_loop/scan body —
+  one host hop per iteration.
+- SL205: constants over a size threshold baked into the graph instead
+  of passed as arguments.
+
+The registry (`default_entries`) covers all five kernel modules:
+``plane`` (window_step in both qdisc/AQM compile modes + chain_windows),
+``tcp`` (event + pull + replay), ``transport`` (the DeviceTransport
+kernel set), ``floweng`` (the fused window driver), and ``codel``
+(trace replay + integrated router). Entries carry per-rule allow-lists
+with justifications — the pass-2 analogue of the source-comment
+suppression syntax, since jaxpr findings have no line to anchor to.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from .rules import Finding
+
+__all__ = [
+    "AuditEntry",
+    "audit_all",
+    "audit_entry",
+    "audit_jaxpr",
+    "default_entries",
+]
+
+# constants above this many bytes should be kernel *arguments*
+CONST_BYTES_LIMIT = 256 * 1024
+
+_64BIT = ("float64", "int64", "uint64", "complex128")
+
+# primitive names that cross the device<->host boundary
+_CALLBACK_MARKERS = ("callback", "outside_call", "infeed", "outfeed",
+                     "debug_print")
+_TRANSFER_PRIMS = {"device_put", "convert_device_array", "copy_to_host"}
+
+
+@dataclass
+class AuditEntry:
+    """One jitted entry point to audit.
+
+    ``build`` returns a zero-argument trace thunk: a (fn, args) pair
+    with every static argument already closed over, so the auditor just
+    calls ``jax.make_jaxpr(fn)(*args)``.
+    """
+
+    name: str
+    module: str
+    build: Callable[[], tuple[Callable, tuple]]
+    allow: dict[str, str] = field(default_factory=dict)
+
+
+def _subjaxprs(value):
+    """Yield (jaxpr, is_loop_body) for any jaxpr nested in an eqn param."""
+    try:
+        from jax.extend import core
+    except ImportError:  # older jax spells it jax.core
+        from jax import core
+    jaxpr_types = (core.Jaxpr, core.ClosedJaxpr)
+    if isinstance(value, jaxpr_types):
+        yield value
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            yield from _subjaxprs(v)
+
+
+def _iter_eqns(jaxpr, in_loop: bool):
+    """DFS over (eqn, in_loop) across every nested jaxpr."""
+    raw = getattr(jaxpr, "jaxpr", jaxpr)  # peel ClosedJaxpr
+    for eqn in raw.eqns:
+        yield eqn, in_loop
+        is_loop = eqn.primitive.name in ("while", "scan")
+        for key, value in eqn.params.items():
+            for sub in _subjaxprs(value):
+                yield from _iter_eqns(sub, in_loop or is_loop)
+
+
+def _consts_of(jaxpr):
+    """(name, array) for every literal const across nested jaxprs."""
+    raw = getattr(jaxpr, "jaxpr", jaxpr)
+    for const in getattr(jaxpr, "consts", []):
+        yield raw, const
+    for eqn in raw.eqns:
+        for value in eqn.params.values():
+            for sub in _subjaxprs(value):
+                yield from _consts_of(sub)
+
+
+def audit_jaxpr(closed_jaxpr, where: str,
+                const_bytes_limit: int = CONST_BYTES_LIMIT
+                ) -> list[Finding]:
+    """Walk one closed jaxpr (and every sub-jaxpr) for SL201-SL205."""
+    findings: list[Finding] = []
+    seen_64: set[str] = set()
+    n_callbacks = 0
+    n_loop_hops = 0
+
+    # producer map for convert-chain detection is per-jaxpr; collect
+    # convert eqns grouped by their owning jaxpr object id
+    converts_by_jaxpr: dict[int, list] = {}
+
+    def visit(jaxpr):
+        raw = getattr(jaxpr, "jaxpr", jaxpr)
+        converts = converts_by_jaxpr.setdefault(id(raw), [])
+        for eqn in raw.eqns:
+            if eqn.primitive.name == "convert_element_type":
+                converts.append(eqn)
+            for value in eqn.params.values():
+                for sub in _subjaxprs(value):
+                    visit(sub)
+
+    visit(closed_jaxpr)
+
+    for eqn, in_loop in _iter_eqns(closed_jaxpr, False):
+        name = eqn.primitive.name
+        for var in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(var, "aval", None)
+            dtype = str(getattr(aval, "dtype", ""))
+            if dtype in _64BIT and dtype not in seen_64:
+                seen_64.add(dtype)
+                findings.append(Finding(
+                    "SL201", where, 0, 0,
+                    f"{dtype} value in the device graph (primitive "
+                    f"`{name}`); the plane contract is 32-bit"))
+        if any(marker in name for marker in _CALLBACK_MARKERS):
+            n_callbacks += 1
+            if n_callbacks == 1:
+                findings.append(Finding(
+                    "SL203", where, 0, 0,
+                    f"host callback primitive `{name}` in a jitted "
+                    "kernel"))
+        if in_loop and (name in _TRANSFER_PRIMS
+                        or any(m in name for m in _CALLBACK_MARKERS)):
+            n_loop_hops += 1
+            if n_loop_hops == 1:
+                findings.append(Finding(
+                    "SL204", where, 0, 0,
+                    f"host transfer/callback `{name}` inside a "
+                    "while_loop/scan body: one sync per iteration"))
+
+    # SL202: convert chains within one jaxpr. Map each convert's outvar
+    # to its eqn; a convert consuming another convert's single-use
+    # output where the composite is dtype-identity is redundant churn.
+    for converts in converts_by_jaxpr.values():
+        by_outvar = {id(eqn.outvars[0]): eqn for eqn in converts}
+        for eqn in converts:
+            src = eqn.invars[0]
+            feeder = by_outvar.get(id(src))
+            if feeder is None:
+                continue
+            d0 = str(feeder.invars[0].aval.dtype)
+            d2 = str(eqn.outvars[0].aval.dtype)
+            if d0 == d2:
+                d1 = str(feeder.outvars[0].aval.dtype)
+                findings.append(Finding(
+                    "SL202", where, 0, 0,
+                    f"convert_element_type round-trip {d0} -> {d1} -> "
+                    f"{d2}: weak-type churn; pin the dtype at the "
+                    "source"))
+
+    for raw, const in _consts_of(closed_jaxpr):
+        try:
+            arr = np.asarray(const)
+        except TypeError:
+            # extended dtypes (PRNG keys) refuse conversion; size via the
+            # aval instead
+            arr = np.zeros(getattr(const, "shape", ()), np.uint32)
+        if arr.nbytes > const_bytes_limit:
+            findings.append(Finding(
+                "SL205", where, 0, 0,
+                f"{arr.nbytes} B constant ({arr.dtype}{list(arr.shape)}) "
+                f"baked into the graph (limit {const_bytes_limit} B); "
+                "pass it as a kernel argument"))
+
+    return findings
+
+
+def audit_entry(entry: AuditEntry) -> list[Finding]:
+    import jax
+
+    fn, args = entry.build()
+    closed = jax.make_jaxpr(fn)(*args)
+    findings = audit_jaxpr(closed, f"{entry.module}:{entry.name}")
+    for f in findings:
+        just = entry.allow.get(f.rule)
+        if just:
+            f.suppressed = True
+            f.justification = just
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry registry: all five tpu kernel modules at small shapes
+# ---------------------------------------------------------------------------
+
+class _StubHost:
+    def __init__(self, host_id: int, node_id: int):
+        self.host_id = host_id
+        self.node_id = node_id
+
+
+class _StubRouting:
+    """Minimal RoutingInfo twin for DeviceTransport's constructor."""
+
+    def __init__(self, n_nodes: int):
+        self.latency_ns = np.full((n_nodes, n_nodes), 1_000_000, np.int64)
+        np.fill_diagonal(self.latency_ns, 5_000)
+
+    def node_index(self, node_id: int) -> int:
+        return int(node_id)
+
+
+def _plane_entry(rr_enabled: bool, router_aqm: bool, no_loss: bool):
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..tpu import plane
+
+        n, m = 4, 3
+        params = plane.make_params(
+            latency_ns=np.full((m, m), 1_000_000, np.int64),
+            loss=np.full((m, m), 0.0 if no_loss else 0.01, np.float64),
+            up_bw_bps=np.full(n, 1_000_000_000, np.int64),
+            qdisc_rr=np.array([True, False] * (n // 2)),
+            down_bw_bps=np.full(n, 1_000_000_000, np.int64),
+            host_node=np.arange(n, dtype=np.int32) % m,
+        )
+        state = plane.make_state(n, egress_cap=8, ingress_cap=8,
+                                 params=params)
+        root = jax.random.key(0)
+
+        def fn(state, shift, window):
+            return plane.window_step(
+                state, params, root, shift, window,
+                rr_enabled=rr_enabled, router_aqm=router_aqm,
+                no_loss=no_loss)
+
+        return fn, (state, jnp.int32(0), jnp.int32(10_000_000))
+
+    return build
+
+
+def _chain_entry():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..tpu import plane
+
+        n = 4
+        params = plane.make_params(
+            latency_ns=np.full((n, n), 1_000_000, np.int64),
+            loss=np.zeros((n, n)),
+            up_bw_bps=np.full(n, 1_000_000_000, np.int64),
+        )
+        state = plane.make_state(n, egress_cap=8, ingress_cap=8,
+                                 params=params)
+        root = jax.random.key(0)
+
+        def fn(state, shift0, horizon):
+            return plane.chain_windows(
+                state, params, root, shift0, jnp.int32(1_000_000),
+                jnp.int32(1_000_000), horizon, horizon,
+                rr_enabled=False, no_loss=True)
+
+        return fn, (state, jnp.int32(0), jnp.int32(50_000_000))
+
+    return build
+
+
+def _tcp_entry(kind: str):
+    def build():
+        import jax.numpy as jnp
+
+        from ..tpu import tcp as dtcp
+
+        c = 4
+        plane = dtcp.make_tcp_plane(c, reass_slots=8)
+        if kind == "event":
+            fn = dtcp.tcp_event_step
+            args = (plane, jnp.zeros((c,), jnp.int32),
+                    jnp.zeros((c, dtcp.N_FIELDS), jnp.int32),
+                    jnp.zeros((c,), jnp.int32))
+        elif kind == "pull":
+            fn = dtcp.tcp_pull_step
+            args = (plane, jnp.zeros((c,), jnp.int32))
+        else:  # replay
+            t = 3
+            fn = dtcp.tcp_replay
+            args = (plane, jnp.zeros((c, t), jnp.int32),
+                    jnp.zeros((c, t, dtcp.N_FIELDS), jnp.int32),
+                    jnp.zeros((c, t), jnp.int32))
+        return fn, args
+
+    return build
+
+
+def _transport_entry(kernel: str):
+    def build():
+        import jax.numpy as jnp
+
+        from ..tpu.transport import DeviceTransport
+
+        n = 4
+        dt = DeviceTransport(
+            [_StubHost(i + 1, i % 3) for i in range(n)],
+            _StubRouting(3), None, egress_cap=8, ingress_cap=8,
+            mode="sync", compact_cap=16)
+        st = dt.state
+        if kernel == "ingest":
+            b = 8
+            z = lambda: jnp.zeros((b,), jnp.int32)
+            args = (st, z(), z(), z(), z(), z(), z(),
+                    jnp.zeros((b,), bool))
+            return dt._k_ingest, args
+        if kernel == "step":
+            return dt._k_step, (st, jnp.int32(0), jnp.int32(1_000_000))
+        if kernel == "chain":
+            i32 = jnp.int32
+            return dt._k_chain, (st, i32(0), i32(1_000_000),
+                                 i32(1_000_000), i32(50_000_000),
+                                 i32(50_000_000))
+        # batch_verify: K windows of B ingest rows
+        k, b = 4, 8
+        zk = lambda: jnp.zeros((k,), jnp.int32)
+        row = {key: jnp.zeros((k, b), jnp.int32)
+               for key in ("src", "dst", "seq", "tag", "send", "clamp")}
+        row["valid"] = jnp.zeros((k, b), bool)
+        args = (st, zk(), zk(), row, jnp.zeros((k,), jnp.uint32),
+                jnp.zeros((k,), jnp.uint32), zk(), jnp.int32(0))
+        return dt._k_batch_verify, args
+
+    return build
+
+
+def _floweng_entry():
+    def build():
+        import functools
+
+        from ..tpu import floweng
+
+        world = floweng.make_flow_world(
+            latency_us=np.full(4, 1000, np.int64),
+            size_bytes=np.full(4, 65536, np.int64),
+            queue_slots=16, loss=0.01)
+        fn = functools.partial(
+            floweng.run_windows, n_windows=2, window_us=1000,
+            max_events_per_window=8, ack_every=2, sched_batch=2,
+            pull_cap=2, gso_segs=4)
+        return fn, (world,)
+
+    return build
+
+
+def _codel_entry(kernel: str):
+    def build():
+        import jax.numpy as jnp
+
+        from ..tpu import codel
+
+        n, k = 4, 8
+        arrival = jnp.full((n, k), codel.I32_MAX, jnp.int32)
+        size = jnp.zeros((n, k), jnp.int32)
+        if kernel == "codel_drain":
+            pops = jnp.full((n, k), codel.I32_MAX, jnp.int32)
+            st = codel.make_codel_state(n)
+            return codel.codel_drain, (arrival, size, pops, st)
+        st = codel.make_router_state(n)
+        rate = jnp.full((n,), 125_000, jnp.int32)
+        cap = rate + 1500
+
+        def fn(arrival, size, rate, cap, st):
+            return codel.router_drain(
+                arrival, size, jnp.int32(10_000_000), rate, cap, st)
+
+        return fn, (arrival, size, rate, cap, st)
+
+    return build
+
+
+def default_entries() -> list[AuditEntry]:
+    """The audited kernel surface: every jitted entry point of the five
+    tpu/ modules at small representative shapes."""
+    entries = [
+        AuditEntry("window_step[rr,aqm,loss]", "shadow_tpu.tpu.plane",
+                   _plane_entry(True, True, False)),
+        AuditEntry("window_step[lean]", "shadow_tpu.tpu.plane",
+                   _plane_entry(False, False, True)),
+        AuditEntry("chain_windows", "shadow_tpu.tpu.plane",
+                   _chain_entry()),
+        AuditEntry("tcp_event_step", "shadow_tpu.tpu.tcp",
+                   _tcp_entry("event")),
+        AuditEntry("tcp_pull_step", "shadow_tpu.tpu.tcp",
+                   _tcp_entry("pull")),
+        AuditEntry("tcp_replay", "shadow_tpu.tpu.tcp",
+                   _tcp_entry("replay")),
+        AuditEntry("ingest", "shadow_tpu.tpu.transport",
+                   _transport_entry("ingest")),
+        AuditEntry("step_compact", "shadow_tpu.tpu.transport",
+                   _transport_entry("step")),
+        AuditEntry("chain", "shadow_tpu.tpu.transport",
+                   _transport_entry("chain")),
+        AuditEntry("batch_verify", "shadow_tpu.tpu.transport",
+                   _transport_entry("verify")),
+        AuditEntry("run_windows", "shadow_tpu.tpu.floweng",
+                   _floweng_entry()),
+        AuditEntry("codel_drain", "shadow_tpu.tpu.codel",
+                   _codel_entry("codel_drain")),
+        AuditEntry("router_drain", "shadow_tpu.tpu.codel",
+                   _codel_entry("router_drain")),
+    ]
+    return entries
+
+
+def audit_all(entries: list[AuditEntry] | None = None) -> list[Finding]:
+    out: list[Finding] = []
+    for entry in entries if entries is not None else default_entries():
+        out.extend(audit_entry(entry))
+    return out

@@ -1,0 +1,165 @@
+"""Recompile-counter harness: assert jit-cache-hit behavior.
+
+A silent recompile costs 1-20 s of wall time (10-20 s on a tunneled
+TPU) and destroys the sim-sec/wall-sec metric in BASELINE.json without
+failing anything — the classic causes being weak-typed Python scalars
+reaching a jitted signature, shape drift, and accidental static
+arguments. This harness wraps a callable in ``jax.jit``, counts
+compile-cache misses per call via the executable cache size, and sweeps
+the representative shape ladder so the contract "N distinct static
+shapes => exactly N compiles, every later call a cache hit" is asserted
+mechanically.
+
+The shape ladder mirrors ``tools/bench_ladder.py`` structurally: rung-2
+(single-node switch mesh) and rung-3 (multi-node GML fleet) host/queue
+shapes, scaled down so the sweep traces in seconds on CPU. Shapes are
+what drive compilation; the host *count* only scales array extents.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, NamedTuple
+
+import numpy as np
+
+__all__ = [
+    "CompileCounter",
+    "LadderShape",
+    "ladder_shapes",
+    "sweep_window_step",
+]
+
+
+class LadderShape(NamedTuple):
+    """One rung-shaped device configuration (scaled down)."""
+
+    name: str
+    n_hosts: int
+    n_nodes: int
+    egress_cap: int
+    ingress_cap: int
+
+
+def ladder_shapes() -> list[LadderShape]:
+    """The bench-ladder shape sweep (`tools/bench_ladder.py` rungs 2/3,
+    scaled): a single-node switch mesh and two GML fleet sizes."""
+    return [
+        LadderShape("rung2_switch_mesh", 8, 1, 8, 16),
+        LadderShape("rung3_gml_small", 16, 4, 8, 16),
+        LadderShape("rung3_gml_wide", 64, 8, 16, 32),
+    ]
+
+
+@dataclass
+class CompileCounter:
+    """Wrap `fn` in jax.jit and count cache misses per call.
+
+    ``misses`` increments whenever a call grew the jit executable
+    cache — i.e. the call compiled instead of hitting. ``expect(n)``
+    marks the next n misses as expected (first-call compiles per static
+    shape); ``unexpected_misses`` is what must stay zero.
+    """
+
+    fn: Callable
+    static_argnames: tuple = ()
+    calls: int = 0
+    misses: int = 0
+    expected: int = 0
+    log: list = field(default_factory=list)
+
+    def __post_init__(self):
+        import jax
+
+        self._jit = jax.jit(self.fn, static_argnames=self.static_argnames)
+
+    def expect(self, n: int = 1) -> None:
+        self.expected += n
+
+    @property
+    def unexpected_misses(self) -> int:
+        return max(0, self.misses - self.expected)
+
+    def __call__(self, *args, **kwargs):
+        before = self._jit._cache_size()
+        out = self._jit(*args, **kwargs)
+        after = self._jit._cache_size()
+        self.calls += 1
+        if after > before:
+            self.misses += after - before
+            self.log.append((self.calls, after - before))
+        return out
+
+
+def _build_shape(shape: LadderShape, rng: np.random.Generator):
+    import jax
+
+    from ..tpu import plane
+
+    m = shape.n_nodes
+    params = plane.make_params(
+        latency_ns=rng.integers(
+            100_000, 5_000_000, (m, m)).astype(np.int64),
+        loss=rng.uniform(0.0, 0.01, (m, m)),
+        up_bw_bps=np.full(shape.n_hosts, 1_000_000_000, np.int64),
+        host_node=(np.arange(shape.n_hosts) % m).astype(np.int32),
+    )
+    state = plane.make_state(
+        shape.n_hosts, egress_cap=shape.egress_cap,
+        ingress_cap=shape.ingress_cap, params=params)
+    return params, state, jax.random.key(7)
+
+
+def sweep_window_step(shapes: list[LadderShape] | None = None,
+                      rounds: int = 4, repeats: int = 2) -> dict:
+    """Drive ``plane.window_step`` across the shape ladder and report
+    cache behavior.
+
+    Per shape: one expected compile, then `rounds` windows with varying
+    (shift, window) scalars — which MUST all hit — then `repeats - 1`
+    re-sweeps of the whole ladder, which must add zero compiles. Window
+    scalars go in as np.int32 so a weak-typed Python int can never
+    sneak a new signature in; that conversion discipline is exactly
+    what the harness exists to enforce on callers.
+
+    Returns ``{"shapes": [...], "total_compiles", "expected_compiles",
+    "unexpected_misses"}`` — the acceptance gate is
+    ``unexpected_misses == 0``.
+    """
+    from ..tpu import plane
+
+    shapes = shapes if shapes is not None else ladder_shapes()
+    rng = np.random.default_rng(13)
+
+    counter = CompileCounter(
+        plane.window_step,
+        static_argnames=("rr_enabled", "router_aqm", "no_loss"))
+
+    built = [(s, *_build_shape(s, rng)) for s in shapes]
+    per_shape = []
+    for sweep in range(repeats):
+        for shape, params, state, key in built:
+            if sweep == 0:
+                counter.expect(1)  # first sight of this static shape
+            before = counter.misses
+            st = state
+            for r in range(rounds):
+                shift = np.int32(0 if r == 0 else 1_000_000 * r)
+                window = np.int32(1_000_000 * (r + 1))
+                st, _delivered, _next = counter(
+                    st, params, key, shift, window,
+                    rr_enabled=False, router_aqm=False, no_loss=False)
+            if sweep == 0:
+                per_shape.append({
+                    "shape": shape.name,
+                    "n_hosts": shape.n_hosts,
+                    "compiles": counter.misses - before,
+                })
+    return {
+        "shapes": per_shape,
+        "rounds_per_shape": rounds,
+        "repeats": repeats,
+        "total_compiles": counter.misses,
+        "expected_compiles": counter.expected,
+        "unexpected_misses": counter.unexpected_misses,
+    }

@@ -1,0 +1,195 @@
+"""The shadowlint rule registry, findings, and suppression syntax.
+
+Every rule protects one determinism or jit-cache invariant of the
+simulation (PAPER.md: "same seed -> same results, on any machine, at any
+parallelism"). Pass 1 (``astlint``) rules are SL1xx and run over source
+text; pass 2 (``jaxpr_audit``) rules are SL2xx and run over the jaxprs of
+the jitted ``tpu/`` entry points.
+
+Suppression syntax (pass 1)::
+
+    time.monotonic()  # shadowlint: disable=SL101 -- wall-clock stats only
+
+A ``# shadowlint: disable=SLxxx[,SLyyy] -- <justification>`` comment
+suppresses those rules on its own line and on the line directly below it
+(so it can trail the offending statement or sit on the preceding line).
+The justification after ``--`` is REQUIRED: a disable comment without one
+still fails the lint, so every suppression documents why the hazard is
+not real. Pass-2 entries carry per-rule allow-lists in the audit registry
+(`jaxpr_audit.default_entries`) instead, since jaxpr findings have no
+source line to anchor a comment to.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "RuleInfo",
+    "Suppressions",
+    "parse_suppressions",
+]
+
+
+@dataclass(frozen=True)
+class RuleInfo:
+    id: str
+    name: str
+    summary: str
+    invariant: str  # the determinism invariant the rule protects
+
+
+RULES: dict[str, RuleInfo] = {
+    r.id: r
+    for r in [
+        RuleInfo(
+            "SL101", "wall-clock-read",
+            "wall-clock read (time.time/monotonic/perf_counter, "
+            "datetime.now) in simulation code",
+            "simulated time comes only from the event clock; real time "
+            "feeding any simulation decision breaks replay",
+        ),
+        RuleInfo(
+            "SL102", "global-randomness",
+            "unseeded/global randomness (random.*, legacy np.random.*) "
+            "outside core/rng.py",
+            "all draws come from the seeded Xoshiro256++ streams in "
+            "core/rng.py (or counter-based threefry on device), so "
+            "results are a pure function of the config seed",
+        ),
+        RuleInfo(
+            "SL103", "unordered-iteration",
+            "iteration over a set/frozenset where order can feed event "
+            "scheduling",
+            "event order must be scheduling-independent; set iteration "
+            "order depends on insertion history and hash seeding",
+        ),
+        RuleInfo(
+            "SL104", "mutable-default-arg",
+            "mutable default argument (list/dict/set) on a function",
+            "a shared mutable default carries state across calls and "
+            "hosts, making results depend on call history",
+        ),
+        RuleInfo(
+            "SL105", "traced-branch",
+            "Python-level branching on a traced value inside a tpu/ "
+            "kernel module",
+            "host branches on device values force a blocking sync and "
+            "bake one branch into the compiled graph (silent recompiles "
+            "or wrong results under jit)",
+        ),
+        RuleInfo(
+            "SL201", "x64-leak",
+            "64-bit dtype (float64/int64) appearing in a device jaxpr",
+            "the device plane is int32/float32 by contract "
+            "(tpu/plane.py dtype discipline); x64 leaks change numerics "
+            "between hosts and recompile per weak-type",
+        ),
+        RuleInfo(
+            "SL202", "convert-churn",
+            "redundant convert_element_type chain in a device jaxpr",
+            "dtype round-trips signal weak-type churn at jit boundaries "
+            "— the classic silent-recompile trigger",
+        ),
+        RuleInfo(
+            "SL203", "host-callback",
+            "host callback primitive inside a jitted kernel",
+            "callbacks leave the device mid-kernel: nondeterministic "
+            "interleaving and a host sync on the hot path",
+        ),
+        RuleInfo(
+            "SL204", "transfer-in-loop",
+            "host transfer/callback inside a while_loop/scan body",
+            "a per-iteration device<->host hop turns an O(1)-dispatch "
+            "window chain into O(iterations) syncs",
+        ),
+        RuleInfo(
+            "SL205", "baked-constant",
+            "large constant baked into a jitted graph",
+            "big captured constants bloat every compiled executable and "
+            "re-upload on each compile; pass them as arguments instead",
+        ),
+    ]
+}
+
+
+@dataclass
+class Finding:
+    """One rule violation (or suppressed violation) with its location.
+
+    ``line`` is 1-based for pass-1 findings and 0 for jaxpr findings,
+    whose location is the audit entry name in ``path``.
+    """
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    justification: str = ""
+
+    def to_json(self) -> dict:
+        info = RULES[self.rule]
+        return {
+            "rule": self.rule,
+            "name": info.name,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "justification": self.justification,
+        }
+
+    def __str__(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}" if self.line else self.path
+        tag = " [suppressed]" if self.suppressed else ""
+        return f"{loc}: {self.rule} {self.message}{tag}"
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*shadowlint:\s*disable=([A-Z0-9,\s]+?)"
+    r"(?:\s*--\s*(.*?))?\s*$"
+)
+
+
+@dataclass
+class Suppressions:
+    """Per-file map of line -> {rule -> justification}.
+
+    A disable comment on line L covers findings on L and L+1; an empty
+    justification means the comment is malformed (missing ``-- reason``)
+    and does NOT suppress.
+    """
+
+    by_line: dict[int, dict[str, str]] = field(default_factory=dict)
+    malformed: list[tuple[int, str]] = field(default_factory=list)
+
+    def lookup(self, rule: str, line: int) -> str | None:
+        """Justification text if (rule, line) is suppressed, else None."""
+        for cand in (line, line - 1):
+            just = self.by_line.get(cand, {}).get(rule)
+            if just:
+                return just
+        return None
+
+
+def parse_suppressions(source: str) -> Suppressions:
+    sup = Suppressions()
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = [r.strip() for r in m.group(1).split(",") if r.strip()]
+        just = (m.group(2) or "").strip()
+        if not just:
+            sup.malformed.append((lineno, text.strip()))
+            continue
+        slot = sup.by_line.setdefault(lineno, {})
+        for rule in rules:
+            slot[rule] = just
+    return sup

@@ -20,7 +20,6 @@ from __future__ import annotations
 from typing import Callable
 
 from ..kernel import errors
-from ..kernel.status import FileState
 
 MS = 1_000_000
 

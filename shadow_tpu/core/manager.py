@@ -585,6 +585,7 @@ class Manager:
         second, to stderr (the non-TTY "printer" flavor)."""
         stop = max(1, self.config.general.stop_time)
         frac = min(100, round(100 * now_ns / stop))
+        # shadowlint: disable=SL101 -- progress line realtime display; never feeds sim state
         wall = _walltime.monotonic() - self._wall_start
         print(
             f"{frac}% — simulated: {simtime.fmt(now_ns)}/"
@@ -600,6 +601,7 @@ class Manager:
                 + self._heartbeat_interval):
             self._last_heartbeat = window_start
             self._log_heartbeat(window_start)
+        # shadowlint: disable=SL101 -- heartbeat/watchdog pacing; never feeds sim state
         wall = _walltime.monotonic()
         if wall - self._last_resource_check >= 30.0:
             self._last_resource_check = wall
@@ -617,7 +619,7 @@ class Manager:
 
             return flowplan.run_flow_simulation(
                 self.config, self.routing, self.stats)
-        wall_start = _walltime.monotonic()
+        wall_start = _walltime.monotonic()  # shadowlint: disable=SL101 -- perf stat
         self._wall_start = wall_start
         self._last_resource_check = wall_start
         try:
@@ -716,6 +718,7 @@ class Manager:
                 h.n_events_executed for h in self._host_order)
             self.stats.packets_sent = int(self.routing.packet_counters.sum())
             self.stats.packets_dropped = self.shared.packet_drop_count
+            # shadowlint: disable=SL101 -- wall-clock perf stat only
             self.stats.wall_seconds = _walltime.monotonic() - wall_start
             for writer in self._pcap_writers:
                 writer.close()

@@ -22,7 +22,6 @@ import threading
 from typing import Optional
 
 from ..net.packet import Packet, PacketStatus
-from .event import Event
 
 # Thread-local "which host is executing on this scheduler thread" — the
 # dispatch point for per-host instrumentation (tracker counters, strace),

@@ -19,7 +19,6 @@ from typing import Callable, Optional
 from ..core.config import QDiscMode
 from ..core.event import Event, EventQueue, TaskRef
 from ..core.rng import Xoshiro256pp
-from ..net.interface import NetworkInterface
 from ..net.namespace import NetworkNamespace
 from ..net.packet import Packet
 from ..net.relay import Relay
@@ -224,10 +223,11 @@ class Host:
 
     def execute(self, until_ns: int) -> None:
         if self._perf_enabled:
-            t0 = _perf_ns()
+            t0 = _perf_ns()  # shadowlint: disable=SL101 -- opt-in host-exec profiling stat
             try:
                 self._execute(until_ns)
             finally:
+                # shadowlint: disable=SL101 -- opt-in host-exec profiling stat
                 self.execution_ns += _perf_ns() - t0
         else:
             self._execute(until_ns)

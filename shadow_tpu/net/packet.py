@@ -12,7 +12,7 @@ carries the same information as SoA arrays (see `shadow_tpu/tpu/`), with
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Optional
 
 CONFIG_MTU = 1500  # bytes (`src/main/core/definitions.h:124-129`)

@@ -16,7 +16,7 @@ period and for device-local (src == dst) deliveries (`relay/mod.rs:202,224`).
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Optional
 
 from ..core import simtime
 from .packet import CONFIG_MTU, Packet, PacketStatus

@@ -1016,9 +1016,11 @@ class ManagedSimProcess:
         charge = cpu is not None and cpu.threshold is not None
         while True:
             if charge:
-                t0 = _time.monotonic_ns()
+                # The CPU model charges native exec wall time by
+                # design (process.rs:465-482); off by default.
+                t0 = _time.monotonic_ns()  # shadowlint: disable=SL101 -- CPU model, see above
                 ev = thread.ipc.recv_from_shim()
-                cpu.add_delay(_time.monotonic_ns() - t0)
+                cpu.add_delay(_time.monotonic_ns() - t0)  # shadowlint: disable=SL101 -- CPU model, see above
             else:
                 ev = thread.ipc.recv_from_shim()
             if ev is None:
@@ -1586,8 +1588,9 @@ class ManagedSimProcess:
             return
         import time as _time
 
-        deadline = _time.monotonic() + timeout_s
+        deadline = _time.monotonic() + timeout_s  # shadowlint: disable=SL101 -- real-OS thread reaping
         while self._native_task_running(tid):
+            # shadowlint: disable=SL101 -- real-OS thread reaping, outside the sim clock
             if _time.monotonic() > deadline:
                 log.warning("thread %d of %r did not exit within %ss",
                             tid, self.name, timeout_s)

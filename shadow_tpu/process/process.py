@@ -32,7 +32,6 @@ import ipaddress
 import logging
 from typing import Callable, Generator, Optional
 
-from ..core.event import TaskRef
 from ..kernel import errors
 from ..kernel.socket.tcp import TcpSocket
 from ..kernel.socket.udp import UdpSocket

@@ -103,9 +103,12 @@ def _probe_d2h_ms(jax, jnp) -> float:
     jax.device_get(f(x))  # compile + first transfer
     costs = []
     for _ in range(3):
-        t0 = _walltime.monotonic()
+        # The D2H link probe picks sync vs mirrored mode; both modes
+        # are bitwise-identical by construction, so this wall read
+        # can only change performance, never results.
+        t0 = _walltime.monotonic()  # shadowlint: disable=SL101 -- link probe, see above
         jax.device_get(f(x))
-        costs.append(_walltime.monotonic() - t0)
+        costs.append(_walltime.monotonic() - t0)  # shadowlint: disable=SL101 -- link probe, see above
     return sorted(costs)[1] * 1e3
 
 

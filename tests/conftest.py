@@ -12,6 +12,7 @@ tunnel from tests would be both slow (every dispatch crosses it) and wrong
 """
 
 import os
+import random as _pyrandom
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -20,5 +21,43 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import jax  # noqa: E402
+import numpy as _np  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def _np_state_equal(a, b) -> bool:
+    # ('MT19937', keys ndarray, pos, has_gauss, cached_gaussian)
+    return (a[0] == b[0] and _np.array_equal(a[1], b[1])
+            and tuple(a[2:]) == tuple(b[2:]))
+
+
+@pytest.fixture(autouse=True)
+def _global_rng_guard(request):
+    """Fail any test that mutates the hidden global RNG streams.
+
+    The determinism contract (shadowlint SL102, docs/determinism.md)
+    routes every simulation draw through the seeded streams in
+    shadow_tpu/core/rng.py — the global `random` / `np.random` states
+    must stay untouched so results can never depend on test order or
+    import side effects. Opt out (e.g. to test an external library's
+    seeding) with @pytest.mark.allow_global_rng.
+    """
+    if request.node.get_closest_marker("allow_global_rng"):
+        yield
+        return
+    py_state = _pyrandom.getstate()
+    np_state = _np.random.get_state()
+    yield
+    offenders = []
+    if _pyrandom.getstate() != py_state:
+        offenders.append("random")
+    if not _np_state_equal(_np.random.get_state(), np_state):
+        offenders.append("np.random")
+    if offenders:
+        pytest.fail(
+            f"test mutated the global {' and '.join(offenders)} state; "
+            "draw from the seeded streams in shadow_tpu/core/rng.py (or "
+            "a local np.random.default_rng(seed)) instead — see "
+            "docs/determinism.md (SL102)", pytrace=False)

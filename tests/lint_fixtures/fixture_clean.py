@@ -1,0 +1,10 @@
+"""Negative fixture: hazard-adjacent but rule-clean code. Never imported."""
+
+import numpy as np
+
+
+def clean(hosts, seed):
+    rng = np.random.default_rng(seed)
+    order = sorted(set(hosts), key=id)
+    draws = [rng.random() for _ in order]
+    return dict(zip(order, draws))

@@ -1,0 +1,25 @@
+"""SL104 fixture: mutable default arguments. Never imported."""
+
+import collections
+
+
+def list_default(xs=[]):  # line 6: violation
+    return xs
+
+
+def dict_default(*, opts={}):  # line 10: violation (kw-only)
+    return opts
+
+
+def set_and_call_defaults(seen=set(), extra=dict()):  # line 14: 2 violations
+    return seen, extra
+
+
+def deque_default(q=collections.deque()):  # line 18: violation
+    return q
+
+
+def allowed(xs=None, n=3, name="x", pair=(1, 2)):
+    if xs is None:
+        xs = []
+    return xs, n, name, pair

@@ -1,0 +1,21 @@
+"""SL102 fixture: global-stream randomness. Never imported."""
+
+import random
+import random as _rnd
+
+import numpy as np
+
+
+def violations():
+    a = random.random()  # line 10: violation
+    b = _rnd.randint(0, 7)  # line 11: violation (alias)
+    random.seed(42)  # line 12: violation (reseeding the hidden stream)
+    c = np.random.rand(3)  # line 14: violation (legacy global)
+    np.random.shuffle([1, 2])  # line 15: violation
+    return a, b, c
+
+
+def allowed():
+    rng = np.random.default_rng(7)  # seeded generator: allowed
+    gen = np.random.Generator(np.random.PCG64(3))  # allowed
+    return rng.integers(0, 10), gen

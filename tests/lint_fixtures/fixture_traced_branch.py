@@ -1,0 +1,29 @@
+"""SL105 fixture: Python branches on traced values. Never imported.
+
+Linted under a synthetic shadow_tpu/tpu/ path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def kernel(x, mask):
+    if jnp.any(mask):  # line 12: violation
+        x = x + 1
+    while x.sum() > 0:  # line 14: violation (method reduction)
+        x = x - 1
+    y = x * 2 if jnp.all(mask) else x  # line 16: violation (ternary)
+    assert jnp.max(x) < 100  # line 17: violation
+    return y
+
+
+def allowed(x, mask, rr_enabled):
+    if rr_enabled:  # static python switch: fine
+        x = x + 1
+    if int(jax.device_get(mask.any())):  # explicit sync: fine
+        x = x + 2
+    host = np.asarray([1, 2, 3])
+    if host.max() > 2:  # host-side numpy local: fine
+        x = x + 3
+    return jnp.where(mask, x, 0)  # data-dependent select: fine

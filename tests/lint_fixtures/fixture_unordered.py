@@ -1,0 +1,29 @@
+"""SL103 fixture: unordered iteration feeding scheduling. Never imported."""
+
+
+def violations(hosts):
+    pending = {h for h in hosts}  # building a set is fine
+    for h in pending:  # line 6: violation (local inferred set-typed)
+        h.execute()
+    for h in set(hosts):  # line 8: violation
+        h.execute()
+    for h in list({1, 2, 3}):  # line 10: violation (wrapper preserves
+        print(h)  # the lack of order)
+    names = [n for n in frozenset(hosts)]  # line 12: violation
+    other = pending | {object()}
+    for h in other:  # line 14: violation (set | set)
+        h.execute()
+    return names
+
+
+def allowed(hosts):
+    pending = set(hosts)
+    for h in sorted(pending, key=id):  # sorted: deterministic
+        h.execute()
+    if "x" in pending:  # membership is order-free
+        pass
+    for h in hosts:  # plain list
+        h.execute()
+    ordered = {h: 1 for h in hosts}
+    for h in ordered:  # dicts are insertion-ordered
+        h.execute()

@@ -1,0 +1,31 @@
+"""SL101 fixture: wall-clock reads, including suppressed and malformed.
+
+Linted by tests/test_shadowlint.py under a synthetic shadow_tpu/ path;
+never imported.
+"""
+
+import time
+import time as _walltime
+from datetime import datetime
+from time import perf_counter_ns as _perf_ns
+
+
+def violations():
+    a = time.time()  # line 14: violation
+    b = _walltime.monotonic()  # line 15: violation (module alias)
+    c = _perf_ns()  # line 16: violation (from-import alias)
+    d = datetime.now()  # line 17: violation
+    return a, b, c, d
+
+
+def suppressed_ok():
+    return time.monotonic()  # shadowlint: disable=SL101 -- test justification
+
+
+def suppressed_on_previous_line():
+    # shadowlint: disable=SL101 -- justified on the preceding line
+    return time.monotonic_ns()
+
+
+def malformed_suppression():
+    return time.perf_counter()  # shadowlint: disable=SL101

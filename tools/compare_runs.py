@@ -29,7 +29,6 @@ import os
 import subprocess
 import sys
 import tempfile
-from typing import Sequence
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -1,0 +1,166 @@
+#!/usr/bin/env python3
+"""shadowlint: the determinism + JAX-kernel static analysis suite.
+
+Pass 1 lints every Python file under the given paths with the AST
+determinism rules (SL1xx); pass 2 abstract-evals the jitted ``tpu/``
+kernel entry points and audits their jaxprs (SL2xx). Exit code is
+nonzero when any unsuppressed finding (or malformed suppression
+comment) exists.
+
+Usage::
+
+    python tools/shadowlint.py                  # both passes, text report
+    python tools/shadowlint.py --json           # machine-readable report
+    python tools/shadowlint.py --no-jaxpr       # AST pass only (no jax)
+    python tools/shadowlint.py --recompile      # + jit-cache sweep
+    python tools/shadowlint.py shadow_tpu/core  # explicit paths
+
+Suppression: ``# shadowlint: disable=SL101 -- <why this is safe>`` on
+the offending line or the line above. The justification is mandatory.
+Rule IDs and the invariants they protect: docs/determinism.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from shadow_tpu.analysis import rules as _rules  # noqa: E402
+from shadow_tpu.analysis.astlint import lint_source  # noqa: E402
+
+DEFAULT_PATHS = ("shadow_tpu", "tools")
+
+
+def _iter_py_files(paths):
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        if not os.path.isdir(path):
+            raise FileNotFoundError(path)
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in ("__pycache__",))
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def run_ast_pass(paths):
+    findings, malformed = [], []
+    for path in _iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(path), _REPO).replace(
+            os.sep, "/")
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+        sup = _rules.parse_suppressions(source)
+        findings.extend(lint_source(source, rel, suppressions=sup))
+        for lineno, text in sup.malformed:
+            malformed.append((rel, lineno, text))
+    return findings, malformed
+
+
+def run_jaxpr_pass():
+    # tracing needs a backend for the concrete example arrays; force CPU
+    # exactly like tests/conftest.py (the env var is already cached by
+    # sitecustomize, so the config update is the only working override)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from shadow_tpu.analysis.jaxpr_audit import audit_all
+
+    return audit_all()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="shadowlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint; default: shadow_tpu and "
+                         "tools, resolved against the repo root so the "
+                         "gate works from any cwd")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON report on stdout")
+    ap.add_argument("--no-jaxpr", action="store_true",
+                    help="skip pass 2 (jaxpr audit of tpu/ kernels)")
+    ap.add_argument("--recompile", action="store_true",
+                    help="also run the jit-cache sweep over the "
+                         "bench-ladder shapes (slow: compiles kernels)")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [os.path.join(_REPO, p) for p in DEFAULT_PATHS]
+    try:
+        findings, malformed = run_ast_pass(paths)
+    except FileNotFoundError as exc:
+        print(f"shadowlint: no such file or directory: {exc.args[0]}",
+              file=sys.stderr)
+        return 2
+    if not args.no_jaxpr:
+        findings.extend(run_jaxpr_pass())
+
+    recompile_report = None
+    if args.recompile:
+        from shadow_tpu.analysis.recompile import sweep_window_step
+
+        recompile_report = sweep_window_step()
+
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+    failed = bool(active or malformed) or bool(
+        recompile_report and recompile_report["unexpected_misses"])
+
+    if args.json:
+        json.dump({
+            "version": 1,
+            "rules": {rid: {
+                "name": info.name,
+                "summary": info.summary,
+                "invariant": info.invariant,
+            } for rid, info in sorted(_rules.RULES.items())},
+            "findings": [f.to_json() for f in findings],
+            "malformed_suppressions": [
+                {"path": p, "line": ln, "text": t}
+                for p, ln, t in malformed
+            ],
+            "recompile": recompile_report,
+            "summary": {
+                "active": len(active),
+                "suppressed": len(suppressed),
+                "malformed_suppressions": len(malformed),
+                "ok": not failed,
+            },
+        }, sys.stdout, indent=2)
+        print()
+        return 1 if failed else 0
+
+    for f in active:
+        print(f)
+    for path, lineno, text in malformed:
+        print(f"{path}:{lineno}:1: malformed suppression (missing "
+              f"`-- justification`): {text}")
+    if suppressed:
+        print(f"-- {len(suppressed)} suppressed finding(s):")
+        for f in suppressed:
+            print(f"   {f}  ({f.justification})")
+    if recompile_report is not None:
+        print(f"-- recompile sweep: {recompile_report['total_compiles']} "
+              f"compiles over {len(recompile_report['shapes'])} ladder "
+              f"shapes x {recompile_report['repeats']} sweeps, "
+              f"{recompile_report['unexpected_misses']} unexpected "
+              "cache misses")
+    print(("FAIL" if failed else "OK")
+          + f": {len(active)} active finding(s), "
+          f"{len(suppressed)} suppressed, "
+          f"{len(malformed)} malformed suppression(s)")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
