@@ -31,6 +31,9 @@ MS = 1_000_000
 N_HOSTS = int(os.environ.get("BENCH_HOSTS", "32768"))
 ROUNDS = int(os.environ.get("BENCH_ROUNDS", "192"))
 N_NODES = int(os.environ.get("BENCH_NODES", "64"))  # graph nodes (GML-like)
+# "xla" (default) or "pallas" — the experimental.plane_kernel flag's
+# bench-side twin (the fused Pallas egress kernel; see docs/performance.md)
+PLANE_KERNEL = os.environ.get("BENCH_PLANE_KERNEL", "xla")
 EGRESS_CAP = 16
 INGRESS_CAP = 32
 SPAWN_PER_DELIVERY = 1
@@ -40,65 +43,47 @@ def bench_tpu() -> tuple[float, int]:
     import jax
     import jax.numpy as jnp
 
-    from shadow_tpu.tpu import (ingest, ingest_rows, make_params, make_state,
-                                window_step)
+    from shadow_tpu.tpu import donating_jit, ingest_rows, window_step
+    from shadow_tpu.tpu import profiling
 
     N, M = N_HOSTS, N_NODES
-    rng = np.random.default_rng(0)
-    # node-level path tables + host->node map, the shape real GML
-    # topologies have (hosts cluster on graph vertices)
-    lat = rng.integers(1 * MS, 50 * MS, size=(M, M), dtype=np.int32)
-    lat = np.minimum(lat, lat.T)  # symmetric-ish
-    loss = np.full((M, M), 0.01, np.float32)  # real loss draws every round
-    host_node = (np.arange(N) % M).astype(np.int32)
-    bw = np.full((N,), 10_000_000_000, np.int64)  # 10 Gbit: not bw-bound
-    params = make_params(lat, loss, bw, host_node=host_node)
-    state = make_state(N, egress_cap=EGRESS_CAP, ingress_cap=INGRESS_CAP,
-                       initial_tokens=np.asarray(params.tb_cap))
-
-    # seed: 4 packets per host
-    k = 4
-    src0 = jnp.repeat(jnp.arange(N, dtype=jnp.int32), k)
-    dst0 = (src0 * 1566083941 + jnp.tile(jnp.arange(k, dtype=jnp.int32), N) * 40503 + 1) % N
-    b0 = src0.shape[0]
-    state = ingest(
-        state, src0, dst0,
-        jnp.full((b0,), 1400, jnp.int32),
-        jnp.arange(b0, dtype=jnp.int32),
-        jnp.arange(b0, dtype=jnp.int32),
-        jnp.zeros((b0,), bool),
-    )
-
-    key = jax.random.key(1)
+    # ONE definition of the PHOLD world, shared with the per-section
+    # profiler (tpu/profiling.build_world): node-level path tables, 4 seed
+    # packets per host — so profiler section times correspond to this
+    # bench's end-to-end line by construction
+    world = profiling.build_world(N, n_nodes=M, egress_cap=EGRESS_CAP,
+                                  ingress_cap=INGRESS_CAP, seed=0,
+                                  warmup_windows=0)
+    state, params = world["state"], world["params"]
+    key = world["rng_root"]
     CI = INGRESS_CAP
-    window = jnp.int32(10 * MS)
+    window = world["window"]
 
     def round_fn(carry, round_idx):
         state, spawn_seq = carry
         shift = jnp.where(round_idx == 0, jnp.int32(0), window)
         state, delivered, next_ev = window_step(state, params, key, shift,
-                                                window, rr_enabled=False)
+                                                window, rr_enabled=False,
+                                                kernel=PLANE_KERNEL)
         # respawn: each delivered packet triggers one new packet from the
         # receiving host to a hashed destination (deterministic). The
         # delivered arrays are already row-shaped (row = receiving host),
         # so the row-local ingest needs no flat cross-host sort.
-        mask = delivered["mask"]
-        new_dst = (delivered["src"] * 40503
-                   + delivered["seq"] * 1566083941 + round_idx * 97) % N
-        rank = jnp.broadcast_to(jnp.arange(CI, dtype=jnp.int32), (N, CI))
-        seq_vals = spawn_seq[:, None] + rank
+        mask, new_dst, nbytes, seq_vals, ctrl = profiling.respawn_batch(
+            delivered, spawn_seq, round_idx, N, CI)
         state = ingest_rows(
-            state, new_dst,
-            jnp.full((N, CI), 1400, jnp.int32),
+            state, new_dst, nbytes,
             seq_vals,  # priority: reuse seq (FIFO-ish)
-            seq_vals,
-            jnp.zeros((N, CI), bool),
+            seq_vals, ctrl,
             valid=mask,
         )
         spawn_seq = spawn_seq + mask.sum(axis=1, dtype=jnp.int32)
         return (state, spawn_seq), mask.sum(dtype=jnp.int32)
 
-    @jax.jit
+    # the state pytree is donated: XLA reuses the input buffers for the
+    # scan carry instead of materializing a second copy of ~20 [N, C]
+    # arrays (donation contract: `state`/`state2` are dead after the call)
+    @donating_jit
     def run(state):
         spawn_seq = jnp.full((N,), 10_000, jnp.int32)
         (state, _), delivered_counts = jax.lax.scan(
@@ -112,16 +97,11 @@ def bench_tpu() -> tuple[float, int]:
     jax.block_until_ready(state_out)
     compile_and_first = time.monotonic() - t0
 
-    # timed run (fresh state, compiled)
-    state2 = make_state(N, egress_cap=EGRESS_CAP, ingress_cap=INGRESS_CAP,
-                        initial_tokens=np.asarray(params.tb_cap))
-    state2 = ingest(
-        state2, src0, dst0,
-        jnp.full((b0,), 1400, jnp.int32),
-        jnp.arange(b0, dtype=jnp.int32),
-        jnp.arange(b0, dtype=jnp.int32),
-        jnp.zeros((b0,), bool),
-    )
+    # timed run (fresh state, compiled): rebuild the identical world —
+    # the first state was donated into the compile run
+    state2 = profiling.build_world(N, n_nodes=M, egress_cap=EGRESS_CAP,
+                                   ingress_cap=INGRESS_CAP, seed=0,
+                                   warmup_windows=0)["state"]
     jax.block_until_ready(state2)
     t0 = time.monotonic()
     state_out, ndel = run(state2)

@@ -228,7 +228,8 @@ class _StubRouting:
         return int(node_id)
 
 
-def _plane_entry(rr_enabled: bool, router_aqm: bool, no_loss: bool):
+def _plane_entry(rr_enabled: bool, router_aqm: bool, no_loss: bool,
+                 packed_sort: bool = True, kernel: str = "xla"):
     def build():
         import jax
         import jax.numpy as jnp
@@ -252,7 +253,7 @@ def _plane_entry(rr_enabled: bool, router_aqm: bool, no_loss: bool):
             return plane.window_step(
                 state, params, root, shift, window,
                 rr_enabled=rr_enabled, router_aqm=router_aqm,
-                no_loss=no_loss)
+                no_loss=no_loss, packed_sort=packed_sort, kernel=kernel)
 
         return fn, (state, jnp.int32(0), jnp.int32(10_000_000))
 
@@ -405,6 +406,10 @@ def default_entries() -> list[AuditEntry]:
                    _plane_entry(True, True, False)),
         AuditEntry("window_step[lean]", "shadow_tpu.tpu.plane",
                    _plane_entry(False, False, True)),
+        AuditEntry("window_step[legacy-sort]", "shadow_tpu.tpu.plane",
+                   _plane_entry(True, True, False, packed_sort=False)),
+        AuditEntry("window_step[pallas]", "shadow_tpu.tpu.plane",
+                   _plane_entry(False, False, True, kernel="pallas")),
         AuditEntry("chain_windows", "shadow_tpu.tpu.plane",
                    _chain_entry()),
         AuditEntry("tcp_event_step", "shadow_tpu.tpu.tcp",

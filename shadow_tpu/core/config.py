@@ -185,6 +185,14 @@ class ExperimentalOptions:
     tpu_egress_cap: int = 256  # per-host device egress slots
     tpu_ingress_cap: int = 256  # per-host device in-flight slots
     tpu_compact_cap: int = 4096  # per-window compacted-delivery slots
+    # device-plane egress kernel: "xla" = the packed-key sort diet path
+    # (default); "pallas" = the fused rebase->sort->token-gate Pallas
+    # kernel (tpu/pallas_egress.py; FIFO qdisc only, bitwise-identical,
+    # interpret mode off-TPU). Governs the general plane's window_step
+    # drivers (bench.py via BENCH_PLANE_KERNEL, tools/profile_plane.py
+    # --kernel); the use_tpu_transport path has its own kernels and does
+    # not consult this yet. See docs/performance.md.
+    plane_kernel: str = "xla"
 
 
 @dataclass
@@ -382,6 +390,10 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
         raise ConfigError("general.stop_time is required and must be positive")
     if not cfg.hosts:
         raise ConfigError("at least one host is required")
+    if cfg.experimental.plane_kernel not in ("xla", "pallas"):
+        raise ConfigError(
+            f"experimental.plane_kernel: expected 'xla' or 'pallas', got "
+            f"{cfg.experimental.plane_kernel!r}")
     return cfg
 
 

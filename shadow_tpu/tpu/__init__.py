@@ -32,9 +32,30 @@ def enable_compilation_cache() -> None:
     except Exception:  # pragma: no cover - knob renamed/removed upstream
         pass
 
+def donating_jit(fun=None, donate_argnums=(0,), **jit_kwargs):
+    """`jax.jit` that donates the state-pytree argument(s) so XLA aliases
+    the ~20 [N, C] state buffers in place across window dispatches instead
+    of re-materializing them. On the CPU backend (tests) donation is a
+    warning-only no-op upstream, so it is skipped there to keep test
+    output clean. DONATION CONTRACT: callers must treat the donated
+    argument as consumed — rebind the returned state and never touch the
+    input again (see docs/performance.md)."""
+    import functools
+
+    import jax
+
+    if fun is None:
+        return functools.partial(donating_jit,
+                                 donate_argnums=donate_argnums, **jit_kwargs)
+    if jax.default_backend() == "cpu":
+        return jax.jit(fun, **jit_kwargs)
+    return jax.jit(fun, donate_argnums=donate_argnums, **jit_kwargs)
+
+
 __all__ = [
     "NetPlaneParams",
     "NetPlaneState",
+    "donating_jit",
     "ingest",
     "ingest_rows",
     "make_params",

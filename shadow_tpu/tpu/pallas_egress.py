@@ -1,0 +1,195 @@
+"""Fused Pallas egress kernel: rebase -> packed-key row sort -> prefix-sum
+token gate in ONE VMEM-resident pass per host tile.
+
+The XLA egress path (plane.window_step sections 2a-2c) round-trips the
+egress columns through HBM between the rebase, the qdisc sort, and the
+token-bucket cumsum. This kernel keeps a tile of host rows resident in
+VMEM and does all three in place:
+
+- clock rebase of send times / barrier clamps (elementwise);
+- the FIFO qdisc order as a BITONIC network over each row's
+  (packed key, column index) pairs — the index tiebreak makes the
+  network's output exactly the stable sort the XLA path computes, and
+  the compare-exchange swaps carry the bytes/tsend/clamp columns along
+  so no in-kernel gather is needed;
+- the token gate as a Hillis-Steele inclusive prefix sum over the
+  sorted byte column.
+
+Scope: the FIFO qdisc only (`rr_enabled=False` — the integrated
+transport and the bench shape; the RR fairness tensors stay on the XLA
+path). Selected via `experimental.plane_kernel = "pallas"` /
+`window_step(kernel="pallas")`; default remains "xla". The kernel runs
+in interpreter mode on non-TPU backends (JAX_PLATFORMS=cpu tests), and
+`tests/test_plane_sortdiet.py` pins bitwise parity of the full window
+step against the XLA path.
+
+Mosaic note: the bitonic partner exchange is written as a static
+column-permutation gather (`a[:, cols ^ stride]`). On TPU hardware
+Mosaic may prefer this rewritten with `pltpu.roll`-based shuffles; the
+interpret path (and the parity contract) is the part this module
+guarantees.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .plane import NO_CLAMP
+
+_SIGN32 = np.uint32(0x80000000)
+
+# host rows per kernel tile: large enough to amortize dispatch, small
+# enough that the ~10 [TILE, CE] int32 buffers stay far inside VMEM
+# (~16 MB/core): 256 rows x 256 slots x 10 cols x 4 B = 2.6 MB worst case
+_TILE_ROWS = 256
+
+
+def _partner_swap(a, stride: int):
+    """a[..., i ^ stride] as pure reshapes + a static reverse — each
+    contiguous block of 2*stride columns swaps its halves. No gather, no
+    captured index constants (Mosaic/pallas-friendly)."""
+    n = a.shape[-1]
+    r = a.reshape(a.shape[:-1] + (n // (2 * stride), 2, stride))
+    return r[..., ::-1, :].reshape(a.shape)
+
+
+def _bitonic_rows(key, idx, cols, carried):
+    """Ascending bitonic sort of each row by (key, idx); the `carried`
+    arrays ride the compare-exchange swaps. Row width must be a power of
+    two; `cols` is the broadcast column iota. (key, idx) pairs are
+    distinct, so the network's output equals the STABLE sort by key —
+    bitwise the permutation the XLA diet path's `lax.sort((packed, col))`
+    produces."""
+    n = key.shape[-1]
+    assert n & (n - 1) == 0, "bitonic row sort needs a power-of-two width"
+    arrs = [key, idx, *carried]
+    size = 2
+    while size <= n:
+        stride = size // 2
+        while stride >= 1:
+            # ascending block iff bit `size` of the column index is clear;
+            # the lower-indexed element of each pair keeps the min there
+            is_left = (cols & stride) == 0
+            up = (cols & size) == 0
+            take_min = is_left == up
+            partners = [_partner_swap(a, stride) for a in arrs]
+            less = (arrs[0] < partners[0]) | (
+                (arrs[0] == partners[0]) & (arrs[1] < partners[1]))
+            keep_self = less == take_min
+            arrs = [jnp.where(keep_self, a, p)
+                    for a, p in zip(arrs, partners)]
+            stride //= 2
+        size *= 2
+    return arrs[0], arrs[1], arrs[2:]
+
+
+def _egress_kernel(shift_ref, valid_ref, prio_ref, bytes_ref, tsend_ref,
+                   clamp_ref, balance_ref, perm_ref, bytes_out_ref,
+                   tsend_out_ref, clamp_out_ref, valid_out_ref,
+                   sendable_ref, spent_ref):
+    shift = shift_ref[0]
+    valid = valid_ref[...] != 0
+    prio = prio_ref[...]
+
+    # rebase send times / clamps to this window's start
+    tsend_rb = jnp.where(valid, tsend_ref[...] - shift, 0)
+    clamp = clamp_ref[...]
+    clamp_rb = jnp.where(valid & (clamp != NO_CLAMP), clamp - shift, clamp)
+
+    # packed FIFO key: validity bit 31, priority bits 0..30 (the same
+    # _pack_valid_key layout the XLA diet path sorts by)
+    key = jnp.where(valid, jnp.uint32(0), _SIGN32) | prio.astype(jnp.uint32)
+    n = key.shape[-1]
+    col = jax.lax.broadcasted_iota(jnp.int32, key.shape, dimension=1)
+
+    key_s, perm, (bytes_s, tsend_s, clamp_s) = _bitonic_rows(
+        key, col, col, (bytes_ref[...], tsend_rb, clamp_rb))
+    valid_s = (key_s & _SIGN32) == 0
+
+    # Hillis-Steele inclusive prefix sum of the sendable byte column
+    cum = jnp.where(valid_s, bytes_s, 0)
+    shift_w = 1
+    while shift_w < n:
+        prev = jnp.concatenate(
+            [jnp.zeros_like(cum[:, :shift_w]), cum[:, :-shift_w]], axis=1)
+        cum = cum + prev
+        shift_w *= 2
+    sendable = valid_s & (cum <= balance_ref[...])
+    spent = jnp.sum(jnp.where(sendable, bytes_s, 0), axis=1, keepdims=True)
+
+    perm_ref[...] = perm
+    bytes_out_ref[...] = bytes_s
+    tsend_out_ref[...] = tsend_s
+    clamp_out_ref[...] = clamp_s
+    valid_out_ref[...] = valid_s.astype(jnp.int32)
+    sendable_ref[...] = sendable.astype(jnp.int32)
+    spent_ref[...] = spent
+
+
+def _pick_tile(n: int) -> int:
+    """Largest divisor of the host count <= _TILE_ROWS (single tile for
+    small worlds; the bench shapes are multiples of 256)."""
+    if n <= _TILE_ROWS:
+        return n
+    for t in range(_TILE_ROWS, 0, -1):
+        if n % t == 0:
+            return t
+    return n
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _egress_call(valid, prio, nbytes, tsend, clamp, balance, shift_ns,
+                 interpret: bool):
+    N, CE = valid.shape
+    T = _pick_tile(N)
+    row_spec = pl.BlockSpec((T, CE), lambda i: (i, 0))
+    col_spec = pl.BlockSpec((T, 1), lambda i: (i, 0))
+    out = pl.pallas_call(
+        _egress_kernel,
+        grid=(N // T,),
+        in_specs=[
+            pl.BlockSpec((1,), lambda i: (0,)),  # shift scalar
+            row_spec, row_spec, row_spec, row_spec, row_spec,  # egress cols
+            col_spec,  # balance [N, 1]
+        ],
+        out_specs=[row_spec] * 6 + [col_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, CE), jnp.int32),  # perm
+            jax.ShapeDtypeStruct((N, CE), jnp.int32),  # bytes sorted
+            jax.ShapeDtypeStruct((N, CE), jnp.int32),  # tsend rebased+sorted
+            jax.ShapeDtypeStruct((N, CE), jnp.int32),  # clamp rebased+sorted
+            jax.ShapeDtypeStruct((N, CE), jnp.int32),  # valid sorted
+            jax.ShapeDtypeStruct((N, CE), jnp.int32),  # sendable
+            jax.ShapeDtypeStruct((N, 1), jnp.int32),  # spent per host
+        ],
+        interpret=interpret,
+    )(shift_ns.reshape(1), valid.astype(jnp.int32), prio, nbytes, tsend,
+      clamp, balance.reshape(N, 1))
+    return out
+
+
+def egress_order_gate(valid, prio, nbytes, tsend, clamp, balance, shift_ns):
+    """The fused egress stage: returns (perm, bytes_s, tsend_s, clamp_s,
+    valid_s, sendable, spent) — the sorted byte/time columns plus the
+    permutation to apply to the remaining payload columns, bitwise equal
+    to the XLA diet path's `_egress_order` + `_token_gate` outputs for
+    FIFO rows."""
+    if (valid.shape[1] & (valid.shape[1] - 1)) != 0:
+        raise ValueError(
+            f"plane_kernel='pallas' needs a power-of-two egress capacity, "
+            f"got {valid.shape[1]}; use the XLA kernel or pad egress_cap")
+    interpret = jax.default_backend() != "tpu"
+    shift_arr = jnp.asarray(shift_ns, jnp.int32)
+    (perm, bytes_s, tsend_s, clamp_s, valid_s, sendable,
+     spent) = _egress_call(valid, prio, jnp.asarray(nbytes, jnp.int32),
+                           jnp.asarray(tsend, jnp.int32),
+                           jnp.asarray(clamp, jnp.int32),
+                           jnp.asarray(balance, jnp.int32), shift_arr,
+                           interpret)
+    return (perm, bytes_s, tsend_s, clamp_s, valid_s != 0, sendable != 0,
+            spent[:, 0])

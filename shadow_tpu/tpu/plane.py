@@ -209,6 +209,77 @@ def _row_sort(*arrays, keys: int):
     return jax.lax.sort(arrays, dimension=1, is_stable=True, num_keys=keys)
 
 
+# --- packed sort keys (the "sort diet") ------------------------------------
+# The window step's row sorts used to push every payload column through the
+# lax.sort comparator network (12 arrays for the egress qdisc sort). The
+# packed-key forms below fuse the (validity, key) pair into ONE uint32 key,
+# sort (key, column-index), and apply the resulting permutation to the
+# payload columns with take_along_axis — the sorting network then carries 2-3
+# arrays instead of 7-12. Flat cross-host sorts (routing, flat ingest) KEEP
+# the variadic form: their permutations are arbitrary global gathers, which
+# are DMA-bound on TPU (~0.5 ms per column at 65k slots on a v5e), while a
+# row-sort permutation only moves values within a C-wide row.
+
+_SIGN32 = np.uint32(0x80000000)
+_U32_MAX = np.uint32(0xFFFFFFFF)
+
+
+def _assert_bit_budget(*fields):
+    """Trace-time guard: the named (bits, what) fields must fit a single
+    32-bit packed sort key. Raises at trace time (shapes and capacities
+    are static), never at runtime."""
+    total = sum(bits for bits, _ in fields)
+    if total > 32:
+        raise ValueError(
+            "packed sort key bit-budget overflow: "
+            + " + ".join(f"{what}={bits}b" for bits, what in fields)
+            + f" = {total} bits > 32")
+
+
+def _pack_valid_key(valid, key, *, what="qdisc key"):
+    """Fuse (invalid-last, key) into one uint32 sort key: validity in bit
+    31, the int32 key in bits 0..30. Exactly order-isomorphic to sorting
+    by the (~valid, key) pair as long as keys are non-negative — the
+    plane's priority / RR-key domain (monotone counters from 0, I32_MAX
+    sentinels). Bit budget (1 validity + 31 key) asserted at trace time."""
+    _assert_bit_budget((1, "validity"), (31, what))
+    return jnp.where(valid, jnp.uint32(0), _SIGN32) | key.astype(jnp.uint32)
+
+
+def _pack_time_key(valid, t):
+    """Fuse (invalid-last, time) into one uint32 key for FULL-RANGE int32
+    times (deliver offsets can be legitimately negative after a window
+    rebase): sign-bias the time into unsigned order, invalid slots take
+    the all-ones key. Exact for any valid time < I32_MAX — the invalid
+    sentinel, unreachable for real deliveries under the int32-ns window
+    budget (path latency + window < ~2.1 s)."""
+    return jnp.where(valid, t.astype(jnp.uint32) ^ _SIGN32, _U32_MAX)
+
+
+def _pack_rank_key(valid, rank, width: int):
+    """Fuse (invalid-last, column-rank) into one uint32 key; `width` is
+    the static column count, so the rank field's bit budget is checked at
+    trace time against the capacities that determine it. Used where the
+    ONLY ordering requirement is valid-first-in-original-order (the
+    ingest_rows merge): the sort then carries a single array and the
+    permutation is recovered from the key's low bits."""
+    rank_bits = max(int(width - 1).bit_length(), 1)
+    _assert_bit_budget((1, "validity"), (rank_bits, f"rank[{width}]"))
+    return jnp.where(valid, jnp.uint32(0), _SIGN32) | rank.astype(jnp.uint32)
+
+
+def _row_perm_sort(packed, *extra_keys):
+    """Stable row sort of (packed uint32 key [, extra keys]); returns the
+    permutation [N, C] to apply to payload columns via take_along_axis.
+    Stability makes the carried column index break ties in original
+    order, exactly like the variadic stable sort it replaces."""
+    N, C = packed.shape
+    col = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32), (N, C))
+    out = jax.lax.sort((packed, *extra_keys, col), dimension=1,
+                       is_stable=True, num_keys=1 + len(extra_keys))
+    return out[-1]
+
+
 def _pkt_uniform(rng_root: jax.Array, host: jax.Array,
                  counter: jax.Array) -> jax.Array:
     """Counter-based uniform [0,1) draw per (host, counter) slot.
@@ -414,12 +485,23 @@ def ingest_rows(state: NetPlaneState, dst: jax.Array, nbytes: jax.Array,
                 prio: jax.Array, seq: jax.Array, ctrl: jax.Array,
                 valid: jax.Array, send_rel: jax.Array | None = None,
                 clamp_rel: jax.Array | None = None,
-                sock: jax.Array | None = None) -> NetPlaneState:
+                sock: jax.Array | None = None, *,
+                packed_sort: bool = True,
+                gate_idle: bool = True) -> NetPlaneState:
     """Append per-host batches ([N, K] arrays, row = emitting host) to the
     egress queues. The row-shaped twin of `ingest` for producers that are
     already host-major (on-device respawn loops, per-host socket emitters):
     no flat cross-host sort is needed — one row-wise merge sort appends
-    each row's valid entries after the existing ones, in column order."""
+    each row's valid entries after the existing ones, in column order.
+
+    `packed_sort` (static) selects the single-key merge: validity + column
+    rank packed into one uint32, ONE array through the sorting network, and
+    the payload columns permuted by the recovered rank — vs the 10-array
+    variadic merge it replaces (kept as the reference path for the parity
+    tests). `gate_idle` wraps the merge in a `lax.cond` on "any new valid
+    entries", so windows that produce nothing pay one reduction instead of
+    a full merge sort; both are bitwise no-ops on the result (rows are
+    front-packed, so an entry-free merge is the identity)."""
     N, CE = state.eg_dst.shape
     if send_rel is None:
         send_rel = jnp.zeros_like(seq)
@@ -429,73 +511,77 @@ def ingest_rows(state: NetPlaneState, dst: jax.Array, nbytes: jax.Array,
         sock = jnp.zeros_like(seq)
 
     cat = lambda a, b: jnp.concatenate([a, b], axis=1)
-    inv = (~cat(state.eg_valid, valid)).astype(jnp.int32)
-    # stable sort by validity alone: existing entries (columns < CE, front-
-    # packed) stay ahead of the new ones, new entries keep column order
-    (_, dst_m, bytes_m, prio_m, seq_m, ctrl_m, tsend_m, clamp_m, sock_m,
-     valid_m) = _row_sort(
-        inv, cat(state.eg_dst, dst), cat(state.eg_bytes, nbytes),
-        cat(state.eg_prio, prio), cat(state.eg_seq, seq),
-        cat(state.eg_ctrl, ctrl), cat(state.eg_tsend, send_rel),
-        cat(state.eg_clamp, clamp_rel), cat(state.eg_sock, sock),
-        cat(state.eg_valid, valid), keys=1,
-    )
-    overflow = jnp.maximum(
-        valid_m.sum(axis=1, dtype=jnp.int32) - CE, 0)
-    return state._replace(
-        eg_dst=dst_m[:, :CE], eg_bytes=bytes_m[:, :CE],
-        eg_prio=prio_m[:, :CE], eg_seq=seq_m[:, :CE],
-        eg_ctrl=ctrl_m[:, :CE], eg_tsend=tsend_m[:, :CE],
-        eg_clamp=clamp_m[:, :CE], eg_sock=sock_m[:, :CE],
-        eg_valid=valid_m[:, :CE],
-        n_overflow_dropped=state.n_overflow_dropped + overflow,
-    )
+
+    def merge(state: NetPlaneState) -> NetPlaneState:
+        valid_all = cat(state.eg_valid, valid)
+        W = valid_all.shape[1]
+        if packed_sort:
+            # stable valid-first order == sort by (validity, column rank);
+            # the rank rides in the key's low bits, so the single sorted
+            # array IS the permutation
+            rank = jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32), (N, W))
+            key = jax.lax.sort(_pack_rank_key(valid_all, rank, W),
+                               dimension=1, is_stable=True)
+            perm = (key & jnp.uint32(0x7FFFFFFF)).astype(jnp.int32)[:, :CE]
+            take = lambda a, b: jnp.take_along_axis(cat(a, b), perm, axis=1)
+            dst_m = take(state.eg_dst, dst)
+            bytes_m = take(state.eg_bytes, nbytes)
+            prio_m = take(state.eg_prio, prio)
+            seq_m = take(state.eg_seq, seq)
+            ctrl_m = take(state.eg_ctrl, ctrl)
+            tsend_m = take(state.eg_tsend, send_rel)
+            clamp_m = take(state.eg_clamp, clamp_rel)
+            sock_m = take(state.eg_sock, sock)
+            valid_m = take(state.eg_valid, valid)
+            overflow = jnp.maximum(
+                valid_all.sum(axis=1, dtype=jnp.int32) - CE, 0)
+        else:
+            inv = (~valid_all).astype(jnp.int32)
+            # stable sort by validity alone: existing entries (columns
+            # < CE, front-packed) stay ahead of the new ones, new entries
+            # keep column order
+            (_, dst_f, bytes_f, prio_f, seq_f, ctrl_f, tsend_f, clamp_f,
+             sock_f, valid_f) = _row_sort(
+                inv, cat(state.eg_dst, dst), cat(state.eg_bytes, nbytes),
+                cat(state.eg_prio, prio), cat(state.eg_seq, seq),
+                cat(state.eg_ctrl, ctrl), cat(state.eg_tsend, send_rel),
+                cat(state.eg_clamp, clamp_rel), cat(state.eg_sock, sock),
+                valid_all, keys=1,
+            )
+            overflow = jnp.maximum(
+                valid_f.sum(axis=1, dtype=jnp.int32) - CE, 0)
+            dst_m, bytes_m, prio_m = dst_f[:, :CE], bytes_f[:, :CE], \
+                prio_f[:, :CE]
+            seq_m, ctrl_m, tsend_m = seq_f[:, :CE], ctrl_f[:, :CE], \
+                tsend_f[:, :CE]
+            clamp_m, sock_m, valid_m = clamp_f[:, :CE], sock_f[:, :CE], \
+                valid_f[:, :CE]
+        return state._replace(
+            eg_dst=dst_m, eg_bytes=bytes_m, eg_prio=prio_m, eg_seq=seq_m,
+            eg_ctrl=ctrl_m, eg_tsend=tsend_m, eg_clamp=clamp_m,
+            eg_sock=sock_m, eg_valid=valid_m,
+            n_overflow_dropped=state.n_overflow_dropped + overflow,
+        )
+
+    if not gate_idle:
+        return merge(state)
+    return jax.lax.cond(valid.any(), merge, lambda st: st, state)
 
 
-def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Array,
-                shift_ns: jax.Array, window_ns: jax.Array, *,
-                rr_enabled: bool = True, router_aqm: bool = False,
-                no_loss: bool = False):
-    """Advance one scheduling round [t, t + window_ns).
+# ---------------------------------------------------------------------------
+# window_step sections. Each stage of the per-window pipeline is a named
+# helper so (a) the profiler (`tpu/profiling.py`) can time every section in
+# isolation with realistic inputs and (b) alternative kernels (the packed
+# sort diet, the Pallas egress fusion) swap in per-section without touching
+# the rest of the pipeline. window_step composes them; the section
+# boundaries are exactly the numbered comments the monolithic body used.
+# ---------------------------------------------------------------------------
 
-    `rr_enabled` is a static (trace-time) switch: False compiles the
-    FIFO-only qdisc without the RR rank/one-hot tensors — use it when no
-    host configures round-robin (e.g. the integrated DeviceTransport,
-    where the CPU NIC owns qdisc ordering). The RR path materializes
-    [N, CE, CE] pairwise tensors, which DOMINATE the per-window cost
-    whenever N < CE^2; callers with all-FIFO configs should pass False.
 
-    `router_aqm` (static) switches the destination side from direct
-    due-release to the full inbound pipeline (`host.rs:810-865`): router
-    CoDel -> down-bandwidth relay -> delivery, via the fused micro-step
-    kernel in `tpu.codel.router_drain`. In this mode a packet's stored
-    time is its ARRIVAL at the destination router; delivery happens when
-    the relay forwards it (same instant when tokens allow, later when the
-    down-bw bucket or CoDel interferes), and CoDel may drop it instead
-    (counted in state.router.dropped). The CPU relay's bootstrap-period
-    rate-limit bypass is not modeled on device.
-
-    `no_loss` (static) compiles out the loss draw + loss-table gather for
-    callers whose loss matrix is all zero (the integrated DeviceTransport,
-    where the CPU drew loss at capture). rng_counter still advances so
-    state stays bitwise-comparable with a loss-enabled run.
-
-    `shift_ns` = this window's start minus the previous window's start;
-    stored relative times are rebased by it. Returns
-    (state', delivered, next_event_rel) where `delivered` is a dict of
-    [N, CI] arrays masked by delivered['mask'] (packets that arrived within
-    this window, in deterministic (deliver_t, src, seq) order per host) and
-    `next_event_rel` is the min pending delivery time relative to the new
-    window start (INT32_MAX when idle).
-    """
-    N, CE = state.eg_dst.shape
-    CI = state.in_src.shape[1]
-
-    # --- 1. rebase clocks + refill token buckets -----------------------
-    in_deliver = jnp.where(state.in_valid, state.in_deliver_rel - shift_ns,
-                           I32_MAX)
-    # lazy 1ms-interval refill (`relay/token_bucket.rs`); the sub-ms
-    # remainder carries across rounds so short windows don't leak bandwidth
+def _refill_tokens(state: NetPlaneState, params: NetPlaneParams, shift_ns):
+    """Section 1b: lazy 1ms-interval token refill (`relay/token_bucket.rs`);
+    the sub-ms remainder carries across rounds so short windows don't leak
+    bandwidth. Returns (balance, tb_rem_ns)."""
     rem_total = state.tb_rem_ns + (shift_ns % 1_000_000)
     elapsed_ms = (shift_ns // 1_000_000) + (rem_total // 1_000_000)
     tb_rem_ns = rem_total % 1_000_000
@@ -510,74 +596,101 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     balance = jnp.minimum(
         state.tb_balance + params.tb_rate * elapsed_eff, params.tb_cap
     )
-    rt = codel.rebase_router_state(state.router, shift_ns, params.dn_rate,
-                                   params.dn_cap)
+    return balance, tb_rem_ns
 
-    # --- 2. egress: qdisc order, token-bucket gate ----------------------
-    # Two qdiscs (`network_interface.c:205-303`, `QDiscMode`): FIFO sends
-    # valid-first by ascending packet priority; round-robin interleaves
-    # emitting sockets, taking one packet from each in turn (FIFO within a
-    # socket by per-source seq, which is monotone in emission order). The
-    # RR key is each slot's rank among same-socket slots, a [N, CE, CE]
-    # pairwise count — the dominant per-window cost when N < CE^2, which
-    # is why all-FIFO callers should compile with rr_enabled=False.
-    # Send times / clamps of leftover packets were taken relative to the
-    # window they were ingested in; rebase them too.
-    eg_tsend_rb = jnp.where(state.eg_valid, state.eg_tsend - shift_ns, 0)
-    eg_clamp_rb = jnp.where(
-        state.eg_valid & (state.eg_clamp != NO_CLAMP),
-        state.eg_clamp - shift_ns, state.eg_clamp,
-    )
+
+def _qdisc_keys(state: NetPlaneState, params: NetPlaneParams, *,
+                rr_enabled: bool):
+    """Section 2a: per-slot qdisc sort keys. FIFO = packet priority;
+    round-robin = virtual-finish counter per socket slot (the [N, CE, CE]
+    pairwise rank tensors — the dominant per-window cost when N < CE^2,
+    which is why all-FIFO callers compile with rr_enabled=False). Returns
+    (qkey1, qkey2, rr_aux) with rr_aux = (rr_base, vtime) bookkeeping the
+    RR advance needs later (None when rr_enabled=False)."""
+    if not rr_enabled:
+        return state.eg_prio, jnp.zeros_like(state.eg_sock), None
+    S = RR_SOCK_SLOTS
+    sock_slot = jnp.where(state.eg_valid, state.eg_sock % S, S - 1)
+    # active sockets re-join at the current virtual time (start-time
+    # fair queuing floor) so a returning socket gets its fair turn, not
+    # a burst; rows with nothing queued reset to 0 (counters only mean
+    # anything relative to each other, and the rebase below keeps every
+    # value within ~CE of zero, so int32 never wraps)
+    slot_onehot = sock_slot[:, :, None] == jnp.arange(S, dtype=jnp.int32)
+    active = (slot_onehot & state.eg_valid[:, :, None]).any(axis=1)
+    vtime = jnp.where(active, state.rr_sent, I32_MAX).min(axis=1)  # [N]
+    vtime = jnp.where(active.any(axis=1), vtime, 0)
+    rr_base = jnp.maximum(state.rr_sent, vtime[:, None])  # [N, S]
+    same_sock = sock_slot[:, :, None] == sock_slot[:, None, :]
+    both_valid = state.eg_valid[:, :, None] & state.eg_valid[:, None, :]
+    earlier = state.eg_seq[:, None, :] < state.eg_seq[:, :, None]
+    rr_rank = jnp.sum(same_sock & both_valid & earlier, axis=2,
+                      dtype=jnp.int32)
+    rr_key = jnp.take_along_axis(rr_base, sock_slot, axis=1) + rr_rank
+    rr_mode = params.qdisc_rr[:, None]
+    qkey1 = jnp.where(rr_mode, rr_key, state.eg_prio)
+    qkey2 = jnp.where(rr_mode, state.eg_sock, 0)
+    return qkey1, qkey2, (rr_base, vtime)
+
+
+def _egress_order(state: NetPlaneState, qkey1, qkey2, eg_tsend_rb,
+                  eg_clamp_rb, *, rr_enabled: bool, packed_sort: bool):
+    """Section 2b: the qdisc sort. Orders each egress row valid-first by
+    (qkey1, qkey2). Packed form: ONE uint32 (validity | qkey1) key plus —
+    only under RR, where socket ids break rr-key ties — qkey2, with the
+    payload columns permuted afterwards; vs the 12-array variadic sort
+    (kept as the parity-reference path). Returns the 9 sorted columns
+    (prio, sock, dst, bytes, seq, ctrl, tsend, clamp, valid)."""
+    if packed_sort:
+        packed = _pack_valid_key(state.eg_valid, qkey1)
+        extra = (qkey2,) if rr_enabled else ()
+        perm = _row_perm_sort(packed, *extra)
+        take = lambda a: jnp.take_along_axis(a, perm, axis=1)
+        return (take(state.eg_prio), take(state.eg_sock),
+                take(state.eg_dst), take(state.eg_bytes),
+                take(state.eg_seq), take(state.eg_ctrl),
+                take(eg_tsend_rb), take(eg_clamp_rb), take(state.eg_valid))
     inv = (~state.eg_valid).astype(jnp.int32)
-    if rr_enabled:
-        S = RR_SOCK_SLOTS
-        sock_slot = jnp.where(state.eg_valid, state.eg_sock % S, S - 1)
-        # active sockets re-join at the current virtual time (start-time
-        # fair queuing floor) so a returning socket gets its fair turn, not
-        # a burst; rows with nothing queued reset to 0 (counters only mean
-        # anything relative to each other, and the rebase below keeps every
-        # value within ~CE of zero, so int32 never wraps)
-        slot_onehot = sock_slot[:, :, None] == jnp.arange(S, dtype=jnp.int32)
-        active = (slot_onehot & state.eg_valid[:, :, None]).any(axis=1)
-        vtime = jnp.where(active, state.rr_sent, I32_MAX).min(axis=1)  # [N]
-        vtime = jnp.where(active.any(axis=1), vtime, 0)
-        rr_base = jnp.maximum(state.rr_sent, vtime[:, None])  # [N, S]
-        same_sock = sock_slot[:, :, None] == sock_slot[:, None, :]
-        both_valid = state.eg_valid[:, :, None] & state.eg_valid[:, None, :]
-        earlier = state.eg_seq[:, None, :] < state.eg_seq[:, :, None]
-        rr_rank = jnp.sum(same_sock & both_valid & earlier, axis=2,
-                          dtype=jnp.int32)
-        rr_key = jnp.take_along_axis(rr_base, sock_slot, axis=1) + rr_rank
-        rr_mode = params.qdisc_rr[:, None]
-        qkey1 = jnp.where(rr_mode, rr_key, state.eg_prio)
-        qkey2 = jnp.where(rr_mode, state.eg_sock, 0)
-    else:
-        qkey1, qkey2 = state.eg_prio, jnp.zeros_like(state.eg_sock)
-    (eg_inv, _, _, eg_prio, eg_sock, eg_dst, eg_bytes, eg_seq, eg_ctrl,
+    (_, _, _, eg_prio, eg_sock, eg_dst, eg_bytes, eg_seq, eg_ctrl,
      eg_tsend, eg_clamp, eg_valid) = _row_sort(
         inv, qkey1, qkey2, state.eg_prio, state.eg_sock, state.eg_dst,
         state.eg_bytes, state.eg_seq, state.eg_ctrl, eg_tsend_rb,
         eg_clamp_rb, state.eg_valid, keys=3,
     )
+    return (eg_prio, eg_sock, eg_dst, eg_bytes, eg_seq, eg_ctrl, eg_tsend,
+            eg_clamp, eg_valid)
+
+
+def _token_gate(eg_valid, eg_bytes, balance):
+    """Section 2c: prefix-sum token-bucket gate over the sorted egress.
+    Returns (sendable, balance_after)."""
     cum = jnp.cumsum(jnp.where(eg_valid, eg_bytes, 0), axis=1)
     sendable = eg_valid & (cum <= balance[:, None])
     spent = jnp.where(sendable, eg_bytes, 0).sum(axis=1)
-    balance = balance - spent
-    if rr_enabled:
-        # advance virtual finish by packets pushed through, then rebase to
-        # the floor so counters stay bounded (per the dtype discipline)
-        sent_slot = jnp.where(eg_valid, eg_sock % S, S - 1)
-        sent_per_sock = jnp.sum(
-            (sent_slot[:, :, None] == jnp.arange(S, dtype=jnp.int32))
-            & sendable[:, :, None], axis=1, dtype=jnp.int32)
-        rr_sent = rr_base - vtime[:, None] + sent_per_sock
-    else:
-        rr_sent = state.rr_sent
+    return sendable, balance - spent
 
-    # --- 3. loss sampling + latency lookup ------------------------------
-    # node-level tables: host -> node (VMEM-resident [N]) then the [M, M]
-    # path matrices — vs a [N, N] host-pair gather whose per-element HBM
-    # cost dominated the step at 4k+ hosts
+
+def _rr_advance(eg_sock, eg_valid, sendable, rr_aux):
+    """Section 2d: advance the RR virtual-finish counters by packets
+    pushed through, then rebase to the floor so counters stay bounded
+    (per the dtype discipline)."""
+    S = RR_SOCK_SLOTS
+    rr_base, vtime = rr_aux
+    sent_slot = jnp.where(eg_valid, eg_sock % S, S - 1)
+    sent_per_sock = jnp.sum(
+        (sent_slot[:, :, None] == jnp.arange(S, dtype=jnp.int32))
+        & sendable[:, :, None], axis=1, dtype=jnp.int32)
+    return rr_base - vtime[:, None] + sent_per_sock
+
+
+def _loss_latency(state: NetPlaneState, params: NetPlaneParams, rng_root,
+                  eg_dst, eg_ctrl, eg_tsend, eg_clamp, sendable, window_ns,
+                  *, no_loss: bool):
+    """Section 3: Bernoulli path-loss draw + latency lookup through the
+    node-level tables (host -> node, then the [M, M] path matrices — vs a
+    [N, N] host-pair gather whose per-element HBM cost dominated the step
+    at 4k+ hosts). Returns (sent, lost, rng_counter', deliver_rel)."""
+    N, CE = eg_dst.shape
     host_idx = jnp.arange(N, dtype=jnp.int32)[:, None]
     dst_clipped = jnp.clip(eg_dst, 0, N - 1)
     node_src = params.host_node[:, None]  # [N, 1]
@@ -604,26 +717,50 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     # end" (pure-device mode, where ingest and step share the window)
     clamp_eff = jnp.where(eg_clamp == NO_CLAMP, window_ns, eg_clamp)
     deliver_rel = jnp.maximum(eg_tsend + latency, clamp_eff)
+    return sent, lost, rng_counter, deliver_rel
 
-    # egress queue keeps only what didn't go out (compacted after routing,
-    # which still indexes this ordering)
-    eg_valid_left = eg_valid & ~sendable
 
-    # --- 4. compact surviving ingress (front-packed for the scatter) -----
-    inv_in = (~state.in_valid).astype(jnp.int32)
+def _compact_ingress(state: NetPlaneState, in_deliver, *, packed_sort: bool):
+    """Section 4: compact surviving ingress, front-packed by deliver time
+    for the scatter. Packed form: one uint32 (validity | sign-biased
+    deliver) key + permutation; reference form: the 7-array variadic sort.
+    Returns (deliver_c, src_c, seq_c, sock_c, bytes_c, valid_c,
+    n_valid_in)."""
     key_deliver = jnp.where(state.in_valid, in_deliver, I32_MAX)
-    (_, in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
-     in_valid_c) = _row_sort(
-        inv_in, key_deliver, state.in_src, state.in_seq, state.in_sock,
-        state.in_bytes, state.in_valid, keys=2,
-    )
+    if packed_sort:
+        perm = _row_perm_sort(_pack_time_key(state.in_valid, key_deliver))
+        take = lambda a: jnp.take_along_axis(a, perm, axis=1)
+        in_deliver_c, in_src_c = take(key_deliver), take(state.in_src)
+        in_seq_c, in_sock_c = take(state.in_seq), take(state.in_sock)
+        in_bytes_c, in_valid_c = take(state.in_bytes), take(state.in_valid)
+    else:
+        inv_in = (~state.in_valid).astype(jnp.int32)
+        (_, in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
+         in_valid_c) = _row_sort(
+            inv_in, key_deliver, state.in_src, state.in_seq, state.in_sock,
+            state.in_bytes, state.in_valid, keys=2,
+        )
     n_valid_in = in_valid_c.sum(axis=1).astype(jnp.int32)  # [N]
+    return (in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
+            in_valid_c, n_valid_in)
 
-    # --- 5. route sent packets into destination ingress queues ----------
-    # This happens BEFORE the due check so a packet whose deliver time
-    # falls inside this window (integrated transport: sent last round,
-    # clamped to this window's start) is released THIS round, matching the
-    # CPU plane's push-then-execute ordering.
+
+def _route_scatter(sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel,
+                   in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
+                   in_valid_c, n_valid_in, *, packed_sort: bool = True):
+    """Section 5: route sent packets into destination ingress queues —
+    one flat variadic sort for deterministic per-destination insertion
+    order, then the grouped scatter-append. (Flat sorts stay variadic:
+    applying a flat permutation with per-column gathers costs ~0.5 ms per
+    column at 65k slots on TPU — arbitrary-index gathers are DMA-bound.)
+    The packed form drops the `sent` column from the sort: it is fully
+    recoverable as ``o_dst < N`` (non-sent slots were routed to the
+    sentinel dst N; a hypothetical sent packet with an out-of-range dst
+    lands in the same not-placeable bucket on both paths). Returns the
+    merged ingress columns + per-host overflow."""
+    N, CE = eg_dst.shape
+    CI = in_src_c.shape[1]
+    host_idx = jnp.arange(N, dtype=jnp.int32)[:, None]
     flat_sent = sent.reshape(-1)
     flat_dst = jnp.where(flat_sent, eg_dst.reshape(-1), N)  # N = "nowhere"
     flat_deliver = deliver_rel.reshape(-1)
@@ -632,19 +769,26 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     flat_bytes = eg_bytes.reshape(-1)
     flat_sock = eg_sock.reshape(-1)
 
-    # deterministic insertion order per destination: ONE variadic sort
-    # moves the payload columns through the sorting network — applying a
-    # lexsort permutation with per-column gathers costs ~0.5 ms per
-    # column at 65k slots on TPU (arbitrary-index gathers are DMA-bound)
-    (o_dst, o_deliver, o_src, o_seq, o_bytes, o_sock, o_sent) = jax.lax.sort(
-        (flat_dst, flat_deliver, flat_src, flat_seq, flat_bytes, flat_sock,
-         flat_sent),
-        dimension=0, is_stable=True, num_keys=4,
-    )
-    flat_idx, ok, overflowed = _scatter_append(o_dst, o_sent, n_valid_in, CI, N)
+    if packed_sort:
+        (o_dst, o_deliver, o_src, o_seq, o_bytes, o_sock) = jax.lax.sort(
+            (flat_dst, flat_deliver, flat_src, flat_seq, flat_bytes,
+             flat_sock),
+            dimension=0, is_stable=True, num_keys=4,
+        )
+        o_sent = o_dst < N
+    else:
+        (o_dst, o_deliver, o_src, o_seq, o_bytes, o_sock,
+         o_sent) = jax.lax.sort(
+            (flat_dst, flat_deliver, flat_src, flat_seq, flat_bytes,
+             flat_sock, flat_sent),
+            dimension=0, is_stable=True, num_keys=4,
+        )
+    flat_idx, ok, overflowed = _scatter_append(o_dst, o_sent, n_valid_in,
+                                               CI, N)
 
     def scatter(buf, vals):
-        return buf.reshape(-1).at[flat_idx].set(vals, mode="drop").reshape(N, CI)
+        return buf.reshape(-1).at[flat_idx].set(
+            vals, mode="drop").reshape(N, CI)
 
     in_src_m = scatter(in_src_c, o_src)
     in_seq_m = scatter(in_seq_c, o_seq)
@@ -656,6 +800,193 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     # non-ok slots carry an out-of-bounds flat_idx, so only accepted
     # arrivals flip their slot valid
     in_valid_m = scatter(in_valid_c, jnp.ones_like(ok))
+    return (in_src_m, in_seq_m, in_sock_m, in_bytes_m, in_deliver_m,
+            in_valid_m, overflowed)
+
+
+def _release_due(in_deliver_m, in_src_m, in_seq_m, in_sock_m, in_bytes_m,
+                 in_valid_m, window_ns, *, packed_sort: bool = True):
+    """Section 5b (direct mode): split the merged ingress into this
+    window's due deliveries and the surviving queue. One sort serves both:
+    not-due first keyed by deliver time keeps the survivors front-packed;
+    the due block lands at the row tail in deterministic (deliver_t, src,
+    seq) presentation order. The packed form carries the column index
+    through the 4-key sort instead of the 4 payload columns and permutes
+    them afterwards. Returns (delivered dict, due, surviving ingress
+    columns)."""
+    in_deliver_key = jnp.where(in_valid_m, in_deliver_m, I32_MAX)
+    due = in_valid_m & (in_deliver_key < window_ns)
+    is_due = due.astype(jnp.int32)
+    if packed_sort:
+        N, CI = due.shape
+        col = jnp.broadcast_to(jnp.arange(CI, dtype=jnp.int32), (N, CI))
+        (_, d_t, d_src, d_seq, perm) = jax.lax.sort(
+            (is_due, in_deliver_key, in_src_m, in_seq_m, col),
+            dimension=1, is_stable=True, num_keys=4,
+        )
+        take = lambda a: jnp.take_along_axis(a, perm, axis=1)
+        d_sock, d_bytes = take(in_sock_m), take(in_bytes_m)
+        d_due, d_valid = take(due), take(in_valid_m)
+    else:
+        (_, d_t, d_src, d_seq, d_sock, d_bytes, d_due,
+         d_valid) = _row_sort(
+            is_due, in_deliver_key, in_src_m,
+            in_seq_m, in_sock_m, in_bytes_m, due, in_valid_m, keys=4,
+        )
+    delivered = {
+        "mask": d_due, "src": d_src, "seq": d_seq, "sock": d_sock,
+        "bytes": d_bytes, "deliver_rel": d_t,
+    }
+    in_valid_new = d_valid & ~d_due
+    in_deliver_new = jnp.where(in_valid_new, d_t, I32_MAX)
+    return (delivered, due, in_deliver_new, d_src, d_seq, d_sock, d_bytes,
+            in_valid_new)
+
+
+def _compact_egress(eg_prio, eg_dst, eg_bytes, eg_seq, eg_ctrl, eg_tsend,
+                    eg_clamp, eg_sock, eg_valid_left, *, packed_sort: bool):
+    """Section 6: compact leftover egress so rows stay front-packed for
+    ingest. Packed form: one uint32 (validity | priority-sentinel) key +
+    permutation; reference form: the 10-array variadic sort."""
+    eg_prio_left = jnp.where(eg_valid_left, eg_prio, I32_MAX)
+    if packed_sort:
+        perm = _row_perm_sort(_pack_time_key(eg_valid_left, eg_prio_left))
+        take = lambda a: jnp.take_along_axis(a, perm, axis=1)
+        return (take(eg_prio_left), take(eg_dst), take(eg_bytes),
+                take(eg_seq), take(eg_ctrl), take(eg_tsend),
+                take(eg_clamp), take(eg_sock), take(eg_valid_left))
+    (_, eg_prio_c, eg_dst_c, eg_bytes_c, eg_seq_c, eg_ctrl_c, eg_tsend_c,
+     eg_clamp_c, eg_sock_c, eg_valid_c) = _row_sort(
+        (~eg_valid_left).astype(jnp.int32), eg_prio_left, eg_dst, eg_bytes,
+        eg_seq, eg_ctrl, eg_tsend, eg_clamp, eg_sock, eg_valid_left, keys=2,
+    )
+    return (eg_prio_c, eg_dst_c, eg_bytes_c, eg_seq_c, eg_ctrl_c,
+            eg_tsend_c, eg_clamp_c, eg_sock_c, eg_valid_c)
+
+
+def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Array,
+                shift_ns: jax.Array, window_ns: jax.Array, *,
+                rr_enabled: bool = True, router_aqm: bool = False,
+                no_loss: bool = False, packed_sort: bool = True,
+                kernel: str = "xla"):
+    """Advance one scheduling round [t, t + window_ns).
+
+    `rr_enabled` is a static (trace-time) switch: False compiles the
+    FIFO-only qdisc without the RR rank/one-hot tensors — use it when no
+    host configures round-robin (e.g. the integrated DeviceTransport,
+    where the CPU NIC owns qdisc ordering). The RR path materializes
+    [N, CE, CE] pairwise tensors, which DOMINATE the per-window cost
+    whenever N < CE^2; callers with all-FIFO configs should pass False.
+
+    `router_aqm` (static) switches the destination side from direct
+    due-release to the full inbound pipeline (`host.rs:810-865`): router
+    CoDel -> down-bandwidth relay -> delivery, via the fused micro-step
+    kernel in `tpu.codel.router_drain`. In this mode a packet's stored
+    time is its ARRIVAL at the destination router; delivery happens when
+    the relay forwards it (same instant when tokens allow, later when the
+    down-bw bucket or CoDel interferes), and CoDel may drop it instead
+    (counted in state.router.dropped). The CPU relay's bootstrap-period
+    rate-limit bypass is not modeled on device.
+
+    `no_loss` (static) compiles out the loss draw + loss-table gather for
+    callers whose loss matrix is all zero (the integrated DeviceTransport,
+    where the CPU drew loss at capture). rng_counter still advances so
+    state stays bitwise-comparable with a loss-enabled run.
+
+    `packed_sort` (static) selects the packed-key sort diet for the row
+    sorts (sections 2b, 4, 6) — bitwise-identical ordering, far fewer
+    arrays through the comparator networks; False compiles the original
+    variadic sorts (the parity-test reference). `kernel` (static) picks
+    the egress-ordering implementation: "xla" (default) or "pallas" — the
+    fused VMEM-resident Pallas kernel (`tpu.pallas_egress`), FIFO-only
+    (requires rr_enabled=False), bitwise-identical to the XLA path.
+
+    `shift_ns` = this window's start minus the previous window's start;
+    stored relative times are rebased by it. Returns
+    (state', delivered, next_event_rel) where `delivered` is a dict of
+    [N, CI] arrays masked by delivered['mask'] (packets that arrived within
+    this window, in deterministic (deliver_t, src, seq) order per host) and
+    `next_event_rel` is the min pending delivery time relative to the new
+    window start (INT32_MAX when idle).
+    """
+    if kernel not in ("xla", "pallas"):
+        raise ValueError(f"unknown plane kernel {kernel!r}: "
+                         "expected 'xla' or 'pallas'")
+    if kernel == "pallas" and rr_enabled:
+        raise ValueError(
+            "plane_kernel='pallas' fuses the FIFO qdisc only; compile "
+            "with rr_enabled=False (all-FIFO configs) or use the XLA path")
+    N, CE = state.eg_dst.shape
+
+    # --- 1. rebase clocks + refill token buckets -----------------------
+    in_deliver = jnp.where(state.in_valid, state.in_deliver_rel - shift_ns,
+                           I32_MAX)
+    balance, tb_rem_ns = _refill_tokens(state, params, shift_ns)
+    rt = codel.rebase_router_state(state.router, shift_ns, params.dn_rate,
+                                   params.dn_cap)
+
+    # --- 2. egress: qdisc order, token-bucket gate ----------------------
+    # Two qdiscs (`network_interface.c:205-303`, `QDiscMode`): FIFO sends
+    # valid-first by ascending packet priority; round-robin interleaves
+    # emitting sockets, taking one packet from each in turn (FIFO within a
+    # socket by per-source seq, which is monotone in emission order).
+    # Send times / clamps of leftover packets were taken relative to the
+    # window they were ingested in; rebase them too.
+    if kernel == "pallas":
+        from . import pallas_egress
+
+        (perm, eg_bytes, eg_tsend, eg_clamp, eg_valid,
+         sendable, spent) = pallas_egress.egress_order_gate(
+            state.eg_valid, state.eg_prio, state.eg_bytes, state.eg_tsend,
+            state.eg_clamp, balance, shift_ns)
+        take = lambda a: jnp.take_along_axis(a, perm, axis=1)
+        eg_prio, eg_sock, eg_dst = (take(state.eg_prio),
+                                    take(state.eg_sock),
+                                    take(state.eg_dst))
+        eg_seq, eg_ctrl = take(state.eg_seq), take(state.eg_ctrl)
+        balance = balance - spent
+        rr_sent = state.rr_sent
+    else:
+        eg_tsend_rb = jnp.where(state.eg_valid, state.eg_tsend - shift_ns, 0)
+        eg_clamp_rb = jnp.where(
+            state.eg_valid & (state.eg_clamp != NO_CLAMP),
+            state.eg_clamp - shift_ns, state.eg_clamp,
+        )
+        qkey1, qkey2, rr_aux = _qdisc_keys(state, params,
+                                           rr_enabled=rr_enabled)
+        (eg_prio, eg_sock, eg_dst, eg_bytes, eg_seq, eg_ctrl, eg_tsend,
+         eg_clamp, eg_valid) = _egress_order(
+            state, qkey1, qkey2, eg_tsend_rb, eg_clamp_rb,
+            rr_enabled=rr_enabled, packed_sort=packed_sort)
+        sendable, balance = _token_gate(eg_valid, eg_bytes, balance)
+        rr_sent = (_rr_advance(eg_sock, eg_valid, sendable, rr_aux)
+                   if rr_enabled else state.rr_sent)
+
+    # --- 3. loss sampling + latency lookup ------------------------------
+    sent, lost, rng_counter, deliver_rel = _loss_latency(
+        state, params, rng_root, eg_dst, eg_ctrl, eg_tsend, eg_clamp,
+        sendable, window_ns, no_loss=no_loss)
+
+    # egress queue keeps only what didn't go out (compacted after routing,
+    # which still indexes this ordering)
+    eg_valid_left = eg_valid & ~sendable
+
+    # --- 4. compact surviving ingress (front-packed for the scatter) -----
+    (in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c, in_valid_c,
+     n_valid_in) = _compact_ingress(state, in_deliver,
+                                    packed_sort=packed_sort)
+
+    # --- 5. route sent packets into destination ingress queues ----------
+    # This happens BEFORE the due check so a packet whose deliver time
+    # falls inside this window (integrated transport: sent last round,
+    # clamped to this window's start) is released THIS round, matching the
+    # CPU plane's push-then-execute ordering.
+    (in_src_m, in_seq_m, in_sock_m, in_bytes_m, in_deliver_m, in_valid_m,
+     overflowed) = _route_scatter(
+        sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel, in_deliver_c,
+        in_src_c, in_seq_c, in_sock_c, in_bytes_c, in_valid_c, n_valid_in,
+        packed_sort=packed_sort)
+    CI = in_src_m.shape[1]
 
     # --- 5b. destination side: release what this window hands the hosts --
     if router_aqm:
@@ -711,35 +1042,17 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
         )
         rt_out = rt2
     else:
-        in_deliver_key = jnp.where(in_valid_m, in_deliver_m, I32_MAX)
-        due = in_valid_m & (in_deliver_key < window_ns)
-        # one sort serves both purposes: not-due first keyed by deliver time
-        # keeps the surviving entries front-packed; the due block lands at
-        # the row tail in deterministic (deliver_t, src, seq) presentation
-        # order
-        is_due = due.astype(jnp.int32)
-        (_, d_t, d_src, d_seq, d_sock, d_bytes, d_due,
-         d_valid) = _row_sort(
-            is_due, jnp.where(in_valid_m, in_deliver_m, I32_MAX), in_src_m,
-            in_seq_m, in_sock_m, in_bytes_m, due, in_valid_m, keys=4,
-        )
-        delivered = {
-            "mask": d_due, "src": d_src, "seq": d_seq, "sock": d_sock,
-            "bytes": d_bytes, "deliver_rel": d_t,
-        }
-        in_valid_new = d_valid & ~d_due
-        in_deliver_new = jnp.where(in_valid_new, d_t, I32_MAX)
-        in_src_new, in_seq_new, in_bytes_new = d_src, d_seq, d_bytes
-        in_sock_new = d_sock
+        (delivered, due, in_deliver_new, in_src_new, in_seq_new,
+         in_sock_new, in_bytes_new, in_valid_new) = _release_due(
+            in_deliver_m, in_src_m, in_seq_m, in_sock_m, in_bytes_m,
+            in_valid_m, window_ns, packed_sort=packed_sort)
         rt_out = rt
 
     # --- 6. compact leftover egress so rows stay front-packed for ingest
-    eg_prio_left = jnp.where(eg_valid_left, eg_prio, I32_MAX)
-    (_, eg_prio_c, eg_dst_c, eg_bytes_c, eg_seq_c, eg_ctrl_c, eg_tsend_c,
-     eg_clamp_c, eg_sock_c, eg_valid_c) = _row_sort(
-        (~eg_valid_left).astype(jnp.int32), eg_prio_left, eg_dst, eg_bytes,
-        eg_seq, eg_ctrl, eg_tsend, eg_clamp, eg_sock, eg_valid_left, keys=2,
-    )
+    (eg_prio_c, eg_dst_c, eg_bytes_c, eg_seq_c, eg_ctrl_c, eg_tsend_c,
+     eg_clamp_c, eg_sock_c, eg_valid_c) = _compact_egress(
+        eg_prio, eg_dst, eg_bytes, eg_seq, eg_ctrl, eg_tsend, eg_clamp,
+        eg_sock, eg_valid_left, packed_sort=packed_sort)
 
     # --- 7. stats + next-event reduction --------------------------------
     per_host_in_next = jnp.where(in_valid_new, in_deliver_new,

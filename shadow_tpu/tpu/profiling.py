@@ -1,0 +1,293 @@
+"""Per-section cost profiler for the device-plane window step.
+
+The r5 verdict's core complaint was that the general device plane had "no
+live win" and nobody could say WHERE the per-window budget goes. This
+module answers that: it rebuilds the PHOLD bench world (`bench.py`) at a
+given shape, warms it to steady-state occupancy, then times every section
+of `plane.window_step` as an ISOLATED jitted micro-kernel — the same
+section helpers `window_step` itself composes (`plane._refill_tokens`,
+`plane._egress_order`, ...), called with realistic intermediates and timed
+with `block_until_ready` around every repetition. The output is a JSON
+cost breakdown per section, so every optimization claim against the
+window step is a measured before/after, not a guess.
+
+Sections (superset of the window step's numbered stages):
+
+- ``rebase_refill``   — clock rebase + token refill (section 1)
+- ``rr_tensors``      — the RR qdisc's [N, CE, CE] rank tensors (2a)
+- ``qdisc_sort``      — the egress qdisc row sort (2b)
+- ``token_gate``      — prefix-sum bandwidth gate (2c)
+- ``loss_latency``    — loss draw + latency table gathers (3)
+- ``ingress_compact`` — surviving-ingress compaction sort (4)
+- ``routing_scatter`` — flat routing sort + grouped scatter (5)
+- ``release_due``     — due split/presentation sort (5b, direct mode)
+- ``codel_drain``     — the router CoDel/relay micro-step (5b, AQM mode)
+- ``egress_compact``  — leftover-egress compaction sort (6)
+- ``ingest_rows``     — the bench/respawn row-merge append
+- ``window_step``     — the full composed step (sanity anchor: section
+  times should roughly sum to it; XLA fusion makes the sum an upper
+  bound)
+
+Drive it from the CLI: ``python tools/profile_plane.py --hosts 1024,32768``.
+"""
+
+from __future__ import annotations
+
+import time as _walltime
+
+import numpy as np
+
+MS = 1_000_000
+
+#: sections timed by default (codel_drain is representative of AQM mode
+#: even though the bench's direct mode never runs it)
+DEFAULT_SECTIONS = (
+    "rebase_refill", "rr_tensors", "qdisc_sort", "token_gate",
+    "loss_latency", "ingress_compact", "routing_scatter", "release_due",
+    "codel_drain", "egress_compact", "ingest_rows", "window_step",
+)
+
+
+def _time_call(fn, args, reps: int) -> dict:
+    """Median/min wall time of a jitted section, blocking every rep.
+
+    Wall-clock here is pure measurement output (the profiler never feeds
+    sim state), hence the SL101 suppressions."""
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)  # compile + first run outside the timing
+    times = []
+    for _ in range(reps):
+        t0 = _walltime.perf_counter()  # shadowlint: disable=SL101 -- profiler measurement
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(_walltime.perf_counter() - t0)  # shadowlint: disable=SL101 -- profiler measurement
+    times.sort()
+    return {
+        "min_ms": round(times[0] * 1e3, 4),
+        "median_ms": round(times[len(times) // 2] * 1e3, 4),
+        "reps": reps,
+    }
+
+
+def build_world(n_hosts: int, *, n_nodes: int = 64, egress_cap: int = 16,
+                ingress_cap: int = 32, seed: int = 0,
+                warmup_windows: int = 3):
+    """The bench.py PHOLD world at steady state: node-level path tables,
+    4 seed packets per host, `warmup_windows` full windows executed so
+    egress/ingress occupancy matches what the bench's scan body sees."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import ingest, make_params, make_state
+    from .plane import window_step
+
+    N, M = n_hosts, n_nodes
+    rng = np.random.default_rng(seed)
+    lat = rng.integers(1 * MS, 50 * MS, size=(M, M), dtype=np.int32)
+    lat = np.minimum(lat, lat.T)
+    loss = np.full((M, M), 0.01, np.float32)
+    host_node = (np.arange(N) % M).astype(np.int32)
+    bw = np.full((N,), 10_000_000_000, np.int64)
+    params = make_params(lat, loss, bw, host_node=host_node)
+    state = make_state(N, egress_cap=egress_cap, ingress_cap=ingress_cap,
+                       initial_tokens=np.asarray(params.tb_cap))
+    k = 4
+    src0 = jnp.repeat(jnp.arange(N, dtype=jnp.int32), k)
+    dst0 = (src0 * 1566083941
+            + jnp.tile(jnp.arange(k, dtype=jnp.int32), N) * 40503 + 1) % N
+    b0 = src0.shape[0]
+    state = ingest(
+        state, src0, dst0,
+        jnp.full((b0,), 1400, jnp.int32),
+        jnp.arange(b0, dtype=jnp.int32),
+        jnp.arange(b0, dtype=jnp.int32),
+        jnp.zeros((b0,), bool),
+    )
+    rng_root = jax.random.key(1)
+    window = jnp.int32(10 * MS)
+    step = jax.jit(lambda st, sh: window_step(
+        st, params, rng_root, sh, window, rr_enabled=False))
+    shift = jnp.int32(0)
+    delivered = None
+    for _ in range(warmup_windows):
+        state, delivered, _next = step(state, shift)
+        shift = window
+    jax.block_until_ready(state)
+    return {
+        "state": state, "params": params, "rng_root": rng_root,
+        "shift": window, "window": window, "delivered": delivered,
+        "egress_cap": egress_cap, "ingress_cap": ingress_cap,
+    }
+
+
+def respawn_batch(delivered, spawn_seq, round_idx, n_hosts: int,
+                  ingress_cap: int):
+    """The PHOLD bench's deterministic respawn batch: each delivered
+    packet triggers one new packet from the receiving host to a hashed
+    destination (FIFO-ish priority = seq). ONE definition shared with
+    `bench.py`'s scan body, so the profiler's `ingest_rows` section times
+    exactly the batch the bench feeds it — any workload change there
+    changes this measurement with it. Returns (valid_mask, dst, nbytes,
+    seq, ctrl), all [N, CI]."""
+    import jax.numpy as jnp
+
+    mask = delivered["mask"]
+    dst = (delivered["src"] * 40503
+           + delivered["seq"] * 1566083941 + round_idx * 97) % n_hosts
+    rank = jnp.broadcast_to(jnp.arange(ingress_cap, dtype=jnp.int32),
+                            (n_hosts, ingress_cap))
+    seq = spawn_seq[:, None] + rank
+    nbytes = jnp.full((n_hosts, ingress_cap), 1400, jnp.int32)
+    ctrl = jnp.zeros((n_hosts, ingress_cap), bool)
+    return mask, dst, nbytes, seq, ctrl
+
+
+def profile_sections(n_hosts: int, *, reps: int = 20,
+                     sections=None, rr_enabled: bool = False,
+                     packed_sort: bool = True, kernel: str = "xla",
+                     n_nodes: int = 64, egress_cap: int = 16,
+                     ingress_cap: int = 32, seed: int = 0) -> dict:
+    """Time each window-step section at the given bench shape. Returns a
+    JSON-ready dict. `packed_sort=False` times the pre-diet variadic
+    sorts (the before/after comparison the PR-level claims quote)."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import codel
+    from .plane import (I32_MAX, NO_CLAMP, _compact_egress,
+                        _compact_ingress, _egress_order, _loss_latency,
+                        _qdisc_keys, _refill_tokens, _release_due,
+                        _route_scatter, _row_sort, _token_gate, ingest_rows,
+                        window_step)
+
+    wanted = tuple(sections) if sections is not None else DEFAULT_SECTIONS
+    world = build_world(n_hosts, n_nodes=n_nodes, egress_cap=egress_cap,
+                        ingress_cap=ingress_cap, seed=seed)
+    state, params = world["state"], world["params"]
+    rng_root, shift, window = world["rng_root"], world["shift"], \
+        world["window"]
+    N = n_hosts
+    CI = ingress_cap
+
+    # precompute each section's inputs ONCE (jitted, materialized) so the
+    # timed call measures exactly one section
+    def rebase_refill(state, shift):
+        in_deliver = jnp.where(state.in_valid,
+                               state.in_deliver_rel - shift, I32_MAX)
+        balance, rem = _refill_tokens(state, params, shift)
+        eg_tsend_rb = jnp.where(state.eg_valid, state.eg_tsend - shift, 0)
+        eg_clamp_rb = jnp.where(
+            state.eg_valid & (state.eg_clamp != NO_CLAMP),
+            state.eg_clamp - shift, state.eg_clamp)
+        return in_deliver, balance, rem, eg_tsend_rb, eg_clamp_rb
+
+    pre = jax.jit(rebase_refill)(state, shift)
+    in_deliver, balance, _rem, eg_tsend_rb, eg_clamp_rb = \
+        jax.block_until_ready(pre)
+    qk1, qk2, _aux = jax.jit(
+        lambda st: _qdisc_keys(st, params, rr_enabled=rr_enabled))(state)
+    order = jax.jit(lambda st, a, b, c, d: _egress_order(
+        st, a, b, c, d, rr_enabled=rr_enabled, packed_sort=packed_sort))
+    (eg_prio, eg_sock, eg_dst, eg_bytes, eg_seq, eg_ctrl, eg_tsend,
+     eg_clamp, eg_valid) = jax.block_until_ready(
+        order(state, qk1, qk2, eg_tsend_rb, eg_clamp_rb))
+    sendable, _bal2 = jax.jit(_token_gate)(eg_valid, eg_bytes, balance)
+    loss_fn = jax.jit(lambda st, dsts, ctrl, ts, cl, snd: _loss_latency(
+        st, params, rng_root, dsts, ctrl, ts, cl, snd, window,
+        no_loss=False))
+    sent, _lost, _rc, deliver_rel = jax.block_until_ready(
+        loss_fn(state, eg_dst, eg_ctrl, eg_tsend, eg_clamp, sendable))
+    compact = jax.jit(lambda st, ind: _compact_ingress(
+        st, ind, packed_sort=packed_sort))
+    (in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c, in_valid_c,
+     n_valid_in) = jax.block_until_ready(compact(state, in_deliver))
+    route = jax.jit(lambda *a: _route_scatter(*a, packed_sort=packed_sort))
+    (in_src_m, in_seq_m, in_sock_m, in_bytes_m, in_deliver_m, in_valid_m,
+     _ovf) = jax.block_until_ready(route(
+        sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel, in_deliver_c,
+        in_src_c, in_seq_c, in_sock_c, in_bytes_c, in_valid_c, n_valid_in))
+    eg_valid_left = jax.block_until_ready(
+        jax.jit(lambda v, s: v & ~s)(eg_valid, sendable))
+
+    # AQM-mode inputs for the codel micro-step: arrival-ordered ingress +
+    # a rebased router state (built once, untimed)
+    def aqm_presort(valid_m, deliver_m, src_m, seq_m, sock_m, bytes_m):
+        inv_m = (~valid_m).astype(jnp.int32)
+        arr_key = jnp.where(valid_m, deliver_m, I32_MAX)
+        return _row_sort(inv_m, arr_key, src_m, seq_m, sock_m, bytes_m,
+                         valid_m, keys=4)
+    (_, arr_s, _src_s, _seq_s, _sock_s, bytes_s, _valid_s) = \
+        jax.block_until_ready(jax.jit(aqm_presort)(
+            in_valid_m, in_deliver_m, in_src_m, in_seq_m, in_sock_m,
+            in_bytes_m))
+    rt = jax.block_until_ready(jax.jit(
+        lambda st, sh: codel.rebase_router_state(
+            st.router, sh, params.dn_rate, params.dn_cap))(state, shift))
+
+    # the bench's respawn batch for ingest_rows, shaped from the warmup
+    # window's delivered set (spawn_seq/round_idx pinned to the bench's
+    # first respawning round)
+    deliv = world["delivered"]
+    spawn_seq = jnp.full((N,), 10_000, jnp.int32)
+    mask, new_dst, row_bytes, seq_vals, row_ctrl = jax.block_until_ready(
+        jax.jit(lambda d: respawn_batch(d, spawn_seq, jnp.int32(1), N, CI))(
+            deliv))
+
+    section_calls = {
+        "rebase_refill": (jax.jit(rebase_refill), (state, shift)),
+        "rr_tensors": (
+            jax.jit(lambda st: _qdisc_keys(st, params, rr_enabled=True)),
+            (state,)),
+        "qdisc_sort": (order, (state, qk1, qk2, eg_tsend_rb, eg_clamp_rb)),
+        "token_gate": (jax.jit(_token_gate), (eg_valid, eg_bytes, balance)),
+        "loss_latency": (
+            loss_fn, (state, eg_dst, eg_ctrl, eg_tsend, eg_clamp, sendable)),
+        "ingress_compact": (compact, (state, in_deliver)),
+        "routing_scatter": (route, (
+            sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel,
+            in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
+            in_valid_c, n_valid_in)),
+        "release_due": (
+            jax.jit(lambda *a: _release_due(
+                *a, window, packed_sort=packed_sort)),
+            (in_deliver_m, in_src_m, in_seq_m, in_sock_m, in_bytes_m,
+             in_valid_m)),
+        "codel_drain": (
+            jax.jit(lambda a, b, r: codel.router_drain(
+                a, b, window, params.dn_rate, params.dn_cap, r)),
+            (arr_s, bytes_s, rt)),
+        "egress_compact": (
+            jax.jit(lambda *a: _compact_egress(
+                *a, packed_sort=packed_sort)),
+            (eg_prio, eg_dst, eg_bytes, eg_seq, eg_ctrl, eg_tsend,
+             eg_clamp, eg_sock, eg_valid_left)),
+        "ingest_rows": (
+            jax.jit(lambda st, d, b, p, s, c, v: ingest_rows(
+                st, d, b, p, s, c, v, packed_sort=packed_sort)),
+            (state, new_dst, row_bytes, seq_vals, seq_vals, row_ctrl,
+             mask)),
+        "window_step": (
+            jax.jit(lambda st, sh: window_step(
+                st, params, rng_root, sh, window, rr_enabled=rr_enabled,
+                packed_sort=packed_sort, kernel=kernel)),
+            (state, shift)),
+    }
+
+    out_sections = {}
+    for name in wanted:
+        fn, args = section_calls[name]
+        out_sections[name] = _time_call(fn, args, reps)
+
+    return {
+        "hosts": n_hosts,
+        "egress_cap": egress_cap,
+        "ingress_cap": ingress_cap,
+        "nodes": n_nodes,
+        "backend": jax.default_backend(),
+        "rr_enabled": rr_enabled,
+        "packed_sort": packed_sort,
+        "kernel": kernel,
+        "sections": out_sections,
+    }

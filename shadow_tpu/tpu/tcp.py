@@ -365,11 +365,20 @@ def _reass_insert(s, off, length):
     touch = live & (s.reass_off <= end) & (off <= s.reass_off + s.reass_len)
     has_touch = touch.any()
     first_touch = jnp.argmax(touch)
-    new_off = jnp.minimum(s.reass_off[first_touch], off)
+    # union ALL touching slots into first_touch: a segment that BRIDGES
+    # two existing ranges merges them in one pass, and every other
+    # touching slot is cleared — so reass_bytes never transiently
+    # double-counts the bridged span (it feeds the OOO / ack-coalescing
+    # signals) and live slots stay pairwise disjoint. Coverage semantics
+    # are unchanged: the drain walks coverage, and SACK blocks merge
+    # touching ranges before reporting anyway.
+    new_off = jnp.minimum(jnp.where(touch, s.reass_off, off).min(), off)
     new_end = jnp.maximum(
-        s.reass_off[first_touch] + s.reass_len[first_touch], end)
+        jnp.where(touch, s.reass_off + s.reass_len, end).max(), end)
     ext_off = s.reass_off.at[first_touch].set(new_off)
     ext_len = s.reass_len.at[first_touch].set(new_end - new_off)
+    cleared = touch & (jnp.arange(ext_len.shape[0]) != first_touch)
+    ext_len = jnp.where(cleared, 0, ext_len)
     # free slot: first with len == 0
     free = s.reass_len == 0
     first_free = jnp.argmax(free)

@@ -352,10 +352,18 @@ class DeviceTransport:
                 (shifts, widths, ing, exp_fp, exp_fp2, exp_n))
             return st, div
 
-        self._k_ingest = jax.jit(ingest)
-        self._k_step = jax.jit(step_compact)
-        self._k_chain = jax.jit(chain)
-        self._k_batch_verify = jax.jit(batch_verify)
+        # every dispatch donates the TransportState pytree: XLA writes the
+        # next window's slot arrays into the incoming buffers instead of
+        # re-materializing the [N, CI] set per window. Safe because
+        # self.state is rebound from each kernel's return before any
+        # further use (the donation contract, docs/performance.md); on the
+        # CPU test backend donating_jit compiles without donation.
+        from . import donating_jit
+
+        self._k_ingest = donating_jit(ingest)
+        self._k_step = donating_jit(step_compact)
+        self._k_chain = donating_jit(chain)
+        self._k_batch_verify = donating_jit(batch_verify)
 
     # -- capture (called from Worker.send_packet, any worker thread) -----
 

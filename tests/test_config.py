@@ -171,3 +171,16 @@ def test_hostname_validation():
         load_config_str("general: {stop_time: 1s}\nhosts: {'bad host!': {}}")
     cfg = load_config_str("general: {stop_time: 1s}\nhosts: {'lossy.tcpserver.echo': {}}")
     assert "lossy.tcpserver.echo" in cfg.hosts
+
+
+def test_plane_kernel_flag_validates():
+    """experimental.plane_kernel accepts xla/pallas and rejects loudly."""
+    assert config.ConfigOptions().experimental.plane_kernel == "xla"
+    cfg = load_config_str(
+        BASIC.replace("general:",
+                      "experimental:\n  plane_kernel: pallas\ngeneral:"))
+    assert cfg.experimental.plane_kernel == "pallas"
+    with pytest.raises(ConfigError, match="plane_kernel"):
+        load_config_str(
+            BASIC.replace("general:",
+                          "experimental:\n  plane_kernel: cuda\ngeneral:"))

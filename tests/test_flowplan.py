@@ -149,3 +149,51 @@ def test_flow_plan_asymmetric_directed_paths():
     # and the whole thing runs
     stats = Manager(cfg).run()
     assert stats.process_failures == []
+
+
+def test_flow_plan_rejects_stop_time_past_int32_us():
+    """stop_time beyond the int32 microsecond domain used to silently
+    wrap on device (advisor r5 medium finding); it must refuse loudly."""
+    cfg = load_config_str(tgen_cfg(n_clients=1, stop="2150s"))
+    mgr = Manager(cfg)
+    with pytest.raises(FlowPlanError, match="int32 microsecond"):
+        compile_flow_plan(cfg, mgr.routing)
+
+
+def test_flow_plan_rejects_client_start_past_int32_us():
+    cfg_text = tgen_cfg(n_clients=1).replace("start_time: 2s",
+                                             "start_time: 2148s")
+    cfg = load_config_str(cfg_text)
+    mgr = Manager(cfg)
+    with pytest.raises(FlowPlanError, match="client0.*int32 microsecond"):
+        compile_flow_plan(cfg, mgr.routing)
+
+
+def test_ring_drops_rerun_bucket_with_doubled_queue_slots(monkeypatch):
+    """Nonzero engine ring-capacity queue_drops must trigger the same
+    re-run discipline as step-cap saturation: a fresh bucket run with
+    doubled queue_slots (advisor r5 finding — ring drops are an engine
+    artifact, distinct from modeled wire drops)."""
+    from shadow_tpu.tpu import floweng
+
+    slots_used = []
+    real_make = floweng.make_flow_world
+    real_results = floweng.flow_results
+
+    def fake_make(lat, size, **kw):
+        slots_used.append(kw.get("queue_slots"))
+        return real_make(lat, size, **kw)
+
+    def fake_results(world):
+        res = real_results(world)
+        if len(slots_used) == 1:  # poison only the first attempt
+            res = dict(res)
+            res["queue_drops"] = 3
+        return res
+
+    monkeypatch.setattr(floweng, "make_flow_world", fake_make)
+    monkeypatch.setattr(floweng, "flow_results", fake_results)
+    cfg = load_config_str(tgen_cfg(n_clients=1, size=20_000))
+    stats = Manager(cfg).run()
+    assert slots_used == [256, 512]
+    assert stats.process_failures == []

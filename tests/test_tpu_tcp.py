@@ -508,3 +508,35 @@ def test_sack_disabled_parity():
                              drop_at=(1, 3, 4), config=TcpConfig(sack=False))
     assert not a.conn._sack_ok and not b.conn._sack_ok
     replay_and_compare([a, b], sack=False)
+
+
+def test_reass_insert_bridging_segment_no_double_count():
+    """A segment bridging two disjoint reassembly ranges must merge them
+    into ONE slot with exact coverage bytes — the pre-fix extend-on-touch
+    grew the first slot across the second and left the second's bytes
+    double-counted in reass_bytes until the next drain (advisor r5
+    finding)."""
+    plane0 = dtcp.make_tcp_plane(1, reass_slots=4)
+    s = jax.tree.map(lambda x: x[0], plane0)
+    s = dtcp._reass_insert(s, jnp.int32(100), jnp.int32(10))  # [100,110)
+    s = dtcp._reass_insert(s, jnp.int32(120), jnp.int32(10))  # [120,130)
+    assert int(s.reass_bytes) == 20
+    s = dtcp._reass_insert(s, jnp.int32(108), jnp.int32(14))  # [108,122)
+    assert int(s.reass_bytes) == 30  # [100,130) exactly once, not 32
+    live = np.asarray(s.reass_len) > 0
+    assert int(live.sum()) == 1
+    slot = int(np.argmax(live))
+    assert int(s.reass_off[slot]) == 100
+    assert int(s.reass_len[slot]) == 30
+
+
+def test_reass_insert_bridge_covering_second_range_entirely():
+    """Bridging segment that fully covers the later range: the covered
+    slot must be cleared (freed), not left to linger until drain."""
+    plane0 = dtcp.make_tcp_plane(1, reass_slots=4)
+    s = jax.tree.map(lambda x: x[0], plane0)
+    s = dtcp._reass_insert(s, jnp.int32(100), jnp.int32(10))  # [100,110)
+    s = dtcp._reass_insert(s, jnp.int32(120), jnp.int32(10))  # [120,130)
+    s = dtcp._reass_insert(s, jnp.int32(105), jnp.int32(30))  # [105,135)
+    assert int(s.reass_bytes) == 35  # [100,135)
+    assert int((np.asarray(s.reass_len) > 0).sum()) == 1
