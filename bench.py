@@ -34,12 +34,20 @@ N_NODES = int(os.environ.get("BENCH_NODES", "64"))  # graph nodes (GML-like)
 # "xla" (default) or "pallas" — the experimental.plane_kernel flag's
 # bench-side twin (the fused Pallas egress kernel; see docs/performance.md)
 PLANE_KERNEL = os.environ.get("BENCH_PLANE_KERNEL", "xla")
+# BENCH_TELEMETRY=1 threads the PlaneMetrics counters through every
+# window and harvests heartbeat JSONL + a Perfetto trace into
+# BENCH_TELEMETRY_DIR every BENCH_HARVEST_EVERY windows
+# (docs/observability.md; the acceptance bar is throughput within 5%
+# of the metrics-off path)
+TELEMETRY = os.environ.get("BENCH_TELEMETRY", "0") == "1"
+TELEMETRY_DIR = os.environ.get("BENCH_TELEMETRY_DIR", "telemetry-bench")
+HARVEST_EVERY = int(os.environ.get("BENCH_HARVEST_EVERY", "32"))
 EGRESS_CAP = 16
 INGRESS_CAP = 32
 SPAWN_PER_DELIVERY = 1
 
 
-def bench_tpu() -> tuple[float, int]:
+def bench_tpu() -> tuple[float, int, dict | None]:
     import jax
     import jax.numpy as jnp
 
@@ -60,11 +68,15 @@ def bench_tpu() -> tuple[float, int]:
     window = world["window"]
 
     def round_fn(carry, round_idx):
-        state, spawn_seq = carry
+        state, spawn_seq, metrics = carry
         shift = jnp.where(round_idx == 0, jnp.int32(0), window)
-        state, delivered, next_ev = window_step(state, params, key, shift,
-                                                window, rr_enabled=False,
-                                                kernel=PLANE_KERNEL)
+        out = window_step(state, params, key, shift, window,
+                          rr_enabled=False, kernel=PLANE_KERNEL,
+                          metrics=metrics)
+        if metrics is not None:
+            state, delivered, next_ev, metrics = out
+        else:
+            state, delivered, next_ev = out
         # respawn: each delivered packet triggers one new packet from the
         # receiving host to a hashed destination (deterministic). The
         # delivered arrays are already row-shaped (row = receiving host),
@@ -76,9 +88,12 @@ def bench_tpu() -> tuple[float, int]:
             seq_vals,  # priority: reuse seq (FIFO-ish)
             seq_vals, ctrl,
             valid=mask,
+            metrics=metrics,
         )
+        if metrics is not None:
+            state, metrics = state
         spawn_seq = spawn_seq + mask.sum(axis=1, dtype=jnp.int32)
-        return (state, spawn_seq), mask.sum(dtype=jnp.int32)
+        return (state, spawn_seq, metrics), mask.sum(dtype=jnp.int32)
 
     # the state pytree is donated: XLA reuses the input buffers for the
     # scan carry instead of materializing a second copy of ~20 [N, C]
@@ -86,14 +101,49 @@ def bench_tpu() -> tuple[float, int]:
     @donating_jit
     def run(state):
         spawn_seq = jnp.full((N,), 10_000, jnp.int32)
-        (state, _), delivered_counts = jax.lax.scan(
-            round_fn, (state, spawn_seq), jnp.arange(ROUNDS, dtype=jnp.int32)
+        (state, _, _), delivered_counts = jax.lax.scan(
+            round_fn, (state, spawn_seq, None),
+            jnp.arange(ROUNDS, dtype=jnp.int32)
         )
         return state, delivered_counts.sum()
 
+    # telemetry mode: same loop, chunked at the harvest cadence. The
+    # metrics pytree rides the scan carry (pure jnp adds, no syncs); the
+    # state is donated, the metrics argument is NOT — the harvester's
+    # asynchronous D2H copy of the previous chunk's output must survive
+    # this chunk's dispatch (telemetry/harvest.py).
+    @donating_jit
+    def run_chunk(state, spawn_seq, metrics, round_ids):
+        (state, spawn_seq, metrics), delivered_counts = jax.lax.scan(
+            round_fn, (state, spawn_seq, metrics), round_ids)
+        return state, spawn_seq, metrics, delivered_counts.sum()
+
+    def telemetry_chunks():
+        ids = np.arange(ROUNDS, dtype=np.int32)
+        return [jnp.asarray(ids[i:i + HARVEST_EVERY])
+                for i in range(0, ROUNDS, HARVEST_EVERY)]
+
+    def run_telemetry(state, harvester=None):
+        from shadow_tpu.telemetry import make_metrics
+
+        spawn_seq = jnp.full((N,), 10_000, jnp.int32)
+        metrics = make_metrics(N)
+        total = jnp.int32(0)
+        done = 0
+        for ids in telemetry_chunks():
+            state, spawn_seq, metrics, ndel = run_chunk(
+                state, spawn_seq, metrics, ids)
+            total = total + ndel
+            done += int(ids.shape[0])
+            if harvester is not None:
+                harvester.tick(done * int(window), device=metrics)
+        return state, total
+
+    driver = run_telemetry if TELEMETRY else run
+
     # compile
     t0 = time.monotonic()
-    state_out, ndel = run(state)
+    state_out, ndel = driver(state)
     jax.block_until_ready(state_out)
     compile_and_first = time.monotonic() - t0
 
@@ -103,15 +153,46 @@ def bench_tpu() -> tuple[float, int]:
                                    ingress_cap=INGRESS_CAP, seed=0,
                                    warmup_windows=0)["state"]
     jax.block_until_ready(state2)
-    t0 = time.monotonic()
-    state_out, ndel = run(state2)
-    ndel = int(ndel)
-    jax.block_until_ready(state_out)
-    wall = time.monotonic() - t0
+    telemetry_info = None
+    if TELEMETRY:
+        from shadow_tpu.telemetry import TelemetryHarvester
+
+        os.makedirs(TELEMETRY_DIR, exist_ok=True)
+        sink = os.path.join(TELEMETRY_DIR, "heartbeats.jsonl")
+        harvester = TelemetryHarvester(
+            interval_ns=HARVEST_EVERY * int(window), sink=sink,
+            slot_capacity=N * (EGRESS_CAP + INGRESS_CAP))
+        t0 = time.monotonic()
+        state_out, ndel = run_telemetry(state2, harvester)
+        ndel = int(ndel)
+        jax.block_until_ready(state_out)
+        wall = time.monotonic() - t0
+        # harvest bookkeeping happens OUTSIDE the timed loop's budget
+        # claims but inside the wall measurement above — the 5% bar is
+        # end-to-end, including the async pulls
+        harvester.finalize()
+        from shadow_tpu.telemetry import export
+
+        trace = export.write_perfetto_trace(
+            harvester.heartbeats,
+            os.path.join(TELEMETRY_DIR, "trace.json"))
+        telemetry_info = {
+            "heartbeats": harvester.emitted,
+            "harvests": harvester.harvests,
+            "sink": sink,
+            "trace": trace["path"],
+            "trace_events": trace["events"],
+        }
+    else:
+        t0 = time.monotonic()
+        state_out, ndel = run(state2)
+        ndel = int(ndel)
+        jax.block_until_ready(state_out)
+        wall = time.monotonic() - t0
 
     sent = int(np.asarray(state_out.n_sent).sum())
     events = ndel + sent  # send + deliver events, like Shadow's event count
-    return events / wall, events
+    return events / wall, events, telemetry_info
 
 
 def bench_cpu_baseline() -> float:
@@ -233,7 +314,7 @@ def _regression_guard(value: float):
 
 
 def main():
-    tpu_rate, events = bench_tpu()
+    tpu_rate, events, telemetry_info = bench_tpu()
     cpu_rate = bench_cpu_baseline()
     compiled_rate = bench_compiled_baseline()
     guard = _regression_guard(tpu_rate)
@@ -243,6 +324,7 @@ def main():
                 "metric": "packet_events_per_sec",
                 "value": round(tpu_rate, 1),
                 "unit": "events/s",
+                "telemetry": telemetry_info,
                 "vs_baseline": round(tpu_rate / cpu_rate, 2),
                 "vs_compiled": (round(tpu_rate / compiled_rate, 3)
                                 if compiled_rate else None),

@@ -15,6 +15,13 @@ once collecting findings. Rules scope by repo-relative path:
 - SL104 (mutable default args) applies everywhere.
 - SL105 (traced-value branching) applies to ``shadow_tpu/tpu/`` kernel
   modules.
+- SL301 (sync-in-kernel) applies to ``shadow_tpu/tpu/``: device_get /
+  block_until_ready inside a KERNEL BODY — a function that is
+  jit-decorated, passed to a jit wrapper (``jax.jit``,
+  ``donating_jit``), or used as a ``lax`` control-flow body
+  (scan/while_loop/cond/...). Syncs outside kernel bodies (transport
+  release barriers, the profiler's measurement loop, telemetry drains)
+  are the sanctioned pattern and are not flagged.
 """
 
 from __future__ import annotations
@@ -69,7 +76,7 @@ def rule_applies(rule: str, relpath: str) -> bool:
         )
     if rule == "SL104":
         return True
-    if rule == "SL105":
+    if rule in ("SL105", "SL301"):
         return p.startswith("shadow_tpu/tpu/")
     return False
 
@@ -205,6 +212,114 @@ def _contains_traced_read(node: ast.expr, imports: _Imports,
                 continue
             return True
     return False
+
+
+# -- SL301: host syncs inside kernel bodies ------------------------------
+
+#: callables whose function argument becomes jitted/traced device code
+_JIT_WRAPPER_LEAVES = {"jit", "donating_jit"}
+_LAX_BODY_LEAVES = {"scan", "while_loop", "cond", "fori_loop", "switch",
+                    "map", "associative_scan"}
+_SYNC_LEAVES = {"device_get", "block_until_ready"}
+
+
+def _callee_leaf(node: ast.expr, imports: _Imports) -> str:
+    """Last dotted component of a callable reference, resolved through
+    the import table when possible (``donating_jit`` arrives via a
+    relative import the table can't follow, so the bare leaf matters)."""
+    resolved = imports.resolve(node)
+    if resolved:
+        return resolved.rsplit(".", 1)[-1]
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _kernel_bodies(tree: ast.AST, imports: _Imports) -> list[ast.AST]:
+    """Function/lambda nodes whose bodies compile into device kernels:
+    jit-decorated defs, function-valued arguments to jit wrappers, and
+    `lax` control-flow bodies. Name arguments resolve against every def
+    of that name in the file (flow-insensitive, like the rest of the
+    linter)."""
+    defs_by_name: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    kernels: list[ast.AST] = []
+    seen: set[int] = set()
+
+    def mark(node: ast.AST) -> None:
+        if id(node) not in seen:
+            seen.add(id(node))
+            kernels.append(node)
+
+    def decorator_is_jit(dec: ast.expr) -> bool:
+        if isinstance(dec, ast.Call):  # @partial(jax.jit, ...) etc.
+            return decorator_is_jit(dec.func) or any(
+                _callee_leaf(a, imports) in _JIT_WRAPPER_LEAVES
+                for a in dec.args)
+        return _callee_leaf(dec, imports) in _JIT_WRAPPER_LEAVES
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(decorator_is_jit(d) for d in node.decorator_list):
+                mark(node)
+        elif isinstance(node, ast.Call):
+            leaf = _callee_leaf(node.func, imports)
+            resolved = imports.resolve(node.func) or ""
+            if leaf in _JIT_WRAPPER_LEAVES:
+                fn_args = node.args[:1]  # jit(fun, ...)
+            elif leaf in _LAX_BODY_LEAVES and (
+                    ".lax." in resolved or resolved.startswith("lax.")):
+                # the resolved-path requirement keeps builtins and local
+                # helpers that happen to be named map/cond/switch from
+                # marking their callees as kernels
+                fn_args = node.args  # lax.while_loop(cond, body, init)
+            else:
+                continue
+            for arg in fn_args:
+                if isinstance(arg, ast.Lambda):
+                    mark(arg)
+                elif isinstance(arg, ast.Name):
+                    for d in defs_by_name.get(arg.id, ()):
+                        mark(d)
+    return kernels
+
+
+def _sl301_findings(tree: ast.AST, imports: _Imports,
+                    relpath: str) -> list[Finding]:
+    if not rule_applies("SL301", relpath):
+        return []
+    findings: list[Finding] = []
+    flagged: set[tuple[int, int]] = set()
+    for kernel in _kernel_bodies(tree, imports):
+        for node in ast.walk(kernel):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = imports.resolve(node.func)
+            is_sync = resolved in ("jax.device_get",
+                                   "jax.block_until_ready")
+            if not is_sync and isinstance(node.func, ast.Attribute):
+                # self._jax.device_get(...) / arr.block_until_ready()
+                is_sync = node.func.attr in _SYNC_LEAVES
+            if not is_sync:
+                continue
+            loc = (node.lineno, node.col_offset)
+            if loc in flagged:
+                continue
+            flagged.add(loc)
+            what = (resolved or f"...{node.func.attr}"
+                    if isinstance(node.func, ast.Attribute)
+                    else resolved)
+            findings.append(Finding(
+                "SL301", relpath, node.lineno, node.col_offset,
+                f"host sync `{what}` inside a jitted kernel body; "
+                "harvest/read device values OUTSIDE jitted code "
+                "(telemetry no-host-sync rule, docs/observability.md)"))
+    return findings
 
 
 class _Linter(ast.NodeVisitor):
@@ -368,6 +483,10 @@ def lint_source(source: str, relpath: str,
     tree = ast.parse(source, filename=relpath)
     linter = _Linter(relpath, _Imports())
     linter.visit(tree)
+    # SL301 runs as a post-pass: the import table is complete after the
+    # main visit, and kernel detection needs the whole-file def map
+    linter.findings.extend(
+        _sl301_findings(tree, linter.imports, relpath))
     sup = suppressions if suppressions is not None \
         else parse_suppressions(source)
     for f in linter.findings:

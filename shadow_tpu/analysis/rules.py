@@ -82,6 +82,15 @@ RULES: dict[str, RuleInfo] = {
             "or wrong results under jit)",
         ),
         RuleInfo(
+            "SL301", "sync-in-kernel",
+            "jax.device_get / block_until_ready inside a tpu/ kernel "
+            "body (a function that is jitted or a lax control-flow body)",
+            "telemetry harvest and every other host readback stay "
+            "OUTSIDE jitted code (docs/observability.md): a sync inside "
+            "a kernel body blocks the device pipeline on every window "
+            "and turns into a host callback under jit",
+        ),
+        RuleInfo(
             "SL201", "x64-leak",
             "64-bit dtype (float64/int64) appearing in a device jaxpr",
             "the device plane is int32/float32 by contract "

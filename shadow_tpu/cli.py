@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--show-config", action="store_true",
         help="print the processed config and exit",
     )
+    p.add_argument(
+        "--telemetry", action="store_true",
+        help="enable the telemetry harvester (overrides telemetry.enabled; "
+             "heartbeat JSONL + Perfetto trace land in the data directory)",
+    )
     return p
 
 
@@ -68,6 +73,8 @@ def _apply_overrides(config: ConfigOptions, args) -> None:
         config.experimental.scheduler = args.scheduler
     if args.data_directory is not None:
         config.general.data_directory = args.data_directory
+    if args.telemetry:
+        config.telemetry.enabled = True
 
 
 def _config_as_dict(config: ConfigOptions) -> dict:
@@ -89,6 +96,7 @@ def _config_as_dict(config: ConfigOptions) -> dict:
         "general": conv(config.general),
         "network": conv(config.network),
         "experimental": conv(config.experimental),
+        "telemetry": conv(config.telemetry),
         "hosts": {name: conv(h) for name, h in config.hosts.items()},
     }
 
@@ -138,6 +146,13 @@ def main(argv=None) -> int:
         "simulation finished: %d rounds, %d packets, %.2fs wall",
         stats.rounds, stats.packets_sent, stats.wall_seconds,
     )
+
+    if mgr.harvester is not None:
+        log.info(
+            "telemetry: %d heartbeat lines over %d harvests -> %s",
+            mgr.harvester.emitted, mgr.harvester.harvests,
+            mgr.harvester.sink_path or "(log only)",
+        )
 
     payload = stats.as_dict()
     payload["hosts"] = mgr.host_stats()

@@ -196,6 +196,29 @@ class ExperimentalOptions:
 
 
 @dataclass
+class TelemetryOptions:
+    """The `telemetry:` config block (no reference counterpart — this
+    rebuild's device plane needs its own observability; see
+    docs/observability.md).
+
+    `interval` is VIRTUAL time between harvests. `sink` is the heartbeat
+    JSONL path (default: <data_dir>/telemetry.jsonl when a data dir
+    exists; "off" = log-summary-only). `trace` is the Perfetto
+    trace.json output path (default: <data_dir>/trace.json when
+    enabled; "off" disables). `per_host` emits one heartbeat line per
+    host per harvest in addition to the run summary line — turn off for
+    very large fleets. Not supported on the flow-engine path
+    (`experimental.use_flow_engine`), which never runs the round loop —
+    enabling both logs a warning."""
+
+    enabled: bool = False
+    interval: int = simtime.SECOND  # virtual ns between harvests
+    sink: Optional[str] = None
+    trace: Optional[str] = None
+    per_host: bool = True
+
+
+@dataclass
 class HostDefaultOptions:
     """`configuration.rs:551` — per-host options with global defaults.
 
@@ -256,6 +279,7 @@ class ConfigOptions:
     general: GeneralOptions = field(default_factory=GeneralOptions)
     network: NetworkOptions = field(default_factory=NetworkOptions)
     experimental: ExperimentalOptions = field(default_factory=ExperimentalOptions)
+    telemetry: TelemetryOptions = field(default_factory=TelemetryOptions)
     host_defaults: HostDefaultOptions = field(default_factory=HostDefaultOptions)
     hosts: dict[str, HostOptions] = field(default_factory=dict)
 
@@ -275,6 +299,7 @@ _DUR_FIELDS = {
     "unblocked_syscall_latency",
     "unblocked_vdso_latency",
     "host_heartbeat_interval",
+    "interval",  # telemetry.interval
 }
 _RATE_FIELDS = {"bandwidth_down", "bandwidth_up"}
 _BYTE_FIELDS = {"socket_send_buffer", "socket_recv_buffer", "pcap_capture_size"}
@@ -289,6 +314,20 @@ def _coerce(name: str, value: Any, default: Any) -> Any:
         return units.parse_bits_per_sec(value)
     if name in _BYTE_FIELDS:
         return units.parse_bytes(value)
+    if name in ("sink", "trace"):
+        # telemetry.sink / telemetry.trace: YAML 1.1 parses bare `off`
+        # as False and bare `on` as True (same trap as
+        # strace_logging_mode below). off -> the "off" sentinel the
+        # Manager checks for; on -> None, i.e. "enabled at the default
+        # <data_dir> path".
+        if value is False:
+            return "off"
+        if value is True:
+            return None
+        if not isinstance(value, str):
+            raise ConfigError(
+                f"{name}: expected a path, on, or off, got {value!r}")
+        return value
     if name == "log_level":
         return LogLevel.parse(value)
     if name == "interface_qdisc":
@@ -378,6 +417,8 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
             cfg.network = _fill_dataclass(NetworkOptions, value, "network")
         elif key == "experimental":
             cfg.experimental = _fill_dataclass(ExperimentalOptions, value, "experimental")
+        elif key == "telemetry":
+            cfg.telemetry = _fill_dataclass(TelemetryOptions, value, "telemetry")
         elif key in ("host_defaults", "host_option_defaults"):
             cfg.host_defaults = _fill_dataclass(HostDefaultOptions, value, key)
         elif key == "hosts":
@@ -394,6 +435,11 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
         raise ConfigError(
             f"experimental.plane_kernel: expected 'xla' or 'pallas', got "
             f"{cfg.experimental.plane_kernel!r}")
+    # unconditional (not just when enabled): the CLI --telemetry flag can
+    # flip `enabled` on AFTER parsing, and a bad interval must fail here
+    # as a ConfigError, not mid-run inside the harvester
+    if cfg.telemetry.interval is None or cfg.telemetry.interval <= 0:
+        raise ConfigError("telemetry.interval must be a positive duration")
     return cfg
 
 

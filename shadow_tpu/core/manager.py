@@ -116,7 +116,20 @@ class Manager:
         # pinned, and never used)
         self.stats = SimStats()
         self.trackers = {}
+        # unified telemetry (docs/observability.md): instantiated at
+        # run() start so a Manager built-but-never-run opens no sink.
+        # Assigned before the flow-engine early return so every Manager
+        # has the attribute (the CLI reads it after run()).
+        self.harvester = None
         if config.experimental.use_flow_engine:
+            if config.telemetry.enabled:
+                # the flow engine never runs the round loop the
+                # harvester hooks; a silently-ignored opt-in would look
+                # like a broken feature
+                log.warning(
+                    "telemetry.enabled is not supported with "
+                    "experimental.use_flow_engine; no heartbeats or "
+                    "trace will be emitted for this run")
             return
 
         # --- IP assignment + host seeds (config-declared order) -------------
@@ -314,6 +327,69 @@ class Manager:
         else:
             self.trackers = {}
             self._status_hook = None
+
+    # -- telemetry ------------------------------------------------------
+
+    def _telemetry_sink_path(self) -> Optional[str]:
+        t = self.config.telemetry
+        if t.sink == "off":
+            return None  # log-summary-only mode
+        if t.sink:
+            return t.sink
+        return (os.path.join(self.data_dir, "telemetry.jsonl")
+                if self.data_dir else None)
+
+    def _telemetry_trace_path(self) -> Optional[str]:
+        t = self.config.telemetry
+        if t.trace == "off":
+            return None
+        if t.trace:
+            return t.trace
+        return (os.path.join(self.data_dir, "trace.json")
+                if self.data_dir else None)
+
+    def _start_telemetry(self) -> None:
+        if not self.config.telemetry.enabled:
+            return
+        from ..telemetry import TelemetryHarvester
+
+        self.harvester = TelemetryHarvester(
+            interval_ns=self.config.telemetry.interval,
+            sink=self._telemetry_sink_path(),
+            host_names=[h.name for h in self.hosts],
+            per_host=self.config.telemetry.per_host,
+            # heartbeats are retained in memory only for the trace
+            # export; with the trace off they'd be dead weight on a
+            # long run (per-host records every interval)
+            retain=bool(self._telemetry_trace_path()),
+        )
+
+    def _telemetry_tick(self, now_ns: int) -> None:
+        """One harvest: device transport counters (fresh undonated
+        copies; the D2H pull is asynchronous and materializes a full
+        interval later) merged with the CPU tracker counters under the
+        host-id namespace."""
+        device = (self.transport.telemetry_arrays()
+                  if self.transport is not None else None)
+        cpu = {
+            t.host.host_id: t.counters.as_dict()
+            for t in self.trackers.values()
+        } or None
+        self.harvester.tick(now_ns, device=device, cpu=cpu)
+
+    def _finish_telemetry(self) -> None:
+        if self.harvester is None:
+            return
+        self._telemetry_tick(self.config.general.stop_time)
+        self.harvester.finalize()
+        trace_path = self._telemetry_trace_path()
+        if trace_path:
+            from ..telemetry import export
+
+            info = export.write_perfetto_trace(
+                self.harvester.heartbeats, trace_path)
+            log.info("telemetry trace: %s (%d events, %d hosts)",
+                     trace_path, info["events"], info["hosts_plotted"])
 
     # ------------------------------------------------------------------
 
@@ -609,6 +685,8 @@ class Manager:
         if self._progress_enabled and wall - self._last_progress >= 1.0:
             self._last_progress = wall
             self._print_progress(window_start)
+        if self.harvester is not None and self.harvester.due(window_start):
+            self._telemetry_tick(window_start)
 
     def run(self) -> SimStats:
         if self.config.experimental.use_flow_engine:
@@ -628,6 +706,7 @@ class Manager:
                 host.boot()
             for tracker in self.trackers.values():
                 tracker.start()
+            self._start_telemetry()
 
             # the scheduling loop (`manager.rs:392-478`)
             min_next = self._min_host_event()
@@ -703,6 +782,10 @@ class Manager:
                     if reap is not None:
                         reap()
 
+            # final telemetry harvest (after transport finalize so the
+            # device counters are settled) + trace export
+            self._finish_telemetry()
+
             # expected-final-state check happens before teardown kills
             # everyone (extend: a transport-divergence failure may
             # already be recorded above)
@@ -724,6 +807,14 @@ class Manager:
                 writer.close()
             return self.stats
         finally:
+            # crash path: preserve whatever telemetry is buffered — the
+            # run that died is exactly the one the heartbeats should
+            # explain. Idempotent after the normal _finish_telemetry.
+            if self.harvester is not None:
+                try:
+                    self.harvester.finalize()
+                except Exception as e:  # never mask the primary error
+                    log.warning("telemetry flush failed at teardown: %s", e)
             # a data-dir-less run's per-host filesystem trees live in a
             # private temp root: the caller never asked for persistence
             tmp_root = getattr(self, "_tmp_data_root", None)

@@ -30,6 +30,9 @@ class Counters:
     by_protocol: dict = field(default_factory=dict)
 
     def as_dict(self) -> dict:
+        # by_protocol sorted by key: its insertion order is first-packet
+        # order, which varies across seeds — sorted serialization keeps
+        # heartbeat log diffs between seeds stable
         return {
             "packets_in": self.packets_in,
             "packets_out": self.packets_out,
@@ -37,7 +40,7 @@ class Counters:
             "bytes_out": self.bytes_out,
             "packets_dropped": self.packets_dropped,
             "retransmitted": self.retransmitted,
-            "by_protocol": dict(self.by_protocol),
+            "by_protocol": dict(sorted(self.by_protocol.items())),
         }
 
 
@@ -89,11 +92,16 @@ class Tracker:
             c.retransmitted += 1
 
     def _heartbeat(self, host) -> None:
-        # JSON payload so parse_shadow.py can consume the line directly
+        # JSON payload so parse_shadow.py can consume the line directly.
+        # sort_keys + the sorted by_protocol above make the line a pure
+        # function of the counter VALUES; the self-rescheduling task
+        # fires for idle hosts too (zero-counter lines on a fixed
+        # cadence), so heartbeat streams from different seeds diff
+        # line-for-line
         log.info(
             "heartbeat host=%s time_ns=%d %s",
             self.host.name, self.host.now(),
-            json.dumps(self.counters.as_dict()),
+            json.dumps(self.counters.as_dict(), sort_keys=True),
         )
         if self._interval:
             self.host.schedule_task_with_delay(

@@ -1,0 +1,27 @@
+"""Device + CPU plane telemetry: on-device counters, virtual-time
+heartbeats, and trace/metrics exporters.
+
+- `metrics` — the `PlaneMetrics` SoA pytree accumulated with pure jnp
+  adds inside the jitted device kernels (zero host syncs, bitwise
+  invisible to simulation state).
+- `harvest` — the `TelemetryHarvester`: asynchronous snapshots every N
+  virtual-time windows, merged with the CPU `host/tracker.py` counters
+  under one host-id namespace, emitted as deterministic JSONL.
+- `export` — Perfetto/Chrome trace on the virtual-time axis and the
+  `stats.shadow.json` bridge into `tools/plot_shadow.py`.
+
+Design rule (docs/observability.md): telemetry may never add a device
+sync to the per-window hot path — harvest happens OUTSIDE jitted code,
+enforced statically by shadowlint SL301.
+"""
+
+from .harvest import TelemetryHarvester, unwrap_u32
+from .metrics import PlaneMetrics, add_retransmits, make_metrics
+
+__all__ = [
+    "PlaneMetrics",
+    "TelemetryHarvester",
+    "add_retransmits",
+    "make_metrics",
+    "unwrap_u32",
+]

@@ -1,0 +1,206 @@
+"""Telemetry exporters: Perfetto/Chrome trace + plot-pipeline stats.
+
+`write_perfetto_trace` lays a run's heartbeat stream out on the
+VIRTUAL-time axis in the Chrome trace-event JSON format (loadable in
+Perfetto / chrome://tracing): one process row per host carrying
+counter tracks (traffic rates and drop totals, computed as per-interval
+deltas of the cumulative heartbeat counters) plus a simulation row
+whose slices mark the harvest intervals and the windows/events each one
+covered. `ts` is virtual nanoseconds divided by 1000 — a trace "µs" IS
+a simulated µs, so two seeds' traces align perfectly for diffing.
+
+`to_plot_stats` converts the same heartbeats into the
+`stats.shadow.json` shape `tools/parse_shadow.py` produces, so
+`tools/plot_shadow.py` plots telemetry runs unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable
+
+from .harvest import MAX_FIELDS
+
+#: keys plotted as per-host counter tracks (cumulative in heartbeats;
+#: traffic is emitted as per-interval rates, drops as running totals)
+_RATE_KEYS = ("bytes_out", "bytes_in", "pkts_out", "pkts_in")
+_TOTAL_KEYS = ("drop_ring_full", "drop_qdisc", "drop_loss",
+               "retransmits", "packets_dropped", "retransmitted")
+
+
+def read_heartbeats(lines: Iterable[str]) -> list[dict]:
+    """Parse heartbeat JSONL. Lines may carry a log prefix (the
+    shadowlog-formatted `telemetry time_ns=...` form): everything
+    before the first '{' is ignored; non-JSON lines are skipped."""
+    out = []
+    for line in lines:
+        brace = line.find("{")
+        if brace < 0:
+            continue
+        try:
+            rec = json.loads(line[brace:])
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and rec.get("type") in ("sim", "host"):
+            out.append(rec)
+    return out
+
+
+def _host_series(heartbeats: list[dict]) -> dict[str, list[dict]]:
+    """Per-host heartbeat lines, keyed by host name, in time order."""
+    series: dict[str, list[dict]] = {}
+    for rec in heartbeats:
+        if rec.get("type") == "host":
+            series.setdefault(rec["host"], []).append(rec)
+    for recs in series.values():
+        recs.sort(key=lambda r: r["time_ns"])
+    return series
+
+
+def _merged_counters(rec: dict) -> dict[str, int]:
+    """One flat counter dict per host line: device counters first, CPU
+    tracker counters layered on top (distinct names, so no clobbering
+    beyond the intentional shared namespace)."""
+    out: dict[str, int] = {}
+    out.update(rec.get("device") or {})
+    for k, v in (rec.get("cpu") or {}).items():
+        if isinstance(v, (int, float)):
+            out[k] = v
+    return out
+
+
+def write_perfetto_trace(heartbeats: list[dict], path: str, *,
+                         max_hosts: int = 256) -> dict:
+    """Write a Chrome trace-event JSON file; returns a small summary
+    dict (events written, hosts plotted/dropped). Hosts are capped at
+    `max_hosts` counter rows (top talkers by total bytes) so a 4096-host
+    run stays loadable; the cap is recorded in the trace's otherData —
+    never silent."""
+    events: list[dict] = [
+        {"ph": "M", "pid": 0, "tid": 0, "name": "process_name",
+         "args": {"name": "simulation (virtual time)"}},
+    ]
+    # simulation row: one slice per harvest interval
+    sims = sorted((r for r in heartbeats if r.get("type") == "sim"),
+                  key=lambda r: r["time_ns"])
+    prev_t = 0
+    for rec in sims:
+        t = rec["time_ns"]
+        args = {k: rec[k] for k in ("windows", "events", "sort_occupancy")
+                if k in rec}
+        events.append({
+            "ph": "X", "pid": 0, "tid": 0,
+            "name": "harvest", "ts": prev_t / 1e3,
+            "dur": max(t - prev_t, 1) / 1e3, "args": args,
+        })
+        for totals_key in ("device_totals", "cpu_totals"):
+            if totals_key in rec:
+                events.append({
+                    "ph": "C", "pid": 0, "name": totals_key,
+                    "ts": t / 1e3,
+                    "args": {k: v for k, v in rec[totals_key].items()},
+                })
+        prev_t = t
+
+    series = _host_series(heartbeats)
+    by_bytes = sorted(
+        series.items(),
+        key=lambda kv: (-sum(_merged_counters(r).get("bytes_out", 0)
+                             + _merged_counters(r).get("bytes_in", 0)
+                             for r in kv[1][-1:]), kv[0]),
+    )
+    plotted, dropped = by_bytes[:max_hosts], by_bytes[max_hosts:]
+    for name, recs in sorted(plotted):
+        pid = recs[0]["host_id"]
+        events.append({"ph": "M", "pid": pid, "tid": 0,
+                       "name": "process_name", "args": {"name": name}})
+        prev: dict[str, int] = {}
+        prev_t = 0
+        for rec in recs:
+            t = rec["time_ns"]
+            c = _merged_counters(rec)
+            dt_s = max(t - prev_t, 1) / 1e9
+            rates = {k: round((c[k] - prev.get(k, 0)) / dt_s, 3)
+                     for k in _RATE_KEYS if k in c}
+            if rates:
+                events.append({"ph": "C", "pid": pid, "name": "traffic/s",
+                               "ts": t / 1e3, "args": rates})
+            totals = {k: c[k] for k in _TOTAL_KEYS if k in c}
+            if totals:
+                events.append({"ph": "C", "pid": pid, "name": "drops",
+                               "ts": t / 1e3, "args": totals})
+            prev, prev_t = c, t
+
+    trace = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "clock": "virtual simulated time (1 trace us = 1 sim us)",
+            "hosts_plotted": len(plotted),
+            "hosts_dropped_by_cap": len(dropped),
+        },
+    }
+    with open(path, "w") as fh:
+        json.dump(trace, fh, sort_keys=True)
+    return {"events": len(events), "hosts_plotted": len(plotted),
+            "hosts_dropped_by_cap": len(dropped), "path": path}
+
+
+def to_plot_stats(heartbeats: list[dict]) -> dict:
+    """The `stats.shadow.json` shape `tools/plot_shadow.py` consumes:
+    cumulative per-host counters sampled at heartbeat times. Drop
+    reasons fold into the `packets_dropped` total when the CPU tracker
+    didn't already provide one."""
+    nodes: dict[str, dict] = {}
+    for name, recs in sorted(_host_series(heartbeats).items()):
+        entry = nodes.setdefault(name, {"time_ns": [], "counters": []})
+        for rec in recs:
+            c = _merged_counters(rec)
+            if "packets_dropped" not in c:
+                c["packets_dropped"] = (
+                    c.get("drop_ring_full", 0) + c.get("drop_qdisc", 0)
+                    + c.get("drop_loss", 0))
+            entry["time_ns"].append(rec["time_ns"])
+            entry["counters"].append(c)
+    return {"nodes": nodes, "rusage": [], "meminfo": []}
+
+
+def summarize(heartbeats: list[dict], *, top: int = 10) -> dict:
+    """Run-level summary for the report CLI: final totals, drop
+    breakdown, window stats, top talkers."""
+    sims = sorted((r for r in heartbeats if r.get("type") == "sim"),
+                  key=lambda r: r["time_ns"])
+    series = _host_series(heartbeats)
+    finals = {name: _merged_counters(recs[-1])
+              for name, recs in series.items()}
+    total = {}
+    for c in finals.values():
+        for k, v in c.items():
+            if not isinstance(v, (int, float)):
+                continue
+            if k in MAX_FIELDS:  # high-water marks: fleet max, not sum
+                total[k] = max(total.get(k, 0), v)
+            else:
+                total[k] = total.get(k, 0) + v
+    talkers = sorted(
+        finals.items(),
+        key=lambda kv: (-(kv[1].get("bytes_out", 0)
+                          + kv[1].get("bytes_in", 0)), kv[0]))[:top]
+    out = {
+        "heartbeats": len(heartbeats),
+        "harvests": len(sims),
+        "hosts": len(series),
+        "last_time_ns": sims[-1]["time_ns"] if sims else 0,
+        "totals": total,
+        "top_talkers": [
+            {"host": name,
+             "bytes_out": c.get("bytes_out", 0),
+             "bytes_in": c.get("bytes_in", 0)}
+            for name, c in talkers],
+    }
+    if sims:
+        last = sims[-1]
+        for k in ("windows", "events", "sort_occupancy"):
+            if k in last:
+                out[k] = last[k]
+    return out

@@ -1,0 +1,217 @@
+"""Asynchronous telemetry harvest: device counters -> JSONL heartbeats.
+
+The harvest cycle is double-buffered so the device NEVER blocks for
+telemetry:
+
+- `tick(now_ns, ...)` first *drains* the previous tick's snapshot —
+  whose D2H copy has had a whole harvest interval to complete — then
+  starts an asynchronous copy of the current counter arrays
+  (`Array.copy_to_host_async`, falling back to holding the reference
+  when the backend has no async copy, e.g. plain numpy stand-ins in
+  tests). Nothing here calls `block_until_ready`, and the only
+  materialization (`np.asarray`) happens on buffers that are already
+  host-resident by the time they are read.
+- Heartbeats therefore trail the simulation by one harvest interval;
+  `finalize()` drains the last snapshot at end of run.
+
+Counters arrive as modular-2^32 int32 (the device dtype discipline,
+`telemetry/metrics.py`); `unwrap_u32` reconstructs monotone int64
+totals from uint32 deltas per interval. High-water-mark fields
+(`max_*`) and the CPU-side tracker counters are plain values and pass
+through unchanged.
+
+Output is deterministic JSONL (sorted keys, virtual-time stamped — no
+wall-clock anywhere): one ``sim`` summary line per harvest plus one
+``host`` line per host (disable with per_host=False for huge fleets),
+written to the configured sink and summarized through the
+`core/shadowlog.py` logging tree.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Mapping, Optional
+
+import numpy as np
+
+log = logging.getLogger("shadow_tpu.telemetry")
+
+#: PlaneMetrics fields that are high-water marks, not modular counters —
+#: they aggregate across hosts with max, never sum (export.py shares this)
+MAX_FIELDS = frozenset({"max_eg_depth", "max_in_depth"})
+_MAX_FIELDS = MAX_FIELDS
+
+_U32 = np.uint64(1 << 32)
+
+
+def unwrap_u32(prev_raw, cur_raw):
+    """Delta of a modular-2^32 counter between two raw snapshots.
+
+    Exact as long as the true delta is < 2^32 (one harvest interval's
+    worth of movement); returns int64 (array or scalar)."""
+    p = np.asarray(prev_raw).astype(np.int64) & 0xFFFFFFFF
+    c = np.asarray(cur_raw).astype(np.int64) & 0xFFFFFFFF
+    return (c - p) % np.int64(_U32)
+
+
+def _leaves(device) -> dict:
+    """Normalize a device-counter source to {name: array}: a
+    PlaneMetrics-style NamedTuple, a mapping, or None."""
+    if device is None:
+        return {}
+    if hasattr(device, "_asdict"):
+        return dict(device._asdict())
+    return dict(device)
+
+
+class TelemetryHarvester:
+    """Snapshots device counters every `interval_ns` of virtual time,
+    merges them with CPU-plane per-host counters under one host-id
+    namespace, and emits JSONL heartbeats.
+
+    `sink` is a path (opened/closed by the harvester) or a file-like
+    object (borrowed). `host_names[i]` names host_id i+1; device array
+    row i and CPU counters for host_id i+1 merge onto the same line.
+    `slot_capacity` is the static per-window sort-slot capacity
+    (N*(CE+CI) for the general plane) used to turn the accumulated
+    `sort_slots` into an occupancy ratio."""
+
+    def __init__(self, *, interval_ns: int, sink=None,
+                 host_names: Optional[list[str]] = None,
+                 slot_capacity: Optional[int] = None,
+                 per_host: bool = True, retain: bool = True):
+        if interval_ns <= 0:
+            raise ValueError("telemetry interval must be positive")
+        self.interval_ns = int(interval_ns)
+        self._next_due = int(interval_ns)
+        self._per_host = per_host
+        self._retain = retain
+        self._slot_capacity = slot_capacity
+        self._host_names = host_names
+        self._pending = None  # (time_ns, {name: array-ref}, cpu dict)
+        self._prev_raw: dict[str, np.ndarray] = {}
+        self._totals: dict[str, np.ndarray] = {}
+        self.heartbeats: list[dict] = []  # retained emitted records
+        self.emitted = 0  # JSONL lines written
+        self.harvests = 0  # completed (drained) snapshots
+        self._own_sink = isinstance(sink, str)
+        #: resolved sink path for callers reporting where heartbeats
+        #: landed (None = borrowed file object or log-summary-only)
+        self.sink_path = sink if self._own_sink else None
+        self._sink = open(sink, "w") if self._own_sink else sink
+
+    # -- cadence ---------------------------------------------------------
+
+    def due(self, now_ns: int) -> bool:
+        return now_ns >= self._next_due
+
+    # -- the harvest cycle ----------------------------------------------
+
+    def tick(self, now_ns: int, device=None,
+             cpu: Optional[Mapping[int, dict]] = None) -> None:
+        """One harvest: drain the previous snapshot (its async copy is
+        long done), then start copying the current counters. `device`
+        is a PlaneMetrics / {name: [N] array} source; `cpu` maps
+        host_id -> plain counter dict (values copied immediately —
+        they are host-side ints already)."""
+        self.drain()
+        leaves = _leaves(device)
+        for arr in leaves.values():
+            copy = getattr(arr, "copy_to_host_async", None)
+            if copy is not None:
+                copy()
+        cpu_copy = (
+            {int(hid): dict(counters) for hid, counters in cpu.items()}
+            if cpu else None
+        )
+        self._pending = (int(now_ns), leaves, cpu_copy)
+        while self._next_due <= now_ns:
+            self._next_due += self.interval_ns
+
+    def drain(self) -> None:
+        """Materialize and emit the pending snapshot, if any."""
+        if self._pending is None:
+            return
+        time_ns, leaves, cpu = self._pending
+        self._pending = None
+        device_now: dict[str, np.ndarray] = {}
+        for name, arr in leaves.items():
+            raw = np.asarray(arr)
+            if name in _MAX_FIELDS:
+                device_now[name] = raw.astype(np.int64)
+                continue
+            prev = self._prev_raw.get(name)
+            delta = unwrap_u32(0 if prev is None else prev, raw)
+            total = self._totals.get(name)
+            self._totals[name] = delta if total is None else total + delta
+            self._prev_raw[name] = raw
+            device_now[name] = self._totals[name]
+        self.harvests += 1
+        self._emit(time_ns, device_now, cpu)
+
+    def finalize(self) -> None:
+        """Drain the pending snapshot and flush/close the sink.
+        Idempotent — the Manager also calls it on the crash path."""
+        self.drain()
+        if self._sink is not None:
+            self._sink.flush()
+            if self._own_sink:
+                self._sink.close()
+                self._sink = None
+
+    # -- emission --------------------------------------------------------
+
+    def _write(self, record: dict) -> None:
+        if self._sink is not None:
+            self._sink.write(json.dumps(record, sort_keys=True) + "\n")
+        if self._retain:
+            self.heartbeats.append(record)
+        self.emitted += 1
+
+    def _host_name(self, idx: int) -> str:
+        if self._host_names and idx < len(self._host_names):
+            return self._host_names[idx]
+        return f"host{idx + 1}"
+
+    def _emit(self, time_ns: int, device: dict[str, np.ndarray],
+              cpu: Optional[dict[int, dict]]) -> None:
+        per_host = {k: v for k, v in device.items() if np.ndim(v) == 1}
+        scalars = {k: int(v) for k, v in device.items() if np.ndim(v) == 0}
+        sim: dict = {"type": "sim", "time_ns": time_ns}
+        sim.update(scalars)
+        if "sort_slots" in scalars and self._slot_capacity and \
+                scalars.get("windows"):
+            sim["sort_occupancy"] = round(
+                scalars["sort_slots"]
+                / (scalars["windows"] * self._slot_capacity), 6)
+        if per_host:
+            # high-water marks aggregate with max (a fleet-summed "max
+            # depth" would read as an impossible queue length); counters
+            # aggregate with sum
+            sim["device_totals"] = {
+                k: int(v.max() if k in _MAX_FIELDS else v.sum())
+                for k, v in sorted(per_host.items())}
+        if cpu:
+            agg: dict[str, int] = {}
+            for counters in cpu.values():
+                for k, v in counters.items():
+                    if isinstance(v, (int, np.integer)):
+                        agg[k] = agg.get(k, 0) + int(v)
+            sim["cpu_totals"] = agg
+        self._write(sim)
+        log.info("telemetry time_ns=%d %s", time_ns,
+                 json.dumps(sim, sort_keys=True))
+        if not self._per_host:
+            return
+        n = max((v.shape[0] for v in per_host.values()), default=0)
+        ids = set(range(1, n + 1)) | set(cpu.keys() if cpu else ())
+        for hid in sorted(ids):
+            rec: dict = {"type": "host", "time_ns": time_ns,
+                         "host_id": hid, "host": self._host_name(hid - 1)}
+            if per_host and hid - 1 < n:
+                rec["device"] = {k: int(v[hid - 1])
+                                 for k, v in sorted(per_host.items())}
+            if cpu and hid in cpu:
+                rec["cpu"] = cpu[hid]
+            self._write(rec)

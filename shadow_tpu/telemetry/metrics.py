@@ -1,0 +1,87 @@
+"""On-device simulation counters for the TPU plane (`PlaneMetrics`).
+
+The device plane's simulation state (`tpu/plane.py NetPlaneState`) keeps
+only the counters the simulation itself needs (n_sent, drop totals).
+Everything an operator needs to *debug* a run — per-host traffic, drops
+broken down by reason, queue-depth high-water marks, per-window event
+and sort-occupancy figures — used to exist only as intermediate traced
+values that vanished after each `window_step`. `PlaneMetrics` is the SoA
+pytree that accumulates them ON DEVICE with pure `jnp` adds inside the
+existing jitted kernels, under three hard rules:
+
+1. **Zero host syncs on the hot path.** Metrics ride the kernel carry
+   and are only pulled by the `TelemetryHarvester` every N virtual-time
+   windows, via an asynchronous D2H copy (`harvest.py`).
+2. **Bitwise-invisible to the simulation.** Every metric is computed
+   from values the window step already materialized; nothing feeds back
+   into simulation state. `tests/test_telemetry.py` pins metrics-on ==
+   metrics-off state across the qdisc matrix.
+3. **Dtype discipline.** Counters are int32 like everything else on
+   device (tpu/plane.py header) and wrap modulo 2^32 by design; the
+   harvester reconstructs monotone 64-bit totals from uint32 deltas
+   per harvest interval (`harvest.unwrap_u32`), so wraparound is safe
+   as long as any single counter moves < 2^31 between harvests.
+
+This module is dependency-light (jax/numpy only): `tpu/plane.py`
+imports it, never the other way around.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class PlaneMetrics(NamedTuple):
+    """Accumulating device counters; per-host leaves are [N] int32,
+    per-window leaves are scalar int32. All modular 2^32."""
+
+    # per-host traffic
+    pkts_out: jax.Array  # packets that left the egress gate (sent)
+    bytes_out: jax.Array  # wire bytes of those packets
+    pkts_in: jax.Array  # packets delivered to this host
+    bytes_in: jax.Array  # wire bytes delivered
+    # per-host drops, by reason
+    drop_ring_full: jax.Array  # egress/ingress ring-capacity overflow
+    drop_qdisc: jax.Array  # router AQM (CoDel) drops
+    drop_loss: jax.Array  # Bernoulli path-loss samples
+    # per-host recovery activity (fed by the device TCP layer / callers;
+    # the raw plane has no retransmit concept of its own)
+    retransmits: jax.Array
+    # per-host queue-depth high-water marks (NOT modular: maxima)
+    max_eg_depth: jax.Array
+    max_in_depth: jax.Array
+    # per-window scalars
+    windows: jax.Array  # window_step invocations accumulated
+    events: jax.Array  # send + deliver events processed
+    sort_slots: jax.Array  # occupied egress+ingress slots entering the
+    # window's sorts (occupancy ratio = sort_slots / (windows * slot
+    # capacity); the capacity is static and supplied by the harvester)
+
+
+def make_metrics(n_hosts: int) -> PlaneMetrics:
+    """A zeroed metrics pytree for `n_hosts` hosts."""
+    z = lambda: jnp.zeros((n_hosts,), jnp.int32)
+    s = lambda: jnp.zeros((), jnp.int32)
+    return PlaneMetrics(
+        pkts_out=z(), bytes_out=z(), pkts_in=z(), bytes_in=z(),
+        drop_ring_full=z(), drop_qdisc=z(), drop_loss=z(),
+        retransmits=z(), max_eg_depth=z(), max_in_depth=z(),
+        windows=s(), events=s(), sort_slots=s(),
+    )
+
+
+def add_retransmits(metrics: PlaneMetrics,
+                    per_host: jax.Array) -> PlaneMetrics:
+    """Fold per-host retransmission counts (e.g. from the device TCP
+    layer's `retransmit_count`, reduced to hosts by the caller) into the
+    metrics pytree. Pure add; safe inside jit."""
+    return metrics._replace(
+        retransmits=metrics.retransmits + per_host.astype(jnp.int32))
+
+
+def metric_names() -> tuple[str, ...]:
+    """Leaf names in pytree order (the harvester's column order)."""
+    return tuple(PlaneMetrics._fields)

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry.metrics import PlaneMetrics
 from . import codel
 
 I32_MAX = np.int32(2**31 - 1)
@@ -343,7 +344,8 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
            ctrl: jax.Array, valid: jax.Array | None = None,
            send_rel: jax.Array | None = None,
            clamp_rel: jax.Array | None = None,
-           sock: jax.Array | None = None) -> NetPlaneState:
+           sock: jax.Array | None = None, *,
+           metrics: PlaneMetrics | None = None):
     """Append a batch of outbound packets ([B] arrays; src = emitting host
     index) to the egress queues. Slots are allocated after the current valid
     entries per row; overflow beyond capacity is counted and dropped.
@@ -351,6 +353,12 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
     `send_rel` is each packet's emission time relative to the current
     window start (defaults to 0 = window start), giving per-packet deliver
     times that bitwise-match the CPU plane's now + latency.
+
+    `metrics` (static presence) threads the telemetry counters: ring
+    overflow drops accumulate into `drop_ring_full` and the call returns
+    (state', metrics') instead of state' — the simulation state itself is
+    bitwise-unchanged (the drop delta is read off the state's own
+    n_overflow_dropped counter).
 
     The CPU syscall plane calls this once per round with everything the
     sockets emitted (double-buffered host arrays in the full system)."""
@@ -391,12 +399,16 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
     eg_clamp = put(state.eg_clamp, clamp_s)
     eg_sock = put(state.eg_sock, sock_s)
     eg_valid = put(state.eg_valid, jnp.ones_like(ok))
-    return state._replace(
+    new_state = state._replace(
         eg_dst=eg_dst, eg_bytes=eg_bytes, eg_prio=eg_prio, eg_seq=eg_seq,
         eg_ctrl=eg_ctrl, eg_tsend=eg_tsend, eg_clamp=eg_clamp,
         eg_sock=eg_sock, eg_valid=eg_valid,
         n_overflow_dropped=state.n_overflow_dropped + overflow,
     )
+    if metrics is not None:
+        return new_state, metrics._replace(
+            drop_ring_full=metrics.drop_ring_full + overflow)
+    return new_state
 
 
 def chain_windows(state: NetPlaneState, params: NetPlaneParams,
@@ -487,7 +499,8 @@ def ingest_rows(state: NetPlaneState, dst: jax.Array, nbytes: jax.Array,
                 clamp_rel: jax.Array | None = None,
                 sock: jax.Array | None = None, *,
                 packed_sort: bool = True,
-                gate_idle: bool = True) -> NetPlaneState:
+                gate_idle: bool = True,
+                metrics: PlaneMetrics | None = None):
     """Append per-host batches ([N, K] arrays, row = emitting host) to the
     egress queues. The row-shaped twin of `ingest` for producers that are
     already host-major (on-device respawn loops, per-host socket emitters):
@@ -501,7 +514,12 @@ def ingest_rows(state: NetPlaneState, dst: jax.Array, nbytes: jax.Array,
     tests). `gate_idle` wraps the merge in a `lax.cond` on "any new valid
     entries", so windows that produce nothing pay one reduction instead of
     a full merge sort; both are bitwise no-ops on the result (rows are
-    front-packed, so an entry-free merge is the identity)."""
+    front-packed, so an entry-free merge is the identity).
+
+    `metrics` (static presence) accumulates ring-overflow drops into
+    `drop_ring_full` and switches the return to (state', metrics'); the
+    drop delta is read off the state's own n_overflow_dropped counter, so
+    the merge itself — and the simulation state — is untouched."""
     N, CE = state.eg_dst.shape
     if send_rel is None:
         send_rel = jnp.zeros_like(seq)
@@ -564,8 +582,16 @@ def ingest_rows(state: NetPlaneState, dst: jax.Array, nbytes: jax.Array,
         )
 
     if not gate_idle:
-        return merge(state)
-    return jax.lax.cond(valid.any(), merge, lambda st: st, state)
+        new_state = merge(state)
+    else:
+        new_state = jax.lax.cond(valid.any(), merge, lambda st: st, state)
+    if metrics is not None:
+        # overflow delta via the state counter: identical through both
+        # gate branches (the idle branch's delta is zero by construction)
+        return new_state, metrics._replace(
+            drop_ring_full=metrics.drop_ring_full
+            + (new_state.n_overflow_dropped - state.n_overflow_dropped))
+    return new_state
 
 
 # ---------------------------------------------------------------------------
@@ -864,11 +890,54 @@ def _compact_egress(eg_prio, eg_dst, eg_bytes, eg_seq, eg_ctrl, eg_tsend,
             eg_tsend_c, eg_clamp_c, eg_sock_c, eg_valid_c)
 
 
+def _accumulate_metrics(metrics: PlaneMetrics, state: NetPlaneState,
+                        sent, lost, due, overflowed, delivered,
+                        in_valid_m, router_dropped_delta,
+                        eg_bytes) -> PlaneMetrics:
+    """Section 8 (telemetry, compiled in only when a metrics pytree is
+    threaded): pure jnp adds over values the step already materialized.
+    Nothing here feeds back into simulation state — the parity tests in
+    tests/test_telemetry.py pin that metrics-on == metrics-off bitwise —
+    and nothing reads back to the host (the no-host-sync rule,
+    docs/observability.md)."""
+    sent_n = sent.sum(axis=1, dtype=jnp.int32)
+    due_n = due.sum(axis=1, dtype=jnp.int32)
+    return PlaneMetrics(
+        pkts_out=metrics.pkts_out + sent_n,
+        bytes_out=metrics.bytes_out
+        + jnp.where(sent, eg_bytes, 0).sum(axis=1, dtype=jnp.int32),
+        pkts_in=metrics.pkts_in + due_n,
+        bytes_in=metrics.bytes_in
+        + jnp.where(delivered["mask"], delivered["bytes"], 0)
+        .sum(axis=1, dtype=jnp.int32),
+        drop_ring_full=metrics.drop_ring_full + overflowed,
+        drop_qdisc=metrics.drop_qdisc + router_dropped_delta,
+        drop_loss=metrics.drop_loss
+        + lost.sum(axis=1, dtype=jnp.int32),
+        retransmits=metrics.retransmits,
+        # high-water marks at the PEAK points: egress occupancy entering
+        # the window (ingest already ran), ingress after this window's
+        # arrivals merged but before the due release
+        max_eg_depth=jnp.maximum(
+            metrics.max_eg_depth,
+            state.eg_valid.sum(axis=1, dtype=jnp.int32)),
+        max_in_depth=jnp.maximum(
+            metrics.max_in_depth,
+            in_valid_m.sum(axis=1, dtype=jnp.int32)),
+        windows=metrics.windows + 1,
+        events=metrics.events + sent_n.sum() + due_n.sum(),
+        sort_slots=metrics.sort_slots
+        + state.eg_valid.sum(dtype=jnp.int32)
+        + state.in_valid.sum(dtype=jnp.int32),
+    )
+
+
 def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Array,
                 shift_ns: jax.Array, window_ns: jax.Array, *,
                 rr_enabled: bool = True, router_aqm: bool = False,
                 no_loss: bool = False, packed_sort: bool = True,
-                kernel: str = "xla"):
+                kernel: str = "xla",
+                metrics: PlaneMetrics | None = None):
     """Advance one scheduling round [t, t + window_ns).
 
     `rr_enabled` is a static (trace-time) switch: False compiles the
@@ -901,13 +970,22 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     fused VMEM-resident Pallas kernel (`tpu.pallas_egress`), FIFO-only
     (requires rr_enabled=False), bitwise-identical to the XLA path.
 
+    `metrics` (static presence switch) threads the telemetry counters
+    (`telemetry/metrics.PlaneMetrics`) through the step: per-host
+    traffic/drop/depth counters and per-window scalars accumulate with
+    pure jnp adds over values the step already materialized — zero extra
+    host syncs, donation-compatible, and bitwise-invisible to the
+    simulation state (tests/test_telemetry.py). With metrics=None
+    (default) the telemetry section is compiled out entirely.
+
     `shift_ns` = this window's start minus the previous window's start;
     stored relative times are rebased by it. Returns
-    (state', delivered, next_event_rel) where `delivered` is a dict of
-    [N, CI] arrays masked by delivered['mask'] (packets that arrived within
-    this window, in deterministic (deliver_t, src, seq) order per host) and
-    `next_event_rel` is the min pending delivery time relative to the new
-    window start (INT32_MAX when idle).
+    (state', delivered, next_event_rel) — plus metrics' as a fourth
+    element when a metrics pytree was passed — where `delivered` is a
+    dict of [N, CI] arrays masked by delivered['mask'] (packets that
+    arrived within this window, in deterministic (deliver_t, src, seq)
+    order per host) and `next_event_rel` is the min pending delivery
+    time relative to the new window start (INT32_MAX when idle).
     """
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown plane kernel {kernel!r}: "
@@ -1080,4 +1158,10 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
         n_overflow_dropped=state.n_overflow_dropped + overflowed,
         n_delivered=state.n_delivered + due.sum(axis=1, dtype=jnp.int32),
     )
+    if metrics is not None:
+        # --- 8. telemetry accumulation (static; compiled out when off) --
+        metrics = _accumulate_metrics(
+            metrics, state, sent, lost, due, overflowed, delivered,
+            in_valid_m, rt_out.dropped - state.router.dropped, eg_bytes)
+        return new_state, delivered, next_event, metrics
     return new_state, delivered, next_event

@@ -27,6 +27,11 @@ Sections (superset of the window step's numbered stages):
 - ``window_step``     — the full composed step (sanity anchor: section
   times should roughly sum to it; XLA fusion makes the sum an upper
   bound)
+- ``window_step_telemetry`` — the full step with the PlaneMetrics
+  telemetry counters threaded (docs/observability.md). The CI
+  perf-smoke job fails when this drifts past the no-host-sync budget
+  relative to ``window_step`` — the harvester may never add a device
+  sync (or material compute) to the hot path.
 
 Drive it from the CLI: ``python tools/profile_plane.py --hosts 1024,32768``.
 """
@@ -45,6 +50,7 @@ DEFAULT_SECTIONS = (
     "rebase_refill", "rr_tensors", "qdisc_sort", "token_gate",
     "loss_latency", "ingress_compact", "routing_scatter", "release_due",
     "codel_drain", "egress_compact", "ingest_rows", "window_step",
+    "window_step_telemetry",
 )
 
 
@@ -162,6 +168,8 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
                         _route_scatter, _row_sort, _token_gate, ingest_rows,
                         window_step)
 
+    from ..telemetry import make_metrics as _zero_metrics
+
     wanted = tuple(sections) if sections is not None else DEFAULT_SECTIONS
     world = build_world(n_hosts, n_nodes=n_nodes, egress_cap=egress_cap,
                         ingress_cap=ingress_cap, seed=seed)
@@ -273,6 +281,11 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
                 st, params, rng_root, sh, window, rr_enabled=rr_enabled,
                 packed_sort=packed_sort, kernel=kernel)),
             (state, shift)),
+        "window_step_telemetry": (
+            jax.jit(lambda st, m, sh: window_step(
+                st, params, rng_root, sh, window, rr_enabled=rr_enabled,
+                packed_sort=packed_sort, kernel=kernel, metrics=m)),
+            (state, _zero_metrics(n_hosts), shift)),
     }
 
     out_sections = {}

@@ -190,6 +190,20 @@ def make_tcp_plane(n_conns: int, sack: bool = _CFG.sack,
     )
 
 
+def retransmits_by_host(plane: TcpPlane, conn_host: jax.Array,
+                        n_hosts: int) -> jax.Array:
+    """Per-host retransmission totals [N] from the per-connection
+    counters [C], for folding into the telemetry pytree
+    (`telemetry.add_retransmits`; the plane itself has no host axis —
+    `conn_host` maps each connection to its SENDING host index). Pure
+    segment-sum, safe inside jit; note the counters are CUMULATIVE, so
+    callers fold the DELTA between harvests (or fold once at the end of
+    a run, the flow-engine pattern)."""
+    return jax.ops.segment_sum(
+        plane.retransmit_count, conn_host.astype(jnp.int32),
+        num_segments=n_hosts).astype(jnp.int32)
+
+
 # ---------------------------------------------------------------------------
 # scalar helpers (everything below runs per-connection under vmap)
 # ---------------------------------------------------------------------------

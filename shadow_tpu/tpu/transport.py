@@ -123,6 +123,10 @@ class TransportState(NamedTuple):
     in_deliver: "jax.Array"  # int32 [N, CI] rel to current device base
     in_valid: "jax.Array"  # bool [N, CI]
     n_overflow: "jax.Array"  # int32 [N]
+    # telemetry counters (pure adds inside the kernels; harvested
+    # asynchronously, never read on the hot path — see telemetry/)
+    n_out: "jax.Array"  # int32 [N] packets ingested per SOURCE host
+    n_released: "jax.Array"  # int32 [N] packets released per DEST host
 
 
 class DeviceTransport:
@@ -159,6 +163,7 @@ class DeviceTransport:
             in_deliver=jnp.full((n, CI), I32_MAX, jnp.int32),
             in_valid=jnp.zeros((n, CI), bool),
             n_overflow=z((n,)),
+            n_out=z((n,)), n_released=z((n,)),
         )
         self._ingress_cap = CI
         self._compact_cap = compact_cap
@@ -256,6 +261,10 @@ class DeviceTransport:
                 in_deliver=put(st.in_deliver, o_del),
                 in_valid=put(st.in_valid, jnp.ones_like(ok)),
                 n_overflow=st.n_overflow + (incoming - placed),
+                # telemetry: captured packets per SOURCE host (out-of-range
+                # src on pad slots falls off via mode="drop")
+                n_out=st.n_out.at[o_src].add(
+                    o_valid & (o_dst < N), mode="drop"),
             )
 
         def step(st: TransportState, shift, window):
@@ -268,7 +277,9 @@ class DeviceTransport:
             next_rel = keep.min()
             st = st._replace(in_deliver=jnp.where(st.in_valid, deliver,
                                                   I32_MAX),
-                             in_valid=new_valid)
+                             in_valid=new_valid,
+                             n_released=st.n_released
+                             + due.sum(axis=1, dtype=jnp.int32))
             return st, due, deliver, next_rel
 
         def fingerprint(st: TransportState, due, deliver):
@@ -673,6 +684,24 @@ class DeviceTransport:
                 self.divergence_count, self.verified_windows)
         self._note_overflow(
             int(self._jax.device_get(self.state.n_overflow.sum())))
+
+    # -- telemetry -------------------------------------------------------
+
+    def telemetry_arrays(self) -> dict:
+        """Per-host counter arrays for the TelemetryHarvester, keyed in
+        the PlaneMetrics namespace (host index i = host_id i+1). The
+        `+ 0` copies matter: the transport kernels DONATE the state
+        pytree, so a later dispatch would invalidate the raw leaves
+        while the harvester's asynchronous D2H copy is still in flight;
+        the tiny [N] device-side copies are fresh, undonated buffers.
+        No sync happens here — materialization is the harvester's
+        drain, a full harvest interval later."""
+        st = self.state
+        return {
+            "pkts_out": st.n_out + 0,
+            "pkts_in": st.n_released + 0,
+            "drop_ring_full": st.n_overflow + 0,
+        }
 
     # -- shared ----------------------------------------------------------
 

@@ -1,0 +1,54 @@
+"""SL301 fixture: host syncs inside vs outside kernel bodies. Never
+imported.
+
+Linted under a synthetic shadow_tpu/tpu/ path.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.tpu import donating_jit
+
+
+@jax.jit
+def decorated_kernel(x):
+    y = x + 1
+    jax.device_get(y)  # violation: sync inside a jit-decorated body
+    return y
+
+
+def wrapped_kernel(x):
+    x.block_until_ready()  # violation: fn is passed to donating_jit below
+    return x * 2
+
+
+_k = donating_jit(wrapped_kernel)
+
+
+def chain(x):
+    def body(c):
+        jax.device_get(c)  # violation: while_loop body
+        return c - 1
+
+    def cond(c):
+        return c.sum() > 0
+
+    return jax.lax.while_loop(cond, body, x)
+
+
+_lam = jax.jit(lambda x: jax.device_get(x))  # violation: lambda under jit
+
+
+def release_barrier(state):
+    # NOT a kernel: the sanctioned sync point outside jitted code
+    return jax.device_get(state)
+
+
+def profiler_loop(fn, args):
+    out = fn(*args)
+    jax.block_until_ready(out)  # NOT a kernel: measurement harness
+    return out
+
+
+def plain_math(x):
+    return jnp.where(x > 0, x, 0)

@@ -163,6 +163,72 @@ def test_sl105_device_get_exempts_only_its_subexpression():
     assert [f.rule for f in findings] == ["SL105"]
 
 
+def test_sl301_sync_in_kernel_bodies():
+    src, findings = _lint_fixture(
+        "fixture_kernel_sync.py",
+        "shadow_tpu/tpu/fixture_kernel_sync.py")
+    lines = {f.line for f in findings if f.rule == "SL301"}
+    assert lines == {
+        _line_of(src, "# violation: sync inside a jit-decorated body"),
+        _line_of(src, "# violation: fn is passed to donating_jit below"),
+        _line_of(src, "# violation: while_loop body"),
+        _line_of(src, "# violation: lambda under jit"),
+    }
+
+
+def test_sl301_scoped_to_tpu_and_allows_barrier_syncs():
+    kernel = ("import jax\n"
+              "@jax.jit\n"
+              "def k(x):\n"
+              "    return jax.device_get(x)\n")
+    # tpu/-only scoping: the same kernel in core/ is out of scope
+    assert not [f for f in lint_source(kernel, "shadow_tpu/core/x.py")
+                if f.rule == "SL301"]
+    assert [f.rule for f in lint_source(kernel, "shadow_tpu/tpu/x.py")
+            if f.rule == "SL301"] == ["SL301"]
+    # a sync in a plain (non-kernel) function is the sanctioned pattern
+    barrier = ("import jax\n"
+               "def release(state):\n"
+               "    return jax.device_get(state)\n")
+    assert not [f for f in lint_source(barrier, "shadow_tpu/tpu/x.py")
+                if f.rule == "SL301"]
+
+
+def test_sl301_builtin_map_is_not_a_lax_body():
+    # Python's map()/local helpers named cond must not mark their
+    # callees as kernels — only resolved jax.lax.* control flow does
+    src = ("import jax\n"
+           "def _drain(x):\n"
+           "    return jax.device_get(x)\n"
+           "def flush(chunks):\n"
+           "    return list(map(_drain, chunks))\n"
+           "def cond(fn, x):\n"
+           "    return fn(x)\n"
+           "def use(x):\n"
+           "    return cond(_drain, x)\n")
+    assert not [f for f in lint_source(src, "shadow_tpu/tpu/x.py")
+                if f.rule == "SL301"]
+    # ...while an aliased lax import still counts
+    src2 = ("import jax\nfrom jax import lax\n"
+            "def body(c):\n"
+            "    return jax.device_get(c)\n"
+            "def drive(x):\n"
+            "    return lax.while_loop(lambda c: True, body, x)\n")
+    assert [f.rule for f in lint_source(src2, "shadow_tpu/tpu/x.py")
+            if f.rule == "SL301"] == ["SL301"]
+
+
+def test_sl301_suppression_works():
+    src = ("import jax\n"
+           "@jax.jit\n"
+           "def k(x):\n"
+           "    # shadowlint: disable=SL301 -- test-only sync\n"
+           "    return jax.device_get(x)\n")
+    findings = [f for f in lint_source(src, "shadow_tpu/tpu/x.py")
+                if f.rule == "SL301"]
+    assert len(findings) == 1 and findings[0].suppressed
+
+
 def test_clean_fixture_and_sl101_scope():
     _, findings = _lint_fixture(
         "fixture_clean.py", "shadow_tpu/core/fixture_clean.py")
@@ -175,9 +241,10 @@ def test_clean_fixture_and_sl101_scope():
 
 def test_rule_registry_complete():
     assert set(RULES) == {f"SL10{i}" for i in range(1, 6)} | {
-        f"SL20{i}" for i in range(1, 6)}
-    for rid in ("SL101", "SL102", "SL103", "SL104", "SL105"):
-        assert rule_applies(rid, "shadow_tpu/core/x.py") or rid == "SL105"
+        f"SL20{i}" for i in range(1, 6)} | {"SL301"}
+    for rid in ("SL101", "SL102", "SL103", "SL104", "SL105", "SL301"):
+        assert rule_applies(rid, "shadow_tpu/core/x.py") \
+            or rid in ("SL105", "SL301")
 
 
 # -- pass 2 rules (synthetic kernels) -------------------------------------

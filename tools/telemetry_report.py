@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""Turn a telemetry heartbeat JSONL stream into a summary, a Perfetto
+trace, and plot-pipeline stats.
+
+Input is the `telemetry.jsonl` a run writes (or a raw shadow log — lines
+are matched on their embedded JSON, so `grep telemetry shadow.log |
+telemetry_report.py -` works too). See docs/observability.md for the
+heartbeat schema.
+
+Usage:
+  python tools/telemetry_report.py run/telemetry.jsonl
+  python tools/telemetry_report.py run/telemetry.jsonl --trace trace.json
+  python tools/telemetry_report.py run/telemetry.jsonl --stats-dir out/
+      # writes out/stats.shadow.json for tools/plot_shadow.py
+  cat run/telemetry.jsonl | python tools/telemetry_report.py - --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from shadow_tpu.telemetry import export  # noqa: E402
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} TiB"
+
+
+def _print_table(summary: dict) -> None:
+    print(f"heartbeats: {summary['heartbeats']}  "
+          f"harvests: {summary['harvests']}  hosts: {summary['hosts']}  "
+          f"last virtual time: {summary['last_time_ns'] / 1e9:.3f} s")
+    for k in ("windows", "events", "sort_occupancy"):
+        if k in summary:
+            print(f"  {k}: {summary[k]}")
+    totals = summary["totals"]
+    if totals:
+        print("totals:")
+        for k in sorted(totals):
+            v = totals[k]
+            shown = _fmt_bytes(v) if k.startswith("bytes") else v
+            print(f"  {k:>18}: {shown}")
+    if summary["top_talkers"]:
+        print("top talkers (bytes out / in):")
+        for t in summary["top_talkers"]:
+            print(f"  {t['host']:>16}  {_fmt_bytes(t['bytes_out']):>12}  "
+                  f"{_fmt_bytes(t['bytes_in']):>12}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", metavar="PATH",
+                    help="heartbeat JSONL (or a shadow log; '-' = stdin)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as JSON instead of a table")
+    ap.add_argument("--trace", metavar="OUT",
+                    help="also write a Perfetto/Chrome trace.json")
+    ap.add_argument("--trace-max-hosts", type=int, default=256,
+                    help="counter-track cap for the trace (default 256)")
+    ap.add_argument("--stats-dir", metavar="DIR",
+                    help="also write DIR/stats.shadow.json for "
+                         "tools/plot_shadow.py")
+    ap.add_argument("--top", type=int, default=10,
+                    help="top talkers to list (default 10)")
+    args = ap.parse_args(argv)
+
+    if args.jsonl == "-":
+        heartbeats = export.read_heartbeats(sys.stdin)
+    else:
+        with open(args.jsonl) as fh:
+            heartbeats = export.read_heartbeats(fh)
+    if not heartbeats:
+        print("telemetry_report: no heartbeat records found",
+              file=sys.stderr)
+        return 1
+
+    summary = export.summarize(heartbeats, top=args.top)
+    if args.trace:
+        summary["trace"] = export.write_perfetto_trace(
+            heartbeats, args.trace, max_hosts=args.trace_max_hosts)
+    if args.stats_dir:
+        os.makedirs(args.stats_dir, exist_ok=True)
+        stats_path = os.path.join(args.stats_dir, "stats.shadow.json")
+        with open(stats_path, "w") as fh:
+            json.dump(export.to_plot_stats(heartbeats), fh, indent=2)
+        summary["stats"] = stats_path
+
+    if args.json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        _print_table(summary)
+        if args.trace:
+            print(f"wrote {args.trace} "
+                  f"({summary['trace']['events']} events)")
+        if args.stats_dir:
+            print(f"wrote {summary['stats']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
