@@ -40,6 +40,12 @@ PLANE_KERNEL = os.environ.get("BENCH_PLANE_KERNEL", "xla")
 # (docs/observability.md; the acceptance bar is throughput within 5%
 # of the metrics-off path)
 TELEMETRY = os.environ.get("BENCH_TELEMETRY", "0") == "1"
+# BENCH_FAULTS=1 threads NEUTRAL FaultArrays masks through every window
+# (docs/robustness.md): the chaos-smoke CI job compares this against the
+# faults-off run — the fault plane's presence switch must stay within 5%
+# when nothing fails (same bar as telemetry). Neutral masks are
+# bitwise-identity, so the measured delta is pure mask arithmetic.
+FAULTS = os.environ.get("BENCH_FAULTS", "0") == "1"
 TELEMETRY_DIR = os.environ.get("BENCH_TELEMETRY_DIR", "telemetry-bench")
 HARVEST_EVERY = int(os.environ.get("BENCH_HARVEST_EVERY", "32"))
 EGRESS_CAP = 16
@@ -67,56 +73,86 @@ def bench_tpu() -> tuple[float, int, dict | None]:
     CI = INGRESS_CAP
     window = world["window"]
 
-    def round_fn(carry, round_idx):
-        state, spawn_seq, metrics = carry
-        shift = jnp.where(round_idx == 0, jnp.int32(0), window)
-        out = window_step(state, params, key, shift, window,
-                          rr_enabled=False, kernel=PLANE_KERNEL,
-                          metrics=metrics)
-        if metrics is not None:
-            state, delivered, next_ev, metrics = out
-        else:
-            state, delivered, next_ev = out
-        # respawn: each delivered packet triggers one new packet from the
-        # receiving host to a hashed destination (deterministic). The
-        # delivered arrays are already row-shaped (row = receiving host),
-        # so the row-local ingest needs no flat cross-host sort.
-        mask, new_dst, nbytes, seq_vals, ctrl = profiling.respawn_batch(
-            delivered, spawn_seq, round_idx, N, CI)
-        state = ingest_rows(
-            state, new_dst, nbytes,
-            seq_vals,  # priority: reuse seq (FIFO-ish)
-            seq_vals, ctrl,
-            valid=mask,
-            metrics=metrics,
-        )
-        if metrics is not None:
-            state, metrics = state
-        spawn_seq = spawn_seq + mask.sum(axis=1, dtype=jnp.int32)
-        return (state, spawn_seq, metrics), mask.sum(dtype=jnp.int32)
+    # neutral fault masks when BENCH_FAULTS=1 (bitwise-identity; the
+    # measured delta is the presence-switch cost, docs/robustness.md)
+    _faults = None
+    if FAULTS:
+        from shadow_tpu.faults import neutral_faults
+
+        _faults = neutral_faults(N, M)
+
+    def make_round_fn(kernel: str):
+        def round_fn(carry, round_idx):
+            state, spawn_seq, metrics = carry
+            shift = jnp.where(round_idx == 0, jnp.int32(0), window)
+            out = window_step(state, params, key, shift, window,
+                              rr_enabled=False, kernel=kernel,
+                              faults=_faults, metrics=metrics)
+            if metrics is not None:
+                state, delivered, next_ev, metrics = out
+            else:
+                state, delivered, next_ev = out
+            # respawn: each delivered packet triggers one new packet from
+            # the receiving host to a hashed destination (deterministic).
+            # The delivered arrays are already row-shaped (row =
+            # receiving host), so the row-local ingest needs no flat
+            # cross-host sort.
+            mask, new_dst, nbytes, seq_vals, ctrl = profiling.respawn_batch(
+                delivered, spawn_seq, round_idx, N, CI)
+            state = ingest_rows(
+                state, new_dst, nbytes,
+                seq_vals,  # priority: reuse seq (FIFO-ish)
+                seq_vals, ctrl,
+                valid=mask,
+                metrics=metrics,
+            )
+            if metrics is not None:
+                state, metrics = state
+            spawn_seq = spawn_seq + mask.sum(axis=1, dtype=jnp.int32)
+            return (state, spawn_seq, metrics), mask.sum(dtype=jnp.int32)
+        return round_fn
 
     # the state pytree is donated: XLA reuses the input buffers for the
     # scan carry instead of materializing a second copy of ~20 [N, C]
     # arrays (donation contract: `state`/`state2` are dead after the call)
-    @donating_jit
-    def run(state):
-        spawn_seq = jnp.full((N,), 10_000, jnp.int32)
-        (state, _, _), delivered_counts = jax.lax.scan(
-            round_fn, (state, spawn_seq, None),
-            jnp.arange(ROUNDS, dtype=jnp.int32)
-        )
-        return state, delivered_counts.sum()
+    def make_run(kernel: str):
+        round_fn = make_round_fn(kernel)
+
+        @donating_jit
+        def run(state):
+            spawn_seq = jnp.full((N,), 10_000, jnp.int32)
+            (state, _, _), delivered_counts = jax.lax.scan(
+                round_fn, (state, spawn_seq, None),
+                jnp.arange(ROUNDS, dtype=jnp.int32)
+            )
+            return state, delivered_counts.sum()
+        return run
+
+    # self-healing (faults/healing.py): a Pallas kernel that fails to
+    # lower/compile on this backend demotes the bench to the
+    # bitwise-identical XLA path LOUDLY instead of killing the run; the
+    # JSON records the fallback so a perf line from the wrong kernel can
+    # never masquerade as a healthy pallas measurement
+    from shadow_tpu.faults import KernelFallback
+
+    run = KernelFallback(PLANE_KERNEL, make_run)
 
     # telemetry mode: same loop, chunked at the harvest cadence. The
     # metrics pytree rides the scan carry (pure jnp adds, no syncs); the
     # state is donated, the metrics argument is NOT — the harvester's
     # asynchronous D2H copy of the previous chunk's output must survive
     # this chunk's dispatch (telemetry/harvest.py).
-    @donating_jit
-    def run_chunk(state, spawn_seq, metrics, round_ids):
-        (state, spawn_seq, metrics), delivered_counts = jax.lax.scan(
-            round_fn, (state, spawn_seq, metrics), round_ids)
-        return state, spawn_seq, metrics, delivered_counts.sum()
+    def make_run_chunk(kernel: str):
+        round_fn = make_round_fn(kernel)
+
+        @donating_jit
+        def run_chunk(state, spawn_seq, metrics, round_ids):
+            (state, spawn_seq, metrics), delivered_counts = jax.lax.scan(
+                round_fn, (state, spawn_seq, metrics), round_ids)
+            return state, spawn_seq, metrics, delivered_counts.sum()
+        return run_chunk
+
+    run_chunk = KernelFallback(PLANE_KERNEL, make_run_chunk)
 
     def telemetry_chunks():
         ids = np.arange(ROUNDS, dtype=np.int32)
@@ -192,7 +228,13 @@ def bench_tpu() -> tuple[float, int, dict | None]:
 
     sent = int(np.asarray(state_out.n_sent).sum())
     events = ndel + sent  # send + deliver events, like Shadow's event count
-    return events / wall, events, telemetry_info
+    kernel_info = {
+        "requested": PLANE_KERNEL,
+        "used": (run_chunk if TELEMETRY else run).kernel,
+        "fell_back": (run_chunk if TELEMETRY else run).fell_back,
+        "faults_threaded": FAULTS,
+    }
+    return events / wall, events, telemetry_info, kernel_info
 
 
 def bench_cpu_baseline() -> float:
@@ -314,7 +356,7 @@ def _regression_guard(value: float):
 
 
 def main():
-    tpu_rate, events, telemetry_info = bench_tpu()
+    tpu_rate, events, telemetry_info, kernel_info = bench_tpu()
     cpu_rate = bench_cpu_baseline()
     compiled_rate = bench_compiled_baseline()
     guard = _regression_guard(tpu_rate)
@@ -325,6 +367,7 @@ def main():
                 "value": round(tpu_rate, 1),
                 "unit": "events/s",
                 "telemetry": telemetry_info,
+                "kernel": kernel_info,
                 "vs_baseline": round(tpu_rate / cpu_rate, 2),
                 "vs_compiled": (round(tpu_rate / compiled_rate, 3)
                                 if compiled_rate else None),

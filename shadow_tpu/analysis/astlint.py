@@ -15,6 +15,12 @@ once collecting findings. Rules scope by repo-relative path:
 - SL104 (mutable default args) applies everywhere.
 - SL105 (traced-value branching) applies to ``shadow_tpu/tpu/`` kernel
   modules.
+- SL401 (swallowed-error) applies to ``shadow_tpu/``: a broad handler
+  (bare ``except:``, ``except Exception``, ``except BaseException``)
+  whose body neither re-raises nor logs — ``except Exception: pass``
+  swallows, and bare ``except:`` additionally eats KeyboardInterrupt.
+  Narrow-typed silent handlers (``except OSError: pass``) are a
+  deliberate judgement call and are not flagged.
 - SL301 (sync-in-kernel) applies to ``shadow_tpu/tpu/``: device_get /
   block_until_ready inside a KERNEL BODY — a function that is
   jit-decorated, passed to a jit wrapper (``jax.jit``,
@@ -78,6 +84,8 @@ def rule_applies(rule: str, relpath: str) -> bool:
         return True
     if rule in ("SL105", "SL301"):
         return p.startswith("shadow_tpu/tpu/")
+    if rule == "SL401":
+        return p.startswith("shadow_tpu/")
     return False
 
 
@@ -322,6 +330,61 @@ def _sl301_findings(tree: ast.AST, imports: _Imports,
     return findings
 
 
+# -- SL401: swallowed broad exceptions -----------------------------------
+
+_BROAD_EXC = {"Exception", "BaseException"}
+
+#: call leaves that count as "the error was at least logged"
+_LOG_LEAVES = {"debug", "info", "warning", "error", "exception",
+               "critical", "log", "warn", "print", "print_exc"}
+
+
+def _exc_leaf(node: ast.expr, imports: _Imports) -> str:
+    resolved = imports.resolve(node)
+    if resolved:
+        return resolved.rsplit(".", 1)[-1]
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _handler_is_broad(handler: ast.ExceptHandler, imports: _Imports) -> bool:
+    """bare `except:`, `except Exception`, `except BaseException`, or a
+    tuple containing one of those."""
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(_exc_leaf(e, imports) in _BROAD_EXC for e in t.elts)
+    return _exc_leaf(t, imports) in _BROAD_EXC
+
+
+def _body_only_swallows(body: list[ast.stmt]) -> bool:
+    """True when the handler does literally nothing: only pass /
+    `...` / continue statements."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) \
+                and isinstance(stmt.value, ast.Constant):
+            continue  # a bare string/ellipsis expression
+        return False
+    return True
+
+
+def _body_reraises_or_logs(body: list[ast.stmt], imports: _Imports) -> bool:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call) \
+                    and _exc_leaf(node.func, imports) in _LOG_LEAVES:
+                return True
+    return False
+
+
 class _Linter(ast.NodeVisitor):
     def __init__(self, relpath: str, imports: _Imports):
         self.relpath = relpath
@@ -442,6 +505,31 @@ class _Linter(ast.NodeVisitor):
                 self._emit("SL104", default,
                            "mutable default argument; default to None "
                            "and construct inside the function")
+
+    # -- SL401: swallowed broad exceptions --------------------------------
+
+    def visit_Try(self, node: ast.Try) -> None:
+        for h in node.handlers:
+            if h.type is None:
+                # bare except: catches KeyboardInterrupt/SystemExit too;
+                # acceptable only when the error is re-raised or logged
+                if not _body_reraises_or_logs(h.body, self.imports):
+                    self._emit(
+                        "SL401", h,
+                        "bare `except:` without re-raise or log swallows "
+                        "every error (including KeyboardInterrupt); "
+                        "catch a concrete exception type, or re-raise/"
+                        "log (fault-plane error discipline, "
+                        "docs/robustness.md)")
+            elif _handler_is_broad(h, self.imports) \
+                    and _body_only_swallows(h.body):
+                self._emit(
+                    "SL401", h,
+                    "broad exception swallowed (`except Exception: "
+                    "pass`): a real fault disappears instead of "
+                    "surfacing as a structured error; narrow the type "
+                    "or log it (docs/robustness.md)")
+        self.generic_visit(node)
 
     # -- SL105: traced-value branching -----------------------------------
 

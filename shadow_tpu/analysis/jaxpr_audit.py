@@ -230,11 +230,12 @@ class _StubRouting:
 
 def _plane_entry(rr_enabled: bool, router_aqm: bool, no_loss: bool,
                  packed_sort: bool = True, kernel: str = "xla",
-                 telemetry: bool = False):
+                 telemetry: bool = False, faults: bool = False):
     def build():
         import jax
         import jax.numpy as jnp
 
+        from ..faults.plane import neutral_faults
         from ..telemetry import make_metrics
         from ..tpu import plane
 
@@ -250,6 +251,17 @@ def _plane_entry(rr_enabled: bool, router_aqm: bool, no_loss: bool,
         state = plane.make_state(n, egress_cap=8, ingress_cap=8,
                                  params=params)
         root = jax.random.key(0)
+
+        if faults:
+            def fn(state, fault_arrays, shift, window):
+                return plane.window_step(
+                    state, params, root, shift, window,
+                    rr_enabled=rr_enabled, router_aqm=router_aqm,
+                    no_loss=no_loss, packed_sort=packed_sort,
+                    kernel=kernel, faults=fault_arrays)
+
+            return fn, (state, neutral_faults(n, m), jnp.int32(0),
+                        jnp.int32(10_000_000))
 
         if telemetry:
             def fn(state, metrics, shift, window):
@@ -425,6 +437,8 @@ def default_entries() -> list[AuditEntry]:
                    _plane_entry(False, False, True, kernel="pallas")),
         AuditEntry("window_step[telemetry]", "shadow_tpu.tpu.plane",
                    _plane_entry(True, True, False, telemetry=True)),
+        AuditEntry("window_step[faults]", "shadow_tpu.tpu.plane",
+                   _plane_entry(True, True, False, faults=True)),
         AuditEntry("chain_windows", "shadow_tpu.tpu.plane",
                    _chain_entry()),
         AuditEntry("tcp_event_step", "shadow_tpu.tpu.tcp",

@@ -91,6 +91,15 @@ RULES: dict[str, RuleInfo] = {
             "and turns into a host callback under jit",
         ),
         RuleInfo(
+            "SL401", "swallowed-error",
+            "broad exception swallow (`except Exception: pass` or a "
+            "bare `except:` without re-raise)",
+            "the fault plane's whole premise is that failures surface "
+            "as structured, attributable events (docs/robustness.md); "
+            "a silently swallowed broad exception turns a real fault "
+            "into an unexplained hang or wrong result",
+        ),
+        RuleInfo(
             "SL201", "x64-leak",
             "64-bit dtype (float64/int64) appearing in a device jaxpr",
             "the device plane is int32/float32 by contract "

@@ -18,8 +18,20 @@ import shutil
 import sys
 
 from .core import shadowlog, units
-from .core.config import ConfigOptions, load_config_file
+from .core.config import ConfigError, ConfigOptions, load_config_file
 from .core.manager import Manager
+
+# Documented exit codes (docs/robustness.md; asserted in tests/test_cli.py).
+# 1 keeps its historical meaning — the SIMULATION failed (a process missed
+# its expected final state, a mirrored transport diverged, a data dir was
+# refused) — while configuration, watchdog, and crash failures get their
+# own codes so wrappers can tell "fix the config" from "file a bug" from
+# "inspect the emergency checkpoint".
+EXIT_OK = 0
+EXIT_SIM_FAILURE = 1
+EXIT_CONFIG = 2
+EXIT_WATCHDOG = 3
+EXIT_CRASH = 4
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -59,6 +71,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="enable the telemetry harvester (overrides telemetry.enabled; "
              "heartbeat JSONL + Perfetto trace land in the data directory)",
     )
+    p.add_argument(
+        "--resume", metavar="CKPT",
+        help="resume from a checkpoint directory (flow-engine runs: "
+             "completed buckets are skipped and the continued run is "
+             "bitwise-identical to an uninterrupted one; see "
+             "docs/robustness.md)",
+    )
     return p
 
 
@@ -97,6 +116,7 @@ def _config_as_dict(config: ConfigOptions) -> dict:
         "network": conv(config.network),
         "experimental": conv(config.experimental),
         "telemetry": conv(config.telemetry),
+        "faults": conv(config.faults),
         "hosts": {name: conv(h) for name, h in config.hosts.items()},
     }
 
@@ -107,7 +127,7 @@ def main(argv=None) -> int:
         config = load_config_file(args.config)
     except Exception as e:
         print(f"shadow_tpu: config error: {e}", file=sys.stderr)
-        return 1
+        return EXIT_CONFIG
     _apply_overrides(config, args)
 
     if args.show_config:
@@ -124,24 +144,58 @@ def main(argv=None) -> int:
 
     data_dir = config.general.data_directory
     if os.path.exists(data_dir):
-        if not args.force:
+        if args.resume:
+            # resuming continues the SAME run: the data directory (which
+            # usually holds the checkpoint being resumed) is reused in
+            # place, never wiped — wiping would destroy the checkpoint
+            pass
+        elif not args.force:
             print(
                 f"shadow_tpu: data directory {data_dir!r} exists "
                 "(pass -e/--force to replace it)",
                 file=sys.stderr,
             )
-            return 1
-        shutil.rmtree(data_dir)
-    os.makedirs(data_dir)
+            return EXIT_SIM_FAILURE
+        else:
+            shutil.rmtree(data_dir)
+    os.makedirs(data_dir, exist_ok=True)
 
     import yaml
 
     with open(os.path.join(data_dir, "processed-config.yaml"), "w") as fh:
         yaml.safe_dump(_config_as_dict(config), fh, sort_keys=False)
 
-    mgr = Manager(config, data_dir=data_dir)
-    log.info("simulation starting: %d hosts", len(mgr.hosts))
-    stats = mgr.run()
+    from .faults.checkpoint import CheckpointError
+    from .faults.watchdog import WatchdogError
+
+    try:
+        mgr = Manager(config, data_dir=data_dir)
+        mgr.resume_from = args.resume
+        log.info("simulation starting: %d hosts", len(mgr.hosts))
+        stats = mgr.run()
+    except ConfigError as e:
+        print(f"shadow_tpu: config error: {e}", file=sys.stderr)
+        return EXIT_CONFIG
+    except CheckpointError as e:
+        print(f"shadow_tpu: checkpoint error: {e}", file=sys.stderr)
+        return EXIT_CONFIG
+    except WatchdogError as e:
+        # structured hang: blame is in the message, forensics in the
+        # emergency checkpoint the Manager dropped before raising
+        log.error("watchdog abort: %s", e)
+        print(f"shadow_tpu: watchdog abort: {e}", file=sys.stderr)
+        return EXIT_WATCHDOG
+    except Exception:
+        import traceback
+
+        traceback.print_exc()
+        print(
+            "shadow_tpu: simulation crashed (see traceback above); an "
+            "emergency checkpoint was dropped in the data directory's "
+            "checkpoints/ if one could be written",
+            file=sys.stderr,
+        )
+        return EXIT_CRASH
     log.info(
         "simulation finished: %d rounds, %d packets, %.2fs wall",
         stats.rounds, stats.packets_sent, stats.wall_seconds,
@@ -162,8 +216,8 @@ def main(argv=None) -> int:
     if stats.process_failures:
         for name, why in stats.process_failures:
             log.error("process failure: %s: %s", name, why)
-        return 1
-    return 0
+        return EXIT_SIM_FAILURE
+    return EXIT_OK
 
 
 if __name__ == "__main__":

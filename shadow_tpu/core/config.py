@@ -219,6 +219,52 @@ class TelemetryOptions:
 
 
 @dataclass
+class FaultCheckpointOptions:
+    """`faults.checkpoint` — periodic sim-state checkpoints
+    (docs/robustness.md). `interval` is VIRTUAL time between
+    checkpoints (None = only the emergency checkpoint on a crash).
+    `directory` defaults to <data_dir>/checkpoints. `keep` bounds how
+    many periodic checkpoints are retained (oldest pruned)."""
+
+    interval: Optional[int] = None  # virtual ns; None = off
+    directory: Optional[str] = None
+    keep: int = 2
+
+
+@dataclass
+class FaultsOptions:
+    """The `faults:` config block (no reference counterpart — failure
+    as a first-class, seeded simulation input; docs/robustness.md).
+
+    `events` is a list of raw event mappings and `random` a mapping of
+    seeded generators — both compiled and validated by
+    `faults/schedule.compile_schedule` (at Manager build time, so a bad
+    event is a ConfigError before anything runs). `watchdog` is the
+    WALL-clock round timeout (a hung managed process becomes a
+    structured WatchdogError instead of a wedged simulator; wall time
+    here can only change failure detection, never results). `seed`
+    overrides `general.seed` for the fault-schedule RNG stream.
+    `kernel_fallback` lets a failing Pallas plane kernel degrade to the
+    XLA path (logged loudly) instead of killing the run;
+    `device_retries`/`retry_backoff` govern the transient-device-error
+    retry loop around transport dispatches."""
+
+    seed: Optional[int] = None
+    events: list = field(default_factory=list)
+    random: Optional[dict] = None
+    respawn_on_reboot: bool = True
+    watchdog: Optional[int] = None  # WALL ns
+    kernel_fallback: bool = True
+    device_retries: int = 3
+    retry_backoff: int = 50 * simtime.MILLISECOND  # WALL ns
+    checkpoint: FaultCheckpointOptions = field(
+        default_factory=FaultCheckpointOptions)
+
+    def any_injection(self) -> bool:
+        return bool(self.events or self.random)
+
+
+@dataclass
 class HostDefaultOptions:
     """`configuration.rs:551` — per-host options with global defaults.
 
@@ -280,6 +326,7 @@ class ConfigOptions:
     network: NetworkOptions = field(default_factory=NetworkOptions)
     experimental: ExperimentalOptions = field(default_factory=ExperimentalOptions)
     telemetry: TelemetryOptions = field(default_factory=TelemetryOptions)
+    faults: FaultsOptions = field(default_factory=FaultsOptions)
     host_defaults: HostDefaultOptions = field(default_factory=HostDefaultOptions)
     hosts: dict[str, HostOptions] = field(default_factory=dict)
 
@@ -299,7 +346,9 @@ _DUR_FIELDS = {
     "unblocked_syscall_latency",
     "unblocked_vdso_latency",
     "host_heartbeat_interval",
-    "interval",  # telemetry.interval
+    "interval",  # telemetry.interval / faults.checkpoint.interval
+    "watchdog",  # faults.watchdog (WALL-clock round timeout)
+    "retry_backoff",  # faults.retry_backoff (WALL-clock)
 }
 _RATE_FIELDS = {"bandwidth_down", "bandwidth_up"}
 _BYTE_FIELDS = {"socket_send_buffer", "socket_recv_buffer", "pcap_capture_size"}
@@ -384,6 +433,20 @@ def _fill_dataclass(cls, raw: dict, where: str):
             )
         elif f.name == "host_options":
             setattr(obj, key, _fill_dataclass(HostDefaultOptions, value, f"{where}.host_options"))
+        elif f.name == "checkpoint" and cls is FaultsOptions:
+            setattr(obj, key, _fill_dataclass(
+                FaultCheckpointOptions, value, f"{where}.checkpoint"))
+        elif f.name in ("events", "random") and cls is FaultsOptions:
+            # raw event/generator mappings; validated by
+            # faults/schedule.compile_schedule at Manager build time
+            if f.name == "events" and value is not None \
+                    and not isinstance(value, list):
+                raise ConfigError(f"{where}.events: expected a list")
+            if f.name == "random" and value is not None \
+                    and not isinstance(value, dict):
+                raise ConfigError(f"{where}.random: expected a mapping")
+            setattr(obj, key, value if value is not None
+                    else getattr(obj, key))
         else:
             setattr(obj, key, _coerce(key, value, getattr(obj, f.name)))
     return obj
@@ -419,6 +482,8 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
             cfg.experimental = _fill_dataclass(ExperimentalOptions, value, "experimental")
         elif key == "telemetry":
             cfg.telemetry = _fill_dataclass(TelemetryOptions, value, "telemetry")
+        elif key == "faults":
+            cfg.faults = _fill_dataclass(FaultsOptions, value, "faults")
         elif key in ("host_defaults", "host_option_defaults"):
             cfg.host_defaults = _fill_dataclass(HostDefaultOptions, value, key)
         elif key == "hosts":
@@ -440,6 +505,18 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
     # as a ConfigError, not mid-run inside the harvester
     if cfg.telemetry.interval is None or cfg.telemetry.interval <= 0:
         raise ConfigError("telemetry.interval must be a positive duration")
+    if cfg.faults.checkpoint.interval is not None \
+            and cfg.faults.checkpoint.interval <= 0:
+        raise ConfigError(
+            "faults.checkpoint.interval must be a positive duration")
+    if cfg.faults.checkpoint.keep < 1:
+        raise ConfigError("faults.checkpoint.keep must be >= 1")
+    if cfg.faults.watchdog is not None and cfg.faults.watchdog <= 0:
+        raise ConfigError("faults.watchdog must be a positive duration")
+    if cfg.faults.device_retries < 0:
+        raise ConfigError("faults.device_retries must be >= 0")
+    if cfg.faults.retry_backoff < 0:
+        raise ConfigError("faults.retry_backoff must be >= 0")
     return cfg
 
 

@@ -132,3 +132,12 @@ class EventQueue:
 
     def __bool__(self) -> bool:
         return bool(self._heap)
+
+    def purge(self) -> list["Event"]:
+        """Drop every pending event (the fault plane's host-crash
+        semantics: a crash loses the queue). The monotonic-pop floor is
+        KEPT — post-reboot events must still sort after everything the
+        host already executed."""
+        out = [event for _key, event in self._heap]
+        self._heap.clear()
+        return out

@@ -172,11 +172,36 @@ def _bucket_window(lat_us: int) -> int:
     return best if best else int(w)  # sub-ladder latency: exact window
 
 
-def run_flow_simulation(config, routing, stats):
+def _plan_fingerprint(plan: FlowPlan) -> str:
+    """Digest of everything that determines a flow run's results: a
+    resume against a DIFFERENT config/seed must refuse, not silently
+    merge incompatible bucket results."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(repr((plan.client, plan.server, plan.window_us, plan.stop_us,
+                   plan.seed)).encode())
+    for arr in (plan.size, plan.start_us, plan.latency_us,
+                plan.latency_back_us, plan.loss, plan.loss_back):
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def run_flow_simulation(config, routing, stats, *, checkpoint_dir=None,
+                        resume_from=None):
     """Execute the config's tgen workload on the device flow engine and
     fill `stats` (a `SimStats`) the way the round loop would: segments
     as events/packets, wire drops as packet drops, incomplete transfers
-    as process failures against the clients' expected exit 0."""
+    as process failures against the clients' expected exit 0.
+
+    Checkpoint/resume (docs/robustness.md): latency buckets are
+    independent worlds, so bucket completion is an EXACT resume unit.
+    With `checkpoint_dir` set, a ``flow-progress`` checkpoint lands
+    after every finished bucket; `resume_from` restores it (fingerprint
+    -verified against this config+seed) and recomputes only the
+    remaining buckets — the merged results are bitwise-identical to an
+    uninterrupted run because per-bucket results are deterministic and
+    disjoint."""
     from ..tpu import enable_compilation_cache, floweng
 
     enable_compilation_cache()
@@ -196,7 +221,63 @@ def run_flow_simulation(config, routing, stats):
     rounds = 0
     total_retries = 0
     ring_dirty = False  # a bucket's FINAL run still had ring drops
+    fingerprint = _plan_fingerprint(plan)
+    done_buckets: set[int] = set()
+    if resume_from:
+        from ..faults.checkpoint import CheckpointError, load_checkpoint
+
+        meta, arrays = load_checkpoint(resume_from)
+        if meta.get("kind") != "flow":
+            raise CheckpointError(
+                f"{resume_from}: kind {meta.get('kind')!r} is not a "
+                f"flow-engine checkpoint")
+        if meta.get("plan_fingerprint") != fingerprint:
+            raise CheckpointError(
+                f"{resume_from}: checkpoint was written by a different "
+                f"config/seed (plan fingerprint mismatch); refusing to "
+                f"merge incompatible bucket results")
+        complete_us = arrays["complete_us"].astype(np.int64)
+        bytes_read = arrays["bytes_read"].astype(np.int64)
+        c = meta["counters"]
+        segments, wire_drops = c["segments"], c["wire_drops"]
+        queue_drops, retransmits = c["queue_drops"], c["retransmits"]
+        rounds, total_retries = c["rounds"], c["retries"]
+        ring_dirty = bool(c["ring_dirty"])
+        done_buckets = set(meta["done_buckets"])
+        log.info("flow engine: resumed from %s (%d/%d bucket(s) done)",
+                 resume_from, len(done_buckets), len(buckets))
+
+    def _bucket_checkpoint():
+        if not checkpoint_dir:
+            return
+        import os
+
+        from ..faults.checkpoint import write_checkpoint
+
+        write_checkpoint(
+            os.path.join(checkpoint_dir, "flow-progress"),
+            meta={
+                "kind": "flow",
+                "plan_fingerprint": fingerprint,
+                "done_buckets": sorted(done_buckets),
+                "counters": {
+                    "segments": int(segments),
+                    "wire_drops": int(wire_drops),
+                    "queue_drops": int(queue_drops),
+                    "retransmits": int(retransmits),
+                    "rounds": int(rounds),
+                    "retries": int(total_retries),
+                    "ring_dirty": bool(ring_dirty),
+                },
+            },
+            arrays={"complete_us": complete_us, "bytes_read": bytes_read},
+        )
+
     for window_us, idx in sorted(buckets.items(), reverse=True):
+        if window_us in done_buckets:
+            log.info("flow engine: bucket window %d us already complete "
+                     "in the resumed checkpoint; skipping", window_us)
+            continue
         Fb = len(idx)
         pad = max(8, 1 << (Fb - 1).bit_length()) - Fb
         sel = np.asarray(idx)
@@ -254,6 +335,8 @@ def run_flow_simulation(config, routing, stats):
         retransmits += res["retransmits"]
         rounds += int(round(sim_s * 1e6 / window_us))
         total_retries += retries
+        done_buckets.add(window_us)
+        _bucket_checkpoint()
 
     ok = bytes_read >= plan.size
     for f in np.nonzero(~ok)[0]:

@@ -42,6 +42,11 @@ class SimStats:
     events_executed: int = 0
     packets_sent: int = 0
     packets_dropped: int = 0
+    # injected fault-plane drops (crashed endpoints, corruption bursts,
+    # purged queues) — never folded into packets_dropped, so the final
+    # stats keep the same taxonomy as the tracker/telemetry counters
+    # (docs/robustness.md)
+    packets_dropped_fault: int = 0
     sim_time_ns: int = 0
     wall_seconds: float = 0.0
     process_failures: list = field(default_factory=list)
@@ -51,6 +56,7 @@ class SimStats:
             "rounds": self.rounds,
             "packets_sent": self.packets_sent,
             "packets_dropped": self.packets_dropped,
+            "packets_dropped_fault": self.packets_dropped_fault,
             "sim_time_ns": self.sim_time_ns,
             "wall_seconds": self.wall_seconds,
             "process_failures": list(self.process_failures),
@@ -121,7 +127,35 @@ class Manager:
         # Assigned before the flow-engine early return so every Manager
         # has the attribute (the CLI reads it after run()).
         self.harvester = None
+        # fault-plane / checkpoint state shared by BOTH run paths (the
+        # flow engine checkpoints per bucket; the round loop per
+        # interval + on the crash path) — initialized before the
+        # flow-engine early return so every Manager has the attributes
+        self.fault_schedule = None
+        self._watchdog = None
+        self._last_window_start = 0
+        self.resume_from = None  # set by the CLI's --resume
+        self._ckpt_dir = config.faults.checkpoint.directory or (
+            os.path.join(self.data_dir, "checkpoints")
+            if self.data_dir else None)
+        self._next_ckpt_ns = None
+        if config.faults.checkpoint.interval:
+            if self._ckpt_dir is None:
+                log.warning(
+                    "faults.checkpoint.interval is set but there is no "
+                    "data directory and no faults.checkpoint.directory; "
+                    "periodic checkpoints are disabled for this run")
+            else:
+                self._next_ckpt_ns = config.faults.checkpoint.interval
         if config.experimental.use_flow_engine:
+            if config.faults.any_injection() or config.faults.watchdog:
+                # the flow engine has no hosts, processes, or round loop
+                # to inject against; a silently-ignored schedule would
+                # look like a broken feature
+                log.warning(
+                    "faults injection/watchdog are not supported with "
+                    "experimental.use_flow_engine; only checkpoint/resume "
+                    "applies to flow-engine runs")
             if config.telemetry.enabled:
                 # the flow engine never runs the round loop the
                 # harvester hooks; a silently-ignored opt-in would look
@@ -253,6 +287,36 @@ class Manager:
                 compact_cap=config.experimental.tpu_compact_cap,
             )
             self.shared.device_transport = self.transport
+            # self-healing: transient device errors retry with backoff
+            # before the crash path (faults/healing.py)
+            self.transport.retry_attempts = config.faults.device_retries
+            self.transport.retry_backoff_s = config.faults.retry_backoff / 1e9
+
+        # --- fault plane (faults/schedule.py; docs/robustness.md) -----------
+        # compiled HERE so a bad `faults:` block dies as a ConfigError
+        # before anything runs; the schedule is shared with every worker
+        # through the send-packet overlay
+        if config.faults.any_injection():
+            from ..faults.schedule import compile_schedule
+
+            node_map = {
+                opts.network_node_id: self.routing.node_index(
+                    opts.network_node_id)
+                for opts in config.hosts.values()
+            }
+            self.fault_schedule = compile_schedule(
+                config.faults,
+                host_names=[h.name for h in self.hosts],
+                n_nodes=len(self.routing.latency_ns),
+                seed=config.general.seed,
+                stop_time_ns=config.general.stop_time,
+                node_index=lambda nid: node_map[nid],
+            )
+            self.fault_schedule.set_node_map(node_map)
+            self.shared.fault_plane = self.fault_schedule
+            log.info("fault plane: %d scheduled event(s), fingerprint %s",
+                     len(self.fault_schedule.events),
+                     self.fault_schedule.fingerprint()[:12])
 
         # parallelism = min(cores, hosts) unless configured
         par = config.general.parallelism
@@ -443,6 +507,9 @@ class Manager:
         from .event import TaskRef
 
         self._spawned = getattr(self, "_spawned", [])
+        # per-host spawn registry: the fault plane's reboot-respawn and
+        # the watchdog's blame collector both need (cell, spawn) by host
+        self._respawn_by_host = getattr(self, "_respawn_by_host", {})
         for i, popt in enumerate(opts.processes):
             # app-registry coroutines first; real executables run as managed
             # native processes under the interposition shim
@@ -498,6 +565,8 @@ class Manager:
                     TaskRef(shutdown, "process-shutdown"), popt.shutdown_time
                 )
             self._spawned.append((proc_name, popt, cell))
+            self._respawn_by_host.setdefault(host_name, []).append(
+                (proc_name, popt, cell, spawn))
 
     def _check_final_states(self) -> list:
         """Compare each process against expected_final_state
@@ -670,6 +739,157 @@ class Manager:
             file=sys.stderr, flush=True,
         )
 
+    # -- fault plane + self-healing (docs/robustness.md) ----------------
+
+    def _fault_horizon(self, min_next):
+        """Fold the next fault instant into the window computation so a
+        round boundary lands EXACTLY on each scheduled fault — the
+        SIGKILL/respawn happens at the configured virtual instant, not
+        at whatever boundary drifts past it."""
+        if self.fault_schedule is None:
+            return min_next
+        nxt = self.fault_schedule.peek_next_ns()
+        if nxt is None or nxt >= self.controller.stop_time:
+            return min_next
+        return nxt if min_next is None else min(min_next, nxt)
+
+    def _clamp_window_to_fault(self, start: int, end: int) -> int:
+        """A fault instant STRICTLY INSIDE a window would otherwise fire
+        a full runahead late (the start-side fold above only helps when
+        the fault is the earliest event): shrink the round end to the
+        fault instant so the next boundary lands on it. Shorter windows
+        are always legal under conservative PDES."""
+        if self.fault_schedule is None:
+            return end
+        nxt = self.fault_schedule.peek_next_ns()
+        if nxt is not None and start < nxt < end:
+            return nxt
+        return end
+
+    def _apply_faults(self, now_ns: int) -> None:
+        """Fire every fault event due at this round boundary, mirroring
+        the schedule's mask state onto the CPU objects (the device masks
+        are read off the same schedule by device-plane drivers)."""
+        if self.fault_schedule is None:
+            return
+        from .event import TaskRef
+
+        link_changed = False
+        for ev in self.fault_schedule.advance(now_ns):
+            log.warning("fault plane: firing %s", ev.describe())
+            if ev.kind in ("link_degrade", "link_restore"):
+                link_changed = True  # table rebuilt ONCE after the loop
+                continue
+            host = self.hosts_by_name[ev.host]
+            if ev.kind == "host_crash":
+                purged = host.fault_crash()
+                killed = 0
+                for _pn, _popt, cell, _spawn in \
+                        self._respawn_by_host.get(ev.host, ()):
+                    proc = cell.get("proc")
+                    if proc is not None and proc.is_alive:
+                        proc.stop(9)  # SIGKILL at the virtual instant
+                        killed += 1
+                log.warning(
+                    "fault plane: host %s crashed at %d (%d event(s) "
+                    "purged, %d process(es) SIGKILLed)",
+                    ev.host, now_ns, purged, killed)
+            elif ev.kind == "host_reboot":
+                host.fault_reboot()
+                respawned = 0
+                if self.config.faults.respawn_on_reboot:
+                    for pn, popt, cell, spawn in \
+                            self._respawn_by_host.get(ev.host, ()):
+                        t = max(now_ns, popt.start_time)
+                        host.schedule_task_at(
+                            TaskRef(spawn, "process-respawn"), t)
+                        respawned += 1
+                # crashed hosts lost their heartbeat tasks with the
+                # queue; restart the cadence at the reboot instant
+                for tracker in self.trackers.values():
+                    if tracker.host is host:
+                        tracker.start()
+                log.warning(
+                    "fault plane: host %s rebooted at %d (%d process "
+                    "respawn(s) scheduled)", ev.host, now_ns, respawned)
+            elif ev.kind in ("iface_down", "iface_up"):
+                host.fault_set_iface(ev.kind == "iface_up")
+            elif ev.kind in ("host_degrade", "host_restore"):
+                div = ev.bandwidth_div if ev.kind == "host_degrade" else 1
+                host.relay_inet_out.set_fault_divisor(div)
+            # corrupt_burst/_corrupt_end live entirely in the schedule
+            # masks the send filter reads
+        if link_changed and self.transport is not None:
+            # keep on-device deliver arithmetic bit-identical to the CPU
+            # overlay. One rebuild per boundary, not per event — the
+            # schedule's lat_mult already reflects every event fired
+            # above, and apply_fault_latency flushes the mirrored batch
+            # and recompiles all four kernels (expensive)
+            self.transport.apply_fault_latency(self.fault_schedule.lat_mult)
+
+    def _collect_watchdog_blame(self, round_start_ns: int):
+        """Runs ON THE WATCHDOG THREAD while workers may still be
+        blocked: read-only over the process table + pidwatcher, builds
+        the per-host blame the WatchdogError carries."""
+        from ..faults.watchdog import HostBlame
+        from ..process.pidwatcher import get_watcher
+
+        watched = set(get_watcher().watched_pids())
+        blame = []
+        for host_name in sorted(self._respawn_by_host):
+            procs, pids, wpids = [], [], []
+            for proc_name, _popt, cell, _spawn in \
+                    self._respawn_by_host[host_name]:
+                proc = cell.get("proc")
+                if proc is None or not getattr(proc, "is_alive", False):
+                    continue
+                procs.append(proc_name)
+                native = getattr(proc, "proc", None)
+                pid = getattr(native, "pid", None)
+                if pid:
+                    pids.append(pid)
+                    if pid in watched:
+                        wpids.append(pid)
+            if procs:
+                blame.append(HostBlame(host_name, procs, pids, wpids))
+        return blame
+
+    def _run_round_guarded(self, start: int, active, end: int):
+        """scheduler.run_round under the round watchdog: a wedged
+        managed process becomes a WatchdogError with host blame instead
+        of a simulator that hangs forever."""
+        if self._watchdog is None:
+            return self.scheduler.run_round(active, end)
+        with self._watchdog.guard(start):
+            sched_min = self.scheduler.run_round(active, end)
+        if self._watchdog.strike is not None:
+            raise self._watchdog.strike
+        return sched_min
+
+    def _checkpoint_due(self, window_start: int) -> None:
+        interval = self.config.faults.checkpoint.interval
+        if (self._next_ckpt_ns is None or self._ckpt_dir is None
+                or window_start < self._next_ckpt_ns):
+            return
+        from ..faults.checkpoint import write_manager_checkpoint
+
+        write_manager_checkpoint(
+            self, self._ckpt_dir, window_start, reason="periodic",
+            keep=self.config.faults.checkpoint.keep)
+        while self._next_ckpt_ns <= window_start:
+            self._next_ckpt_ns += interval
+
+    def _emergency_checkpoint(self) -> None:
+        """Crash/watchdog path: preserve the forensic state of exactly
+        the run that needs explaining. Never raises."""
+        if self._ckpt_dir is None:
+            return
+        from ..faults.checkpoint import write_manager_checkpoint
+
+        write_manager_checkpoint(
+            self, self._ckpt_dir, self._last_window_start,
+            reason="emergency")
+
     def _round_upkeep(self, window_start: int) -> None:
         """Per-round heartbeat/watchdog/progress pass (`manager.rs:439-453`)."""
         if (self._heartbeat_interval
@@ -687,19 +907,45 @@ class Manager:
             self._print_progress(window_start)
         if self.harvester is not None and self.harvester.due(window_start):
             self._telemetry_tick(window_start)
+        self._checkpoint_due(window_start)
 
     def run(self) -> SimStats:
         if self.config.experimental.use_flow_engine:
             # tgen-shaped workload on the device flow engine: the round
             # loop never runs; flowplan reconciles completions into the
-            # same SimStats surface (failures, packets, sim time)
+            # same SimStats surface (failures, packets, sim time).
+            # Checkpoints are bucket-granular (flowplan.py): --resume
+            # skips completed buckets, results bitwise-identical.
             from . import flowplan
 
             return flowplan.run_flow_simulation(
-                self.config, self.routing, self.stats)
+                self.config, self.routing, self.stats,
+                checkpoint_dir=self._ckpt_dir
+                if self.config.faults.checkpoint.interval is not None
+                or self.resume_from else None,
+                resume_from=self.resume_from)
         wall_start = _walltime.monotonic()  # shadowlint: disable=SL101 -- perf stat
         self._wall_start = wall_start
         self._last_resource_check = wall_start
+        if self.resume_from:
+            # round-loop runs cannot restore mid-run state (host event
+            # queues hold live closures, managed processes hold kernel
+            # state — docs/robustness.md); only the flow engine and the
+            # device-plane drivers resume. Fail loudly, don't pretend.
+            from .config import ConfigError
+
+            raise ConfigError(
+                "--resume is supported for flow-engine runs "
+                "(experimental.use_flow_engine) and device-plane "
+                "checkpoints (tools/chaos_smoke.py); round-loop Manager "
+                "checkpoints are diagnostic snapshots — see "
+                "docs/robustness.md")
+        if self.config.faults.watchdog:
+            from ..faults.watchdog import RoundWatchdog
+
+            self._watchdog = RoundWatchdog(
+                self.config.faults.watchdog / 1e9,
+                self._collect_watchdog_blame)
         try:
             # round 0: boot all hosts (schedules application-start tasks)
             for host in self._host_order:
@@ -710,19 +956,26 @@ class Manager:
 
             # the scheduling loop (`manager.rs:392-478`)
             min_next = self._min_host_event()
-            window = self.controller.next_window(min_next)
+            window = self.controller.next_window(
+                self._fault_horizon(min_next))
             while window is not None:
                 start, end = window
+                self._last_window_start = start
+                self._apply_faults(start)
+                end = self._clamp_window_to_fault(start, end)
                 self._round_upkeep(start)
                 if self.transport is not None:
                     # release device-held packets due in this window into
                     # host event queues before anyone executes; the device
                     # chains straight through delivery-free windows up to
                     # the earliest CPU-side event (host queues are
-                    # quiescent here, so that horizon is exact)
+                    # quiescent here, so that horizon is exact). The
+                    # fault horizon clamps the chain too: the device must
+                    # not run past an instant whose crash/link event the
+                    # CPU hasn't applied yet.
                     host_min = self._min_host_event()
                     self.transport.release(
-                        start, end, horizon_ns=host_min,
+                        start, end, horizon_ns=self._fault_horizon(host_min),
                         runahead_ns=self.runahead.get(),
                         stop_ns=self.controller.stop_time,
                     )
@@ -734,7 +987,7 @@ class Manager:
                 # yet (ingest happens at finish_round below) — only the
                 # sending worker's next_event_time knows its deliver time
                 # (`manager.rs:430-436`)
-                sched_min = self.scheduler.run_round(active, end)
+                sched_min = self._run_round_guarded(start, active, end)
                 if self.transport is not None:
                     # barrier: ship this round's captured egress to device
                     self.transport.finish_round(start, end)
@@ -756,7 +1009,8 @@ class Manager:
                           else self.transport.next_pending_abs):
                     if t is not None:
                         min_next = t if min_next is None else min(min_next, t)
-                window = self.controller.next_window(min_next)
+                window = self.controller.next_window(
+                    self._fault_horizon(min_next))
 
             if self.transport is not None:
                 # mirrored mode: drain the lagged device-verification
@@ -801,11 +1055,21 @@ class Manager:
                 h.n_events_executed for h in self._host_order)
             self.stats.packets_sent = int(self.routing.packet_counters.sum())
             self.stats.packets_dropped = self.shared.packet_drop_count
+            self.stats.packets_dropped_fault = (
+                self.shared.fault_drop_count
+                + sum(h.fault_packets_dropped for h in self.hosts))
             # shadowlint: disable=SL101 -- wall-clock perf stat only
             self.stats.wall_seconds = _walltime.monotonic() - wall_start
             for writer in self._pcap_writers:
                 writer.close()
             return self.stats
+        except BaseException:
+            # crash / watchdog path: drop the emergency checkpoint FIRST
+            # — it documents exactly the run that is about to die — then
+            # let the error propagate through the telemetry-preserving
+            # finally below
+            self._emergency_checkpoint()
+            raise
         finally:
             # crash path: preserve whatever telemetry is buffered — the
             # run that died is exactly the one the heartbeats should

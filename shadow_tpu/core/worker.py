@@ -64,9 +64,18 @@ class WorkerShared:
         self.sim_end_time = sim_end_time
         self.bootstrap_end_time = bootstrap_end_time
         self.packet_drop_count = 0
+        # injected fault-plane drops, kept APART from packet_drop_count
+        # so SimStats never conflates a scheduled outage with wire loss
+        # (docs/robustness.md drop taxonomy)
+        self.fault_drop_count = 0
         # set by the Manager when experimental.use_tpu_transport is on:
         # cross-host delivery runs through the device plane
         self.device_transport = None
+        # set by the Manager when a `faults:` schedule is configured: the
+        # compiled FaultSchedule whose overlay send_packet consults
+        # (crashed endpoints, link latency multipliers, corruption
+        # bursts). None = the fault branch below never runs.
+        self.fault_plane = None
         # guards the (non-atomic) numpy counter updates and the drop count
         self._count_lock = threading.Lock()
 
@@ -85,6 +94,10 @@ class WorkerShared:
     def count_drop(self) -> None:
         with self._count_lock:
             self.packet_drop_count += 1
+
+    def count_fault_drop(self) -> None:
+        with self._count_lock:
+            self.fault_drop_count += 1
 
 
 class Worker:
@@ -140,6 +153,23 @@ class Worker:
         latency, reliability = self.shared.latency_and_reliability(
             packet.src[0], dst_ip
         )
+
+        # Fault plane (faults/schedule.py): crashed endpoints drop the
+        # packet (FAULT_DROPPED, never the loss counter), degraded links
+        # multiply latency, and an active corruption burst may draw an
+        # extra Bernoulli from the SOURCE host's stream. The filter runs
+        # BEFORE the loss draw so a corruption-free schedule consumes
+        # exactly the same RNG stream as a faultless run.
+        fp = self.shared.fault_plane
+        if fp is not None:
+            drop, latency = fp.filter_send(
+                src_host, dst_host, packet,
+                self.shared.ip_to_node_id[packet.src[0]],
+                self.shared.ip_to_node_id[dst_ip], latency)
+            if drop:
+                packet.add_status(PacketStatus.FAULT_DROPPED)
+                self.shared.count_fault_drop()
+                return
 
         # Bernoulli path loss from the *source host's* RNG stream — part of
         # the determinism contract. Control packets (payload 0) are never

@@ -1,0 +1,32 @@
+"""The fault plane: deterministic fault injection, checkpoint/restore,
+and self-healing execution (docs/robustness.md).
+
+- `schedule`   — the `faults:` config block compiled into a seeded,
+  virtual-time event schedule with CPU mask state + device arrays.
+- `plane`      — the `FaultArrays` SoA masks `tpu/plane.window_step`
+  threads as a static presence switch (faults=None compiles out).
+- `checkpoint` — atomic, checksummed checkpoints: bitwise device-plane
+  restore, flow-engine bucket resume, Manager diagnostic snapshots.
+- `watchdog`   — the round watchdog: hung managed processes become a
+  structured `WatchdogError` with per-host blame.
+- `healing`    — transient-device-error retry and the Pallas->XLA
+  kernel fallback.
+"""
+
+from .checkpoint import (CheckpointError, load_checkpoint,  # noqa: F401
+                         load_plane_checkpoint, prune_checkpoints,
+                         save_plane_checkpoint, write_checkpoint)
+from .healing import (KernelFallback, is_transient_device_error,  # noqa: F401
+                      retry_transient)
+from .plane import FaultArrays, neutral_faults  # noqa: F401
+from .schedule import (FaultEvent, FaultSchedule,  # noqa: F401
+                       compile_schedule)
+from .watchdog import HostBlame, RoundWatchdog, WatchdogError  # noqa: F401
+
+__all__ = [
+    "CheckpointError", "FaultArrays", "FaultEvent", "FaultSchedule",
+    "HostBlame", "KernelFallback", "RoundWatchdog", "WatchdogError",
+    "compile_schedule", "is_transient_device_error", "load_checkpoint",
+    "load_plane_checkpoint", "neutral_faults", "prune_checkpoints",
+    "retry_transient", "save_plane_checkpoint", "write_checkpoint",
+]

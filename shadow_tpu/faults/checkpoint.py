@@ -1,0 +1,332 @@
+"""Crash-survivable checkpoints: atomic write-rename + manifest checksums.
+
+Format: a checkpoint is a DIRECTORY ``<name>/`` containing
+
+- ``arrays.npz``    — every numpy/device array leaf, flattened to
+  ``<group>.<field>`` keys (device pytrees go through `jax.device_get`
+  first; restore re-uploads),
+- ``meta.json``     — JSON-serializable metadata (virtual clock, round,
+  RNG streams, counters, config digest, ``kind``),
+- ``MANIFEST.json`` — sha256 of both payload files plus the format
+  version. `load_checkpoint` re-hashes and refuses a mismatch.
+
+Atomicity: everything is written into ``<name>.tmp-<pid>/`` and
+`os.replace`d into place, so a checkpoint either exists completely or
+not at all — a run killed mid-write leaves only a ``.tmp-*`` turd that
+the next `prune_checkpoints` sweep removes. (os.replace of a directory
+over an existing one fails on POSIX, so the previous checkpoint of the
+same name is rotated away first; the rotation window leaves the older
+sibling checkpoints intact, which is why periodic checkpoints are
+timestamped names, not one mutating directory.)
+
+Three checkpoint kinds share the format (``meta["kind"]``):
+
+- ``plane``   — a device-plane world (NetPlaneState [+ FaultArrays,
+  PlaneMetrics] + rng key + virtual clock): full bitwise restore, used
+  by `tools/chaos_smoke.py` and the tests' kill/resume parity matrix.
+- ``flow``    — flow-engine bucket progress (core/flowplan.py): the CLI
+  ``--resume`` path; completed buckets are never recomputed and the
+  merged results are bitwise-identical to an uninterrupted run.
+- ``manager`` — a round-loop diagnostic snapshot (RNG streams, clocks,
+  tracker counters, stats, telemetry totals, transport counters):
+  written periodically and as the EMERGENCY checkpoint on the crash
+  path. Not resumable (host event queues hold live closures and
+  managed native processes hold kernel state no serializer can see —
+  docs/robustness.md spells out the boundary), but it preserves the
+  forensic state of exactly the runs that need explaining.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+from typing import Any, Optional
+
+import numpy as np
+
+log = logging.getLogger("shadow_tpu.faults")
+
+FORMAT_VERSION = 1
+MANIFEST = "MANIFEST.json"
+_ARRAYS = "arrays.npz"
+_META = "meta.json"
+
+
+class CheckpointError(RuntimeError):
+    """Unreadable, corrupt, or mismatched checkpoint."""
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for block in iter(lambda: fh.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def write_checkpoint(path: str, *, meta: dict,
+                     arrays: Optional[dict[str, np.ndarray]] = None) -> dict:
+    """Write one checkpoint directory atomically; returns the manifest.
+
+    `meta` must be JSON-serializable; `arrays` values must be numpy
+    arrays (callers `jax.device_get` device pytrees first). `path` is
+    the final directory name."""
+    path = os.path.abspath(path)
+    parent = os.path.dirname(path)
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    try:
+        np.savez(os.path.join(tmp, _ARRAYS), **(arrays or {}))
+        with open(os.path.join(tmp, _META), "w") as fh:
+            json.dump(meta, fh, sort_keys=True, indent=1)
+        manifest = {
+            "format": FORMAT_VERSION,
+            "kind": meta.get("kind", "unknown"),
+            "sha256": {
+                _ARRAYS: _sha256(os.path.join(tmp, _ARRAYS)),
+                _META: _sha256(os.path.join(tmp, _META)),
+            },
+        }
+        with open(os.path.join(tmp, MANIFEST), "w") as fh:
+            json.dump(manifest, fh, sort_keys=True, indent=1)
+        # fsync the payload so the rename can't land before the bytes
+        for name in (_ARRAYS, _META, MANIFEST):
+            fd = os.open(os.path.join(tmp, name), os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        if os.path.exists(path):
+            # rotate the old same-name checkpoint out of the way so the
+            # replace is atomic; it is gone only after the new one lands
+            old = f"{path}.old-{os.getpid()}"
+            os.replace(path, old)
+            os.replace(tmp, path)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.replace(tmp, path)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return manifest
+
+
+def load_checkpoint(path: str) -> tuple[dict, dict[str, np.ndarray]]:
+    """Verify the manifest checksums and return (meta, arrays)."""
+    path = os.path.abspath(path)
+    mpath = os.path.join(path, MANIFEST)
+    if not os.path.isfile(mpath):
+        raise CheckpointError(f"{path}: not a checkpoint (no {MANIFEST})")
+    try:
+        with open(mpath) as fh:
+            manifest = json.load(fh)
+    except (OSError, ValueError) as e:
+        raise CheckpointError(f"{path}: unreadable manifest: {e}") from e
+    if manifest.get("format") != FORMAT_VERSION:
+        raise CheckpointError(
+            f"{path}: checkpoint format {manifest.get('format')!r} != "
+            f"supported {FORMAT_VERSION}")
+    for name, want in manifest.get("sha256", {}).items():
+        fpath = os.path.join(path, name)
+        if not os.path.isfile(fpath):
+            raise CheckpointError(f"{path}: missing payload file {name}")
+        got = _sha256(fpath)
+        if got != want:
+            raise CheckpointError(
+                f"{path}: checksum mismatch on {name} (manifest {want[:12]}"
+                f"..., file {got[:12]}...) — the checkpoint is corrupt")
+    with open(os.path.join(path, _META)) as fh:
+        meta = json.load(fh)
+    with np.load(os.path.join(path, _ARRAYS)) as z:
+        arrays = {k: z[k] for k in z.files}
+    return meta, arrays
+
+
+def prune_checkpoints(directory: str, keep: int, prefix: str = "ckpt-") -> None:
+    """Keep the newest `keep` periodic checkpoints (by name — names
+    embed the zero-padded round number, so lexicographic == temporal)
+    and sweep dead ``.tmp-*`` / ``.old-*`` partials."""
+    if not os.path.isdir(directory):
+        return
+    entries = sorted(
+        e for e in os.listdir(directory)
+        if e.startswith(prefix) and ".tmp-" not in e and ".old-" not in e)
+    for e in entries[:-keep] if keep > 0 else entries:
+        shutil.rmtree(os.path.join(directory, e), ignore_errors=True)
+    for e in os.listdir(directory):
+        if ".tmp-" in e or ".old-" in e:
+            shutil.rmtree(os.path.join(directory, e), ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# device-plane checkpoints (kind="plane"): full bitwise restore
+# ---------------------------------------------------------------------------
+
+
+def _flatten_named(prefix: str, pytree) -> dict[str, np.ndarray]:
+    """NamedTuple-of-arrays -> {prefix.field: np.ndarray} (nested
+    NamedTuples recurse with dotted names)."""
+    out: dict[str, np.ndarray] = {}
+    for name in pytree._fields:
+        leaf = getattr(pytree, name)
+        if hasattr(leaf, "_fields"):
+            out.update(_flatten_named(f"{prefix}.{name}", leaf))
+        else:
+            out[f"{prefix}.{name}"] = np.asarray(leaf)
+    return out
+
+
+def _unflatten_named(prefix: str, template, arrays: dict[str, np.ndarray]):
+    """Inverse of `_flatten_named`: rebuild `template`'s type with the
+    stored leaves re-uploaded as jnp arrays (dtype preserved)."""
+    import jax.numpy as jnp
+
+    kw = {}
+    for name in template._fields:
+        leaf = getattr(template, name)
+        if hasattr(leaf, "_fields"):
+            kw[name] = _unflatten_named(f"{prefix}.{name}", leaf, arrays)
+        else:
+            key = f"{prefix}.{name}"
+            if key not in arrays:
+                raise CheckpointError(
+                    f"checkpoint is missing array leaf {key!r} — written "
+                    f"by an incompatible shadow_tpu version?")
+            kw[name] = jnp.asarray(arrays[key])
+    return type(template)(**kw)
+
+
+def save_plane_checkpoint(path: str, *, state, clock_ns: int,
+                          rng_key_data: np.ndarray,
+                          faults=None, metrics=None,
+                          extra_arrays: Optional[dict] = None,
+                          meta: Optional[dict] = None) -> dict:
+    """Checkpoint a device-plane world (`tpu/plane.NetPlaneState` and
+    friends) with full bitwise restore. `rng_key_data` is
+    `jax.random.key_data(root_key)` (raw uint32 words, reconstructed
+    with `jax.random.wrap_key_data`). `extra_arrays` carries any
+    driver-private carry (e.g. the PHOLD respawn sequence counters);
+    restore returns them under `extra`."""
+    import jax
+
+    arrays = _flatten_named("state", jax.device_get(state))
+    arrays["rng.key_data"] = np.asarray(rng_key_data)
+    if faults is not None:
+        arrays.update(_flatten_named("faults", jax.device_get(faults)))
+    if metrics is not None:
+        arrays.update(_flatten_named("metrics", jax.device_get(metrics)))
+    for name, arr in (extra_arrays or {}).items():
+        arrays[f"extra.{name}"] = np.asarray(jax.device_get(arr))
+    full_meta = {
+        "kind": "plane",
+        "clock_ns": int(clock_ns),
+        "has_faults": faults is not None,
+        "has_metrics": metrics is not None,
+    }
+    full_meta.update(meta or {})
+    return write_checkpoint(path, meta=full_meta, arrays=arrays)
+
+
+def load_plane_checkpoint(path: str, *, state_template,
+                          faults_template=None, metrics_template=None):
+    """Restore a `plane` checkpoint. Returns a dict with `state`,
+    `clock_ns`, `rng_key` (a rebuilt jax PRNG key), and — when stored
+    and a template is given — `faults` / `metrics`."""
+    import jax
+
+    meta, arrays = load_checkpoint(path)
+    if meta.get("kind") != "plane":
+        raise CheckpointError(
+            f"{path}: kind {meta.get('kind')!r} is not a device-plane "
+            f"checkpoint")
+    out: dict[str, Any] = {
+        "meta": meta,
+        "clock_ns": int(meta["clock_ns"]),
+        "state": _unflatten_named("state", state_template, arrays),
+        "rng_key": jax.random.wrap_key_data(
+            jax.numpy.asarray(arrays["rng.key_data"])),
+    }
+    if meta.get("has_faults") and faults_template is not None:
+        out["faults"] = _unflatten_named("faults", faults_template, arrays)
+    if meta.get("has_metrics") and metrics_template is not None:
+        out["metrics"] = _unflatten_named("metrics", metrics_template,
+                                          arrays)
+    out["extra"] = {k[len("extra."):]: v for k, v in arrays.items()
+                    if k.startswith("extra.")}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# manager snapshots (kind="manager"): periodic + emergency diagnostics
+# ---------------------------------------------------------------------------
+
+
+def manager_snapshot(manager, now_ns: int, *, reason: str) -> dict:
+    """The serializable core of a round-loop Manager: RNG streams,
+    clocks, tracker counters, stats, telemetry totals, and the device
+    transport's counter arrays. See the module docstring for why this
+    kind is diagnostic, not resumable."""
+    meta: dict[str, Any] = {
+        "kind": "manager",
+        "resumable": False,
+        "reason": reason,
+        "clock_ns": int(now_ns),
+        "rounds": int(manager.stats.rounds),
+        "seed": int(manager.config.general.seed),
+        "stop_time_ns": int(manager.config.general.stop_time),
+        "global_rng_state": [int(s) for s in manager.global_rng.s],
+        "hosts": {
+            h.name: {
+                "now_ns": int(h.now()),
+                "rng_state": [int(s) for s in h.rng.s],
+                "events_executed": int(h.n_events_executed),
+                "fault_down": bool(getattr(h, "fault_down", False)),
+                "fault_packets_dropped": int(
+                    getattr(h, "fault_packets_dropped", 0)),
+            }
+            for h in manager.hosts
+        },
+        "trackers": {name: t.counters.as_dict()
+                     for name, t in manager.trackers.items()},
+        "stats": manager.stats.as_dict(),
+    }
+    if manager.harvester is not None:
+        meta["telemetry"] = {
+            "harvests": manager.harvester.harvests,
+            "emitted": manager.harvester.emitted,
+        }
+    arrays: dict[str, np.ndarray] = {}
+    transport = getattr(manager, "transport", None)
+    if transport is not None:
+        import jax
+
+        for name, arr in transport.telemetry_arrays().items():
+            arrays[f"transport.{name}"] = np.asarray(jax.device_get(arr))
+    return {"meta": meta, "arrays": arrays}
+
+
+def write_manager_checkpoint(manager, directory: str, now_ns: int, *,
+                             reason: str, keep: int = 2) -> Optional[str]:
+    """Periodic/emergency Manager snapshot; never raises (a failing
+    emergency checkpoint must not mask the crash it documents)."""
+    try:
+        snap = manager_snapshot(manager, now_ns, reason=reason)
+        name = ("emergency" if reason == "emergency"
+                else f"ckpt-{manager.stats.rounds:012d}")
+        path = os.path.join(directory, name)
+        write_checkpoint(path, meta=snap["meta"], arrays=snap["arrays"])
+        if reason != "emergency":
+            prune_checkpoints(directory, keep)
+        log.info("checkpoint: wrote %s snapshot at simtime %d -> %s",
+                 reason, now_ns, path)
+        return path
+    except Exception:
+        log.error("checkpoint: failed to write %s snapshot", reason,
+                  exc_info=True)
+        return None

@@ -1,0 +1,97 @@
+"""Device-side fault masks for the TPU network plane (`FaultArrays`).
+
+The fault plane makes failure a *simulated input*: host crashes, NIC
+link flaps, per-link degradation, and burst packet corruption are
+compiled from the seeded `faults:` schedule (`faults/schedule.py`) into
+SoA mask arrays that ride into `tpu/plane.window_step` as ordinary
+kernel arguments, under the same discipline as the telemetry switch
+(`telemetry/metrics.py`):
+
+1. **Static presence switch.** `window_step(..., faults=None)` compiles
+   every fault branch out — the jaxpr is identical to the pre-fault
+   plane and the results are bitwise-identical (pinned by the parity
+   matrix in tests/test_faults.py).
+2. **Neutral masks are identity.** `neutral_faults(...)` (everyone
+   alive, multiplier 1, corruption 0) produces bitwise-identical
+   simulation state to `faults=None` for any in-budget world — the
+   masks gate with `where`/`&` on values the step already materialized.
+3. **Dtype discipline.** bool / int32 / float32 like everything else on
+   device (tpu/plane.py header); latency multipliers are integers and
+   the degraded latency is clamped to the int32 window budget before
+   the multiply so the arithmetic can never wrap.
+4. **Independent corruption stream.** Burst corruption draws use the
+   same counter-based threefry as path loss but with the host index
+   offset by N, so the loss stream is untouched: a schedule with
+   corruption never perturbs which packets the base world loss-drops.
+
+Fault *semantics* on device (documented in docs/robustness.md):
+
+- a host with `host_alive=False` or `link_up=False` neither transmits
+  (its queued egress drops, counted per source host) nor accepts new
+  routing (packets sent toward it drop at routing time, counted per
+  destination host). Packets already in its ingress ring still deliver
+  — the crash withdraws the route, it does not reach into the wire.
+- `lat_mult[src_node, dst_node]` multiplies path latency (int >= 1).
+- `bw_div[host]` divides the egress token-bucket refill rate (>= 1).
+- `corrupt_p[host]` adds an independent Bernoulli corruption drop on
+  that host's egress (control packets exempt, like path loss).
+
+This module is dependency-light (jax/numpy only): `tpu/plane.py`
+imports it, never the other way around.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class FaultArrays(NamedTuple):
+    """The compiled fault masks for one scheduling window. Leaves are
+    plain kernel arguments (not static), so advancing the schedule
+    between rounds never recompiles."""
+
+    host_alive: jax.Array  # [N] bool — host not crashed
+    link_up: jax.Array  # [N] bool — NIC administratively up
+    lat_mult: jax.Array  # [M, M] int32 >= 1 — per-link latency multiplier
+    bw_div: jax.Array  # [N] int32 >= 1 — egress bandwidth divisor
+    corrupt_p: jax.Array  # [N] float32 — burst corruption probability
+
+
+def neutral_faults(n_hosts: int, n_nodes: int | None = None) -> FaultArrays:
+    """An all-healthy mask set: bitwise-identity against faults=None."""
+    m = n_nodes if n_nodes is not None else n_hosts
+    return FaultArrays(
+        host_alive=jnp.ones((n_hosts,), bool),
+        link_up=jnp.ones((n_hosts,), bool),
+        lat_mult=jnp.ones((m, m), jnp.int32),
+        bw_div=jnp.ones((n_hosts,), jnp.int32),
+        corrupt_p=jnp.zeros((n_hosts,), jnp.float32),
+    )
+
+
+def faults_from_numpy(host_alive: np.ndarray, link_up: np.ndarray,
+                      lat_mult: np.ndarray, bw_div: np.ndarray,
+                      corrupt_p: np.ndarray) -> FaultArrays:
+    """Upload a schedule's current numpy mask state (the
+    `FaultSchedule.device_arrays` bridge).
+
+    Each array is COPIED before the upload: on the CPU backend
+    `jnp.asarray` may zero-copy alias the numpy buffer, and the
+    schedule mutates its mask arrays in place on the next `advance()` —
+    an aliased buffer would let a later event leak into a window whose
+    dispatch hadn't drained yet (observed as cross-process
+    nondeterminism; pinned by tests/test_faults.py determinism runs)."""
+    def up(arr, dtype):
+        return jnp.asarray(np.array(arr, dtype=dtype, copy=True))
+
+    return FaultArrays(
+        host_alive=up(host_alive, bool),
+        link_up=up(link_up, bool),
+        lat_mult=up(lat_mult, np.int32),
+        bw_div=up(bw_div, np.int32),
+        corrupt_p=up(corrupt_p, np.float32),
+    )

@@ -20,7 +20,7 @@ from ..core.config import QDiscMode
 from ..core.event import Event, EventQueue, TaskRef
 from ..core.rng import Xoshiro256pp
 from ..net.namespace import NetworkNamespace
-from ..net.packet import Packet
+from ..net.packet import Packet, PacketStatus
 from ..net.relay import Relay
 from ..net.router import Router
 from .cpu import Cpu
@@ -88,6 +88,11 @@ class Host:
         self._now = 0
         # The worker currently executing this host (set by the scheduler).
         self._worker = None
+        # Fault plane (faults/schedule.py): True between a host_crash
+        # and its host_reboot. A down host executes nothing, accepts no
+        # packet events, and its crash purged the queue.
+        self.fault_down = False
+        self.fault_packets_dropped = 0
 
         self.netns = NetworkNamespace(ip, qdisc, pcap_factory)
         # The router's address is the unspecified address (`host.rs:298`):
@@ -168,6 +173,16 @@ class Host:
         self, packet: Packet, time_ns: int, src_host_id: int, src_event_id: int
     ) -> None:
         """Called from ANY worker thread (`worker.rs:629-639`)."""
+        if self.fault_down:
+            # crashed destination: the packet event is lost, bucketed as
+            # a fault drop (never wire loss). Guards the device-transport
+            # release path too — the send-side filter in Worker.send_packet
+            # can't see a crash that happened after capture. The counter
+            # update takes the queue lock: this runs on ANY worker thread.
+            packet.add_status(PacketStatus.FAULT_DROPPED)
+            with self._queue_lock:
+                self.fault_packets_dropped += 1
+            return
         with self._queue_lock:
             self.event_queue.push(
                 Event.new_packet(time_ns, packet, src_host_id, src_event_id)
@@ -201,6 +216,48 @@ class Host:
     def next_event_time(self) -> Optional[int]:
         with self._queue_lock:
             return self.event_queue.next_time()
+
+    # -- fault plane (faults/schedule.py; docs/robustness.md) ---------------
+
+    def fault_crash(self) -> int:
+        """Host crash at the current round boundary: the event queue and
+        inbound router are purged (a crash loses everything), the NIC
+        goes down, and no new packet events are accepted until
+        `fault_reboot`. Process SIGKILLs are the Manager's job (it owns
+        the process table). Returns the number of purged events."""
+        self.fault_down = True
+        with self._queue_lock:
+            purged = self.event_queue.purge()
+        for event in purged:
+            if event.is_packet:
+                event.payload.add_status(PacketStatus.FAULT_DROPPED)
+                self.fault_packets_dropped += 1
+        purged_router = self.router.purge_for_fault()
+        self.fault_packets_dropped += purged_router
+        self._cached_next = None  # Manager heap entries go stale lazily
+        # the simulated kernel's networking state dies with the host:
+        # port associations clear so respawned processes re-bind cleanly
+        self.netns.purge_for_fault()
+        for iface in (self.netns.internet, self.netns.localhost):
+            iface.set_link_up(False)
+        return len(purged) + purged_router
+
+    def fault_reboot(self) -> None:
+        """Restore connectivity after a crash. Respawning the host's
+        configured processes is the Manager's job."""
+        self.fault_down = False
+        for iface in (self.netns.internet, self.netns.localhost):
+            iface.set_link_up(True)
+
+    def fault_set_iface(self, up: bool) -> None:
+        """Administrative NIC flap (iface_down/iface_up): the internet
+        interface only — loopback stays up, like pulling a cable."""
+        self.netns.internet.set_link_up(up)
+        if up:
+            # kick the relays: backlog queued behind the downed link
+            # resumes forwarding at the restore instant
+            self.relay_inet_out.notify()
+            self.relay_inet_in.notify()
 
     # -- applications -------------------------------------------------------
 

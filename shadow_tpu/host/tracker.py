@@ -26,6 +26,10 @@ class Counters:
     bytes_in: int = 0
     bytes_out: int = 0
     packets_dropped: int = 0
+    # injected fault-plane drops (FAULT_DROPPED), kept APART from
+    # packets_dropped so an injected outage is never misread as wire
+    # loss (docs/robustness.md drop taxonomy)
+    packets_dropped_fault: int = 0
     retransmitted: int = 0
     by_protocol: dict = field(default_factory=dict)
 
@@ -39,6 +43,7 @@ class Counters:
             "bytes_in": self.bytes_in,
             "bytes_out": self.bytes_out,
             "packets_dropped": self.packets_dropped,
+            "packets_dropped_fault": self.packets_dropped_fault,
             "retransmitted": self.retransmitted,
             "by_protocol": dict(sorted(self.by_protocol.items())),
         }
@@ -68,12 +73,13 @@ class Tracker:
     _SENT = int(PacketStatus.SND_INTERFACE_SENT)
     _RCVD = int(PacketStatus.RCV_INTERFACE_RECEIVED)
     _RETX = int(PacketStatus.SND_TCP_RETRANSMITTED)
+    _FAULT = int(PacketStatus.FAULT_DROPPED)
     _DROPS = frozenset((
         int(PacketStatus.INET_DROPPED), int(PacketStatus.ROUTER_DROPPED),
         int(PacketStatus.RCV_SOCKET_DROPPED),
         int(PacketStatus.RCV_INTERFACE_DROPPED),
     ))
-    WANTED = frozenset({_SENT, _RCVD, _RETX} | _DROPS)
+    WANTED = frozenset({_SENT, _RCVD, _RETX, _FAULT} | _DROPS)
 
     def on_packet_status(self, packet: Packet, status: PacketStatus) -> None:
         s = int(status)
@@ -88,6 +94,8 @@ class Tracker:
             c.bytes_in += packet.total_size()
         elif s in self._DROPS:
             c.packets_dropped += 1
+        elif s == self._FAULT:
+            c.packets_dropped_fault += 1
         elif s == self._RETX:
             c.retransmitted += 1
 

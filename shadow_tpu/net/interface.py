@@ -66,6 +66,11 @@ class NetworkInterface(PacketDevice):
         self._address = address
         self._qdisc = qdisc
         self._pcap_hook = pcap_hook  # (packet, inbound) -> None
+        # administrative link state (the fault plane's iface_down/up
+        # events, faults/schedule.py): down = outbound pop() yields
+        # nothing, inbound push() drops with FAULT_DROPPED
+        self.link_up = True
+        self.fault_dropped = 0
         self._associations: dict[AssociationKey, InterfaceSocket] = {}
         # send-side: sockets with data, managed per qdisc
         self._ready_fifo: list[tuple[int, int, InterfaceSocket]] = []  # heap by priority
@@ -132,11 +137,19 @@ class NetworkInterface(PacketDevice):
         else:
             self._ready_rr.append(socket)
 
+    def set_link_up(self, up: bool) -> None:
+        """Administrative link flap (fault plane). Sockets keep queueing
+        while the link is down; on restore the caller kicks the relays
+        so the backlog forwards again."""
+        self.link_up = bool(up)
+
     def has_data_to_send(self) -> bool:
-        return bool(self._ready_fifo or self._ready_rr)
+        return self.link_up and bool(self._ready_fifo or self._ready_rr)
 
     def pop(self) -> Optional[Packet]:
         """Dequeue the next outgoing packet per the queuing discipline."""
+        if not self.link_up:
+            return None  # administratively down: nothing leaves
         while self._ready_fifo or self._ready_rr:
             if self._qdisc == QDiscMode.FIFO:
                 _, _, socket = heapq.heappop(self._ready_fifo)
@@ -160,6 +173,11 @@ class NetworkInterface(PacketDevice):
     # -- receive side -------------------------------------------------------
 
     def push(self, packet: Packet) -> None:
+        if not self.link_up:
+            # inbound during a link-down window: the NIC never sees it
+            packet.add_status(PacketStatus.FAULT_DROPPED)
+            self.fault_dropped += 1
+            return
         self.recv_bytes += packet.total_size()
         packet.add_status(PacketStatus.RCV_INTERFACE_RECEIVED)
         if self._pcap_hook is not None:

@@ -42,6 +42,21 @@ class NetworkNamespace:
         self.localhost = NetworkInterface("127.0.0.1", qdisc, lo_hook)
         self.internet = NetworkInterface(public_ip, qdisc, eth_hook)
 
+    def purge_for_fault(self) -> int:
+        """Host crash (faults/schedule.py): the simulated kernel's
+        networking state is gone — every association, every queued
+        ready-socket. Respawned processes re-bind their ports on a
+        clean namespace, exactly like a power cycle. Returns the number
+        of associations dropped."""
+        n = 0
+        for iface in (self.localhost, self.internet):
+            n += len(iface._associations)
+            iface._associations.clear()
+            iface._ready_fifo.clear()
+            iface._ready_rr.clear()
+            iface._ready_set.clear()
+        return n
+
     def interface_for(self, ip: str) -> Optional[NetworkInterface]:
         if ip == "127.0.0.1":
             return self.localhost

@@ -51,6 +51,10 @@ class PacketStatus(enum.IntEnum):
     DESTROYED = 19
     RELAY_CACHED = 20
     RELAY_FORWARDED = 21
+    # injected fault-plane drop (crashed host, downed interface, burst
+    # corruption — faults/schedule.py): its own status so trackers can
+    # keep the `fault` drop bucket apart from wire loss
+    FAULT_DROPPED = 22
 
 
 # Optional global hook for packet tracing (the tracker/pcap layers register

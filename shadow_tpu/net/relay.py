@@ -90,11 +90,25 @@ class Relay:
     def __init__(self, host, src_dev_address: str, bytes_per_second: Optional[int]):
         self._host = host
         self._src_address = src_dev_address
+        self._base_bytes_per_second = bytes_per_second
         self._rate_limiter = (
             create_token_bucket(bytes_per_second) if bytes_per_second is not None else None
         )
         self._state = _IDLE
         self._next_packet: Optional[Packet] = None
+
+    def set_fault_divisor(self, div: int) -> None:
+        """Fault-plane bandwidth degradation (host_degrade): rebuild the
+        bucket at base_rate // div. Rebuilding starts the new bucket at
+        full (degraded) capacity and re-anchors its refill phase at the
+        event instant — documented modeling choice (docs/robustness.md):
+        a degradation event resets the bucket."""
+        if self._base_bytes_per_second is None:
+            return
+        rate = max(1, self._base_bytes_per_second // max(int(div), 1))
+        bucket = create_token_bucket(rate)
+        bucket.last_refill = self._host.now()
+        self._rate_limiter = bucket
 
     def notify(self) -> None:
         """Source device became non-empty; start forwarding after the current

@@ -144,6 +144,19 @@ class CoDelQueue:
         self.dropped_count += 1
         packet.add_status(PacketStatus.ROUTER_DROPPED)
 
+    def drain(self) -> list[Packet]:
+        """Empty the queue without CoDel accounting (fault purge): the
+        queue state machine resets to STORE as if freshly built."""
+        out = [p for p, _ts in self._elements]
+        self._elements.clear()
+        self._total_bytes = 0
+        self._mode = _STORE
+        self._interval_end = None
+        self._drop_next = None
+        self._current_drop_count = 0
+        self._previous_drop_count = 0
+        return out
+
 
 class Router(PacketDevice):
     """Per-host entry point for packets arriving from the simulated internet
@@ -172,3 +185,14 @@ class Router(PacketDevice):
 
     def inbound_len(self) -> int:
         return len(self._inbound)
+
+    def purge_for_fault(self) -> int:
+        """A host crash loses everything queued at its inbound router
+        (faults/schedule.py host_crash). Returns the drop count; each
+        purged packet gets FAULT_DROPPED so trackers bucket it apart
+        from CoDel/wire drops."""
+        n = 0
+        for packet in self._inbound.drain():
+            packet.add_status(PacketStatus.FAULT_DROPPED)
+            n += 1
+        return n

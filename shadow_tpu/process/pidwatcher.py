@@ -12,10 +12,13 @@ announces PROCESS_DEATH) never runs.
 
 from __future__ import annotations
 
+import logging
 import os
 import select
 import threading
 from typing import Callable, Optional
+
+log = logging.getLogger("shadow_tpu.process")
 
 # os.pidfd_open exists on Linux 5.3+ / Python 3.9+; fall back to a
 # waitpid-polling thread per child if unavailable.
@@ -68,6 +71,14 @@ class ChildPidWatcher:
             self._epoll.register(pidfd, select.EPOLLIN)
         self._wake()
 
+    def watched_pids(self) -> list[int]:
+        """Pids with a live death-watch — i.e. children the watcher has
+        NOT yet seen die. The round watchdog's blame collector reads
+        this to mark which of a hung host's processes were still alive
+        when the watchdog fired (faults/watchdog.py)."""
+        with self._lock:
+            return sorted(self._callbacks)
+
     def unwatch(self, pid: int) -> None:
         with self._lock:
             entry = self._callbacks.pop(pid, None)
@@ -117,7 +128,13 @@ class ChildPidWatcher:
                 try:
                     cb()
                 except Exception:
-                    pass
+                    # a failing death-callback must not kill the watcher
+                    # thread (other children still need their wakeups),
+                    # but it may leave a worker blocked forever — say so
+                    log.error(
+                        "child-death callback raised; a simulator thread "
+                        "may stay blocked on this child's IPC channel",
+                        exc_info=True)
 
     def _poll_fallback(self, pid: int, callback: Callable[[], None]) -> None:
         """No pidfd support: block in waitid(WNOWAIT) — it returns as soon

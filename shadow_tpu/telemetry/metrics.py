@@ -47,6 +47,10 @@ class PlaneMetrics(NamedTuple):
     drop_ring_full: jax.Array  # egress/ingress ring-capacity overflow
     drop_qdisc: jax.Array  # router AQM (CoDel) drops
     drop_loss: jax.Array  # Bernoulli path-loss samples
+    drop_fault: jax.Array  # injected fault-plane drops (crashed-host
+    # egress purge, burst corruption, routing toward a down host) —
+    # kept apart from drop_loss so an injected outage is never
+    # misread as wire loss (docs/robustness.md drop taxonomy)
     # per-host recovery activity (fed by the device TCP layer / callers;
     # the raw plane has no retransmit concept of its own)
     retransmits: jax.Array
@@ -68,6 +72,7 @@ def make_metrics(n_hosts: int) -> PlaneMetrics:
     return PlaneMetrics(
         pkts_out=z(), bytes_out=z(), pkts_in=z(), bytes_in=z(),
         drop_ring_full=z(), drop_qdisc=z(), drop_loss=z(),
+        drop_fault=z(),
         retransmits=z(), max_eg_depth=z(), max_in_depth=z(),
         windows=s(), events=s(), sort_slots=s(),
     )

@@ -29,8 +29,13 @@ def enable_compilation_cache() -> None:
                 _os.path.expanduser("~/.cache/shadow_tpu_xla"))
             jax.config.update("jax_persistent_cache_min_compile_time_secs",
                               0.5)
-    except Exception:  # pragma: no cover - knob renamed/removed upstream
-        pass
+    except Exception as e:  # pragma: no cover - knob renamed/removed upstream
+        # losing the persistent cache is a perf regression, not an
+        # error; say so once instead of swallowing (SL401 discipline)
+        import logging
+
+        logging.getLogger("shadow_tpu.tpu").debug(
+            "persistent compilation cache unavailable: %s", e)
 
 def donating_jit(fun=None, donate_argnums=(0,), **jit_kwargs):
     """`jax.jit` that donates the state-pytree argument(s) so XLA aliases

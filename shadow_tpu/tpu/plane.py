@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..faults.plane import FaultArrays
 from ..telemetry.metrics import PlaneMetrics
 from . import codel
 
@@ -109,6 +110,12 @@ class NetPlaneState(NamedTuple):
     n_loss_dropped: jax.Array
     n_overflow_dropped: jax.Array
     n_delivered: jax.Array
+    # fault-plane drops (injected failures: dead-host egress purge,
+    # burst corruption, routing toward a crashed/link-down host) —
+    # distinct from n_loss_dropped so injected losses are never
+    # misattributed to the Bernoulli loss sample (docs/robustness.md);
+    # stays zero when window_step compiles with faults=None
+    n_fault_dropped: jax.Array
 
 
 def make_params(latency_ns: np.ndarray, loss: np.ndarray, up_bw_bps: np.ndarray,
@@ -201,6 +208,7 @@ def make_state(n_hosts: int, egress_cap: int = 32, ingress_cap: int = 64,
         n_loss_dropped=z((N,)),
         n_overflow_dropped=z((N,)),
         n_delivered=z((N,)),
+        n_fault_dropped=z((N,)),
     )
 
 
@@ -415,7 +423,8 @@ def chain_windows(state: NetPlaneState, params: NetPlaneParams,
                   rng_root: jax.Array, shift0, window0_ns, runahead_ns,
                   horizon_rel, stop_rel, max_windows: int = 64, *,
                   rr_enabled: bool = True, router_aqm: bool = False,
-                  no_loss: bool = False):
+                  no_loss: bool = False,
+                  faults: FaultArrays | None = None):
     """Advance consecutive scheduling windows ON DEVICE until one delivers.
 
     The device-resident analogue of the controller's window chain
@@ -440,7 +449,7 @@ def chain_windows(state: NetPlaneState, params: NetPlaneParams,
     def step(st, shift, window_ns):
         return window_step(st, params, rng_root, shift, window_ns,
                            rr_enabled=rr_enabled, router_aqm=router_aqm,
-                           no_loss=no_loss)
+                           no_loss=no_loss, faults=faults)
 
     hs = jnp.minimum(jnp.int32(horizon_rel), jnp.int32(stop_rel))
 
@@ -604,10 +613,20 @@ def ingest_rows(state: NetPlaneState, dst: jax.Array, nbytes: jax.Array,
 # ---------------------------------------------------------------------------
 
 
-def _refill_tokens(state: NetPlaneState, params: NetPlaneParams, shift_ns):
+def _refill_tokens(state: NetPlaneState, params: NetPlaneParams, shift_ns,
+                   *, faults: FaultArrays | None = None):
     """Section 1b: lazy 1ms-interval token refill (`relay/token_bucket.rs`);
     the sub-ms remainder carries across rounds so short windows don't leak
-    bandwidth. Returns (balance, tb_rem_ns)."""
+    bandwidth. Returns (balance, tb_rem_ns).
+
+    `faults` (static presence) applies per-host bandwidth degradation:
+    the refill rate is divided by `bw_div` (the rate-proportional part
+    of the capacity scales with it, the MTU burst term does not).
+    `bw_div=1` is bitwise-identity with faults=None."""
+    rate, cap = params.tb_rate, params.tb_cap
+    if faults is not None:
+        rate = jnp.maximum(rate // jnp.maximum(faults.bw_div, 1), 1)
+        cap = rate + (params.tb_cap - params.tb_rate)
     rem_total = state.tb_rem_ns + (shift_ns % 1_000_000)
     elapsed_ms = (shift_ns // 1_000_000) + (rem_total // 1_000_000)
     tb_rem_ns = rem_total % 1_000_000
@@ -616,12 +635,10 @@ def _refill_tokens(state: NetPlaneState, params: NetPlaneParams, shift_ns):
     # which stays inside int32 for any rate <= 2^30 (make_params guarantees
     # it) — the naive balance + rate*fill_ms wrapped negative for rates near
     # 1e9 B/ms and stalled every egress queue for one round
-    headroom = jnp.maximum(params.tb_cap - state.tb_balance, 0)
-    need_ms = (headroom + params.tb_rate - 1) // params.tb_rate
+    headroom = jnp.maximum(cap - state.tb_balance, 0)
+    need_ms = (headroom + rate - 1) // rate
     elapsed_eff = jnp.minimum(elapsed_ms, need_ms)
-    balance = jnp.minimum(
-        state.tb_balance + params.tb_rate * elapsed_eff, params.tb_cap
-    )
+    balance = jnp.minimum(state.tb_balance + rate * elapsed_eff, cap)
     return balance, tb_rem_ns
 
 
@@ -711,11 +728,22 @@ def _rr_advance(eg_sock, eg_valid, sendable, rr_aux):
 
 def _loss_latency(state: NetPlaneState, params: NetPlaneParams, rng_root,
                   eg_dst, eg_ctrl, eg_tsend, eg_clamp, sendable, window_ns,
-                  *, no_loss: bool):
+                  *, no_loss: bool, faults: FaultArrays | None = None):
     """Section 3: Bernoulli path-loss draw + latency lookup through the
     node-level tables (host -> node, then the [M, M] path matrices — vs a
     [N, N] host-pair gather whose per-element HBM cost dominated the step
-    at 4k+ hosts). Returns (sent, lost, rng_counter', deliver_rel)."""
+    at 4k+ hosts). Returns (sent, lost, rng_counter', deliver_rel); with
+    `faults` threaded (static presence) the return gains a `corrupt`
+    mask after `lost`: (sent, lost, corrupt, rng_counter', deliver_rel).
+
+    Fault handling here: (a) burst corruption — an extra Bernoulli drawn
+    from an INDEPENDENT counter-based stream (host index offset by N, so
+    the loss stream is untouched and a corruption schedule never changes
+    which packets the base world loss-drops); control packets exempt,
+    like path loss. (b) per-link latency degradation — `lat_mult` as an
+    integer multiplier with the latency pre-clamped to the int32 window
+    budget so the multiply can never wrap; `mult=1` is bitwise identity.
+    """
     N, CE = eg_dst.shape
     host_idx = jnp.arange(N, dtype=jnp.int32)[:, None]
     dst_clipped = jnp.clip(eg_dst, 0, N - 1)
@@ -733,16 +761,32 @@ def _loss_latency(state: NetPlaneState, params: NetPlaneParams, rng_root,
         p_loss = params.loss[jnp.broadcast_to(node_src, (N, CE)), node_dst]
         lost = sendable & (u < p_loss) & ~eg_ctrl
         sent = sendable & ~lost
+    corrupt = None
+    if faults is not None:
+        counter2 = state.rng_counter[:, None] + jnp.arange(CE,
+                                                           dtype=jnp.int32)
+        u2 = _pkt_uniform(rng_root,
+                          jnp.broadcast_to(host_idx + N, (N, CE)), counter2)
+        corrupt = (sendable & ~lost & ~eg_ctrl
+                   & (u2 < faults.corrupt_p[:, None]))
+        sent = sent & ~corrupt
     # draws consumed only for slots that attempted transmission, keeping the
     # stream independent of queue occupancy beyond the sendable prefix
     rng_counter = state.rng_counter + sendable.sum(axis=1, dtype=jnp.int32)
 
     latency = params.latency_ns[jnp.broadcast_to(node_src, (N, CE)), node_dst]
+    if faults is not None:
+        mult = jnp.maximum(faults.lat_mult[
+            jnp.broadcast_to(node_src, (N, CE)), node_dst], 1)
+        degraded = jnp.minimum(latency, (I32_MAX // 2) // mult) * mult
+        latency = jnp.where(mult > 1, degraded, latency)
     # send time + latency, but no earlier than the round barrier the packet
     # was sent under (`worker.rs:396-399`); NO_CLAMP means "this window's
     # end" (pure-device mode, where ingest and step share the window)
     clamp_eff = jnp.where(eg_clamp == NO_CLAMP, window_ns, eg_clamp)
     deliver_rel = jnp.maximum(eg_tsend + latency, clamp_eff)
+    if faults is not None:
+        return sent, lost, corrupt, rng_counter, deliver_rel
     return sent, lost, rng_counter, deliver_rel
 
 
@@ -893,7 +937,7 @@ def _compact_egress(eg_prio, eg_dst, eg_bytes, eg_seq, eg_ctrl, eg_tsend,
 def _accumulate_metrics(metrics: PlaneMetrics, state: NetPlaneState,
                         sent, lost, due, overflowed, delivered,
                         in_valid_m, router_dropped_delta,
-                        eg_bytes) -> PlaneMetrics:
+                        fault_dropped_delta, eg_bytes) -> PlaneMetrics:
     """Section 8 (telemetry, compiled in only when a metrics pytree is
     threaded): pure jnp adds over values the step already materialized.
     Nothing here feeds back into simulation state — the parity tests in
@@ -914,6 +958,7 @@ def _accumulate_metrics(metrics: PlaneMetrics, state: NetPlaneState,
         drop_qdisc=metrics.drop_qdisc + router_dropped_delta,
         drop_loss=metrics.drop_loss
         + lost.sum(axis=1, dtype=jnp.int32),
+        drop_fault=metrics.drop_fault + fault_dropped_delta,
         retransmits=metrics.retransmits,
         # high-water marks at the PEAK points: egress occupancy entering
         # the window (ingest already ran), ingress after this window's
@@ -937,6 +982,7 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
                 rr_enabled: bool = True, router_aqm: bool = False,
                 no_loss: bool = False, packed_sort: bool = True,
                 kernel: str = "xla",
+                faults: FaultArrays | None = None,
                 metrics: PlaneMetrics | None = None):
     """Advance one scheduling round [t, t + window_ns).
 
@@ -978,6 +1024,19 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     simulation state (tests/test_telemetry.py). With metrics=None
     (default) the telemetry section is compiled out entirely.
 
+    `faults` (static presence switch) threads the fault plane
+    (`faults/plane.FaultArrays`, docs/robustness.md): crashed /
+    link-down hosts stop transmitting (their queued egress drops) and
+    stop accepting new routing (packets toward them drop), per-link
+    latency multiplies, per-host egress bandwidth divides, and burst
+    corruption applies an extra Bernoulli drop from an independent
+    counter stream. All fault drops accumulate in `n_fault_dropped`
+    (and the telemetry `drop_fault` bucket), never in the loss-sample
+    counter. With faults=None (default) every fault branch is compiled
+    out — bitwise-identical to the pre-fault plane — and neutral masks
+    (`neutral_faults`) are bitwise-identity too (tests/test_faults.py).
+    XLA kernel only (the pallas egress fusion predates the fault gate).
+
     `shift_ns` = this window's start minus the previous window's start;
     stored relative times are rebased by it. Returns
     (state', delivered, next_event_rel) — plus metrics' as a fourth
@@ -994,12 +1053,19 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
         raise ValueError(
             "plane_kernel='pallas' fuses the FIFO qdisc only; compile "
             "with rr_enabled=False (all-FIFO configs) or use the XLA path")
+    if kernel == "pallas" and faults is not None:
+        raise ValueError(
+            "plane_kernel='pallas' does not fuse the fault plane; compile "
+            "with kernel='xla' when a FaultArrays pytree is threaded (the "
+            "self-healing kernel fallback in faults/healing.py does this "
+            "automatically)")
     N, CE = state.eg_dst.shape
 
     # --- 1. rebase clocks + refill token buckets -----------------------
     in_deliver = jnp.where(state.in_valid, state.in_deliver_rel - shift_ns,
                            I32_MAX)
-    balance, tb_rem_ns = _refill_tokens(state, params, shift_ns)
+    balance, tb_rem_ns = _refill_tokens(state, params, shift_ns,
+                                        faults=faults)
     rt = codel.rebase_router_state(state.router, shift_ns, params.dn_rate,
                                    params.dn_cap)
 
@@ -1036,14 +1102,45 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
          eg_clamp, eg_valid) = _egress_order(
             state, qkey1, qkey2, eg_tsend_rb, eg_clamp_rb,
             rr_enabled=rr_enabled, packed_sort=packed_sort)
+        if faults is not None:
+            # 2f. a crashed / link-down host transmits nothing: its queued
+            # egress drops HERE, before the token gate (dead hosts spend
+            # no bandwidth), counted once per slot — the slots leave the
+            # queue, so a multi-window outage never double-counts
+            up_src = (faults.host_alive & faults.link_up)[:, None]
+            fault_purged = eg_valid & ~up_src
+            eg_valid = eg_valid & up_src
         sendable, balance = _token_gate(eg_valid, eg_bytes, balance)
         rr_sent = (_rr_advance(eg_sock, eg_valid, sendable, rr_aux)
                    if rr_enabled else state.rr_sent)
 
     # --- 3. loss sampling + latency lookup ------------------------------
-    sent, lost, rng_counter, deliver_rel = _loss_latency(
-        state, params, rng_root, eg_dst, eg_ctrl, eg_tsend, eg_clamp,
-        sendable, window_ns, no_loss=no_loss)
+    if faults is not None:
+        sent, lost, corrupt, rng_counter, deliver_rel = _loss_latency(
+            state, params, rng_root, eg_dst, eg_ctrl, eg_tsend, eg_clamp,
+            sendable, window_ns, no_loss=no_loss, faults=faults)
+        # 3f. routing toward a crashed / link-down destination drops (the
+        # fault withdraws the route); packets already in the dst's ingress
+        # ring are untouched — the crash does not reach into the wire
+        up = faults.host_alive & faults.link_up
+        dst_ok = up[jnp.clip(eg_dst, 0, N - 1)] & (eg_dst >= 0) \
+            & (eg_dst < N)
+        blocked_dst = sent & ~dst_ok & (eg_dst >= 0) & (eg_dst < N)
+        sent = sent & dst_ok
+        # per-host fault-drop attribution: purge + corruption to the
+        # SOURCE (its packets died on its own NIC), routing blocks to
+        # the DESTINATION (the crash that ate them is the dst's)
+        fault_drops = (
+            fault_purged.sum(axis=1, dtype=jnp.int32)
+            + corrupt.sum(axis=1, dtype=jnp.int32)
+            + jnp.zeros((N,), jnp.int32).at[
+                jnp.clip(eg_dst, 0, N - 1).reshape(-1)].add(
+                blocked_dst.reshape(-1), mode="drop")
+        )
+    else:
+        sent, lost, rng_counter, deliver_rel = _loss_latency(
+            state, params, rng_root, eg_dst, eg_ctrl, eg_tsend, eg_clamp,
+            sendable, window_ns, no_loss=no_loss)
 
     # egress queue keeps only what didn't go out (compacted after routing,
     # which still indexes this ordering)
@@ -1157,11 +1254,15 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
         n_loss_dropped=state.n_loss_dropped + lost.sum(axis=1, dtype=jnp.int32),
         n_overflow_dropped=state.n_overflow_dropped + overflowed,
         n_delivered=state.n_delivered + due.sum(axis=1, dtype=jnp.int32),
+        **({"n_fault_dropped": state.n_fault_dropped + fault_drops}
+           if faults is not None else {}),
     )
     if metrics is not None:
         # --- 8. telemetry accumulation (static; compiled out when off) --
         metrics = _accumulate_metrics(
             metrics, state, sent, lost, due, overflowed, delivered,
-            in_valid_m, rt_out.dropped - state.router.dropped, eg_bytes)
+            in_valid_m, rt_out.dropped - state.router.dropped,
+            fault_drops if faults is not None
+            else jnp.zeros((N,), jnp.int32), eg_bytes)
         return new_state, delivered, next_event, metrics
     return new_state, delivered, next_event

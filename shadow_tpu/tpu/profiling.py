@@ -32,6 +32,10 @@ Sections (superset of the window step's numbered stages):
   perf-smoke job fails when this drifts past the no-host-sync budget
   relative to ``window_step`` — the harvester may never add a device
   sync (or material compute) to the hot path.
+- ``window_step_faults`` — the full step with NEUTRAL FaultArrays
+  masks threaded (docs/robustness.md). The CI chaos-smoke job gates on
+  its ratio against ``window_step`` the same way (local bar: 5%): the
+  fault plane's presence switch must stay cheap when nothing fails.
 
 Drive it from the CLI: ``python tools/profile_plane.py --hosts 1024,32768``.
 """
@@ -50,7 +54,7 @@ DEFAULT_SECTIONS = (
     "rebase_refill", "rr_tensors", "qdisc_sort", "token_gate",
     "loss_latency", "ingress_compact", "routing_scatter", "release_due",
     "codel_drain", "egress_compact", "ingest_rows", "window_step",
-    "window_step_telemetry",
+    "window_step_telemetry", "window_step_faults",
 )
 
 
@@ -168,6 +172,7 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
                         _route_scatter, _row_sort, _token_gate, ingest_rows,
                         window_step)
 
+    from ..faults.plane import neutral_faults as _neutral_faults
     from ..telemetry import make_metrics as _zero_metrics
 
     wanted = tuple(sections) if sections is not None else DEFAULT_SECTIONS
@@ -286,6 +291,13 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
                 st, params, rng_root, sh, window, rr_enabled=rr_enabled,
                 packed_sort=packed_sort, kernel=kernel, metrics=m)),
             (state, _zero_metrics(n_hosts), shift)),
+        "window_step_faults": (
+            # faults require the XLA step (the pallas fusion predates
+            # the fault gate), so this section pins kernel="xla"
+            jax.jit(lambda st, f, sh: window_step(
+                st, params, rng_root, sh, window, rr_enabled=rr_enabled,
+                packed_sort=packed_sort, kernel="xla", faults=f)),
+            (state, _neutral_faults(n_hosts, n_nodes), shift)),
     }
 
     out_sections = {}

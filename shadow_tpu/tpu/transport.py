@@ -153,8 +153,16 @@ class DeviceTransport:
             raise ValueError("path latency exceeds the int32 device budget")
         host_node = np.asarray(
             [routing.node_index(h.node_id) for h in self.hosts], np.int32)
+        # the UNDEGRADED table is kept host-side: the fault plane's
+        # link_degrade events rebuild the device table from it
+        # (`apply_fault_latency`)
+        self._base_latency_np = node_lat.astype(np.int64)
         self._latency = jnp.asarray(node_lat.astype(np.int32))
         self._host_node = jnp.asarray(host_node)
+        # transient-device-error retry policy (faults/healing.py); the
+        # Manager sets attempts > 0 from `faults.device_retries`
+        self.retry_attempts = 0
+        self.retry_backoff_s = 0.05
 
         CI = ingress_cap
         z = lambda shape: jnp.zeros(shape, jnp.int32)
@@ -371,10 +379,53 @@ class DeviceTransport:
         # CPU test backend donating_jit compiles without donation.
         from . import donating_jit
 
-        self._k_ingest = donating_jit(ingest)
-        self._k_step = donating_jit(step_compact)
-        self._k_chain = donating_jit(chain)
-        self._k_batch_verify = donating_jit(batch_verify)
+        self._k_ingest = self._retrying(donating_jit(ingest), "ingest")
+        self._k_step = self._retrying(donating_jit(step_compact), "step")
+        self._k_chain = self._retrying(donating_jit(chain), "chain")
+        self._k_batch_verify = self._retrying(
+            donating_jit(batch_verify), "batch_verify")
+
+    def _retrying(self, kernel, what: str):
+        """Wrap a kernel dispatch in the transient-error retry loop
+        (`faults/healing.retry_transient`) when the Manager configured
+        retries. NOTE donation: the wrapped kernels donate the state
+        pytree, but a dispatch that raises before enqueue leaves the
+        input buffers valid — XLA only invalidates donated buffers it
+        actually consumed, and a dispatch that died mid-execution is
+        not retryable state anyway (the classifier treats data-plane
+        poison like INTERNAL as non-transient)."""
+
+        def call(*args, **kwargs):
+            if not self.retry_attempts:
+                return kernel(*args, **kwargs)
+            from ..faults.healing import retry_transient
+
+            return retry_transient(
+                kernel, *args, attempts=self.retry_attempts,
+                backoff_s=self.retry_backoff_s,
+                what=f"device transport {what}", **kwargs)
+
+        return call
+
+    def apply_fault_latency(self, lat_mult: np.ndarray) -> None:
+        """Mirror a link_degrade/link_restore event onto the device:
+        rebuild the latency table as base * mult (node-index space) and
+        recompile the kernels against it, so on-device deliver times
+        keep matching the CPU arithmetic bit for bit. Rare (once per
+        link event); mirrored mode flushes its record batch FIRST so no
+        dispatched window ever mixes tables."""
+        import jax.numpy as jnp
+
+        if self.mirrored and self._records:
+            self._flush_mirrored()
+        degraded = self._base_latency_np * np.asarray(lat_mult, np.int64)
+        # shadowlint: disable=SL105 -- host-side numpy overflow guard, not a traced value
+        if degraded.size and degraded.max() >= I32_MAX:
+            raise ValueError(
+                "fault-degraded path latency exceeds the int32 device "
+                "budget; lower the latency_mult")
+        self._latency = jnp.asarray(degraded.astype(np.int32))
+        self._build_kernels(self._n, self._ingress_cap, self._compact_cap)
 
     # -- capture (called from Worker.send_packet, any worker thread) -----
 

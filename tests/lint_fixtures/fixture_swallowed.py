@@ -1,0 +1,67 @@
+"""SL401 fixture: swallowed broad exceptions vs acceptable handlers."""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def swallow_exception():
+    try:
+        risky()
+    except Exception:  # BAD: broad + pure swallow
+        pass
+
+
+def swallow_base_exception_tuple():
+    try:
+        risky()
+    except (ValueError, BaseException):  # BAD: tuple containing broad
+        ...
+
+
+def bare_no_reraise():
+    try:
+        risky()
+    except:  # noqa: E722  BAD: bare, no raise/log anywhere in body
+        cleanup()
+
+
+def bare_with_reraise():
+    try:
+        risky()
+    except:  # noqa: E722  OK: re-raises
+        cleanup()
+        raise
+
+
+def broad_but_logged():
+    try:
+        risky()
+    except Exception:  # OK: not a pure swallow (and it logs)
+        log.warning("risky failed", exc_info=True)
+
+
+def broad_but_handled():
+    try:
+        risky()
+    except Exception as e:  # OK: error is transported, not dropped
+        record(e)
+
+
+def narrow_swallow_ok():
+    try:
+        risky()
+    except OSError:  # OK: narrow type, deliberate judgement call
+        pass
+
+
+def risky():
+    raise ValueError
+
+
+def cleanup():
+    pass
+
+
+def record(e):
+    return e

@@ -99,6 +99,70 @@ def test_cli_show_config(tmp_path):
     assert "server" in parsed["hosts"]
 
 
+def test_cli_exit_codes_documented():
+    """The exit-code contract (docs/robustness.md): 0 ok, 1 simulation
+    failure, 2 config error, 3 watchdog abort, 4 unhandled crash."""
+    from shadow_tpu import cli
+
+    assert (cli.EXIT_OK, cli.EXIT_SIM_FAILURE, cli.EXIT_CONFIG,
+            cli.EXIT_WATCHDOG, cli.EXIT_CRASH) == (0, 1, 2, 3, 4)
+
+
+def test_cli_config_error_exit_code(tmp_path):
+    cfg = tmp_path / "bad.yaml"
+    cfg.write_text("general: {stop_time: 2s}\nbogus_section: {}\n")
+    proc = run_cli([str(cfg)], cwd=tmp_path)
+    assert proc.returncode == 2
+    assert "config error" in proc.stderr
+
+
+def test_cli_bad_fault_event_exit_code(tmp_path):
+    """A bad `faults:` event dies as a ConfigError (exit 2) at Manager
+    build, never as a mid-run traceback."""
+    cfg = tmp_path / "badfault.yaml"
+    cfg.write_text(
+        """
+general: {stop_time: 2s, data_directory: %s}
+network: {graph: {type: 1_gbit_switch}}
+faults:
+  events:
+    - {at: 1s, kind: host_crash, host: no-such-host}
+hosts:
+  a: {network_node_id: 0}
+"""
+        % (tmp_path / "dataf")
+    )
+    proc = run_cli([str(cfg)], cwd=tmp_path)
+    assert proc.returncode == 2
+    assert "not a configured host" in proc.stderr
+
+
+def test_cli_crash_exit_code(tmp_path):
+    """An unhandled error inside the run is exit 4 (distinct from the
+    simulation-failure exit 1), with the traceback on stderr."""
+    cfg = tmp_path / "crash.yaml"
+    cfg.write_text(
+        """
+general: {stop_time: 2s, data_directory: %s}
+network: {graph: {type: gml, file: /nonexistent/topology.gml}}
+hosts:
+  a: {network_node_id: 0}
+"""
+        % (tmp_path / "datac")
+    )
+    proc = run_cli([str(cfg)], cwd=tmp_path)
+    assert proc.returncode == 4
+    assert "Traceback" in proc.stderr
+
+
+def test_cli_resume_on_round_loop_run_refused(tmp_path):
+    cfg = write_config(tmp_path)
+    proc = run_cli([str(cfg), "--resume", str(tmp_path / "nope")],
+                   cwd=tmp_path)
+    assert proc.returncode == 2
+    assert "flow-engine" in proc.stderr
+
+
 def test_determinism_harness(tmp_path):
     cfg = write_config(tmp_path)
     env = dict(os.environ)

@@ -241,10 +241,78 @@ def test_clean_fixture_and_sl101_scope():
 
 def test_rule_registry_complete():
     assert set(RULES) == {f"SL10{i}" for i in range(1, 6)} | {
-        f"SL20{i}" for i in range(1, 6)} | {"SL301"}
-    for rid in ("SL101", "SL102", "SL103", "SL104", "SL105", "SL301"):
+        f"SL20{i}" for i in range(1, 6)} | {"SL301", "SL401"}
+    for rid in ("SL101", "SL102", "SL103", "SL104", "SL105", "SL301",
+                "SL401"):
         assert rule_applies(rid, "shadow_tpu/core/x.py") \
             or rid in ("SL105", "SL301")
+
+
+# -- SL401: swallowed broad exceptions ------------------------------------
+
+def test_sl401_swallowed_errors():
+    src, findings = _lint_fixture(
+        "fixture_swallowed.py", "shadow_tpu/process/fixture_swallowed.py")
+    f401 = [f for f in findings if f.rule == "SL401"]
+    active = {f.line for f in f401 if not f.suppressed}
+    assert active == {
+        _line_of(src, "except Exception:  # BAD"),
+        _line_of(src, "except (ValueError, BaseException):  # BAD"),
+        _line_of(src, "except:  # noqa: E722  BAD"),
+    }
+
+
+def test_sl401_scoped_to_shadow_tpu():
+    src = "try:\n    pass\nexcept Exception:\n    pass\n"
+    assert [f for f in lint_source(src, "shadow_tpu/core/x.py")
+            if f.rule == "SL401"]
+    assert not [f for f in lint_source(src, "tools/x.py")
+                if f.rule == "SL401"]
+
+
+def test_sl401_narrow_types_and_logged_handlers_pass():
+    src, findings = _lint_fixture(
+        "fixture_swallowed.py", "shadow_tpu/process/fixture_swallowed.py")
+    ok_lines = {
+        _line_of(src, "except:  # noqa: E722  OK"),
+        _line_of(src, "except Exception:  # OK"),
+        _line_of(src, "except Exception as e:  # OK"),
+        _line_of(src, "except OSError:  # OK"),
+    }
+    assert not ok_lines & {f.line for f in findings if f.rule == "SL401"}
+
+
+def test_sl401_suppression_works():
+    src = (
+        "try:\n"
+        "    risky()\n"
+        "# shadowlint: disable=SL401 -- cleanup-only teardown guard\n"
+        "except Exception:\n"
+        "    pass\n"
+    )
+    f401 = [f for f in lint_source(src, "shadow_tpu/core/x.py")
+            if f.rule == "SL401"]
+    assert len(f401) == 1 and f401[0].suppressed
+    assert f401[0].justification == "cleanup-only teardown guard"
+
+
+def test_sl401_tree_is_clean():
+    """Every in-tree broad handler either logs, re-raises, or carries a
+    justified suppression — the satellite's fix-or-suppress contract."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = os.path.join(repo, "shadow_tpu")
+    bad = []
+    for dirpath, _dirs, files in os.walk(root):
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, repo).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as fh:
+                for f in lint_source(fh.read(), rel):
+                    if f.rule == "SL401" and not f.suppressed:
+                        bad.append(str(f))
+    assert not bad, "\n".join(bad)
 
 
 # -- pass 2 rules (synthetic kernels) -------------------------------------

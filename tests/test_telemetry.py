@@ -560,5 +560,6 @@ def test_tracker_heartbeat_sorted_keys_and_idle_zero_lines(caplog):
     line = caplog.records[-1].getMessage()
     payload = json.loads(line[line.index("{"):])
     assert payload == {"by_protocol": {}, "bytes_in": 0, "bytes_out": 0,
-                       "packets_dropped": 0, "packets_in": 0,
-                       "packets_out": 0, "retransmitted": 0}
+                       "packets_dropped": 0, "packets_dropped_fault": 0,
+                       "packets_in": 0, "packets_out": 0,
+                       "retransmitted": 0}

@@ -1,0 +1,214 @@
+#!/usr/bin/env python3
+"""Chaos smoke: a fault-injected device-plane sim that survives a kill.
+
+The CI end-to-end for the fault plane (docs/robustness.md): run the
+PHOLD bench world with an ACTIVE fault schedule (host crash + reboot,
+link degradation, corruption burst, iface flap) threaded through
+`window_step(..., faults=)`, checkpointing every few windows; kill the
+process mid-run; resume from the checkpoint and prove the final state
+is BITWISE-identical to an uninterrupted run of the same seed.
+
+Usage:
+  python tools/chaos_smoke.py --hosts 256 --windows 48 \
+      --checkpoint-dir chaos/ --checkpoint-every 8        # full run
+  python tools/chaos_smoke.py ... --kill-at 20            # dies at w20
+  python tools/chaos_smoke.py ... --resume chaos/ckpt-000000000016
+                                                          # continues
+Each invocation prints ONE JSON line with the final state digest,
+drop-bucket totals, and fallback/fault bookkeeping; CI compares the
+digest of the resumed run against the uninterrupted one.
+
+`--kernel pallas` drives the step through the self-healing
+`KernelFallback`: the Pallas egress kernel cannot fuse the fault gate,
+so the driver demotes to the bitwise-identical XLA path, loudly — the
+run completes and the JSON records `fell_back: true`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MS = 1_000_000
+
+
+def default_schedule(n_hosts: int, n_windows: int, window_ns: int):
+    """The built-in chaos scenario, scaled to the run length: crash one
+    host for the middle quarter, degrade a link 4x, corrupt another
+    host's egress, flap a third's NIC. Compiled through the REAL
+    `faults:` schedule path (config dataclass -> compile_schedule)."""
+    from shadow_tpu.core.config import FaultsOptions
+    from shadow_tpu.faults.schedule import compile_schedule
+
+    w = lambda k: f"{max(1, k) * window_ns}ns"
+    q = max(2, n_windows // 4)
+    events = [
+        {"at": w(q), "kind": "host_crash", "host": "h1"},
+        {"at": w(2 * q), "kind": "host_reboot", "host": "h1"},
+        {"at": w(q // 2), "kind": "link_degrade", "src_node": 0,
+         "dst_node": 1, "latency_mult": 4, "duration": w(2 * q)},
+        {"at": w(q), "kind": "corrupt_burst", "host": f"h{n_hosts - 1}",
+         "p": 0.3, "duration": w(q)},
+        {"at": w(2 * q), "kind": "iface_down", "host": "h2"},
+        {"at": w(2 * q + q // 2), "kind": "iface_up", "host": "h2"},
+        {"at": w(q), "kind": "host_degrade", "host": "h0",
+         "bandwidth_div": 8, "duration": w(q)},
+    ]
+    opts = FaultsOptions(events=events)
+    return compile_schedule(
+        opts, host_names=[f"h{i}" for i in range(n_hosts)],
+        n_nodes=64, seed=1234, stop_time_ns=(n_windows + 1) * window_ns)
+
+
+def state_digest(*pytrees) -> str:
+    import jax
+
+    h = hashlib.sha256()
+    for tree in pytrees:
+        for leaf in jax.tree.leaves(jax.device_get(tree)):
+            arr = np.asarray(leaf)
+            h.update(str(arr.dtype).encode())
+            h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--hosts", type=int, default=256)
+    ap.add_argument("--windows", type=int, default=48)
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--checkpoint-every", type=int, default=8)
+    ap.add_argument("--kill-at", type=int, default=None,
+                    help="exit abruptly (no cleanup) after this window")
+    ap.add_argument("--resume", default=None,
+                    help="checkpoint directory to restore and continue")
+    ap.add_argument("--kernel", choices=["xla", "pallas"], default="xla")
+    ap.add_argument("--no-faults", action="store_true",
+                    help="neutral masks only (the overhead-gate twin)")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from shadow_tpu.faults import (KernelFallback, load_plane_checkpoint,
+                                   neutral_faults, save_plane_checkpoint)
+    from shadow_tpu.telemetry import make_metrics
+    from shadow_tpu.tpu import ingest_rows, profiling
+    from shadow_tpu.tpu.plane import window_step
+
+    N, R = args.hosts, args.windows
+    world = profiling.build_world(N, warmup_windows=0)
+    window = world["window"]
+    window_ns = int(window)
+    CI = world["ingress_cap"]
+    schedule = (None if args.no_faults
+                else default_schedule(N, R, window_ns))
+
+    def build_step(kernel: str):
+        @jax.jit
+        def step(state, metrics, faults, spawn_seq, shift, round_idx):
+            out = window_step(state, world["params"], world["rng_root"],
+                              shift, window, rr_enabled=False,
+                              kernel=kernel, faults=faults,
+                              metrics=metrics)
+            state, delivered, _next, metrics = out
+            mask, dst, nbytes, seq, ctrl = profiling.respawn_batch(
+                delivered, spawn_seq, round_idx, N, CI)
+            # dead/flapped hosts generate no respawn traffic
+            mask = mask & (faults.host_alive & faults.link_up)[:, None]
+            state, metrics = ingest_rows(
+                state, dst, nbytes, seq, seq, ctrl, valid=mask,
+                metrics=metrics)
+            return state, metrics, spawn_seq + mask.sum(
+                axis=1, dtype=jnp.int32)
+        return step
+
+    driver = KernelFallback(args.kernel, build_step)
+
+    start_w = 0
+    state = world["state"]
+    metrics = make_metrics(N)
+    spawn_seq = jnp.full((N,), 10_000, jnp.int32)
+    if args.resume:
+        restored = load_plane_checkpoint(
+            args.resume, state_template=state,
+            faults_template=neutral_faults(N, 64),
+            metrics_template=metrics)
+        state = restored["state"]
+        metrics = restored["metrics"]
+        spawn_seq = jnp.asarray(restored["extra"]["spawn_seq"])
+        start_w = int(restored["meta"]["window_index"])
+        got = state_digest(state, spawn_seq)
+        want = restored["meta"].get("state_digest")
+        if want and got != want:
+            raise SystemExit(
+                f"chaos_smoke: restored state digest {got[:12]} != "
+                f"checkpointed {want[:12]} — restore is not faithful")
+        if schedule is not None:
+            # replay the schedule's mask state up to the restore point
+            # (the schedule is a pure function of config — cheap)
+            schedule.advance(start_w * window_ns)
+        print(f"chaos_smoke: resumed at window {start_w} from "
+              f"{args.resume}", file=sys.stderr)
+
+    checkpoints = []
+    for wdx in range(start_w, R):
+        now_ns = (wdx + 1) * window_ns
+        if schedule is not None:
+            schedule.advance(now_ns)
+            faults = schedule.device_arrays()
+        else:
+            faults = neutral_faults(N, 64)
+        shift = jnp.int32(0 if wdx == 0 else window_ns)
+        state, metrics, spawn_seq = driver(
+            state, metrics, faults, spawn_seq, shift, jnp.int32(wdx))
+        if args.checkpoint_dir and args.checkpoint_every \
+                and (wdx + 1) % args.checkpoint_every == 0 and wdx + 1 < R:
+            path = os.path.join(args.checkpoint_dir,
+                                f"ckpt-{wdx + 1:012d}")
+            save_plane_checkpoint(
+                path, state=state, clock_ns=now_ns,
+                rng_key_data=jax.random.key_data(world["rng_root"]),
+                faults=faults, metrics=metrics,
+                extra_arrays={"spawn_seq": spawn_seq},
+                meta={"window_index": wdx + 1, "hosts": N,
+                      "state_digest": state_digest(state, spawn_seq)})
+            checkpoints.append(path)
+        if args.kill_at is not None and wdx + 1 >= args.kill_at:
+            print(f"chaos_smoke: simulating a crash at window {wdx + 1}",
+                  file=sys.stderr)
+            sys.stderr.flush()
+            os._exit(137)  # abrupt: no atexit, like a SIGKILL'd run
+
+    jax.block_until_ready(state)
+    m = jax.device_get(metrics)
+    out = {
+        "hosts": N,
+        "windows": R,
+        "resumed_from": args.resume,
+        "kernel": driver.kernel,
+        "fell_back": driver.fell_back,
+        "faults_active": schedule is not None,
+        "state_digest": state_digest(state, spawn_seq),
+        "drops": {
+            "ring_full": int(np.asarray(m.drop_ring_full).sum()),
+            "qdisc": int(np.asarray(m.drop_qdisc).sum()),
+            "loss": int(np.asarray(m.drop_loss).sum()),
+            "fault": int(np.asarray(m.drop_fault).sum()),
+        },
+        "events": int(np.asarray(m.events)),
+        "checkpoints": checkpoints,
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

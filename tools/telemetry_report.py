@@ -49,6 +49,18 @@ def _print_table(summary: dict) -> None:
             v = totals[k]
             shown = _fmt_bytes(v) if k.startswith("bytes") else v
             print(f"  {k:>18}: {shown}")
+        # drop taxonomy (docs/robustness.md): the `fault` bucket holds
+        # INJECTED losses (crashes, corruption bursts) so an operator
+        # never misreads a scheduled outage as wire loss
+        drops = {k[len("drop_"):]: v for k, v in totals.items()
+                 if k.startswith("drop_")}
+        if any(drops.values()):
+            total_drops = sum(drops.values())
+            parts = ", ".join(f"{k}={v}" for k, v in sorted(drops.items()))
+            print(f"drop breakdown ({total_drops} total): {parts}")
+            if drops.get("fault"):
+                print(f"  note: {drops['fault']} drop(s) are INJECTED "
+                      "faults (faults: schedule), not wire loss")
     if summary["top_talkers"]:
         print("top talkers (bytes out / in):")
         for t in summary["top_talkers"]:
