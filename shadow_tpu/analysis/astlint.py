@@ -28,6 +28,12 @@ once collecting findings. Rules scope by repo-relative path:
   (scan/while_loop/cond/...). Syncs outside kernel bodies (transport
   release barriers, the profiler's measurement loop, telemetry drains)
   are the sanctioned pattern and are not flagged.
+- SL402 (assert-in-kernel) applies to ``shadow_tpu/tpu/``: a Python
+  ``assert`` inside a kernel body (same detection as SL301) traces
+  once against abstract values and vanishes under ``-O`` — runtime
+  invariants go through the guard plane (``shadow_tpu/guards/``);
+  trace-time static checks use an explicit raise. Host-side asserts
+  outside kernel bodies are untouched.
 """
 
 from __future__ import annotations
@@ -82,7 +88,7 @@ def rule_applies(rule: str, relpath: str) -> bool:
         )
     if rule == "SL104":
         return True
-    if rule in ("SL105", "SL301"):
+    if rule in ("SL105", "SL301", "SL402"):
         return p.startswith("shadow_tpu/tpu/")
     if rule == "SL401":
         return p.startswith("shadow_tpu/")
@@ -330,6 +336,39 @@ def _sl301_findings(tree: ast.AST, imports: _Imports,
     return findings
 
 
+# -- SL402: Python assert inside kernel bodies ---------------------------
+
+
+def _sl402_findings(tree: ast.AST, imports: _Imports,
+                    relpath: str) -> list[Finding]:
+    """`assert` in a kernel body runs ONCE at trace time against
+    abstract values — it cannot check runtime data (and vanishes under
+    -O), so it reads as an invariant check that silently is not one.
+    Runtime invariants belong in the guard plane (shadow_tpu/guards/);
+    trace-time static checks use an explicit raise. Shares the kernel
+    detection with SL301."""
+    if not rule_applies("SL402", relpath):
+        return []
+    findings: list[Finding] = []
+    flagged: set[tuple[int, int]] = set()
+    for kernel in _kernel_bodies(tree, imports):
+        for node in ast.walk(kernel):
+            if not isinstance(node, ast.Assert):
+                continue
+            loc = (node.lineno, node.col_offset)
+            if loc in flagged:
+                continue
+            flagged.add(loc)
+            findings.append(Finding(
+                "SL402", relpath, node.lineno, node.col_offset,
+                "Python `assert` inside a jitted kernel body: it traces "
+                "once against abstract values and vanishes under -O — "
+                "route runtime invariants through the guard plane "
+                "(shadow_tpu/guards/, docs/robustness.md) and use an "
+                "explicit raise for trace-time static checks"))
+    return findings
+
+
 # -- SL401: swallowed broad exceptions -----------------------------------
 
 _BROAD_EXC = {"Exception", "BaseException"}
@@ -571,10 +610,12 @@ def lint_source(source: str, relpath: str,
     tree = ast.parse(source, filename=relpath)
     linter = _Linter(relpath, _Imports())
     linter.visit(tree)
-    # SL301 runs as a post-pass: the import table is complete after the
-    # main visit, and kernel detection needs the whole-file def map
+    # SL301/SL402 run as post-passes: the import table is complete after
+    # the main visit, and kernel detection needs the whole-file def map
     linter.findings.extend(
         _sl301_findings(tree, linter.imports, relpath))
+    linter.findings.extend(
+        _sl402_findings(tree, linter.imports, relpath))
     sup = suppressions if suppressions is not None \
         else parse_suppressions(source)
     for f in linter.findings:

@@ -230,12 +230,14 @@ class _StubRouting:
 
 def _plane_entry(rr_enabled: bool, router_aqm: bool, no_loss: bool,
                  packed_sort: bool = True, kernel: str = "xla",
-                 telemetry: bool = False, faults: bool = False):
+                 telemetry: bool = False, faults: bool = False,
+                 guards: bool = False):
     def build():
         import jax
         import jax.numpy as jnp
 
         from ..faults.plane import neutral_faults
+        from ..guards.plane import make_guards
         from ..telemetry import make_metrics
         from ..tpu import plane
 
@@ -272,6 +274,17 @@ def _plane_entry(rr_enabled: bool, router_aqm: bool, no_loss: bool,
                     kernel=kernel, metrics=metrics)
 
             return fn, (state, make_metrics(n), jnp.int32(0),
+                        jnp.int32(10_000_000))
+
+        if guards:
+            def fn(state, guard_state, shift, window):
+                return plane.window_step(
+                    state, params, root, shift, window,
+                    rr_enabled=rr_enabled, router_aqm=router_aqm,
+                    no_loss=no_loss, packed_sort=packed_sort,
+                    kernel=kernel, guards=guard_state)
+
+            return fn, (state, make_guards(n), jnp.int32(0),
                         jnp.int32(10_000_000))
 
         def fn(state, shift, window):
@@ -351,18 +364,22 @@ def _transport_entry(kernel: str):
             [_StubHost(i + 1, i % 3) for i in range(n)],
             _StubRouting(3), None, egress_cap=8, ingress_cap=8,
             mode="sync", compact_cap=16)
-        st = dt.state
+        # audit the GUARDED variants: the guard plane's checks are part
+        # of the kernel surface whenever guards are enabled, and the
+        # unguarded trace is a strict subset (g=None compiles them out)
+        dt.enable_guards()
+        st, g = dt.state, dt._guard
         if kernel == "ingest":
             b = 8
             z = lambda: jnp.zeros((b,), jnp.int32)
-            args = (st, z(), z(), z(), z(), z(), z(),
+            args = (st, g, z(), z(), z(), z(), z(), z(),
                     jnp.zeros((b,), bool))
             return dt._k_ingest, args
         if kernel == "step":
-            return dt._k_step, (st, jnp.int32(0), jnp.int32(1_000_000))
+            return dt._k_step, (st, g, jnp.int32(0), jnp.int32(1_000_000))
         if kernel == "chain":
             i32 = jnp.int32
-            return dt._k_chain, (st, i32(0), i32(1_000_000),
+            return dt._k_chain, (st, g, i32(0), i32(1_000_000),
                                  i32(1_000_000), i32(50_000_000),
                                  i32(50_000_000))
         # batch_verify: K windows of B ingest rows
@@ -371,7 +388,7 @@ def _transport_entry(kernel: str):
         row = {key: jnp.zeros((k, b), jnp.int32)
                for key in ("src", "dst", "seq", "tag", "send", "clamp")}
         row["valid"] = jnp.zeros((k, b), bool)
-        args = (st, zk(), zk(), row, jnp.zeros((k,), jnp.uint32),
+        args = (st, g, zk(), zk(), row, jnp.zeros((k,), jnp.uint32),
                 jnp.zeros((k,), jnp.uint32), zk(), jnp.int32(0))
         return dt._k_batch_verify, args
 
@@ -439,6 +456,8 @@ def default_entries() -> list[AuditEntry]:
                    _plane_entry(True, True, False, telemetry=True)),
         AuditEntry("window_step[faults]", "shadow_tpu.tpu.plane",
                    _plane_entry(True, True, False, faults=True)),
+        AuditEntry("window_step[guards]", "shadow_tpu.tpu.plane",
+                   _plane_entry(True, True, False, guards=True)),
         AuditEntry("chain_windows", "shadow_tpu.tpu.plane",
                    _chain_entry()),
         AuditEntry("tcp_event_step", "shadow_tpu.tpu.tcp",

@@ -91,6 +91,18 @@ RULES: dict[str, RuleInfo] = {
             "and turns into a host callback under jit",
         ),
         RuleInfo(
+            "SL402", "assert-in-kernel",
+            "Python `assert` inside a tpu/ kernel body (a jit-decorated"
+            "/jit-wrapped function or a lax control-flow body)",
+            "an assert in traced code runs ONCE at trace time against "
+            "abstract values (and vanishes entirely under -O): it can "
+            "never check runtime data, so it reads as an invariant "
+            "check that silently is not one. Runtime invariants go "
+            "through the guard plane (shadow_tpu/guards/, "
+            "docs/robustness.md); trace-time shape/static checks use "
+            "an explicit raise",
+        ),
+        RuleInfo(
             "SL401", "swallowed-error",
             "broad exception swallow (`except Exception: pass` or a "
             "bare `except:` without re-raise)",

@@ -24,14 +24,16 @@ from .core.manager import Manager
 # Documented exit codes (docs/robustness.md; asserted in tests/test_cli.py).
 # 1 keeps its historical meaning — the SIMULATION failed (a process missed
 # its expected final state, a mirrored transport diverged, a data dir was
-# refused) — while configuration, watchdog, and crash failures get their
-# own codes so wrappers can tell "fix the config" from "file a bug" from
-# "inspect the emergency checkpoint".
+# refused) — while configuration, watchdog, crash, and guard failures get
+# their own codes so wrappers can tell "fix the config" from "file a bug"
+# from "inspect the emergency checkpoint" from "the simulation failed its
+# own runtime invariants".
 EXIT_OK = 0
 EXIT_SIM_FAILURE = 1
 EXIT_CONFIG = 2
 EXIT_WATCHDOG = 3
 EXIT_CRASH = 4
+EXIT_GUARD = 5
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -72,6 +74,13 @@ def build_parser() -> argparse.ArgumentParser:
              "heartbeat JSONL + Perfetto trace land in the data directory)",
     )
     p.add_argument(
+        "--guards", action="store_true",
+        help="enable the guard plane (overrides guards.enabled; runtime "
+             "invariants + cross-plane reconciliation + progress "
+             "detection under the configured per-class policies — see "
+             "docs/robustness.md)",
+    )
+    p.add_argument(
         "--resume", metavar="CKPT",
         help="resume from a checkpoint directory (flow-engine runs: "
              "completed buckets are skipped and the continued run is "
@@ -94,6 +103,8 @@ def _apply_overrides(config: ConfigOptions, args) -> None:
         config.general.data_directory = args.data_directory
     if args.telemetry:
         config.telemetry.enabled = True
+    if args.guards:
+        config.guards.enabled = True
 
 
 def _config_as_dict(config: ConfigOptions) -> dict:
@@ -117,6 +128,8 @@ def _config_as_dict(config: ConfigOptions) -> dict:
         "experimental": conv(config.experimental),
         "telemetry": conv(config.telemetry),
         "faults": conv(config.faults),
+        "guards": conv(config.guards),
+        "strict": config.strict,
         "hosts": {name: conv(h) for name, h in config.hosts.items()},
     }
 
@@ -167,6 +180,7 @@ def main(argv=None) -> int:
 
     from .faults.checkpoint import CheckpointError
     from .faults.watchdog import WatchdogError
+    from .guards.report import GuardError
 
     try:
         mgr = Manager(config, data_dir=data_dir)
@@ -185,6 +199,19 @@ def main(argv=None) -> int:
         log.error("watchdog abort: %s", e)
         print(f"shadow_tpu: watchdog abort: {e}", file=sys.stderr)
         return EXIT_WATCHDOG
+    except GuardError as e:
+        # the simulation failed its OWN runtime invariants: the
+        # violation report (guards-report.json) is in the data dir, and
+        # an abort+checkpoint policy also left the emergency checkpoint
+        # + finalized telemetry as a postmortem bundle
+        log.error("guard abort: %s", e)
+        print(f"shadow_tpu: guard abort: {e}", file=sys.stderr)
+        print(
+            f"shadow_tpu: violation report: "
+            f"{os.path.join(data_dir, 'guards-report.json')}",
+            file=sys.stderr,
+        )
+        return EXIT_GUARD
     except Exception:
         import traceback
 
@@ -206,6 +233,15 @@ def main(argv=None) -> int:
             "telemetry: %d heartbeat lines over %d harvests -> %s",
             mgr.harvester.emitted, mgr.harvester.harvests,
             mgr.harvester.sink_path or "(log only)",
+        )
+
+    if mgr.guard_violations:
+        # warn-policy violations: the run completed, but it failed its
+        # own invariants — say so loudly and point at the report
+        log.warning(
+            "guards: %d violation(s) recorded under warn policy — see %s",
+            len(mgr.guard_violations),
+            os.path.join(data_dir, "guards-report.json"),
         )
 
     payload = stats.as_dict()

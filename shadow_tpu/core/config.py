@@ -218,6 +218,43 @@ class TelemetryOptions:
     per_host: bool = True
 
 
+#: valid per-class guard policies (guards/report.py shares this set)
+GUARD_POLICIES = ("off", "warn", "abort", "abort+checkpoint")
+
+
+@dataclass
+class GuardsOptions:
+    """The `guards:` config block (docs/robustness.md "Guard plane") —
+    runtime self-verification of the simulation against itself.
+
+    Three guard classes, each with its own policy:
+
+    - `device`    — on-device conservation/structure invariants threaded
+      through the device kernels (`tpu/plane.window_step(..., guards=)`
+      and the `DeviceTransport` kernels);
+    - `reconcile` — cross-plane reconciliation of device counters
+      against independent CPU ledgers and SimStats fleet totals, at
+      telemetry harvest boundaries and teardown;
+    - `progress`  — the round-loop zero-progress livelock detector
+      (`progress_rounds` consecutive stalled rounds trip it).
+
+    Policies: `off` | `warn` (log each violation, keep running) |
+    `abort` (raise GuardError, CLI exit 5) | `abort+checkpoint` (abort
+    plus the emergency checkpoint + finalized telemetry — a full
+    postmortem bundle). `enabled: false` (the default) turns the whole
+    plane off regardless of per-class policies, so `guards: {enabled:
+    true}` activates the warn-everything default in one line."""
+
+    enabled: bool = False
+    device: str = "warn"
+    reconcile: str = "warn"
+    progress: str = "warn"
+    progress_rounds: int = 64
+
+    def active(self, cls: str) -> bool:
+        return self.enabled and getattr(self, cls) != "off"
+
+
 @dataclass
 class FaultCheckpointOptions:
     """`faults.checkpoint` — periodic sim-state checkpoints
@@ -327,8 +364,15 @@ class ConfigOptions:
     experimental: ExperimentalOptions = field(default_factory=ExperimentalOptions)
     telemetry: TelemetryOptions = field(default_factory=TelemetryOptions)
     faults: FaultsOptions = field(default_factory=FaultsOptions)
+    guards: GuardsOptions = field(default_factory=GuardsOptions)
     host_defaults: HostDefaultOptions = field(default_factory=HostDefaultOptions)
     hosts: dict[str, HostOptions] = field(default_factory=dict)
+    # strict mode: unsupported feature combinations that normally
+    # log-and-ignore (flow-engine runs configured with fault injection,
+    # the watchdog, telemetry, or guards) become ConfigErrors (exit 2)
+    # instead — for CI and wrappers that must not silently lose a
+    # requested feature
+    strict: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -377,6 +421,13 @@ def _coerce(name: str, value: Any, default: Any) -> Any:
             raise ConfigError(
                 f"{name}: expected a path, on, or off, got {value!r}")
         return value
+    if name in ("device", "reconcile", "progress") \
+            and isinstance(default, str) and value is False:
+        # guard policy fields: YAML 1.1 parses a bare `off` as boolean
+        # False (same trap as strace_logging_mode / telemetry.sink).
+        # The default-type check keeps the boolean general.progress
+        # flag out of this mapping.
+        return "off"
     if name == "log_level":
         return LogLevel.parse(value)
     if name == "interface_qdisc":
@@ -484,6 +535,13 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
             cfg.telemetry = _fill_dataclass(TelemetryOptions, value, "telemetry")
         elif key == "faults":
             cfg.faults = _fill_dataclass(FaultsOptions, value, "faults")
+        elif key == "guards":
+            cfg.guards = _fill_dataclass(GuardsOptions, value, "guards")
+        elif key == "strict":
+            if not isinstance(value, bool):
+                raise ConfigError(
+                    f"strict: expected a boolean, got {value!r}")
+            cfg.strict = value
         elif key in ("host_defaults", "host_option_defaults"):
             cfg.host_defaults = _fill_dataclass(HostDefaultOptions, value, key)
         elif key == "hosts":
@@ -517,6 +575,14 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
         raise ConfigError("faults.device_retries must be >= 0")
     if cfg.faults.retry_backoff < 0:
         raise ConfigError("faults.retry_backoff must be >= 0")
+    for cls in ("device", "reconcile", "progress"):
+        policy = getattr(cfg.guards, cls)
+        if policy not in GUARD_POLICIES:
+            raise ConfigError(
+                f"guards.{cls}: expected one of "
+                f"{'|'.join(GUARD_POLICIES)}, got {policy!r}")
+    if cfg.guards.progress_rounds <= 0:
+        raise ConfigError("guards.progress_rounds must be positive")
     return cfg
 
 

@@ -135,6 +135,23 @@ class Manager:
         self._watchdog = None
         self._last_window_start = 0
         self.resume_from = None  # set by the CLI's --resume
+        # guard plane (docs/robustness.md): the ledger collects every
+        # violation and dispatches the per-class policy; the reconciler
+        # and progress detector attach below when their classes are
+        # active. Initialized before the flow-engine early return so
+        # every Manager has the attributes.
+        self._guard_ledger = None
+        self._guard_recon = None
+        self._progress = None
+        self._progress_packets = 0
+        if config.guards.enabled:
+            from ..guards.report import GuardLedger
+
+            self._guard_ledger = GuardLedger(policies={
+                "device": config.guards.device,
+                "reconcile": config.guards.reconcile,
+                "progress": config.guards.progress,
+            })
         self._ckpt_dir = config.faults.checkpoint.directory or (
             os.path.join(self.data_dir, "checkpoints")
             if self.data_dir else None)
@@ -148,11 +165,15 @@ class Manager:
             else:
                 self._next_ckpt_ns = config.faults.checkpoint.interval
         if config.experimental.use_flow_engine:
+            # unsupported feature combinations: log-and-ignore by
+            # default; `strict: true` promotes each to a ConfigError
+            # (exit 2) so CI and wrappers never silently lose a
+            # requested feature
             if config.faults.any_injection() or config.faults.watchdog:
                 # the flow engine has no hosts, processes, or round loop
                 # to inject against; a silently-ignored schedule would
                 # look like a broken feature
-                log.warning(
+                self._unsupported_combo(
                     "faults injection/watchdog are not supported with "
                     "experimental.use_flow_engine; only checkpoint/resume "
                     "applies to flow-engine runs")
@@ -160,10 +181,17 @@ class Manager:
                 # the flow engine never runs the round loop the
                 # harvester hooks; a silently-ignored opt-in would look
                 # like a broken feature
-                log.warning(
+                self._unsupported_combo(
                     "telemetry.enabled is not supported with "
                     "experimental.use_flow_engine; no heartbeats or "
                     "trace will be emitted for this run")
+            if config.guards.enabled:
+                # guards hook the round loop, the transport kernels,
+                # and the harvest boundary — none of which exist here
+                self._unsupported_combo(
+                    "guards.enabled is not supported with "
+                    "experimental.use_flow_engine; no invariants will "
+                    "be checked for this run")
             return
 
         # --- IP assignment + host seeds (config-declared order) -------------
@@ -291,6 +319,20 @@ class Manager:
             # before the crash path (faults/healing.py)
             self.transport.retry_attempts = config.faults.device_retries
             self.transport.retry_backoff_s = config.faults.retry_backoff / 1e9
+            # guard plane: thread the device invariant accumulator
+            # through every transport dispatch, and pair the device
+            # counters with the CPU ledger for reconciliation (mid-run
+            # pairs are only meaningful in sync mode — the mirrored
+            # device re-executes windows in lagged batches, so there
+            # reconciliation runs on the settled teardown snapshot)
+            if config.guards.active("device"):
+                self.transport.enable_guards()
+            if config.guards.active("reconcile"):
+                from ..guards.reconcile import TransportReconciler
+
+                self._guard_recon = TransportReconciler(
+                    self.transport, [h.name for h in self.hosts],
+                    mid_run=self.transport.mode == "sync")
 
         # --- fault plane (faults/schedule.py; docs/robustness.md) -----------
         # compiled HERE so a bad `faults:` block dies as a ConfigError
@@ -317,6 +359,14 @@ class Manager:
             log.info("fault plane: %d scheduled event(s), fingerprint %s",
                      len(self.fault_schedule.events),
                      self.fault_schedule.fingerprint()[:12])
+
+        # guard plane: the round-loop zero-progress detector (the
+        # virtual-time complement of the wall-clock watchdog)
+        if config.guards.active("progress"):
+            from ..guards.progress import ProgressDetector
+
+            self._progress = ProgressDetector(
+                config.guards.progress_rounds)
 
         # parallelism = min(cores, hosts) unless configured
         par = config.general.parallelism
@@ -392,6 +442,17 @@ class Manager:
             self.trackers = {}
             self._status_hook = None
 
+    def _unsupported_combo(self, message: str) -> None:
+        """Flow-engine unsupported-combo handling: warn by default,
+        ConfigError under top-level `strict: true` (exit 2) — the
+        feature the config asked for will NOT run, and strict callers
+        want that to be fatal, not a log line."""
+        if self.config.strict:
+            from .config import ConfigError
+
+            raise ConfigError(f"strict mode: {message}")
+        log.warning(message)
+
     # -- telemetry ------------------------------------------------------
 
     def _telemetry_sink_path(self) -> Optional[str]:
@@ -417,6 +478,18 @@ class Manager:
             return
         from ..telemetry import TelemetryHarvester
 
+        on_drain = None
+        if self._guard_recon is not None:
+            # cross-plane reconciliation rides the harvester's drain:
+            # the device snapshot for a tick materializes one interval
+            # later, and is compared against the CPU ledger copied at
+            # the SAME tick (guards/reconcile.py) — zero added syncs
+            def on_drain(time_ns, device_totals, cpu):
+                self._guard_ledger.apply(
+                    "reconcile",
+                    self._guard_recon.on_drain(time_ns, device_totals,
+                                               cpu))
+
         self.harvester = TelemetryHarvester(
             interval_ns=self.config.telemetry.interval,
             sink=self._telemetry_sink_path(),
@@ -426,6 +499,7 @@ class Manager:
             # export; with the trace off they'd be dead weight on a
             # long run (per-host records every interval)
             retain=bool(self._telemetry_trace_path()),
+            on_drain=on_drain,
         )
 
     def _telemetry_tick(self, now_ns: int) -> None:
@@ -440,6 +514,10 @@ class Manager:
             for t in self.trackers.values()
         } or None
         self.harvester.tick(now_ns, device=device, cpu=cpu)
+        if self._guard_recon is not None:
+            # pair the device snapshot just started with a same-instant
+            # CPU ledger copy; compared when the harvester drains it
+            self._guard_recon.note_tick(now_ns)
 
     def _finish_telemetry(self) -> None:
         if self.harvester is None:
@@ -854,6 +932,96 @@ class Manager:
                 blame.append(HostBlame(host_name, procs, pids, wpids))
         return blame
 
+    # -- guard plane (docs/robustness.md "Guard plane") ------------------
+
+    def _collect_host_waits(self):
+        """Who is waiting on what: every host holding alive processes,
+        with its next queued event (None = blocked purely on input).
+        Read-only over the process table, like the watchdog blame."""
+        from ..guards.progress import HostWait
+        from ..process.process import ProcessState
+
+        waits = []
+        for host_name in sorted(getattr(self, "_respawn_by_host", {})):
+            procs = []
+            for proc_name, _popt, cell, _spawn in \
+                    self._respawn_by_host[host_name]:
+                proc = cell.get("proc")
+                if proc is None:
+                    continue
+                alive = getattr(proc, "is_alive", None)
+                if alive is None:
+                    alive = proc.state == ProcessState.RUNNING
+                if alive:
+                    procs.append(proc_name)
+            if procs:
+                host = self.hosts_by_name[host_name]
+                waits.append(HostWait(host_name, procs,
+                                      host.next_event_time()))
+        return waits
+
+    def _observe_progress(self, window_start: int, active,
+                          events_before: int) -> None:
+        """One round's progress sample: host events executed + packets
+        moved. Everything observed is virtual-time/counter state — a
+        run that never stalls is bitwise-unaffected."""
+        events_after = sum(h.n_events_executed for h in active)
+        packets_now = int(self.routing.packet_counters.sum())
+        diagnosis = self._progress.observe(
+            window_start,
+            events_delta=events_after - events_before,
+            packets_delta=packets_now - self._progress_packets,
+        )
+        self._progress_packets = packets_now
+        if diagnosis is not None:
+            diagnosis.waiting = self._collect_host_waits()
+            diagnosis.device_in_flight = (
+                self.transport.in_flight if self.transport else 0)
+            self._guard_ledger.apply("progress",
+                                     [diagnosis.to_violation()])
+
+    def _final_guard_checks(self) -> None:
+        """Teardown self-verification on settled counters: the device
+        guard accumulator (transport kernels) and the full cross-plane
+        reconciliation including SimStats fleet totals. Blocking pulls
+        are fine here — the run is over."""
+        if self._guard_ledger is None:
+            return
+        from ..guards.report import GuardViolation
+
+        if self.transport is not None:
+            report = self.transport.guard_report()
+            if report is not None and not report["clean"]:
+                self._guard_ledger.apply("device", [GuardViolation(
+                    cls="device", check=",".join(report["classes"]),
+                    time_ns=self.config.general.stop_time,
+                    expected="clean device guard accumulator",
+                    actual=report["classes"],
+                    detail=f"first violation at guarded dispatch "
+                           f"{report['first_window']} of "
+                           f"{report['windows']}")])
+        if self._guard_recon is not None:
+            self._guard_ledger.apply(
+                "reconcile",
+                self._guard_recon.final(
+                    self.config.general.stop_time,
+                    packets_sent=self.stats.packets_sent))
+
+    def _write_guard_report(self) -> None:
+        if self._guard_ledger is None or not self.data_dir:
+            return
+        from ..guards.report import write_report
+
+        extra = {"clean": not self._guard_ledger.violations}
+        if self.transport is not None:
+            try:
+                extra["device_guard"] = self.transport.guard_report()
+            except Exception as e:  # teardown path: report, don't mask
+                log.warning("guards: device report unavailable: %s", e)
+        if self._progress is not None:
+            extra["progress_trips"] = self._progress.trips
+        write_report(self.data_dir, self._guard_ledger, extra=extra)
+
     def _run_round_guarded(self, start: int, active, end: int):
         """scheduler.run_round under the round watchdog: a wedged
         managed process becomes a WatchdogError with host blame instead
@@ -982,6 +1150,9 @@ class Manager:
                 # only hosts with an event in this window run; everyone
                 # else keeps their heap entry untouched
                 active = self._pop_active(end)
+                events_before = (
+                    sum(h.n_events_executed for h in active)
+                    if self._progress is not None else 0)
                 # sched_min matters in sync device mode: a packet captured
                 # this round lives on NEITHER a host queue nor the device
                 # yet (ingest happens at finish_round below) — only the
@@ -1003,6 +1174,8 @@ class Manager:
                 # (event pushes during the round) re-key alongside them
                 self._rekey_hosts(active)
                 self.stats.rounds += 1
+                if self._progress is not None:
+                    self._observe_progress(start, active, events_before)
                 min_next = self._min_host_event()
                 for t in (sched_min,
                           None if self.transport is None
@@ -1062,13 +1235,25 @@ class Manager:
             self.stats.wall_seconds = _walltime.monotonic() - wall_start
             for writer in self._pcap_writers:
                 writer.close()
+
+            # guard plane teardown pass: device guard accumulator +
+            # full cross-plane reconciliation against the settled
+            # SimStats totals. Runs LAST so an abort policy reports on
+            # a finished, fully-accounted run (the raise still takes
+            # the crash path below: emergency checkpoint + telemetry
+            # finalize = the postmortem bundle).
+            self._final_guard_checks()
             return self.stats
-        except BaseException:
+        except BaseException as e:
             # crash / watchdog path: drop the emergency checkpoint FIRST
             # — it documents exactly the run that is about to die — then
             # let the error propagate through the telemetry-preserving
-            # finally below
-            self._emergency_checkpoint()
+            # finally below. A plain `abort` guard policy opts out of
+            # the checkpoint; `abort+checkpoint` keeps it.
+            from ..guards.report import GuardError
+
+            if not isinstance(e, GuardError) or e.want_checkpoint:
+                self._emergency_checkpoint()
             raise
         finally:
             # crash path: preserve whatever telemetry is buffered — the
@@ -1079,6 +1264,10 @@ class Manager:
                     self.harvester.finalize()
                 except Exception as e:  # never mask the primary error
                     log.warning("telemetry flush failed at teardown: %s", e)
+            # every guarded run leaves guards-report.json behind — the
+            # violation report for aborts, a clean: true record
+            # otherwise. write_report never raises.
+            self._write_guard_report()
             # a data-dir-less run's per-host filesystem trees live in a
             # private temp root: the caller never asked for persistence
             tmp_root = getattr(self, "_tmp_data_root", None)
@@ -1098,6 +1287,13 @@ class Manager:
                 and packet_mod.status_trace_hook is self._status_hook
             ):
                 packet_mod.status_trace_hook = None
+
+    @property
+    def guard_violations(self) -> list:
+        """Every violation the guard plane recorded this run (empty
+        when guards are off or the run was clean)."""
+        return (list(self._guard_ledger.violations)
+                if self._guard_ledger is not None else [])
 
     def host_stats(self) -> dict:
         """Per-host tracker counters for sim-stats.json, plus perf-timer

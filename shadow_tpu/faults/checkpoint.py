@@ -301,6 +301,12 @@ def manager_snapshot(manager, now_ns: int, *, reason: str) -> dict:
             "harvests": manager.harvester.harvests,
             "emitted": manager.harvester.emitted,
         }
+    ledger = getattr(manager, "_guard_ledger", None)
+    if ledger is not None:
+        # the violation ledger rides every snapshot: an emergency
+        # checkpoint dropped by an abort guard policy carries the
+        # findings that killed the run (docs/robustness.md)
+        meta["guards"] = ledger.as_dict()
     arrays: dict[str, np.ndarray] = {}
     transport = getattr(manager, "transport", None)
     if transport is not None:

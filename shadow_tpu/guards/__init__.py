@@ -1,0 +1,57 @@
+"""The guard plane: runtime invariants, cross-plane reconciliation,
+and virtual-time progress detection (docs/robustness.md).
+
+The simulation checks itself against itself at runtime, in three legs:
+
+- `plane`     — `GuardState`, the on-device conservation/structure
+  checks threaded through `tpu/plane.window_step` / `ingest_rows` and
+  the `DeviceTransport` kernels as a static presence switch
+  (guards=None compiles out, bitwise-identical).
+- `reconcile` — per-host-id reconciliation of device counters against
+  independently-maintained CPU ledgers and `SimStats` fleet totals at
+  telemetry harvest boundaries and teardown.
+- `progress`  — the round-loop zero-progress livelock detector
+  (virtual-time complement of the fault plane's wall-clock watchdog).
+- `report`    — `GuardViolation` / `GuardError` / `GuardLedger`: one
+  structured shape for every finding, policy dispatch (warn / abort /
+  abort+checkpoint, CLI exit 5), and the guards-report.json artifact.
+"""
+
+from .plane import (GUARD_BIT_NAMES, GUARD_CLOCK,  # noqa: F401
+                    GUARD_EGRESS_FLOW, GUARD_INGEST_FLOW,
+                    GUARD_INGRESS_FLOW, GUARD_KEY_BUDGET,
+                    GUARD_RING_STRUCT, GUARD_RNG_MONOTONE, GuardState,
+                    decode_bits, make_guards, summarize)
+from .progress import (HostWait, ProgressDetector,  # noqa: F401
+                       StallDiagnosis)
+from .reconcile import (TRANSPORT_PAIRS, TransportReconciler,  # noqa: F401
+                        reconcile_fleet, reconcile_per_host)
+from .report import (POLICIES, GuardError, GuardLedger,  # noqa: F401
+                     GuardViolation, write_report)
+
+__all__ = [
+    "GUARD_BIT_NAMES",
+    "GUARD_CLOCK",
+    "GUARD_EGRESS_FLOW",
+    "GUARD_INGEST_FLOW",
+    "GUARD_INGRESS_FLOW",
+    "GUARD_KEY_BUDGET",
+    "GUARD_RING_STRUCT",
+    "GUARD_RNG_MONOTONE",
+    "GuardError",
+    "GuardLedger",
+    "GuardState",
+    "GuardViolation",
+    "HostWait",
+    "POLICIES",
+    "ProgressDetector",
+    "StallDiagnosis",
+    "TRANSPORT_PAIRS",
+    "TransportReconciler",
+    "decode_bits",
+    "make_guards",
+    "reconcile_fleet",
+    "reconcile_per_host",
+    "summarize",
+    "write_report",
+]

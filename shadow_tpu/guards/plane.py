@@ -1,0 +1,254 @@
+"""Device-side runtime invariants for the TPU plane (`GuardState`).
+
+The guard plane makes the simulation *self-verifying*: conservation
+laws and structural invariants of the SoA world are checked ON DEVICE,
+every window, with pure `jnp` compares over values the kernels already
+materialized — under the same discipline as the telemetry and fault
+switches (`telemetry/metrics.py`, `faults/plane.py`):
+
+1. **Static presence switch.** `window_step(..., guards=None)` compiles
+   every check out — the jaxpr is identical to the unguarded plane and
+   the results are bitwise-identical. Threading a `GuardState` never
+   touches simulation state either: guards only READ; the parity matrix
+   in tests/test_guards.py pins guards-on == guards-off bitwise.
+2. **No raising inside jit.** A violated invariant cannot raise from a
+   traced kernel (the check IS traced). Violations accumulate as
+   per-host int32 bitmasks plus the window index of the FIRST
+   violation; drivers pull the tiny pytree at a sync point they already
+   own (teardown, a harvest boundary, the chaos driver's end) and
+   decode it with `summarize`/`decode_bits`.
+3. **Dtype discipline.** int32 like everything on device; bitmask
+   compares and segment adds only — the profiler section
+   `window_step_guards` and the chaos-smoke CI gate hold the presence
+   switch to the same overhead budget as telemetry and faults.
+
+The checked invariants (docs/robustness.md "Guard plane"):
+
+- **egress conservation** (`GUARD_EGRESS_FLOW`): per host, packets
+  occupying the egress ring at window entry == packets that left this
+  window (token-gate sendable + fault purge) + packets still queued at
+  exit. A qdisc sort or compaction that loses or duplicates a slot
+  trips this.
+- **ingress conservation** (`GUARD_INGRESS_FLOW`): per host, ring
+  occupancy at entry + routed arrivals == overflow drops + AQM drops +
+  deliveries + relay-cached transitions + occupancy at exit. A scatter
+  that drops valid packets silently trips this.
+- **ring structure** (`GUARD_RING_STRUCT`): validity is front-packed
+  and invalid slots carry their I32_MAX sentinels — the invariant every
+  min-reduction and append in the plane relies on.
+- **packed-key bit budget** (`GUARD_KEY_BUDGET`): live sort keys
+  (priority, seq) stay non-negative, the domain the uint32 packed-key
+  sort diet is order-isomorphic over (tpu/plane.py `_pack_valid_key`).
+- **RNG monotonicity** (`GUARD_RNG_MONOTONE`): the per-host counter
+  stream advances by [0, CE] draws per window — the determinism
+  contract's bookkeeping.
+- **virtual clock** (`GUARD_CLOCK`, scalar): window rebases are
+  monotone (shift >= 0) and windows non-negative.
+- **ingest conservation** (`GUARD_INGEST_FLOW`): `ingest`/`ingest_rows`
+  appends exactly (incoming - overflow) entries per row.
+
+This module is dependency-light (jax/numpy only): `tpu/plane.py`
+imports it, never the other way around.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+I32_MAX = np.int32(2**31 - 1)
+
+# violation bits; per-host unless marked scalar
+GUARD_EGRESS_FLOW = 1 << 0
+GUARD_INGRESS_FLOW = 1 << 1
+GUARD_RING_STRUCT = 1 << 2
+GUARD_KEY_BUDGET = 1 << 3
+GUARD_RNG_MONOTONE = 1 << 4
+GUARD_CLOCK = 1 << 5  # scalar (flags leaf)
+GUARD_INGEST_FLOW = 1 << 6
+
+GUARD_BIT_NAMES = {
+    GUARD_EGRESS_FLOW: "egress-conservation",
+    GUARD_INGRESS_FLOW: "ingress-conservation",
+    GUARD_RING_STRUCT: "ring-structure",
+    GUARD_KEY_BUDGET: "packed-key-budget",
+    GUARD_RNG_MONOTONE: "rng-monotone",
+    GUARD_CLOCK: "virtual-clock",
+    GUARD_INGEST_FLOW: "ingest-conservation",
+}
+
+#: checks evaluated per guarded window (the `checks` accounting leaf)
+_CHECKS_PER_WINDOW = 6
+
+
+class GuardState(NamedTuple):
+    """Accumulating violation state; plain kernel arguments (never
+    static), so threading guards never recompiles between rounds."""
+
+    violations: jax.Array  # [N] int32 bitmask of GUARD_* bits
+    first_window: jax.Array  # [N] int32 window idx of first hit (I32_MAX)
+    flags: jax.Array  # scalar int32 bitmask (window-global checks)
+    windows: jax.Array  # scalar int32 — guarded windows so far
+    checks: jax.Array  # scalar int32 — individual checks evaluated
+
+
+def make_guards(n_hosts: int) -> GuardState:
+    """A clean guard accumulator for `n_hosts` hosts."""
+    return GuardState(
+        violations=jnp.zeros((n_hosts,), jnp.int32),
+        first_window=jnp.full((n_hosts,), I32_MAX, jnp.int32),
+        flags=jnp.zeros((), jnp.int32),
+        windows=jnp.zeros((), jnp.int32),
+        checks=jnp.zeros((), jnp.int32),
+    )
+
+
+def _record(guards: GuardState, bad_bits: jax.Array,
+            scalar_bits, n_checks: int) -> GuardState:
+    """Fold one window's per-host violation bits (and scalar bits) into
+    the accumulator; first_window pins the CURRENT window index for
+    hosts whose first bit lands now."""
+    hit_now = (guards.violations == 0) & (bad_bits != 0)
+    return GuardState(
+        violations=guards.violations | bad_bits,
+        first_window=jnp.where(hit_now, guards.windows,
+                               guards.first_window),
+        flags=guards.flags | scalar_bits,
+        windows=guards.windows + 1,
+        checks=guards.checks + jnp.int32(n_checks),
+    )
+
+
+def _front_packed(valid: jax.Array) -> jax.Array:
+    """Per row: True when an invalid slot precedes a valid one — the
+    front-pack invariant is broken."""
+    return (~valid[:, :-1] & valid[:, 1:]).any(axis=1)
+
+
+def _struct_bits(state) -> jax.Array:
+    """Per-host ring-structure violations: validity must be
+    front-packed and invalid slots must carry their I32_MAX
+    sentinels — the invariants every min-reduction and append in the
+    plane relies on."""
+    return (
+        _front_packed(state.eg_valid)
+        | _front_packed(state.in_valid)
+        | (~state.in_valid
+           & (state.in_deliver_rel != I32_MAX)).any(axis=1)
+        | (~state.eg_valid
+           & (state.eg_prio != I32_MAX)).any(axis=1)
+    )
+
+
+def _key_bits(state) -> jax.Array:
+    """Per-host packed-key bit-budget violations: live sort keys must
+    be non-negative (the uint32 fuse in plane._pack_valid_key is only
+    order-isomorphic over that domain)."""
+    return (state.eg_valid
+            & ((state.eg_prio < 0) | (state.eg_seq < 0))).any(axis=1)
+
+
+def check_window(guards: GuardState, *, state, eg_occ_in,
+                 eg_left_this_window, in_occ_in, arrivals, overflowed,
+                 delivered, qdisc_delta, cached_in, cached_out,
+                 new_state, rng_delta, egress_cap: int, shift_ns,
+                 window_ns) -> GuardState:
+    """Section 9 of `window_step` (compiled in only when a GuardState is
+    threaded): evaluate every window invariant over values the step
+    already materialized. Pure reads — nothing here feeds back into
+    simulation state.
+
+    `state`/`new_state` are the window's entry/exit states — structure
+    and key-budget invariants are checked on BOTH, so at-rest
+    corruption between windows (a bad restore, a driver bug, bitflips)
+    is caught at the next step even though the step's own sorts would
+    re-normalize it. `eg_left_this_window` [N] = packets that left the
+    egress ring (sendable + fault purge); `arrivals` [N] = routed
+    packets per destination; `cached_in/out` [N] int32 = relay-cached
+    occupancy before/after (zeros in direct mode); `rng_delta` [N] =
+    RNG counter advance this window."""
+    eg_occ_out = new_state.eg_valid.sum(axis=1, dtype=jnp.int32)
+    in_occ_out = new_state.in_valid.sum(axis=1, dtype=jnp.int32)
+
+    # conservation (all int32 modular; equality is exact while any
+    # per-host flow stays < 2^31 per window, amply true by capacity)
+    egress_bad = eg_occ_in - eg_left_this_window != eg_occ_out
+    ingress_bad = (in_occ_in + arrivals - overflowed - delivered
+                   - qdisc_delta + cached_in - cached_out) != in_occ_out
+
+    struct_bad = _struct_bits(state) | _struct_bits(new_state)
+    key_bad = _key_bits(state) | _key_bits(new_state)
+
+    rng_bad = (rng_delta < 0) | (rng_delta > jnp.int32(egress_cap))
+
+    bad = (
+        jnp.where(egress_bad, GUARD_EGRESS_FLOW, 0)
+        | jnp.where(ingress_bad, GUARD_INGRESS_FLOW, 0)
+        | jnp.where(struct_bad, GUARD_RING_STRUCT, 0)
+        | jnp.where(key_bad, GUARD_KEY_BUDGET, 0)
+        | jnp.where(rng_bad, GUARD_RNG_MONOTONE, 0)
+    ).astype(jnp.int32)
+
+    clock_bad = (jnp.int32(shift_ns) < 0) | (jnp.int32(window_ns) < 0)
+    scalar_bits = jnp.where(clock_bad, GUARD_CLOCK, 0).astype(jnp.int32)
+    return _record(guards, bad, scalar_bits, _CHECKS_PER_WINDOW)
+
+
+def check_ingest(guards: GuardState, *, occ_before, occ_after, incoming,
+                 overflow) -> GuardState:
+    """Append conservation for `ingest`/`ingest_rows`: each row must
+    gain exactly (incoming - overflow) entries. Does not advance the
+    window counter — ingest rides between windows, so a violation pins
+    the index of the window about to run."""
+    bad = jnp.where(
+        occ_after - occ_before != incoming - overflow,
+        GUARD_INGEST_FLOW, 0).astype(jnp.int32)
+    hit_now = (guards.violations == 0) & (bad != 0)
+    return guards._replace(
+        violations=guards.violations | bad,
+        first_window=jnp.where(hit_now, guards.windows,
+                               guards.first_window),
+        checks=guards.checks + 1,
+    )
+
+
+# -- host-side decode (outside jit; drivers pull the pytree first) ------
+
+
+def decode_bits(bits: int) -> list[str]:
+    """Names of the guard classes set in a violation bitmask."""
+    return [name for bit, name in sorted(GUARD_BIT_NAMES.items())
+            if bits & bit]
+
+
+def summarize(guards) -> dict:
+    """Host-side summary of a pulled GuardState: total violation count,
+    per-class host counts, and the first offenders. `guards` may be a
+    GuardState of device arrays or of numpy arrays."""
+    violations = np.asarray(jax.device_get(guards.violations))
+    first = np.asarray(jax.device_get(guards.first_window))
+    flags = int(jax.device_get(guards.flags))
+    bad_hosts = np.nonzero(violations)[0]
+    by_class: dict[str, int] = {}
+    for bit, name in sorted(GUARD_BIT_NAMES.items()):
+        n = int(((violations & bit) != 0).sum()) + (
+            1 if flags & bit else 0)
+        if n:
+            by_class[name] = n
+    offenders = [
+        {"host_index": int(h), "bits": decode_bits(int(violations[h])),
+         "first_window": int(first[h])}
+        for h in bad_hosts[:16]
+    ]
+    return {
+        "violating_hosts": int(bad_hosts.size),
+        "scalar_flags": decode_bits(flags),
+        "by_class": by_class,
+        "first_offenders": offenders,
+        "windows_checked": int(jax.device_get(guards.windows)),
+        "checks_evaluated": int(jax.device_get(guards.checks)),
+        "clean": bad_hosts.size == 0 and flags == 0,
+    }

@@ -1,0 +1,176 @@
+"""Cross-plane reconciliation: device counters vs CPU ledgers vs stats.
+
+Batched device execution hides single-counter corruption: a wrong
+per-host total on either plane would surface (if at all) as silently
+wrong stats. This module closes the loop by comparing, per host-id,
+counters that are maintained INDEPENDENTLY on the two planes but are
+equal by construction:
+
+- the `DeviceTransport` kernels count ingested packets per SOURCE host
+  (`n_out`), released packets per DESTINATION host (`n_released`), and
+  ring-overflow drops (`n_overflow`) on device;
+- the transport's CPU side mirrors the same events in plain numpy
+  int64 ledgers at capture / release time (`cpu_ledger`), and the
+  `SimStats` fleet totals count every routed packet a third way
+  (`routing.packet_counters`).
+
+Any disagreement is a real accounting bug — a lost scatter, a counter
+that wrapped wrong, a D2H corruption — and becomes a structured
+`GuardViolation` carrying the host blame and the offending counter
+pair.
+
+Timing discipline: device snapshots materialize asynchronously one
+harvest interval late (telemetry/harvest.py), so comparisons pair each
+device snapshot with the CPU ledger copied AT THE SAME TICK. In
+mirrored transport mode the device re-executes windows in batches and
+its counters lag by design — mid-run comparison would be pure noise —
+so reconciliation runs only on the settled teardown snapshot there
+(the Manager wires the mode in; docs/robustness.md).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from .report import GuardViolation
+
+#: (device counter name, CPU ledger name) identity pairs for the
+#: device-transport reconciliation
+TRANSPORT_PAIRS = (
+    ("pkts_out", "captured"),
+    ("pkts_in", "released"),
+)
+
+
+def reconcile_per_host(time_ns: int,
+                       device: Mapping[str, np.ndarray],
+                       cpu: Mapping[str, np.ndarray],
+                       pairs: Sequence[tuple[str, str]],
+                       host_names: Optional[Sequence[str]] = None,
+                       max_violations: int = 32) -> list[GuardViolation]:
+    """Compare per-host device totals against the CPU ledger for every
+    (device_field, cpu_field) pair present on both sides. Returns one
+    violation per (pair, host) mismatch, capped at `max_violations`
+    (the cap is recorded as a final fleet-level violation so truncation
+    is never silent)."""
+    out: list[GuardViolation] = []
+    truncated = 0
+    for dev_name, cpu_name in pairs:
+        if dev_name not in device or cpu_name not in cpu:
+            continue
+        dev = np.asarray(device[dev_name], np.int64)
+        led = np.asarray(cpu[cpu_name], np.int64)
+        n = min(dev.shape[0], led.shape[0])
+        bad = np.nonzero(dev[:n] != led[:n])[0]
+        for i in bad:
+            if len(out) >= max_violations:
+                truncated += 1
+                continue
+            name = (host_names[i] if host_names and i < len(host_names)
+                    else f"host{i + 1}")
+            out.append(GuardViolation(
+                cls="reconcile",
+                check=f"{dev_name}-vs-{cpu_name}",
+                time_ns=time_ns, host=name,
+                expected=int(led[i]), actual=int(dev[i]),
+                detail="device counter disagrees with the CPU ledger "
+                       "for this host-id",
+            ))
+    if truncated:
+        out.append(GuardViolation(
+            cls="reconcile", check="per-host-mismatch-overflow",
+            time_ns=time_ns,
+            detail=f"{truncated} further per-host mismatches truncated "
+                   f"from this report (cap {max_violations})",
+        ))
+    return out
+
+
+def reconcile_fleet(time_ns: int,
+                    checks: Sequence[tuple[str, int, int, str]],
+                    ) -> list[GuardViolation]:
+    """Fleet-total identities: `checks` is (name, expected, actual,
+    detail) tuples; every inequality becomes a violation."""
+    return [
+        GuardViolation(cls="reconcile", check=name, time_ns=time_ns,
+                       expected=int(expected), actual=int(actual),
+                       detail=detail)
+        for name, expected, actual, detail in checks
+        if int(expected) != int(actual)
+    ]
+
+
+class TransportReconciler:
+    """The Manager-side reconciliation hook for `use_tpu_transport`
+    runs. Snapshots the transport's CPU ledger at each telemetry tick
+    (same instant as the device copy the harvester starts), then
+    compares when the harvester's drain materializes that snapshot —
+    zero added device syncs. `final` comparisons additionally check the
+    fleet conservation identity and the SimStats totals."""
+
+    def __init__(self, transport, host_names: Sequence[str],
+                 *, mid_run: bool):
+        self._transport = transport
+        self._host_names = list(host_names)
+        # mirrored mode lags by design: compare only the settled
+        # teardown snapshot there
+        self._mid_run = mid_run
+        self._pending: dict[int, dict[str, np.ndarray]] = {}
+
+    def note_tick(self, time_ns: int) -> None:
+        """Called at harvest tick time, right after the harvester
+        started the async device copy: pair it with a same-instant
+        ledger snapshot."""
+        if self._mid_run:
+            self._pending[int(time_ns)] = self._transport.cpu_ledger()
+
+    def on_drain(self, time_ns: int, device_totals: dict,
+                 _cpu) -> list[GuardViolation]:
+        """Harvester drain callback: the device snapshot for `time_ns`
+        is now host-resident; reconcile it against the ledger snapshot
+        taken at the same tick."""
+        ledger = self._pending.pop(int(time_ns), None)
+        if ledger is None:
+            return []
+        return reconcile_per_host(
+            time_ns, device_totals, ledger, TRANSPORT_PAIRS,
+            self._host_names)
+
+    def final(self, time_ns: int, *, packets_sent: Optional[int] = None,
+              ) -> list[GuardViolation]:
+        """Teardown reconciliation on settled counters (a blocking pull
+        is fine here — the run is over). Valid in BOTH transport modes:
+        sync released everything it delivered, mirrored flushed every
+        record batch in `finalize`."""
+        import jax
+
+        device = {
+            name: np.asarray(jax.device_get(arr), np.int64)
+            for name, arr in self._transport.telemetry_arrays().items()
+        }
+        ledger = self._transport.cpu_ledger()
+        out = reconcile_per_host(time_ns, device, ledger,
+                                 TRANSPORT_PAIRS, self._host_names)
+        # fleet conservation: everything ingested is released, dropped
+        # to overflow, or still in flight on device
+        fleet = [(
+            "transport-conservation",
+            int(device["pkts_out"].sum()),
+            int(device["pkts_in"].sum())
+            + int(device["drop_ring_full"].sum())
+            + int(self._transport.device_in_flight()),
+            "sum(n_out) != sum(n_released) + sum(n_overflow) + in-flight",
+        )]
+        if packets_sent is not None:
+            # every routed packet was captured exactly once
+            # (worker.send_packet counts then captures)
+            fleet.append((
+                "packets_sent-vs-captured",
+                int(packets_sent),
+                int(ledger["captured"].sum()),
+                "SimStats.packets_sent != transport captures",
+            ))
+        out.extend(reconcile_fleet(time_ns, fleet))
+        return out

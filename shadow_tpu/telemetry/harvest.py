@@ -80,9 +80,19 @@ class TelemetryHarvester:
     def __init__(self, *, interval_ns: int, sink=None,
                  host_names: Optional[list[str]] = None,
                  slot_capacity: Optional[int] = None,
-                 per_host: bool = True, retain: bool = True):
+                 per_host: bool = True, retain: bool = True,
+                 on_drain=None):
+        """`on_drain(time_ns, device_totals, cpu)` is invoked at the end
+        of every drain, when the snapshot's asynchronous device copy has
+        materialized — the guard plane's cross-plane reconciliation hook
+        (guards/reconcile.py). `device_totals` maps counter name to the
+        unwrapped int64 totals (per-host arrays / scalars); `cpu` is the
+        tick-time CPU counter snapshot. The callback may raise (an abort
+        guard policy): the pending snapshot was already consumed, so a
+        later finalize() still flushes cleanly."""
         if interval_ns <= 0:
             raise ValueError("telemetry interval must be positive")
+        self._on_drain = on_drain
         self.interval_ns = int(interval_ns)
         self._next_due = int(interval_ns)
         self._per_host = per_host
@@ -149,6 +159,8 @@ class TelemetryHarvester:
             device_now[name] = self._totals[name]
         self.harvests += 1
         self._emit(time_ns, device_now, cpu)
+        if self._on_drain is not None:
+            self._on_drain(time_ns, device_now, cpu)
 
     def finalize(self) -> None:
         """Drain the pending snapshot and flush/close the sink.

@@ -178,7 +178,13 @@ def egress_order_gate(valid, prio, nbytes, tsend, clamp, balance, shift_ns):
     valid_s, sendable, spent) — the sorted byte/time columns plus the
     permutation to apply to the remaining payload columns, bitwise equal
     to the XLA diet path's `_egress_order` + `_token_gate` outputs for
-    FIFO rows."""
+    FIFO rows.
+
+    The fusion covers the FIFO qdisc stage ONLY: neither the fault gate
+    (`faults=`) nor the guard plane (`guards=`) is part of the fused
+    pipeline, and `window_step` refuses both combinations at trace time
+    — the self-healing `KernelFallback` (faults/healing.py) demotes
+    such drivers to the bitwise-identical XLA path automatically."""
     if (valid.shape[1] & (valid.shape[1] - 1)) != 0:
         raise ValueError(
             f"plane_kernel='pallas' needs a power-of-two egress capacity, "

@@ -37,6 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..faults.plane import FaultArrays
+from ..guards.plane import GuardState
+from ..guards import plane as guards_plane
 from ..telemetry.metrics import PlaneMetrics
 from . import codel
 
@@ -353,7 +355,8 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
            send_rel: jax.Array | None = None,
            clamp_rel: jax.Array | None = None,
            sock: jax.Array | None = None, *,
-           metrics: PlaneMetrics | None = None):
+           metrics: PlaneMetrics | None = None,
+           guards: GuardState | None = None):
     """Append a batch of outbound packets ([B] arrays; src = emitting host
     index) to the egress queues. Slots are allocated after the current valid
     entries per row; overflow beyond capacity is counted and dropped.
@@ -367,6 +370,12 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
     (state', metrics') instead of state' — the simulation state itself is
     bitwise-unchanged (the drop delta is read off the state's own
     n_overflow_dropped counter).
+
+    `guards` (static presence, docs/robustness.md) threads the runtime
+    invariant checks: append conservation (each row gains exactly
+    incoming - overflow entries) accumulates into the violation bitmask
+    and guards' is appended to the return. Pure reads — the simulation
+    state is untouched.
 
     The CPU syscall plane calls this once per round with everything the
     sockets emitted (double-buffered host arrays in the full system)."""
@@ -413,10 +422,24 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
         eg_sock=eg_sock, eg_valid=eg_valid,
         n_overflow_dropped=state.n_overflow_dropped + overflow,
     )
+    if guards is not None:
+        # incoming per row: live batch slots routed to in-range rows
+        # (dead slots went to src N and fall off the segment sum)
+        incoming = jax.ops.segment_sum(
+            (src_s < N).astype(jnp.int32),
+            jnp.clip(src_s, 0, N - 1), num_segments=N)
+        guards = guards_plane.check_ingest(
+            guards,
+            occ_before=n_valid,
+            occ_after=eg_valid.sum(axis=1, dtype=jnp.int32),
+            incoming=incoming, overflow=overflow)
+    out = (new_state,)
     if metrics is not None:
-        return new_state, metrics._replace(
-            drop_ring_full=metrics.drop_ring_full + overflow)
-    return new_state
+        out += (metrics._replace(
+            drop_ring_full=metrics.drop_ring_full + overflow),)
+    if guards is not None:
+        out += (guards,)
+    return out if len(out) > 1 else new_state
 
 
 def chain_windows(state: NetPlaneState, params: NetPlaneParams,
@@ -509,7 +532,8 @@ def ingest_rows(state: NetPlaneState, dst: jax.Array, nbytes: jax.Array,
                 sock: jax.Array | None = None, *,
                 packed_sort: bool = True,
                 gate_idle: bool = True,
-                metrics: PlaneMetrics | None = None):
+                metrics: PlaneMetrics | None = None,
+                guards: GuardState | None = None):
     """Append per-host batches ([N, K] arrays, row = emitting host) to the
     egress queues. The row-shaped twin of `ingest` for producers that are
     already host-major (on-device respawn loops, per-host socket emitters):
@@ -528,7 +552,11 @@ def ingest_rows(state: NetPlaneState, dst: jax.Array, nbytes: jax.Array,
     `metrics` (static presence) accumulates ring-overflow drops into
     `drop_ring_full` and switches the return to (state', metrics'); the
     drop delta is read off the state's own n_overflow_dropped counter, so
-    the merge itself — and the simulation state — is untouched."""
+    the merge itself — and the simulation state — is untouched.
+
+    `guards` (static presence, docs/robustness.md) appends append-
+    conservation checking to the return, exactly like `ingest`: each
+    row must gain (incoming valid - overflow) entries. Pure reads."""
     N, CE = state.eg_dst.shape
     if send_rel is None:
         send_rel = jnp.zeros_like(seq)
@@ -594,13 +622,23 @@ def ingest_rows(state: NetPlaneState, dst: jax.Array, nbytes: jax.Array,
         new_state = merge(state)
     else:
         new_state = jax.lax.cond(valid.any(), merge, lambda st: st, state)
+    overflow_delta = new_state.n_overflow_dropped - state.n_overflow_dropped
+    if guards is not None:
+        guards = guards_plane.check_ingest(
+            guards,
+            occ_before=state.eg_valid.sum(axis=1, dtype=jnp.int32),
+            occ_after=new_state.eg_valid.sum(axis=1, dtype=jnp.int32),
+            incoming=valid.sum(axis=1, dtype=jnp.int32),
+            overflow=overflow_delta)
+    out = (new_state,)
     if metrics is not None:
         # overflow delta via the state counter: identical through both
         # gate branches (the idle branch's delta is zero by construction)
-        return new_state, metrics._replace(
-            drop_ring_full=metrics.drop_ring_full
-            + (new_state.n_overflow_dropped - state.n_overflow_dropped))
-    return new_state
+        out += (metrics._replace(
+            drop_ring_full=metrics.drop_ring_full + overflow_delta),)
+    if guards is not None:
+        out += (guards,)
+    return out if len(out) > 1 else new_state
 
 
 # ---------------------------------------------------------------------------
@@ -983,7 +1021,8 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
                 no_loss: bool = False, packed_sort: bool = True,
                 kernel: str = "xla",
                 faults: FaultArrays | None = None,
-                metrics: PlaneMetrics | None = None):
+                metrics: PlaneMetrics | None = None,
+                guards: GuardState | None = None):
     """Advance one scheduling round [t, t + window_ns).
 
     `rr_enabled` is a static (trace-time) switch: False compiles the
@@ -1037,14 +1076,25 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     (`neutral_faults`) are bitwise-identity too (tests/test_faults.py).
     XLA kernel only (the pallas egress fusion predates the fault gate).
 
+    `guards` (static presence switch, docs/robustness.md) threads the
+    runtime invariant plane (`guards/plane.GuardState`): conservation
+    laws, ring structure, packed-key bit budget, RNG monotonicity, and
+    the virtual-clock check accumulate per-host violation bitmasks with
+    pure jnp compares over values the step already materialized —
+    nothing raises inside jit, nothing feeds back into simulation
+    state, and guards=None compiles the section out entirely (bitwise-
+    identical; pinned by tests/test_guards.py). XLA kernel only, like
+    faults.
+
     `shift_ns` = this window's start minus the previous window's start;
     stored relative times are rebased by it. Returns
-    (state', delivered, next_event_rel) — plus metrics' as a fourth
-    element when a metrics pytree was passed — where `delivered` is a
-    dict of [N, CI] arrays masked by delivered['mask'] (packets that
-    arrived within this window, in deterministic (deliver_t, src, seq)
-    order per host) and `next_event_rel` is the min pending delivery
-    time relative to the new window start (INT32_MAX when idle).
+    (state', delivered, next_event_rel) — plus metrics' and/or guards'
+    appended in that order when the respective pytrees were passed —
+    where `delivered` is a dict of [N, CI] arrays masked by
+    delivered['mask'] (packets that arrived within this window, in
+    deterministic (deliver_t, src, seq) order per host) and
+    `next_event_rel` is the min pending delivery time relative to the
+    new window start (INT32_MAX when idle).
     """
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown plane kernel {kernel!r}: "
@@ -1057,6 +1107,12 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
         raise ValueError(
             "plane_kernel='pallas' does not fuse the fault plane; compile "
             "with kernel='xla' when a FaultArrays pytree is threaded (the "
+            "self-healing kernel fallback in faults/healing.py does this "
+            "automatically)")
+    if kernel == "pallas" and guards is not None:
+        raise ValueError(
+            "plane_kernel='pallas' does not fuse the guard plane; compile "
+            "with kernel='xla' when a GuardState pytree is threaded (the "
             "self-healing kernel fallback in faults/healing.py does this "
             "automatically)")
     N, CE = state.eg_dst.shape
@@ -1257,6 +1313,7 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
         **({"n_fault_dropped": state.n_fault_dropped + fault_drops}
            if faults is not None else {}),
     )
+    out = (new_state, delivered, next_event)
     if metrics is not None:
         # --- 8. telemetry accumulation (static; compiled out when off) --
         metrics = _accumulate_metrics(
@@ -1264,5 +1321,35 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
             in_valid_m, rt_out.dropped - state.router.dropped,
             fault_drops if faults is not None
             else jnp.zeros((N,), jnp.int32), eg_bytes)
-        return new_state, delivered, next_event, metrics
-    return new_state, delivered, next_event
+        out += (metrics,)
+    if guards is not None:
+        # --- 9. guard plane (static; compiled out when off) -------------
+        # pure reads over values the step already materialized; nothing
+        # here can perturb the simulation stream (docs/determinism.md)
+        arrivals = jnp.zeros((N,), jnp.int32).at[
+            jnp.clip(eg_dst, 0, N - 1).reshape(-1)].add(
+            sent.reshape(-1), mode="drop")
+        eg_left = sendable.sum(axis=1, dtype=jnp.int32)
+        if faults is not None:
+            eg_left = eg_left + fault_purged.sum(axis=1, dtype=jnp.int32)
+        cached_zero = jnp.zeros((N,), jnp.int32)
+        guards = guards_plane.check_window(
+            guards,
+            state=state,
+            eg_occ_in=state.eg_valid.sum(axis=1, dtype=jnp.int32),
+            eg_left_this_window=eg_left,
+            in_occ_in=state.in_valid.sum(axis=1, dtype=jnp.int32),
+            arrivals=arrivals,
+            overflowed=overflowed,
+            delivered=due.sum(axis=1, dtype=jnp.int32),
+            qdisc_delta=(rt_out.dropped - state.router.dropped
+                         if router_aqm else cached_zero),
+            cached_in=(state.router.has_cached.astype(jnp.int32)
+                       if router_aqm else cached_zero),
+            cached_out=(rt_out.has_cached.astype(jnp.int32)
+                        if router_aqm else cached_zero),
+            new_state=new_state,
+            rng_delta=rng_counter - state.rng_counter,
+            egress_cap=CE, shift_ns=shift_ns, window_ns=window_ns)
+        out += (guards,)
+    return out

@@ -36,6 +36,11 @@ Sections (superset of the window step's numbered stages):
   masks threaded (docs/robustness.md). The CI chaos-smoke job gates on
   its ratio against ``window_step`` the same way (local bar: 5%): the
   fault plane's presence switch must stay cheap when nothing fails.
+- ``window_step_guards`` — the full step with a clean GuardState
+  threaded (the runtime invariant plane, docs/robustness.md). Gated in
+  CI chaos-smoke against ``window_step`` like telemetry and faults:
+  self-verification may never cost the hot path more than the presence
+  switches before it.
 
 Drive it from the CLI: ``python tools/profile_plane.py --hosts 1024,32768``.
 """
@@ -54,7 +59,7 @@ DEFAULT_SECTIONS = (
     "rebase_refill", "rr_tensors", "qdisc_sort", "token_gate",
     "loss_latency", "ingress_compact", "routing_scatter", "release_due",
     "codel_drain", "egress_compact", "ingest_rows", "window_step",
-    "window_step_telemetry", "window_step_faults",
+    "window_step_telemetry", "window_step_faults", "window_step_guards",
 )
 
 
@@ -173,6 +178,7 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
                         window_step)
 
     from ..faults.plane import neutral_faults as _neutral_faults
+    from ..guards.plane import make_guards as _clean_guards
     from ..telemetry import make_metrics as _zero_metrics
 
     wanted = tuple(sections) if sections is not None else DEFAULT_SECTIONS
@@ -298,6 +304,12 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
                 st, params, rng_root, sh, window, rr_enabled=rr_enabled,
                 packed_sort=packed_sort, kernel="xla", faults=f)),
             (state, _neutral_faults(n_hosts, n_nodes), shift)),
+        "window_step_guards": (
+            # guards, like faults, refuse the pallas fusion: pin xla
+            jax.jit(lambda st, g, sh: window_step(
+                st, params, rng_root, sh, window, rr_enabled=rr_enabled,
+                packed_sort=packed_sort, kernel="xla", guards=g)),
+            (state, _clean_guards(n_hosts), shift)),
     }
 
     out_sections = {}

@@ -129,6 +129,31 @@ class TransportState(NamedTuple):
     n_released: "jax.Array"  # int32 [N] packets released per DEST host
 
 
+class TransportGuard(NamedTuple):
+    """Scalar device-side invariant accumulator for the transport
+    kernels (guard plane, docs/robustness.md). Threaded as a static
+    presence switch by `_build_kernels(guards=True)`: each window step
+    re-checks the transport conservation law — everything ingested is
+    released, overflow-dropped, or still occupying a slot — plus the
+    idle-slot sentinel structure and clock monotonicity, with pure jnp
+    compares. Tiny (3 scalars), pulled only at teardown."""
+
+    violations: "jax.Array"  # scalar int32 bitmask (guards.plane bits)
+    first_window: "jax.Array"  # scalar int32 guarded-dispatch index of
+    # the first violation (I32_MAX = clean)
+    windows: "jax.Array"  # scalar int32 — guarded dispatches checked
+
+
+def make_transport_guard():
+    import jax.numpy as jnp
+
+    return TransportGuard(
+        violations=jnp.zeros((), jnp.int32),
+        first_window=jnp.full((), I32_MAX, jnp.int32),
+        windows=jnp.zeros((), jnp.int32),
+    )
+
+
 class DeviceTransport:
     def __init__(self, hosts, routing, ip_to_node_id, *,
                  egress_cap: int = 256, ingress_cap: int = 256,
@@ -176,6 +201,25 @@ class DeviceTransport:
         self._ingress_cap = CI
         self._compact_cap = compact_cap
         self._n = n
+        # guard plane (docs/robustness.md): enable_guards() threads a
+        # TransportGuard scalar pytree through every kernel dispatch
+        # (static presence switch — disabled compiles the checks out)
+        self._guards_enabled = False
+        self._guard = None
+        # CPU-side ledgers for cross-plane reconciliation
+        # (guards/reconcile.py): the same capture/release events the
+        # device kernels count, mirrored independently in numpy. The
+        # capture-side increment runs on ANY worker thread (like the
+        # shared packet counters), so it takes this lock; the release
+        # side only moves at round barriers (single-threaded).
+        import threading
+
+        self._led_lock = threading.Lock()
+        self._led_captured = np.zeros(n, np.int64)
+        self._led_released = np.zeros(n, np.int64)
+        # optional device-TCP retransmit source for the telemetry
+        # harvest (attach_tcp_source; docs/observability.md)
+        self._tcp_source = None
         self._build_kernels(n, CI, compact_cap)
 
         if mode == "auto":
@@ -207,7 +251,9 @@ class DeviceTransport:
         # mirrored-mode verification state: the CPU ledger heap, the
         # host-side per-round record batch, and a DEVICE-resident
         # divergence counter (pulled only at finalize)
-        self._expect_heap: list[tuple[int, int]] = []  # (deliver_abs, tag)
+        # (deliver_abs, tag, dst_idx) — tag is unique per live entry,
+        # so dst never enters the heap comparison
+        self._expect_heap: list[tuple[int, int, int]] = []
         self._div = jnp.int32(0)
         self._k = 32  # windows per batched dispatch
         self._records: list[tuple] = []  # (start, end, expected, ingest)
@@ -226,6 +272,38 @@ class DeviceTransport:
 
         latency = self._latency
         host_node = self._host_node
+
+        def guard_update(g, st: TransportState, shift, window):
+            """Guard plane (static presence: g=None compiles this out).
+            Re-checks the transport conservation law — sum(ingested) ==
+            sum(released) + sum(overflow-dropped) + slots occupied — the
+            idle-slot deliver sentinel, and clock monotonicity; pure jnp
+            compares, accumulated as a scalar bitmask (nothing raises
+            inside jit; drivers pull the 3 scalars at teardown)."""
+            if g is None:
+                return None
+            from ..guards import plane as gp
+
+            occupancy = st.in_valid.sum(dtype=jnp.int32)
+            conserved = (st.n_out.sum() - st.n_released.sum()
+                         - st.n_overflow.sum()) == occupancy
+            # a LIVE slot carrying the idle sentinel would never
+            # release: a silent livelock. (Released slots legitimately
+            # keep their stale deliver value — slots are sparse, not
+            # compacted — so the inverse check would misfire.)
+            struct_ok = (st.in_valid
+                         & (st.in_deliver == I32_MAX)).sum() == 0
+            clock_ok = (jnp.int32(shift) >= 0) & (jnp.int32(window) >= 0)
+            bad = (jnp.where(conserved, 0, gp.GUARD_INGRESS_FLOW)
+                   | jnp.where(struct_ok, 0, gp.GUARD_RING_STRUCT)
+                   | jnp.where(clock_ok, 0, gp.GUARD_CLOCK)
+                   ).astype(jnp.int32)
+            hit = (g.violations == 0) & (bad != 0)
+            return TransportGuard(
+                violations=g.violations | bad,
+                first_window=jnp.where(hit, g.windows, g.first_window),
+                windows=g.windows + 1,
+            )
 
         def ingest(st: TransportState, src, dst, seq, tag, send_rel,
                    clamp_rel, valid):
@@ -299,21 +377,22 @@ class DeviceTransport:
             fp2 = jnp.where(due, h2, jnp.uint32(0)).sum(dtype=jnp.uint32)
             return fp1, fp2, due.sum(dtype=jnp.int32)
 
-        def step_compact(st, shift, window):
+        def step_compact(st, g, shift, window):
             """Sync mode: one window + the released set front-packed into
             [cap] columns for one small D2H transfer (count first; the
             caller raises if count exceeds the compact cap — deliveries
             cannot be dropped, unlike a diagnostic pull)."""
             st, due, deliver, next_rel = step(st, shift, window)
+            g = guard_update(g, st, shift, window)
             flat = due.reshape(-1)
             idx = jnp.argsort(~flat, stable=True)[:cap]
             take = lambda a: a.reshape(-1)[idx]
             dst = jnp.where(take(due), (idx // CI).astype(jnp.int32), -1)
             comp = (due.sum(dtype=jnp.int32), dst, take(st.in_src),
                     take(st.in_seq), take(st.in_tag), take(deliver))
-            return st, comp, next_rel, st.n_overflow.sum()
+            return st, g, comp, next_rel, st.n_overflow.sum()
 
-        def chain(st, shift0, window0, runahead, horizon, stop):
+        def chain(st, g, shift0, window0, runahead, horizon, stop):
             """Sync mode: advance through delivery-free windows on device —
             the boundary rule of `plane.chain_windows` (itself the
             controller's `controller.rs:87-113` chain): the first window
@@ -323,32 +402,35 @@ class DeviceTransport:
             window opens at that next event with width
             min(runahead, stop - start)."""
             st, due, deliver, next_rel = step(st, shift0, window0)
+            g = guard_update(g, st, shift0, window0)
             hs = jnp.minimum(horizon, stop)
 
             def cond(c):
-                st, due, deliver, off, next_rel, n = c
+                st, g, due, deliver, off, next_rel, n = c
                 return (~due.any()) & (next_rel < hs - off) \
                     & (n < jnp.int32(64))
 
             def body(c):
-                st, due, deliver, off, next_rel, n = c
+                st, g, due, deliver, off, next_rel, n = c
                 off2 = off + next_rel
                 width = jnp.minimum(runahead, stop - off2)
                 st, due, deliver, next2 = step(st, next_rel, width)
-                return (st, due, deliver, off2, next2, n + 1)
+                g = guard_update(g, st, next_rel, width)
+                return (st, g, due, deliver, off2, next2, n + 1)
 
-            st, due, deliver, off, next_rel, _n = jax.lax.while_loop(
+            st, g, due, deliver, off, next_rel, _n = jax.lax.while_loop(
                 cond, body,
-                (st, due, deliver, jnp.int32(0), next_rel, jnp.int32(1)))
+                (st, g, due, deliver, jnp.int32(0), next_rel,
+                 jnp.int32(1)))
             flat = due.reshape(-1)
             idx = jnp.argsort(~flat, stable=True)[:cap]
             take = lambda a: a.reshape(-1)[idx]
             dst = jnp.where(take(due), (idx // CI).astype(jnp.int32), -1)
             comp = (due.sum(dtype=jnp.int32), dst, take(st.in_src),
                     take(st.in_seq), take(st.in_tag), take(deliver))
-            return st, comp, off, next_rel, st.n_overflow.sum()
+            return st, g, comp, off, next_rel, st.n_overflow.sum()
 
-        def batch_verify(st, shifts, widths, ing, exp_fp, exp_fp2,
+        def batch_verify(st, g, shifts, widths, ing, exp_fp, exp_fp2,
                          exp_n, div):
             """Mirrored mode: K windows per dispatch. Scan body = window
             step -> released-set fingerprint vs the CPU ledger -> ingest
@@ -356,7 +438,7 @@ class DeviceTransport:
             sync mode)."""
 
             def body(carry, xs):
-                st, div = carry
+                st, g, div = carry
                 shift, width, row, efp, efp2, en = xs
                 st, due, deliver, _next = step(st, shift, width)
                 fp1, fp2, cnt = fingerprint(st, due, deliver)
@@ -364,12 +446,24 @@ class DeviceTransport:
                 st = ingest(st, row["src"], row["dst"], row["seq"],
                             row["tag"], row["send"], row["clamp"],
                             row["valid"])
-                return (st, jnp.where(ok, div, div + 1)), None
+                g = guard_update(g, st, shift, width)
+                return (st, g, jnp.where(ok, div, div + 1)), None
 
-            (st, div), _ = jax.lax.scan(
-                body, (st, div),
+            (st, g, div), _ = jax.lax.scan(
+                body, (st, g, div),
                 (shifts, widths, ing, exp_fp, exp_fp2, exp_n))
-            return st, div
+            return st, g, div
+
+        def ingest_guarded(st, g, src, dst, seq, tag, send_rel,
+                           clamp_rel, valid):
+            """The standalone ingest dispatch, with the guard check run
+            over the post-ingest state (the conservation identity holds
+            at every kernel boundary, so an ingest that loses or
+            double-places a packet trips here, one dispatch early)."""
+            st = ingest(st, src, dst, seq, tag, send_rel, clamp_rel,
+                        valid)
+            # ingest rides between windows: a neutral (0, 0) clock
+            return st, guard_update(g, st, 0, 0)
 
         # every dispatch donates the TransportState pytree: XLA writes the
         # next window's slot arrays into the incoming buffers instead of
@@ -379,7 +473,8 @@ class DeviceTransport:
         # CPU test backend donating_jit compiles without donation.
         from . import donating_jit
 
-        self._k_ingest = self._retrying(donating_jit(ingest), "ingest")
+        self._k_ingest = self._retrying(donating_jit(ingest_guarded),
+                                        "ingest")
         self._k_step = self._retrying(donating_jit(step_compact), "step")
         self._k_chain = self._retrying(donating_jit(chain), "chain")
         self._k_batch_verify = self._retrying(
@@ -407,6 +502,50 @@ class DeviceTransport:
 
         return call
 
+    # -- guard plane (docs/robustness.md) --------------------------------
+
+    def enable_guards(self) -> None:
+        """Thread a `TransportGuard` scalar pytree through every kernel
+        dispatch from now on. Static presence switch: with guards never
+        enabled the checks never compile (the kernels trace with a None
+        pytree); enabling costs three scalar compares per dispatch."""
+        if self._guard is None:
+            self._guard = make_transport_guard()
+
+    def guard_report(self) -> Optional[dict]:
+        """Pull and decode the device guard accumulator (one tiny
+        blocking transfer — call at teardown / harvest boundaries the
+        caller already owns, never on the hot path). None when guards
+        were never enabled."""
+        if self._guard is None:
+            return None
+        from ..guards import plane as gp
+
+        g = self._jax.device_get(self._guard)
+        bits = int(g.violations)
+        return {
+            "clean": bits == 0,
+            "classes": gp.decode_bits(bits),
+            "first_window": int(g.first_window),
+            "windows": int(g.windows),
+        }
+
+    def cpu_ledger(self) -> dict[str, np.ndarray]:
+        """The CPU-plane reconciliation ledger: per-host capture /
+        release counts maintained independently of (and compared
+        against) the device kernels' n_out / n_released
+        (guards/reconcile.py). Returns copies."""
+        return {
+            "captured": self._led_captured.copy(),
+            "released": self._led_released.copy(),
+        }
+
+    def device_in_flight(self) -> int:
+        """Slots currently occupied on device (one blocking scalar
+        pull; teardown reconciliation only)."""
+        return int(self._jax.device_get(
+            self.state.in_valid.sum(dtype=self._jnp.int32)))
+
     def apply_fault_latency(self, lat_mult: np.ndarray) -> None:
         """Mirror a link_degrade/link_restore event onto the device:
         rebuild the latency table as base * mult (node-index space) and
@@ -433,6 +572,14 @@ class DeviceTransport:
                 round_end_ns: int, deliver_ns: int) -> None:
         src_idx = src_host.host_id - 1
         dst_idx = dst_host.host_id - 1
+        # cross-plane reconciliation ledger (guards/reconcile.py): the
+        # CPU side counts the same event the device ingest kernel will
+        # count into n_out — independently, in plain numpy. Locked: a
+        # numpy element read-modify-write is not atomic, and this runs
+        # on any worker thread — a lost count would make the guard
+        # plane flag a healthy run.
+        with self._led_lock:
+            self._led_captured[src_idx] += 1
         if self._free:
             tag = self._free.pop()
         else:
@@ -440,7 +587,7 @@ class DeviceTransport:
             self._pool.append(None)
         if self.mirrored:
             self._pool[tag] = True  # ledger entry lives in the heap
-            heapq.heappush(self._expect_heap, (deliver_ns, tag))
+            heapq.heappush(self._expect_heap, (deliver_ns, tag, dst_idx))
         else:
             self._pool[tag] = packet
         self._pending.append(
@@ -489,8 +636,8 @@ class DeviceTransport:
         arr[0, b:] = self._n  # pad slots: out-of-range src
         arr[4, b:] = base_ns
         arr[5, b:] = base_ns
-        self.state = self._k_ingest(
-            self.state,
+        self.state, self._guard = self._k_ingest(
+            self.state, self._guard,
             jnp.asarray(arr[0], jnp.int32), jnp.asarray(arr[1], jnp.int32),
             jnp.asarray(arr[2], jnp.int32), jnp.asarray(arr[3], jnp.int32),
             jnp.asarray(arr[4] - base_ns, jnp.int32),
@@ -546,16 +693,20 @@ class DeviceTransport:
             horizon_rel = min((horizon_ns if horizon_ns is not None
                                else stop_ns) - start_ns, clamp)
             stop_rel = min(stop_ns - start_ns, clamp)
-            self.state, comp, off, next_rel, overflow = self._k_chain(
-                self.state, jnp.int32(shift), jnp.int32(end_ns - start_ns),
-                jnp.int32(runahead_ns), jnp.int32(horizon_rel),
-                jnp.int32(stop_rel),
-            )
+            self.state, self._guard, comp, off, next_rel, overflow = \
+                self._k_chain(
+                    self.state, self._guard, jnp.int32(shift),
+                    jnp.int32(end_ns - start_ns),
+                    jnp.int32(runahead_ns), jnp.int32(horizon_rel),
+                    jnp.int32(stop_rel),
+                )
             base_ns = start_ns + int(off)
         else:
-            self.state, comp, next_rel, overflow = self._k_step(
-                self.state, jnp.int32(shift), jnp.int32(end_ns - start_ns),
-            )
+            self.state, self._guard, comp, next_rel, overflow = \
+                self._k_step(
+                    self.state, self._guard, jnp.int32(shift),
+                    jnp.int32(end_ns - start_ns),
+                )
             base_ns = start_ns
         self._prev_start = base_ns
 
@@ -575,6 +726,9 @@ class DeviceTransport:
         # deliveries are relative to the LAST window's start (base_ns =
         # start_ns when no chaining happened)
         if n:
+            # the release twin of the capture ledger: one count per
+            # device-released packet, by destination host-id
+            np.add.at(self._led_released, dst, 1)
             hosts = self.hosts
             pool = self._pool
             free = self._free
@@ -594,10 +748,10 @@ class DeviceTransport:
 
     # -- mirrored mode ---------------------------------------------------
 
-    def _pop_expected(self, end_ns: int) -> list[tuple[int, int]]:
+    def _pop_expected(self, end_ns: int) -> list[tuple[int, int, int]]:
         """The CPU ledger for this window: every capture due before
-        end_ns, as (deliver_abs, tag) pairs. Split out so tests can
-        intercept and poison it."""
+        end_ns, as (deliver_abs, tag, dst_idx) triples. Split out so
+        tests can intercept and poison it."""
         out = []
         heap = self._expect_heap
         while heap and heap[0][0] < end_ns:
@@ -660,7 +814,10 @@ class DeviceTransport:
             widths[i] = end - start
             base = start
             if expected:
-                pairs = np.asarray(expected, np.int64)  # [(deliver, tag)]
+                # [(deliver, tag, dst)] — the fingerprint hashes
+                # (tag, deliver) exactly as before; dst feeds the
+                # reconciliation ledger below
+                pairs = np.asarray(expected, np.int64)
                 exp_fp[i], exp_fp2[i] = _fingerprint_np(
                     pairs[:, 1], pairs[:, 0] - start)
                 exp_n[i] = len(expected)
@@ -679,8 +836,9 @@ class DeviceTransport:
             "src": col(0), "dst": col(1), "seq": col(2), "tag": col(3),
             "send": col(4), "clamp": col(5), "valid": jnp.asarray(valid),
         }
-        self.state, self._div = self._k_batch_verify(
-            self.state, jnp.asarray(shifts), jnp.asarray(widths), row,
+        self.state, self._guard, self._div = self._k_batch_verify(
+            self.state, self._guard, jnp.asarray(shifts),
+            jnp.asarray(widths), row,
             jnp.asarray(exp_fp), jnp.asarray(exp_fp2), jnp.asarray(exp_n),
             self._div,
         )
@@ -690,9 +848,10 @@ class DeviceTransport:
             # the CPU ledger is authoritative: tags come home when their
             # window is dispatched (device execution is sequential, so a
             # reused tag in a later ingest can never collide on device)
-            for _deliver, tag in expected:
+            for _deliver, tag, dst_idx in expected:
                 pool[tag] = None
                 free.append(tag)
+                self._led_released[dst_idx] += 1
             self.verified_packets += len(expected)
         # count only REAL windows (width > 0 or a ledger to check) —
         # width-0 base-shift/tail-padding records are no-ops and would
@@ -723,7 +882,7 @@ class DeviceTransport:
             self._records = rest
         # packets still in flight past the stop time: their release
         # windows never ran; hand the tags back
-        for _deliver, tag in self._expect_heap:
+        for _deliver, tag, _dst in self._expect_heap:
             self._pool[tag] = None
             self._free.append(tag)
         self._expect_heap.clear()
@@ -738,6 +897,17 @@ class DeviceTransport:
 
     # -- telemetry -------------------------------------------------------
 
+    def attach_tcp_source(self, plane_getter, conn_host) -> None:
+        """Register a device-TCP retransmit source for the harvest
+        path: `plane_getter()` returns the current `tpu/tcp.TcpPlane`
+        and `conn_host` [C] maps each connection to its sending host
+        index. Every harvest then folds the per-connection cumulative
+        `retransmit_count` into the per-host `retransmits` telemetry
+        field via `tcp.retransmits_by_host` + the harvester's standard
+        delta-unwrap (docs/observability.md)."""
+        self._tcp_source = (plane_getter, self._jnp.asarray(
+            np.asarray(conn_host), self._jnp.int32))
+
     def telemetry_arrays(self) -> dict:
         """Per-host counter arrays for the TelemetryHarvester, keyed in
         the PlaneMetrics namespace (host index i = host_id i+1). The
@@ -748,11 +918,23 @@ class DeviceTransport:
         No sync happens here — materialization is the harvester's
         drain, a full harvest interval later."""
         st = self.state
-        return {
+        out = {
             "pkts_out": st.n_out + 0,
             "pkts_in": st.n_released + 0,
             "drop_ring_full": st.n_overflow + 0,
         }
+        if self._tcp_source is not None:
+            from . import tcp as dtcp
+
+            plane_getter, conn_host = self._tcp_source
+            # the per-host array lands in the same int32 `retransmits`
+            # slot `telemetry.add_retransmits` feeds on a PlaneMetrics
+            # pytree — same dtype/namespace contract, no throwaway
+            # zero pytree per harvest
+            out["retransmits"] = dtcp.retransmits_by_host(
+                plane_getter(), conn_host, self._n).astype(
+                self._jnp.int32)
+        return out
 
     # -- shared ----------------------------------------------------------
 

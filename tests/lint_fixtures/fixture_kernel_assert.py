@@ -1,0 +1,48 @@
+"""SL402 fixture: Python asserts inside vs outside kernel bodies.
+Never imported.
+
+Linted under a synthetic shadow_tpu/tpu/ path.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.tpu import donating_jit
+
+
+@jax.jit
+def decorated_kernel(x):
+    assert x.shape[0] > 0  # violation: assert in a jit-decorated body
+    return x + 1
+
+
+def wrapped_kernel(x):
+    assert x.dtype == jnp.int32  # violation: fn passed to donating_jit
+    return x * 2
+
+
+_k = donating_jit(wrapped_kernel)
+
+
+def chain(x):
+    def body(c):
+        assert c is not None  # violation: while_loop body
+        return c - 1
+
+    def cond(c):
+        return c.sum() > 0
+
+    return jax.lax.while_loop(cond, body, x)
+
+
+def host_side_driver(batch):
+    # NOT a kernel: host-side shape validation before dispatch is fine
+    assert len(batch) > 0
+    return decorated_kernel(jnp.asarray(batch))
+
+
+def trace_time_check(cap: int):
+    # NOT an assert: the sanctioned trace-time static check
+    if cap <= 0:
+        raise ValueError("capacity must be positive")
+    return jnp.zeros((cap,), jnp.int32)

@@ -101,11 +101,13 @@ def test_cli_show_config(tmp_path):
 
 def test_cli_exit_codes_documented():
     """The exit-code contract (docs/robustness.md): 0 ok, 1 simulation
-    failure, 2 config error, 3 watchdog abort, 4 unhandled crash."""
+    failure, 2 config error, 3 watchdog abort, 4 unhandled crash,
+    5 guard abort."""
     from shadow_tpu import cli
 
     assert (cli.EXIT_OK, cli.EXIT_SIM_FAILURE, cli.EXIT_CONFIG,
-            cli.EXIT_WATCHDOG, cli.EXIT_CRASH) == (0, 1, 2, 3, 4)
+            cli.EXIT_WATCHDOG, cli.EXIT_CRASH,
+            cli.EXIT_GUARD) == (0, 1, 2, 3, 4, 5)
 
 
 def test_cli_config_error_exit_code(tmp_path):

@@ -229,6 +229,65 @@ def test_sl301_suppression_works():
     assert len(findings) == 1 and findings[0].suppressed
 
 
+def test_sl402_assert_in_kernel_bodies():
+    src, findings = _lint_fixture(
+        "fixture_kernel_assert.py",
+        "shadow_tpu/tpu/fixture_kernel_assert.py")
+    lines = {f.line for f in findings if f.rule == "SL402"}
+    assert lines == {
+        _line_of(src, "# violation: assert in a jit-decorated body"),
+        _line_of(src, "# violation: fn passed to donating_jit"),
+        _line_of(src, "# violation: while_loop body"),
+    }
+
+
+def test_sl402_scoped_to_tpu_and_allows_host_asserts():
+    kernel = ("import jax\n"
+              "@jax.jit\n"
+              "def k(x):\n"
+              "    assert x is not None\n"
+              "    return x\n")
+    # tpu/-only scoping: the same kernel elsewhere is out of scope
+    assert not [f for f in lint_source(kernel, "shadow_tpu/core/x.py")
+                if f.rule == "SL402"]
+    assert [f.rule for f in lint_source(kernel, "shadow_tpu/tpu/x.py")
+            if f.rule == "SL402"] == ["SL402"]
+    # a host-side assert in a plain function is untouched
+    host = ("def barrier(batch):\n"
+            "    assert batch\n"
+            "    return batch\n")
+    assert not [f for f in lint_source(host, "shadow_tpu/tpu/x.py")
+                if f.rule == "SL402"]
+
+
+def test_sl402_suppression_works():
+    src = ("import jax\n"
+           "@jax.jit\n"
+           "def k(x):\n"
+           "    # shadowlint: disable=SL402 -- trace-time shape pin\n"
+           "    assert x is not None\n"
+           "    return x\n")
+    findings = [f for f in lint_source(src, "shadow_tpu/tpu/x.py")
+                if f.rule == "SL402"]
+    assert len(findings) == 1 and findings[0].suppressed
+
+
+def test_sl402_tree_is_clean():
+    """No active assert-in-kernel finding anywhere in shadow_tpu/tpu/:
+    runtime invariants go through the guard plane (shadow_tpu/guards/),
+    trace-time checks through explicit raises."""
+    root = os.path.join(os.path.dirname(__file__), "..",
+                        "shadow_tpu", "tpu")
+    for name in sorted(os.listdir(root)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(root, name), encoding="utf-8") as fh:
+            findings = lint_source(fh.read(), f"shadow_tpu/tpu/{name}")
+        active = [f for f in findings
+                  if f.rule == "SL402" and not f.suppressed]
+        assert not active, [str(f) for f in active]
+
+
 def test_clean_fixture_and_sl101_scope():
     _, findings = _lint_fixture(
         "fixture_clean.py", "shadow_tpu/core/fixture_clean.py")
@@ -241,11 +300,11 @@ def test_clean_fixture_and_sl101_scope():
 
 def test_rule_registry_complete():
     assert set(RULES) == {f"SL10{i}" for i in range(1, 6)} | {
-        f"SL20{i}" for i in range(1, 6)} | {"SL301", "SL401"}
+        f"SL20{i}" for i in range(1, 6)} | {"SL301", "SL401", "SL402"}
     for rid in ("SL101", "SL102", "SL103", "SL104", "SL105", "SL301",
-                "SL401"):
+                "SL401", "SL402"):
         assert rule_applies(rid, "shadow_tpu/core/x.py") \
-            or rid in ("SL105", "SL301")
+            or rid in ("SL105", "SL301", "SL402")
 
 
 # -- SL401: swallowed broad exceptions ------------------------------------

@@ -162,8 +162,8 @@ def test_mirrored_detects_divergence():
     def poison(end_ns):
         expected = orig(end_ns)
         if not poisoned["done"] and expected:
-            deliver, tag = expected[0]
-            expected[0] = (deliver + 1, tag)  # ledger now off by 1 ns
+            deliver, tag, dst = expected[0]
+            expected[0] = (deliver + 1, tag, dst)  # ledger off by 1 ns
             poisoned["done"] = True
         return expected
 

@@ -22,6 +22,13 @@ digest of the resumed run against the uninterrupted one.
 `KernelFallback`: the Pallas egress kernel cannot fuse the fault gate,
 so the driver demotes to the bitwise-identical XLA path, loudly — the
 run completes and the JSON records `fell_back: true`.
+
+`--guards warn|abort` threads the guard plane (`shadow_tpu/guards/`,
+docs/robustness.md) through every window: the JSON gains a `guards`
+summary, a clean fault-injected run must report zero violations, and
+under `abort` any violation exits with the CLI guard code (5).
+`--tamper-at W` deliberately corrupts the device state after window W
+(a phantom ring slot) — the guards-catch-it proof CI runs.
 """
 
 from __future__ import annotations
@@ -92,6 +99,13 @@ def main(argv=None) -> int:
     ap.add_argument("--kernel", choices=["xla", "pallas"], default="xla")
     ap.add_argument("--no-faults", action="store_true",
                     help="neutral masks only (the overhead-gate twin)")
+    ap.add_argument("--guards", choices=["off", "warn", "abort"],
+                    default="off",
+                    help="thread the runtime invariant plane through "
+                         "every window (abort: violations exit 5)")
+    ap.add_argument("--tamper-at", type=int, default=None,
+                    help="corrupt the device state after this window "
+                         "(a phantom ring slot) — guards must catch it")
     args = ap.parse_args(argv)
 
     import jax
@@ -99,9 +113,13 @@ def main(argv=None) -> int:
 
     from shadow_tpu.faults import (KernelFallback, load_plane_checkpoint,
                                    neutral_faults, save_plane_checkpoint)
+    from shadow_tpu.guards import make_guards, summarize
+    from shadow_tpu.guards.plane import GuardState
     from shadow_tpu.telemetry import make_metrics
     from shadow_tpu.tpu import ingest_rows, profiling
     from shadow_tpu.tpu.plane import window_step
+
+    EXIT_GUARD = 5  # shadow_tpu.cli.EXIT_GUARD (docs/robustness.md)
 
     N, R = args.hosts, args.windows
     world = profiling.build_world(N, warmup_windows=0)
@@ -110,23 +128,32 @@ def main(argv=None) -> int:
     CI = world["ingress_cap"]
     schedule = (None if args.no_faults
                 else default_schedule(N, R, window_ns))
+    use_guards = args.guards != "off"
 
     def build_step(kernel: str):
         @jax.jit
-        def step(state, metrics, faults, spawn_seq, shift, round_idx):
+        def step(state, metrics, faults, guards, spawn_seq, shift,
+                 round_idx):
             out = window_step(state, world["params"], world["rng_root"],
                               shift, window, rr_enabled=False,
                               kernel=kernel, faults=faults,
-                              metrics=metrics)
-            state, delivered, _next, metrics = out
+                              metrics=metrics, guards=guards)
+            if guards is not None:
+                state, delivered, _next, metrics, guards = out
+            else:
+                state, delivered, _next, metrics = out
             mask, dst, nbytes, seq, ctrl = profiling.respawn_batch(
                 delivered, spawn_seq, round_idx, N, CI)
             # dead/flapped hosts generate no respawn traffic
             mask = mask & (faults.host_alive & faults.link_up)[:, None]
-            state, metrics = ingest_rows(
+            out = ingest_rows(
                 state, dst, nbytes, seq, seq, ctrl, valid=mask,
-                metrics=metrics)
-            return state, metrics, spawn_seq + mask.sum(
+                metrics=metrics, guards=guards)
+            if guards is not None:
+                state, metrics, guards = out
+            else:
+                state, metrics = out
+            return state, metrics, guards, spawn_seq + mask.sum(
                 axis=1, dtype=jnp.int32)
         return step
 
@@ -135,6 +162,7 @@ def main(argv=None) -> int:
     start_w = 0
     state = world["state"]
     metrics = make_metrics(N)
+    guards = make_guards(N) if use_guards else None
     spawn_seq = jnp.full((N,), 10_000, jnp.int32)
     if args.resume:
         restored = load_plane_checkpoint(
@@ -144,6 +172,10 @@ def main(argv=None) -> int:
         state = restored["state"]
         metrics = restored["metrics"]
         spawn_seq = jnp.asarray(restored["extra"]["spawn_seq"])
+        if use_guards and "guards.violations" in restored["extra"]:
+            guards = GuardState(**{
+                f: jnp.asarray(restored["extra"][f"guards.{f}"])
+                for f in GuardState._fields})
         start_w = int(restored["meta"]["window_index"])
         got = state_digest(state, spawn_seq)
         want = restored["meta"].get("state_digest")
@@ -167,17 +199,32 @@ def main(argv=None) -> int:
         else:
             faults = neutral_faults(N, 64)
         shift = jnp.int32(0 if wdx == 0 else window_ns)
-        state, metrics, spawn_seq = driver(
-            state, metrics, faults, spawn_seq, shift, jnp.int32(wdx))
+        state, metrics, guards, spawn_seq = driver(
+            state, metrics, faults, guards, spawn_seq, shift,
+            jnp.int32(wdx))
+        if args.tamper_at is not None and wdx + 1 == args.tamper_at:
+            # deliberate corruption: a phantom valid slot at the back
+            # of one ingress ring (carrying the idle sentinel) — the
+            # exact single-slot damage batched execution would hide
+            print(f"chaos_smoke: tampering with the device state at "
+                  f"window {wdx + 1}", file=sys.stderr)
+            state = state._replace(
+                in_valid=state.in_valid.at[1, CI - 1].set(True))
         if args.checkpoint_dir and args.checkpoint_every \
                 and (wdx + 1) % args.checkpoint_every == 0 and wdx + 1 < R:
             path = os.path.join(args.checkpoint_dir,
                                 f"ckpt-{wdx + 1:012d}")
+            extra = {"spawn_seq": spawn_seq}
+            if use_guards:
+                # the guard accumulator rides the checkpoint so a
+                # resumed run reports the same violation history
+                extra.update({f"guards.{f}": getattr(guards, f)
+                              for f in GuardState._fields})
             save_plane_checkpoint(
                 path, state=state, clock_ns=now_ns,
                 rng_key_data=jax.random.key_data(world["rng_root"]),
                 faults=faults, metrics=metrics,
-                extra_arrays={"spawn_seq": spawn_seq},
+                extra_arrays=extra,
                 meta={"window_index": wdx + 1, "hosts": N,
                       "state_digest": state_digest(state, spawn_seq)})
             checkpoints.append(path)
@@ -206,6 +253,15 @@ def main(argv=None) -> int:
         "events": int(np.asarray(m.events)),
         "checkpoints": checkpoints,
     }
+    if use_guards:
+        gsum = summarize(guards)
+        out["guards"] = gsum
+        if not gsum["clean"]:
+            print("chaos_smoke: guard violations: "
+                  + json.dumps(gsum["by_class"]), file=sys.stderr)
+            if args.guards == "abort":
+                print(json.dumps(out))
+                return EXIT_GUARD
     print(json.dumps(out))
     return 0
 
