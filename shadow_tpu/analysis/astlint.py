@@ -34,6 +34,16 @@ once collecting findings. Rules scope by repo-relative path:
   invariants go through the guard plane (``shadow_tpu/guards/``);
   trace-time static checks use an explicit raise. Host-side asserts
   outside kernel bodies are untouched.
+- SL403 (variadic-sort) applies to ``shadow_tpu/tpu/``: a
+  ``jax.lax.sort`` call (or a call to the ``_row_sort`` wrapper) whose
+  statically-countable operand tuple carries more than 3 payload
+  operands (operands beyond ``num_keys``/``keys``) — the variadic
+  anti-pattern the sort diet removed (docs/performance.md): payload
+  belongs on a packed-key permutation or a bucketed counting
+  placement, not in the comparator network. Calls whose operand count
+  or key count is not statically countable (starred args, computed
+  key counts) are skipped; the compiled-in ``packed_sort=False``
+  parity-reference paths carry justified suppressions.
 """
 
 from __future__ import annotations
@@ -88,7 +98,7 @@ def rule_applies(rule: str, relpath: str) -> bool:
         )
     if rule == "SL104":
         return True
-    if rule in ("SL105", "SL301", "SL402"):
+    if rule in ("SL105", "SL301", "SL402", "SL403"):
         return p.startswith("shadow_tpu/tpu/")
     if rule == "SL401":
         return p.startswith("shadow_tpu/")
@@ -477,10 +487,56 @@ class _Linter(ast.NodeVisitor):
                  else self.host_arrays.unmark)(target.id)
         self.generic_visit(node)
 
+    # -- SL403: variadic sorts past the payload diet ----------------------
+
+    #: sort-diet payload budget: a sort may carry up to this many
+    #: non-key operands before it reads as the variadic anti-pattern
+    _SORT_PAYLOAD_BUDGET = 3
+
+    def _check_sort_diet(self, node: ast.Call, resolved) -> None:
+        leaf = _callee_leaf(node.func, self.imports)
+        if resolved and resolved.endswith("lax.sort"):
+            # jax.lax.sort((a, b, ...), num_keys=k): count the operand
+            # tuple; non-tuple first args (a Name forwarding *arrays)
+            # are not statically countable
+            if not node.args or not isinstance(node.args[0], ast.Tuple):
+                return
+            elts = node.args[0].elts
+            keys_kw, default_keys = "num_keys", 1
+        elif leaf == "_row_sort":
+            # the plane's row-sort wrapper: _row_sort(*arrays, keys=k)
+            elts = list(node.args)
+            keys_kw, default_keys = "keys", None
+        else:
+            return
+        if any(isinstance(e, ast.Starred) for e in elts):
+            return  # e.g. _row_perm_sort's *extra_keys: uncountable
+        num_keys = default_keys
+        for kw in node.keywords:
+            if kw.arg == keys_kw:
+                if isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, int):
+                    num_keys = kw.value.value
+                else:
+                    return  # computed key count: uncountable
+        if num_keys is None:
+            return
+        payload = len(elts) - num_keys
+        if payload > self._SORT_PAYLOAD_BUDGET:
+            self._emit(
+                "SL403", node,
+                f"variadic sort carries {payload} payload operands "
+                f"(> {self._SORT_PAYLOAD_BUDGET}) through the comparator "
+                "network; pack the keys and move payload to a "
+                "permutation/bucketed placement (sort diet, "
+                "docs/performance.md) — parity-reference paths need a "
+                "justified suppression")
+
     # -- SL101 / SL102: calls --------------------------------------------
 
     def visit_Call(self, node: ast.Call) -> None:
         resolved = self.imports.resolve(node.func)
+        self._check_sort_diet(node, resolved)
         if resolved in _WALL_CLOCK:
             self._emit("SL101", node,
                        f"wall-clock read `{resolved}` in simulation code; "

@@ -298,6 +298,43 @@ def _plane_entry(rr_enabled: bool, router_aqm: bool, no_loss: bool,
     return build
 
 
+def _routing_entry(stage: str):
+    """The routing-stage split (plane section 5): `routing_rank` audits
+    the bucketed-order computation (row seq-rank + diet flat sort +
+    histogram placement), `routing_place` the fused per-column
+    gather-scatters — the same split the per-section profiler times."""
+    def build():
+        import jax.numpy as jnp
+
+        from ..tpu import plane
+
+        n, ce, ci = 4, 8, 8
+        rng = np.random.default_rng(0)
+        sent = jnp.asarray(rng.integers(0, 2, (n, ce)) == 0)
+        eg_dst = jnp.asarray(rng.integers(0, n, (n, ce)), jnp.int32)
+        eg_seq = jnp.asarray(rng.integers(0, 100, (n, ce)), jnp.int32)
+        eg_bytes = jnp.full((n, ce), 1400, jnp.int32)
+        eg_sock = jnp.zeros((n, ce), jnp.int32)
+        deliver = jnp.asarray(
+            rng.integers(0, 10**6, (n, ce)), jnp.int32)
+        n_valid = jnp.zeros((n,), jnp.int32)
+        if stage == "rank":
+            def fn(sent, eg_dst, eg_seq, deliver, n_valid):
+                return plane._routing_rank(
+                    sent, eg_dst, eg_seq, deliver, n_valid, ci)
+
+            return fn, (sent, eg_dst, eg_seq, deliver, n_valid)
+        row_perm, o_pos, offsets, take_n, _ovf = plane._routing_rank(
+            sent, eg_dst, eg_seq, deliver, n_valid, ci)
+        z = lambda: jnp.zeros((n, ci), jnp.int32)
+        return plane._routing_place, (
+            row_perm, o_pos, offsets, take_n, n_valid, eg_seq, eg_bytes,
+            eg_sock, deliver, z(), z(), z(), z(), z(),
+            jnp.zeros((n, ci), bool))
+
+    return build
+
+
 def _chain_entry():
     def build():
         import jax
@@ -458,6 +495,10 @@ def default_entries() -> list[AuditEntry]:
                    _plane_entry(True, True, False, faults=True)),
         AuditEntry("window_step[guards]", "shadow_tpu.tpu.plane",
                    _plane_entry(True, True, False, guards=True)),
+        AuditEntry("routing_rank", "shadow_tpu.tpu.plane",
+                   _routing_entry("rank")),
+        AuditEntry("routing_place", "shadow_tpu.tpu.plane",
+                   _routing_entry("place")),
         AuditEntry("chain_windows", "shadow_tpu.tpu.plane",
                    _chain_entry()),
         AuditEntry("tcp_event_step", "shadow_tpu.tpu.tcp",

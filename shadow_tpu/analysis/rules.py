@@ -112,6 +112,17 @@ RULES: dict[str, RuleInfo] = {
             "into an unexplained hang or wrong result",
         ),
         RuleInfo(
+            "SL403", "variadic-sort",
+            "lax.sort (or the `_row_sort` wrapper) carrying more than 3 "
+            "payload operands through the comparator network in tpu/",
+            "the sort diet (docs/performance.md): payload columns ride a "
+            "packed-key permutation or a bucketed counting placement, "
+            "never the O(n log n) comparator network — the variadic "
+            "anti-pattern was the window step's dominant cost until PR 2 "
+            "removed it; the compiled-in packed_sort=False parity "
+            "reference paths carry justified suppressions",
+        ),
+        RuleInfo(
             "SL201", "x64-leak",
             "64-bit dtype (float64/int64) appearing in a device jaxpr",
             "the device plane is int32/float32 by contract "

@@ -185,13 +185,16 @@ class ExperimentalOptions:
     tpu_egress_cap: int = 256  # per-host device egress slots
     tpu_ingress_cap: int = 256  # per-host device in-flight slots
     tpu_compact_cap: int = 4096  # per-window compacted-delivery slots
-    # device-plane egress kernel: "xla" = the packed-key sort diet path
-    # (default); "pallas" = the fused rebase->sort->token-gate Pallas
-    # kernel (tpu/pallas_egress.py; FIFO qdisc only, bitwise-identical,
+    # device-plane fused-kernel selector: "xla" = the packed-key sort
+    # diet + bucketed routing path (default); "pallas" = the fused
+    # Pallas kernels for egress (tpu/pallas_egress.py) and routing
+    # (tpu/pallas_route.py; FIFO qdisc only, bitwise-identical,
     # interpret mode off-TPU). Governs the general plane's window_step
     # drivers (bench.py via BENCH_PLANE_KERNEL, tools/profile_plane.py
-    # --kernel); the use_tpu_transport path has its own kernels and does
-    # not consult this yet. See docs/performance.md.
+    # --kernel); the use_tpu_transport path has its own kernels and
+    # does not consult this — Manager-driven runs therefore warn
+    # loudly (ConfigError under `strict: true`) when it is set. See
+    # docs/performance.md.
     plane_kernel: str = "xla"
 
 

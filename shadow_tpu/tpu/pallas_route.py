@@ -1,0 +1,138 @@
+"""Fused Pallas routing stage: per-destination-tile rank + scatter-append
+in ONE VMEM-resident pass.
+
+The XLA routing path (plane.window_step section 5) computes the bucketed
+arrival order (`plane._routing_order`), derives every item's destination
+slot, and then lands each payload column with a separate flat scatter —
+six scatter dispatches round-tripping the ingress columns through HBM.
+This kernel fuses the per-destination placement: a tile of destination
+rows stays resident in VMEM while, for each row, the bucket's segment of
+the arrival-sorted stream is appended after the row's existing entries
+in one masked select — rank computation (bucket offset - current
+occupancy) and scatter-append collapse into a windowed dynamic load plus
+a compare mask, with no per-column scatter dispatches.
+
+The arrival order itself still comes from the XLA diet sort (sorting is
+what XLA's comparator networks are for); the sorted payload streams are
+materialized once and consumed by every destination tile.
+
+Scope mirrors `pallas_egress`: selected via `experimental.plane_kernel =
+"pallas"` / `window_step(kernel="pallas")` (FIFO worlds — the flag
+already requires `rr_enabled=False`); `window_step` refuses the
+combination with threaded faults or guards at trace time, and the
+self-healing `KernelFallback` (faults/healing.py) demotes failing
+drivers to the bitwise-identical XLA path. Off-TPU the kernel runs in
+Pallas interpret mode — correct and parity-tested
+(tests/test_plane_routing.py), not fast; the interpret path is the part
+this module guarantees.
+
+Mosaic note: the per-row windowed loads use dynamic-start `pl.ds`
+slices; on TPU hardware Mosaic may want the stream blocks routed through
+scalar-prefetched block indices instead. As with `pallas_egress`, the
+interpret path and the bitwise-parity contract are what this module
+pins.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .pallas_egress import _pick_tile
+from .plane import I32_MAX, _routing_rank
+
+
+def _route_kernel(nv_ref, lo_ref, take_ref, s_src, s_seq, s_sock, s_bytes,
+                  s_del, b_src, b_seq, b_sock, b_bytes, b_del, b_valid,
+                  o_src, o_seq, o_sock, o_bytes, o_del, o_valid):
+    T, CI = b_src.shape
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, CI), 1)
+
+    def row(r, carry):
+        nv = pl.load(nv_ref, (pl.ds(r, 1),))[0]
+        lo = pl.load(lo_ref, (pl.ds(r, 1),))[0]
+        take = pl.load(take_ref, (pl.ds(r, 1),))[0]
+        # append mask: slots [nv, nv + take) receive the bucket segment;
+        # the stream window is loaded at (bucket offset - nv) so window
+        # column c IS the item destined for row slot c — the rank
+        # computation and the scatter-append collapse into this select
+        mask = (col >= nv) & (col < nv + take)
+        start = lo + CI  # into the CI-left-padded stream
+        for s_ref, b_ref, o_ref in ((s_src, b_src, o_src),
+                                    (s_seq, b_seq, o_seq),
+                                    (s_sock, b_sock, o_sock),
+                                    (s_bytes, b_bytes, o_bytes),
+                                    (s_del, b_del, o_del)):
+            win = pl.load(s_ref, (pl.ds(start, CI),)).reshape(1, CI)
+            base = pl.load(b_ref, (pl.ds(r, 1), pl.ds(0, CI)))
+            pl.store(o_ref, (pl.ds(r, 1), pl.ds(0, CI)),
+                     jnp.where(mask, win, base))
+        basev = pl.load(b_valid, (pl.ds(r, 1), pl.ds(0, CI)))
+        pl.store(o_valid, (pl.ds(r, 1), pl.ds(0, CI)),
+                 jnp.where(mask, 1, basev))
+        return carry
+
+    jax.lax.fori_loop(0, T, row, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _route_call(nv, lo, take, s_src, s_seq, s_sock, s_bytes, s_del,
+                b_src, b_seq, b_sock, b_bytes, b_del, b_valid,
+                interpret: bool):
+    N, CI = b_src.shape
+    B2 = s_src.shape[0]
+    T = _pick_tile(N)
+    tile1 = pl.BlockSpec((T,), lambda i: (i,))
+    row_spec = pl.BlockSpec((T, CI), lambda i: (i, 0))
+    full = pl.BlockSpec((B2,), lambda i: (0,))
+    return pl.pallas_call(
+        _route_kernel,
+        grid=(N // T,),
+        in_specs=[tile1] * 3 + [full] * 5 + [row_spec] * 6,
+        out_specs=[row_spec] * 6,
+        out_shape=[jax.ShapeDtypeStruct((N, CI), jnp.int32)] * 6,
+        interpret=interpret,
+    )(nv, lo, take, s_src, s_seq, s_sock, s_bytes, s_del,
+      b_src, b_seq, b_sock, b_bytes, b_del, b_valid)
+
+
+def route_scatter(sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel,
+                  in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
+                  in_valid_c, n_valid_in):
+    """The fused routing stage: bitwise equal to the XLA diet path's
+    `_routing_rank` + `_routing_place` (plane.py section 5). Returns the
+    merged ingress columns + per-host overflow, like `_route_scatter`."""
+    N, CE = eg_dst.shape
+    CI = in_src_c.shape[1]
+    # ONE source of truth for the bucketed order and the placement-
+    # capacity arithmetic: the same phase-A the XLA path composes
+    row_perm, o_pos, offsets, take_n, overflow = _routing_rank(
+        sent, eg_dst, eg_seq, deliver_rel, n_valid_in, CI)
+    lo = offsets - n_valid_in
+
+    # arrival-sorted payload streams (the cross-host exchange the tiles
+    # consume) addressed through the composed permutation (sorted
+    # position -> original slot), padded CI on both sides so every
+    # windowed load is in bounds; padding is never selected (masked
+    # lanes only cover the bucket's own segment)
+    flat = lambda a: a.reshape(-1)
+    g = (o_pos // CE) * CE + flat(row_perm)[o_pos]
+    pad = lambda a: jnp.pad(a, (CI, CI))
+    stream = lambda a: pad(flat(a)[g])
+    s_src = pad((o_pos // CE).astype(jnp.int32))
+    s_seq, s_sock = stream(eg_seq), stream(eg_sock)
+    s_bytes = stream(eg_bytes)
+    s_del = stream(deliver_rel)
+
+    b_del = jnp.where(in_valid_c, in_deliver_c, I32_MAX)
+    interpret = jax.default_backend() != "tpu"
+    (in_src_m, in_seq_m, in_sock_m, in_bytes_m, in_deliver_m,
+     in_valid_m) = _route_call(
+        n_valid_in, lo, take_n, s_src, s_seq, s_sock, s_bytes, s_del,
+        in_src_c, in_seq_c, in_sock_c, in_bytes_c, b_del,
+        in_valid_c.astype(jnp.int32), interpret)
+    return (in_src_m, in_seq_m, in_sock_m, in_bytes_m, in_deliver_m,
+            in_valid_m != 0, overflow)

@@ -226,10 +226,13 @@ def _row_sort(*arrays, keys: int):
 # packed-key forms below fuse the (validity, key) pair into ONE uint32 key,
 # sort (key, column-index), and apply the resulting permutation to the
 # payload columns with take_along_axis — the sorting network then carries 2-3
-# arrays instead of 7-12. Flat cross-host sorts (routing, flat ingest) KEEP
-# the variadic form: their permutations are arbitrary global gathers, which
-# are DMA-bound on TPU (~0.5 ms per column at 65k slots on a v5e), while a
-# row-sort permutation only moves values within a C-wide row.
+# arrays instead of 7-12. Flat cross-host sorts (routing, flat ingest) get
+# the BUCKETED diet instead (`_routing_rank`, `ingest`): the group key is a
+# bounded bucket id, so the comparator network carries only (bucket, order
+# key, slot index) and the payload columns land via one fused scatter each —
+# never a standalone flat-permutation gather, which is DMA-bound on TPU
+# (~0.5 ms per column at 65k slots on a v5e); a row-sort permutation, by
+# contrast, only moves values within a C-wide row.
 
 _SIGN32 = np.uint32(0x80000000)
 _U32_MAX = np.uint32(0xFFFFFFFF)
@@ -355,6 +358,7 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
            send_rel: jax.Array | None = None,
            clamp_rel: jax.Array | None = None,
            sock: jax.Array | None = None, *,
+           packed_sort: bool = True,
            metrics: PlaneMetrics | None = None,
            guards: GuardState | None = None):
     """Append a batch of outbound packets ([B] arrays; src = emitting host
@@ -377,6 +381,15 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
     and guards' is appended to the return. Pure reads — the simulation
     state is untouched.
 
+    `packed_sort` (static) selects the bucketed flat-append diet: src is
+    a bounded bucket id, so the deterministic (src, seq) append order
+    needs only ONE diet sort carrying (bucket, sign-biased seq, batch
+    index) plus binary-searched bucket bounds for the counting
+    placement, and the payload columns land via one fused stacked
+    gather straight from the batch layout — vs the 9-array 2-key
+    variadic sort it replaces (kept as the parity-test reference under
+    `packed_sort=False`, bitwise-identical for in-domain src).
+
     The CPU syscall plane calls this once per round with everything the
     sockets emitted (double-buffered host arrays in the full system)."""
     N, CE = state.eg_dst.shape
@@ -389,33 +402,83 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
         clamp_rel = jnp.full_like(seq, NO_CLAMP)
     if sock is None:
         sock = jnp.zeros_like(seq)
-    # rank of each packet within its src group, deterministic by (src, seq);
-    # one variadic sort carries every payload column (see window_step's
-    # routing sort for why this beats lexsort + per-column gathers)
-    (src_s, seq_s, dst_s, bytes_s, prio_s, ctrl_s, tsend_s, clamp_s,
-     sock_s) = jax.lax.sort(
-        (src, seq, dst, nbytes, prio, ctrl, send_rel, clamp_rel, sock),
-        dimension=0, is_stable=True, num_keys=2,
-    )
 
     n_valid = state.eg_valid.sum(axis=1).astype(jnp.int32)  # [N]
     # rows are front-compacted (window_step re-sorts), so slot placement is
     # append; overflowing packets get an out-of-bounds index and drop
-    live = jnp.ones_like(src_s, bool)
-    flat, ok, overflow = _scatter_append(src_s, live, n_valid, CE, N)
+    incoming = None
+    if packed_sort:
+        # bucketed counting placement (same shape as the routing stage,
+        # `_routing_rank`/`_routing_place`): ONE diet sort establishes
+        # the (src, seq) append order, binary search bounds each row's
+        # segment, and every payload column lands via one fused stacked
+        # gather — base entries where the row already had them, the
+        # segment's stream items in the appended slots
+        B = src.shape[0]
+        src_b = jnp.where((src >= 0) & (src < N), src, N)
+        pos = jnp.arange(B, dtype=jnp.int32)
+        o_src, _, o_pos = jax.lax.sort(
+            (src_b, seq.astype(jnp.uint32) ^ _SIGN32, pos),
+            dimension=0, is_stable=True, num_keys=2)
+        bounds = jnp.searchsorted(
+            o_src, jnp.arange(N + 1, dtype=jnp.int32)).astype(jnp.int32)
+        offsets, counts = bounds[:-1], bounds[1:] - bounds[:-1]
+        take_n = jnp.minimum(counts, jnp.int32(CE) - n_valid)
+        overflow = jnp.maximum(counts + n_valid - CE, 0)
+        incoming = counts
+        flat = lambda a: a.reshape(-1)
+        streams = jnp.stack([
+            dst[o_pos], nbytes[o_pos], prio[o_pos], seq[o_pos],
+            ctrl[o_pos].astype(jnp.int32), send_rel[o_pos],
+            clamp_rel[o_pos], sock[o_pos], jnp.ones((B,), jnp.int32)])
+        bases = jnp.stack([
+            flat(state.eg_dst), flat(state.eg_bytes), flat(state.eg_prio),
+            flat(state.eg_seq), flat(state.eg_ctrl.astype(jnp.int32)),
+            flat(state.eg_tsend), flat(state.eg_clamp),
+            flat(state.eg_sock), flat(state.eg_valid.astype(jnp.int32))])
+        combined = jnp.concatenate([bases, streams], axis=1)
+        ce_col = jnp.arange(CE, dtype=jnp.int32)[None, :]
+        nv = n_valid[:, None]
+        append = (ce_col >= nv) & (ce_col < nv + take_n[:, None])
+        stream_idx = jnp.clip(offsets[:, None] + ce_col - nv, 0, B - 1)
+        rows_i = jnp.arange(N, dtype=jnp.int32)[:, None]
+        gidx = jnp.where(append, N * CE + stream_idx,
+                         rows_i * CE + ce_col)
+        merged = combined[:, gidx]  # one [9, N, CE] gather
+        (eg_dst, eg_bytes, eg_prio, eg_seq, eg_ctrl_i, eg_tsend,
+         eg_clamp, eg_sock, eg_valid_i) = merged
+        eg_ctrl, eg_valid = eg_ctrl_i != 0, eg_valid_i != 0
+    else:
+        # the pre-diet reference: rank within each src group via one
+        # variadic sort carrying every payload column
+        (src_s, seq_s, dst_s, bytes_s, prio_s, ctrl_s, tsend_s, clamp_s,
+         # shadowlint: disable=SL403 -- pre-diet variadic reference path
+         sock_s) = jax.lax.sort(
+            (src, seq, dst, nbytes, prio, ctrl, send_rel, clamp_rel, sock),
+            dimension=0, is_stable=True, num_keys=2,
+        )
+        live = jnp.ones_like(src_s, bool)
+        flat, ok, overflow = _scatter_append(src_s, live, n_valid, CE, N)
+        if guards is not None:
+            # incoming per row: live batch slots routed to in-range rows
+            # (dead slots went to src N and fall off the segment sum)
+            incoming = jax.ops.segment_sum(
+                (src_s < N).astype(jnp.int32),
+                jnp.clip(src_s, 0, N - 1), num_segments=N)
 
-    def put(buf, vals):
-        return buf.reshape(-1).at[flat].set(vals, mode="drop").reshape(N, CE)
+        def put(buf, vals):
+            return buf.reshape(-1).at[flat].set(
+                vals, mode="drop").reshape(N, CE)
 
-    eg_dst = put(state.eg_dst, dst_s)
-    eg_bytes = put(state.eg_bytes, bytes_s)
-    eg_prio = put(state.eg_prio, prio_s)
-    eg_seq = put(state.eg_seq, seq_s)
-    eg_ctrl = put(state.eg_ctrl, ctrl_s)
-    eg_tsend = put(state.eg_tsend, tsend_s)
-    eg_clamp = put(state.eg_clamp, clamp_s)
-    eg_sock = put(state.eg_sock, sock_s)
-    eg_valid = put(state.eg_valid, jnp.ones_like(ok))
+        eg_dst = put(state.eg_dst, dst_s)
+        eg_bytes = put(state.eg_bytes, bytes_s)
+        eg_prio = put(state.eg_prio, prio_s)
+        eg_seq = put(state.eg_seq, seq_s)
+        eg_ctrl = put(state.eg_ctrl, ctrl_s)
+        eg_tsend = put(state.eg_tsend, tsend_s)
+        eg_clamp = put(state.eg_clamp, clamp_s)
+        eg_sock = put(state.eg_sock, sock_s)
+        eg_valid = put(state.eg_valid, jnp.ones_like(ok))
     new_state = state._replace(
         eg_dst=eg_dst, eg_bytes=eg_bytes, eg_prio=eg_prio, eg_seq=eg_seq,
         eg_ctrl=eg_ctrl, eg_tsend=eg_tsend, eg_clamp=eg_clamp,
@@ -423,11 +486,6 @@ def ingest(state: NetPlaneState, src: jax.Array, dst: jax.Array,
         n_overflow_dropped=state.n_overflow_dropped + overflow,
     )
     if guards is not None:
-        # incoming per row: live batch slots routed to in-range rows
-        # (dead slots went to src N and fall off the segment sum)
-        incoming = jax.ops.segment_sum(
-            (src_s < N).astype(jnp.int32),
-            jnp.clip(src_s, 0, N - 1), num_segments=N)
         guards = guards_plane.check_ingest(
             guards,
             occ_before=n_valid,
@@ -596,6 +654,7 @@ def ingest_rows(state: NetPlaneState, dst: jax.Array, nbytes: jax.Array,
             # < CE, front-packed) stay ahead of the new ones, new entries
             # keep column order
             (_, dst_f, bytes_f, prio_f, seq_f, ctrl_f, tsend_f, clamp_f,
+             # shadowlint: disable=SL403 -- pre-diet variadic reference
              sock_f, valid_f) = _row_sort(
                 inv, cat(state.eg_dst, dst), cat(state.eg_bytes, nbytes),
                 cat(state.eg_prio, prio), cat(state.eg_seq, seq),
@@ -733,6 +792,7 @@ def _egress_order(state: NetPlaneState, qkey1, qkey2, eg_tsend_rb,
                 take(eg_tsend_rb), take(eg_clamp_rb), take(state.eg_valid))
     inv = (~state.eg_valid).astype(jnp.int32)
     (_, _, _, eg_prio, eg_sock, eg_dst, eg_bytes, eg_seq, eg_ctrl,
+     # shadowlint: disable=SL403 -- pre-diet variadic reference path
      eg_tsend, eg_clamp, eg_valid) = _row_sort(
         inv, qkey1, qkey2, state.eg_prio, state.eg_sock, state.eg_dst,
         state.eg_bytes, state.eg_seq, state.eg_ctrl, eg_tsend_rb,
@@ -844,6 +904,7 @@ def _compact_ingress(state: NetPlaneState, in_deliver, *, packed_sort: bool):
     else:
         inv_in = (~state.in_valid).astype(jnp.int32)
         (_, in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
+         # shadowlint: disable=SL403 -- pre-diet variadic reference path
          in_valid_c) = _row_sort(
             inv_in, key_deliver, state.in_src, state.in_seq, state.in_sock,
             state.in_bytes, state.in_valid, keys=2,
@@ -853,46 +914,152 @@ def _compact_ingress(state: NetPlaneState, in_deliver, *, packed_sort: bool):
             in_valid_c, n_valid_in)
 
 
-def _route_scatter(sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel,
-                   in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
-                   in_valid_c, n_valid_in, *, packed_sort: bool = True):
-    """Section 5: route sent packets into destination ingress queues —
-    one flat variadic sort for deterministic per-destination insertion
-    order, then the grouped scatter-append. (Flat sorts stay variadic:
-    applying a flat permutation with per-column gathers costs ~0.5 ms per
-    column at 65k slots on TPU — arbitrary-index gathers are DMA-bound.)
-    The packed form drops the `sent` column from the sort: it is fully
-    recoverable as ``o_dst < N`` (non-sent slots were routed to the
-    sentinel dst N; a hypothetical sent packet with an out-of-range dst
-    lands in the same not-placeable bucket on both paths). Returns the
-    merged ingress columns + per-host overflow."""
+def _routing_order(sent, eg_dst, eg_seq, deliver_rel):
+    """Bucketed routing, phase A: establish the deterministic global
+    arrival order WITHOUT pushing payload through the flat comparator
+    network. The order the CPU plane's event queue imposes per
+    destination is (deliver, src, seq); the legacy path realizes it as
+    one flat 4-key variadic sort over [N*CE] slots. Here:
+
+    - a row-local stable seq RANK (an [N, CE, CE] pairwise compare —
+      CE is small, so this beats a row sort the same way the RR qdisc's
+      rank tensors do) permutes each source row into seq order, so the
+      flat slot index itself encodes the (src, seq) tiebreak;
+    - ONE flat sort then carries just the routing key pair — destination
+      bucket + sign-biased deliver time, the 64-bit (dst | deliver) key
+      expressed as two uint32/int32 words under the plane's 32-bit dtype
+      discipline — plus the flat slot index as the only payload;
+    - each bucket's [start, count) segment of the sorted sequence comes
+      from a binary search of the bucket ids over the sorted keys
+      (O(N log B) — vs an O(B) histogram scatter-add).
+
+    Non-sent slots (and any out-of-domain dst) route to the sentinel
+    bucket N, which sorts last and is never placed. Returns
+    (row_perm [N, CE] — seq-rank position -> original column,
+    o_pos [B] — sorted order -> seq-permuted flat slot,
+    offsets/counts [N] — each bucket's segment of the sorted order)."""
     N, CE = eg_dst.shape
-    CI = in_src_c.shape[1]
+    B = N * CE
+    col = jnp.arange(CE, dtype=jnp.int32)
+    # stable rank of each slot within its row by (seq, column): the
+    # qdisc sort left rows in priority order, not seq order, and equal
+    # (dst, deliver) arrivals from one source must land by seq
+    earlier = ((eg_seq[:, None, :] < eg_seq[:, :, None])
+               | ((eg_seq[:, None, :] == eg_seq[:, :, None])
+                  & (col[None, None, :] < col[None, :, None])))
+    rank = jnp.sum(earlier, axis=2, dtype=jnp.int32)  # [N, CE]
+    rows = jnp.arange(N, dtype=jnp.int32)[:, None]
+    # rank is a permutation per row ((seq, col) pairs are distinct), so
+    # the scatter inverts it: row_perm[n, rank[n, c]] = c
+    row_perm = jnp.zeros((N, CE), jnp.int32).at[rows, rank].set(
+        jnp.broadcast_to(col, (N, CE)))
+    take_row = lambda a: jnp.take_along_axis(a, row_perm, axis=1)
+    sent_p, dst_p = take_row(sent), take_row(eg_dst)
+    flat_dst = jnp.where(sent_p & (dst_p >= 0) & (dst_p < N),
+                         dst_p, N).reshape(-1)
+    deliver_key = take_row(deliver_rel).reshape(-1) \
+        .astype(jnp.uint32) ^ _SIGN32
+    pos = jnp.arange(B, dtype=jnp.int32)
+    o_dst, _, o_pos = jax.lax.sort((flat_dst, deliver_key, pos),
+                                   dimension=0, is_stable=True, num_keys=2)
+    bounds = jnp.searchsorted(
+        o_dst, jnp.arange(N + 1, dtype=jnp.int32)).astype(jnp.int32)
+    offsets, counts = bounds[:-1], bounds[1:] - bounds[:-1]
+    return row_perm, o_pos, offsets, counts
+
+
+def _routing_rank(sent, eg_dst, eg_seq, deliver_rel, n_valid_in,
+                  ingress_cap: int):
+    """Section 5a (packed): counting placement over the bucketed order.
+    Each destination row accepts the first `take` items of its bucket's
+    sorted segment — exactly the items whose in-bucket rank fits the
+    row's free slots — so placement reduces to per-bucket [N] arithmetic
+    over the segment bounds; no per-item destination indices are ever
+    materialized. Returns (row_perm, o_pos, offsets, take [N], overflow
+    [N])."""
+    row_perm, o_pos, offsets, counts = _routing_order(
+        sent, eg_dst, eg_seq, deliver_rel)
+    # per-bucket arithmetic is exact: occupancy never exceeds capacity,
+    # so free = CI - n_valid >= 0; arrivals past the free slots drop
+    take_n = jnp.minimum(counts, jnp.int32(ingress_cap) - n_valid_in)
+    overflow = jnp.maximum(counts + n_valid_in - ingress_cap, 0)
+    return row_perm, o_pos, offsets, take_n, overflow
+
+
+def _routing_place(row_perm, o_pos, offsets, take_n, n_valid_in, eg_seq,
+                   eg_bytes, eg_sock, deliver_rel, in_deliver_c, in_src_c,
+                   in_seq_c, in_sock_c, in_bytes_c, in_valid_c):
+    """Section 5b (packed): land the payload columns with ONE fused
+    gather per column (stacked into a single [6, ...] gather) — no flat
+    scatters at all. Each merged ingress row is a select between its
+    existing entries and its bucket's contiguous segment of the
+    arrival-sorted stream; the stream itself is addressed through the
+    composed permutation (sorted position -> seq-permuted slot ->
+    original slot), so the payload columns are read straight from their
+    original layout and never materialize any intermediate."""
+    N, CI = in_src_c.shape
+    CE = row_perm.shape[1]
+    B = N * CE
+    flat = lambda a: a.reshape(-1)
+    # sorted position -> original flat slot (row-major)
+    g = (o_pos // CE) * CE + flat(row_perm)[o_pos]
+    streams = jnp.stack([
+        (o_pos // CE).astype(jnp.int32),  # src == source row
+        flat(eg_seq)[g], flat(eg_sock)[g], flat(eg_bytes)[g],
+        flat(deliver_rel)[g],
+        jnp.ones((B,), jnp.int32),  # arrivals are valid
+    ])
+    bases = jnp.stack([
+        flat(in_src_c), flat(in_seq_c), flat(in_sock_c), flat(in_bytes_c),
+        flat(jnp.where(in_valid_c, in_deliver_c, I32_MAX)),
+        flat(in_valid_c.astype(jnp.int32)),
+    ])
+    combined = jnp.concatenate([bases, streams], axis=1)  # [6, N*CI + B]
+    ci_col = jnp.arange(CI, dtype=jnp.int32)[None, :]
+    nv = n_valid_in[:, None]
+    append = (ci_col >= nv) & (ci_col < nv + take_n[:, None])
+    # append lane c of row d reads stream slot offsets[d] + (c - nv[d]);
+    # non-append lanes keep the base value (compaction garbage included,
+    # exactly like the reference scatters, which never touch them)
+    stream_idx = jnp.clip(offsets[:, None] + ci_col - nv, 0, B - 1)
+    rows = jnp.arange(N, dtype=jnp.int32)[:, None]
+    idx = jnp.where(append, N * CI + stream_idx, rows * CI + ci_col)
+    merged = combined[:, idx]  # one [6, N, CI] gather
+    return (merged[0], merged[1], merged[2], merged[3], merged[4],
+            merged[5] != 0)
+
+
+def _routing_rank_legacy(sent, eg_dst, eg_seq, eg_bytes, eg_sock,
+                         deliver_rel, n_valid_in, ingress_cap: int):
+    """Section 5a (reference): the pre-diet flat variadic sort — every
+    payload column rides the 4-key comparator network — plus the grouped
+    scatter-append ranks. Kept compiled-in under `packed_sort=False` as
+    the bitwise parity reference for the bucketed path."""
+    N, CE = eg_dst.shape
     host_idx = jnp.arange(N, dtype=jnp.int32)[:, None]
     flat_sent = sent.reshape(-1)
     flat_dst = jnp.where(flat_sent, eg_dst.reshape(-1), N)  # N = "nowhere"
-    flat_deliver = deliver_rel.reshape(-1)
-    flat_src = jnp.broadcast_to(host_idx, (N, CE)).reshape(-1)
-    flat_seq = eg_seq.reshape(-1)
-    flat_bytes = eg_bytes.reshape(-1)
-    flat_sock = eg_sock.reshape(-1)
-
-    if packed_sort:
-        (o_dst, o_deliver, o_src, o_seq, o_bytes, o_sock) = jax.lax.sort(
-            (flat_dst, flat_deliver, flat_src, flat_seq, flat_bytes,
-             flat_sock),
-            dimension=0, is_stable=True, num_keys=4,
-        )
-        o_sent = o_dst < N
-    else:
-        (o_dst, o_deliver, o_src, o_seq, o_bytes, o_sock,
-         o_sent) = jax.lax.sort(
-            (flat_dst, flat_deliver, flat_src, flat_seq, flat_bytes,
-             flat_sock, flat_sent),
-            dimension=0, is_stable=True, num_keys=4,
-        )
+    (o_dst, o_deliver, o_src, o_seq, o_bytes, o_sock,
+     # shadowlint: disable=SL403 -- pre-diet variadic reference path
+     o_sent) = jax.lax.sort(
+        (flat_dst, deliver_rel.reshape(-1),
+         jnp.broadcast_to(host_idx, (N, CE)).reshape(-1),
+         eg_seq.reshape(-1), eg_bytes.reshape(-1), eg_sock.reshape(-1),
+         flat_sent),
+        dimension=0, is_stable=True, num_keys=4,
+    )
     flat_idx, ok, overflowed = _scatter_append(o_dst, o_sent, n_valid_in,
-                                               CI, N)
+                                               ingress_cap, N)
+    return (flat_idx, ok, o_deliver, o_src, o_seq, o_bytes, o_sock,
+            overflowed)
+
+
+def _routing_place_legacy(flat_idx, ok, o_deliver, o_src, o_seq, o_bytes,
+                          o_sock, in_deliver_c, in_src_c, in_seq_c,
+                          in_sock_c, in_bytes_c, in_valid_c):
+    """Section 5b (reference): per-column scatters from the sorted
+    payload of `_routing_rank_legacy`."""
+    N, CI = in_src_c.shape
 
     def scatter(buf, vals):
         return buf.reshape(-1).at[flat_idx].set(
@@ -909,7 +1076,67 @@ def _route_scatter(sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel,
     # arrivals flip their slot valid
     in_valid_m = scatter(in_valid_c, jnp.ones_like(ok))
     return (in_src_m, in_seq_m, in_sock_m, in_bytes_m, in_deliver_m,
-            in_valid_m, overflowed)
+            in_valid_m)
+
+
+def _route_scatter(sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel,
+                   in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
+                   in_valid_c, n_valid_in, *, packed_sort: bool = True,
+                   kernel: str = "xla"):
+    """Section 5: route sent packets into destination ingress queues,
+    in the deterministic per-destination (deliver, src, seq) insertion
+    order the CPU plane's event queue imposes.
+
+    Three implementations, all bitwise-identical for in-domain inputs
+    (dst in [0, N), the only thing callers produce):
+
+    - `packed_sort=True` (default): BUCKETED counting placement — dst is
+      a bounded key, so the rank computation is one diet flat sort over
+      (bucket, deliver, slot-index) plus binary-searched bucket bounds,
+      and the payload columns land via one fused stacked gather
+      (`_routing_rank` / `_routing_place`). A hypothetical sent packet
+      with an out-of-range dst lands in the not-placeable bucket here;
+      the reference path drops it through an out-of-bounds scatter
+      index instead (same state, the overflow counter may differ for
+      that impossible input).
+    - `packed_sort=False`: the pre-diet flat 4-key variadic sort, the
+      parity-test reference.
+    - `kernel="pallas"`: the rank computation feeds the fused
+      per-destination-tile append kernel (`tpu.pallas_route`) instead of
+      the XLA scatters; interpret mode off-TPU, refused when faults or
+      guards are threaded (window_step enforces this at trace time).
+
+    Returns the merged ingress columns + per-host overflow."""
+    if kernel == "pallas":
+        if not packed_sort:
+            raise ValueError(
+                "kernel='pallas' implements the packed/bucketed ordering "
+                "only; use kernel='xla' for the packed_sort=False parity "
+                "reference")
+        from . import pallas_route
+
+        return pallas_route.route_scatter(
+            sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel,
+            in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
+            in_valid_c, n_valid_in)
+    CI = in_src_c.shape[1]
+    if packed_sort:
+        row_perm, o_pos, offsets, take_n, overflowed = _routing_rank(
+            sent, eg_dst, eg_seq, deliver_rel, n_valid_in, CI)
+        merged = _routing_place(
+            row_perm, o_pos, offsets, take_n, n_valid_in, eg_seq,
+            eg_bytes, eg_sock, deliver_rel, in_deliver_c, in_src_c,
+            in_seq_c, in_sock_c, in_bytes_c, in_valid_c)
+        return (*merged, overflowed)
+    (flat_idx, ok, o_deliver, o_src, o_seq, o_bytes, o_sock,
+     overflowed) = _routing_rank_legacy(
+        sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel, n_valid_in,
+        CI)
+    merged = _routing_place_legacy(
+        flat_idx, ok, o_deliver, o_src, o_seq, o_bytes, o_sock,
+        in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
+        in_valid_c)
+    return (*merged, overflowed)
 
 
 def _release_due(in_deliver_m, in_src_m, in_seq_m, in_sock_m, in_bytes_m,
@@ -937,6 +1164,7 @@ def _release_due(in_deliver_m, in_src_m, in_seq_m, in_sock_m, in_bytes_m,
         d_due, d_valid = take(due), take(in_valid_m)
     else:
         (_, d_t, d_src, d_seq, d_sock, d_bytes, d_due,
+         # shadowlint: disable=SL403 -- pre-diet variadic reference path
          d_valid) = _row_sort(
             is_due, in_deliver_key, in_src_m,
             in_seq_m, in_sock_m, in_bytes_m, due, in_valid_m, keys=4,
@@ -964,6 +1192,7 @@ def _compact_egress(eg_prio, eg_dst, eg_bytes, eg_seq, eg_ctrl, eg_tsend,
                 take(eg_seq), take(eg_ctrl), take(eg_tsend),
                 take(eg_clamp), take(eg_sock), take(eg_valid_left))
     (_, eg_prio_c, eg_dst_c, eg_bytes_c, eg_seq_c, eg_ctrl_c, eg_tsend_c,
+     # shadowlint: disable=SL403 -- pre-diet variadic reference path
      eg_clamp_c, eg_sock_c, eg_valid_c) = _row_sort(
         (~eg_valid_left).astype(jnp.int32), eg_prio_left, eg_dst, eg_bytes,
         eg_seq, eg_ctrl, eg_tsend, eg_clamp, eg_sock, eg_valid_left, keys=2,
@@ -1048,12 +1277,16 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     state stays bitwise-comparable with a loss-enabled run.
 
     `packed_sort` (static) selects the packed-key sort diet for the row
-    sorts (sections 2b, 4, 6) — bitwise-identical ordering, far fewer
-    arrays through the comparator networks; False compiles the original
+    sorts (sections 2b, 4, 5b-AQM, 6) AND the bucketed counting
+    placement for the flat routing stage (section 5, `_routing_rank` /
+    `_routing_place`) — bitwise-identical ordering, far fewer arrays
+    through the comparator networks; False compiles the original
     variadic sorts (the parity-test reference). `kernel` (static) picks
-    the egress-ordering implementation: "xla" (default) or "pallas" — the
-    fused VMEM-resident Pallas kernel (`tpu.pallas_egress`), FIFO-only
-    (requires rr_enabled=False), bitwise-identical to the XLA path.
+    the fused-kernel implementation: "xla" (default) or "pallas" — the
+    fused VMEM-resident Pallas kernels for the egress stage
+    (`tpu.pallas_egress`) and the routing scatter-append
+    (`tpu.pallas_route`), FIFO-only (requires rr_enabled=False),
+    bitwise-identical to the XLA path.
 
     `metrics` (static presence switch) threads the telemetry counters
     (`telemetry/metrics.PlaneMetrics`) through the step: per-host
@@ -1103,6 +1336,12 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
         raise ValueError(
             "plane_kernel='pallas' fuses the FIFO qdisc only; compile "
             "with rr_enabled=False (all-FIFO configs) or use the XLA path")
+    if kernel == "pallas" and not packed_sort:
+        raise ValueError(
+            "plane_kernel='pallas' implements the packed/bucketed "
+            "ordering only; the packed_sort=False parity reference is an "
+            "XLA-path concept — compile with kernel='xla' to measure or "
+            "compare against the legacy variadic sorts")
     if kernel == "pallas" and faults is not None:
         raise ValueError(
             "plane_kernel='pallas' does not fuse the fault plane; compile "
@@ -1216,7 +1455,7 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
      overflowed) = _route_scatter(
         sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel, in_deliver_c,
         in_src_c, in_seq_c, in_sock_c, in_bytes_c, in_valid_c, n_valid_in,
-        packed_sort=packed_sort)
+        packed_sort=packed_sort, kernel=kernel)
     CI = in_src_m.shape[1]
 
     # --- 5b. destination side: release what this window hands the hosts --
@@ -1266,11 +1505,24 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
         due = d_due  # for the n_delivered counter
         # surviving queue = the untouched FIFO suffix, re-front-packed
         keep = valid_s2 & (rstatus == codel.STATUS_QUEUED)
-        (_, in_deliver_new, in_src_new, in_seq_new, in_sock_new,
-         in_bytes_new, in_valid_new) = _row_sort(
-            (~keep).astype(jnp.int32), jnp.where(keep, arr_s, I32_MAX),
-            src_s2, seq_s2, sock_s2, bytes_s2, keep, keys=2,
-        )
+        if packed_sort:
+            # sort-diet form: ONE (validity | arrival) packed key +
+            # permutation (kept arrivals are real times < I32_MAX, so
+            # the pack is exactly the (~keep, key) order)
+            perm_keep = _row_perm_sort(_pack_time_key(keep, arr_s))
+            take_keep = lambda a: jnp.take_along_axis(a, perm_keep, axis=1)
+            in_deliver_new = take_keep(jnp.where(keep, arr_s, I32_MAX))
+            in_src_new, in_seq_new = take_keep(src_s2), take_keep(seq_s2)
+            in_sock_new, in_bytes_new = (take_keep(sock_s2),
+                                         take_keep(bytes_s2))
+            in_valid_new = take_keep(keep)
+        else:
+            (_, in_deliver_new, in_src_new, in_seq_new, in_sock_new,
+             # shadowlint: disable=SL403 -- pre-diet variadic reference
+             in_bytes_new, in_valid_new) = _row_sort(
+                (~keep).astype(jnp.int32), jnp.where(keep, arr_s, I32_MAX),
+                src_s2, seq_s2, sock_s2, bytes_s2, keep, keys=2,
+            )
         rt_out = rt2
     else:
         (delivered, due, in_deliver_new, in_src_new, in_seq_new,

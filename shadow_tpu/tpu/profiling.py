@@ -19,7 +19,13 @@ Sections (superset of the window step's numbered stages):
 - ``token_gate``      — prefix-sum bandwidth gate (2c)
 - ``loss_latency``    — loss draw + latency table gathers (3)
 - ``ingress_compact`` — surviving-ingress compaction sort (4)
-- ``routing_scatter`` — flat routing sort + grouped scatter (5)
+- ``routing_scatter`` — the full routing stage (5): rank + placement
+- ``routing_rank``    — routing sub-section 5a: the bucketed order
+  (row seq-rank + diet flat sort + histogram/prefix offsets; on the
+  legacy path, the variadic flat sort + grouped ranks)
+- ``routing_place``   — routing sub-section 5b: landing the payload
+  columns into the destination ingress rows (the fused gather-scatters;
+  on the legacy path, the per-column scatters)
 - ``release_due``     — due split/presentation sort (5b, direct mode)
 - ``codel_drain``     — the router CoDel/relay micro-step (5b, AQM mode)
 - ``egress_compact``  — leftover-egress compaction sort (6)
@@ -57,9 +63,20 @@ MS = 1_000_000
 #: even though the bench's direct mode never runs it)
 DEFAULT_SECTIONS = (
     "rebase_refill", "rr_tensors", "qdisc_sort", "token_gate",
-    "loss_latency", "ingress_compact", "routing_scatter", "release_due",
-    "codel_drain", "egress_compact", "ingest_rows", "window_step",
-    "window_step_telemetry", "window_step_faults", "window_step_guards",
+    "loss_latency", "ingress_compact", "routing_scatter", "routing_rank",
+    "routing_place", "release_due", "codel_drain", "egress_compact",
+    "ingest_rows", "window_step", "window_step_telemetry",
+    "window_step_faults", "window_step_guards",
+)
+
+#: the cheap per-section subset bench.py records in its JSON `sections`
+#: field (one profiled rep; the window_step_* presence-switch variants
+#: are gated separately in CI and cost full extra compiles, and
+#: rr_tensors/codel_drain never run in the bench's FIFO direct mode)
+BENCH_SECTIONS = (
+    "rebase_refill", "qdisc_sort", "token_gate", "loss_latency",
+    "ingress_compact", "routing_scatter", "routing_rank", "routing_place",
+    "release_due", "egress_compact", "ingest_rows", "window_step",
 )
 
 
@@ -174,8 +191,10 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
     from .plane import (I32_MAX, NO_CLAMP, _compact_egress,
                         _compact_ingress, _egress_order, _loss_latency,
                         _qdisc_keys, _refill_tokens, _release_due,
-                        _route_scatter, _row_sort, _token_gate, ingest_rows,
-                        window_step)
+                        _route_scatter, _routing_place,
+                        _routing_place_legacy, _routing_rank,
+                        _routing_rank_legacy, _row_sort, _token_gate,
+                        ingest_rows, window_step)
 
     from ..faults.plane import neutral_faults as _neutral_faults
     from ..guards.plane import make_guards as _clean_guards
@@ -222,11 +241,37 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
         st, ind, packed_sort=packed_sort))
     (in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c, in_valid_c,
      n_valid_in) = jax.block_until_ready(compact(state, in_deliver))
-    route = jax.jit(lambda *a: _route_scatter(*a, packed_sort=packed_sort))
+    route = jax.jit(lambda *a: _route_scatter(*a, packed_sort=packed_sort,
+                                              kernel=kernel))
     (in_src_m, in_seq_m, in_sock_m, in_bytes_m, in_deliver_m, in_valid_m,
      _ovf) = jax.block_until_ready(route(
         sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel, in_deliver_c,
         in_src_c, in_seq_c, in_sock_c, in_bytes_c, in_valid_c, n_valid_in))
+
+    # the routing sub-sections (5a rank / 5b place) per sort mode; the
+    # place inputs are the rank outputs, precomputed untimed
+    if packed_sort:
+        route_rank = jax.jit(lambda s, d, q, dl, nv: _routing_rank(
+            s, d, q, dl, nv, CI))
+        rank_out = jax.block_until_ready(route_rank(
+            sent, eg_dst, eg_seq, deliver_rel, n_valid_in))
+        route_place = jax.jit(_routing_place)
+        place_args = (*rank_out[:4], n_valid_in, eg_seq, eg_bytes,
+                      eg_sock, deliver_rel, in_deliver_c, in_src_c,
+                      in_seq_c, in_sock_c, in_bytes_c, in_valid_c)
+        rank_args = (sent, eg_dst, eg_seq, deliver_rel, n_valid_in)
+    else:
+        route_rank = jax.jit(lambda s, d, q, b, k, dl, nv:
+                             _routing_rank_legacy(s, d, q, b, k, dl, nv,
+                                                  CI))
+        rank_out = jax.block_until_ready(route_rank(
+            sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel,
+            n_valid_in))
+        route_place = jax.jit(_routing_place_legacy)
+        place_args = (*rank_out[:7], in_deliver_c, in_src_c, in_seq_c,
+                      in_sock_c, in_bytes_c, in_valid_c)
+        rank_args = (sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel,
+                     n_valid_in)
     eg_valid_left = jax.block_until_ready(
         jax.jit(lambda v, s: v & ~s)(eg_valid, sendable))
 
@@ -268,6 +313,8 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
             sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel,
             in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
             in_valid_c, n_valid_in)),
+        "routing_rank": (route_rank, rank_args),
+        "routing_place": (route_place, place_args),
         "release_due": (
             jax.jit(lambda *a: _release_due(
                 *a, window, packed_sort=packed_sort)),

@@ -1,0 +1,39 @@
+"""SL403 fixture: variadic sorts past the sort-diet payload budget."""
+
+import jax
+
+
+def _row_sort(*arrays, keys: int):
+    return jax.lax.sort(arrays, dimension=1, is_stable=True, num_keys=keys)
+
+
+def fat_flat_sort(a, b, c, d, e, f):
+    # 6 operands, 2 keys -> 4 payload: the variadic anti-pattern
+    return jax.lax.sort((a, b, c, d, e, f), dimension=0, is_stable=True,
+                        num_keys=2)
+
+
+def fat_row_sort(a, b, c, d, e, f):
+    # the wrapper counts too: 6 operands, 1 key -> 5 payload
+    return _row_sort(a, b, c, d, e, f, keys=1)
+
+
+def lean_flat_sort(a, b, c, d):
+    # 4 operands, 1 key -> 3 payload: exactly at the budget, clean
+    return jax.lax.sort((a, b, c, d), dimension=0, is_stable=True,
+                        num_keys=1)
+
+
+def suppressed_sort(a, b, c, d, e, f):
+    # shadowlint: disable=SL403 -- legacy parity reference (fixture)
+    return jax.lax.sort((a, b, c, d, e, f), dimension=0, is_stable=True,
+                        num_keys=1)
+
+
+def uncountable_sorts(packed, extras, col, arrays, k):
+    # starred operands / computed key counts are not statically
+    # countable and must be skipped, not guessed at
+    one = jax.lax.sort((packed, *extras, col), dimension=1, num_keys=1)
+    two = jax.lax.sort(arrays, dimension=0, num_keys=1)
+    three = _row_sort(packed, col, keys=k)
+    return one, two, three

@@ -1,0 +1,334 @@
+"""Routing-plane sort diet (bucketed route-scatter + packed flat ingest
++ fused Pallas routing stage).
+
+The PR-2 sort diet left one variadic sort standing: the flat [N*CE]
+4-key routing sort in `_route_scatter`. The bucketed rebuild replaces it
+with counting placement over a diet sort; this file pins what
+tests/test_plane_sortdiet.py's base matrix does not reach:
+
+- the metrics/faults/guards static presence switches thread through the
+  bucketed path with bitwise-identical state AND identical accumulator
+  contents vs the `packed_sort=False` reference (overflow attribution,
+  fault dst-blocking, routed-arrivals conservation);
+- the packed flat `ingest` append (bucketed counting placement) against
+  its 9-array variadic reference, including overflow and guards;
+- the fused Pallas routing kernel (`tpu/pallas_route.py`, interpret
+  mode on CPU) directly against the XLA scatters, with overflow forced;
+- the profiler's routing_rank/routing_place split and the bench
+  `sections` plumbing (tools/compare_runs.py --bench).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from shadow_tpu.faults.plane import FaultArrays  # noqa: E402
+from shadow_tpu.guards.plane import make_guards, summarize  # noqa: E402
+from shadow_tpu.telemetry import make_metrics  # noqa: E402
+from shadow_tpu.tpu import ingest, make_params, make_state  # noqa: E402
+from shadow_tpu.tpu.plane import window_step  # noqa: E402
+
+MS = 1_000_000
+N = 8
+
+
+def busy_world(rr_mix=True, *, ingress_cap=8, seed=7):
+    """The test_plane_sortdiet busy world: starved buckets, real loss,
+    duplicate priorities — every tiebreak path exercised."""
+    rng = np.random.default_rng(seed)
+    lat = rng.integers(1 * MS, 20 * MS, size=(N, N)).astype(np.int32)
+    loss = np.full((N, N), 0.3, np.float32)
+    qrr = (np.arange(N) % 2 == 0) if rr_mix else np.zeros(N, bool)
+    params = make_params(lat, loss, np.full((N,), 80_000, np.int64),
+                         qdisc_rr=qrr, down_bw_bps=np.full((N,), 400_000))
+    state = make_state(N, egress_cap=8, ingress_cap=ingress_cap,
+                       params=params,
+                       initial_tokens=np.asarray(params.tb_cap))
+    b = 48
+    state = ingest(
+        state,
+        jnp.asarray(rng.integers(0, N, b), jnp.int32),
+        jnp.asarray(rng.integers(0, N, b), jnp.int32),
+        jnp.asarray(rng.integers(100, 1500, b), jnp.int32),
+        jnp.asarray(rng.integers(0, 6, b), jnp.int32),
+        jnp.arange(b, dtype=jnp.int32),
+        jnp.asarray(rng.integers(0, 3, b) == 0),
+        sock=jnp.asarray(rng.integers(0, 40, b), jnp.int32),
+    )
+    return state, params
+
+
+def active_faults():
+    """A genuinely-active mask set: two dead/blocked hosts (their queued
+    egress purges, routing toward them drops — the dst-blocking leg),
+    degraded links and bandwidth, some corruption."""
+    lat_mult = np.ones((N, N), np.int32)
+    lat_mult[1, :] = 3
+    return FaultArrays(
+        host_alive=jnp.asarray(np.arange(N) != 2),
+        link_up=jnp.asarray(np.arange(N) != 5),
+        lat_mult=jnp.asarray(lat_mult),
+        bw_div=jnp.asarray(np.where(np.arange(N) == 3, 4, 1)
+                           .astype(np.int32)),
+        corrupt_p=jnp.asarray(np.where(np.arange(N) == 1, 0.5, 0.0)
+                              .astype(np.float32)),
+    )
+
+
+def run_windows(state, params, *, windows=4, extra=None, **kw):
+    """Chain windows; `extra` (metrics/faults/guards pytrees) rides
+    through every step. Returns [(state, delivered, next, extra_out)]."""
+    key = jax.random.key(3)
+    out = []
+    shift = jnp.int32(0)
+    for _ in range(windows):
+        res = window_step(state, params, key, shift, jnp.int32(10 * MS),
+                          **kw, **(extra or {}))
+        if extra and "metrics" in extra:
+            state, delivered, nxt, extra["metrics"] = res
+            extra_out = extra["metrics"]
+        elif extra and "guards" in extra:
+            state, delivered, nxt, extra["guards"] = res
+            extra_out = extra["guards"]
+        else:
+            state, delivered, nxt = res
+            extra_out = None
+        out.append((state, delivered, nxt, extra_out))
+        shift = jnp.int32(10 * MS)
+    return out
+
+
+def assert_runs_equal(a, b, ctx):
+    for w, ((sa, da, na, xa), (sb, db, nb, xb)) in enumerate(zip(a, b)):
+        for la, lb in zip(jax.tree.leaves(sa), jax.tree.leaves(sb)):
+            assert np.array_equal(np.asarray(la), np.asarray(lb)), (ctx, w)
+        for k in da:
+            assert np.array_equal(np.asarray(da[k]),
+                                  np.asarray(db[k])), (ctx, w, k)
+        assert int(na) == int(nb), (ctx, w)
+        if xa is not None or xb is not None:
+            for la, lb in zip(jax.tree.leaves(xa), jax.tree.leaves(xb)):
+                assert np.array_equal(np.asarray(la),
+                                      np.asarray(lb)), (ctx, w, "extra")
+
+
+# -- threading: the presence switches flow through the bucketed path ------
+
+@pytest.mark.parametrize("router_aqm", [False, True])
+def test_bucketed_routing_with_active_faults_matches_reference(router_aqm):
+    """Fault dst-blocking, egress purge, latency/bw degradation and
+    corruption all thread through the bucketed route-scatter unchanged:
+    state, delivered sets, and the n_fault_dropped attribution are
+    bitwise the packed_sort=False reference's."""
+    state, params = busy_world(rr_mix=False)
+    kw = dict(rr_enabled=False, router_aqm=router_aqm,
+              faults=active_faults())
+    packed = run_windows(state, params, packed_sort=True, **kw)
+    ref = run_windows(state, params, packed_sort=False, **kw)
+    assert_runs_equal(packed, ref, ("faults", router_aqm))
+    # the fault plane actually did something (dead test guard)
+    assert int(packed[-1][0].n_fault_dropped.sum()) > 0
+
+
+@pytest.mark.parametrize("router_aqm", [False, True])
+def test_bucketed_routing_with_guards_matches_reference(router_aqm):
+    """The guards' routed-arrivals conservation term (ingress occupancy
+    + arrivals == drops + deliveries + exit occupancy) holds over the
+    bucketed scatter, accumulates identically to the reference, and
+    stays clean on a healthy world."""
+    state, params = busy_world()
+    kw = dict(rr_enabled=True, router_aqm=router_aqm)
+    packed = run_windows(state, params, packed_sort=True,
+                         extra={"guards": make_guards(N)}, **kw)
+    ref = run_windows(state, params, packed_sort=False,
+                      extra={"guards": make_guards(N)}, **kw)
+    assert_runs_equal(packed, ref, ("guards", router_aqm))
+    report = summarize(packed[-1][3])
+    assert report["clean"], report
+
+
+def test_bucketed_routing_with_metrics_matches_reference():
+    """Overflow attribution (drop_ring_full), traffic counters, and the
+    depth high-water marks come out of the bucketed path bit-identical
+    to the reference — with ring overflow actually forced: fat pipes,
+    tiny ingress rings, and everything routed at two hot hosts."""
+    rng = np.random.default_rng(3)
+    lat = np.full((N, N), 2 * MS, np.int32)
+    params = make_params(lat, np.zeros((N, N), np.float32),
+                         np.full((N,), 10_000_000_000, np.int64))
+    state = make_state(N, egress_cap=8, ingress_cap=2, params=params,
+                       initial_tokens=np.asarray(params.tb_cap))
+    b = 64
+    state = ingest(
+        state,
+        jnp.asarray(rng.integers(0, N, b), jnp.int32),
+        jnp.asarray(rng.integers(0, 2, b), jnp.int32),  # hot dsts
+        jnp.full((b,), 200, jnp.int32),
+        jnp.asarray(rng.integers(0, 6, b), jnp.int32),
+        jnp.arange(b, dtype=jnp.int32),
+        jnp.zeros((b,), bool),
+    )
+    kw = dict(rr_enabled=False, no_loss=True)
+    packed = run_windows(state, params, packed_sort=True,
+                         extra={"metrics": make_metrics(N)}, **kw)
+    ref = run_windows(state, params, packed_sort=False,
+                      extra={"metrics": make_metrics(N)}, **kw)
+    assert_runs_equal(packed, ref, ("metrics",))
+    assert int(packed[-1][3].drop_ring_full.sum()) > 0  # overflow seen
+
+
+# -- packed flat ingest ---------------------------------------------------
+
+def test_packed_ingest_matches_variadic():
+    """The bucketed counting-placement ingest == the 9-array 2-key
+    variadic reference: same rings, same overflow, same guard
+    accumulator — including an overflowing batch and duplicate
+    (src, seq) pairs (stability must break ties by batch order)."""
+    state, params = busy_world()
+    rng = np.random.default_rng(11)
+    for b, hi in ((40, N), (200, 3)):  # second batch overflows rows
+        src = jnp.asarray(rng.integers(0, hi, b), jnp.int32)
+        dst = jnp.asarray(rng.integers(0, N, b), jnp.int32)
+        nbytes = jnp.asarray(rng.integers(100, 1500, b), jnp.int32)
+        prio = jnp.asarray(rng.integers(0, 6, b), jnp.int32)
+        seq = jnp.asarray(rng.integers(0, 8, b), jnp.int32)  # dup seqs
+        ctrl = jnp.zeros((b,), bool)
+        valid = jnp.asarray(rng.integers(0, 4, b) > 0)
+        got, g1 = ingest(state, src, dst, nbytes, prio, seq, ctrl,
+                         valid=valid, guards=make_guards(N))
+        ref, g2 = ingest(state, src, dst, nbytes, prio, seq, ctrl,
+                         valid=valid, packed_sort=False,
+                         guards=make_guards(N))
+        for la, lb in zip(jax.tree.leaves(got), jax.tree.leaves(ref)):
+            assert np.array_equal(np.asarray(la), np.asarray(lb)), b
+        for la, lb in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            assert np.array_equal(np.asarray(la), np.asarray(lb)), b
+        assert summarize(g1)["clean"]
+    assert int(got.n_overflow_dropped.sum()) > 0  # the b=200 batch
+
+
+# -- the fused Pallas routing stage ---------------------------------------
+
+def test_pallas_route_matches_xla_scatters_with_overflow():
+    """`pallas_route.route_scatter` (interpret mode on CPU) is bitwise
+    the XLA diet path — merged columns, valid mask, and per-host
+    overflow — on a world whose ingress rows overflow."""
+    from shadow_tpu.tpu import pallas_route
+    from shadow_tpu.tpu.plane import (I32_MAX, _compact_ingress,
+                                      _route_scatter)
+
+    state, params = busy_world(rr_mix=False, ingress_cap=4)
+    rng = np.random.default_rng(0)
+    CE, CI = 8, 4
+    sent = jnp.asarray(rng.integers(0, 2, (N, CE)) == 0)
+    deliver = jnp.asarray(rng.integers(-5 * MS, 15 * MS, (N, CE)),
+                          jnp.int32)
+    # a hot destination so at least one bucket overflows its free slots
+    eg_dst = jnp.asarray(rng.integers(0, 3, (N, CE)), jnp.int32)
+    in_deliver = jnp.where(state.in_valid, state.in_deliver_rel, I32_MAX)
+    compact = _compact_ingress(state, in_deliver, packed_sort=True)
+    (in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c, in_valid_c,
+     n_valid_in) = compact
+    args = (sent, eg_dst, state.eg_seq, state.eg_bytes, state.eg_sock,
+            deliver, in_deliver_c, in_src_c, in_seq_c, in_sock_c,
+            in_bytes_c, in_valid_c, n_valid_in)
+    got = jax.jit(pallas_route.route_scatter)(*args)
+    ref = jax.jit(lambda *a: _route_scatter(*a, packed_sort=True))(*args)
+    for la, lb in zip(got, ref):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))
+    assert int(got[-1].sum()) > 0  # overflow exercised
+
+
+def test_pallas_kernel_refuses_legacy_sort():
+    """kernel='pallas' implements the packed/bucketed ordering only: the
+    contradictory combination with the packed_sort=False parity
+    reference must be refused at trace time (like rr/faults/guards),
+    never silently mislabel a legacy measurement."""
+    state, params = busy_world(rr_mix=False)
+    with pytest.raises(ValueError, match="packed"):
+        window_step(state, params, jax.random.key(0), jnp.int32(0),
+                    jnp.int32(MS), rr_enabled=False, packed_sort=False,
+                    kernel="pallas")
+
+
+# -- profiler split + bench sections plumbing -----------------------------
+
+@pytest.mark.parametrize("packed", [True, False])
+def test_profiler_routing_split_times_both_paths(packed):
+    """routing_rank + routing_place time on both sort modes, and the
+    composed routing_scatter section still exists for before/after
+    tables."""
+    from shadow_tpu.tpu import profiling
+
+    rep = profiling.profile_sections(
+        8, reps=1, rr_enabled=False, packed_sort=packed, n_nodes=4,
+        egress_cap=8, ingress_cap=8,
+        sections=("routing_scatter", "routing_rank", "routing_place"))
+    for name in ("routing_scatter", "routing_rank", "routing_place"):
+        assert rep["sections"][name]["min_ms"] >= 0
+
+
+def test_bench_sections_subset_and_compare_runs_bench_mode(tmp_path,
+                                                           capsys):
+    """BENCH_SECTIONS is a valid section subset, and compare_runs
+    --bench prints headline + per-section deltas for two bench JSONs
+    (one wrapped the way the PR driver wraps them)."""
+    from shadow_tpu.tpu import profiling
+    from tools import compare_runs
+
+    assert set(profiling.BENCH_SECTIONS) <= set(profiling.DEFAULT_SECTIONS)
+
+    before = {"value": 1_000_000.0, "hosts": 1024,
+              "sections": {"routing_scatter": 20.0, "window_step": 30.0}}
+    after = {"value": 2_000_000.0, "hosts": 1024,
+             "sections": {"routing_scatter": 8.0, "window_step": 18.0,
+                          "routing_rank": 5.0}}
+    a = tmp_path / "before.json"
+    b = tmp_path / "after.json"
+    a.write_text(json.dumps({"parsed": before}))  # driver-wrapped form
+    b.write_text(json.dumps(after))
+    assert compare_runs.main(["--bench", str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "2.00x" in out and "routing_scatter" in out
+    assert "2.50x" in out  # 20.0 -> 8.0 section ratio
+
+
+def test_routing_rank_seq_tiebreak_vs_row_position():
+    """The regression the bucketed path must not reintroduce: two
+    same-src packets to the same dst with the same (clamped) deliver
+    time but qdisc order opposite to seq order must land in seq order —
+    the (deliver, src, seq) contract, not (deliver, src, row-position).
+    Compared against the variadic reference on a world built to hit it."""
+    rng = np.random.default_rng(1)
+    lat = np.full((N, N), 2 * MS, np.int32)  # uniform: deliver ties
+    params = make_params(lat, np.zeros((N, N), np.float32),
+                         np.full((N,), 10_000_000, np.int64))
+    state = make_state(N, egress_cap=8, ingress_cap=8, params=params,
+                       initial_tokens=np.asarray(params.tb_cap))
+    b = 32
+    # priorities DESCEND while seqs ascend: the qdisc row order inverts
+    # seq order, and the uniform latency + window clamp makes every
+    # same-(src,dst) pair tie on deliver time
+    state = ingest(
+        state,
+        jnp.asarray(rng.integers(0, N, b), jnp.int32),
+        jnp.asarray(rng.integers(0, N, b), jnp.int32),
+        jnp.full((b,), 200, jnp.int32),
+        jnp.asarray(np.arange(b)[::-1].copy(), jnp.int32),
+        jnp.arange(b, dtype=jnp.int32),
+        jnp.zeros((b,), bool),
+    )
+    kw = dict(rr_enabled=False, no_loss=True)
+    key = jax.random.key(0)
+    step = lambda ps: window_step(state, params, key, jnp.int32(0),
+                                  jnp.int32(10 * MS), packed_sort=ps,
+                                  **kw)
+    got, ref = step(True), step(False)
+    for la, lb in zip(jax.tree.leaves(got[0]), jax.tree.leaves(ref[0])):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))
+    for k in got[1]:
+        assert np.array_equal(np.asarray(got[1][k]), np.asarray(ref[1][k]))

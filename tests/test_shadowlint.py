@@ -288,6 +288,72 @@ def test_sl402_tree_is_clean():
         assert not active, [str(f) for f in active]
 
 
+def test_sl403_variadic_sorts_fire():
+    src, findings = _lint_fixture(
+        "fixture_variadic_sort.py",
+        "shadow_tpu/tpu/fixture_variadic_sort.py")
+    f403 = [f for f in findings if f.rule == "SL403"]
+    active = {f.line for f in f403 if not f.suppressed}
+    assert active == {
+        _line_of(src, "return jax.lax.sort((a, b, c, d, e, f), "
+                      "dimension=0, is_stable=True,"),
+        _line_of(src, "return _row_sort(a, b, c, d, e, f, keys=1)"),
+    }
+    # the suppressed legacy-reference call carries its justification
+    sup = [f for f in f403 if f.suppressed]
+    assert len(sup) == 1
+    assert sup[0].justification == "legacy parity reference (fixture)"
+
+
+def test_sl403_skips_uncountable_and_budget_sorts():
+    """Starred operand tuples, non-tuple operand forwarding, computed
+    key counts, and sorts at the 3-payload budget all stay clean."""
+    src, findings = _lint_fixture(
+        "fixture_variadic_sort.py",
+        "shadow_tpu/tpu/fixture_variadic_sort.py")
+    flagged = {f.line for f in findings if f.rule == "SL403"}
+    for needle in ("return jax.lax.sort((a, b, c, d), dimension=0,",
+                   "one = jax.lax.sort((packed, *extras, col)",
+                   "two = jax.lax.sort(arrays",
+                   "three = _row_sort(packed, col, keys=k)",
+                   # the wrapper's own forwarding call (Name, not tuple)
+                   "return jax.lax.sort(arrays, dimension=1"):
+        assert _line_of(src, needle) not in flagged, needle
+
+
+def test_sl403_scoped_to_tpu():
+    src = ("import jax\n"
+           "def f(a, b, c, d, e):\n"
+           "    return jax.lax.sort((a, b, c, d, e), num_keys=1)\n")
+    assert [f.rule for f in lint_source(src, "shadow_tpu/tpu/x.py")] \
+        == ["SL403"]
+    assert not lint_source(src, "shadow_tpu/core/x.py")
+    assert not lint_source(src, "tools/x.py")
+
+
+def test_sl403_tree_is_clean():
+    """No active variadic-sort finding anywhere in shadow_tpu/tpu/: the
+    hot paths are on the packed-key/bucketed diet, and the compiled-in
+    packed_sort=False parity references carry justified suppressions."""
+    root = os.path.join(os.path.dirname(__file__), "..",
+                        "shadow_tpu", "tpu")
+    suppressed = []
+    for name in sorted(os.listdir(root)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(root, name), encoding="utf-8") as fh:
+            findings = lint_source(fh.read(), f"shadow_tpu/tpu/{name}")
+        active = [f for f in findings
+                  if f.rule == "SL403" and not f.suppressed]
+        assert not active, [str(f) for f in active]
+        suppressed += [f for f in findings
+                       if f.rule == "SL403" and f.suppressed]
+    # the legacy reference paths exist and are justified, not silently
+    # diet-ed away (they ARE the parity baseline)
+    assert len(suppressed) >= 6
+    assert all(f.justification for f in suppressed)
+
+
 def test_clean_fixture_and_sl101_scope():
     _, findings = _lint_fixture(
         "fixture_clean.py", "shadow_tpu/core/fixture_clean.py")
@@ -300,11 +366,12 @@ def test_clean_fixture_and_sl101_scope():
 
 def test_rule_registry_complete():
     assert set(RULES) == {f"SL10{i}" for i in range(1, 6)} | {
-        f"SL20{i}" for i in range(1, 6)} | {"SL301", "SL401", "SL402"}
+        f"SL20{i}" for i in range(1, 6)} | {"SL301", "SL401", "SL402",
+                                            "SL403"}
     for rid in ("SL101", "SL102", "SL103", "SL104", "SL105", "SL301",
-                "SL401", "SL402"):
+                "SL401", "SL402", "SL403"):
         assert rule_applies(rid, "shadow_tpu/core/x.py") \
-            or rid in ("SL105", "SL301", "SL402")
+            or rid in ("SL105", "SL301", "SL402", "SL403")
 
 
 # -- SL401: swallowed broad exceptions ------------------------------------

@@ -17,7 +17,11 @@ Usage:
   python tools/compare_runs.py <config.yaml> --matrix         # vary
       scheduler (serial / thread-per-core / thread-per-host) and
       parallelism (1 / 2 / 4) and require identical artifacts across all
-Exit 0 when all runs match bit-for-bit; 1 otherwise.
+  python tools/compare_runs.py --bench BEFORE.json AFTER.json # diff two
+      bench.py records: headline events/s plus the per-section ms deltas
+      (the `sections` field), so the BENCH_r*.json trajectory shows WHERE
+      time went (docs/performance.md)
+Exit 0 when all runs match bit-for-bit (--bench: always); 1 otherwise.
 """
 
 from __future__ import annotations
@@ -77,16 +81,58 @@ MATRIX = [
 ]
 
 
+def _load_bench(path: str) -> dict:
+    with open(path) as fh:
+        rec = json.load(fh)
+    return rec.get("parsed", rec)  # the PR driver wraps the JSON line
+
+
+def bench_delta(before_path: str, after_path: str) -> int:
+    """Print the headline + per-section deltas between two bench.py JSON
+    records (informational — always exits 0)."""
+    before, after = _load_bench(before_path), _load_bench(after_path)
+    v0, v1 = float(before.get("value", 0)), float(after.get("value", 0))
+    speedup = (v1 / v0) if v0 else float("nan")
+    print(f"events/s: {v0:,.0f} -> {v1:,.0f}  ({speedup:.2f}x)"
+          f"  [hosts {before.get('hosts')} -> {after.get('hosts')}]")
+    s0 = before.get("sections") or {}
+    s1 = after.get("sections") or {}
+    if not (s0 or s1):
+        print("(no `sections` field in either record — re-run bench.py "
+              "without BENCH_SECTIONS=0 to record the breakdown)")
+        return 0
+    names = sorted(set(s0) | set(s1),
+                   key=lambda n: -float(s0.get(n, s1.get(n, 0))))
+    print(f"{'section':<24} {'before ms':>10} {'after ms':>10} {'ratio':>7}")
+    for name in names:
+        a, b = s0.get(name), s1.get(name)
+        ratio = (f"{a / b:.2f}x" if a and b else "-")
+        fmt = lambda x: f"{x:.2f}" if x is not None else "-"
+        print(f"{name:<24} {fmt(a):>10} {fmt(b):>10} {ratio:>7}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("config")
+    ap.add_argument("config", nargs="?")
     ap.add_argument("--runs", type=int, default=None,
                     help="repeat count (incompatible with --matrix)")
     ap.add_argument(
         "--matrix", action="store_true",
         help="vary scheduler and parallelism instead of repeating",
     )
+    ap.add_argument(
+        "--bench", nargs=2, metavar=("BEFORE", "AFTER"), default=None,
+        help="diff two bench.py JSON records (headline + section deltas) "
+             "instead of running the determinism harness",
+    )
     args = ap.parse_args(argv)
+    if args.bench is not None:
+        if args.config or args.matrix or args.runs is not None:
+            ap.error("--bench takes exactly two bench JSONs and no config")
+        return bench_delta(*args.bench)
+    if args.config is None:
+        ap.error("config is required (or use --bench)")
     if args.matrix and args.runs is not None:
         ap.error("--runs and --matrix are mutually exclusive")
 

@@ -2,16 +2,19 @@
 """Profile the device-plane window step per section.
 
 Times every section of `plane.window_step` (qdisc sort, RR tensors,
-loss+latency gathers, routing scatter, ingress compaction, CoDel drain,
-...) as isolated jitted micro-kernels at one or more bench-ladder shapes,
-and emits a JSON cost breakdown. This is the measurement substrate for
+loss+latency gathers, routing scatter — split into its routing_rank /
+routing_place sub-sections — ingress compaction, CoDel drain, ...) as
+isolated jitted micro-kernels at one or more bench-ladder shapes, and
+emits a JSON cost breakdown. This is the measurement substrate for
 every window-step optimization claim: run it with `--legacy-sort` to
 price the pre-diet variadic sorts against the packed-key default.
 
     python tools/profile_plane.py                       # default shapes
     python tools/profile_plane.py --hosts 1024 --reps 5
     python tools/profile_plane.py --legacy-sort -o before.json
-    python tools/profile_plane.py --kernel pallas       # fused egress
+    python tools/profile_plane.py --kernel pallas       # fused kernels
+    python tools/profile_plane.py \
+        --sections routing_scatter,routing_rank,routing_place
 
 See docs/performance.md for the cost model the sections map onto.
 """
