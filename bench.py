@@ -55,17 +55,41 @@ FAULTS = os.environ.get("BENCH_FAULTS", "0") == "1"
 SECTIONS = os.environ.get("BENCH_SECTIONS", "1") == "1"
 TELEMETRY_DIR = os.environ.get("BENCH_TELEMETRY_DIR", "telemetry-bench")
 HARVEST_EVERY = int(os.environ.get("BENCH_HARVEST_EVERY", "32"))
-EGRESS_CAP = 16
-INGRESS_CAP = 32
+EGRESS_CAP = int(os.environ.get("BENCH_EGRESS_CAP", "16"))
+INGRESS_CAP = int(os.environ.get("BENCH_INGRESS_CAP", "32"))
+# BENCH_CAPACITY=elastic|strict drives the capacity policy plane
+# (docs/robustness.md "Elastic capacity"): the run proceeds in chunks of
+# BENCH_GROW_EVERY windows; a chunk with ring-full overflow is DISCARDED,
+# the offending ring doubles (next power of two, bounded by
+# BENCH_MAX_DOUBLINGS), and the chunk re-executes from its start
+# snapshot — so a run started with tiny rings ends bitwise-identical to
+# one pre-provisioned at the final capacity. strict raises CapacityError
+# on the first overflow instead. The JSON records the trajectory.
+CAPACITY_MODE = os.environ.get("BENCH_CAPACITY", "fixed")
+MAX_DOUBLINGS = int(os.environ.get("BENCH_MAX_DOUBLINGS", "4"))
+GROW_EVERY = int(os.environ.get("BENCH_GROW_EVERY", "16"))
 SPAWN_PER_DELIVERY = 1
 
 
-def bench_tpu() -> tuple[float, int, dict | None]:
+def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None]:
     import jax
     import jax.numpy as jnp
 
     from shadow_tpu.tpu import donating_jit, ingest_rows, window_step
     from shadow_tpu.tpu import profiling
+
+    if CAPACITY_MODE not in ("fixed", "strict", "elastic"):
+        raise SystemExit(
+            f"BENCH_CAPACITY={CAPACITY_MODE!r}: expected "
+            f"fixed|strict|elastic")
+    if PLANE_KERNEL == "pallas" and EGRESS_CAP & (EGRESS_CAP - 1):
+        # bench-side twin of the config-time ConfigError: the fused
+        # Pallas egress kernel's bitonic row sort needs a power-of-two
+        # ring (shadow_tpu/tpu/pallas_egress.py) — fail before tracing
+        raise SystemExit(
+            f"BENCH_PLANE_KERNEL=pallas needs a power-of-two "
+            f"BENCH_EGRESS_CAP, got {EGRESS_CAP}; pick a power of two "
+            f"or use the xla kernel")
 
     N, M = N_HOSTS, N_NODES
     # ONE definition of the PHOLD world, shared with the per-section
@@ -88,9 +112,13 @@ def bench_tpu() -> tuple[float, int, dict | None]:
 
         _faults = neutral_faults(N, M)
 
-    def make_round_fn(kernel: str):
+    def make_round_fn(kernel: str, track_overflow: bool = False):
         def round_fn(carry, round_idx):
-            state, spawn_seq, metrics = carry
+            if track_overflow:
+                state, spawn_seq, metrics, eg_acc, in_acc = carry
+            else:
+                state, spawn_seq, metrics = carry
+            state0 = state
             shift = jnp.where(round_idx == 0, jnp.int32(0), window)
             out = window_step(state, params, key, shift, window,
                               rr_enabled=False, kernel=kernel,
@@ -99,13 +127,20 @@ def bench_tpu() -> tuple[float, int, dict | None]:
                 state, delivered, next_ev, metrics = out
             else:
                 state, delivered, next_ev = out
+            if track_overflow:
+                # ingress-ring overflow (the routing stage's drops) —
+                # the elastic capacity driver reads this back per chunk
+                in_acc = in_acc + (state.n_overflow_dropped
+                                   - state0.n_overflow_dropped)
+            state1 = state
             # respawn: each delivered packet triggers one new packet from
             # the receiving host to a hashed destination (deterministic).
             # The delivered arrays are already row-shaped (row =
             # receiving host), so the row-local ingest needs no flat
             # cross-host sort.
             mask, new_dst, nbytes, seq_vals, ctrl = profiling.respawn_batch(
-                delivered, spawn_seq, round_idx, N, CI)
+                delivered, spawn_seq, round_idx, N,
+                state.in_src.shape[1])
             state = ingest_rows(
                 state, new_dst, nbytes,
                 seq_vals,  # priority: reuse seq (FIFO-ish)
@@ -115,8 +150,14 @@ def bench_tpu() -> tuple[float, int, dict | None]:
             )
             if metrics is not None:
                 state, metrics = state
+            if track_overflow:
+                # egress-ring overflow (the respawn append's drops)
+                eg_acc = eg_acc + (state.n_overflow_dropped
+                                   - state1.n_overflow_dropped)
             spawn_seq = spawn_seq + mask.sum(axis=1, dtype=jnp.int32)
-            return (state, spawn_seq, metrics), mask.sum(dtype=jnp.int32)
+            carry = ((state, spawn_seq, metrics, eg_acc, in_acc)
+                     if track_overflow else (state, spawn_seq, metrics))
+            return carry, mask.sum(dtype=jnp.int32)
         return round_fn
 
     # the state pytree is donated: XLA reuses the input buffers for the
@@ -161,6 +202,59 @@ def bench_tpu() -> tuple[float, int, dict | None]:
 
     run_chunk = KernelFallback(PLANE_KERNEL, make_run_chunk)
 
+    # elastic/strict capacity driver (docs/robustness.md "Elastic
+    # capacity"): the run proceeds in GROW_EVERY-window chunks through a
+    # NON-donating jit, so the chunk-start snapshot stays valid and an
+    # overflowing chunk can be discarded and re-executed against grown
+    # rings — the committed stream is bitwise-identical to a run
+    # pre-provisioned at the final capacity. jit retraces once per ring
+    # shape (log2-bounded by the power-of-two growth).
+    def make_elastic_chunk(kernel: str):
+        round_fn = make_round_fn(kernel, track_overflow=True)
+
+        @jax.jit
+        def chunk(state, spawn_seq, round_ids):
+            zeros = jnp.zeros((N,), jnp.int32)
+            (state, spawn_seq, _m, eg, inn), delivered_counts = \
+                jax.lax.scan(round_fn,
+                             (state, spawn_seq, None, zeros, zeros),
+                             round_ids)
+            return state, spawn_seq, eg, inn, delivered_counts.sum()
+        return chunk
+
+    elastic_chunk = (KernelFallback(PLANE_KERNEL, make_elastic_chunk)
+                     if CAPACITY_MODE != "fixed" else None)
+    capacity_info: dict | None = None
+
+    def run_elastic(state):
+        nonlocal capacity_info
+        from shadow_tpu.tpu import elastic
+
+        policy = elastic.RingPolicy(
+            mode=CAPACITY_MODE, max_doublings=MAX_DOUBLINGS,
+            egress_cap=EGRESS_CAP, ingress_cap=INGRESS_CAP,
+            plane="bench")
+        spawn_seq = jnp.full((N,), 10_000, jnp.int32)
+        total = jnp.int32(0)
+        ids = np.arange(ROUNDS, dtype=np.int32)
+        for i in range(0, ROUNDS, GROW_EVERY):
+            rid = jnp.asarray(ids[i:i + GROW_EVERY])
+
+            def attempt(st, _sp=spawn_seq, _rid=rid):
+                st2, sp2, eg, inn, nd = elastic_chunk(st, _sp, _rid)
+                return (st2, sp2, nd), eg, inn
+
+            out, _ = elastic.run_elastic_window(
+                state, attempt, policy, time_ns=i * int(window))
+            state, spawn_seq, nd = out
+            total = total + nd
+        capacity_info = policy.trajectory.as_dict()
+        capacity_info["initial"] = {"egress_cap": EGRESS_CAP,
+                                    "ingress_cap": INGRESS_CAP}
+        capacity_info["final"] = {"egress_cap": policy.egress_cap,
+                                  "ingress_cap": policy.ingress_cap}
+        return state, total
+
     def telemetry_chunks():
         ids = np.arange(ROUNDS, dtype=np.int32)
         return [jnp.asarray(ids[i:i + HARVEST_EVERY])
@@ -182,7 +276,15 @@ def bench_tpu() -> tuple[float, int, dict | None]:
                 harvester.tick(done * int(window), device=metrics)
         return state, total
 
-    driver = run_telemetry if TELEMETRY else run
+    if CAPACITY_MODE != "fixed":
+        if TELEMETRY:
+            raise SystemExit(
+                "BENCH_CAPACITY=elastic/strict and BENCH_TELEMETRY=1 "
+                "are mutually exclusive (each owns the chunk cadence); "
+                "run them separately")
+        driver = run_elastic
+    else:
+        driver = run_telemetry if TELEMETRY else run
 
     # compile
     t0 = time.monotonic()
@@ -228,20 +330,23 @@ def bench_tpu() -> tuple[float, int, dict | None]:
         }
     else:
         t0 = time.monotonic()
-        state_out, ndel = run(state2)
+        state_out, ndel = driver(state2)
         ndel = int(ndel)
         jax.block_until_ready(state_out)
         wall = time.monotonic() - t0
 
     sent = int(np.asarray(state_out.n_sent).sum())
     events = ndel + sent  # send + deliver events, like Shadow's event count
+    active = (elastic_chunk if elastic_chunk is not None
+              else run_chunk if TELEMETRY else run)
     kernel_info = {
         "requested": PLANE_KERNEL,
-        "used": (run_chunk if TELEMETRY else run).kernel,
-        "fell_back": (run_chunk if TELEMETRY else run).fell_back,
+        "used": active.kernel,
+        "fell_back": active.fell_back,
         "faults_threaded": FAULTS,
     }
-    return events / wall, events, telemetry_info, kernel_info
+    return events / wall, events, telemetry_info, kernel_info, \
+        capacity_info
 
 
 def bench_cpu_baseline() -> float:
@@ -377,7 +482,8 @@ def bench_sections(kernel: str) -> dict | None:
 
 
 def main():
-    tpu_rate, events, telemetry_info, kernel_info = bench_tpu()
+    tpu_rate, events, telemetry_info, kernel_info, capacity_info = \
+        bench_tpu()
     # sections are recorded for the default XLA kernel only: a pallas
     # run off-TPU would re-time every section in interpret mode (slow
     # and not the trajectory being tracked)
@@ -394,6 +500,7 @@ def main():
                 "unit": "events/s",
                 "telemetry": telemetry_info,
                 "kernel": kernel_info,
+                "capacity": capacity_info,
                 "vs_baseline": round(tpu_rate / cpu_rate, 2),
                 "vs_compiled": (round(tpu_rate / compiled_rate, 3)
                                 if compiled_rate else None),

@@ -24,16 +24,18 @@ from .core.manager import Manager
 # Documented exit codes (docs/robustness.md; asserted in tests/test_cli.py).
 # 1 keeps its historical meaning — the SIMULATION failed (a process missed
 # its expected final state, a mirrored transport diverged, a data dir was
-# refused) — while configuration, watchdog, crash, and guard failures get
-# their own codes so wrappers can tell "fix the config" from "file a bug"
-# from "inspect the emergency checkpoint" from "the simulation failed its
-# own runtime invariants".
+# refused) — while configuration, watchdog, crash, guard, and capacity
+# failures get their own codes so wrappers can tell "fix the config" from
+# "file a bug" from "inspect the emergency checkpoint" from "the simulation
+# failed its own runtime invariants" from "provision bigger rings (or go
+# elastic)".
 EXIT_OK = 0
 EXIT_SIM_FAILURE = 1
 EXIT_CONFIG = 2
 EXIT_WATCHDOG = 3
 EXIT_CRASH = 4
 EXIT_GUARD = 5
+EXIT_CAPACITY = 6
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -129,6 +131,7 @@ def _config_as_dict(config: ConfigOptions) -> dict:
         "telemetry": conv(config.telemetry),
         "faults": conv(config.faults),
         "guards": conv(config.guards),
+        "capacity": conv(config.capacity),
         "strict": config.strict,
         "hosts": {name: conv(h) for name, h in config.hosts.items()},
     }
@@ -178,6 +181,7 @@ def main(argv=None) -> int:
     with open(os.path.join(data_dir, "processed-config.yaml"), "w") as fh:
         yaml.safe_dump(_config_as_dict(config), fh, sort_keys=False)
 
+    from .core.capacity import CapacityError
     from .faults.checkpoint import CheckpointError
     from .faults.watchdog import WatchdogError
     from .guards.report import GuardError
@@ -199,6 +203,20 @@ def main(argv=None) -> int:
         log.error("watchdog abort: %s", e)
         print(f"shadow_tpu: watchdog abort: {e}", file=sys.stderr)
         return EXIT_WATCHDOG
+    except CapacityError as e:
+        # a ring-full overflow under the strict capacity policy: the
+        # run refused to silently diverge from the reference's
+        # unbounded-queue semantics (docs/robustness.md "Elastic
+        # capacity"); blame is in the message
+        log.error("capacity abort: %s", e)
+        print(f"shadow_tpu: capacity abort: {e}", file=sys.stderr)
+        print(
+            "shadow_tpu: raise the ring capacities or run "
+            "capacity.mode: elastic (rings grow on demand, "
+            "bitwise-identical to pre-provisioned)",
+            file=sys.stderr,
+        )
+        return EXIT_CAPACITY
     except GuardError as e:
         # the simulation failed its OWN runtime invariants: the
         # violation report (guards-report.json) is in the data dir, and

@@ -221,6 +221,34 @@ class TelemetryOptions:
     per_host: bool = True
 
 
+@dataclass
+class CapacityOptions:
+    """The `capacity:` config block (docs/robustness.md "Elastic
+    capacity") — the ring-sizing policy for every capacity-bounded
+    ring: the device plane's egress/ingress rings, the transport's
+    per-destination in-flight slots, and the flow engine's segment
+    rings (`core/capacity.py`).
+
+    - `fixed`   — overflow is counted and dropped (today's behavior),
+      with a structured once-per-run capacity event so the drop is
+      never only a log line.
+    - `strict`  — any ring-full overflow raises `CapacityError` with
+      per-host blame (CLI exit code 6): the run refuses to silently
+      diverge from the reference's unbounded-queue semantics.
+    - `elastic` — rings DOUBLE (next power of two, bounded by
+      `max_doublings` per ring dimension) and the window re-executes
+      from the pre-window snapshot, so the final stream is bitwise
+      identical to a run pre-provisioned at the final capacity
+      (docs/determinism.md "Growth is bitwise-invisible").
+
+    Top-level `strict: true` additionally promotes `fixed`-mode ring
+    drops to the strict failure (a strict caller never silently loses
+    packets to simulator capacity)."""
+
+    mode: str = "fixed"  # fixed | strict | elastic
+    max_doublings: int = 3
+
+
 #: valid per-class guard policies (guards/report.py shares this set)
 GUARD_POLICIES = ("off", "warn", "abort", "abort+checkpoint")
 
@@ -368,6 +396,7 @@ class ConfigOptions:
     telemetry: TelemetryOptions = field(default_factory=TelemetryOptions)
     faults: FaultsOptions = field(default_factory=FaultsOptions)
     guards: GuardsOptions = field(default_factory=GuardsOptions)
+    capacity: CapacityOptions = field(default_factory=CapacityOptions)
     host_defaults: HostDefaultOptions = field(default_factory=HostDefaultOptions)
     hosts: dict[str, HostOptions] = field(default_factory=dict)
     # strict mode: unsupported feature combinations that normally
@@ -540,6 +569,9 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
             cfg.faults = _fill_dataclass(FaultsOptions, value, "faults")
         elif key == "guards":
             cfg.guards = _fill_dataclass(GuardsOptions, value, "guards")
+        elif key == "capacity":
+            cfg.capacity = _fill_dataclass(CapacityOptions, value,
+                                           "capacity")
         elif key == "strict":
             if not isinstance(value, bool):
                 raise ConfigError(
@@ -561,6 +593,31 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
         raise ConfigError(
             f"experimental.plane_kernel: expected 'xla' or 'pallas', got "
             f"{cfg.experimental.plane_kernel!r}")
+    for cap_name in ("tpu_egress_cap", "tpu_ingress_cap",
+                     "tpu_compact_cap"):
+        if getattr(cfg.experimental, cap_name) < 1:
+            raise ConfigError(f"experimental.{cap_name} must be >= 1")
+    if cfg.experimental.plane_kernel == "pallas":
+        ce = cfg.experimental.tpu_egress_cap
+        if ce & (ce - 1):
+            # the fused Pallas egress kernel's bitonic row sort needs a
+            # power-of-two egress ring (tpu/pallas_egress.py); failing
+            # HERE beats the opaque trace-time death it used to be.
+            # Elastic growth always targets powers of two, so an
+            # elastic run never grows its way out of pallas eligibility.
+            raise ConfigError(
+                f"experimental.plane_kernel: 'pallas' requires a "
+                f"power-of-two egress capacity (the fused kernel's "
+                f"bitonic row sort), got tpu_egress_cap={ce}; pick a "
+                f"power of two or use plane_kernel: xla")
+    from .capacity import CAPACITY_MODES
+
+    if cfg.capacity.mode not in CAPACITY_MODES:
+        raise ConfigError(
+            f"capacity.mode: expected one of "
+            f"{'|'.join(CAPACITY_MODES)}, got {cfg.capacity.mode!r}")
+    if cfg.capacity.max_doublings < 0:
+        raise ConfigError("capacity.max_doublings must be >= 0")
     # unconditional (not just when enabled): the CLI --telemetry flag can
     # flip `enabled` on AFTER parsing, and a bad interval must fail here
     # as a ConfigError, not mid-run inside the harvester

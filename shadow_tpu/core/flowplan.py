@@ -34,6 +34,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from . import simtime
+from .capacity import CapacityError, CapacityTrajectory
 
 log = logging.getLogger("shadow.flowplan")
 
@@ -221,6 +222,17 @@ def run_flow_simulation(config, routing, stats, *, checkpoint_dir=None,
     rounds = 0
     total_retries = 0
     ring_dirty = False  # a bucket's FINAL run still had ring drops
+    # ring-capacity policy (core/capacity.py): the flow engine's
+    # per-destination segment rings are this path's capacity dimension.
+    # Engine ring drops were ALWAYS re-run with doubled queue_slots
+    # (they are an engine artifact, not modeled wire loss), so fixed
+    # and elastic behave identically here — the policy contributes the
+    # unified trajectory record, the strict failure, and the
+    # max_doublings bound.
+    cap_opts = getattr(config, "capacity", None)
+    max_doublings = cap_opts.max_doublings if cap_opts else 3
+    cap_mode = cap_opts.mode if cap_opts else "fixed"
+    trajectory = CapacityTrajectory(cap_mode)
     fingerprint = _plan_fingerprint(plan)
     done_buckets: set[int] = set()
     if resume_from:
@@ -243,6 +255,7 @@ def run_flow_simulation(config, routing, stats, *, checkpoint_dir=None,
         queue_drops, retransmits = c["queue_drops"], c["retransmits"]
         rounds, total_retries = c["rounds"], c["retries"]
         ring_dirty = bool(c["ring_dirty"])
+        trajectory.events.extend(meta.get("capacity_events", []))
         done_buckets = set(meta["done_buckets"])
         log.info("flow engine: resumed from %s (%d/%d bucket(s) done)",
                  resume_from, len(done_buckets), len(buckets))
@@ -260,6 +273,7 @@ def run_flow_simulation(config, routing, stats, *, checkpoint_dir=None,
                 "kind": "flow",
                 "plan_fingerprint": fingerprint,
                 "done_buckets": sorted(done_buckets),
+                "capacity_events": list(trajectory.events),
                 "counters": {
                     "segments": int(segments),
                     "wire_drops": int(wire_drops),
@@ -300,7 +314,7 @@ def run_flow_simulation(config, routing, stats, *, checkpoint_dir=None,
         # completion times are distorted. Same discipline as step-cap
         # saturation: re-run the bucket from scratch with doubled rings.
         queue_slots = 256
-        for ring_attempt in range(4):
+        for ring_attempt in range(max_doublings + 1):
             world = floweng.make_flow_world(
                 lat, size, start_us=start, loss=loss, seed=plan.seed,
                 server_writes=True, queue_slots=queue_slots,
@@ -312,14 +326,39 @@ def run_flow_simulation(config, routing, stats, *, checkpoint_dir=None,
             res = floweng.flow_results(world)
             if res["queue_drops"] == 0:
                 break
-            if ring_attempt == 3:
+            if cap_mode == "strict":
+                # strict refuses the self-healing re-run too: the
+                # caller claimed the provisioning was right
+                raise CapacityError(
+                    f"flow engine: {int(res['queue_drops'])} "
+                    f"ring-capacity drop(s) in the {window_us} us "
+                    f"bucket under capacity.mode=strict "
+                    f"(queue_slots={queue_slots}); raise the rings or "
+                    f"run capacity.mode=elastic", ring="flow-queue")
+            if ring_attempt == max_doublings:
                 ring_dirty = True
+                ev = trajectory.record_drop(
+                    time_ns=config.general.stop_time, ring="flow-queue",
+                    cap=queue_slots, overflow=int(res["queue_drops"]),
+                    plane="floweng", exhausted=True)
+                ev["bucket_window_us"] = window_us
                 log.warning(
-                    "flow engine: ring drops persist after 3 doublings "
+                    "flow engine: ring drops persist after %d doublings "
                     "(queue_slots=%d); reconciled packets_dropped now "
                     "includes %d engine ring drops alongside wire drops",
-                    queue_slots, res["queue_drops"])
+                    max_doublings, queue_slots, res["queue_drops"])
                 break
+            # the ad-hoc doubled-queue_slots re-run, now ONE policy with
+            # the device planes: a bucket re-run from scratch with
+            # doubled rings IS the elastic snapshot/re-execute (the
+            # snapshot is the bucket's deterministic start), so fixed
+            # and elastic both take it; only the trajectory record and
+            # bounds come from the policy
+            ev = trajectory.record_growth(
+                time_ns=config.general.stop_time, ring="flow-queue",
+                from_cap=queue_slots, to_cap=queue_slots * 2,
+                overflow=int(res["queue_drops"]), plane="floweng")
+            ev["bucket_window_us"] = window_us
             queue_slots *= 2
             log.warning(
                 "flow engine: %d ring-capacity drop(s) in the %d us "
@@ -351,6 +390,16 @@ def run_flow_simulation(config, routing, stats, *, checkpoint_dir=None,
                     "saturation%s", total_retries,
                     " (ring drops persisted in a final run)" if ring_dirty
                     else " (final runs clean)")
+    if ring_dirty and getattr(config, "strict", False):
+        # top-level strict: a final run that still lost packets to
+        # engine ring capacity is a refused silent divergence, not a
+        # warning (same promotion as the transport's ingress drops)
+        raise CapacityError(
+            "flow engine: ring-capacity drops persisted after the "
+            "growth budget (capacity.max_doublings="
+            f"{max_doublings}) under strict: true; raise the rings or "
+            "the budget", ring="flow-queue")
+    stats.capacity_events = list(trajectory.events)
     stats.rounds = rounds
     stats.events_executed = segments
     stats.packets_sent = segments

@@ -50,6 +50,12 @@ class SimStats:
     sim_time_ns: int = 0
     wall_seconds: float = 0.0
     process_failures: list = field(default_factory=list)
+    # capacity-trajectory events (core/capacity.py): every ring
+    # growth/drop/exhaustion the run recorded, across the transport's
+    # in-flight slots and the flow engine's segment rings — the
+    # "metrics minus capacity trajectory" remainder is what the elastic
+    # parity contract compares (docs/robustness.md "Elastic capacity")
+    capacity_events: list = field(default_factory=list)
 
     def as_dict(self) -> dict:
         return {
@@ -60,6 +66,7 @@ class SimStats:
             "sim_time_ns": self.sim_time_ns,
             "wall_seconds": self.wall_seconds,
             "process_failures": list(self.process_failures),
+            "capacity_events": list(self.capacity_events),
         }
 
 
@@ -330,6 +337,15 @@ class Manager:
                 ingress_cap=config.experimental.tpu_ingress_cap,
                 mode=config.experimental.tpu_transport_mode,
                 compact_cap=config.experimental.tpu_compact_cap,
+                capacity_mode=config.capacity.mode,
+                max_doublings=config.capacity.max_doublings,
+                # top-level strict promotes fixed-mode ring drops to the
+                # strict capacity failure: a strict caller never
+                # silently loses packets to simulator capacity
+                capacity_strict=(
+                    config.capacity.mode == "strict"
+                    or (config.strict
+                        and config.capacity.mode == "fixed")),
             )
             self.shared.device_transport = self.transport
             # self-healing: transient device errors retry with backoff
@@ -531,6 +547,13 @@ class Manager:
             t.host.host_id: t.counters.as_dict()
             for t in self.trackers.values()
         } or None
+        drain = getattr(self.transport, "drain_capacity_events", None)
+        if drain is not None:
+            # capacity resize events ride the heartbeat stream (and the
+            # trace as instants) — a run that grew its rings says so in
+            # its own telemetry, not only in a log line
+            for ev in drain():
+                self.harvester.note_event(ev)
         self.harvester.tick(now_ns, device=device, cpu=cpu)
         if self._guard_recon is not None:
             # pair the device snapshot just started with a same-instant
@@ -1249,6 +1272,13 @@ class Manager:
             self.stats.packets_dropped_fault = (
                 self.shared.fault_drop_count
                 + sum(h.fault_packets_dropped for h in self.hosts))
+            # the full capacity trajectory (growths + drops, incl.
+            # anything finalize() just accounted) lands in the final
+            # stats — sim-stats.json carries it verbatim. getattr:
+            # tests stand in phantom transports without the policy.
+            cap = getattr(self.transport, "capacity", None)
+            if cap is not None:
+                self.stats.capacity_events = list(cap.events)
             # shadowlint: disable=SL101 -- wall-clock perf stat only
             self.stats.wall_seconds = _walltime.monotonic() - wall_start
             for writer in self._pcap_writers:

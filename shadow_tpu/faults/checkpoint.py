@@ -229,15 +229,33 @@ def save_plane_checkpoint(path: str, *, state, clock_ns: int,
         "has_faults": faults is not None,
         "has_metrics": metrics is not None,
     }
+    if hasattr(state, "eg_dst") and hasattr(state, "in_src"):
+        # ring dimensions ride the meta so a resumed elastic run knows
+        # the capacity the world had grown to (the arrays carry the
+        # shapes anyway; this makes them inspectable without loading)
+        full_meta["ring_dims"] = {
+            "egress_cap": int(np.asarray(arrays["state.eg_dst"]).shape[1]),
+            "ingress_cap": int(np.asarray(arrays["state.in_src"]).shape[1]),
+        }
     full_meta.update(meta or {})
     return write_checkpoint(path, meta=full_meta, arrays=arrays)
 
 
 def load_plane_checkpoint(path: str, *, state_template,
-                          faults_template=None, metrics_template=None):
+                          faults_template=None, metrics_template=None,
+                          grow_to=None):
     """Restore a `plane` checkpoint. Returns a dict with `state`,
     `clock_ns`, `rng_key` (a rebuilt jax PRNG key), and — when stored
-    and a template is given — `faults` / `metrics`."""
+    and a template is given — `faults` / `metrics`.
+
+    The restored state keeps the ring shapes it was SAVED with (the
+    template only provides pytree structure), so a checkpoint written
+    mid-growth restores the grown world bitwise. `grow_to=(egress_cap,
+    ingress_cap)` additionally repacks the restored state into larger
+    rings via `tpu/elastic.grow_state` — digest-verified state
+    equivalence across the resize is pinned by tests/test_elastic.py —
+    so a CE=32 checkpoint restores cleanly into a CE=64 world
+    (shrinking is refused there, never silent)."""
     import jax
 
     meta, arrays = load_checkpoint(path)
@@ -252,6 +270,10 @@ def load_plane_checkpoint(path: str, *, state_template,
         "rng_key": jax.random.wrap_key_data(
             jax.numpy.asarray(arrays["rng.key_data"])),
     }
+    if grow_to is not None:
+        from ..tpu import elastic
+
+        out["state"] = elastic.grow_state(out["state"], *grow_to)
     if meta.get("has_faults") and faults_template is not None:
         out["faults"] = _unflatten_named("faults", faults_template, arrays)
     if meta.get("has_metrics") and metrics_template is not None:
@@ -312,6 +334,13 @@ def manager_snapshot(manager, now_ns: int, *, reason: str) -> dict:
     if transport is not None:
         import jax
 
+        # the capacity trajectory (ring growths/drops so far) rides
+        # every snapshot — an emergency checkpoint of an
+        # under-provisioned run says so itself (getattr: tests stand
+        # in phantom transports without the policy)
+        cap_summary = getattr(transport, "capacity_summary", None)
+        if cap_summary is not None:
+            meta["capacity"] = cap_summary()
         for name, arr in transport.telemetry_arrays().items():
             arrays[f"transport.{name}"] = np.asarray(jax.device_get(arr))
     return {"meta": meta, "arrays": arrays}

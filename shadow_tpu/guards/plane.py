@@ -47,6 +47,18 @@ The checked invariants (docs/robustness.md "Guard plane"):
 - **ingest conservation** (`GUARD_INGEST_FLOW`): `ingest`/`ingest_rows`
   appends exactly (incoming - overflow) entries per row.
 
+Elastic ring growth (`tpu/elastic.grow_state`, docs/robustness.md
+"Elastic capacity") is invariant-preserving by construction: the
+accumulators are [N]/scalar-shaped (never ring-shaped), growth pads
+rings with front-pack-respecting defaults (invalid lanes, I32_MAX
+sentinels), and every conservation identity here is a masked sum — so
+guards thread unchanged through a resize, and a guards-on elastic run
+must stay as clean as its pre-provisioned twin
+(tests/test_elastic.py pins it). The elastic drivers restore the guard
+accumulator alongside the state snapshot when they discard an
+overflowing window attempt, so re-execution never double-counts a
+window.
+
 This module is dependency-light (jax/numpy only): `tpu/plane.py`
 imports it, never the other way around.
 """

@@ -100,6 +100,15 @@ def write_perfetto_trace(heartbeats: list[dict], path: str, *,
                     "ts": t / 1e3,
                     "args": {k: v for k, v in rec[totals_key].items()},
                 })
+        for ev in rec.get("annotations", ()):
+            # run-lifecycle annotations (capacity-ring growth, ...) as
+            # global trace instants at their own virtual instant
+            events.append({
+                "ph": "i", "pid": 0, "tid": 0, "s": "g",
+                "name": ev.get("kind", "event"),
+                "ts": ev.get("time_ns", t) / 1e3,
+                "args": dict(ev),
+            })
         prev_t = t
 
     series = _host_series(heartbeats)

@@ -100,6 +100,8 @@ class TelemetryHarvester:
         self._slot_capacity = slot_capacity
         self._host_names = host_names
         self._pending = None  # (time_ns, {name: array-ref}, cpu dict)
+        self._events: list[dict] = []  # run-lifecycle events for the
+        # next sim heartbeat line (capacity growth, ...; note_event)
         self._prev_raw: dict[str, np.ndarray] = {}
         self._totals: dict[str, np.ndarray] = {}
         self.heartbeats: list[dict] = []  # retained emitted records
@@ -115,6 +117,17 @@ class TelemetryHarvester:
 
     def due(self, now_ns: int) -> bool:
         return now_ns >= self._next_due
+
+    # -- run-lifecycle events --------------------------------------------
+
+    def note_event(self, record: dict) -> None:
+        """Queue a structured run-lifecycle event (a capacity-ring
+        growth, a kernel fallback, ...) for the NEXT emitted sim
+        heartbeat line (its ``annotations`` field) — and, through it, a
+        trace instant in the Perfetto export. Records must be
+        JSON-serializable and should carry a virtual ``time_ns``; they
+        never touch the hot path (attached at drain time)."""
+        self._events.append(dict(record))
 
     # -- the harvest cycle ----------------------------------------------
 
@@ -191,6 +204,11 @@ class TelemetryHarvester:
         per_host = {k: v for k, v in device.items() if np.ndim(v) == 1}
         scalars = {k: int(v) for k, v in device.items() if np.ndim(v) == 0}
         sim: dict = {"type": "sim", "time_ns": time_ns}
+        if self._events:
+            # resize & co. ride the heartbeat stream once, in order
+            # ("annotations", not "events" — that name is the
+            # PlaneMetrics per-window event counter)
+            sim["annotations"], self._events = self._events, []
         sim.update(scalars)
         if "sort_slots" in scalars and self._slot_capacity and \
                 scalars.get("windows"):

@@ -1,0 +1,236 @@
+"""Elastic ring growth for the device plane: the bitwise repack kernels.
+
+The device half of the capacity policy plane (`core/capacity.py`,
+docs/robustness.md "Elastic capacity"): pure, donation-friendly repack
+functions that migrate a SoA world into larger power-of-two rings —
+every live ring column, every I32_MAX/NO_CLAMP idle sentinel, every
+counter moves bitwise; the new trailing columns carry exactly the
+`make_state` defaults. Growth is therefore invisible to the step
+kernels (docs/determinism.md "Growth is bitwise-invisible"):
+
+- live lanes are front-packed, so they occupy the same columns before
+  and after a grow;
+- every sort in `window_step` is stable with invalid-last keys, so the
+  extra all-invalid columns sort behind the live lanes and never
+  change their order;
+- every consumer masks by validity, so the dead-lane payload
+  ("compaction garbage", `plane._routing_place`) can never feed back
+  into live state.
+
+The one thing growth does NOT preserve is that garbage itself: a run
+grown mid-flight carries different dead-lane payload than a run
+pre-provisioned at the final capacity (each permuted its own history's
+garbage). `canonical_state` normalizes those don't-care lanes to the
+`make_state` defaults so two such runs compare bitwise — the contract
+the elastic parity matrix in tests/test_elastic.py pins is
+``canonical_state(elastic) == canonical_state(pre-provisioned)`` plus
+identical delivered streams, counters, RNG, and metrics.
+
+`run_elastic_window` is the shared driver loop (tools/chaos_smoke.py,
+bench.py, tests): attempt the window, read the per-ring overflow the
+attempt reported, and — under the elastic policy — grow the offending
+dimension and re-execute the window from the pre-window snapshot
+(`jax.jit` retraces per ring shape, so recompiles are bounded at log2
+by the power-of-two growth; the PR-1 recompile harness asserts it).
+
+jax imports are lazy (function-local) so `core/` consumers of the
+re-exported :class:`CapacityError` never pull the device stack.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.capacity import (CAPACITY_MODES, CapacityError,  # noqa: F401
+                             CapacityTrajectory, RingPolicy, next_pow2)
+
+__all__ = [
+    "CAPACITY_MODES", "CapacityError", "CapacityTrajectory", "RingPolicy",
+    "canonical_state", "grow_state", "grow_transport_state", "next_pow2",
+    "ring_dims", "run_elastic_window",
+]
+
+
+def ring_dims(state) -> tuple[int, int]:
+    """(egress_cap, ingress_cap) of a `plane.NetPlaneState`."""
+    return int(state.eg_dst.shape[1]), int(state.in_src.shape[1])
+
+
+def _pad_cols(arr, width: int, fill):
+    """Widen a [N, C] ring to [N, width] with `fill` in the new lanes."""
+    import jax.numpy as jnp
+
+    n, c = arr.shape
+    if width == c:
+        return arr
+    block = jnp.full((n, width - c), fill, arr.dtype)
+    return jnp.concatenate([arr, block], axis=1)
+
+
+def grow_state(state, new_egress_cap: int, new_ingress_cap: int):
+    """Repack a `plane.NetPlaneState` into larger rings, bitwise.
+
+    Pure and donation-friendly (jnp concatenations only — wrap in
+    `tpu.donating_jit` to repack in place on device). Every existing
+    column migrates unchanged; new trailing lanes carry exactly the
+    `make_state` defaults (-1 dst/src, I32_MAX priority/deliver
+    sentinels, NO_CLAMP clamps, zeros elsewhere, invalid), so the next
+    `window_step` sees a state indistinguishable from one that was
+    front-packed at the larger capacity all along. Scalars, RR
+    counters, router state, and the per-host counters pass through
+    untouched. Shrinking is refused — dropping lanes could drop live
+    packets, the exact silent divergence this plane exists to prevent.
+    """
+    from .plane import I32_MAX, NO_CLAMP
+
+    ce, ci = ring_dims(state)
+    if new_egress_cap < ce or new_ingress_cap < ci:
+        raise ValueError(
+            f"grow_state cannot shrink rings: have (CE={ce}, CI={ci}), "
+            f"asked for (CE={new_egress_cap}, CI={new_ingress_cap})")
+    if (new_egress_cap, new_ingress_cap) == (ce, ci):
+        return state
+    return state._replace(
+        eg_dst=_pad_cols(state.eg_dst, new_egress_cap, -1),
+        eg_bytes=_pad_cols(state.eg_bytes, new_egress_cap, 0),
+        eg_prio=_pad_cols(state.eg_prio, new_egress_cap, I32_MAX),
+        eg_seq=_pad_cols(state.eg_seq, new_egress_cap, 0),
+        eg_ctrl=_pad_cols(state.eg_ctrl, new_egress_cap, False),
+        eg_tsend=_pad_cols(state.eg_tsend, new_egress_cap, 0),
+        eg_clamp=_pad_cols(state.eg_clamp, new_egress_cap, NO_CLAMP),
+        eg_sock=_pad_cols(state.eg_sock, new_egress_cap, 0),
+        eg_valid=_pad_cols(state.eg_valid, new_egress_cap, False),
+        in_src=_pad_cols(state.in_src, new_ingress_cap, -1),
+        in_bytes=_pad_cols(state.in_bytes, new_ingress_cap, 0),
+        in_seq=_pad_cols(state.in_seq, new_ingress_cap, 0),
+        in_sock=_pad_cols(state.in_sock, new_ingress_cap, 0),
+        in_deliver_rel=_pad_cols(state.in_deliver_rel, new_ingress_cap,
+                                 I32_MAX),
+        in_valid=_pad_cols(state.in_valid, new_ingress_cap, False),
+    )
+
+
+def grow_transport_state(state, new_ingress_cap: int):
+    """Repack a `transport.TransportState` into larger per-destination
+    in-flight rings. Transport slots are sparse (never compacted) and
+    the ingest kernel fills the LOWEST free columns first, so as long
+    as no packet was ever overflow-dropped the grown state is bitwise
+    identical — including dead-lane payload — to a run pre-provisioned
+    at the larger capacity: lanes < CI carry the identical history,
+    lanes >= CI carry the construction defaults in both."""
+    ci = int(state.in_src.shape[1])
+    if new_ingress_cap < ci:
+        raise ValueError(
+            f"grow_transport_state cannot shrink: have CI={ci}, asked "
+            f"for {new_ingress_cap}")
+    if new_ingress_cap == ci:
+        return state
+    I32_MAX = np.int32(2**31 - 1)
+    return state._replace(
+        in_src=_pad_cols(state.in_src, new_ingress_cap, 0),
+        in_seq=_pad_cols(state.in_seq, new_ingress_cap, 0),
+        in_tag=_pad_cols(state.in_tag, new_ingress_cap, 0),
+        in_deliver=_pad_cols(state.in_deliver, new_ingress_cap, I32_MAX),
+        in_valid=_pad_cols(state.in_valid, new_ingress_cap, False),
+    )
+
+
+def canonical_state(state):
+    """Normalize a `NetPlaneState`'s dead lanes to `make_state`
+    defaults, leaving live lanes and every scalar/counter untouched.
+
+    Dead-lane payload is outside the determinism contract (every
+    consumer masks by validity; the stable sorts only shuffle it), and
+    it is the ONE thing a mid-run grow cannot reproduce bitwise — so
+    the elastic-vs-pre-provisioned parity gate compares canonical
+    states. Two runs whose canonical states AND delivered streams match
+    are behaviorally identical forever after (live content determines
+    every future output)."""
+    import jax.numpy as jnp
+
+    from .plane import I32_MAX, NO_CLAMP
+
+    ev, iv = state.eg_valid, state.in_valid
+    w = lambda mask, arr, fill: jnp.where(
+        mask, arr, jnp.asarray(fill, dtype=arr.dtype))
+    return state._replace(
+        eg_dst=w(ev, state.eg_dst, -1),
+        eg_bytes=w(ev, state.eg_bytes, 0),
+        eg_prio=w(ev, state.eg_prio, I32_MAX),
+        eg_seq=w(ev, state.eg_seq, 0),
+        eg_ctrl=state.eg_ctrl & ev,
+        eg_tsend=w(ev, state.eg_tsend, 0),
+        eg_clamp=w(ev, state.eg_clamp, NO_CLAMP),
+        eg_sock=w(ev, state.eg_sock, 0),
+        in_src=w(iv, state.in_src, -1),
+        in_bytes=w(iv, state.in_bytes, 0),
+        in_seq=w(iv, state.in_seq, 0),
+        in_sock=w(iv, state.in_sock, 0),
+        in_deliver_rel=w(iv, state.in_deliver_rel, I32_MAX),
+    )
+
+
+def run_elastic_window(state, attempt_fn, policy: RingPolicy, *,
+                       time_ns: int, host_names=None):
+    """One window (or chunk of windows) under the capacity policy.
+
+    `attempt_fn(state)` runs the window against `state` and returns
+    ``(out, eg_overflow, in_overflow)`` where `out` is whatever the
+    driver commits (its first element being the post-window state is
+    conventional but not required here) and the overflow values are
+    per-host [N] arrays (or scalars) of ring-full drops the attempt
+    incurred — egress-ring (ingest-side) and ingress-ring
+    (routing-side) respectively. The attempt must be a pure function of
+    `state` plus snapshots the closure holds (metrics, guards, fault
+    masks, respawn counters): under the elastic policy an overflowing
+    attempt is DISCARDED, the offending ring dimension doubles
+    (`grow_state` on the pre-attempt snapshot), and the window
+    re-executes — so the committed stream is bitwise identical to a
+    run pre-provisioned at the final capacity, and the discarded
+    attempt's drops never happened.
+
+    fixed: commit the attempt; a first drop lands a structured
+    trajectory event. strict: raise :class:`CapacityError` with
+    per-host blame. elastic: grow + re-execute, bounded by the
+    policy's ``max_doublings`` per dimension (exhaustion commits the
+    overflowing attempt, recorded loudly).
+
+    Returns ``(out, state_used)`` — `state_used` is the (possibly
+    grown) pre-window state the committed attempt consumed, which is
+    what drivers must snapshot/checkpoint against."""
+    while True:
+        out, eg_ovf, in_ovf = attempt_fn(state)
+        eg_arr = np.atleast_1d(np.asarray(eg_ovf))
+        in_arr = np.atleast_1d(np.asarray(in_ovf))
+        eg_total, in_total = int(eg_arr.sum()), int(in_arr.sum())
+        if eg_total == 0 and in_total == 0:
+            return out, state
+        if policy.mode == "strict":
+            blame = sorted(set(np.nonzero(eg_arr)[0].tolist())
+                           | set(np.nonzero(in_arr)[0].tolist()))
+            if host_names:
+                blame = [host_names[i] if i < len(host_names) else i
+                         for i in blame]
+            ring = ("egress" if eg_total and not in_total else
+                    "ingress" if in_total and not eg_total else
+                    "egress+ingress")
+            raise CapacityError(
+                f"ring-full overflow under capacity.mode=strict: "
+                f"{eg_total} egress + {in_total} ingress drop(s) in the "
+                f"window at t={time_ns} ns (caps CE={policy.egress_cap}, "
+                f"CI={policy.ingress_cap}); raise the ring capacities or "
+                f"run capacity.mode=elastic", ring=ring, blame=blame)
+        if policy.mode != "elastic":
+            if eg_total:
+                policy.note_drop(ring="egress", overflow=eg_total,
+                                 time_ns=time_ns)
+            if in_total:
+                policy.note_drop(ring="ingress", overflow=in_total,
+                                 time_ns=time_ns)
+            return out, state
+        target = policy.plan_growth(eg_overflow=eg_total,
+                                    in_overflow=in_total,
+                                    time_ns=time_ns)
+        if target is None:  # growth budget exhausted: the drops are real
+            return out, state
+        state = grow_state(state, *target)

@@ -177,7 +177,17 @@ def make_state(n_hosts: int, egress_cap: int = 32, ingress_cap: int = 64,
     """`params` (or an explicit `initial_dn_tokens`) starts the down-bw
     bucket at full capacity like the CPU TokenBucket — REQUIRED for parity
     whenever window_step runs with router_aqm=True (a zero-token start
-    would delay every host's first inbound delivery to the 1 ms refill)."""
+    would delay every host's first inbound delivery to the 1 ms refill).
+
+    `egress_cap`/`ingress_cap` need not be guessed right: under the
+    elastic capacity policy (`capacity: {mode: elastic}` /
+    `tpu/elastic.grow_state`, docs/robustness.md "Elastic capacity")
+    drivers double a ring that overflows and re-execute the window from
+    the pre-window snapshot, bitwise-identical to a run pre-provisioned
+    at the final size. The invalid-lane fills below (-1 dst, I32_MAX
+    priority/deliver sentinels, NO_CLAMP) are the canonical dead-lane
+    values `elastic.grow_state`/`elastic.canonical_state` reproduce —
+    keep the three in sync."""
     if initial_dn_tokens is None and params is not None:
         initial_dn_tokens = np.asarray(params.dn_cap)
     N, CE, CI = n_hosts, egress_cap, ingress_cap

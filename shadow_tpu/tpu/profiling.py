@@ -47,6 +47,12 @@ Sections (superset of the window step's numbered stages):
   CI chaos-smoke against ``window_step`` like telemetry and faults:
   self-verification may never cost the hot path more than the presence
   switches before it.
+- ``window_step_elastic`` — the full step plus the per-ring overflow
+  deltas the elastic capacity driver reads back every window
+  (`tpu/elastic.run_elastic_window`, docs/robustness.md "Elastic
+  capacity"). Gated in CI chaos-smoke against ``window_step`` at the
+  same 1.35x budget: an idle elastic run (nothing overflows) must cost
+  essentially nothing over the plain step.
 
 Drive it from the CLI: ``python tools/profile_plane.py --hosts 1024,32768``.
 """
@@ -66,7 +72,7 @@ DEFAULT_SECTIONS = (
     "loss_latency", "ingress_compact", "routing_scatter", "routing_rank",
     "routing_place", "release_due", "codel_drain", "egress_compact",
     "ingest_rows", "window_step", "window_step_telemetry",
-    "window_step_faults", "window_step_guards",
+    "window_step_faults", "window_step_guards", "window_step_elastic",
 )
 
 #: the cheap per-section subset bench.py records in its JSON `sections`
@@ -168,8 +174,14 @@ def respawn_batch(delivered, spawn_seq, round_idx, n_hosts: int,
     mask = delivered["mask"]
     dst = (delivered["src"] * 40503
            + delivered["seq"] * 1566083941 + round_idx * 97) % n_hosts
-    rank = jnp.broadcast_to(jnp.arange(ingress_cap, dtype=jnp.int32),
-                            (n_hosts, ingress_cap))
+    # seq rank = position among the row's DUE lanes, not the raw column
+    # index: due lanes sit at the row TAIL of the delivered arrays, so a
+    # column-index rank would bake the ring capacity into every respawned
+    # seq — making the PHOLD stream capacity-dependent and breaking the
+    # elastic-growth parity contract (docs/determinism.md "Growth is
+    # bitwise-invisible"). The cumsum rank is identical at any CI.
+    rank = jnp.where(
+        mask, jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1, 0)
     seq = spawn_seq[:, None] + rank
     nbytes = jnp.full((n_hosts, ingress_cap), 1400, jnp.int32)
     ctrl = jnp.zeros((n_hosts, ingress_cap), bool)
@@ -299,6 +311,13 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
         jax.jit(lambda d: respawn_batch(d, spawn_seq, jnp.int32(1), N, CI))(
             deliv))
 
+    def _elastic_probe(st, sh):
+        out = window_step(st, params, rng_root, sh, window,
+                          rr_enabled=rr_enabled, packed_sort=packed_sort,
+                          kernel=kernel)
+        ovf = out[0].n_overflow_dropped - st.n_overflow_dropped
+        return (*out, ovf, ovf.sum())
+
     section_calls = {
         "rebase_refill": (jax.jit(rebase_refill), (state, shift)),
         "rr_tensors": (
@@ -357,6 +376,13 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
                 st, params, rng_root, sh, window, rr_enabled=rr_enabled,
                 packed_sort=packed_sort, kernel="xla", guards=g)),
             (state, _clean_guards(n_hosts), shift)),
+        "window_step_elastic": (
+            # the elastic driver's per-window cost: the step + the
+            # per-ring overflow deltas it reads back to decide growth
+            # (the read-back itself is the same tiny D2H every timed
+            # rep already pays in block_until_ready)
+            jax.jit(lambda st, sh: _elastic_probe(st, sh)),
+            (state, shift)),
     }
 
     out_sections = {}

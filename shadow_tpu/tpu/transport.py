@@ -68,6 +68,8 @@ from typing import NamedTuple, Optional
 
 import numpy as np
 
+from ..core.capacity import CapacityError, CapacityTrajectory, next_pow2
+
 log = logging.getLogger("shadow_tpu.tpu")
 
 I32_MAX = 2**31 - 1
@@ -157,7 +159,9 @@ def make_transport_guard():
 class DeviceTransport:
     def __init__(self, hosts, routing, ip_to_node_id, *,
                  egress_cap: int = 256, ingress_cap: int = 256,
-                 mode: str = "auto", compact_cap: int = 4096):
+                 mode: str = "auto", compact_cap: int = 4096,
+                 capacity_mode: str = "fixed", max_doublings: int = 3,
+                 capacity_strict: bool | None = None):
         import jax
         import jax.numpy as jnp
 
@@ -201,6 +205,27 @@ class DeviceTransport:
         self._ingress_cap = CI
         self._compact_cap = compact_cap
         self._n = n
+        # capacity policy (core/capacity.py, docs/robustness.md "Elastic
+        # capacity"): the per-destination in-flight slots are this
+        # plane's one ring dimension.
+        # - elastic: a host-side occupancy mirror (exact while nothing
+        #   drops — captures and releases are both visible here) grows
+        #   the rings BEFORE an overflowing ingest, so no packet is
+        #   ever dropped and no re-execution is needed: transport
+        #   ingest fills the lowest free columns, so a pad-only grow is
+        #   bitwise-identical to a pre-provisioned run by construction.
+        # - strict: any ingress-capacity drop raises CapacityError with
+        #   per-host blame (CLI exit 6) instead of the old log line.
+        self._capacity_mode = capacity_mode
+        self._capacity_strict = (capacity_strict if capacity_strict
+                                 is not None
+                                 else capacity_mode == "strict")
+        self._max_doublings = max_doublings
+        self._ingress_cap0 = CI
+        self._exhausted_noted = False
+        self.capacity = CapacityTrajectory(capacity_mode)
+        self._cap_drained = 0  # drain_capacity_events cursor
+        self._occ = np.zeros(n, np.int64)  # per-dest device occupancy
         # guard plane (docs/robustness.md): enable_guards() threads a
         # TransportGuard scalar pytree through every kernel dispatch
         # (static presence switch — disabled compiles the checks out)
@@ -567,6 +592,88 @@ class DeviceTransport:
         self._latency = jnp.asarray(degraded.astype(np.int32))
         self._build_kernels(self._n, self._ingress_cap, self._compact_cap)
 
+    # -- capacity policy (docs/robustness.md "Elastic capacity") ---------
+
+    def drain_capacity_events(self) -> list[dict]:
+        """Capacity-trajectory events recorded since the last drain —
+        the Manager feeds these into telemetry heartbeats (and trace
+        instants) at harvest boundaries."""
+        events = self.capacity.events[self._cap_drained:]
+        self._cap_drained = len(self.capacity.events)
+        return list(events)
+
+    def capacity_summary(self) -> dict:
+        """The run's capacity record for sim-stats / snapshots."""
+        out = self.capacity.as_dict()
+        out["ingress_cap"] = self._ingress_cap
+        out["ingress_cap_initial"] = self._ingress_cap0
+        return out
+
+    def _maybe_grow_for(self, batch, time_ns: int) -> None:
+        """Elastic mode, called BEFORE an ingest dispatch: if this
+        capture batch would overflow any destination's in-flight ring,
+        grow the rings first (next power of two covering the need,
+        bounded by max_doublings) so nothing is ever dropped. The
+        occupancy mirror then absorbs the batch."""
+        if self._capacity_mode != "elastic" or not batch:
+            return
+        counts = np.bincount(
+            np.asarray([row[1] for row in batch], np.int64),
+            minlength=self._n)
+        need_per = self._occ + counts
+        need = int(need_per.max())
+        if need > self._ingress_cap:
+            cap_max = self._ingress_cap0 << self._max_doublings
+            new_ci = min(next_pow2(need), cap_max)
+            if new_ci > self._ingress_cap:
+                self._grow_ingress(
+                    new_ci, time_ns=time_ns,
+                    overflow=int(np.maximum(
+                        need_per - self._ingress_cap, 0).sum()))
+            if need > new_ci and not self._exhausted_noted:
+                # growth budget exhausted: the overflow drops become
+                # real (counted by _note_overflow / the device ring).
+                # Once per run, like RingPolicy.note_drop — the
+                # per-drop totals live in the metrics plane.
+                self._exhausted_noted = True
+                self.capacity.record_drop(
+                    time_ns=time_ns, ring="transport-ingress",
+                    cap=new_ci,
+                    overflow=int(np.maximum(need_per - new_ci, 0).sum()),
+                    plane="transport", exhausted=True)
+        # post-ingest device occupancy per dest is min(occ + counts, CI)
+        # — the ingest kernel drops the excess — so the mirror clamps
+        # too; without the clamp, exhausted-budget drops (which never
+        # release) would inflate the mirror forever
+        self._occ = np.minimum(self._occ + counts, self._ingress_cap)
+
+    def _note_released(self, dst_idx: np.ndarray) -> None:
+        """Occupancy-mirror decrement for device-released packets (by
+        destination index). Elastic mode only — the mirror is unused
+        otherwise."""
+        if self._capacity_mode == "elastic" and len(dst_idx):
+            self._occ -= np.bincount(np.asarray(dst_idx, np.int64),
+                                     minlength=self._n)
+
+    def _grow_ingress(self, new_ci: int, *, time_ns: int,
+                      overflow: int) -> None:
+        """Repack the in-flight rings into `new_ci` columns and
+        recompile the kernels against the new shape. Mirrored mode
+        flushes its record batch FIRST (like apply_fault_latency) so no
+        dispatched window ever mixes ring shapes; recompiles are
+        bounded at log2 by the power-of-two growth."""
+        from . import elastic
+
+        if self.mirrored and self._records:
+            self._flush_mirrored()
+        self.capacity.record_growth(
+            time_ns=time_ns, ring="transport-ingress",
+            from_cap=self._ingress_cap, to_cap=new_ci, overflow=overflow,
+            plane="transport")
+        self.state = elastic.grow_transport_state(self.state, new_ci)
+        self._ingress_cap = new_ci
+        self._build_kernels(self._n, new_ci, self._compact_cap)
+
     # -- capture (called from Worker.send_packet, any worker thread) -----
 
     def capture(self, src_host, dst_host, packet, now_ns: int, seq: int,
@@ -602,6 +709,11 @@ class DeviceTransport:
 
     def finish_round(self, start_ns: int, end_ns: int) -> None:
         if self.mirrored:
+            # elastic capacity: grow BEFORE this round's captures are
+            # recorded, so the batched replay never overflows a ring
+            # (the flush inside _grow_ingress dispatches only the
+            # already-recorded windows, which predate this batch)
+            self._maybe_grow_for(self._pending, start_ns)
             rec, self._open_record = self._open_record, None
             if rec is not None:
                 self._records.append((*rec, self._pending))
@@ -622,6 +734,10 @@ class DeviceTransport:
         jnp = self._jnp
         batch = self._pending
         self._pending = []
+        # elastic capacity: grow the in-flight rings before an ingest
+        # that would overflow them — nothing is ever dropped, and the
+        # pad-only grow is bitwise-identical to a pre-provisioned run
+        self._maybe_grow_for(batch, start_ns)
         b = len(batch)
         pad = self._batch_pad
         while pad < b:
@@ -730,6 +846,7 @@ class DeviceTransport:
             # the release twin of the capture ledger: one count per
             # device-released packet, by destination host-id
             np.add.at(self._led_released, dst, 1)
+            self._note_released(dst)
             hosts = self.hosts
             pool = self._pool
             free = self._free
@@ -782,7 +899,12 @@ class DeviceTransport:
                 self._records.append((last, last, [], []))
                 if len(self._records) >= self._k:
                     self._flush_mirrored()
-        self._open_record = (start_ns, end_ns, self._pop_expected(end_ns))
+        expected = self._pop_expected(end_ns)
+        # occupancy mirror: these deliveries will release their device
+        # slots when this window's record replays (step runs before the
+        # ingest in the batched scan body, matching this call order)
+        self._note_released([e[2] for e in expected])
+        self._open_record = (start_ns, end_ns, expected)
 
     def _flush_mirrored(self) -> None:
         """Dispatch one batched verify for the accumulated records."""
@@ -942,11 +1064,38 @@ class DeviceTransport:
     def _note_overflow(self, total_overflow: int) -> None:
         if total_overflow <= self._overflow_seen:
             return
+        delta = total_overflow - self._overflow_seen
         log.error(
             "device transport dropped %d packets to ingress-capacity "
-            "overflow — raise experimental.tpu_ingress_cap",
-            total_overflow - self._overflow_seen,
+            "overflow — raise experimental.tpu_ingress_cap or run "
+            "capacity.mode=elastic",
+            delta,
         )
+        if self._capacity_strict:
+            # the capacity policy's strict promotion (docs/robustness.md
+            # "Elastic capacity"): a strict run refuses to silently
+            # diverge from the reference's unbounded-queue semantics.
+            # Blame comes from the per-host device overflow counters —
+            # one tiny blocking pull on a path that is already fatal.
+            overflow = np.asarray(
+                self._jax.device_get(self.state.n_overflow), np.int64)
+            blame = [self.hosts[i].name
+                     for i in np.nonzero(overflow > 0)[0]]
+            raise CapacityError(
+                f"device transport dropped {delta} packet(s) to "
+                f"ingress-capacity overflow under the strict capacity "
+                f"policy (tpu_ingress_cap={self._ingress_cap}); raise "
+                f"the cap or run capacity.mode=elastic",
+                ring="transport-ingress", blame=blame)
+        # structured once-per-run accounting: the first drop lands a
+        # capacity-trajectory event (surfaced in sim-stats.json and
+        # telemetry heartbeats), not only the log line above
+        if not any(e["ring"] == "transport-ingress"
+                   and e["kind"] != "capacity-growth"
+                   for e in self.capacity.events):
+            self.capacity.record_drop(
+                time_ns=self._prev_start or 0, ring="transport-ingress",
+                cap=self._ingress_cap, overflow=delta, plane="transport")
         self._overflow_seen = total_overflow
         if self.mirrored:
             # CPU-side delivery is authoritative in mirrored mode: a

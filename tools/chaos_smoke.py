@@ -29,6 +29,15 @@ summary, a clean fault-injected run must report zero violations, and
 under `abort` any violation exits with the CLI guard code (5).
 `--tamper-at W` deliberately corrupts the device state after window W
 (a phantom ring slot) — the guards-catch-it proof CI runs.
+
+`--capacity elastic` (with `--egress-cap/--ingress-cap/--max-doublings`)
+drives the elastic capacity plane (docs/robustness.md "Elastic
+capacity"): each window snapshots the pre-window state, and on
+ring-full overflow the offending ring doubles and the window
+RE-EXECUTES from the snapshot — the JSON gains the capacity trajectory,
+`drops.ring_full` must be ZERO, and `canonical_digest` must equal a
+run pre-provisioned at the final capacity (the CI proof). `--capacity
+strict` exits with the CLI capacity code (6) on the first overflow.
 """
 
 from __future__ import annotations
@@ -106,6 +115,15 @@ def main(argv=None) -> int:
     ap.add_argument("--tamper-at", type=int, default=None,
                     help="corrupt the device state after this window "
                          "(a phantom ring slot) — guards must catch it")
+    ap.add_argument("--capacity", choices=["fixed", "strict", "elastic"],
+                    default="fixed",
+                    help="ring-capacity policy (docs/robustness.md "
+                         "'Elastic capacity'): elastic grows + "
+                         "re-executes overflowing windows; strict exits "
+                         "6 on the first ring-full drop")
+    ap.add_argument("--egress-cap", type=int, default=16)
+    ap.add_argument("--ingress-cap", type=int, default=32)
+    ap.add_argument("--max-doublings", type=int, default=4)
     args = ap.parse_args(argv)
 
     import jax
@@ -116,24 +134,39 @@ def main(argv=None) -> int:
     from shadow_tpu.guards import make_guards, summarize
     from shadow_tpu.guards.plane import GuardState
     from shadow_tpu.telemetry import make_metrics
-    from shadow_tpu.tpu import ingest_rows, profiling
+    from shadow_tpu.tpu import elastic, ingest_rows, profiling
+    from shadow_tpu.tpu.elastic import CapacityError
     from shadow_tpu.tpu.plane import window_step
 
     EXIT_GUARD = 5  # shadow_tpu.cli.EXIT_GUARD (docs/robustness.md)
+    EXIT_CAPACITY = 6  # shadow_tpu.cli.EXIT_CAPACITY
 
     N, R = args.hosts, args.windows
-    world = profiling.build_world(N, warmup_windows=0)
+    world = profiling.build_world(N, warmup_windows=0,
+                                  egress_cap=args.egress_cap,
+                                  ingress_cap=args.ingress_cap)
     window = world["window"]
     window_ns = int(window)
-    CI = world["ingress_cap"]
     schedule = (None if args.no_faults
                 else default_schedule(N, R, window_ns))
     use_guards = args.guards != "off"
+    policy = None
+    if args.capacity != "fixed":
+        policy = elastic.RingPolicy(
+            mode=args.capacity, max_doublings=args.max_doublings,
+            egress_cap=args.egress_cap, ingress_cap=args.ingress_cap,
+            plane="chaos_smoke")
 
     def build_step(kernel: str):
         @jax.jit
         def step(state, metrics, faults, guards, spawn_seq, shift,
                  round_idx):
+            # ring shapes come from the state itself (trace-time), so
+            # elastic growth retraces this step per ring size — bounded
+            # at log2 by the power-of-two growth, asserted in CI via
+            # the jit cache size (the PR-1 recompile discipline)
+            ci = state.in_src.shape[1]
+            state0 = state
             out = window_step(state, world["params"], world["rng_root"],
                               shift, window, rr_enabled=False,
                               kernel=kernel, faults=faults,
@@ -142,8 +175,11 @@ def main(argv=None) -> int:
                 state, delivered, _next, metrics, guards = out
             else:
                 state, delivered, _next, metrics = out
+            # ingress-ring overflow: the routing stage's ring-full drops
+            in_ovf = state.n_overflow_dropped - state0.n_overflow_dropped
+            state1 = state
             mask, dst, nbytes, seq, ctrl = profiling.respawn_batch(
-                delivered, spawn_seq, round_idx, N, CI)
+                delivered, spawn_seq, round_idx, N, ci)
             # dead/flapped hosts generate no respawn traffic
             mask = mask & (faults.host_alive & faults.link_up)[:, None]
             out = ingest_rows(
@@ -153,8 +189,11 @@ def main(argv=None) -> int:
                 state, metrics, guards = out
             else:
                 state, metrics = out
-            return state, metrics, guards, spawn_seq + mask.sum(
-                axis=1, dtype=jnp.int32)
+            # egress-ring overflow: the respawn append's ring-full drops
+            eg_ovf = state.n_overflow_dropped - state1.n_overflow_dropped
+            return (state, metrics, guards,
+                    spawn_seq + mask.sum(axis=1, dtype=jnp.int32),
+                    eg_ovf, in_ovf)
         return step
 
     driver = KernelFallback(args.kernel, build_step)
@@ -177,6 +216,12 @@ def main(argv=None) -> int:
                 f: jnp.asarray(restored["extra"][f"guards.{f}"])
                 for f in GuardState._fields})
         start_w = int(restored["meta"]["window_index"])
+        if policy is not None and "capacity" in restored["meta"]:
+            # the growth history rides the checkpoint: a resumed
+            # elastic run continues from the grown capacity (the state
+            # arrays already restored at their grown shapes) with the
+            # same remaining growth budget, drop dedup, and trajectory
+            policy.restore_meta(restored["meta"]["capacity"])
         got = state_digest(state, spawn_seq)
         want = restored["meta"].get("state_digest")
         if want and got != want:
@@ -199,9 +244,36 @@ def main(argv=None) -> int:
         else:
             faults = neutral_faults(N, 64)
         shift = jnp.int32(0 if wdx == 0 else window_ns)
-        state, metrics, guards, spawn_seq = driver(
-            state, metrics, faults, guards, spawn_seq, shift,
-            jnp.int32(wdx))
+        if policy is None:
+            state, metrics, guards, spawn_seq, _eg, _in = driver(
+                state, metrics, faults, guards, spawn_seq, shift,
+                jnp.int32(wdx))
+        else:
+            # capacity policy: the attempt is a pure function of the
+            # (possibly grown) pre-window state plus the snapshots this
+            # closure holds — an overflowing attempt is discarded and
+            # re-executed after growth (elastic), or aborts (strict)
+            def attempt(st, _m=metrics, _f=faults, _g=guards,
+                        _sp=spawn_seq, _sh=shift, _w=wdx):
+                st2, m2, g2, sp2, eg, inn = driver(
+                    st, _m, _f, _g, _sp, _sh, jnp.int32(_w))
+                return (st2, m2, g2, sp2), eg, inn
+
+            try:
+                out, _ = elastic.run_elastic_window(
+                    state, attempt, policy, time_ns=now_ns)
+            except CapacityError as e:
+                print(f"chaos_smoke: capacity abort: {e}",
+                      file=sys.stderr)
+                print(json.dumps({
+                    "capacity_error": str(e),
+                    "mode": policy.mode,
+                    "window": wdx,
+                    "egress_cap": policy.egress_cap,
+                    "ingress_cap": policy.ingress_cap,
+                }))
+                return EXIT_CAPACITY
+            state, metrics, guards, spawn_seq = out
         if args.tamper_at is not None and wdx + 1 == args.tamper_at:
             # deliberate corruption: a phantom valid slot at the back
             # of one ingress ring (carrying the idle sentinel) — the
@@ -209,7 +281,8 @@ def main(argv=None) -> int:
             print(f"chaos_smoke: tampering with the device state at "
                   f"window {wdx + 1}", file=sys.stderr)
             state = state._replace(
-                in_valid=state.in_valid.at[1, CI - 1].set(True))
+                in_valid=state.in_valid.at[
+                    1, state.in_src.shape[1] - 1].set(True))
         if args.checkpoint_dir and args.checkpoint_every \
                 and (wdx + 1) % args.checkpoint_every == 0 and wdx + 1 < R:
             path = os.path.join(args.checkpoint_dir,
@@ -220,13 +293,15 @@ def main(argv=None) -> int:
                 # resumed run reports the same violation history
                 extra.update({f"guards.{f}": getattr(guards, f)
                               for f in GuardState._fields})
+            meta = {"window_index": wdx + 1, "hosts": N,
+                    "state_digest": state_digest(state, spawn_seq)}
+            if policy is not None:
+                meta["capacity"] = policy.to_meta()
             save_plane_checkpoint(
                 path, state=state, clock_ns=now_ns,
                 rng_key_data=jax.random.key_data(world["rng_root"]),
                 faults=faults, metrics=metrics,
-                extra_arrays=extra,
-                meta={"window_index": wdx + 1, "hosts": N,
-                      "state_digest": state_digest(state, spawn_seq)})
+                extra_arrays=extra, meta=meta)
             checkpoints.append(path)
         if args.kill_at is not None and wdx + 1 >= args.kill_at:
             print(f"chaos_smoke: simulating a crash at window {wdx + 1}",
@@ -244,6 +319,16 @@ def main(argv=None) -> int:
         "fell_back": driver.fell_back,
         "faults_active": schedule is not None,
         "state_digest": state_digest(state, spawn_seq),
+        # dead-lane payload differs between a mid-run-grown world and a
+        # pre-provisioned one (each permuted its own history's
+        # compaction garbage); the canonical digest normalizes those
+        # don't-care lanes, so elastic-vs-pre-provisioned parity is
+        # canonical_digest equality (docs/determinism.md "Growth is
+        # bitwise-invisible")
+        "canonical_digest": state_digest(
+            elastic.canonical_state(state), spawn_seq),
+        "egress_cap": int(state.eg_dst.shape[1]),
+        "ingress_cap": int(state.in_src.shape[1]),
         "drops": {
             "ring_full": int(np.asarray(m.drop_ring_full).sum()),
             "qdisc": int(np.asarray(m.drop_qdisc).sum()),
@@ -253,6 +338,22 @@ def main(argv=None) -> int:
         "events": int(np.asarray(m.events)),
         "checkpoints": checkpoints,
     }
+    if policy is not None:
+        # the jit cache size of the step IS the compile count: one
+        # entry per ring shape stepped, so elastic recompiles must stay
+        # within 1 + growth events (the log2 bound CI gates on)
+        jit_step = getattr(driver, "_driver", None)
+        cache_size = getattr(jit_step, "_cache_size", lambda: None)()
+        out["capacity"] = {
+            "mode": policy.mode,
+            "initial": {"egress_cap": args.egress_cap,
+                        "ingress_cap": args.ingress_cap},
+            "final": {"egress_cap": policy.egress_cap,
+                      "ingress_cap": policy.ingress_cap},
+            "growth_events": len(policy.trajectory.growth_events()),
+            "events": list(policy.trajectory.events),
+            "step_recompiles": cache_size,
+        }
     if use_guards:
         gsum = summarize(guards)
         out["guards"] = gsum
