@@ -77,6 +77,7 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None]:
 
     from shadow_tpu.tpu import donating_jit, ingest_rows, window_step
     from shadow_tpu.tpu import profiling
+    from shadow_tpu.workloads.phold import respawn_batch
 
     if CAPACITY_MODE not in ("fixed", "strict", "elastic"):
         raise SystemExit(
@@ -138,7 +139,7 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None]:
             # The delivered arrays are already row-shaped (row =
             # receiving host), so the row-local ingest needs no flat
             # cross-host sort.
-            mask, new_dst, nbytes, seq_vals, ctrl = profiling.respawn_batch(
+            mask, new_dst, nbytes, seq_vals, ctrl = respawn_batch(
                 delivered, spawn_seq, round_idx, N,
                 state.in_src.shape[1])
             state = ingest_rows(

@@ -249,6 +249,33 @@ class CapacityOptions:
     max_doublings: int = 3
 
 
+@dataclass
+class WorkloadOptions:
+    """The `workload:` config block (no reference counterpart — the
+    workload plane, docs/workloads.md): a declarative traffic
+    scenario riding the device plane.
+
+    `scenario` names a standalone scenario YAML (the DSL in
+    `shadow_tpu/workloads/spec.py`); "off" is the explicit-disable
+    sentinel (YAML 1.1 parses a bare ``off`` as boolean False — the
+    same footgun `telemetry.sink` and `strace_logging_mode` already
+    harden against — and a bare ``on`` maps to None, i.e. "enabled,
+    path supplied elsewhere"). The whole block also accepts the bare
+    spellings: ``workload: off`` / ``workload: on``. `seed` overrides
+    the scenario's own seed (and `general.seed`) for the compiled
+    traffic program.
+
+    Manager-driven runs do not execute workload scenarios — the corpus
+    runner consumes this block instead (`tools/run_scenarios.py
+    --config sim.yaml` resolves `scenario` relative to the config file
+    and applies the `seed` override): declaring the block on a Manager
+    run warns loudly, ConfigError under top-level `strict: true`."""
+
+    enabled: bool = False
+    scenario: Optional[str] = None
+    seed: Optional[int] = None
+
+
 #: valid per-class guard policies (guards/report.py shares this set)
 GUARD_POLICIES = ("off", "warn", "abort", "abort+checkpoint")
 
@@ -397,6 +424,7 @@ class ConfigOptions:
     faults: FaultsOptions = field(default_factory=FaultsOptions)
     guards: GuardsOptions = field(default_factory=GuardsOptions)
     capacity: CapacityOptions = field(default_factory=CapacityOptions)
+    workload: WorkloadOptions = field(default_factory=WorkloadOptions)
     host_defaults: HostDefaultOptions = field(default_factory=HostDefaultOptions)
     hosts: dict[str, HostOptions] = field(default_factory=dict)
     # strict mode: unsupported feature combinations that normally
@@ -439,12 +467,11 @@ def _coerce(name: str, value: Any, default: Any) -> Any:
         return units.parse_bits_per_sec(value)
     if name in _BYTE_FIELDS:
         return units.parse_bytes(value)
-    if name in ("sink", "trace"):
-        # telemetry.sink / telemetry.trace: YAML 1.1 parses bare `off`
-        # as False and bare `on` as True (same trap as
-        # strace_logging_mode below). off -> the "off" sentinel the
-        # Manager checks for; on -> None, i.e. "enabled at the default
-        # <data_dir> path".
+    if name in ("sink", "trace", "scenario"):
+        # telemetry.sink / telemetry.trace / workload.scenario: YAML
+        # 1.1 parses bare `off` as False and bare `on` as True (same
+        # trap as strace_logging_mode below). off -> the "off"
+        # sentinel; on -> None, i.e. "enabled at the default path".
         if value is False:
             return "off"
         if value is True:
@@ -572,6 +599,18 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
         elif key == "capacity":
             cfg.capacity = _fill_dataclass(CapacityOptions, value,
                                            "capacity")
+        elif key == "workload":
+            # YAML 1.1 block-level hardening: a bare `workload: off` /
+            # `workload: on` parses as a boolean — coerce to the
+            # disabled/enabled default block instead of dying on
+            # "expected a mapping" (docs/workloads.md)
+            if value is False:
+                cfg.workload = WorkloadOptions(enabled=False)
+            elif value is True:
+                cfg.workload = WorkloadOptions(enabled=True)
+            else:
+                cfg.workload = _fill_dataclass(WorkloadOptions, value,
+                                               "workload")
         elif key == "strict":
             if not isinstance(value, bool):
                 raise ConfigError(
@@ -635,6 +674,8 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
         raise ConfigError("faults.device_retries must be >= 0")
     if cfg.faults.retry_backoff < 0:
         raise ConfigError("faults.retry_backoff must be >= 0")
+    if cfg.workload.seed is not None and cfg.workload.seed < 0:
+        raise ConfigError("workload.seed must be >= 0")
     for cls in ("device", "reconcile", "progress"):
         policy = getattr(cfg.guards, cls)
         if policy not in GUARD_POLICIES:

@@ -188,6 +188,18 @@ class Manager:
                 "fused kernels; the CPU plane runs no window_step) — "
                 "this run proceeds on its default kernels; the flag "
                 "governs bench.py and tools/profile_plane.py only")
+        if config.workload.enabled or config.workload.scenario not in (
+                None, "off"):
+            # the workload plane's generators ride the device-plane
+            # window drivers (tools/run_scenarios.py is the driver);
+            # Manager-driven runs execute managed processes, not
+            # scenario programs — a silently-ignored `workload:` block
+            # would look like a broken feature (docs/workloads.md)
+            self._unsupported_combo(
+                "workload.enabled is not consulted by Manager-driven "
+                "runs: scenario traffic programs run through the "
+                "device-plane drivers (tools/run_scenarios.py) — this "
+                "run proceeds without the declared workload")
         if config.experimental.use_flow_engine:
             # unsupported feature combinations: log-and-ignore by
             # default; `strict: true` promotes each to a ConfigError

@@ -53,6 +53,13 @@ Sections (superset of the window step's numbered stages):
   capacity"). Gated in CI chaos-smoke against ``window_step`` at the
   same 1.35x budget: an idle elastic run (nothing overflows) must cost
   essentially nothing over the plain step.
+- ``window_step_workload`` — the full step plus the workload plane's
+  `workload_step` (`shadow_tpu/workloads/device.py`, an onoff traffic
+  program at the bench shape): phase-pointer advance + table-driven
+  emission + the ingest_rows append, i.e. the per-window cost a
+  scenario driver pays over the bare step. Gated in CI like the
+  other plane sections (ratio vs ``window_step`` <= 1.35,
+  docs/workloads.md).
 
 Drive it from the CLI: ``python tools/profile_plane.py --hosts 1024,32768``.
 """
@@ -62,6 +69,11 @@ from __future__ import annotations
 import time as _walltime
 
 import numpy as np
+
+from ..workloads.phold import respawn_batch  # noqa: F401 — back-compat
+# re-export: PHOLD moved to the workload plane (workloads/phold.py);
+# bench.py / chaos_smoke import the new home, older callers keep
+# finding `profiling.respawn_batch` here.
 
 MS = 1_000_000
 
@@ -73,6 +85,7 @@ DEFAULT_SECTIONS = (
     "routing_place", "release_due", "codel_drain", "egress_compact",
     "ingest_rows", "window_step", "window_step_telemetry",
     "window_step_faults", "window_step_guards", "window_step_elastic",
+    "window_step_workload",
 )
 
 #: the cheap per-section subset bench.py records in its JSON `sections`
@@ -158,34 +171,6 @@ def build_world(n_hosts: int, *, n_nodes: int = 64, egress_cap: int = 16,
         "shift": window, "window": window, "delivered": delivered,
         "egress_cap": egress_cap, "ingress_cap": ingress_cap,
     }
-
-
-def respawn_batch(delivered, spawn_seq, round_idx, n_hosts: int,
-                  ingress_cap: int):
-    """The PHOLD bench's deterministic respawn batch: each delivered
-    packet triggers one new packet from the receiving host to a hashed
-    destination (FIFO-ish priority = seq). ONE definition shared with
-    `bench.py`'s scan body, so the profiler's `ingest_rows` section times
-    exactly the batch the bench feeds it — any workload change there
-    changes this measurement with it. Returns (valid_mask, dst, nbytes,
-    seq, ctrl), all [N, CI]."""
-    import jax.numpy as jnp
-
-    mask = delivered["mask"]
-    dst = (delivered["src"] * 40503
-           + delivered["seq"] * 1566083941 + round_idx * 97) % n_hosts
-    # seq rank = position among the row's DUE lanes, not the raw column
-    # index: due lanes sit at the row TAIL of the delivered arrays, so a
-    # column-index rank would bake the ring capacity into every respawned
-    # seq — making the PHOLD stream capacity-dependent and breaking the
-    # elastic-growth parity contract (docs/determinism.md "Growth is
-    # bitwise-invisible"). The cumsum rank is identical at any CI.
-    rank = jnp.where(
-        mask, jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1, 0)
-    seq = spawn_seq[:, None] + rank
-    nbytes = jnp.full((n_hosts, ingress_cap), 1400, jnp.int32)
-    ctrl = jnp.zeros((n_hosts, ingress_cap), bool)
-    return mask, dst, nbytes, seq, ctrl
 
 
 def profile_sections(n_hosts: int, *, reps: int = 20,
@@ -318,6 +303,35 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
         ovf = out[0].n_overflow_dropped - st.n_overflow_dropped
         return (*out, ovf, ovf.sum())
 
+    def _make_workload_probe():
+        # the workload plane's per-window cost: the step + a
+        # table-driven workload_step (an onoff program over the full
+        # fleet at the bench shape — phase advance, emission gathers,
+        # ingest_rows append). Built only when the section is wanted:
+        # bench.py's BENCH_SECTIONS subset skips it, so bench runs
+        # never pay the program compile.
+        from ..workloads import compile_program, parse_scenario
+        from ..workloads import device as _wdevice
+
+        prog = compile_program(parse_scenario({
+            "name": "profile-onoff", "hosts": n_hosts,
+            "egress_cap": egress_cap, "ingress_cap": ingress_cap,
+            "patterns": [{"kind": "onoff", "burst": 2, "rounds": 4,
+                          "gap_ns": 200_000, "off_mean_ns": 2_000_000}],
+        }))
+        wl = _wdevice.to_device(prog)
+
+        def probe(st, ws, sh):
+            st, delivered, nxt = window_step(
+                st, params, rng_root, sh, window,
+                rr_enabled=rr_enabled, packed_sort=packed_sort,
+                kernel=kernel)
+            st, ws = _wdevice.workload_step(wl, ws, st, delivered,
+                                            jnp.int32(1), window)
+            return st, ws, nxt
+
+        return jax.jit(probe), _wdevice.make_workload_state(prog)
+
     section_calls = {
         "rebase_refill": (jax.jit(rebase_refill), (state, shift)),
         "rr_tensors": (
@@ -384,6 +398,10 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
             jax.jit(lambda st, sh: _elastic_probe(st, sh)),
             (state, shift)),
     }
+    if "window_step_workload" in wanted:
+        _probe, _wstate = _make_workload_probe()
+        section_calls["window_step_workload"] = (
+            _probe, (state, _wstate, shift))
 
     out_sections = {}
     for name in wanted:

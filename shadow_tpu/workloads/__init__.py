@@ -1,0 +1,46 @@
+"""The workload plane: declarative traffic scenarios for the device plane.
+
+Every other plane (telemetry, faults, guards, elastic capacity) exercises
+the network through exactly two traffic sources: the PHOLD respawn loop
+and the tgen flow plan. This subsystem makes *structured* traffic — the
+phase-dependent collective steps, incast bursts, and RPC fan-outs of
+large-model training runs — a first-party, reproducible simulation input:
+
+- `workloads/spec.py`    — the jax-free scenario DSL: seeded pattern
+  instances (ring_allreduce, all_to_all, incast, rpc_fanout, onoff)
+  with validation and a fingerprint that is a pure function of
+  (spec, seed);
+- `workloads/compile.py` — lowers a scenario to SoA "traffic program"
+  arrays: per-(host, phase) dependency counts, hold times, and send
+  tables;
+- `workloads/device.py`  — the batched on-device generator:
+  `workload_step` threads through the window drivers like the PHOLD
+  respawn (bitwise-deterministic, composes with metrics/faults/guards
+  as the same kind of static presence switch);
+- `workloads/phold.py`   — the PHOLD respawn generator (relocated from
+  `tpu/profiling.py`; the profiler is measurement-only again);
+- `workloads/runner.py`  — the corpus runner: executes checked-in
+  scenarios, records canonical digests + per-phase completion virtual
+  times, and diffs against the golden corpus
+  (`tools/run_scenarios.py --check`).
+
+See docs/workloads.md for the DSL reference and determinism contract.
+"""
+
+from .spec import (PATTERN_KINDS, ScenarioError, ScenarioSpec,
+                   load_scenario_file, parse_scenario, scenario_fingerprint)
+from .compile import TrafficProgram, compile_program, program_digest
+from .phold import respawn_batch
+
+__all__ = [
+    "PATTERN_KINDS",
+    "ScenarioError",
+    "ScenarioSpec",
+    "TrafficProgram",
+    "compile_program",
+    "load_scenario_file",
+    "parse_scenario",
+    "program_digest",
+    "respawn_batch",
+    "scenario_fingerprint",
+]

@@ -1,0 +1,209 @@
+"""The batched on-device traffic generator: `workload_step`.
+
+The device half of the workload plane. A compiled traffic program
+(`compile.TrafficProgram`) uploads once as a `WorkloadArrays` pytree;
+per window, `workload_step` threads through the driver loop exactly
+like the PHOLD respawn (`workloads/phold.respawn_batch` in bench.py /
+chaos_smoke): it consumes the window's `delivered` dict, advances
+per-host phase pointers, and emits the next phases' sends via
+`ingest_rows` — fully inside the compiled chain, no host round trips,
+bitwise-deterministic.
+
+Phase semantics (compile.py is the other half of this contract):
+
+- deliveries received this window credit the host's CURRENT phase;
+- a host advances when its phase's dependency count is met AND its
+  hold time has elapsed — at most ``max_advance`` phase advances per
+  window (static; pass-through phases like the incast sink's
+  ack-emission phase consume one each);
+- hold times are quantized to the window cadence (decremented by
+  ``window_ns`` per window) — pacing is deterministic, not
+  ns-exact;
+- ENTERING a phase emits its send table; per-lane ``send_delay``
+  offsets the emission within the entry window (think time, burst
+  gaps), shifting delivery exactly like a late CPU-plane send;
+- the window index at which a host LEAVES each phase records into
+  ``done_win`` (I32_MAX = not yet) — the per-phase completion times
+  the corpus runner reports.
+
+Composition: `metrics` / `guards` thread through the emission's
+`ingest_rows` as the same static presence switches the other planes
+use; `workload=None` in a driver means this module is never called —
+the workloads-off world is bitwise-unchanged by the subsystem's
+presence (pinned in tests/test_workloads.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tpu.plane import I32_MAX, ingest_rows
+from .compile import TrafficProgram
+
+#: default phase-advance budget per window (static): covers every
+#: in-tree pattern's longest same-window cascade (an incast sink's
+#: wait -> ack pass-through -> next wait is 2; rpc's wait -> reply
+#: emission is 2) with headroom for dep=0 chains
+MAX_ADVANCE = 4
+
+
+class WorkloadArrays(NamedTuple):
+    """The uploaded traffic program (read-only on device)."""
+
+    dep: jnp.ndarray  # [N, P] int32
+    hold_ns: jnp.ndarray  # [N, P] int32
+    send_peer: jnp.ndarray  # [N, P, K] int32 (-1 = unused lane)
+    send_bytes: jnp.ndarray  # [N, P, K] int32
+    send_delay: jnp.ndarray  # [N, P, K] int32
+    n_phases: jnp.ndarray  # [N] int32
+
+
+class WorkloadState(NamedTuple):
+    """Mutable per-host generator state, axis 0 = host (sharded with
+    the net-plane state over the mesh)."""
+
+    phase: jnp.ndarray  # [N] int32 current phase (== n_phases: done)
+    recv_acc: jnp.ndarray  # [N] int32 deliveries credited to it
+    hold_left: jnp.ndarray  # [N] int32 ns left in the phase's hold
+    seq: jnp.ndarray  # [N] int32 next send seq (per-source monotone)
+    done_win: jnp.ndarray  # [N, P] int32 window idx the phase was left
+
+
+def to_device(prog: TrafficProgram) -> WorkloadArrays:
+    """Upload the program tables. Copies (jnp.array, not asarray) so a
+    mutated numpy program can never alias device state — the same
+    zero-copy trap the fault schedule hit (faults/plane.py)."""
+    return WorkloadArrays(
+        dep=jnp.array(prog.dep, jnp.int32),
+        hold_ns=jnp.array(prog.hold_ns, jnp.int32),
+        send_peer=jnp.array(prog.send_peer, jnp.int32),
+        send_bytes=jnp.array(prog.send_bytes, jnp.int32),
+        send_delay=jnp.array(prog.send_delay, jnp.int32),
+        n_phases=jnp.array(prog.n_phases, jnp.int32),
+    )
+
+
+def make_workload_state(prog: TrafficProgram) -> WorkloadState:
+    """Initial state: every participant IN phase 0 (its sends go out
+    via `prime`), holds pre-armed from phase 0's table."""
+    N, P = prog.dep.shape
+    return WorkloadState(
+        phase=jnp.zeros((N,), jnp.int32),
+        recv_acc=jnp.zeros((N,), jnp.int32),
+        hold_left=jnp.array(prog.hold_ns[:, 0], jnp.int32),
+        seq=jnp.zeros((N,), jnp.int32),
+        done_win=jnp.full((N, P), I32_MAX, jnp.int32),
+    )
+
+
+def _phase_sends(wl: WorkloadArrays, phase, entered):
+    """[N, K] send lanes of each host's `phase`, masked by `entered`."""
+    idx = jnp.clip(phase, 0, wl.dep.shape[1] - 1)[:, None, None]
+    take = lambda a: jnp.take_along_axis(a, idx, axis=1)[:, 0, :]
+    peer = take(wl.send_peer)
+    valid = entered[:, None] & (peer >= 0)
+    return valid, peer, take(wl.send_bytes), take(wl.send_delay)
+
+
+def _emit(state, ws: WorkloadState, valid, peer, nbytes, delay, *,
+          metrics=None, guards=None):
+    """Append the emission batch to the egress rings with workload
+    seqs assigned in lane order (cumsum rank over valid lanes, the
+    same capacity-independent ranking the PHOLD respawn uses)."""
+    rank = jnp.where(
+        valid, jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
+    seq_vals = ws.seq[:, None] + rank
+    out = ingest_rows(
+        state, peer, nbytes,
+        seq_vals,  # priority: FIFO-ish by emission order
+        seq_vals, jnp.zeros_like(valid), valid=valid,
+        send_rel=delay, metrics=metrics, guards=guards)
+    # ingest_rows returns a bare state when neither presence switch is
+    # threaded, else (state, metrics?, guards?) — normalize (a bare
+    # NetPlaneState is itself a tuple, so test the switches, not the
+    # type)
+    if metrics is None and guards is None:
+        state_out, extras = out, ()
+    else:
+        state_out, extras = out[0], tuple(out[1:])
+    ws = ws._replace(seq=ws.seq + valid.sum(axis=1, dtype=jnp.int32))
+    return state_out, extras, ws
+
+
+def prime(wl: WorkloadArrays, ws: WorkloadState, state, *,
+          metrics=None, guards=None):
+    """Emit every participant's phase-0 sends (drivers call this once
+    before the first window; hosts start IN phase 0). Returns
+    (state', ws'[, metrics'][, guards']) like `workload_step`."""
+    entered = wl.n_phases > 0
+    valid, peer, nbytes, delay = _phase_sends(
+        wl, jnp.zeros_like(ws.phase), entered)
+    state, extras, ws = _emit(state, ws, valid, peer, nbytes, delay,
+                              metrics=metrics, guards=guards)
+    return (state, ws, *extras)
+
+
+def workload_step(wl: WorkloadArrays, ws: WorkloadState, state,
+                  delivered, round_idx, window_ns, *,
+                  max_advance: int = MAX_ADVANCE,
+                  metrics=None, guards=None):
+    """Advance the generator by one window and emit the next sends.
+
+    `delivered` is `window_step`'s released dict for THIS window;
+    every delivery credits the receiving host's current phase (in a
+    scenario world all traffic is workload traffic). `round_idx` is
+    the driver's window counter (stamps `done_win`); `window_ns`
+    decrements the hold clocks. Returns
+    (state', ws'[, metrics'][, guards']) — the same presence-switch
+    return discipline as `ingest_rows`."""
+    N, P = wl.dep.shape
+    got = delivered["mask"].sum(axis=1, dtype=jnp.int32)
+    recv_acc = ws.recv_acc + got
+    hold_left = jnp.maximum(ws.hold_left - jnp.int32(window_ns), 0)
+    phase = ws.phase
+    done_win = ws.done_win
+    col = jnp.arange(P, dtype=jnp.int32)[None, :]
+    lanes = []
+    for _ in range(max_advance):
+        cur = jnp.clip(phase, 0, P - 1)
+        dep_cur = jnp.take_along_axis(wl.dep, cur[:, None],
+                                      axis=1)[:, 0]
+        live = phase < wl.n_phases
+        adv = live & (recv_acc >= dep_cur) & (hold_left == 0)
+        recv_acc = jnp.where(adv, recv_acc - dep_cur, recv_acc)
+        # the window a phase was LEFT: min-scatter via a one-hot
+        # compare (idempotent, no scatter dispatch — shards cleanly)
+        done_win = jnp.minimum(
+            done_win,
+            jnp.where(adv[:, None] & (col == cur[:, None]),
+                      jnp.int32(round_idx), I32_MAX))
+        phase = jnp.where(adv, phase + 1, phase)
+        entered = adv & (phase < wl.n_phases)
+        new = jnp.clip(phase, 0, P - 1)
+        hold_new = jnp.take_along_axis(wl.hold_ns, new[:, None],
+                                       axis=1)[:, 0]
+        hold_left = jnp.where(entered, hold_new, hold_left)
+        lanes.append(_phase_sends(wl, phase, entered))
+    valid = jnp.concatenate([ln[0] for ln in lanes], axis=1)
+    peer = jnp.concatenate([ln[1] for ln in lanes], axis=1)
+    nbytes = jnp.concatenate([ln[2] for ln in lanes], axis=1)
+    delay = jnp.concatenate([ln[3] for ln in lanes], axis=1)
+    ws = ws._replace(phase=phase, recv_acc=recv_acc,
+                     hold_left=hold_left, done_win=done_win)
+    state, extras, ws = _emit(state, ws, valid, peer, nbytes, delay,
+                              metrics=metrics, guards=guards)
+    return (state, ws, *extras)
+
+
+def all_done(wl: WorkloadArrays, ws: WorkloadState):
+    """Scalar bool: every participant reached its terminal phase."""
+    return (ws.phase >= wl.n_phases).all()
+
+
+def completion_windows(ws: WorkloadState) -> np.ndarray:
+    """[N, P] int64 window indices at which each phase was left
+    (I32_MAX where never) — host-side, for the runner's reports."""
+    return np.asarray(ws.done_win).astype(np.int64)

@@ -1,0 +1,361 @@
+"""The scenario corpus runner: execute, digest, and report workloads.
+
+Drives a compiled scenario through the device plane: a deterministic
+scenario world (lossless — the phase machine has no retransmit layer,
+so a lost dependency would stall a collective forever; loss/fault
+behavior is exercised by threading the fault plane instead), the
+window loop composing `window_step` + `workload_step`, and a JSON
+record per scenario carrying:
+
+- the scenario ``fingerprint`` (pure function of (spec, seed)) and
+  ``program_digest`` (the compiled tables);
+- the ``canonical_digest`` of the final world — `elastic.
+  canonical_state`-normalized net-plane state + the full workload
+  state — the golden-corpus comparison key (two runs of one scenario
+  must produce byte-identical records; `tools/run_scenarios.py
+  --check` gates on it);
+- per-phase completion *virtual* times (window-quantized: the end of
+  the window in which the last participant left the phase — for
+  ring_allreduce, the per-step collective completion times) and the
+  per-host completion spread (stragglers);
+- traffic/drop totals from a threaded `PlaneMetrics` (bitwise-
+  invisible to the stream, like every presence switch).
+
+Optional composition, same switches as the other planes: `guards=True`
+threads the runtime invariant plane (a fault-injected scenario must
+finish guards-clean — the CI proof), `fault_events` compiles a
+`faults:`-style schedule, `mesh_devices` runs the whole scenario
+host-axis-sharded (the canonical digest must not change — the
+MULTICHIP parity contract extended to structured workloads), and
+`telemetry` attaches a TelemetryHarvester whose heartbeat
+``annotations`` carry the phase completions.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Optional
+
+import numpy as np
+
+from .compile import TrafficProgram, compile_program, program_digest
+from .spec import ScenarioSpec, scenario_fingerprint
+
+MS = 1_000_000
+
+
+def digest_pytrees(*pytrees) -> str:
+    """sha256 over every leaf's dtype+bytes (the chaos_smoke digest
+    discipline)."""
+    import jax
+
+    h = hashlib.sha256()
+    for tree in pytrees:
+        for leaf in jax.tree.leaves(jax.device_get(tree)):
+            arr = np.asarray(leaf)
+            h.update(str(arr.dtype).encode())
+            h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def build_scenario_world(spec: ScenarioSpec):
+    """Deterministic net-plane world for a scenario: host-pair latency
+    table seeded from the scenario seed, zero loss, 10 Gbit hosts,
+    full initial token buckets. Returns (state, params)."""
+    from ..tpu import make_params, make_state
+
+    N = spec.n_hosts
+    rng = np.random.default_rng([spec.seed, 0x57A7])
+    lat = rng.integers(1 * MS, 5 * MS, size=(N, N), dtype=np.int32)
+    lat = np.minimum(lat, lat.T)
+    loss = np.zeros((N, N), np.float32)
+    bw = np.full((N,), 10_000_000_000, np.int64)
+    params = make_params(lat, loss, bw)
+    state = make_state(N, egress_cap=spec.egress_cap,
+                       ingress_cap=spec.ingress_cap,
+                       initial_tokens=np.asarray(params.tb_cap))
+    return state, params
+
+
+def default_fault_schedule(spec: ScenarioSpec):
+    """A small chaos schedule scaled to the scenario (the chaos_smoke
+    shape): crash one participant for the middle quarter, degrade a
+    link, corrupt a host's egress. Compiled through the REAL `faults:`
+    schedule path so validation and mask semantics are identical."""
+    from ..core.config import FaultsOptions
+    from ..faults.schedule import compile_schedule
+
+    w = lambda k: f"{max(1, k) * spec.window_ns}ns"
+    q = max(2, spec.windows // 4)
+    last = spec.n_hosts - 1
+    events = [
+        {"at": w(q), "kind": "host_crash", "host": f"h{last}"},
+        {"at": w(2 * q), "kind": "host_reboot", "host": f"h{last}"},
+        {"at": w(q // 2), "kind": "link_degrade", "src_node": 0,
+         "dst_node": min(1, spec.n_hosts - 1), "latency_mult": 4,
+         "duration": w(2 * q)},
+        {"at": w(q), "kind": "corrupt_burst",
+         "host": f"h{max(0, last - 1)}", "p": 0.3, "duration": w(q)},
+    ]
+    opts = FaultsOptions(events=events)
+    return compile_schedule(
+        opts, host_names=[f"h{i}" for i in range(spec.n_hosts)],
+        n_nodes=spec.n_hosts, seed=spec.seed,
+        stop_time_ns=(spec.windows + 1) * spec.window_ns)
+
+
+def _shard_host_axis(tree, mesh):
+    """Host-axis-shard a pytree: rank>=1 leaves split on axis 0 (every
+    workload/metrics/guards array is host-major), rank-0 scalars
+    (PlaneMetrics.windows/events/...) replicate."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..tpu.mesh import host_sharding
+
+    sh, rep = host_sharding(mesh), NamedSharding(mesh, P())
+    return jax.tree.map(
+        lambda a: jax.device_put(a, sh if jnp_rank(a) >= 1 else rep),
+        tree)
+
+
+def jnp_rank(a) -> int:
+    return int(getattr(a, "ndim", 0))
+
+
+def run_scenario(spec: ScenarioSpec, *,
+                 guards: bool = False,
+                 fault_events=None,
+                 use_default_faults: bool = False,
+                 mesh_devices: Optional[int] = None,
+                 telemetry=None,
+                 telemetry_every: int = 16,
+                 max_advance: Optional[int] = None) -> dict:
+    """Execute one scenario for its full window budget. Returns the
+    JSON-ready record (no wall-clock anywhere — byte-stable across
+    runs by construction)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..guards import make_guards, summarize
+    from ..telemetry import make_metrics
+    from ..tpu import elastic
+    from ..tpu.plane import window_step
+    from . import device as wdevice
+
+    prog = compile_program(spec)
+    state, params = build_scenario_world(spec)
+    wl = wdevice.to_device(prog)
+    ws = wdevice.make_workload_state(prog)
+    N = spec.n_hosts
+    metrics = make_metrics(N)
+    gstate = make_guards(N) if guards else None
+    schedule = fault_events
+    if schedule is None and use_default_faults:
+        schedule = default_fault_schedule(spec)
+    if mesh_devices is not None:
+        from ..tpu import make_mesh, shard_state
+
+        mesh = make_mesh(mesh_devices)
+        state, params = shard_state(state, params, mesh)
+        wl = _shard_host_axis(wl, mesh)
+        ws = _shard_host_axis(ws, mesh)
+        metrics = _shard_host_axis(metrics, mesh)
+        if gstate is not None:
+            gstate = _shard_host_axis(gstate, mesh)
+    state, ws, metrics = wdevice.prime(wl, ws, state, metrics=metrics)
+    rng_root = jax.random.key(spec.seed)
+    window = jnp.int32(spec.window_ns)
+    adv = max_advance if max_advance is not None else wdevice.MAX_ADVANCE
+    faulted = schedule is not None
+
+    @jax.jit
+    def step(state, ws, metrics, gstate, faults, shift, ridx):
+        out = window_step(state, params, rng_root, shift, window,
+                          rr_enabled=False, faults=faults,
+                          metrics=metrics, guards=gstate)
+        if gstate is not None:
+            state, delivered, _next, metrics, gstate = out
+        else:
+            state, delivered, _next, metrics = out
+        out = wdevice.workload_step(
+            wl, ws, state, delivered, ridx, window, max_advance=adv,
+            metrics=metrics, guards=gstate)
+        if gstate is not None:
+            state, ws, metrics, gstate = out
+        else:
+            state, ws, metrics = out
+        return state, ws, metrics, gstate
+
+    annotated = 0
+    for r in range(spec.windows):
+        now_ns = (r + 1) * spec.window_ns
+        faults = None
+        if faulted:
+            schedule.advance(now_ns)
+            faults = schedule.device_arrays()
+        shift = jnp.int32(0 if r == 0 else spec.window_ns)
+        state, ws, metrics, gstate = step(state, ws, metrics, gstate,
+                                          faults, shift, jnp.int32(r))
+        if telemetry is not None and (r + 1) % telemetry_every == 0:
+            annotated = _annotate_phases(
+                telemetry, spec, prog, ws, annotated)
+            telemetry.tick(now_ns, device=metrics)
+
+    jax.block_until_ready(state)
+    done_win = wdevice.completion_windows(ws)
+    m = jax.device_get(metrics)
+    completion = _phase_completion(spec, prog, done_win)
+    record = {
+        "name": spec.name,
+        "family": spec.family,
+        "fingerprint": scenario_fingerprint(spec),
+        "program_digest": program_digest(prog),
+        "hosts": N,
+        "windows": spec.windows,
+        "window_ns": spec.window_ns,
+        "phases": prog.max_phases,
+        "faults_active": faulted,
+        "canonical_digest": digest_pytrees(
+            elastic.canonical_state(state), ws),
+        "all_done": bool(np.asarray(
+            jax.device_get(ws.phase) >= prog.n_phases).all()),
+        "completed_hosts": int(
+            (np.asarray(jax.device_get(ws.phase)) >= prog.n_phases)
+            [prog.n_phases > 0].sum()),
+        "participants": int((prog.n_phases > 0).sum()),
+        "sent": int(np.asarray(jax.device_get(state.n_sent)).sum()),
+        "delivered": int(np.asarray(
+            jax.device_get(state.n_delivered)).sum()),
+        "events": int(np.asarray(m.events)),
+        "drops": {
+            "ring_full": int(np.asarray(m.drop_ring_full).sum()),
+            "qdisc": int(np.asarray(m.drop_qdisc).sum()),
+            "loss": int(np.asarray(m.drop_loss).sum()),
+            "fault": int(np.asarray(m.drop_fault).sum()),
+        },
+        **completion,
+    }
+    if gstate is not None:
+        record["guards"] = summarize(gstate)
+    if telemetry is not None:
+        # trailing annotations attach to the pending snapshot at the
+        # harvester's next drain (finalize); only tick again when the
+        # loop's cadence did NOT already harvest this exact instant —
+        # a duplicate-timestamp heartbeat reads as a broken stream
+        _annotate_phases(telemetry, spec, prog, ws, annotated)
+        if spec.windows % telemetry_every != 0:
+            telemetry.tick(spec.windows * spec.window_ns,
+                           device=metrics)
+    return record
+
+
+def _phase_completion(spec: ScenarioSpec, prog: TrafficProgram,
+                      done_win: np.ndarray) -> dict:
+    """Completion-time report from the [N, P] done-window table.
+
+    Times are window-quantized VIRTUAL ns: a phase left during window
+    w completed by (w+1) * window_ns. Per phase p, completion is the
+    max over hosts whose program includes p (None while any of them
+    hasn't left it); per host, completion is its terminal phase's
+    time — min/p50/max expose the straggler spread."""
+    P = prog.max_phases
+    never = 2**31 - 1
+    phase_ns: list[Optional[int]] = []
+    for p in range(P):
+        members = prog.n_phases > p
+        if not members.any():
+            phase_ns.append(None)
+            continue
+        wins = done_win[members, p]
+        phase_ns.append(None if (wins >= never).any()
+                        else int((wins.max() + 1) * spec.window_ns))
+    hosts_done = []
+    for h in range(prog.n_hosts):
+        np_h = int(prog.n_phases[h])
+        if np_h == 0:
+            continue
+        w = done_win[h, np_h - 1]
+        if w < never:
+            hosts_done.append(int((w + 1) * spec.window_ns))
+    hosts_done.sort()
+    spread = (
+        {"min_ns": hosts_done[0],
+         "p50_ns": hosts_done[len(hosts_done) // 2],
+         "max_ns": hosts_done[-1]}
+        if hosts_done else None)
+    return {"phase_completion_ns": phase_ns,
+            "host_completion": spread}
+
+
+def _annotate_phases(harvester, spec: ScenarioSpec,
+                     prog: TrafficProgram, ws, already: int):
+    """Queue heartbeat annotations for phases fully completed since the
+    last harvest (the one host-side pull the runner makes per harvest —
+    this is a reporting tool, not the hot path). Returns the new
+    annotated-phase count."""
+    import jax
+
+    done_win = np.asarray(jax.device_get(ws.done_win)).astype(np.int64)
+    never = 2**31 - 1
+    # phases complete in order per participant, so the fleet-wide
+    # completed prefix is monotone and `already` tracks how many were
+    # announced; a phase counts once EVERY host whose program includes
+    # it has left it
+    count = already
+    for p in range(already, prog.max_phases):
+        members = prog.n_phases > p
+        if not members.any():
+            break
+        wins = done_win[members, p]
+        if (wins >= never).any():
+            break
+        harvester.note_event({
+            "kind": "workload_phase",
+            "scenario": spec.name,
+            "family": spec.family,
+            "phase": p,
+            "time_ns": int((wins.max() + 1) * spec.window_ns),
+        })
+        count = p + 1
+    return count
+
+
+def load_golden(path: str) -> dict:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def golden_entry(record: dict) -> dict:
+    """The per-scenario golden tuple: enough to tell 'the scenario
+    changed' (fingerprint) from 'the compiler changed' (program
+    digest) from 'determinism broke' (canonical digest)."""
+    return {"fingerprint": record["fingerprint"],
+            "program_digest": record["program_digest"],
+            "canonical_digest": record["canonical_digest"]}
+
+
+def check_against_golden(records: list[dict], golden: dict) -> list[str]:
+    """Compare a corpus run against the golden file; returns a list of
+    human-readable mismatch lines (empty = clean)."""
+    problems = []
+    seen = set()
+    for rec in records:
+        name = rec["name"]
+        seen.add(name)
+        want = golden.get(name)
+        if want is None:
+            problems.append(f"{name}: not in the golden corpus "
+                            f"(run --update-golden after review)")
+            continue
+        got = golden_entry(rec)
+        for key in ("fingerprint", "program_digest", "canonical_digest"):
+            if got[key] != want.get(key):
+                problems.append(
+                    f"{name}: {key} mismatch\n"
+                    f"  golden: {want.get(key)}\n"
+                    f"  run:    {got[key]}")
+    for name in sorted(set(golden) - seen):
+        problems.append(f"{name}: in the golden corpus but not run")
+    return problems

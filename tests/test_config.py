@@ -184,3 +184,41 @@ def test_plane_kernel_flag_validates():
         load_config_str(
             BASIC.replace("general:",
                           "experimental:\n  plane_kernel: cuda\ngeneral:"))
+
+
+def test_workload_block_yaml11_spellings():
+    """The `workload:` block survives YAML 1.1's bare off/on-as-bool at
+    BOTH levels — the whole block and the scenario field — like
+    telemetry.sink and strace_logging_mode (docs/workloads.md)."""
+    # block level: `workload: off` parses as boolean False
+    cfg = load_config_str(BASIC.replace("general:", "workload: off\ngeneral:"))
+    assert cfg.workload.enabled is False
+    assert cfg.workload.scenario is None
+    cfg = load_config_str(BASIC.replace("general:", "workload: on\ngeneral:"))
+    assert cfg.workload.enabled is True
+    # field level: `scenario: off` -> the "off" sentinel, `scenario: on`
+    # -> None ("enabled at the default path")
+    cfg = load_config_str(BASIC.replace(
+        "general:", "workload:\n  enabled: true\n  scenario: off\ngeneral:"))
+    assert cfg.workload.scenario == "off"
+    cfg = load_config_str(BASIC.replace(
+        "general:", "workload:\n  enabled: true\n  scenario: on\ngeneral:"))
+    assert cfg.workload.scenario is None
+
+
+def test_workload_block_fields_validate():
+    cfg = load_config_str(BASIC.replace(
+        "general:",
+        "workload:\n  scenario: scenarios/incast.yaml\n  seed: 3\ngeneral:"))
+    assert cfg.workload.scenario == "scenarios/incast.yaml"
+    assert cfg.workload.seed == 3
+    assert cfg.workload.enabled is False
+    with pytest.raises(ConfigError, match="workload.seed"):
+        load_config_str(BASIC.replace(
+            "general:", "workload:\n  seed: -1\ngeneral:"))
+    with pytest.raises(ConfigError, match="unknown option"):
+        load_config_str(BASIC.replace(
+            "general:", "workload:\n  bogus: 1\ngeneral:"))
+    with pytest.raises(ConfigError, match="scenario"):
+        load_config_str(BASIC.replace(
+            "general:", "workload:\n  scenario: 7\ngeneral:"))

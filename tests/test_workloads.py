@@ -1,0 +1,547 @@
+"""Workload plane: scenario DSL, compiler, device generator, runner.
+
+Covers (docs/workloads.md):
+- spec parsing/validation and the (spec, seed)-pure fingerprint;
+- compile determinism (program_digest) and capacity refusal;
+- end-to-end completion for all five pattern families;
+- the workload-off parity contract (a world stepped through a driver
+  whose workload slot is None is bitwise-identical to one stepped
+  without the subsystem at all) and presence-switch invariance
+  (metrics/guards threading never perturbs the stream);
+- the MULTICHIP parity contract extended to structured workloads: the
+  ring_allreduce corpus entry sharded over the mesh produces a
+  bitwise-identical canonical digest;
+- a fault-injected scenario finishing guards-clean;
+- the corpus runner's byte-stable records + golden-corpus diffing;
+- the PHOLD respawn relocation (tpu/profiling re-export).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shadow_tpu.workloads import (ScenarioError, compile_program,
+                                  load_scenario_file, parse_scenario,
+                                  program_digest, scenario_fingerprint)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MS = 1_000_000
+
+
+def _spec(patterns, hosts=8, windows=40, **kw):
+    return parse_scenario({"name": "t", "hosts": hosts,
+                           "windows": windows, "patterns": patterns,
+                           **kw})
+
+
+# -- spec ------------------------------------------------------------------
+
+
+def test_spec_validation_errors():
+    with pytest.raises(ScenarioError, match="kind"):
+        _spec([{"kind": "bittorrent"}])
+    with pytest.raises(ScenarioError, match="name"):
+        parse_scenario({"hosts": 8,
+                        "patterns": [{"kind": "onoff"}]})
+    with pytest.raises(ScenarioError, match="patterns"):
+        parse_scenario({"name": "t", "hosts": 8, "patterns": []})
+    with pytest.raises(ScenarioError, match="unknown"):
+        _spec([{"kind": "incast", "count": 4, "think_ns": 5}])
+    with pytest.raises(ScenarioError, match="out of range"):
+        _spec([{"kind": "incast", "first": 2, "count": 8}])
+    with pytest.raises(ScenarioError, match="unknown option"):
+        parse_scenario({"name": "t", "hosts": 8, "bogus": 1,
+                        "patterns": [{"kind": "onoff"}]})
+    with pytest.raises(ScenarioError, match="family"):
+        parse_scenario({"name": "t", "hosts": 8, "family": "nope",
+                        "patterns": [{"kind": "onoff"}]})
+
+
+def test_spec_disjoint_host_ranges():
+    with pytest.raises(ScenarioError, match="disjoint"):
+        _spec([{"kind": "incast", "first": 0, "count": 5},
+               {"kind": "onoff", "first": 4, "count": 2}])
+    # adjacent ranges are fine
+    s = _spec([{"kind": "incast", "first": 0, "count": 5},
+               {"kind": "onoff", "first": 5, "count": 3}])
+    assert len(s.patterns) == 2
+
+
+def test_fingerprint_pure_in_spec_and_seed():
+    raw = {"name": "fp", "hosts": 8, "seed": 5,
+           "patterns": [{"kind": "all_to_all", "count": 8}]}
+    a = scenario_fingerprint(parse_scenario(raw))
+    b = scenario_fingerprint(parse_scenario(dict(raw)))
+    assert a == b
+    c = scenario_fingerprint(parse_scenario({**raw, "seed": 6}))
+    assert c != a
+    d = scenario_fingerprint(parse_scenario(
+        {**raw, "patterns": [{"kind": "all_to_all", "count": 8,
+                              "bytes": 777}]}))
+    assert d != a
+    # the seed= override wins over the spec's own
+    assert scenario_fingerprint(parse_scenario(raw, seed=6)) == c
+
+
+def test_scenario_wrapper_key_accepted():
+    s = parse_scenario({"scenario": {
+        "name": "w", "hosts": 4,
+        "patterns": [{"kind": "onoff", "count": 4}]}})
+    assert s.name == "w"
+
+
+# -- compile ---------------------------------------------------------------
+
+
+def test_compile_deterministic_and_seeded():
+    raw = {"name": "c", "hosts": 8, "seed": 5,
+           "patterns": [{"kind": "onoff", "count": 8, "burst": 2,
+                         "rounds": 3}]}
+    p1 = compile_program(parse_scenario(raw))
+    p2 = compile_program(parse_scenario(raw))
+    assert program_digest(p1) == program_digest(p2)
+    p3 = compile_program(parse_scenario({**raw, "seed": 6}))
+    assert program_digest(p3) != program_digest(p1)
+
+
+def test_compile_shapes_ring():
+    spec = _spec([{"kind": "ring_allreduce", "count": 8, "rounds": 2}])
+    prog = compile_program(spec)
+    # 2 rounds x 2*(8-1) hops, every participant
+    assert prog.max_phases == 2 * 14
+    assert (prog.n_phases[:8] == 28).all()
+    assert prog.max_sends == 1
+    # each phase: one send to the ring successor, dep 1
+    assert (prog.dep[:8, :28] == 1).all()
+    assert prog.send_peer[0, 0, 0] == 1
+    assert prog.send_peer[7, 0, 0] == 0
+
+
+def test_onoff_burst_delay_budget_validated():
+    # per-field-valid knobs whose PRODUCT overflows the int32 delay
+    # table must die as a ScenarioError at parse, not a numpy
+    # OverflowError at compile (or a silent wrap on older numpy)
+    with pytest.raises(ScenarioError, match="delay budget"):
+        _spec([{"kind": "onoff", "count": 8, "burst": 256,
+                "gap_ns": 100_000_000}])
+
+
+def test_single_host_onoff_avoids_claimed_hosts():
+    """A count-1 onoff's fleet-fallback peer pool must exclude other
+    patterns' participants: deliveries credit the receiver's current
+    phase anonymously, so a stray CBR packet would stand in for a
+    collective chunk."""
+    spec = _spec([{"kind": "ring_allreduce", "first": 0, "count": 8},
+                  {"kind": "onoff", "first": 8, "count": 1,
+                   "rounds": 4}], hosts=12)
+    prog = compile_program(spec)
+    peers = prog.send_peer[8][prog.send_peer[8] >= 0]
+    assert len(peers) and (peers >= 9).all(), peers
+    # and when every other host is claimed, compile refuses
+    with pytest.raises(ScenarioError, match="unclaimed"):
+        compile_program(_spec(
+            [{"kind": "ring_allreduce", "first": 0, "count": 7},
+             {"kind": "onoff", "first": 7, "count": 1}], hosts=8))
+
+
+def test_compile_refuses_overflowing_fanout():
+    # an incast sink's ack phase emits fan-in messages at once; a ring
+    # smaller than that is a guaranteed overflow — refused at compile
+    with pytest.raises(ScenarioError, match="egress_cap"):
+        compile_program(_spec(
+            [{"kind": "incast", "count": 10}], hosts=10,
+            egress_cap=4))
+
+
+# -- device generator ------------------------------------------------------
+
+
+def _run_spec(spec, *, metrics=False, guards=False, faults=None,
+              windows=None):
+    """Minimal driver loop over the scenario world (the runner's loop,
+    inlined so tests can thread switches selectively)."""
+    import jax
+    import jax.numpy as jnp
+
+    from shadow_tpu.guards import make_guards
+    from shadow_tpu.telemetry import make_metrics
+    from shadow_tpu.tpu.plane import window_step
+    from shadow_tpu.workloads import device as wd
+    from shadow_tpu.workloads import runner
+
+    prog = compile_program(spec)
+    state, params = runner.build_scenario_world(spec)
+    wl = wd.to_device(prog)
+    ws = wd.make_workload_state(prog)
+    m = make_metrics(spec.n_hosts) if metrics else None
+    g = make_guards(spec.n_hosts) if guards else None
+    out = wd.prime(wl, ws, state, metrics=m, guards=g)
+    state, ws, rest = out[0], out[1], out[2:]
+    if metrics:
+        m, rest = rest[0], rest[1:]
+    if guards:
+        g = rest[0]
+    key = jax.random.key(spec.seed)
+    window = jnp.int32(spec.window_ns)
+
+    @jax.jit
+    def step(state, ws, m, g, faults, shift, ridx):
+        out = window_step(state, params, key, shift, window,
+                          rr_enabled=False, faults=faults, metrics=m,
+                          guards=g)
+        state, delivered = out[0], out[1]
+        rest = out[3:]
+        if m is not None:
+            m, rest = rest[0], rest[1:]
+        if g is not None:
+            g = rest[0]
+        out = wd.workload_step(wl, ws, state, delivered, ridx, window,
+                               metrics=m, guards=g)
+        state, ws, rest = out[0], out[1], out[2:]
+        if m is not None:
+            m, rest = rest[0], rest[1:]
+        if g is not None:
+            g = rest[0]
+        return state, ws, m, g
+
+    R = windows if windows is not None else spec.windows
+    for r in range(R):
+        fa = None
+        if faults is not None:
+            faults.advance((r + 1) * spec.window_ns)
+            fa = faults.device_arrays()
+        shift = jnp.int32(0 if r == 0 else spec.window_ns)
+        state, ws, m, g = step(state, ws, m, g, fa, shift,
+                               jnp.int32(r))
+    jax.block_until_ready(state)
+    return prog, state, ws, m, g, wl
+
+
+FAMILY_SPECS = {
+    "ring_allreduce": ({"kind": "ring_allreduce", "count": 8,
+                        "bytes": 4096, "rounds": 1}, 8, 36, 14 * 8),
+    "all_to_all": ({"kind": "all_to_all", "count": 8, "bytes": 2048,
+                    "rounds": 2}, 8, 36, 14 * 8),
+    "incast": ({"kind": "incast", "count": 8, "bytes": 8000,
+                "rounds": 3}, 8, 24, 3 * 7 * 2),
+    "rpc_fanout": ({"kind": "rpc_fanout", "count": 8, "bytes": 900,
+                    "rounds": 3, "think_ns": 3 * MS,
+                    "think_jitter_ns": MS}, 8, 30, 3 * 7 * 2),
+    "onoff": ({"kind": "onoff", "count": 8, "burst": 3, "rounds": 4,
+               "off_mean_ns": 15 * MS}, 8, 40, 8 * 4 * 3),
+}
+
+
+@pytest.mark.parametrize("family", sorted(FAMILY_SPECS))
+def test_family_completes(family):
+    """Every pattern family runs to completion with the exact send
+    count its structure implies and zero ring overflow."""
+    from shadow_tpu.workloads import device as wd
+
+    pat, hosts, windows, want_sent = FAMILY_SPECS[family]
+    spec = _spec([pat], hosts=hosts, windows=windows)
+    prog, state, ws, _m, _g, wl = _run_spec(spec)
+    assert bool(wd.all_done(wl, ws)), np.asarray(ws.phase)
+    assert int(np.asarray(state.n_sent).sum()) == want_sent
+    assert int(np.asarray(state.n_overflow_dropped).sum()) == 0
+    # every left phase stamped a completion window, in phase order
+    done = wd.completion_windows(ws)
+    for h in range(hosts):
+        np_h = int(prog.n_phases[h])
+        wins = done[h, :np_h]
+        assert (wins < 2**31 - 1).all()
+        assert (np.diff(wins) >= 0).all()
+
+
+def test_rpc_think_time_delays_completion():
+    # think must span multiple windows to be visible: pacing is
+    # window-quantized (docs/workloads.md "Determinism contract"), so
+    # a sub-window think hides in the delivery clamp
+    mk = lambda think: _spec(
+        [{"kind": "rpc_fanout", "count": 8, "rounds": 2,
+          "think_ns": think}], hosts=8, windows=40)
+    from shadow_tpu.workloads import device as wd
+
+    _, _, ws_fast, _, _, _ = _run_spec(mk(0))
+    _, _, ws_slow, _, _, _ = _run_spec(mk(45 * MS))
+    fast = wd.completion_windows(ws_fast)[0]
+    slow = wd.completion_windows(ws_slow)[0]
+    # the root's last round closes later when children think longer
+    assert slow[1] > fast[1]
+
+
+def test_workload_off_world_bitwise_unchanged():
+    """The parity contract: a PHOLD-style world stepped through the
+    runner-shaped loop with NO workload threaded is bitwise-identical
+    to stepping window_step directly — the subsystem's presence (its
+    import, its None slot in a driver) can never perturb a world that
+    doesn't use it."""
+    import jax
+    import jax.numpy as jnp
+
+    from shadow_tpu.tpu import profiling
+    from shadow_tpu.tpu.plane import window_step
+
+    world = profiling.build_world(32, warmup_windows=0)
+    params, key, window = world["params"], world["rng_root"], \
+        world["window"]
+
+    def raw_loop(state):
+        step = jax.jit(lambda st, sh: window_step(
+            st, params, key, sh, window, rr_enabled=False))
+        for r in range(6):
+            state, _d, _n = step(
+                state, jnp.int32(0 if r == 0 else int(window)))
+        return state
+
+    def none_slot_loop(state):
+        # the runner's step shape with the workload branch compiled out
+        @jax.jit
+        def step(st, sh):
+            st, d, n = window_step(st, params, key, sh, window,
+                                   rr_enabled=False)
+            return st, d, n
+
+        for r in range(6):
+            state, _d, _n = step(
+                state, jnp.int32(0 if r == 0 else int(window)))
+        return state
+
+    a = raw_loop(world["state"])
+    b = none_slot_loop(profiling.build_world(32, warmup_windows=0)["state"])
+    for name, la, lb in zip(a._fields, a, b):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), name
+
+
+def test_presence_switches_bitwise_invisible():
+    """metrics/guards threaded through prime + workload_step never
+    perturb the stream (the standing presence-switch contract), and a
+    clean scenario reports clean guards."""
+    from shadow_tpu.guards import summarize
+
+    spec = _spec([{"kind": "incast", "count": 8, "rounds": 2}],
+                 hosts=8, windows=20)
+    _, plain, ws_a, _, _, _ = _run_spec(spec)
+    _, switched, ws_b, m, g, _ = _run_spec(spec, metrics=True,
+                                           guards=True)
+    for name, la, lb in zip(plain._fields, plain, switched):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), name
+    for name, la, lb in zip(ws_a._fields, ws_a, ws_b):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), name
+    assert summarize(g)["clean"]
+    assert int(np.asarray(m.pkts_out).sum()) > 0
+
+
+def test_fault_injected_scenario_guards_clean():
+    """A scenario with the default fault schedule threaded (crash,
+    link degrade, corruption burst) must finish with ZERO guard
+    violations — injected failure is simulation input, not invariant
+    breakage (docs/workloads.md)."""
+    from shadow_tpu.guards import summarize
+    from shadow_tpu.workloads import runner
+
+    spec = _spec([{"kind": "onoff", "count": 8, "burst": 2,
+                   "rounds": 3, "off_mean_ns": 10 * MS}],
+                 hosts=8, windows=24)
+    schedule = runner.default_fault_schedule(spec)
+    _, state, _ws, m, g, _ = _run_spec(spec, metrics=True, guards=True,
+                                       faults=schedule)
+    assert summarize(g)["clean"], summarize(g)
+    # the schedule actually bit: fault drops were recorded
+    assert int(np.asarray(state.n_fault_dropped).sum()) > 0
+
+
+# -- runner + corpus -------------------------------------------------------
+
+
+def test_runner_record_byte_stable(tmp_path):
+    from shadow_tpu.workloads import runner
+
+    spec = _spec([{"kind": "all_to_all", "count": 8, "rounds": 1}],
+                 hosts=8, windows=20)
+    a = runner.run_scenario(spec)
+    b = runner.run_scenario(spec)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    assert a["all_done"]
+    assert a["fingerprint"] == scenario_fingerprint(spec)
+    assert a["drops"] == {"ring_full": 0, "qdisc": 0, "loss": 0,
+                          "fault": 0}
+    # phase completion: monotone, window-quantized virtual ns
+    times = [t for t in a["phase_completion_ns"] if t is not None]
+    assert times == sorted(times) and times
+    assert all(t % spec.window_ns == 0 for t in times)
+    assert a["host_completion"]["max_ns"] >= a["host_completion"]["min_ns"]
+
+
+def test_golden_corpus_checking(tmp_path):
+    from shadow_tpu.workloads import runner
+
+    spec = _spec([{"kind": "incast", "count": 6, "rounds": 2}],
+                 hosts=8, windows=20)
+    rec = runner.run_scenario(spec)
+    golden = {rec["name"]: runner.golden_entry(rec)}
+    assert runner.check_against_golden([rec], golden) == []
+    # a digest drift names the scenario and the key
+    tampered = {rec["name"]: {**golden[rec["name"]],
+                              "canonical_digest": "0" * 64}}
+    problems = runner.check_against_golden([rec], tampered)
+    assert problems and "canonical_digest" in problems[0]
+    # unknown / missing entries both surface
+    assert runner.check_against_golden([rec], {})
+    assert runner.check_against_golden(
+        [], golden) == [f"{rec['name']}: in the golden corpus but not "
+                        f"run"]
+
+
+def test_ring_corpus_entry_sharded_parity():
+    """The MULTICHIP parity contract extended to structured workloads
+    (docs/determinism.md): the ring_allreduce CORPUS entry run
+    host-axis-sharded over the 8-device test mesh produces a canonical
+    digest bitwise-identical to the single-device run."""
+    from shadow_tpu.workloads import runner
+
+    spec = load_scenario_file(
+        os.path.join(REPO, "scenarios", "ring_allreduce.yaml"))
+    single = runner.run_scenario(spec)
+    sharded = runner.run_scenario(spec, mesh_devices=8)
+    assert sharded["canonical_digest"] == single["canonical_digest"]
+    assert sharded["all_done"] and single["all_done"]
+
+
+def test_corpus_entry_matches_golden():
+    """One corpus entry against the checked-in golden digests (the CI
+    gate runs the full corpus; this pins the plumbing in tier-1)."""
+    from shadow_tpu.workloads import runner
+
+    spec = load_scenario_file(
+        os.path.join(REPO, "scenarios", "incast.yaml"))
+    rec = runner.run_scenario(spec)
+    golden = runner.load_golden(
+        os.path.join(REPO, "scenarios", "GOLDEN.json"))
+    assert runner.check_against_golden([rec], {
+        rec["name"]: golden[rec["name"]]}) == []
+
+
+def test_run_scenarios_config_block(tmp_path):
+    """`run_scenarios.py --config` consumes the sim config's
+    `workload:` block: scenario path resolved relative to the config
+    file, seed override applied (the fingerprint shifts with it)."""
+    import runpy
+
+    mod = runpy.run_path(
+        os.path.join(REPO, "tools", "run_scenarios.py"),
+        run_name="run_scenarios")
+    scen = tmp_path / "scen.yaml"
+    scen.write_text(
+        "scenario:\n  name: cfg-driven\n  hosts: 8\n  windows: 16\n"
+        "  seed: 1\n"
+        "  patterns:\n    - {kind: onoff, count: 8, rounds: 2}\n")
+    cfg = tmp_path / "sim.yaml"
+    cfg.write_text(
+        "general: {stop_time: 1s}\n"
+        "workload: {scenario: scen.yaml, seed: 9}\n"
+        "hosts:\n  h0: {network_node_id: 0}\n")
+    out = tmp_path / "rec.json"
+    assert mod["main"](["--config", str(cfg), "-o", str(out)]) == 0
+    rec = json.load(open(out))["records"][0]
+    spec_seeded = parse_scenario(
+        {"name": "cfg-driven", "hosts": 8, "windows": 16, "seed": 1,
+         "patterns": [{"kind": "onoff", "count": 8, "rounds": 2}]},
+        seed=9)
+    assert rec["fingerprint"] == scenario_fingerprint(spec_seeded)
+    # a block that names no scenario is a loud exit-2, not a silent
+    # fleet-wide corpus run
+    cfg2 = tmp_path / "sim2.yaml"
+    cfg2.write_text("general: {stop_time: 1s}\nworkload: on\n"
+                    "hosts:\n  h0: {network_node_id: 0}\n")
+    assert mod["main"](["--config", str(cfg2),
+                        "-o", str(tmp_path / "r2.json")]) == 2
+
+
+@pytest.mark.slow
+def test_full_corpus_matches_golden(tmp_path):
+    import subprocess
+    import sys
+
+    out = tmp_path / "scenarios.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_scenarios.py"),
+         "--check", "-o", str(out)],
+        capture_output=True, text=True, env=env)
+    assert proc.returncode == 0, proc.stderr
+    assert "match the golden corpus" in proc.stderr
+
+
+def test_runner_telemetry_annotations(tmp_path):
+    """Phase completions ride the heartbeat stream as annotations
+    (docs/observability.md)."""
+    from shadow_tpu.telemetry import TelemetryHarvester
+    from shadow_tpu.workloads import runner
+
+    spec = _spec([{"kind": "incast", "count": 6, "rounds": 2}],
+                 hosts=8, windows=20)
+    sink = tmp_path / "hb.jsonl"
+    h = TelemetryHarvester(interval_ns=spec.window_ns, sink=str(sink))
+    runner.run_scenario(spec, telemetry=h, telemetry_every=4)
+    h.finalize()
+    lines = [json.loads(ln) for ln in open(sink)]
+    annos = [a for ln in lines for a in ln.get("annotations", ())]
+    phases = [a for a in annos if a["kind"] == "workload_phase"]
+    assert phases, lines
+    assert phases[0]["scenario"] == spec.name
+    assert all(p["time_ns"] % spec.window_ns == 0 for p in phases)
+    assert [p["phase"] for p in phases] == sorted(
+        p["phase"] for p in phases)
+
+
+# -- relocation + config wiring -------------------------------------------
+
+
+def test_phold_respawn_relocated_with_reexport():
+    """PHOLD moved to the workload plane; the profiler keeps a
+    back-compat re-export and is otherwise measurement-only."""
+    import shadow_tpu.tpu.profiling as profiling
+    from shadow_tpu.workloads import phold
+
+    assert profiling.respawn_batch is phold.respawn_batch
+    import inspect
+
+    src = inspect.getsource(profiling)
+    assert "def respawn_batch" not in src
+
+
+def test_manager_workload_warns_and_strict_refuses(caplog):
+    """A Manager-driven run never executes scenario programs: the
+    `workload:` block warns loudly and `strict: true` refuses."""
+    import logging
+
+    from shadow_tpu.core.config import ConfigError, load_config_str
+    from shadow_tpu.core.manager import Manager
+
+    mk = lambda blk: (f"general: {{stop_time: 1s, seed: 1}}\n{blk}\n"
+                      "network:\n  graph:\n    type: 1_gbit_switch\n"
+                      "hosts:\n  h0:\n    network_node_id: 0\n")
+    # both spellings a user would reach for: enabled-flag only, and a
+    # bare scenario path (enabled left default) — each must warn
+    for blk in ("workload: {enabled: true}",
+                "workload: {scenario: scenarios/incast.yaml}"):
+        caplog.clear()
+        with caplog.at_level(logging.WARNING,
+                             logger="shadow_tpu.manager"):
+            Manager(load_config_str(mk(blk)))
+        assert any("workload" in r.message and "run_scenarios"
+                   in r.message for r in caplog.records), blk
+        with pytest.raises(ConfigError, match="strict mode"):
+            Manager(load_config_str("strict: true\n" + mk(blk)))
+    # an explicitly-off block stays silent
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="shadow_tpu.manager"):
+        Manager(load_config_str(mk("workload: off")))
+    assert not any("workload" in r.message for r in caplog.records)

@@ -43,7 +43,6 @@ strict` exits with the CLI capacity code (6) on the first overflow.
 from __future__ import annotations
 
 import argparse
-import hashlib
 import json
 import os
 import sys
@@ -84,15 +83,11 @@ def default_schedule(n_hosts: int, n_windows: int, window_ns: int):
 
 
 def state_digest(*pytrees) -> str:
-    import jax
+    # ONE digest definition for every bitwise-parity contract (the
+    # golden scenario corpus shares it): shadow_tpu/workloads/runner.py
+    from shadow_tpu.workloads.runner import digest_pytrees
 
-    h = hashlib.sha256()
-    for tree in pytrees:
-        for leaf in jax.tree.leaves(jax.device_get(tree)):
-            arr = np.asarray(leaf)
-            h.update(str(arr.dtype).encode())
-            h.update(arr.tobytes())
-    return h.hexdigest()
+    return digest_pytrees(*pytrees)
 
 
 def main(argv=None) -> int:
@@ -137,6 +132,7 @@ def main(argv=None) -> int:
     from shadow_tpu.tpu import elastic, ingest_rows, profiling
     from shadow_tpu.tpu.elastic import CapacityError
     from shadow_tpu.tpu.plane import window_step
+    from shadow_tpu.workloads.phold import respawn_batch
 
     EXIT_GUARD = 5  # shadow_tpu.cli.EXIT_GUARD (docs/robustness.md)
     EXIT_CAPACITY = 6  # shadow_tpu.cli.EXIT_CAPACITY
@@ -178,7 +174,7 @@ def main(argv=None) -> int:
             # ingress-ring overflow: the routing stage's ring-full drops
             in_ovf = state.n_overflow_dropped - state0.n_overflow_dropped
             state1 = state
-            mask, dst, nbytes, seq, ctrl = profiling.respawn_batch(
+            mask, dst, nbytes, seq, ctrl = respawn_batch(
                 delivered, spawn_seq, round_idx, N, ci)
             # dead/flapped hosts generate no respawn traffic
             mask = mask & (faults.host_alive & faults.link_up)[:, None]

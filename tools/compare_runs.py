@@ -21,7 +21,12 @@ Usage:
       bench.py records: headline events/s plus the per-section ms deltas
       (the `sections` field), so the BENCH_r*.json trajectory shows WHERE
       time went (docs/performance.md)
-Exit 0 when all runs match bit-for-bit (--bench: always); 1 otherwise.
+  python tools/compare_runs.py --scenarios BEFORE.json AFTER.json # diff
+      two tools/run_scenarios.py records: headline completion-time
+      deltas per scenario family plus per-scenario event/completion
+      tables (docs/workloads.md)
+Exit 0 when all runs match bit-for-bit (--bench/--scenarios: always);
+1 otherwise.
 """
 
 from __future__ import annotations
@@ -87,6 +92,20 @@ def _load_bench(path: str) -> dict:
     return rec.get("parsed", rec)  # the PR driver wraps the JSON line
 
 
+def _delta_table(label: str, s0: dict, s1: dict, width: int = 24):
+    """The shared per-key before/after/ratio printer (bench sections
+    and scenario completion tables use the same shape)."""
+    names = sorted(set(s0) | set(s1),
+                   key=lambda n: -float(s0.get(n, s1.get(n, 0)) or 0))
+    print(f"{label:<{width}} {'before ms':>10} {'after ms':>10} "
+          f"{'ratio':>7}")
+    for name in names:
+        a, b = s0.get(name), s1.get(name)
+        ratio = (f"{a / b:.2f}x" if a and b else "-")
+        fmt = lambda x: f"{x:.2f}" if x is not None else "-"
+        print(f"{name:<{width}} {fmt(a):>10} {fmt(b):>10} {ratio:>7}")
+
+
 def bench_delta(before_path: str, after_path: str) -> int:
     """Print the headline + per-section deltas between two bench.py JSON
     records (informational — always exits 0)."""
@@ -101,14 +120,50 @@ def bench_delta(before_path: str, after_path: str) -> int:
         print("(no `sections` field in either record — re-run bench.py "
               "without BENCH_SECTIONS=0 to record the breakdown)")
         return 0
-    names = sorted(set(s0) | set(s1),
-                   key=lambda n: -float(s0.get(n, s1.get(n, 0))))
-    print(f"{'section':<24} {'before ms':>10} {'after ms':>10} {'ratio':>7}")
-    for name in names:
-        a, b = s0.get(name), s1.get(name)
-        ratio = (f"{a / b:.2f}x" if a and b else "-")
-        fmt = lambda x: f"{x:.2f}" if x is not None else "-"
-        print(f"{name:<24} {fmt(a):>10} {fmt(b):>10} {ratio:>7}")
+    _delta_table("section", s0, s1)
+    return 0
+
+
+def _scenario_completions(path: str) -> tuple[dict, dict, dict]:
+    """Load a run_scenarios.py record file -> (per-family completion ms,
+    per-scenario completion ms, per-scenario fingerprints)."""
+    with open(path) as fh:
+        records = json.load(fh).get("records", [])
+    family: dict[str, float] = {}
+    per_scenario: dict[str, float] = {}
+    fps: dict[str, str] = {}
+    for rec in records:
+        hc = rec.get("host_completion") or {}
+        done_ms = (hc.get("max_ns") / 1e6
+                   if hc.get("max_ns") is not None else None)
+        fps[rec["name"]] = rec.get("fingerprint", "")
+        if done_ms is None:
+            continue  # incomplete scenario: no headline time
+        per_scenario[rec["name"]] = done_ms
+        fam = rec.get("family", "?")
+        family[fam] = max(family.get(fam, 0.0), done_ms)
+    return family, per_scenario, fps
+
+
+def scenarios_delta(before_path: str, after_path: str) -> int:
+    """Print headline completion-time deltas per scenario family, then
+    the per-scenario table, between two tools/run_scenarios.py record
+    files (informational — always exits 0). Completion times are the
+    virtual host_completion.max_ns headline (straggler-inclusive); a
+    fingerprint mismatch is flagged since the delta then compares two
+    DIFFERENT scenarios, not two runs of one."""
+    f0, s0, fp0 = _scenario_completions(before_path)
+    f1, s1, fp1 = _scenario_completions(after_path)
+    print("scenario-family completion (virtual ms, max over family):")
+    _delta_table("family", f0, f1)
+    print()
+    print("per-scenario completion (virtual ms):")
+    _delta_table("scenario", s0, s1)
+    for name in sorted(set(fp0) & set(fp1)):
+        if fp0[name] != fp1[name]:
+            print(f"NOTE: {name}: scenario fingerprint changed between "
+                  f"the records — this is a different scenario, not a "
+                  f"behavior delta")
     return 0
 
 
@@ -126,11 +181,24 @@ def main(argv=None) -> int:
         help="diff two bench.py JSON records (headline + section deltas) "
              "instead of running the determinism harness",
     )
+    ap.add_argument(
+        "--scenarios", nargs=2, metavar=("BEFORE", "AFTER"), default=None,
+        help="diff two tools/run_scenarios.py record files (completion-"
+             "time deltas per scenario family) instead of running the "
+             "determinism harness",
+    )
     args = ap.parse_args(argv)
+    if args.bench is not None and args.scenarios is not None:
+        ap.error("--bench and --scenarios are mutually exclusive")
     if args.bench is not None:
         if args.config or args.matrix or args.runs is not None:
             ap.error("--bench takes exactly two bench JSONs and no config")
         return bench_delta(*args.bench)
+    if args.scenarios is not None:
+        if args.config or args.matrix or args.runs is not None:
+            ap.error("--scenarios takes exactly two scenario record "
+                     "files and no config")
+        return scenarios_delta(*args.scenarios)
     if args.config is None:
         ap.error("config is required (or use --bench)")
     if args.matrix and args.runs is not None:

@@ -1,0 +1,182 @@
+#!/usr/bin/env python3
+"""Run the workload scenario corpus and diff against golden digests.
+
+The workload plane's CI surface (docs/workloads.md): every checked-in
+scenario under `scenarios/` executes through the corpus runner
+(`shadow_tpu/workloads/runner.py`), producing one JSON record per
+scenario — canonical digest, per-phase completion virtual times,
+traffic/drop totals — with no wall-clock anywhere, so two runs of the
+same corpus are byte-identical.
+
+Usage:
+  python tools/run_scenarios.py                       # run corpus,
+      write scenarios.json
+  python tools/run_scenarios.py --check               # also diff
+      digests against scenarios/GOLDEN.json (exit 1 on mismatch)
+  python tools/run_scenarios.py --update-golden       # rewrite the
+      golden file from this run (review the diff!)
+  python tools/run_scenarios.py scenarios/incast.yaml # subset
+  python tools/run_scenarios.py --config sim.yaml     # the sim
+      config's `workload:` block names the scenario (+ seed override)
+  python tools/run_scenarios.py --shard 8             # host-axis
+      sharded over 8 devices; digests must not change
+  python tools/run_scenarios.py --faults --guards     # fault-injected
+      run with the guard plane threaded (must finish guards-clean)
+  python tools/run_scenarios.py --telemetry DIR       # heartbeat
+      JSONL with workload_phase annotations
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS_DIR = os.path.join(REPO, "scenarios")
+GOLDEN = os.path.join(CORPUS_DIR, "GOLDEN.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("scenarios", nargs="*",
+                    help="scenario YAMLs (default: scenarios/*.yaml)")
+    ap.add_argument("--config", default=None, metavar="SIM_YAML",
+                    help="read the scenario path + seed override from "
+                         "a simulation config's `workload:` block "
+                         "(docs/workloads.md) instead of listing "
+                         "scenario files")
+    ap.add_argument("--check", action="store_true",
+                    help="diff digests against the golden corpus "
+                         "(exit 1 on any mismatch)")
+    ap.add_argument("--update-golden", action="store_true",
+                    help="rewrite scenarios/GOLDEN.json from this run")
+    ap.add_argument("-o", "--out", default="scenarios.json",
+                    help="record output path (default scenarios.json)")
+    ap.add_argument("--golden", default=GOLDEN)
+    ap.add_argument("--shard", type=int, default=None, metavar="N",
+                    help="host-axis shard over N devices (digest parity)")
+    ap.add_argument("--faults", action="store_true",
+                    help="thread the default fault schedule per scenario")
+    ap.add_argument("--guards", action="store_true",
+                    help="thread the runtime invariant plane; exits 1 "
+                         "when any scenario reports a violation")
+    ap.add_argument("--telemetry", default=None, metavar="DIR",
+                    help="write heartbeat JSONL (with workload_phase "
+                         "annotations) per scenario into DIR")
+    args = ap.parse_args(argv)
+
+    from shadow_tpu.workloads import load_scenario_file
+    from shadow_tpu.workloads import runner
+
+    seed_override = None
+    if args.config is not None:
+        if args.scenarios:
+            ap.error("--config and positional scenarios are mutually "
+                     "exclusive")
+        from shadow_tpu.core.config import ConfigError, load_config_file
+
+        try:
+            cfg = load_config_file(args.config)
+        except ConfigError as e:
+            print(f"run_scenarios: {args.config}: {e}", file=sys.stderr)
+            return 2
+        if cfg.workload.scenario in (None, "off"):
+            print(f"run_scenarios: {args.config}: the `workload:` "
+                  f"block names no scenario (workload.scenario is "
+                  f"{cfg.workload.scenario!r})", file=sys.stderr)
+            return 2
+        paths = [os.path.join(os.path.dirname(os.path.abspath(
+            args.config)), cfg.workload.scenario)
+            if not os.path.isabs(cfg.workload.scenario)
+            else cfg.workload.scenario]
+        seed_override = cfg.workload.seed
+    else:
+        paths = args.scenarios or sorted(
+            glob.glob(os.path.join(CORPUS_DIR, "*.yaml")))
+    if not paths:
+        print("run_scenarios: no scenarios found", file=sys.stderr)
+        return 2
+    if (args.faults or args.guards) and (args.check
+                                         or args.update_golden):
+        # the golden corpus is the FAULT-FREE contract; a fault run's
+        # digests are a different world by design
+        print("run_scenarios: --faults/--guards runs cannot be "
+              "checked against (or written to) the golden corpus",
+              file=sys.stderr)
+        return 2
+
+    records = []
+    guards_dirty = False
+    for path in paths:
+        spec = load_scenario_file(path, seed=seed_override)
+        harvester = None
+        if args.telemetry:
+            from shadow_tpu.telemetry import TelemetryHarvester
+
+            os.makedirs(args.telemetry, exist_ok=True)
+            harvester = TelemetryHarvester(
+                interval_ns=spec.window_ns,
+                sink=os.path.join(args.telemetry,
+                                  f"{spec.name}.jsonl"))
+        rec = runner.run_scenario(
+            spec, guards=args.guards,
+            use_default_faults=args.faults,
+            mesh_devices=args.shard,
+            telemetry=harvester)
+        if harvester is not None:
+            harvester.finalize()
+        records.append(rec)
+        g = rec.get("guards")
+        status = ("done" if rec["all_done"]
+                  else f"{rec['completed_hosts']}/{rec['participants']}")
+        gtxt = ""
+        if g is not None:
+            gtxt = " guards=clean" if g["clean"] else " guards=DIRTY"
+            guards_dirty |= not g["clean"]
+        print(f"{spec.name:<24} [{rec['family']}] {status:>8}  "
+              f"events={rec['events']:<8} "
+              f"digest={rec['canonical_digest'][:12]}{gtxt}",
+              file=sys.stderr)
+
+    with open(args.out, "w") as fh:
+        json.dump({"records": records}, fh, sort_keys=True, indent=1)
+        fh.write("\n")
+    print(f"run_scenarios: {len(records)} scenario(s) -> {args.out}",
+          file=sys.stderr)
+
+    if args.update_golden:
+        golden = {rec["name"]: runner.golden_entry(rec)
+                  for rec in records}
+        with open(args.golden, "w") as fh:
+            json.dump(golden, fh, sort_keys=True, indent=1)
+            fh.write("\n")
+        print(f"run_scenarios: golden corpus rewritten: {args.golden}",
+              file=sys.stderr)
+    if args.check:
+        try:
+            golden = runner.load_golden(args.golden)
+        except OSError as e:
+            print(f"run_scenarios: no golden corpus: {e}",
+                  file=sys.stderr)
+            return 1
+        problems = runner.check_against_golden(records, golden)
+        for p in problems:
+            print(f"GOLDEN MISMATCH: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        print(f"run_scenarios: {len(records)} scenario(s) match the "
+              f"golden corpus", file=sys.stderr)
+    if guards_dirty:
+        print("run_scenarios: guard violations reported",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
