@@ -40,6 +40,12 @@ PLANE_KERNEL = os.environ.get("BENCH_PLANE_KERNEL", "xla")
 # (docs/observability.md; the acceptance bar is throughput within 5%
 # of the metrics-off path)
 TELEMETRY = os.environ.get("BENCH_TELEMETRY", "0") == "1"
+# BENCH_HIST=1 (telemetry mode only) additionally threads the
+# log2-bucketed latency/depth histograms (telemetry/histo.py) through
+# the scan carry: heartbeats gain per-host `hist` bucket vectors and
+# the JSON records fleet latency percentiles
+# (docs/observability.md "Distributions and the flight recorder")
+HIST = os.environ.get("BENCH_HIST", "0") == "1"
 # BENCH_FAULTS=1 threads NEUTRAL FaultArrays masks through every window
 # (docs/robustness.md): the chaos-smoke CI job compares this against the
 # faults-off run — the fault plane's presence switch must stay within 5%
@@ -113,18 +119,25 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None]:
 
         _faults = neutral_faults(N, M)
 
-    def make_round_fn(kernel: str, track_overflow: bool = False):
+    def make_round_fn(kernel: str, track_overflow: bool = False,
+                      use_hist: bool = False):
         def round_fn(carry, round_idx):
+            hist = None
             if track_overflow:
                 state, spawn_seq, metrics, eg_acc, in_acc = carry
+            elif use_hist:
+                state, spawn_seq, metrics, hist = carry
             else:
                 state, spawn_seq, metrics = carry
             state0 = state
             shift = jnp.where(round_idx == 0, jnp.int32(0), window)
             out = window_step(state, params, key, shift, window,
                               rr_enabled=False, kernel=kernel,
-                              faults=_faults, metrics=metrics)
-            if metrics is not None:
+                              faults=_faults, metrics=metrics,
+                              hist=hist)
+            if hist is not None:
+                state, delivered, next_ev, metrics, hist = out
+            elif metrics is not None:
                 state, delivered, next_ev, metrics = out
             else:
                 state, delivered, next_ev = out
@@ -148,16 +161,23 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None]:
                 seq_vals, ctrl,
                 valid=mask,
                 metrics=metrics,
+                hist=hist,
             )
-            if metrics is not None:
+            if hist is not None:
+                state, metrics, hist = state
+            elif metrics is not None:
                 state, metrics = state
             if track_overflow:
                 # egress-ring overflow (the respawn append's drops)
                 eg_acc = eg_acc + (state.n_overflow_dropped
                                    - state1.n_overflow_dropped)
             spawn_seq = spawn_seq + mask.sum(axis=1, dtype=jnp.int32)
-            carry = ((state, spawn_seq, metrics, eg_acc, in_acc)
-                     if track_overflow else (state, spawn_seq, metrics))
+            if track_overflow:
+                carry = (state, spawn_seq, metrics, eg_acc, in_acc)
+            elif use_hist:
+                carry = (state, spawn_seq, metrics, hist)
+            else:
+                carry = (state, spawn_seq, metrics)
             return carry, mask.sum(dtype=jnp.int32)
         return round_fn
 
@@ -192,13 +212,20 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None]:
     # asynchronous D2H copy of the previous chunk's output must survive
     # this chunk's dispatch (telemetry/harvest.py).
     def make_run_chunk(kernel: str):
-        round_fn = make_round_fn(kernel)
+        round_fn = make_round_fn(kernel, use_hist=HIST)
 
         @donating_jit
-        def run_chunk(state, spawn_seq, metrics, round_ids):
-            (state, spawn_seq, metrics), delivered_counts = jax.lax.scan(
-                round_fn, (state, spawn_seq, metrics), round_ids)
-            return state, spawn_seq, metrics, delivered_counts.sum()
+        def run_chunk(state, spawn_seq, metrics, hist, round_ids):
+            carry0 = ((state, spawn_seq, metrics, hist) if HIST
+                      else (state, spawn_seq, metrics))
+            carry, delivered_counts = jax.lax.scan(
+                round_fn, carry0, round_ids)
+            if HIST:
+                state, spawn_seq, metrics, hist = carry
+            else:
+                state, spawn_seq, metrics = carry
+            return state, spawn_seq, metrics, hist, \
+                delivered_counts.sum()
         return run_chunk
 
     run_chunk = KernelFallback(PLANE_KERNEL, make_run_chunk)
@@ -261,20 +288,25 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None]:
         return [jnp.asarray(ids[i:i + HARVEST_EVERY])
                 for i in range(0, ROUNDS, HARVEST_EVERY)]
 
-    def run_telemetry(state, harvester=None):
-        from shadow_tpu.telemetry import make_metrics
+    def run_telemetry(state, harvester=None, collect=None):
+        from shadow_tpu.telemetry import make_histograms, make_metrics
 
         spawn_seq = jnp.full((N,), 10_000, jnp.int32)
         metrics = make_metrics(N)
+        hist = make_histograms(N) if HIST else None
         total = jnp.int32(0)
         done = 0
         for ids in telemetry_chunks():
-            state, spawn_seq, metrics, ndel = run_chunk(
-                state, spawn_seq, metrics, ids)
+            state, spawn_seq, metrics, hist, ndel = run_chunk(
+                state, spawn_seq, metrics, hist, ids)
             total = total + ndel
             done += int(ids.shape[0])
             if harvester is not None:
-                harvester.tick(done * int(window), device=metrics)
+                device = (dict(metrics._asdict(), **hist._asdict())
+                          if HIST else metrics)
+                harvester.tick(done * int(window), device=device)
+        if collect is not None and hist is not None:
+            collect["hist"] = hist
         return state, total
 
     if CAPACITY_MODE != "fixed":
@@ -308,8 +340,9 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None]:
         harvester = TelemetryHarvester(
             interval_ns=HARVEST_EVERY * int(window), sink=sink,
             slot_capacity=N * (EGRESS_CAP + INGRESS_CAP))
+        collect: dict = {}
         t0 = time.monotonic()
-        state_out, ndel = run_telemetry(state2, harvester)
+        state_out, ndel = run_telemetry(state2, harvester, collect)
         ndel = int(ndel)
         jax.block_until_ready(state_out)
         wall = time.monotonic() - t0
@@ -329,6 +362,15 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None]:
             "trace": trace["path"],
             "trace_events": trace["events"],
         }
+        if "hist" in collect:
+            from shadow_tpu.telemetry.histo import (HIST_PREFIX,
+                                                    percentiles)
+
+            h = jax.device_get(collect["hist"])
+            telemetry_info["latency"] = {
+                name[len(HIST_PREFIX):]: percentiles(
+                    np.asarray(arr, np.int64).sum(axis=0))
+                for name, arr in h._asdict().items()}
     else:
         t0 = time.monotonic()
         state_out, ndel = driver(state2)

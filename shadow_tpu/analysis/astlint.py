@@ -34,6 +34,17 @@ once collecting findings. Rules scope by repo-relative path:
   invariants go through the guard plane (``shadow_tpu/guards/``);
   trace-time static checks use an explicit raise. Host-side asserts
   outside kernel bodies are untouched.
+- SL405 (sync-telemetry-read) applies to ``shadow_tpu/`` EXCEPT
+  ``shadow_tpu/telemetry/`` (the harvest boundary is the one
+  sanctioned reader): a host-side ``float(...)`` call or ``.item()``
+  method read whose target mentions a device telemetry array — a
+  `PlaneMetrics`/`PlaneHistograms`/transport counter field or a
+  conventionally-named local (``metrics``, ``hist``, ``flightrec``) —
+  is a blocking D2H sync outside the asynchronous harvester
+  (docs/observability.md no-host-sync rule). Detection is lexical
+  (field/receiver names), so it forces NEW observability reads through
+  the drain without type inference; justified exceptions use the
+  standard suppression comment.
 - SL403 (variadic-sort) applies to ``shadow_tpu/tpu/``: a
   ``jax.lax.sort`` call (or a call to the ``_row_sort`` wrapper) whose
   statically-countable operand tuple carries more than 3 payload
@@ -78,6 +89,46 @@ _ORDER_PRESERVING = {"list", "tuple", "iter", "enumerate", "reversed"}
 # jax entry points that are *intentional* host syncs, not kernel branches
 _SYNC_OK = {"jax.device_get", "jax.block_until_ready"}
 
+# SL405: leaf names of the device telemetry pytrees — a float()/.item()
+# read of one of these outside shadow_tpu/telemetry/ is a blocking D2H
+# sync bypassing the asynchronous harvester. The set mirrors
+# PlaneMetrics / PlaneHistograms / TransportHist / FlightRecArrays /
+# TransportState's telemetry counters; tests/test_shadowlint.py pins it
+# against the live pytree definitions so a new counter field cannot
+# silently escape the rule.
+_TELEMETRY_FIELD_ATTRS = frozenset({
+    # telemetry/metrics.PlaneMetrics
+    "pkts_out", "bytes_out", "pkts_in", "bytes_in", "drop_ring_full",
+    "drop_qdisc", "drop_loss", "drop_fault", "retransmits",
+    "max_eg_depth", "max_in_depth", "windows", "events", "sort_slots",
+    # telemetry/histo.PlaneHistograms + tpu/transport.TransportHist
+    "hist_delivery_ns", "hist_sojourn_ns", "hist_qdepth",
+    # telemetry/flightrec.FlightRecArrays ring columns
+    "ev_kind", "ev_src", "ev_seq", "ev_dst", "ev_t", "ev_win",
+    # tpu/transport.TransportState telemetry counters
+    "n_out", "n_released",
+})
+
+# conventional local/parameter names for the telemetry pytrees — a bare
+# `float(metrics.x)` resolves through these even when the field name is
+# computed
+_TELEMETRY_NAMES = frozenset({
+    "metrics", "hist", "hists", "histograms", "flightrec",
+    "plane_metrics",
+})
+
+
+def _mentions_telemetry(node: ast.AST) -> bool:
+    """True when the expression touches a telemetry array by field
+    name or conventional receiver name (lexical — the SL405 net)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) \
+                and sub.attr in _TELEMETRY_FIELD_ATTRS:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in _TELEMETRY_NAMES:
+            return True
+    return False
+
 _REDUCTION_METHODS = {"any", "all", "sum", "min", "max", "item",
                       "argmax", "argmin"}
 
@@ -102,6 +153,11 @@ def rule_applies(rule: str, relpath: str) -> bool:
         return p.startswith("shadow_tpu/tpu/")
     if rule == "SL401":
         return p.startswith("shadow_tpu/")
+    if rule == "SL405":
+        # the telemetry package IS the harvest boundary — its drain is
+        # the sanctioned place to materialize device counters
+        return (p.startswith("shadow_tpu/")
+                and not p.startswith("shadow_tpu/telemetry/"))
     return False
 
 
@@ -534,9 +590,33 @@ class _Linter(ast.NodeVisitor):
 
     # -- SL101 / SL102: calls --------------------------------------------
 
+    # -- SL405: blocking telemetry reads outside the harvest boundary ----
+
+    def _check_telemetry_read(self, node: ast.Call, resolved) -> None:
+        if resolved == "float" and node.args \
+                and _mentions_telemetry(node.args[0]):
+            self._emit(
+                "SL405", node,
+                "host-side float(...) read of a device telemetry array "
+                "outside the harvest boundary: this is a blocking D2H "
+                "sync — route observability reads through the "
+                "asynchronous TelemetryHarvester/FlightRecorder drain "
+                "(docs/observability.md no-host-sync rule)")
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item" and not node.args \
+                and _mentions_telemetry(node.func.value):
+            self._emit(
+                "SL405", node,
+                "host-side .item() read of a device telemetry array "
+                "outside the harvest boundary: this is a blocking D2H "
+                "sync — route observability reads through the "
+                "asynchronous TelemetryHarvester/FlightRecorder drain "
+                "(docs/observability.md no-host-sync rule)")
+
     def visit_Call(self, node: ast.Call) -> None:
         resolved = self.imports.resolve(node.func)
         self._check_sort_diet(node, resolved)
+        self._check_telemetry_read(node, resolved)
         if resolved in _WALL_CLOCK:
             self._emit("SL101", node,
                        f"wall-clock read `{resolved}` in simulation code; "

@@ -231,14 +231,15 @@ class _StubRouting:
 def _plane_entry(rr_enabled: bool, router_aqm: bool, no_loss: bool,
                  packed_sort: bool = True, kernel: str = "xla",
                  telemetry: bool = False, faults: bool = False,
-                 guards: bool = False):
+                 guards: bool = False, trace: bool = False):
     def build():
         import jax
         import jax.numpy as jnp
 
         from ..faults.plane import neutral_faults
         from ..guards.plane import make_guards
-        from ..telemetry import make_metrics
+        from ..telemetry import make_flightrec, make_histograms, \
+            make_metrics
         from ..tpu import plane
 
         n, m = 4, 3
@@ -275,6 +276,18 @@ def _plane_entry(rr_enabled: bool, router_aqm: bool, no_loss: bool,
 
             return fn, (state, make_metrics(n), jnp.int32(0),
                         jnp.int32(10_000_000))
+
+        if trace:
+            def fn(state, hist, flightrec, shift, window):
+                return plane.window_step(
+                    state, params, root, shift, window,
+                    rr_enabled=rr_enabled, router_aqm=router_aqm,
+                    no_loss=no_loss, packed_sort=packed_sort,
+                    kernel=kernel, hist=hist, flightrec=flightrec)
+
+            return fn, (state, make_histograms(n),
+                        make_flightrec(0, sample_every=4, ring=64),
+                        jnp.int32(0), jnp.int32(10_000_000))
 
         if guards:
             def fn(state, guard_state, shift, window):
@@ -401,22 +414,25 @@ def _transport_entry(kernel: str):
             [_StubHost(i + 1, i % 3) for i in range(n)],
             _StubRouting(3), None, egress_cap=8, ingress_cap=8,
             mode="sync", compact_cap=16)
-        # audit the GUARDED variants: the guard plane's checks are part
-        # of the kernel surface whenever guards are enabled, and the
-        # unguarded trace is a strict subset (g=None compiles them out)
+        # audit the GUARDED + HISTOGRAMMED variants: guard checks and
+        # histogram adds are part of the kernel surface whenever the
+        # planes are enabled, and the disabled traces are strict
+        # subsets (g=None / h=None compile them out)
         dt.enable_guards()
-        st, g = dt.state, dt._guard
+        dt.enable_histograms()
+        st, g, h = dt.state, dt._guard, dt._hist
         if kernel == "ingest":
             b = 8
             z = lambda: jnp.zeros((b,), jnp.int32)
-            args = (st, g, z(), z(), z(), z(), z(), z(),
+            args = (st, g, h, z(), z(), z(), z(), z(), z(),
                     jnp.zeros((b,), bool))
             return dt._k_ingest, args
         if kernel == "step":
-            return dt._k_step, (st, g, jnp.int32(0), jnp.int32(1_000_000))
+            return dt._k_step, (st, g, h, jnp.int32(0),
+                                jnp.int32(1_000_000))
         if kernel == "chain":
             i32 = jnp.int32
-            return dt._k_chain, (st, g, i32(0), i32(1_000_000),
+            return dt._k_chain, (st, g, h, i32(0), i32(1_000_000),
                                  i32(1_000_000), i32(50_000_000),
                                  i32(50_000_000))
         # batch_verify: K windows of B ingest rows
@@ -425,7 +441,7 @@ def _transport_entry(kernel: str):
         row = {key: jnp.zeros((k, b), jnp.int32)
                for key in ("src", "dst", "seq", "tag", "send", "clamp")}
         row["valid"] = jnp.zeros((k, b), bool)
-        args = (st, g, zk(), zk(), row, jnp.zeros((k,), jnp.uint32),
+        args = (st, g, h, zk(), zk(), row, jnp.zeros((k,), jnp.uint32),
                 jnp.zeros((k,), jnp.uint32), zk(), jnp.int32(0))
         return dt._k_batch_verify, args
 
@@ -495,6 +511,8 @@ def default_entries() -> list[AuditEntry]:
                    _plane_entry(True, True, False, faults=True)),
         AuditEntry("window_step[guards]", "shadow_tpu.tpu.plane",
                    _plane_entry(True, True, False, guards=True)),
+        AuditEntry("window_step[trace]", "shadow_tpu.tpu.plane",
+                   _plane_entry(True, True, False, trace=True)),
         AuditEntry("routing_rank", "shadow_tpu.tpu.plane",
                    _routing_entry("rank")),
         AuditEntry("routing_place", "shadow_tpu.tpu.plane",

@@ -123,6 +123,19 @@ RULES: dict[str, RuleInfo] = {
             "reference paths carry justified suppressions",
         ),
         RuleInfo(
+            "SL405", "sync-telemetry-read",
+            "host-side float(...)/.item() read of a device telemetry "
+            "array (metrics/histogram/flight-recorder leaves) outside "
+            "harvest-boundary code",
+            "every observability read goes through the asynchronous "
+            "TelemetryHarvester/FlightRecorder drain "
+            "(docs/observability.md no-host-sync rule): a float()/"
+            ".item() on a device counter is a blocking D2H sync that "
+            "stalls the dispatch pipeline wherever it runs — "
+            "shadow_tpu/telemetry/ (the harvest boundary itself) is "
+            "the one sanctioned reader",
+        ),
+        RuleInfo(
             "SL201", "x64-leak",
             "64-bit dtype (float64/int64) appearing in a device jaxpr",
             "the device plane is int32/float32 by contract "

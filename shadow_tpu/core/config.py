@@ -199,6 +199,24 @@ class ExperimentalOptions:
 
 
 @dataclass
+class FlightRecorderOptions:
+    """`telemetry.flight_recorder` — the sampled per-packet hop
+    recorder (docs/observability.md "Distributions and the flight
+    recorder"). `sample_every` = K tags ~1/K packets with a seeded
+    deterministic mask (pure function of (seed, src, seq)); `ring` is
+    the device-side trace-ring capacity (overflow is counted and
+    reported loudly, and the ring participates in elastic capacity
+    growth). Consumed by the device-plane window drivers (bench.py,
+    tools/chaos_smoke.py, tools/run_scenarios.py); Manager-driven runs
+    warn that hop tracing is not executed there (ConfigError under
+    top-level `strict: true`)."""
+
+    enabled: bool = False
+    sample_every: int = 64
+    ring: int = 4096
+
+
+@dataclass
 class TelemetryOptions:
     """The `telemetry:` config block (no reference counterpart — this
     rebuild's device plane needs its own observability; see
@@ -210,7 +228,10 @@ class TelemetryOptions:
     trace.json output path (default: <data_dir>/trace.json when
     enabled; "off" disables). `per_host` emits one heartbeat line per
     host per harvest in addition to the run summary line — turn off for
-    very large fleets. Not supported on the flow-engine path
+    very large fleets. `histograms` threads the log2-bucketed
+    latency/queue-depth distributions (`telemetry/histo.py`) through
+    the device kernels; `flight_recorder` configures the sampled
+    per-packet hop recorder. Not supported on the flow-engine path
     (`experimental.use_flow_engine`), which never runs the round loop —
     enabling both logs a warning."""
 
@@ -219,6 +240,9 @@ class TelemetryOptions:
     sink: Optional[str] = None
     trace: Optional[str] = None
     per_host: bool = True
+    histograms: bool = False
+    flight_recorder: FlightRecorderOptions = field(
+        default_factory=FlightRecorderOptions)
 
 
 @dataclass
@@ -546,6 +570,18 @@ def _fill_dataclass(cls, raw: dict, where: str):
         elif f.name == "checkpoint" and cls is FaultsOptions:
             setattr(obj, key, _fill_dataclass(
                 FaultCheckpointOptions, value, f"{where}.checkpoint"))
+        elif f.name == "flight_recorder" and cls is TelemetryOptions:
+            # YAML 1.1 sub-block hardening: a bare `flight_recorder:
+            # off/on` parses as a boolean — coerce to the disabled/
+            # enabled default block like the `workload:` block does
+            if value is False:
+                setattr(obj, key, FlightRecorderOptions(enabled=False))
+            elif value is True:
+                setattr(obj, key, FlightRecorderOptions(enabled=True))
+            else:
+                setattr(obj, key, _fill_dataclass(
+                    FlightRecorderOptions, value,
+                    f"{where}.flight_recorder"))
         elif f.name in ("events", "random") and cls is FaultsOptions:
             # raw event/generator mappings; validated by
             # faults/schedule.compile_schedule at Manager build time
@@ -662,6 +698,11 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
     # as a ConfigError, not mid-run inside the harvester
     if cfg.telemetry.interval is None or cfg.telemetry.interval <= 0:
         raise ConfigError("telemetry.interval must be a positive duration")
+    if cfg.telemetry.flight_recorder.sample_every < 1:
+        raise ConfigError(
+            "telemetry.flight_recorder.sample_every must be >= 1")
+    if cfg.telemetry.flight_recorder.ring < 1:
+        raise ConfigError("telemetry.flight_recorder.ring must be >= 1")
     if cfg.faults.checkpoint.interval is not None \
             and cfg.faults.checkpoint.interval <= 0:
         raise ConfigError(

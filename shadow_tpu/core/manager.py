@@ -188,6 +188,22 @@ class Manager:
                 "fused kernels; the CPU plane runs no window_step) — "
                 "this run proceeds on its default kernels; the flag "
                 "governs bench.py and tools/profile_plane.py only")
+        if config.telemetry.flight_recorder.enabled:
+            # the sampled hop recorder rides the device-plane WINDOW
+            # drivers (bench.py, tools/chaos_smoke.py,
+            # tools/run_scenarios.py), which own the fixed window
+            # cadence its virtual timestamps decode against;
+            # Manager-driven rounds have no such driver loop — a
+            # silently-ignored opt-in would look like a broken feature
+            # (docs/observability.md "Distributions and the flight
+            # recorder")
+            self._unsupported_combo(
+                "telemetry.flight_recorder is not consulted by "
+                "Manager-driven runs: sampled per-packet hop tracing "
+                "rides the device-plane window drivers (bench.py, "
+                "tools/chaos_smoke.py, tools/run_scenarios.py) — this "
+                "run proceeds without hop tracing; telemetry.histograms "
+                "remains available on the use_tpu_transport path")
         if config.workload.enabled or config.workload.scenario not in (
                 None, "off"):
             # the workload plane's generators ride the device-plane
@@ -524,6 +540,21 @@ class Manager:
             return
         from ..telemetry import TelemetryHarvester
 
+        if self.config.telemetry.histograms:
+            if self.transport is not None:
+                # per-destination delivery-latency / in-flight-depth
+                # log2 histograms ride the transport kernels as a
+                # static presence switch and drain through the same
+                # async harvest (docs/observability.md "Distributions
+                # and the flight recorder")
+                self.transport.enable_histograms()
+            else:
+                self._unsupported_combo(
+                    "telemetry.histograms needs the device transport "
+                    "(experimental.use_tpu_transport): the CPU object "
+                    "plane has no device counter arrays to bucket — "
+                    "this run emits no histograms")
+
         on_drain = None
         if self._guard_recon is not None:
             # cross-plane reconciliation rides the harvester's drain:
@@ -555,6 +586,11 @@ class Manager:
         host-id namespace."""
         device = (self.transport.telemetry_arrays()
                   if self.transport is not None else None)
+        if device is not None:
+            # [N, B] histogram leaves merge into the same device dict;
+            # the harvester splits them off by rank (empty when
+            # telemetry.histograms is off)
+            device = {**device, **self.transport.histogram_arrays()}
         cpu = {
             t.host.host_id: t.counters.as_dict()
             for t in self.trackers.values()

@@ -9,19 +9,33 @@ heartbeats, and trace/metrics exporters.
   under one host-id namespace, emitted as deterministic JSONL.
 - `export` — Perfetto/Chrome trace on the virtual-time axis and the
   `stats.shadow.json` bridge into `tools/plot_shadow.py`.
+- `histo` — on-device log2-bucketed latency/queue-depth histograms
+  (`PlaneHistograms`), the distribution half of the counters.
+- `flightrec` — the sampled per-packet flight recorder: a seeded
+  deterministic 1/K sampling mask, a device-side hop trace ring, and
+  the asynchronous host drain (`FlightRecorder`).
 
 Design rule (docs/observability.md): telemetry may never add a device
 sync to the per-window hot path — harvest happens OUTSIDE jitted code,
-enforced statically by shadowlint SL301.
+enforced statically by shadowlint SL301 (and SL405 for the float()/
+.item() read side).
 """
 
+from .flightrec import FlightRecArrays, FlightRecorder, make_flightrec
 from .harvest import TelemetryHarvester, unwrap_u32
+from .histo import HIST_BUCKETS, PlaneHistograms, make_histograms
 from .metrics import PlaneMetrics, add_retransmits, make_metrics
 
 __all__ = [
+    "FlightRecArrays",
+    "FlightRecorder",
+    "HIST_BUCKETS",
+    "PlaneHistograms",
     "PlaneMetrics",
     "TelemetryHarvester",
     "add_retransmits",
+    "make_flightrec",
+    "make_histograms",
     "make_metrics",
     "unwrap_u32",
 ]

@@ -17,8 +17,10 @@ a simulated µs, so two seeds' traces align perfectly for diffing.
 from __future__ import annotations
 
 import json
-from typing import Iterable
+from typing import Iterable, Optional
 
+from . import histo
+from .flightrec import hop_flows
 from .harvest import MAX_FIELDS
 
 #: keys plotted as per-host counter tracks (cumulative in heartbeats;
@@ -70,12 +72,24 @@ def _merged_counters(rec: dict) -> dict[str, int]:
 
 
 def write_perfetto_trace(heartbeats: list[dict], path: str, *,
-                         max_hosts: int = 256) -> dict:
+                         max_hosts: int = 256,
+                         hops: Optional[list[dict]] = None,
+                         max_flows: int = 512) -> dict:
     """Write a Chrome trace-event JSON file; returns a small summary
     dict (events written, hosts plotted/dropped). Hosts are capped at
     `max_hosts` counter rows (top talkers by total bytes) so a 4096-host
     run stays loadable; the cap is recorded in the trace's otherData —
-    never silent."""
+    never silent.
+
+    When the sim heartbeats carry `hist` bucket vectors
+    (telemetry/histo.py), the simulation row gains per-interval
+    percentile COUNTER tracks on the virtual-time axis (p50/p90/p99/
+    p999 of each histogram's interval delta). When `hops` (flight-
+    recorder hop records, telemetry/flightrec.py) are given, sampled
+    packets become FLOW events: a send slice on the source host row
+    bound by an `s` arrow to a deliver slice on the destination row —
+    one packet's life, linked across hosts. Flows are capped at
+    `max_flows` (recorded in otherData, never silent)."""
     events: list[dict] = [
         {"ph": "M", "pid": 0, "tid": 0, "name": "process_name",
          "args": {"name": "simulation (virtual time)"}},
@@ -84,6 +98,7 @@ def write_perfetto_trace(heartbeats: list[dict], path: str, *,
     sims = sorted((r for r in heartbeats if r.get("type") == "sim"),
                   key=lambda r: r["time_ns"])
     prev_t = 0
+    prev_hist: dict[str, list] = {}
     for rec in sims:
         t = rec["time_ns"]
         args = {k: rec[k] for k in ("windows", "events", "sort_occupancy")
@@ -93,6 +108,20 @@ def write_perfetto_trace(heartbeats: list[dict], path: str, *,
             "name": "harvest", "ts": prev_t / 1e3,
             "dur": max(t - prev_t, 1) / 1e3, "args": args,
         })
+        for hname, counts in sorted((rec.get("hist") or {}).items()):
+            # interval percentiles from the cumulative bucket deltas:
+            # counter tracks on the VIRTUAL-time axis, so an incast's
+            # p99 blowup lands at its simulated instant
+            prev = prev_hist.get(hname, [0] * len(counts))
+            delta = [c - p for c, p in zip(counts, prev)]
+            prev_hist[hname] = counts
+            if sum(delta) <= 0:
+                continue
+            events.append({
+                "ph": "C", "pid": 0,
+                "name": hname.removeprefix(histo.HIST_PREFIX),
+                "ts": t / 1e3, "args": histo.percentiles(delta),
+            })
         for totals_key in ("device_totals", "cpu_totals"):
             if totals_key in rec:
                 events.append({
@@ -140,6 +169,11 @@ def write_perfetto_trace(heartbeats: list[dict], path: str, *,
                                "ts": t / 1e3, "args": totals})
             prev, prev_t = c, t
 
+    flows_written = flows_dropped = 0
+    if hops:
+        flows_written, flows_dropped = _flow_events(
+            events, hops, max_flows)
+
     trace = {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -147,12 +181,71 @@ def write_perfetto_trace(heartbeats: list[dict], path: str, *,
             "clock": "virtual simulated time (1 trace us = 1 sim us)",
             "hosts_plotted": len(plotted),
             "hosts_dropped_by_cap": len(dropped),
+            "flows_plotted": flows_written,
+            "flows_dropped_by_cap": flows_dropped,
         },
     }
     with open(path, "w") as fh:
         json.dump(trace, fh, sort_keys=True)
     return {"events": len(events), "hosts_plotted": len(plotted),
-            "hosts_dropped_by_cap": len(dropped), "path": path}
+            "hosts_dropped_by_cap": len(dropped),
+            "flows_plotted": flows_written,
+            "flows_dropped_by_cap": flows_dropped, "path": path}
+
+
+def _flow_events(events: list[dict], hops: list[dict],
+                 max_flows: int) -> tuple[int, int]:
+    """Append flight-recorder packet flows to a trace-event list: for
+    each sampled packet with a `routed` hop, a send slice on the
+    source host's row, an `s` flow arrow, and (when the packet's
+    terminal hop was recorded) a terminal slice on the destination row
+    closing the arrow (`f`, bp="e"). An AQM drop is a terminal hop
+    too, named `drop_aqm` — the trace says where and why the packet
+    died. Loss/fault-dropped packets never entered the wire, so they
+    have no flow; their hops still appear in the hops JSONL. Host rows
+    use pid = host index + 1 (the heartbeat host_id), matching the
+    counter-track rows. Returns (flows written, flows dropped by the
+    cap)."""
+    # only flows with a `routed` hop are plottable (e.g. an ingest-only
+    # group has no wire span); the cap counts PLOTTABLE flows cut, so
+    # flows_dropped_by_cap is the same number regardless of where the
+    # unplottable groups fall in iteration order
+    plottable = []
+    for (src, seq), group in sorted(hop_flows(hops).items()):
+        routed = next((h for h in group if h["kind"] == "routed"), None)
+        if routed is not None:
+            plottable.append(((src, seq), group, routed))
+    written = 0
+    for (src, seq), group, routed in plottable[:max_flows]:
+        fid = f"pkt-{src}-{seq}"
+        terminal = next(
+            (h for h in group
+             if h["kind"] in ("delivered", "drop_aqm")), None)
+        end_t = terminal["t_ns"] if terminal else routed["t_ns"]
+        events.append({
+            "ph": "X", "pid": src + 1, "tid": 1,
+            "name": f"send #{seq} -> host{routed['dst'] + 1}",
+            "ts": routed["t_ns"] / 1e3,
+            "dur": max(end_t - routed["t_ns"], 1) / 1e3,
+            "args": dict(routed),
+        })
+        events.append({"ph": "s", "pid": src + 1, "tid": 1,
+                       "id": fid, "name": "packet",
+                       "ts": routed["t_ns"] / 1e3})
+        if terminal is not None:
+            events.append({
+                "ph": "X", "pid": terminal["dst"] + 1, "tid": 1,
+                "name": f"{terminal['kind']} #{seq} "
+                        f"from host{src + 1}",
+                "ts": terminal["t_ns"] / 1e3, "dur": 1.0,
+                "args": dict(terminal),
+            })
+            events.append({"ph": "f", "bp": "e",
+                           "pid": terminal["dst"] + 1, "tid": 1,
+                           "id": fid, "name": "packet",
+                           "ts": terminal["t_ns"] / 1e3})
+        written += 1
+    return written, len(plottable) - written
 
 
 def to_plot_stats(heartbeats: list[dict]) -> dict:
@@ -212,4 +305,28 @@ def summarize(heartbeats: list[dict], *, top: int = 10) -> dict:
         for k in ("windows", "events", "sort_occupancy"):
             if k in last:
                 out[k] = last[k]
+        if last.get("hist"):
+            # run-level SLO percentiles from the final cumulative
+            # fleet histograms (telemetry/histo.py bucket scheme)
+            out["percentiles"] = {
+                name.removeprefix(histo.HIST_PREFIX):
+                    histo.percentiles(counts)
+                for name, counts in sorted(last["hist"].items())}
+    return out
+
+
+def host_percentiles(heartbeats: list[dict]) -> dict[str, dict]:
+    """Per-host percentile tables from each host's FINAL cumulative
+    histogram line: {host_name: {hist_name: {p50: ..., ...}}} — the
+    report CLI's per-host latency table."""
+    out: dict[str, dict] = {}
+    for name, recs in sorted(_host_series(heartbeats).items()):
+        hist = next((r["hist"] for r in reversed(recs)
+                     if r.get("hist")), None)
+        if not hist:
+            continue
+        out[name] = {
+            hname.removeprefix(histo.HIST_PREFIX):
+                histo.percentiles(counts)
+            for hname, counts in sorted(hist.items())}
     return out

@@ -1,0 +1,393 @@
+"""Sampled per-packet hop tracing: the device-plane flight recorder.
+
+Histograms (`telemetry/histo.py`) answer "how bad"; the flight recorder
+answers "WHERE did this packet spend its time": a seeded deterministic
+sampling mask tags ~1/K packets, and every tagged packet's hops —
+ingested into an egress ring, routed onto the wire, AQM-judged,
+delivered or dropped (with the reason) — land as fixed-shape SoA events
+in a device-side trace ring, drained at harvest boundaries with zero
+added syncs and exported as Perfetto flow events linking a packet's
+life across hosts (docs/observability.md "Distributions and the flight
+recorder").
+
+Design rules, same as every observability plane:
+
+1. **Static presence switch.** `window_step(..., flightrec=None)`
+   compiles the recorder out; threading a `FlightRecArrays` pytree is
+   bitwise-invisible to simulation state, metrics, AND guards
+   (tests/test_flightrec.py parity matrix).
+2. **Deterministic sampling.** The mask is a pure function of
+   (seed, src, seq) — an independent counter-based threefry stream,
+   exactly like the fault plane's corruption draws: it never touches
+   the simulation RNG, and whether a packet is sampled does not depend
+   on batch shape, queue occupancy, sharding, or ring capacity. Two
+   identical runs record byte-identical hop streams.
+3. **No silent truncation.** The ring keeps the LAST R events under a
+   monotone (modular) write cursor; when more events land between two
+   drains than the ring holds, the overwritten count is computed from
+   the cursor delta and reported LOUDLY (log + summary + heartbeat
+   annotation), never dropped silently. Under the elastic capacity
+   policy the ring participates in growth: `grow_ring` repacks the
+   ring into a larger power-of-two, entry-preserving and
+   cursor-consistent, so a driver can double it instead of losing
+   events (docs/robustness.md "Elastic capacity").
+
+The host half (`FlightRecorder`) mirrors the `TelemetryHarvester`
+double-buffer: `tick()` starts an asynchronous D2H copy of the ring
+columns and materializes the PREVIOUS tick's copy — no
+`block_until_ready`, no blocking pull on the driver loop.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .harvest import unwrap_u32
+
+log = logging.getLogger("shadow_tpu.telemetry")
+
+# hop kinds (ev_kind values). DROP reasons are distinct kinds so the
+# drop taxonomy (docs/robustness.md) survives into the hop stream: an
+# injected fault never reads as wire loss, per-packet included.
+HOP_INGEST = 0  # appended to its source's egress ring
+HOP_ROUTED = 1  # cleared the egress gate and entered the wire
+HOP_DELIVERED = 2  # released to the destination host
+HOP_DROP_LOSS = 3  # Bernoulli path-loss sample
+HOP_DROP_FAULT = 4  # injected fault (crash purge / corruption burst)
+HOP_DROP_AQM = 5  # router CoDel verdict at the destination
+
+HOP_NAMES = {
+    HOP_INGEST: "ingest",
+    HOP_ROUTED: "routed",
+    HOP_DELIVERED: "delivered",
+    HOP_DROP_LOSS: "drop_loss",
+    HOP_DROP_FAULT: "drop_fault",
+    HOP_DROP_AQM: "drop_aqm",
+}
+
+I32_MAX = np.int32(2**31 - 1)
+
+
+class FlightRecArrays(NamedTuple):
+    """The device-side trace ring. Plain kernel arguments (never
+    static), so advancing between windows never recompiles; the ring
+    length R is the only static dimension."""
+
+    key: jax.Array  # [2] uint32 — threefry key of the sampling stream
+    sample_every: jax.Array  # scalar uint32 — tag ~1/K packets
+    ev_kind: jax.Array  # [R] int32 HOP_* code
+    ev_src: jax.Array  # [R] int32 source host index
+    ev_seq: jax.Array  # [R] int32 per-source packet id
+    ev_dst: jax.Array  # [R] int32 destination host index
+    ev_t: jax.Array  # [R] int32 ns relative to the event's window start
+    ev_win: jax.Array  # [R] int32 window counter at the event
+    cursor: jax.Array  # scalar int32 — monotone (modular) write cursor
+    win: jax.Array  # scalar int32 — windows recorded so far
+
+
+def make_flightrec(seed: int, *, sample_every: int = 64,
+                   ring: int = 4096) -> FlightRecArrays:
+    """A fresh recorder. `seed` keys the sampling stream (a pure
+    function of (seed, src, seq) — docs/determinism.md); `sample_every`
+    = K tags ~1/K packets (1 = every packet); `ring` is the trace-ring
+    capacity (static: changing it retraces the step)."""
+    if sample_every < 1:
+        raise ValueError("flight_recorder.sample_every must be >= 1")
+    if ring < 1:
+        raise ValueError("flight_recorder.ring must be >= 1")
+    kd = jax.random.key_data(jax.random.key(seed)).astype(jnp.uint32)
+    z = lambda: jnp.zeros((ring,), jnp.int32)
+    return FlightRecArrays(
+        key=kd.reshape(-1)[:2],
+        sample_every=jnp.uint32(sample_every),
+        ev_kind=z(), ev_src=z(), ev_seq=z(), ev_dst=z(),
+        ev_t=z(), ev_win=z(),
+        cursor=jnp.zeros((), jnp.int32),
+        win=jnp.zeros((), jnp.int32),
+    )
+
+
+def ring_capacity(fr: FlightRecArrays) -> int:
+    return int(fr.ev_kind.shape[0])
+
+
+# -- device half (pure jnp; safe inside jit) ------------------------------
+
+
+def sample_mask(fr: FlightRecArrays, src: jax.Array,
+                seq: jax.Array) -> jax.Array:
+    """The deterministic sampling mask: True for packets whose
+    (src, seq) hashes to 0 mod K under the recorder's threefry key.
+    One batched 2x32 block over all slots — the (src, seq) pair IS the
+    cipher's counter block, so the mask depends only on
+    (seed, src, seq): identical under any vectorization, sharding,
+    batch shape, or ring capacity (the determinism contract), and
+    INDEPENDENT of the simulation RNG streams (separate key)."""
+    from jax.extend import random as jex_random
+
+    shape = src.shape
+    count = jnp.concatenate([
+        src.reshape(-1).astype(jnp.uint32),
+        seq.reshape(-1).astype(jnp.uint32),
+    ])
+    bits = jex_random.threefry_2x32(fr.key, count)[: src.size]
+    return (bits % fr.sample_every == 0).reshape(shape)
+
+
+def record_events(fr: FlightRecArrays, kind, src, seq, dst, t,
+                  mask) -> FlightRecArrays:
+    """Append this window's masked candidate events ([B] flat int32
+    columns, bool mask) to the trace ring, in layout order (the
+    deterministic candidate order window_step concatenates them in).
+
+    The append is sort-free AND scatter-free (the same diet the
+    routing stage is on, docs/performance.md): a masked event's ring
+    position is (cursor + rank) % R with rank its layout-order
+    counting rank (an inclusive cumsum), and because those positions
+    are CONSECUTIVE modulo R, the update inverts into a per-ring-slot
+    GATHER — each slot computes which rank (if any) lands on it this
+    window and binary-searches the cumsum for that event's index. One
+    cumsum over the candidates + O(R log B) searchsorted + 6 R-sized
+    gathers, vs the 6 B-sized scatters (or worse, a B-sized sort)
+    the naive formulations pay.
+
+    When the window produces more events than the ring holds, only
+    the LAST R survive (ring-overwrite semantics, uniform within a
+    window and across windows) — the loss is visible in the cursor
+    delta and reported loudly by the host drain, never silent."""
+    R = fr.ev_kind.shape[0]
+    B = mask.shape[0]
+    csum = jnp.cumsum(mask.astype(jnp.int32))  # inclusive rank + 1
+    count = csum[-1]
+    # ring slot j receives the event whose rank r satisfies
+    # (cursor + r) % R == j, taking the LARGEST such r < count (newest
+    # wins); r < count - R means the slot keeps its previous entry
+    r0 = (jnp.arange(R, dtype=jnp.int32) - fr.cursor) % R
+    r = count - 1 - (count - 1 - r0) % R
+    written = (r >= 0) & (r >= count - R)
+    # first candidate index with inclusive cumsum == r + 1 IS the
+    # masked event of rank r (the cumsum jumps exactly there)
+    src_idx = jnp.clip(
+        jnp.searchsorted(csum, r + 1).astype(jnp.int32), 0, B - 1)
+    take = lambda col, old: jnp.where(
+        written, col.reshape(-1)[src_idx], old)
+    return fr._replace(
+        ev_kind=take(kind, fr.ev_kind),
+        ev_src=take(src, fr.ev_src),
+        ev_seq=take(seq, fr.ev_seq),
+        ev_dst=take(dst, fr.ev_dst),
+        ev_t=take(t, fr.ev_t),
+        ev_win=jnp.where(written, fr.win, fr.ev_win),
+        cursor=fr.cursor + count,
+    )
+
+
+def advance_window(fr: FlightRecArrays) -> FlightRecArrays:
+    """Bump the window counter (window_step calls this once, AFTER
+    recording the window's events — events stamp the window they
+    happened in)."""
+    return fr._replace(win=fr.win + 1)
+
+
+def grow_ring(fr: FlightRecArrays, new_ring: int) -> FlightRecArrays:
+    """Repack the trace ring into `new_ring` slots (> R), preserving
+    every live entry at its cursor-consistent position — index j of
+    the new ring holds the event whose absolute cursor position p
+    satisfies p % new_ring == j, exactly as if the run had started at
+    the larger capacity with the same event stream. Pure device op
+    (one stacked scatter); drivers call it between windows when a
+    drain reports overwritten events under the elastic capacity
+    policy (docs/robustness.md 'Elastic capacity'). Recompiles the
+    step per ring shape — bounded at log2 by power-of-two growth."""
+    R = fr.ev_kind.shape[0]
+    if new_ring <= R:
+        raise ValueError(
+            f"flight-recorder ring can only grow ({R} -> {new_ring})")
+    idx = jnp.arange(R, dtype=jnp.int32)
+    # old slot j holds the latest absolute position p < cursor with
+    # p % R == j (only the last min(cursor, R) slots are live)
+    abs_pos = fr.cursor - 1 - (fr.cursor - 1 - idx) % R
+    live = (abs_pos >= 0) & (abs_pos >= fr.cursor - R)
+    pos = jnp.where(live, abs_pos % new_ring, new_ring)
+    old = jnp.stack([fr.ev_kind, fr.ev_src, fr.ev_seq, fr.ev_dst,
+                     fr.ev_t, fr.ev_win])
+    ring = jnp.zeros((6, new_ring), jnp.int32).at[:, pos].set(
+        old, mode="drop")
+    return fr._replace(
+        ev_kind=ring[0], ev_src=ring[1], ev_seq=ring[2],
+        ev_dst=ring[3], ev_t=ring[4], ev_win=ring[5])
+
+
+# -- host half: the asynchronous drain ------------------------------------
+
+#: ring columns the drain copies (cursor rides along)
+_COLS = ("ev_kind", "ev_src", "ev_seq", "ev_dst", "ev_t", "ev_win")
+
+
+class FlightRecorder:
+    """Host-side drain for the device trace ring, double-buffered like
+    the `TelemetryHarvester`: `tick(fr)` drains the previous snapshot
+    (whose asynchronous D2H copy has had a whole interval to land) and
+    starts copying the current ring. Decoded hops accumulate in
+    `self.hops` (and stream to `sink` as deterministic JSONL — sorted
+    keys, virtual-time stamps, byte-stable across identical runs).
+
+    `window_ns` maps the device (win, t_rel) stamp to absolute virtual
+    ns for fixed-cadence window drivers (bench/chaos/scenario loops —
+    the only drivers that thread the recorder). `overwritten` counts
+    ring-overflow losses, computed from the cursor delta at every
+    drain and reported loudly — no silent truncation."""
+
+    def __init__(self, *, window_ns: int, sink=None,
+                 retain: bool = True):
+        self.window_ns = int(window_ns)
+        self.hops: list[dict] = []
+        self.recorded = 0  # hops decoded across all drains
+        self.overwritten = 0  # events lost to ring overwrite
+        self._retain = retain
+        self._pending = None  # {col: array-ref} + cursor ref
+        self._prev_cursor_raw = 0
+        self._cursor_total = 0
+        self._grown_at = 0  # overwritten count at the last grow_ring
+        self._own_sink = isinstance(sink, str)
+        self.sink_path = sink if self._own_sink else None
+        self._sink = open(sink, "w") if self._own_sink else sink
+
+    # -- the drain cycle -------------------------------------------------
+
+    def tick(self, fr: FlightRecArrays) -> None:
+        """Drain the previous snapshot, then start an asynchronous copy
+        of the current ring columns + cursor. Nothing blocks."""
+        self.drain()
+        snap = {c: getattr(fr, c) for c in _COLS}
+        snap["cursor"] = fr.cursor
+        for arr in snap.values():
+            copy = getattr(arr, "copy_to_host_async", None)
+            if copy is not None:
+                copy()
+        self._pending = snap
+
+    def seed_cursor(self, cursor_raw: int) -> None:
+        """Start the drain window at an existing ring cursor (a
+        checkpoint resume): hops before it were drained — and
+        reported — by the run that wrote the checkpoint."""
+        self._prev_cursor_raw = int(cursor_raw) & 0xFFFFFFFF
+        self._cursor_total = int(cursor_raw)
+
+    def drain(self) -> None:
+        """Materialize and decode the pending snapshot, if any."""
+        if self._pending is None:
+            return
+        snap, self._pending = self._pending, None
+        cols = {c: np.asarray(snap[c]) for c in _COLS}
+        cur_raw = int(np.asarray(snap["cursor"]))
+        delta = int(unwrap_u32(self._prev_cursor_raw, cur_raw))
+        self._prev_cursor_raw = cur_raw
+        if delta == 0:
+            return
+        R = cols["ev_kind"].shape[0]
+        lost = max(0, delta - R)
+        if lost:
+            # no silent truncation: the overwritten count is first-class
+            self.overwritten += lost
+            log.error(
+                "flight-recorder trace ring overflowed: %d hop event(s) "
+                "overwritten before the drain (ring=%d); shorten the "
+                "harvest interval, raise flight_recorder.ring, or run "
+                "capacity.mode=elastic to grow it", lost, R)
+        start = self._cursor_total + lost
+        end = self._cursor_total + delta
+        self._cursor_total = end
+        for p in range(start, end):
+            j = p % R
+            rec = {
+                "kind": HOP_NAMES.get(int(cols["ev_kind"][j]),
+                                      str(int(cols["ev_kind"][j]))),
+                "src": int(cols["ev_src"][j]),
+                "seq": int(cols["ev_seq"][j]),
+                "dst": int(cols["ev_dst"][j]),
+                "win": int(cols["ev_win"][j]),
+                "t_ns": int(cols["ev_win"][j]) * self.window_ns
+                + int(cols["ev_t"][j]),
+            }
+            self._write(rec)
+
+    def finalize(self) -> None:
+        """Drain the pending snapshot and flush/close the sink.
+        Idempotent."""
+        self.drain()
+        if self._sink is not None:
+            self._sink.flush()
+            if self._own_sink:
+                self._sink.close()
+                self._sink = None
+
+    # -- growth (elastic capacity participation) -------------------------
+
+    def want_growth(self) -> bool:
+        """True when a drain reported overwritten events since the last
+        growth — the elastic driver's cue to `grow_ring` (and
+        retrace)."""
+        return self.overwritten > self._grown_at
+
+    def note_grown(self) -> None:
+        self._grown_at = self.overwritten
+
+    # -- emission --------------------------------------------------------
+
+    def _write(self, rec: dict) -> None:
+        if self._sink is not None:
+            self._sink.write(json.dumps(rec, sort_keys=True) + "\n")
+        if self._retain:
+            self.hops.append(rec)
+        self.recorded += 1
+
+    def summary(self) -> dict:
+        """JSON-ready drain summary for driver records."""
+        return {
+            "recorded_hops": self.recorded,
+            "overwritten": self.overwritten,
+            "sink": self.sink_path,
+        }
+
+
+def read_hops(lines) -> list[dict]:
+    """Parse a hops JSONL stream back into hop dicts (the report/export
+    input path)."""
+    out = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "kind" in rec:
+            out.append(rec)
+    return out
+
+
+def hop_flows(hops: list[dict]) -> dict[tuple[int, int], list[dict]]:
+    """Group hops by packet identity (src, seq), each group in hop
+    order — the Perfetto flow-event builder's input."""
+    flows: dict[tuple[int, int], list[dict]] = {}
+    for h in hops:
+        flows.setdefault((h["src"], h["seq"]), []).append(h)
+    for group in flows.values():
+        group.sort(key=lambda h: (h["t_ns"], h["kind"]))
+    return flows
+
+
+def flightrec_meta(fr: FlightRecArrays) -> dict:
+    """Static recorder parameters for checkpoint meta / run records."""
+    return {
+        "sample_every": int(np.asarray(fr.sample_every)),
+        "ring": ring_capacity(fr),
+    }

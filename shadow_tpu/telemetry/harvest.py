@@ -203,7 +203,17 @@ class TelemetryHarvester:
               cpu: Optional[dict[int, dict]]) -> None:
         per_host = {k: v for k, v in device.items() if np.ndim(v) == 1}
         scalars = {k: int(v) for k, v in device.items() if np.ndim(v) == 0}
+        # [N, B] leaves are per-host log2 histograms (telemetry/histo.py,
+        # conventionally `hist_`-prefixed): the sim line carries the
+        # fleet-summed bucket vector per histogram, host lines each
+        # host's own row — raw unwrapped counts, so percentile math
+        # downstream (report/export) stays exact and byte-stable
+        hists = {k: v for k, v in device.items() if np.ndim(v) == 2}
         sim: dict = {"type": "sim", "time_ns": time_ns}
+        if hists:
+            sim["hist"] = {
+                k: [int(x) for x in v.sum(axis=0)]
+                for k, v in sorted(hists.items())}
         if self._events:
             # resize & co. ride the heartbeat stream once, in order
             # ("annotations", not "events" — that name is the
@@ -235,13 +245,20 @@ class TelemetryHarvester:
         if not self._per_host:
             return
         n = max((v.shape[0] for v in per_host.values()), default=0)
+        n = max(n, max((v.shape[0] for v in hists.values()), default=0))
         ids = set(range(1, n + 1)) | set(cpu.keys() if cpu else ())
         for hid in sorted(ids):
             rec: dict = {"type": "host", "time_ns": time_ns,
                          "host_id": hid, "host": self._host_name(hid - 1)}
             if per_host and hid - 1 < n:
                 rec["device"] = {k: int(v[hid - 1])
-                                 for k, v in sorted(per_host.items())}
+                                 for k, v in sorted(per_host.items())
+                                 if hid - 1 < v.shape[0]}
+            if hists and hid - 1 < n:
+                rec["hist"] = {
+                    k: [int(x) for x in v[hid - 1]]
+                    for k, v in sorted(hists.items())
+                    if hid - 1 < v.shape[0]}
             if cpu and hid in cpu:
                 rec["cpu"] = cpu[hid]
             self._write(rec)

@@ -1,0 +1,172 @@
+"""On-device log2-bucketed latency/depth histograms (`PlaneHistograms`).
+
+The scalar counters in `telemetry/metrics.py` answer "how much" but not
+"how bad": a p99 delivery latency under incast, or how often a queue ran
+deep, are DISTRIBUTION questions the telemetry plane could not answer
+without host-side replay. This module is the batched-SoA equivalent of
+an HDR histogram: per-host `[N, B]` int32 bucket matrices where bucket
+``b`` counts observations in ``[2^b, 2^(b+1))`` nanoseconds (or queue
+slots), accumulated ON DEVICE with pure `jnp` one-hot sums and
+scatter-adds inside the existing jitted kernels — under the exact rules
+`PlaneMetrics` obeys (docs/observability.md):
+
+1. **Zero host syncs on the hot path.** Histograms ride the kernel
+   carry as a static presence switch (`window_step(..., hist=None)`
+   compiles the section out) and are only pulled by the
+   `TelemetryHarvester`'s asynchronous drain.
+2. **Bitwise-invisible to the simulation.** Every bucket is computed
+   from values the window step already materialized; nothing feeds
+   back. tests/test_flightrec.py pins hist-on == hist-off state
+   bitwise across the qdisc matrix (plus faults-on and workload-on
+   worlds).
+3. **Dtype discipline.** Buckets are int32 and wrap modulo 2^32 like
+   every modular counter; the harvester delta-unwraps them per
+   interval (`harvest.unwrap_u32`), so percentiles computed from the
+   unwrapped totals are exact. The bucket index itself is pure integer
+   arithmetic (`31 - clz(v)`), never a float log2 — a float32 log near
+   a power-of-two boundary would misbucket and break bitwise replay.
+
+Percentile extraction (`percentile`/`percentiles`) happens HOST-SIDE on
+the unwrapped totals and reports the bucket's UPPER edge — a
+conservative bound, exact to within the 2x bucket resolution, which is
+what a log-bucketed histogram promises (the HDR trade: O(B) memory for
+bounded relative error at any scale).
+
+This module is dependency-light (jax/numpy only): `tpu/plane.py`
+imports it, never the other way around.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: log2 buckets: bucket b counts values v with floor(log2(max(v, 1)))
+#: == b, i.e. [2^b, 2^(b+1)) for v >= 1; bucket 0 also absorbs v <= 1
+#: (sub-2ns latencies / empty-or-single-slot queues). 32 buckets cover
+#: the whole int32 ns domain (2^31 ns ~ 2.1 s, the device window
+#: budget) with no clipping ambiguity.
+HIST_BUCKETS = 32
+
+#: the standard SLO quantiles the report surfaces
+QUANTILES = (0.5, 0.9, 0.99, 0.999)
+
+#: harvester/export key prefix marking a [N, B] histogram leaf in a
+#: device-counter dict (harvest.py splits on leaf rank, this prefix
+#: keeps the JSONL namespace self-describing)
+HIST_PREFIX = "hist_"
+
+
+class PlaneHistograms(NamedTuple):
+    """Accumulating device histograms; every leaf is [N, B] int32,
+    modular 2^32 (delta-unwrapped by the harvester)."""
+
+    #: delivery latency (deliver - send instant, i.e. wire latency plus
+    #: the round-barrier clamp) per packet, attributed to the
+    #: DESTINATION host — the consumer's view, the "p99 under incast"
+    #: question
+    hist_delivery_ns: jax.Array
+    #: egress-queue sojourn: how long a packet waited in its source's
+    #: egress ring (token-bucket backlog) before clearing the gate,
+    #: attributed to the SOURCE host
+    hist_sojourn_ns: jax.Array
+    #: queue-depth samples: one observation per host per window (egress
+    #: occupancy at entry + ingress occupancy after the arrival merge)
+    #: plus one per ingest_rows append (post-append egress occupancy) —
+    #: bucket b counts samples with depth in [2^b, 2^(b+1))
+    hist_qdepth: jax.Array
+
+
+def make_histograms(n_hosts: int) -> PlaneHistograms:
+    """A zeroed histogram pytree for `n_hosts` hosts."""
+    z = lambda: jnp.zeros((n_hosts, HIST_BUCKETS), jnp.int32)
+    return PlaneHistograms(
+        hist_delivery_ns=z(), hist_sojourn_ns=z(), hist_qdepth=z())
+
+
+def hist_names() -> tuple[str, ...]:
+    """Leaf names in pytree order (the harvester's histogram keys)."""
+    return tuple(PlaneHistograms._fields)
+
+
+# -- device-side accumulation (pure jnp; safe inside jit) -----------------
+
+
+def bucket_index(values: jax.Array) -> jax.Array:
+    """log2 bucket of int32 values: floor(log2(max(v, 1))), clipped to
+    [0, HIST_BUCKETS). Pure integer arithmetic via count-leading-zeros —
+    exact at every power-of-two boundary (a float32 log2 is not)."""
+    v = jnp.maximum(values.astype(jnp.int32), 1)
+    return jnp.clip(31 - jax.lax.clz(v), 0, HIST_BUCKETS - 1)
+
+
+def accum_rows(h: jax.Array, bucket: jax.Array,
+               mask: jax.Array) -> jax.Array:
+    """Fold [N, C] per-slot observations into the [N, B] histogram of
+    the ROW (source-attributed): a one-hot compare + sum, no scatter
+    dispatch (shards cleanly along the host axis)."""
+    onehot = (bucket[:, :, None]
+              == jnp.arange(HIST_BUCKETS, dtype=jnp.int32)) \
+        & mask[:, :, None]
+    return h + onehot.sum(axis=1, dtype=jnp.int32)
+
+
+def accum_scatter(h: jax.Array, rows: jax.Array, bucket: jax.Array,
+                  mask: jax.Array) -> jax.Array:
+    """Fold [N, C] per-slot observations into the [N, B] histogram of
+    an arbitrary target row per slot (destination-attributed): one
+    2-D scatter-add. int32 adds commute exactly, so the result is
+    bitwise-identical under any sharding/execution order. Out-of-range
+    rows must be pre-masked by the caller."""
+    n = h.shape[0]
+    r = jnp.clip(rows, 0, n - 1).reshape(-1)
+    return h.at[r, bucket.reshape(-1)].add(
+        mask.reshape(-1).astype(jnp.int32), mode="drop")
+
+
+def accum_depth(h: jax.Array, depth: jax.Array) -> jax.Array:
+    """One depth observation per host ([N] int32 occupancy) into the
+    [N, B] histogram."""
+    onehot = (bucket_index(depth)[:, None]
+              == jnp.arange(HIST_BUCKETS, dtype=jnp.int32))
+    return h + onehot.astype(jnp.int32)
+
+
+# -- host-side percentile extraction (outside jit; unwrapped totals) ------
+
+
+def bucket_edges(b: int) -> tuple[int, int]:
+    """[lo, hi) value bounds of bucket ``b`` (lo of bucket 0 is 0: it
+    absorbs sub-2 observations)."""
+    return (0 if b == 0 else 1 << b, 1 << (b + 1))
+
+
+def percentile(counts, q: float) -> int:
+    """The q-quantile's conservative upper bound from a bucket-count
+    vector ([B] ints): the UPPER edge of the first bucket whose
+    cumulative count reaches ceil(q * total). 0 when the histogram is
+    empty. Exact to within the 2x log-bucket resolution."""
+    c = np.asarray(counts, np.int64)
+    total = int(c.sum())
+    if total <= 0:
+        return 0
+    need = int(np.ceil(q * total))
+    need = max(need, 1)
+    cum = np.cumsum(c)
+    b = int(np.searchsorted(cum, need))
+    return bucket_edges(min(b, HIST_BUCKETS - 1))[1]
+
+
+def percentiles(counts, qs=QUANTILES) -> dict:
+    """{"p50": ..., "p99": ..., ...} upper-bound values for the given
+    quantiles; keys are the conventional percentile labels (0.5 -> p50,
+    0.9 -> p90, 0.99 -> p99, 0.999 -> p999)."""
+    out = {}
+    for q in qs:
+        digits = f"{q:g}".split(".")[1]
+        key = "p" + (digits + "0" if len(digits) == 1 else digits)
+        out[key] = percentile(counts, q)
+    return out

@@ -22,6 +22,12 @@ existing jitted kernels, under three hard rules:
    per harvest interval (`harvest.unwrap_u32`), so wraparound is safe
    as long as any single counter moves < 2^31 between harvests.
 
+Counters answer "how much"; their DISTRIBUTION twins live in
+`telemetry/histo.py` (log2-bucketed latency/queue-depth histograms,
+threaded as the `hist=` presence switch under the same three rules)
+and `telemetry/flightrec.py` (the sampled per-packet hop recorder) —
+docs/observability.md "Distributions and the flight recorder".
+
 This module is dependency-light (jax/numpy only): `tpu/plane.py`
 imports it, never the other way around.
 """

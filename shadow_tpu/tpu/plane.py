@@ -39,6 +39,10 @@ import numpy as np
 from ..faults.plane import FaultArrays
 from ..guards.plane import GuardState
 from ..guards import plane as guards_plane
+from ..telemetry import flightrec as flightrec_mod
+from ..telemetry import histo
+from ..telemetry.flightrec import FlightRecArrays
+from ..telemetry.histo import PlaneHistograms
 from ..telemetry.metrics import PlaneMetrics
 from . import codel
 
@@ -601,7 +605,9 @@ def ingest_rows(state: NetPlaneState, dst: jax.Array, nbytes: jax.Array,
                 packed_sort: bool = True,
                 gate_idle: bool = True,
                 metrics: PlaneMetrics | None = None,
-                guards: GuardState | None = None):
+                guards: GuardState | None = None,
+                hist: PlaneHistograms | None = None,
+                flightrec: FlightRecArrays | None = None):
     """Append per-host batches ([N, K] arrays, row = emitting host) to the
     egress queues. The row-shaped twin of `ingest` for producers that are
     already host-major (on-device respawn loops, per-host socket emitters):
@@ -624,7 +630,15 @@ def ingest_rows(state: NetPlaneState, dst: jax.Array, nbytes: jax.Array,
 
     `guards` (static presence, docs/robustness.md) appends append-
     conservation checking to the return, exactly like `ingest`: each
-    row must gain (incoming valid - overflow) entries. Pure reads."""
+    row must gain (incoming valid - overflow) entries. Pure reads.
+
+    `hist` (static presence, docs/observability.md "Distributions and
+    the flight recorder") samples the post-append egress occupancy
+    into the queue-depth histogram; `flightrec` records an `ingest`
+    hop for every sampled appended packet. Both are pure reads over
+    values the merge already materialized and append to the return
+    after metrics/guards: (state'[, metrics'][, guards'][, hist']
+    [, flightrec'])."""
     N, CE = state.eg_dst.shape
     if send_rel is None:
         send_rel = jnp.zeros_like(seq)
@@ -707,6 +721,37 @@ def ingest_rows(state: NetPlaneState, dst: jax.Array, nbytes: jax.Array,
             drop_ring_full=metrics.drop_ring_full + overflow_delta),)
     if guards is not None:
         out += (guards,)
+    if hist is not None:
+        # queue-depth sample at the append point (post-merge egress
+        # occupancy) — pure read, nothing feeds back
+        out += (hist._replace(hist_qdepth=histo.accum_depth(
+            hist.hist_qdepth,
+            new_state.eg_valid.sum(axis=1, dtype=jnp.int32))),)
+    if flightrec is not None:
+        # `ingest` hop per sampled ACCEPTED packet, stamped with the
+        # UPCOMING window's counter (appends ride between windows) and
+        # the emission offset relative to its start. Overflow-dropped
+        # batch entries never entered the ring, so they record no hop
+        # — their loss is the aggregate drop_ring_full counter, and a
+        # phantom `ingest` would read as "queued" to a trace reader.
+        # Accepted = the first (CE - occupancy) valid entries per row,
+        # exactly the prefix the merge keeps (new entries append after
+        # the existing ones in column order).
+        rows = jnp.broadcast_to(
+            jnp.arange(N, dtype=jnp.int32)[:, None], valid.shape)
+        samp = flightrec_mod.sample_mask(flightrec, rows, seq)
+        valid_i = valid.astype(jnp.int32)
+        new_rank = jnp.cumsum(valid_i, axis=1) - valid_i
+        free = (jnp.int32(CE)
+                - state.eg_valid.sum(axis=1, dtype=jnp.int32))
+        accepted = valid & (new_rank < free[:, None])
+        flat = lambda a: a.reshape(-1)
+        flightrec = flightrec_mod.record_events(
+            flightrec,
+            jnp.full((valid.size,), flightrec_mod.HOP_INGEST, jnp.int32),
+            flat(rows), flat(seq), flat(dst), flat(send_rel),
+            flat(accepted & samp))
+        out += (flightrec,)
     return out if len(out) > 1 else new_state
 
 
@@ -1261,7 +1306,9 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
                 kernel: str = "xla",
                 faults: FaultArrays | None = None,
                 metrics: PlaneMetrics | None = None,
-                guards: GuardState | None = None):
+                guards: GuardState | None = None,
+                hist: PlaneHistograms | None = None,
+                flightrec: FlightRecArrays | None = None):
     """Advance one scheduling round [t, t + window_ns).
 
     `rr_enabled` is a static (trace-time) switch: False compiles the
@@ -1329,15 +1376,34 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     identical; pinned by tests/test_guards.py). XLA kernel only, like
     faults.
 
+    `hist` (static presence switch, docs/observability.md
+    "Distributions and the flight recorder") threads the log2-bucketed
+    `PlaneHistograms`: delivery latency (deliver - send, attributed to
+    the destination), egress-queue sojourn (attributed to the source),
+    and a per-window queue-depth sample accumulate with pure jnp
+    one-hot sums / int32 scatter-adds over values the step already
+    materialized — bitwise-invisible to simulation state, metrics, and
+    guards (tests/test_flightrec.py). hist=None compiles the section
+    out. XLA kernel only, like faults and guards.
+
+    `flightrec` (static presence switch, same doc) threads the sampled
+    flight recorder (`telemetry/flightrec.py`): packets whose
+    (src, seq) hashes into the seeded 1/K sampling stream record their
+    per-hop events (routed, delivered, dropped-with-reason, AQM
+    verdict) into the device-side trace ring, drained asynchronously
+    at harvest boundaries. The sampling draw is an independent
+    counter-based stream (like fault corruption), so recording never
+    perturbs the simulation. XLA kernel only.
+
     `shift_ns` = this window's start minus the previous window's start;
     stored relative times are rebased by it. Returns
-    (state', delivered, next_event_rel) — plus metrics' and/or guards'
-    appended in that order when the respective pytrees were passed —
-    where `delivered` is a dict of [N, CI] arrays masked by
-    delivered['mask'] (packets that arrived within this window, in
-    deterministic (deliver_t, src, seq) order per host) and
-    `next_event_rel` is the min pending delivery time relative to the
-    new window start (INT32_MAX when idle).
+    (state', delivered, next_event_rel) — plus metrics', guards',
+    hist', and/or flightrec' appended in that order when the
+    respective pytrees were passed — where `delivered` is a dict of
+    [N, CI] arrays masked by delivered['mask'] (packets that arrived
+    within this window, in deterministic (deliver_t, src, seq) order
+    per host) and `next_event_rel` is the min pending delivery time
+    relative to the new window start (INT32_MAX when idle).
     """
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown plane kernel {kernel!r}: "
@@ -1364,6 +1430,13 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
             "with kernel='xla' when a GuardState pytree is threaded (the "
             "self-healing kernel fallback in faults/healing.py does this "
             "automatically)")
+    if kernel == "pallas" and (hist is not None or flightrec is not None):
+        raise ValueError(
+            "plane_kernel='pallas' does not fuse the histogram/flight-"
+            "recorder observability plane; compile with kernel='xla' "
+            "when a PlaneHistograms or FlightRecArrays pytree is "
+            "threaded (the self-healing kernel fallback in "
+            "faults/healing.py does this automatically)")
     N, CE = state.eg_dst.shape
 
     # --- 1. rebase clocks + refill token buckets -----------------------
@@ -1614,4 +1687,96 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
             rng_delta=rng_counter - state.rng_counter,
             egress_cap=CE, shift_ns=shift_ns, window_ns=window_ns)
         out += (guards,)
+    if hist is not None:
+        # --- 10. latency/depth histograms (static; compiled out when
+        # off) — pure reads over already-materialized values, like the
+        # metrics section (docs/observability.md "Distributions and
+        # the flight recorder")
+        hist = PlaneHistograms(
+            # deliver - send: wire latency + the round-barrier clamp,
+            # attributed to the DESTINATION (the consumer's view —
+            # "p99 delivery latency under incast" is a per-receiver
+            # question); int32 scatter-adds commute exactly
+            hist_delivery_ns=histo.accum_scatter(
+                hist.hist_delivery_ns, eg_dst,
+                histo.bucket_index(deliver_rel - eg_tsend), sent),
+            # egress sojourn: a packet carried from k windows back has
+            # a negative rebased send time; -tsend is exactly how long
+            # it waited for bandwidth (fresh sends land in bucket 0)
+            hist_sojourn_ns=histo.accum_rows(
+                hist.hist_sojourn_ns,
+                histo.bucket_index(-eg_tsend), sent),
+            hist_qdepth=histo.accum_depth(
+                hist.hist_qdepth,
+                state.eg_valid.sum(axis=1, dtype=jnp.int32)
+                + in_valid_m.sum(axis=1, dtype=jnp.int32)),
+        )
+        out += (hist,)
+    if flightrec is not None:
+        # --- 11. sampled flight recorder (static; compiled out when
+        # off): per-hop events for the ~1/K packets whose (src, seq)
+        # hashes into the seeded sampling stream — an independent
+        # counter-based draw, so recording never perturbs the
+        # simulation (docs/determinism.md). Candidate classes
+        # concatenate in a fixed layout order (routed, loss-drop,
+        # fault-drop, delivered, AQM-drop), so the ring content is a
+        # pure function of the event stream. Ring-overflow drops at
+        # routing are aggregate-counted only (metrics drop_ring_full);
+        # a per-slot overflow identity is not materialized.
+        rows_e = jnp.broadcast_to(
+            jnp.arange(N, dtype=jnp.int32)[:, None], eg_dst.shape)
+        samp_eg = flightrec_mod.sample_mask(flightrec, rows_e, eg_seq)
+        flat = lambda a: a.reshape(-1)
+        kind_of = lambda k, ref: jnp.full((ref.size,), k, jnp.int32)
+        ev_kind = [kind_of(flightrec_mod.HOP_ROUTED, eg_dst),
+                   kind_of(flightrec_mod.HOP_DROP_LOSS, eg_dst)]
+        ev_src = [flat(rows_e), flat(rows_e)]
+        ev_seq = [flat(eg_seq), flat(eg_seq)]
+        ev_dst = [flat(eg_dst), flat(eg_dst)]
+        ev_t = [flat(eg_tsend), flat(eg_tsend)]
+        ev_mask = [flat(sent & samp_eg), flat(lost & samp_eg)]
+        if faults is not None:
+            # every fault-drop class the step distinguishes: source
+            # purge (crashed/link-down sender), burst corruption, AND
+            # the destination-blocked route withdrawal — a sampled
+            # packet eaten by its destination's crash must record a
+            # drop_fault hop, not silently vanish from the hop stream
+            # while metrics.drop_fault counts it
+            ev_kind.append(kind_of(flightrec_mod.HOP_DROP_FAULT, eg_dst))
+            ev_src.append(flat(rows_e))
+            ev_seq.append(flat(eg_seq))
+            ev_dst.append(flat(eg_dst))
+            ev_t.append(flat(eg_tsend))
+            ev_mask.append(flat(
+                (fault_purged | corrupt | blocked_dst) & samp_eg))
+        d_rows = jnp.broadcast_to(
+            jnp.arange(N, dtype=jnp.int32)[:, None],
+            delivered["mask"].shape)
+        samp_d = flightrec_mod.sample_mask(
+            flightrec, delivered["src"], delivered["seq"])
+        ev_kind.append(kind_of(flightrec_mod.HOP_DELIVERED,
+                               delivered["mask"]))
+        ev_src.append(flat(delivered["src"]))
+        ev_seq.append(flat(delivered["seq"]))
+        ev_dst.append(flat(d_rows))
+        ev_t.append(flat(delivered["deliver_rel"]))
+        ev_mask.append(flat(delivered["mask"] & samp_d))
+        if router_aqm:
+            a_rows = jnp.broadcast_to(
+                jnp.arange(N, dtype=jnp.int32)[:, None], src_s2.shape)
+            samp_a = flightrec_mod.sample_mask(flightrec, src_s2, seq_s2)
+            ev_kind.append(kind_of(flightrec_mod.HOP_DROP_AQM, src_s2))
+            ev_src.append(flat(src_s2))
+            ev_seq.append(flat(seq_s2))
+            ev_dst.append(flat(a_rows))
+            ev_t.append(flat(arr_s))
+            ev_mask.append(flat(valid_s2
+                                & (rstatus == codel.STATUS_DROPPED)
+                                & samp_a))
+        flightrec = flightrec_mod.record_events(
+            flightrec,
+            jnp.concatenate(ev_kind), jnp.concatenate(ev_src),
+            jnp.concatenate(ev_seq), jnp.concatenate(ev_dst),
+            jnp.concatenate(ev_t), jnp.concatenate(ev_mask))
+        out += (flightrec_mod.advance_window(flightrec),)
     return out

@@ -53,6 +53,15 @@ Sections (superset of the window step's numbered stages):
   capacity"). Gated in CI chaos-smoke against ``window_step`` at the
   same 1.35x budget: an idle elastic run (nothing overflows) must cost
   essentially nothing over the plain step.
+- ``window_step_trace`` — the full step with BOTH halves of the
+  distribution/flight-recorder observability plane threaded
+  (`telemetry/histo.PlaneHistograms` + `telemetry/flightrec.
+  FlightRecArrays` at sample_every=64, docs/observability.md
+  "Distributions and the flight recorder"). The CI perf-smoke job
+  GATES on its ratio against ``window_step`` (<= 1.35) like the
+  telemetry section: histogram one-hot sums, the sampling threefry,
+  and the trace-ring compaction may never cost the hot path a sync or
+  material compute.
 - ``window_step_workload`` — the full step plus the workload plane's
   `workload_step` (`shadow_tpu/workloads/device.py`, an onoff traffic
   program at the bench shape): phase-pointer advance + table-driven
@@ -85,7 +94,7 @@ DEFAULT_SECTIONS = (
     "routing_place", "release_due", "codel_drain", "egress_compact",
     "ingest_rows", "window_step", "window_step_telemetry",
     "window_step_faults", "window_step_guards", "window_step_elastic",
-    "window_step_workload",
+    "window_step_trace", "window_step_workload",
 )
 
 #: the cheap per-section subset bench.py records in its JSON `sections`
@@ -195,6 +204,8 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
 
     from ..faults.plane import neutral_faults as _neutral_faults
     from ..guards.plane import make_guards as _clean_guards
+    from ..telemetry import make_flightrec as _fresh_flightrec
+    from ..telemetry import make_histograms as _zero_hist
     from ..telemetry import make_metrics as _zero_metrics
 
     wanted = tuple(sections) if sections is not None else DEFAULT_SECTIONS
@@ -390,6 +401,17 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
                 st, params, rng_root, sh, window, rr_enabled=rr_enabled,
                 packed_sort=packed_sort, kernel="xla", guards=g)),
             (state, _clean_guards(n_hosts), shift)),
+        "window_step_trace": (
+            # the flight recorder + histograms (docs/observability.md
+            # "Distributions and the flight recorder"); like faults/
+            # guards, the observability plane refuses the pallas
+            # fusion — pin xla
+            jax.jit(lambda st, h, f, sh: window_step(
+                st, params, rng_root, sh, window, rr_enabled=rr_enabled,
+                packed_sort=packed_sort, kernel="xla", hist=h,
+                flightrec=f)),
+            (state, _zero_hist(n_hosts),
+             _fresh_flightrec(0, sample_every=64, ring=4096), shift)),
         "window_step_elastic": (
             # the elastic driver's per-window cost: the step + the
             # per-ring overflow deltas it reads back to decide growth

@@ -156,6 +156,32 @@ def make_transport_guard():
     )
 
 
+class TransportHist(NamedTuple):
+    """Per-destination log2 histograms for the transport kernels
+    (docs/observability.md "Distributions and the flight recorder").
+    Threaded as a static presence switch like `TransportGuard`
+    (`enable_histograms()`; disabled compiles the section out), pure
+    jnp adds over values the kernels already materialized, harvested
+    through `histogram_arrays()` + the async TelemetryHarvester drain
+    and delta-unwrapped like every modular counter."""
+
+    #: [N, B] int32 — delivery latency (deliver - send, including the
+    #: round-barrier clamp) per packet, attributed to the destination
+    hist_delivery_ns: "jax.Array"
+    #: [N, B] int32 — in-flight ring occupancy sampled once per window
+    #: step, per destination
+    hist_qdepth: "jax.Array"
+
+
+def make_transport_hist(n_hosts: int) -> TransportHist:
+    import jax.numpy as jnp
+
+    from ..telemetry.histo import HIST_BUCKETS
+
+    z = lambda: jnp.zeros((n_hosts, HIST_BUCKETS), jnp.int32)
+    return TransportHist(hist_delivery_ns=z(), hist_qdepth=z())
+
+
 class DeviceTransport:
     def __init__(self, hosts, routing, ip_to_node_id, *,
                  egress_cap: int = 256, ingress_cap: int = 256,
@@ -231,6 +257,10 @@ class DeviceTransport:
         # (static presence switch — disabled compiles the checks out)
         self._guards_enabled = False
         self._guard = None
+        # histogram plane (docs/observability.md "Distributions and the
+        # flight recorder"): enable_histograms() threads a TransportHist
+        # pytree through every kernel dispatch (static presence switch)
+        self._hist = None
         # CPU-side ledgers for cross-plane reconciliation
         # (guards/reconcile.py): the same capture/release events the
         # device kernels count, mirrored independently in numpy. The
@@ -330,17 +360,38 @@ class DeviceTransport:
                 windows=g.windows + 1,
             )
 
-        def ingest(st: TransportState, src, dst, seq, tag, send_rel,
+        def hist_step(h, st: TransportState):
+            """Histogram plane (static presence: h=None compiles this
+            out): one in-flight-occupancy sample per destination per
+            window step. Pure read."""
+            if h is None:
+                return None
+            from ..telemetry import histo
+
+            return h._replace(hist_qdepth=histo.accum_depth(
+                h.hist_qdepth, st.in_valid.sum(axis=1, dtype=jnp.int32)))
+
+        def ingest(st: TransportState, h, src, dst, seq, tag, send_rel,
                    clamp_rel, valid):
             """Place a capture batch ([B] columns, times relative to the
             device base) into per-destination free slots; deliver time
             computed here, bit-identical to the CPU (`worker.rs:396-399`):
-            max(send + latency, send-round end)."""
+            max(send + latency, send-round end). `h` (static presence)
+            accumulates each placed packet's delivery latency
+            (deliver - send) into the destination's log2 histogram;
+            returns (st', h')."""
             B = src.shape[0]
             sc = jnp.clip(src, 0, N - 1)
             dc = jnp.clip(dst, 0, N - 1)
             lat = latency[host_node[sc], host_node[dc]]
             deliver = jnp.maximum(send_rel + lat, clamp_rel)
+            if h is not None:
+                from ..telemetry import histo
+
+                h = h._replace(hist_delivery_ns=histo.accum_scatter(
+                    h.hist_delivery_ns, dc,
+                    histo.bucket_index(deliver - send_rel),
+                    valid & (dst >= 0) & (dst < N)))
             # group by destination (stable: batch order preserved within)
             dkey = jnp.where(valid, dst, N)
             # shadowlint: disable=SL403 -- compact-cap capture batch, not the N*CE flat hot path; bucketed-diet follow-up tracked in docs/performance.md
@@ -377,7 +428,7 @@ class DeviceTransport:
                 # src on pad slots falls off via mode="drop")
                 n_out=st.n_out.at[o_src].add(
                     o_valid & (o_dst < N), mode="drop"),
-            )
+            ), h
 
         def step(st: TransportState, shift, window):
             """One window [0, window) after rebasing by shift: release =
@@ -403,22 +454,23 @@ class DeviceTransport:
             fp2 = jnp.where(due, h2, jnp.uint32(0)).sum(dtype=jnp.uint32)
             return fp1, fp2, due.sum(dtype=jnp.int32)
 
-        def step_compact(st, g, shift, window):
+        def step_compact(st, g, h, shift, window):
             """Sync mode: one window + the released set front-packed into
             [cap] columns for one small D2H transfer (count first; the
             caller raises if count exceeds the compact cap — deliveries
             cannot be dropped, unlike a diagnostic pull)."""
             st, due, deliver, next_rel = step(st, shift, window)
             g = guard_update(g, st, shift, window)
+            h = hist_step(h, st)
             flat = due.reshape(-1)
             idx = jnp.argsort(~flat, stable=True)[:cap]
             take = lambda a: a.reshape(-1)[idx]
             dst = jnp.where(take(due), (idx // CI).astype(jnp.int32), -1)
             comp = (due.sum(dtype=jnp.int32), dst, take(st.in_src),
                     take(st.in_seq), take(st.in_tag), take(deliver))
-            return st, g, comp, next_rel, st.n_overflow.sum()
+            return st, g, h, comp, next_rel, st.n_overflow.sum()
 
-        def chain(st, g, shift0, window0, runahead, horizon, stop):
+        def chain(st, g, h, shift0, window0, runahead, horizon, stop):
             """Sync mode: advance through delivery-free windows on device —
             the boundary rule of `plane.chain_windows` (itself the
             controller's `controller.rs:87-113` chain): the first window
@@ -429,34 +481,37 @@ class DeviceTransport:
             min(runahead, stop - start)."""
             st, due, deliver, next_rel = step(st, shift0, window0)
             g = guard_update(g, st, shift0, window0)
+            h = hist_step(h, st)
             hs = jnp.minimum(horizon, stop)
 
             def cond(c):
-                st, g, due, deliver, off, next_rel, n = c
+                st, g, h, due, deliver, off, next_rel, n = c
                 return (~due.any()) & (next_rel < hs - off) \
                     & (n < jnp.int32(64))
 
             def body(c):
-                st, g, due, deliver, off, next_rel, n = c
+                st, g, h, due, deliver, off, next_rel, n = c
                 off2 = off + next_rel
                 width = jnp.minimum(runahead, stop - off2)
                 st, due, deliver, next2 = step(st, next_rel, width)
                 g = guard_update(g, st, next_rel, width)
-                return (st, g, due, deliver, off2, next2, n + 1)
+                h = hist_step(h, st)
+                return (st, g, h, due, deliver, off2, next2, n + 1)
 
-            st, g, due, deliver, off, next_rel, _n = jax.lax.while_loop(
-                cond, body,
-                (st, g, due, deliver, jnp.int32(0), next_rel,
-                 jnp.int32(1)))
+            st, g, h, due, deliver, off, next_rel, _n = \
+                jax.lax.while_loop(
+                    cond, body,
+                    (st, g, h, due, deliver, jnp.int32(0), next_rel,
+                     jnp.int32(1)))
             flat = due.reshape(-1)
             idx = jnp.argsort(~flat, stable=True)[:cap]
             take = lambda a: a.reshape(-1)[idx]
             dst = jnp.where(take(due), (idx // CI).astype(jnp.int32), -1)
             comp = (due.sum(dtype=jnp.int32), dst, take(st.in_src),
                     take(st.in_seq), take(st.in_tag), take(deliver))
-            return st, g, comp, off, next_rel, st.n_overflow.sum()
+            return st, g, h, comp, off, next_rel, st.n_overflow.sum()
 
-        def batch_verify(st, g, shifts, widths, ing, exp_fp, exp_fp2,
+        def batch_verify(st, g, h, shifts, widths, ing, exp_fp, exp_fp2,
                          exp_n, div):
             """Mirrored mode: K windows per dispatch. Scan body = window
             step -> released-set fingerprint vs the CPU ledger -> ingest
@@ -464,32 +519,33 @@ class DeviceTransport:
             sync mode)."""
 
             def body(carry, xs):
-                st, g, div = carry
+                st, g, h, div = carry
                 shift, width, row, efp, efp2, en = xs
                 st, due, deliver, _next = step(st, shift, width)
                 fp1, fp2, cnt = fingerprint(st, due, deliver)
                 ok = (fp1 == efp) & (fp2 == efp2) & (cnt == en)
-                st = ingest(st, row["src"], row["dst"], row["seq"],
-                            row["tag"], row["send"], row["clamp"],
-                            row["valid"])
+                h = hist_step(h, st)
+                st, h = ingest(st, h, row["src"], row["dst"],
+                               row["seq"], row["tag"], row["send"],
+                               row["clamp"], row["valid"])
                 g = guard_update(g, st, shift, width)
-                return (st, g, jnp.where(ok, div, div + 1)), None
+                return (st, g, h, jnp.where(ok, div, div + 1)), None
 
-            (st, g, div), _ = jax.lax.scan(
-                body, (st, g, div),
+            (st, g, h, div), _ = jax.lax.scan(
+                body, (st, g, h, div),
                 (shifts, widths, ing, exp_fp, exp_fp2, exp_n))
-            return st, g, div
+            return st, g, h, div
 
-        def ingest_guarded(st, g, src, dst, seq, tag, send_rel,
+        def ingest_guarded(st, g, h, src, dst, seq, tag, send_rel,
                            clamp_rel, valid):
             """The standalone ingest dispatch, with the guard check run
             over the post-ingest state (the conservation identity holds
             at every kernel boundary, so an ingest that loses or
             double-places a packet trips here, one dispatch early)."""
-            st = ingest(st, src, dst, seq, tag, send_rel, clamp_rel,
-                        valid)
+            st, h = ingest(st, h, src, dst, seq, tag, send_rel,
+                           clamp_rel, valid)
             # ingest rides between windows: a neutral (0, 0) clock
-            return st, guard_update(g, st, 0, 0)
+            return st, guard_update(g, st, 0, 0), h
 
         # every dispatch donates the TransportState pytree: XLA writes the
         # next window's slot arrays into the incoming buffers instead of
@@ -555,6 +611,25 @@ class DeviceTransport:
             "first_window": int(g.first_window),
             "windows": int(g.windows),
         }
+
+    def enable_histograms(self) -> None:
+        """Thread a `TransportHist` pytree through every kernel
+        dispatch from now on (static presence switch like
+        `enable_guards`): per-destination delivery-latency and
+        in-flight-depth log2 histograms, pure jnp adds, pulled only by
+        the asynchronous harvester via `histogram_arrays()`."""
+        if self._hist is None:
+            self._hist = make_transport_hist(self._n)
+
+    def histogram_arrays(self) -> dict:
+        """Per-host [N, B] histogram counters for the
+        TelemetryHarvester (empty when histograms were never enabled).
+        Same freshness contract as `telemetry_arrays`: the `+ 0`
+        copies are undonated buffers safe for the async D2H drain."""
+        if self._hist is None:
+            return {}
+        return {name: getattr(self._hist, name) + 0
+                for name in TransportHist._fields}
 
     def cpu_ledger(self) -> dict[str, np.ndarray]:
         """The CPU-plane reconciliation ledger: per-host capture /
@@ -753,8 +828,8 @@ class DeviceTransport:
         arr[0, b:] = self._n  # pad slots: out-of-range src
         arr[4, b:] = base_ns
         arr[5, b:] = base_ns
-        self.state, self._guard = self._k_ingest(
-            self.state, self._guard,
+        self.state, self._guard, self._hist = self._k_ingest(
+            self.state, self._guard, self._hist,
             jnp.asarray(arr[0], jnp.int32), jnp.asarray(arr[1], jnp.int32),
             jnp.asarray(arr[2], jnp.int32), jnp.asarray(arr[3], jnp.int32),
             jnp.asarray(arr[4] - base_ns, jnp.int32),
@@ -810,18 +885,19 @@ class DeviceTransport:
             horizon_rel = min((horizon_ns if horizon_ns is not None
                                else stop_ns) - start_ns, clamp)
             stop_rel = min(stop_ns - start_ns, clamp)
-            self.state, self._guard, comp, off, next_rel, overflow = \
-                self._k_chain(
-                    self.state, self._guard, jnp.int32(shift),
-                    jnp.int32(end_ns - start_ns),
-                    jnp.int32(runahead_ns), jnp.int32(horizon_rel),
-                    jnp.int32(stop_rel),
-                )
+            (self.state, self._guard, self._hist, comp, off, next_rel,
+             overflow) = self._k_chain(
+                self.state, self._guard, self._hist, jnp.int32(shift),
+                jnp.int32(end_ns - start_ns),
+                jnp.int32(runahead_ns), jnp.int32(horizon_rel),
+                jnp.int32(stop_rel),
+            )
             base_ns = start_ns + int(off)
         else:
-            self.state, self._guard, comp, next_rel, overflow = \
-                self._k_step(
-                    self.state, self._guard, jnp.int32(shift),
+            self.state, self._guard, self._hist, comp, next_rel, \
+                overflow = self._k_step(
+                    self.state, self._guard, self._hist,
+                    jnp.int32(shift),
                     jnp.int32(end_ns - start_ns),
                 )
             base_ns = start_ns
@@ -959,12 +1035,13 @@ class DeviceTransport:
             "src": col(0), "dst": col(1), "seq": col(2), "tag": col(3),
             "send": col(4), "clamp": col(5), "valid": jnp.asarray(valid),
         }
-        self.state, self._guard, self._div = self._k_batch_verify(
-            self.state, self._guard, jnp.asarray(shifts),
-            jnp.asarray(widths), row,
-            jnp.asarray(exp_fp), jnp.asarray(exp_fp2), jnp.asarray(exp_n),
-            self._div,
-        )
+        self.state, self._guard, self._hist, self._div = \
+            self._k_batch_verify(
+                self.state, self._guard, self._hist, jnp.asarray(shifts),
+                jnp.asarray(widths), row,
+                jnp.asarray(exp_fp), jnp.asarray(exp_fp2),
+                jnp.asarray(exp_n), self._div,
+            )
         self._dev_base = base
         pool, free = self._pool, self._free
         for start, _end, expected, _batch in records:

@@ -131,15 +131,30 @@ def run_scenario(spec: ScenarioSpec, *,
                  mesh_devices: Optional[int] = None,
                  telemetry=None,
                  telemetry_every: int = 16,
+                 histograms: bool = True,
+                 sample_every: Optional[int] = None,
+                 trace_ring: int = 4096,
+                 hops_sink=None,
                  max_advance: Optional[int] = None) -> dict:
     """Execute one scenario for its full window budget. Returns the
     JSON-ready record (no wall-clock anywhere — byte-stable across
-    runs by construction)."""
+    runs by construction).
+
+    `histograms` (default on) threads the log2 latency/depth
+    distributions and records the per-scenario SLO percentiles
+    (`latency` in the record — the "p99 delivery latency under incast"
+    answer); `sample_every=K` additionally threads the flight recorder
+    (seeded from the scenario seed) and drains sampled hops at the
+    telemetry cadence into `hops_sink` (a path or file object). Both
+    are presence switches: the canonical digest is bitwise-unchanged
+    (docs/observability.md "Distributions and the flight recorder")."""
     import jax
     import jax.numpy as jnp
 
     from ..guards import make_guards, summarize
     from ..telemetry import make_metrics
+    from ..telemetry import flightrec as frmod
+    from ..telemetry import histo
     from ..tpu import elastic
     from ..tpu.plane import window_step
     from . import device as wdevice
@@ -151,6 +166,13 @@ def run_scenario(spec: ScenarioSpec, *,
     N = spec.n_hosts
     metrics = make_metrics(N)
     gstate = make_guards(N) if guards else None
+    hstate = histo.make_histograms(N) if histograms else None
+    fstate = recorder = None
+    if sample_every is not None:
+        fstate = frmod.make_flightrec(
+            spec.seed, sample_every=sample_every, ring=trace_ring)
+        recorder = frmod.FlightRecorder(window_ns=spec.window_ns,
+                                        sink=hops_sink)
     schedule = fault_events
     if schedule is None and use_default_faults:
         schedule = default_fault_schedule(spec)
@@ -164,6 +186,11 @@ def run_scenario(spec: ScenarioSpec, *,
         metrics = _shard_host_axis(metrics, mesh)
         if gstate is not None:
             gstate = _shard_host_axis(gstate, mesh)
+        if hstate is not None:
+            # [N, B] histograms are host-major like every counter
+            hstate = _shard_host_axis(hstate, mesh)
+        # the flight-recorder ring is [R] (not host-major) and stays
+        # replicated; the partitioner gathers the sampled events
     state, ws, metrics = wdevice.prime(wl, ws, state, metrics=metrics)
     rng_root = jax.random.key(spec.seed)
     window = jnp.int32(spec.window_ns)
@@ -171,14 +198,21 @@ def run_scenario(spec: ScenarioSpec, *,
     faulted = schedule is not None
 
     @jax.jit
-    def step(state, ws, metrics, gstate, faults, shift, ridx):
+    def step(state, ws, metrics, gstate, hstate, fstate, faults, shift,
+             ridx):
         out = window_step(state, params, rng_root, shift, window,
                           rr_enabled=False, faults=faults,
-                          metrics=metrics, guards=gstate)
+                          metrics=metrics, guards=gstate,
+                          hist=hstate, flightrec=fstate)
+        state, delivered, _next = out[:3]
+        rest = list(out[3:])
+        metrics = rest.pop(0)
         if gstate is not None:
-            state, delivered, _next, metrics, gstate = out
-        else:
-            state, delivered, _next, metrics = out
+            gstate = rest.pop(0)
+        if hstate is not None:
+            hstate = rest.pop(0)
+        if fstate is not None:
+            fstate = rest.pop(0)
         out = wdevice.workload_step(
             wl, ws, state, delivered, ridx, window, max_advance=adv,
             metrics=metrics, guards=gstate)
@@ -186,7 +220,13 @@ def run_scenario(spec: ScenarioSpec, *,
             state, ws, metrics, gstate = out
         else:
             state, ws, metrics = out
-        return state, ws, metrics, gstate
+        return state, ws, metrics, gstate, hstate, fstate
+
+    def _device_counters():
+        """The harvester's device dict: metrics + histogram leaves."""
+        if hstate is None:
+            return metrics
+        return {**metrics._asdict(), **hstate._asdict()}
 
     annotated = 0
     for r in range(spec.windows):
@@ -196,12 +236,16 @@ def run_scenario(spec: ScenarioSpec, *,
             schedule.advance(now_ns)
             faults = schedule.device_arrays()
         shift = jnp.int32(0 if r == 0 else spec.window_ns)
-        state, ws, metrics, gstate = step(state, ws, metrics, gstate,
-                                          faults, shift, jnp.int32(r))
-        if telemetry is not None and (r + 1) % telemetry_every == 0:
-            annotated = _annotate_phases(
-                telemetry, spec, prog, ws, annotated)
-            telemetry.tick(now_ns, device=metrics)
+        state, ws, metrics, gstate, hstate, fstate = step(
+            state, ws, metrics, gstate, hstate, fstate, faults, shift,
+            jnp.int32(r))
+        if (r + 1) % telemetry_every == 0:
+            if telemetry is not None:
+                annotated = _annotate_phases(
+                    telemetry, spec, prog, ws, annotated)
+                telemetry.tick(now_ns, device=_device_counters())
+            if recorder is not None:
+                recorder.tick(fstate)
 
     jax.block_until_ready(state)
     done_win = wdevice.completion_windows(ws)
@@ -239,6 +283,24 @@ def run_scenario(spec: ScenarioSpec, *,
     }
     if gstate is not None:
         record["guards"] = summarize(gstate)
+    if hstate is not None:
+        # per-scenario SLO percentiles from the fleet-summed final
+        # histograms (docs/observability.md bucket scheme: log2 upper
+        # bounds) — byte-stable ints, "p99 delivery latency under
+        # incast" answered per corpus entry
+        h = jax.device_get(hstate)
+        record["latency"] = {
+            name[len(histo.HIST_PREFIX):] if name.startswith(
+                histo.HIST_PREFIX) else name:
+            histo.percentiles(np.asarray(arr, np.int64).sum(axis=0))
+            for name, arr in h._asdict().items()}
+    if recorder is not None:
+        # final drain: one tick to queue the last ring snapshot, one
+        # materializing drain via finalize (the double-buffer contract)
+        recorder.tick(fstate)
+        recorder.finalize()
+        record["flight_recorder"] = {
+            **recorder.summary(), **frmod.flightrec_meta(fstate)}
     if telemetry is not None:
         # trailing annotations attach to the pending snapshot at the
         # harvester's next drain (finalize); only tick again when the
@@ -247,7 +309,7 @@ def run_scenario(spec: ScenarioSpec, *,
         _annotate_phases(telemetry, spec, prog, ws, annotated)
         if spec.windows % telemetry_every != 0:
             telemetry.tick(spec.windows * spec.window_ns,
-                           device=metrics)
+                           device=_device_counters())
     return record
 
 

@@ -1,0 +1,30 @@
+"""SL405 fixture: host-side float()/.item() reads of device telemetry
+arrays outside the harvest boundary (each BAD line is one finding)."""
+
+import numpy as np
+
+
+def bad_reads(metrics, state, hist):
+    a = float(metrics.pkts_out.sum())  # BAD: float() on a metrics leaf
+    b = metrics.drop_loss.sum().item()  # BAD: .item() on a metrics leaf
+    c = float(state.n_out[0])  # BAD: transport telemetry counter
+    d = hist.hist_delivery_ns.sum().item()  # BAD: histogram leaf
+    e = float(metrics.windows)  # BAD: scalar telemetry leaf
+    return a, b, c, d, e
+
+
+def ok_reads(metrics, totals, weights):
+    # host-side numpy on ALREADY-DRAINED totals is fine: no device read
+    f = float(np.asarray(totals["pkts_out"]).sum())
+    # float()/.item() on non-telemetry values is out of scope
+    g = float(weights[0])
+    h = weights.sum().item()
+    # item with arguments is indexing sugar on a container, not a sync
+    i = totals.item if hasattr(totals, "item") else None
+    return f, g, h, i
+
+
+def justified(metrics):
+    # teardown-only diagnostic pull, documented:
+    # shadowlint: disable=SL405 -- teardown diagnostic, run already over
+    return float(metrics.events)

@@ -1,0 +1,667 @@
+"""Histogram + flight-recorder correctness: the presence parity matrix
+(sim state, metrics, AND guards bitwise-unchanged across rr x aqm x
+no_loss, plus faults-on and workload-on worlds), deterministic sampling,
+trace-ring overwrite/growth semantics, percentile math, the harvester's
+2-D histogram emission, transport histograms, config parsing, Manager
+warnings, and double-run byte-stability of heartbeats/hops/trace.json
+with sampling on (docs/observability.md "Distributions and the flight
+recorder")."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from shadow_tpu.telemetry import (TelemetryHarvester,  # noqa: E402
+                                  make_flightrec, make_histograms,
+                                  make_metrics)
+from shadow_tpu.telemetry import flightrec as frmod  # noqa: E402
+from shadow_tpu.telemetry import histo  # noqa: E402
+from shadow_tpu.telemetry.flightrec import FlightRecorder  # noqa: E402
+from shadow_tpu.tpu import (ingest, ingest_rows, make_params,  # noqa: E402
+                            make_state)
+from shadow_tpu.tpu.plane import window_step  # noqa: E402
+
+MS = 1_000_000
+N = 8
+
+
+def busy_world(rr_mix=True):
+    """The telemetry-test busy world: starved buckets, real loss, mixed
+    qdiscs (tests/test_telemetry.py) — every histogram/hop path gets
+    exercised."""
+    rng = np.random.default_rng(7)
+    lat = rng.integers(1 * MS, 20 * MS, size=(N, N)).astype(np.int32)
+    loss = np.full((N, N), 0.3, np.float32)
+    qrr = (np.arange(N) % 2 == 0) if rr_mix else np.zeros(N, bool)
+    params = make_params(lat, loss, np.full((N,), 80_000, np.int64),
+                         qdisc_rr=qrr, down_bw_bps=np.full((N,), 400_000))
+    state = make_state(N, egress_cap=8, ingress_cap=8, params=params,
+                       initial_tokens=np.asarray(params.tb_cap))
+    b = 48
+    state = ingest(
+        state,
+        jnp.asarray(rng.integers(0, N, b), jnp.int32),
+        jnp.asarray(rng.integers(0, N, b), jnp.int32),
+        jnp.asarray(rng.integers(100, 1500, b), jnp.int32),
+        jnp.asarray(rng.integers(0, 6, b), jnp.int32),
+        jnp.arange(b, dtype=jnp.int32),
+        jnp.asarray(rng.integers(0, 3, b) == 0),
+        sock=jnp.asarray(rng.integers(0, 40, b), jnp.int32),
+    )
+    return state, params
+
+
+def run_windows(state, params, *, windows=4, metrics=None, guards=None,
+                hist=None, fr=None, faults=None, **kw):
+    key = jax.random.key(3)
+
+    @jax.jit
+    def step(state, metrics, guards, hist, fr, shift):
+        out = window_step(state, params, key, shift, jnp.int32(10 * MS),
+                          metrics=metrics, guards=guards, hist=hist,
+                          flightrec=fr, faults=faults, **kw)
+        state, delivered, nxt = out[:3]
+        rest = list(out[3:])
+        if metrics is not None:
+            metrics = rest.pop(0)
+        if guards is not None:
+            guards = rest.pop(0)
+        if hist is not None:
+            hist = rest.pop(0)
+        if fr is not None:
+            fr = rest.pop(0)
+        return state, delivered, nxt, metrics, guards, hist, fr
+
+    shift = jnp.int32(0)
+    out = []
+    for _ in range(windows):
+        state, delivered, nxt, metrics, guards, hist, fr = step(
+            state, metrics, guards, hist, fr, shift)
+        out.append((state, delivered, nxt))
+        shift = jnp.int32(10 * MS)
+    return out, metrics, guards, hist, fr
+
+
+def assert_tree_equal(a, b, ctx=""):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), ctx
+
+
+# -- bucket/percentile units ----------------------------------------------
+
+
+def test_bucket_index_is_exact_integer_log2():
+    vals = jnp.asarray([0, 1, 2, 3, 4, 7, 8, 1023, 1024,
+                        2**24, 2**24 + 1, 2**30, 2**31 - 1], jnp.int32)
+    got = np.asarray(histo.bucket_index(vals)).tolist()
+    want = [0, 0, 1, 1, 2, 2, 3, 9, 10, 24, 24, 30, 30]
+    assert got == want
+    # negative / zero observations land in bucket 0, never wrap
+    assert np.asarray(histo.bucket_index(
+        jnp.asarray([-5, -(2**31) + 1], jnp.int32))).tolist() == [0, 0]
+
+
+def test_percentiles_upper_bounds():
+    counts = np.zeros(histo.HIST_BUCKETS, np.int64)
+    counts[10] = 90  # 90 obs in [1024, 2048)
+    counts[20] = 10  # 10 obs in [2^20, 2^21)
+    assert histo.percentile(counts, 0.5) == 2048
+    assert histo.percentile(counts, 0.9) == 2048
+    assert histo.percentile(counts, 0.99) == 1 << 21
+    assert histo.percentiles(counts) == {
+        "p50": 2048, "p90": 2048, "p99": 1 << 21, "p999": 1 << 21}
+    assert histo.percentile(np.zeros(32, np.int64), 0.99) == 0
+
+
+def test_accum_helpers_count_correctly():
+    h = jnp.zeros((2, histo.HIST_BUCKETS), jnp.int32)
+    bucket = jnp.asarray([[0, 3, 3], [1, 1, 1]], jnp.int32)
+    mask = jnp.asarray([[True, True, False], [True, True, True]])
+    rowwise = np.asarray(histo.accum_rows(h, bucket, mask))
+    assert rowwise[0, 0] == 1 and rowwise[0, 3] == 1
+    assert rowwise[1, 1] == 3
+    rows = jnp.asarray([[1, 1, 0], [0, 0, 0]], jnp.int32)
+    scat = np.asarray(histo.accum_scatter(h, rows, bucket, mask))
+    assert scat[1, 0] == 1 and scat[1, 3] == 1  # attributed to row 1
+    assert scat[0, 1] == 3
+    depth = np.asarray(histo.accum_depth(h, jnp.asarray([5, 0],
+                                                        jnp.int32)))
+    assert depth[0, 2] == 1 and depth[1, 0] == 1
+
+
+# -- sampling determinism -------------------------------------------------
+
+
+def test_sampling_mask_deterministic_and_shape_independent():
+    fr = make_flightrec(11, sample_every=4, ring=64)
+    src = jnp.arange(64, dtype=jnp.int32) % 8
+    seq = jnp.arange(64, dtype=jnp.int32)
+    m1 = np.asarray(frmod.sample_mask(fr, src, seq))
+    m2 = np.asarray(frmod.sample_mask(fr, src.reshape(8, 8),
+                                      seq.reshape(8, 8))).reshape(-1)
+    assert np.array_equal(m1, m2)  # independent of batch shape
+    # a subset sees the same verdicts: pure function of (seed, src, seq)
+    m3 = np.asarray(frmod.sample_mask(fr, src[10:20], seq[10:20]))
+    assert np.array_equal(m1[10:20], m3)
+    # ~1/K of packets tagged (loose: it's a hash)
+    assert 4 <= m1.sum() <= 32
+    # a different seed samples a different set
+    fr2 = make_flightrec(12, sample_every=4, ring=64)
+    assert not np.array_equal(
+        m1, np.asarray(frmod.sample_mask(fr2, src, seq)))
+
+
+def test_make_flightrec_validates():
+    with pytest.raises(ValueError):
+        make_flightrec(0, sample_every=0)
+    with pytest.raises(ValueError):
+        make_flightrec(0, ring=0)
+    with pytest.raises(ValueError):
+        frmod.grow_ring(make_flightrec(0, ring=8), 8)
+
+
+# -- trace-ring semantics -------------------------------------------------
+
+
+def _mk_events(n, base, valid=None):
+    return (jnp.full((n,), frmod.HOP_ROUTED, jnp.int32),
+            jnp.arange(n, dtype=jnp.int32),
+            jnp.arange(base, base + n, dtype=jnp.int32),
+            jnp.zeros((n,), jnp.int32),
+            jnp.full((n,), 7, jnp.int32),
+            jnp.ones((n,), bool) if valid is None else valid)
+
+
+def test_ring_overwrite_is_counted_loudly(caplog):
+    import logging
+
+    rec = make_flightrec(1, sample_every=1, ring=16)
+    rec = frmod.record_events(rec, *_mk_events(24, 0))
+    rcd = FlightRecorder(window_ns=1)
+    with caplog.at_level(logging.ERROR, logger="shadow_tpu.telemetry"):
+        rcd.tick(rec)
+        rcd.drain()
+    assert rcd.recorded == 16 and rcd.overwritten == 8
+    assert [h["seq"] for h in rcd.hops] == list(range(8, 24))
+    assert any("overflowed" in r.getMessage() for r in caplog.records)
+    assert rcd.want_growth()
+
+
+def test_ring_growth_preserves_entries_and_continues():
+    rec = make_flightrec(1, sample_every=1, ring=16)
+    rec = frmod.record_events(rec, *_mk_events(10, 0))
+    rcd = FlightRecorder(window_ns=1)
+    rcd.tick(rec)
+    rec = frmod.grow_ring(rec, 64)
+    rec = frmod.record_events(rec, *_mk_events(10, 100))
+    rcd.tick(rec)
+    rcd.finalize()
+    assert rcd.overwritten == 0
+    assert [h["seq"] for h in rcd.hops] == \
+        list(range(0, 10)) + list(range(100, 110))
+
+
+def test_ring_wraps_across_windows():
+    rec = make_flightrec(1, sample_every=1, ring=8)
+    for w in range(3):
+        rec = frmod.record_events(rec, *_mk_events(5, 10 * w))
+        rec = frmod.advance_window(rec)
+    rcd = FlightRecorder(window_ns=100)
+    rcd.tick(rec)
+    rcd.finalize()
+    assert rcd.overwritten == 7
+    assert [h["seq"] for h in rcd.hops] == [12, 13, 14, 20, 21, 22,
+                                            23, 24]
+    # t_ns decodes from (win, t_rel) on the driver's fixed cadence
+    assert [h["t_ns"] for h in rcd.hops] == [107, 107, 107, 207, 207,
+                                             207, 207, 207]
+
+
+def test_masked_and_empty_windows_are_noops():
+    rec = make_flightrec(1, sample_every=1, ring=8)
+    k, s, q, d, t, _ = _mk_events(5, 0)
+    out = frmod.record_events(rec, k, s, q, d, t,
+                              jnp.zeros((5,), bool))
+    assert int(out.cursor) == 0
+    assert_tree_equal(out.ev_seq, rec.ev_seq)
+    # partial mask keeps only masked events, in layout order
+    out = frmod.record_events(
+        rec, k, s, q, d, t,
+        jnp.asarray([True, False, True, False, True]))
+    rcd = FlightRecorder(window_ns=1)
+    rcd.tick(out)
+    rcd.finalize()
+    assert [h["seq"] for h in rcd.hops] == [0, 2, 4]
+
+
+# -- presence parity matrix ----------------------------------------------
+
+
+@pytest.mark.parametrize("rr_enabled", [False, True])
+@pytest.mark.parametrize("router_aqm", [False, True])
+@pytest.mark.parametrize("no_loss", [False, True])
+def test_trace_presence_bitwise_invisible(rr_enabled, router_aqm,
+                                          no_loss):
+    """hist + flightrec threaded must leave sim state, delivered sets,
+    next-event scalars, metrics, AND guards accumulators bitwise
+    unchanged across the qdisc matrix."""
+    from shadow_tpu.guards import make_guards
+
+    state, params = busy_world(rr_mix=rr_enabled)
+    kw = dict(rr_enabled=rr_enabled, router_aqm=router_aqm,
+              no_loss=no_loss)
+    with_t, m_a, g_a, hist, fr = run_windows(
+        state, params, metrics=make_metrics(N), guards=make_guards(N),
+        hist=make_histograms(N),
+        fr=make_flightrec(5, sample_every=2, ring=256), **kw)
+    without, m_b, g_b, _h, _f = run_windows(
+        state, params, metrics=make_metrics(N), guards=make_guards(N),
+        **kw)
+    for w, ((sa, da, na), (sb, db, nb)) in enumerate(zip(with_t,
+                                                         without)):
+        assert_tree_equal(sa, sb, (kw, w))
+        for k in da:
+            assert np.array_equal(np.asarray(da[k]),
+                                  np.asarray(db[k])), (kw, w, k)
+        assert int(na) == int(nb), (kw, w)
+    assert_tree_equal(m_a, m_b, kw)  # metrics untouched by hist/fr
+    assert_tree_equal(g_a, g_b, kw)  # guards untouched too
+    from shadow_tpu.guards import summarize
+
+    assert summarize(g_a)["clean"]
+    # and the observability actually observed something
+    assert int(np.asarray(hist.hist_qdepth).sum()) > 0
+    assert int(fr.cursor) > 0
+
+
+def test_trace_presence_invisible_with_faults_on():
+    from shadow_tpu.faults.plane import FaultArrays
+
+    state, params = busy_world()
+    alive = np.ones(N, bool)
+    alive[3] = False  # an active crash: the fault-drop hop path runs
+    faults = FaultArrays(
+        host_alive=jnp.asarray(alive),
+        link_up=jnp.ones((N,), bool),
+        lat_mult=jnp.full((N, N), 2, jnp.int32),
+        bw_div=jnp.ones((N,), jnp.int32),
+        corrupt_p=jnp.full((N,), 0.2, jnp.float32),
+    )
+    with_t, m_a, _g, hist, fr = run_windows(
+        state, params, metrics=make_metrics(N),
+        hist=make_histograms(N),
+        fr=make_flightrec(5, sample_every=1, ring=1024), faults=faults)
+    without, m_b, _g2, _h, _f = run_windows(
+        state, params, metrics=make_metrics(N), faults=faults)
+    for (sa, da, na), (sb, db, nb) in zip(with_t, without):
+        assert_tree_equal(sa, sb)
+    assert_tree_equal(m_a, m_b)
+    assert int(np.asarray(m_a.drop_fault).sum()) > 0
+    rcd = FlightRecorder(window_ns=10 * MS)
+    rcd.tick(fr)
+    rcd.finalize()
+    kinds = {h["kind"] for h in rcd.hops}
+    assert "drop_fault" in kinds  # injected losses carry their taxonomy
+    # destination-blocked drops (the crashed host ate the route) record
+    # a hop too — a sampled packet never silently vanishes while
+    # metrics.drop_fault counts it
+    assert any(h["kind"] == "drop_fault" and h["dst"] == 3
+               for h in rcd.hops)
+
+
+def test_trace_presence_invisible_in_workload_world():
+    from shadow_tpu.workloads import load_scenario_file, runner
+
+    spec = load_scenario_file(os.path.join(
+        os.path.dirname(__file__), "..", "scenarios", "incast.yaml"))
+    plain = runner.run_scenario(spec, histograms=False)
+    traced = runner.run_scenario(spec, histograms=True, sample_every=4)
+    assert traced["canonical_digest"] == plain["canonical_digest"]
+    assert traced["latency"]["delivery_ns"]["p99"] > 0
+    assert traced["flight_recorder"]["recorded_hops"] > 0
+    assert traced["flight_recorder"]["overwritten"] == 0
+
+
+def test_hops_pair_routed_with_delivered():
+    state, params = busy_world()
+    _runs, _m, _g, _h, fr = run_windows(
+        state, params, windows=6,
+        fr=make_flightrec(5, sample_every=1, ring=4096))
+    rcd = FlightRecorder(window_ns=10 * MS)
+    rcd.tick(fr)
+    rcd.finalize()
+    flows = frmod.hop_flows(rcd.hops)
+    paired = [
+        g for g in flows.values()
+        if {"routed", "delivered"} <= {h["kind"] for h in g}]
+    assert paired, "no packet recorded both ends of its flight"
+    for g in paired:
+        routed = next(h for h in g if h["kind"] == "routed")
+        delivered = next(h for h in g if h["kind"] == "delivered")
+        assert delivered["t_ns"] >= routed["t_ns"]
+        assert routed["dst"] == delivered["dst"]
+
+
+# -- ingest_rows hooks ----------------------------------------------------
+
+
+def test_ingest_rows_records_ingest_hops_and_depth():
+    # EMPTY rings: every appended entry is accepted, so every sampled
+    # one records an ingest hop (the overflow case is the next test)
+    _state, params = busy_world()
+    state = make_state(N, egress_cap=8, ingress_cap=8, params=params,
+                       initial_tokens=np.asarray(params.tb_cap))
+    K = 4
+    dst = jnp.zeros((N, K), jnp.int32)
+    nb = jnp.full((N, K), 100, jnp.int32)
+    seq = jnp.arange(N * K, dtype=jnp.int32).reshape(N, K)
+    valid = jnp.ones((N, K), bool)
+    ctrl = jnp.zeros((N, K), bool)
+    out = ingest_rows(state, dst, nb, seq, seq, ctrl, valid,
+                      hist=make_histograms(N),
+                      flightrec=make_flightrec(5, sample_every=1,
+                                               ring=256))
+    st2, hist, fr = out
+    ref = ingest_rows(state, dst, nb, seq, seq, ctrl, valid)
+    assert_tree_equal(st2, ref)
+    assert int(np.asarray(hist.hist_qdepth).sum()) == N
+    rcd = FlightRecorder(window_ns=10 * MS)
+    rcd.tick(fr)
+    rcd.finalize()
+    assert rcd.recorded == N * K
+    assert all(h["kind"] == "ingest" for h in rcd.hops)
+
+
+def test_ingest_rows_overflow_drops_record_no_phantom_hops():
+    """Overflow-dropped batch entries never entered the ring, so they
+    record NO ingest hop — a phantom hop would read as 'queued'."""
+    state, params = busy_world()  # 48 seeded packets over 8 hosts, CE=8
+    K = 12
+    dst = jnp.zeros((N, K), jnp.int32)
+    nb = jnp.full((N, K), 100, jnp.int32)
+    seq = (jnp.arange(N * K, dtype=jnp.int32).reshape(N, K) + 1000)
+    valid = jnp.ones((N, K), bool)
+    ctrl = jnp.zeros((N, K), bool)
+    from shadow_tpu.telemetry import make_metrics as _mm
+
+    st2, metrics, fr = ingest_rows(
+        state, dst, nb, seq, seq, ctrl, valid, metrics=_mm(N),
+        flightrec=make_flightrec(5, sample_every=1, ring=1024))
+    dropped = int(np.asarray(metrics.drop_ring_full).sum())
+    assert dropped > 0  # the batch really overflowed
+    accepted = N * K - dropped
+    rcd = FlightRecorder(window_ns=10 * MS)
+    rcd.tick(fr)
+    rcd.finalize()
+    assert rcd.recorded == accepted
+    # and the hops are exactly the per-row accepted PREFIXES (the
+    # merge keeps new entries in column order after the existing ones)
+    occ = np.asarray(state.eg_valid.sum(axis=1))
+    want = {(r, int(seq[r, c])) for r in range(N)
+            for c in range(max(0, min(K, 8 - occ[r])))}
+    got = {(h["src"], h["seq"]) for h in rcd.hops}
+    assert got == want
+
+
+# -- harvester emission of 2-D histogram leaves ---------------------------
+
+
+def test_harvester_emits_histograms_per_host_and_fleet():
+    def hist_arrays(scale):
+        h = np.zeros((2, histo.HIST_BUCKETS), np.int32)
+        h[0, 3] = 2 * scale
+        h[1, 5] = 1 * scale
+        return {"hist_delivery_ns": h}
+
+    h = TelemetryHarvester(interval_ns=MS, sink=None,
+                           host_names=["a", "b"])
+    h.tick(1 * MS, device=hist_arrays(1))
+    h.tick(2 * MS, device=hist_arrays(2))
+    h.finalize()
+    sims = [r for r in h.heartbeats if r["type"] == "sim"]
+    hosts = [r for r in h.heartbeats if r["type"] == "host"]
+    assert sims[0]["hist"]["hist_delivery_ns"][3] == 2
+    assert sims[0]["hist"]["hist_delivery_ns"][5] == 1
+    # cumulative totals, delta-unwrapped like every modular counter
+    assert sims[1]["hist"]["hist_delivery_ns"][3] == 4
+    a0 = next(r for r in hosts if r["host"] == "a")
+    assert a0["hist"]["hist_delivery_ns"][3] == 2
+    from shadow_tpu.telemetry import export
+
+    summary = export.summarize(h.heartbeats)
+    assert summary["percentiles"]["delivery_ns"]["p50"] == 16
+    per_host = export.host_percentiles(h.heartbeats)
+    assert per_host["b"]["delivery_ns"]["p99"] == 64
+
+
+def test_perfetto_trace_gains_percentile_tracks_and_flows(tmp_path):
+    def hist_arrays(scale):
+        h = np.zeros((2, histo.HIST_BUCKETS), np.int32)
+        h[0, 3] = 2 * scale
+        return {"hist_delivery_ns": h,
+                "pkts_out": np.asarray([1, 2], np.int32)}
+
+    h = TelemetryHarvester(interval_ns=MS, sink=None,
+                           host_names=["a", "b"])
+    h.tick(1 * MS, device=hist_arrays(1))
+    h.tick(2 * MS, device=hist_arrays(3))
+    h.finalize()
+    hops = [
+        {"kind": "routed", "src": 0, "seq": 5, "dst": 1, "win": 0,
+         "t_ns": 1000},
+        {"kind": "delivered", "src": 0, "seq": 5, "dst": 1, "win": 0,
+         "t_ns": 9000},
+    ]
+    from shadow_tpu.telemetry import export
+
+    path = str(tmp_path / "trace.json")
+    info = export.write_perfetto_trace(h.heartbeats, path, hops=hops)
+    assert info["flows_plotted"] == 1
+    trace = json.load(open(path))
+    events = trace["traceEvents"]
+    pct = [e for e in events if e["ph"] == "C"
+           and e["name"] == "delivery_ns"]
+    assert len(pct) == 2 and pct[0]["args"]["p99"] == 16
+    phases = {e["ph"] for e in events}
+    assert {"s", "f", "X"} <= phases  # a cross-host flow span loads
+    s_ev = next(e for e in events if e["ph"] == "s")
+    f_ev = next(e for e in events if e["ph"] == "f")
+    assert s_ev["id"] == f_ev["id"]
+    assert s_ev["pid"] == 1 and f_ev["pid"] == 2  # src row -> dst row
+    assert trace["otherData"]["flows_plotted"] == 1
+
+
+def test_flow_cap_is_loud(tmp_path):
+    hops = []
+    for i in range(4):
+        hops.append({"kind": "routed", "src": 0, "seq": i, "dst": 1,
+                     "win": 0, "t_ns": 1000 + i})
+    # ingest-only groups are never plottable and must not count as
+    # "dropped by the cap" wherever they fall in iteration order
+    hops.append({"kind": "ingest", "src": 0, "seq": 99, "dst": 1,
+                 "win": 0, "t_ns": 1})
+    hops.append({"kind": "ingest", "src": 9, "seq": 0, "dst": 1,
+                 "win": 0, "t_ns": 1})
+    from shadow_tpu.telemetry import export
+
+    path = str(tmp_path / "trace.json")
+    info = export.write_perfetto_trace([], path, hops=hops, max_flows=2)
+    assert info["flows_plotted"] == 2
+    assert info["flows_dropped_by_cap"] == 2
+    assert json.load(open(path))["otherData"]["flows_dropped_by_cap"] == 2
+    info = export.write_perfetto_trace([], path, hops=hops, max_flows=8)
+    assert info["flows_plotted"] == 4
+    assert info["flows_dropped_by_cap"] == 0
+
+
+# -- transport histograms -------------------------------------------------
+
+
+class _StubHost:
+    def __init__(self, hid):
+        self.host_id = hid
+        self.node_id = 0
+        self.delivered = []
+
+    def push_packet_event(self, packet, t, src_id, seq):
+        self.delivered.append((packet, t, src_id, seq))
+
+
+class _StubRouting:
+    latency_ns = np.asarray([[1_000_000]], np.int64)
+
+    def node_index(self, node_id):
+        return 0
+
+
+def test_transport_histograms_accumulate_and_stay_invisible():
+    from shadow_tpu.tpu.transport import DeviceTransport
+
+    def run(enable):
+        hosts = [_StubHost(1), _StubHost(2)]
+        tr = DeviceTransport(hosts, _StubRouting(), {}, mode="sync",
+                             egress_cap=8, ingress_cap=8)
+        if enable:
+            tr.enable_histograms()
+        tr.release(0, 1000)
+        tr.capture(hosts[0], hosts[1], "pkt-a", now_ns=0, seq=1,
+                   round_end_ns=1000, deliver_ns=1_000_000)
+        tr.finish_round(0, 1000)
+        tr.release(1000, 2_000_001)
+        return hosts, tr
+
+    hosts_on, tr_on = run(True)
+    hosts_off, _tr_off = run(False)
+    assert [h.delivered for h in hosts_on] == \
+        [h.delivered for h in hosts_off]  # bitwise-invisible delivery
+    arrs = tr_on.histogram_arrays()
+    assert set(arrs) == {"hist_delivery_ns", "hist_qdepth"}
+    lat = np.asarray(arrs["hist_delivery_ns"])
+    # one packet, ~1ms latency -> bucket 19 ([2^19, 2^20) ns), dest row
+    assert lat[1, 19] == 1 and lat.sum() == 1
+    assert np.asarray(arrs["hist_qdepth"]).sum() > 0
+    assert _tr_off.histogram_arrays() == {}
+
+
+# -- config + manager -----------------------------------------------------
+
+
+BASE_CFG = ("general:\n  stop_time: 1s\n"
+            "network:\n  graph:\n    type: 1_gbit_switch\n"
+            "hosts:\n  a:\n    network_node_id: 0\n")
+
+
+def test_flight_recorder_config_block_parses():
+    from shadow_tpu.core.config import ConfigError, load_config_str
+
+    cfg = load_config_str(BASE_CFG)
+    assert not cfg.telemetry.histograms
+    assert not cfg.telemetry.flight_recorder.enabled
+    assert cfg.telemetry.flight_recorder.sample_every == 64
+    cfg = load_config_str(
+        BASE_CFG + "telemetry:\n  enabled: true\n  histograms: true\n"
+                   "  flight_recorder:\n    enabled: true\n"
+                   "    sample_every: 16\n    ring: 512\n")
+    assert cfg.telemetry.histograms
+    assert cfg.telemetry.flight_recorder.enabled
+    assert cfg.telemetry.flight_recorder.sample_every == 16
+    assert cfg.telemetry.flight_recorder.ring == 512
+    # YAML 1.1 bare off/on coerce like the workload block
+    cfg = load_config_str(
+        BASE_CFG + "telemetry:\n  flight_recorder: off\n")
+    assert not cfg.telemetry.flight_recorder.enabled
+    cfg = load_config_str(
+        BASE_CFG + "telemetry:\n  flight_recorder: on\n")
+    assert cfg.telemetry.flight_recorder.enabled
+    with pytest.raises(ConfigError):
+        load_config_str(
+            BASE_CFG + "telemetry:\n  flight_recorder:\n"
+                       "    sample_every: 0\n")
+    with pytest.raises(ConfigError):
+        load_config_str(
+            BASE_CFG + "telemetry:\n  flight_recorder:\n    ring: 0\n")
+    with pytest.raises(ConfigError):
+        load_config_str(
+            BASE_CFG + "telemetry:\n  flight_recorder:\n    bogus: 1\n")
+
+
+def test_manager_warns_on_flight_recorder(caplog):
+    import logging
+
+    from shadow_tpu.core.config import ConfigError, load_config_str
+    from shadow_tpu.core.manager import Manager
+
+    cfg = load_config_str(
+        BASE_CFG + "telemetry:\n  enabled: true\n"
+                   "  flight_recorder: on\n")
+    with caplog.at_level(logging.WARNING, logger="shadow_tpu.manager"):
+        Manager(cfg)
+    assert any("flight_recorder" in r.getMessage()
+               for r in caplog.records)
+    cfg = load_config_str(
+        BASE_CFG + "strict: true\n"
+                   "telemetry:\n  enabled: true\n"
+                   "  flight_recorder: on\n")
+    with pytest.raises(ConfigError):
+        Manager(cfg)
+
+
+def test_manager_warns_on_histograms_without_transport(caplog):
+    import logging
+
+    from shadow_tpu.core.config import load_config_str
+    from shadow_tpu.core.manager import Manager
+
+    cfg = load_config_str(
+        BASE_CFG + "telemetry:\n  enabled: true\n  histograms: true\n")
+    mgr = Manager(cfg)
+    with caplog.at_level(logging.WARNING, logger="shadow_tpu.manager"):
+        mgr.run()
+    assert any("histograms" in r.getMessage() for r in caplog.records)
+
+
+# -- double-run byte-stability through a real driver ----------------------
+
+
+def _chaos(argv):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import chaos_smoke
+
+    return chaos_smoke.main(argv)
+
+
+def test_chaos_smoke_telemetry_byte_stable(tmp_path, capsys):
+    """The chaos driver with --telemetry + --sample-every: two
+    identical runs produce byte-identical heartbeats, hops, and
+    trace.json; the JSON reports recorded hops and latency
+    percentiles; the digest equals a telemetry-off run's."""
+    outs = []
+    for d in ("t1", "t2"):
+        rc = _chaos(["--hosts", "16", "--windows", "6",
+                     "--harvest-every", "3",
+                     "--telemetry", str(tmp_path / d),
+                     "--sample-every", "2", "--guards", "warn"])
+        assert rc == 0
+        outs.append(json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1]))
+    for name in ("heartbeats.jsonl", "hops.jsonl", "trace.json"):
+        a = (tmp_path / "t1" / name).read_bytes()
+        b = (tmp_path / "t2" / name).read_bytes()
+        assert a == b, f"{name} not byte-stable"
+        assert a, f"{name} empty"
+    tel = outs[0]["telemetry"]
+    assert tel["flight_recorder"]["recorded_hops"] > 0
+    assert tel["latency"]["delivery_ns"]["p99"] > 0
+    assert outs[0]["guards"]["clean"]
+    rc = _chaos(["--hosts", "16", "--windows", "6"])
+    assert rc == 0
+    plain = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert plain["state_digest"] == outs[0]["state_digest"]

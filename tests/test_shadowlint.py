@@ -354,6 +354,87 @@ def test_sl403_tree_is_clean():
     assert all(f.justification for f in suppressed)
 
 
+def test_sl405_telemetry_reads_fire():
+    src, findings = _lint_fixture(
+        "fixture_telemetry_read.py",
+        "shadow_tpu/core/fixture_telemetry_read.py")
+    f405 = [f for f in findings if f.rule == "SL405"]
+    active = {f.line for f in f405 if not f.suppressed}
+    assert active == {
+        _line_of(src, "float(metrics.pkts_out.sum())"),
+        _line_of(src, "metrics.drop_loss.sum().item()"),
+        _line_of(src, "float(state.n_out[0])"),
+        _line_of(src, "hist.hist_delivery_ns.sum().item()"),
+        _line_of(src, "float(metrics.windows)"),
+    }
+    sup = [f for f in f405 if f.suppressed]
+    assert len(sup) == 1
+    assert sup[0].justification == \
+        "teardown diagnostic, run already over"
+
+
+def test_sl405_skips_host_side_and_untyped_reads():
+    src, findings = _lint_fixture(
+        "fixture_telemetry_read.py",
+        "shadow_tpu/core/fixture_telemetry_read.py")
+    flagged = {f.line for f in findings if f.rule == "SL405"}
+    for needle in ('float(np.asarray(totals["pkts_out"]).sum())',
+                   "float(weights[0])",
+                   "weights.sum().item()"):
+        assert _line_of(src, needle) not in flagged, needle
+
+
+def test_sl405_scope_exempts_harvest_boundary_and_tools():
+    src = "def f(metrics):\n    return float(metrics.pkts_out.sum())\n"
+    assert [f.rule for f in lint_source(src, "shadow_tpu/core/x.py")] \
+        == ["SL405"]
+    assert [f.rule for f in lint_source(src, "shadow_tpu/tpu/x.py")] \
+        == ["SL405"]
+    # the harvest boundary itself is the sanctioned reader
+    assert not lint_source(src, "shadow_tpu/telemetry/harvest.py")
+    assert not lint_source(src, "shadow_tpu/telemetry/flightrec.py")
+    # tools/ drivers pull at sync points they own
+    assert not lint_source(src, "tools/chaos_smoke.py")
+
+
+def test_sl405_field_set_matches_live_pytrees():
+    """The lexical field net must cover every leaf of the live
+    telemetry pytrees — a new counter field cannot silently escape
+    the rule."""
+    from shadow_tpu.analysis.astlint import _TELEMETRY_FIELD_ATTRS
+    from shadow_tpu.telemetry.flightrec import FlightRecArrays
+    from shadow_tpu.telemetry.histo import PlaneHistograms
+    from shadow_tpu.telemetry.metrics import PlaneMetrics
+    from shadow_tpu.tpu.transport import TransportHist
+
+    want = (set(PlaneMetrics._fields) | set(PlaneHistograms._fields)
+            | set(TransportHist._fields)
+            | {f for f in FlightRecArrays._fields
+               if f.startswith("ev_")}
+            | {"n_out", "n_released"})
+    missing = want - _TELEMETRY_FIELD_ATTRS
+    assert not missing, f"SL405 field set is missing {missing}"
+
+
+def test_sl405_tree_is_clean():
+    """No active sync-telemetry-read anywhere in shadow_tpu/ outside
+    the harvest boundary: every observability read rides the
+    asynchronous drain."""
+    root = os.path.join(os.path.dirname(__file__), "..", "shadow_tpu")
+    for dirpath, _dirs, files in os.walk(root):
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, os.path.join(root, "..")) \
+                .replace(os.sep, "/")
+            with open(full, encoding="utf-8") as fh:
+                findings = lint_source(fh.read(), rel)
+            active = [f for f in findings
+                      if f.rule == "SL405" and not f.suppressed]
+            assert not active, [str(f) for f in active]
+
+
 def test_clean_fixture_and_sl101_scope():
     _, findings = _lint_fixture(
         "fixture_clean.py", "shadow_tpu/core/fixture_clean.py")
@@ -367,9 +448,9 @@ def test_clean_fixture_and_sl101_scope():
 def test_rule_registry_complete():
     assert set(RULES) == {f"SL10{i}" for i in range(1, 6)} | {
         f"SL20{i}" for i in range(1, 6)} | {"SL301", "SL401", "SL402",
-                                            "SL403"}
+                                            "SL403", "SL405"}
     for rid in ("SL101", "SL102", "SL103", "SL104", "SL105", "SL301",
-                "SL401", "SL402", "SL403"):
+                "SL401", "SL402", "SL403", "SL405"):
         assert rule_applies(rid, "shadow_tpu/core/x.py") \
             or rid in ("SL105", "SL301", "SL402", "SL403")
 

@@ -264,6 +264,32 @@ def test_harvester_counter_wrap_across_ticks():
     assert sims[1]["device_totals"]["pkts_out"] == 2**31 + 10
 
 
+def test_harvester_unwrap_across_int32_and_uint32_boundaries():
+    """Drive one counter across BOTH wrap boundaries — 2^31 (the int32
+    sign flip) and 2^32 (the full modular wrap back past zero) — over
+    several harvest intervals and pin the reconstructed totals. The
+    device counters are int32 two's-complement views of a modular-2^32
+    stream; the unwrap must be exact as long as any single interval
+    moves < 2^32."""
+    # true totals, strictly increasing, crossing 2^31 then 2^32
+    truth = [0, 2**31 - 10, 2**31 + 10, 2**32 - 7, 2**32 + 9,
+             2**32 + 2**31 + 1]
+    raw = [np.asarray([t], np.uint64).astype(np.uint32).astype(np.int32)
+           for t in truth]
+    # the raw int32 views really do go negative / wrap to small again
+    assert int(raw[2][0]) < 0 and 0 < int(raw[4][0]) < 100
+    h = TelemetryHarvester(interval_ns=1, sink=None, per_host=False)
+    for i, arr in enumerate(raw, start=1):
+        h.tick(i, device={"pkts_out": arr})
+    h.finalize()
+    totals = [r["device_totals"]["pkts_out"] for r in h.heartbeats
+              if r["type"] == "sim"]
+    assert totals == truth
+    # the scalar helper agrees at both boundaries
+    assert int(unwrap_u32(raw[1], raw[2])) == truth[2] - truth[1]
+    assert int(unwrap_u32(raw[3], raw[4])) == truth[4] - truth[3]
+
+
 def test_harvester_rejects_bad_interval():
     with pytest.raises(ValueError):
         TelemetryHarvester(interval_ns=0)

@@ -38,6 +38,19 @@ RE-EXECUTES from the snapshot — the JSON gains the capacity trajectory,
 `drops.ring_full` must be ZERO, and `canonical_digest` must equal a
 run pre-provisioned at the final capacity (the CI proof). `--capacity
 strict` exits with the CLI capacity code (6) on the first overflow.
+
+`--telemetry DIR` (matching tools/run_scenarios.py) threads the
+log2 latency/depth histograms and writes heartbeat JSONL +
+`trace.json` into DIR every `--harvest-every` windows — fault-injected
+runs emit the same observability surface as bench-driven ones.
+`--sample-every K` additionally threads the flight recorder
+(docs/observability.md "Distributions and the flight recorder"):
+sampled per-packet hops land in DIR/hops.jsonl and as Perfetto flow
+spans in the trace; the JSON gains `telemetry` (recorded hops,
+ring-overwrite count, fleet latency percentiles). Histogram and
+trace-ring state ride checkpoints, so a resumed run keeps its
+distributions; under `--capacity elastic` a drain that reports
+overwritten hops doubles the trace ring (bounded by --max-doublings).
 """
 
 from __future__ import annotations
@@ -119,7 +132,24 @@ def main(argv=None) -> int:
     ap.add_argument("--egress-cap", type=int, default=16)
     ap.add_argument("--ingress-cap", type=int, default=32)
     ap.add_argument("--max-doublings", type=int, default=4)
+    ap.add_argument("--telemetry", default=None, metavar="DIR",
+                    help="write heartbeats.jsonl + trace.json (and "
+                         "hops.jsonl with --sample-every) into DIR; "
+                         "threads the latency/depth histograms")
+    ap.add_argument("--harvest-every", type=int, default=8,
+                    help="windows between telemetry harvests "
+                         "(default 8)")
+    ap.add_argument("--sample-every", type=int, default=None,
+                    metavar="K",
+                    help="thread the flight recorder: tag ~1/K packets "
+                         "and trace their hops (requires --telemetry)")
+    ap.add_argument("--trace-ring", type=int, default=2048,
+                    help="flight-recorder trace-ring capacity "
+                         "(default 2048)")
     args = ap.parse_args(argv)
+    if args.sample_every is not None and not args.telemetry:
+        ap.error("--sample-every requires --telemetry DIR (the hop "
+                 "drain needs somewhere to land)")
 
     import jax
     import jax.numpy as jnp
@@ -155,8 +185,8 @@ def main(argv=None) -> int:
 
     def build_step(kernel: str):
         @jax.jit
-        def step(state, metrics, faults, guards, spawn_seq, shift,
-                 round_idx):
+        def step(state, metrics, faults, guards, hist, fr, spawn_seq,
+                 shift, round_idx):
             # ring shapes come from the state itself (trace-time), so
             # elastic growth retraces this step per ring size — bounded
             # at log2 by the power-of-two growth, asserted in CI via
@@ -166,11 +196,17 @@ def main(argv=None) -> int:
             out = window_step(state, world["params"], world["rng_root"],
                               shift, window, rr_enabled=False,
                               kernel=kernel, faults=faults,
-                              metrics=metrics, guards=guards)
+                              metrics=metrics, guards=guards,
+                              hist=hist, flightrec=fr)
+            state, delivered, _next = out[:3]
+            rest = list(out[3:])
+            metrics = rest.pop(0)
             if guards is not None:
-                state, delivered, _next, metrics, guards = out
-            else:
-                state, delivered, _next, metrics = out
+                guards = rest.pop(0)
+            if hist is not None:
+                hist = rest.pop(0)
+            if fr is not None:
+                fr = rest.pop(0)
             # ingress-ring overflow: the routing stage's ring-full drops
             in_ovf = state.n_overflow_dropped - state0.n_overflow_dropped
             state1 = state
@@ -180,14 +216,19 @@ def main(argv=None) -> int:
             mask = mask & (faults.host_alive & faults.link_up)[:, None]
             out = ingest_rows(
                 state, dst, nbytes, seq, seq, ctrl, valid=mask,
-                metrics=metrics, guards=guards)
+                metrics=metrics, guards=guards, hist=hist, flightrec=fr)
+            state = out[0]
+            rest = list(out[1:])
+            metrics = rest.pop(0)
             if guards is not None:
-                state, metrics, guards = out
-            else:
-                state, metrics = out
+                guards = rest.pop(0)
+            if hist is not None:
+                hist = rest.pop(0)
+            if fr is not None:
+                fr = rest.pop(0)
             # egress-ring overflow: the respawn append's ring-full drops
             eg_ovf = state.n_overflow_dropped - state1.n_overflow_dropped
-            return (state, metrics, guards,
+            return (state, metrics, guards, hist, fr,
                     spawn_seq + mask.sum(axis=1, dtype=jnp.int32),
                     eg_ovf, in_ovf)
         return step
@@ -198,6 +239,27 @@ def main(argv=None) -> int:
     state = world["state"]
     metrics = make_metrics(N)
     guards = make_guards(N) if use_guards else None
+    hist = fr = harvester = recorder = None
+    if args.telemetry:
+        from shadow_tpu.telemetry import (TelemetryHarvester,
+                                          make_histograms)
+        from shadow_tpu.telemetry import flightrec as frmod
+
+        os.makedirs(args.telemetry, exist_ok=True)
+        hist = make_histograms(N)
+        harvester = TelemetryHarvester(
+            interval_ns=args.harvest_every * window_ns,
+            sink=os.path.join(args.telemetry, "heartbeats.jsonl"))
+        if args.sample_every:
+            # seeded like the fault schedule: the sampling mask is a
+            # pure function of (seed, src, seq) — two identical runs
+            # record byte-identical hop streams
+            fr = frmod.make_flightrec(
+                1234, sample_every=args.sample_every,
+                ring=args.trace_ring)
+            recorder = frmod.FlightRecorder(
+                window_ns=window_ns,
+                sink=os.path.join(args.telemetry, "hops.jsonl"))
     spawn_seq = jnp.full((N,), 10_000, jnp.int32)
     if args.resume:
         restored = load_plane_checkpoint(
@@ -211,6 +273,23 @@ def main(argv=None) -> int:
             guards = GuardState(**{
                 f: jnp.asarray(restored["extra"][f"guards.{f}"])
                 for f in GuardState._fields})
+        if hist is not None and "hist.hist_qdepth" in restored["extra"]:
+            # the distributions ride the checkpoint: a resumed run
+            # reports the same histograms an uninterrupted one would
+            from shadow_tpu.telemetry.histo import PlaneHistograms
+
+            hist = PlaneHistograms(**{
+                f: jnp.asarray(restored["extra"][f"hist.{f}"])
+                for f in PlaneHistograms._fields})
+        if fr is not None and "flightrec.cursor" in restored["extra"]:
+            from shadow_tpu.telemetry.flightrec import FlightRecArrays
+
+            fr = FlightRecArrays(**{
+                f: jnp.asarray(restored["extra"][f"flightrec.{f}"])
+                for f in FlightRecArrays._fields})
+            # the prior run drained everything up to the checkpointed
+            # cursor; the resumed recorder starts its window there
+            recorder.seed_cursor(int(np.asarray(fr.cursor)))
         start_w = int(restored["meta"]["window_index"])
         if policy is not None and "capacity" in restored["meta"]:
             # the growth history rides the checkpoint: a resumed
@@ -241,19 +320,22 @@ def main(argv=None) -> int:
             faults = neutral_faults(N, 64)
         shift = jnp.int32(0 if wdx == 0 else window_ns)
         if policy is None:
-            state, metrics, guards, spawn_seq, _eg, _in = driver(
-                state, metrics, faults, guards, spawn_seq, shift,
-                jnp.int32(wdx))
+            state, metrics, guards, hist, fr, spawn_seq, _eg, _in = \
+                driver(state, metrics, faults, guards, hist, fr,
+                       spawn_seq, shift, jnp.int32(wdx))
         else:
             # capacity policy: the attempt is a pure function of the
             # (possibly grown) pre-window state plus the snapshots this
             # closure holds — an overflowing attempt is discarded and
-            # re-executed after growth (elastic), or aborts (strict)
+            # re-executed after growth (elastic), or aborts (strict);
+            # hist/flight-recorder snapshots restore with the rest, so
+            # a re-executed window never double-counts an observation
             def attempt(st, _m=metrics, _f=faults, _g=guards,
-                        _sp=spawn_seq, _sh=shift, _w=wdx):
-                st2, m2, g2, sp2, eg, inn = driver(
-                    st, _m, _f, _g, _sp, _sh, jnp.int32(_w))
-                return (st2, m2, g2, sp2), eg, inn
+                        _h=hist, _fr=fr, _sp=spawn_seq, _sh=shift,
+                        _w=wdx):
+                st2, m2, g2, h2, fr2, sp2, eg, inn = driver(
+                    st, _m, _f, _g, _h, _fr, _sp, _sh, jnp.int32(_w))
+                return (st2, m2, g2, h2, fr2, sp2), eg, inn
 
             try:
                 out, _ = elastic.run_elastic_window(
@@ -269,7 +351,7 @@ def main(argv=None) -> int:
                     "ingress_cap": policy.ingress_cap,
                 }))
                 return EXIT_CAPACITY
-            state, metrics, guards, spawn_seq = out
+            state, metrics, guards, hist, fr, spawn_seq = out
         if args.tamper_at is not None and wdx + 1 == args.tamper_at:
             # deliberate corruption: a phantom valid slot at the back
             # of one ingress ring (carrying the idle sentinel) — the
@@ -279,6 +361,26 @@ def main(argv=None) -> int:
             state = state._replace(
                 in_valid=state.in_valid.at[
                     1, state.in_src.shape[1] - 1].set(True))
+        if harvester is not None \
+                and (wdx + 1) % args.harvest_every == 0:
+            harvester.tick((wdx + 1) * window_ns,
+                           device={**metrics._asdict(),
+                                   **hist._asdict()})
+            if recorder is not None:
+                recorder.tick(fr)
+                if args.capacity == "elastic" and recorder.want_growth():
+                    # the trace ring participates in elastic growth:
+                    # an overwriting drain doubles it (power of two,
+                    # bounded like every ring by --max-doublings)
+                    from shadow_tpu.telemetry import flightrec as frmod
+
+                    cur = fr.ev_kind.shape[0]
+                    cap_max = args.trace_ring << args.max_doublings
+                    if cur < cap_max:
+                        fr = frmod.grow_ring(fr, min(cur * 2, cap_max))
+                        recorder.note_grown()
+                        print(f"chaos_smoke: trace ring grown to "
+                              f"{fr.ev_kind.shape[0]}", file=sys.stderr)
         if args.checkpoint_dir and args.checkpoint_every \
                 and (wdx + 1) % args.checkpoint_every == 0 and wdx + 1 < R:
             path = os.path.join(args.checkpoint_dir,
@@ -289,8 +391,24 @@ def main(argv=None) -> int:
                 # resumed run reports the same violation history
                 extra.update({f"guards.{f}": getattr(guards, f)
                               for f in GuardState._fields})
+            if hist is not None:
+                # distributions + trace ring ride checkpoints too: a
+                # resumed run keeps its histograms and hop stream
+                extra.update({f"hist.{f}": getattr(hist, f)
+                              for f in hist._fields})
+            if fr is not None:
+                extra.update({f"flightrec.{f}": getattr(fr, f)
+                              for f in fr._fields})
             meta = {"window_index": wdx + 1, "hosts": N,
                     "state_digest": state_digest(state, spawn_seq)}
+            if hist is not None:
+                from shadow_tpu.telemetry import flightrec as frmod
+
+                meta["telemetry"] = {
+                    "histograms": True,
+                    "flight_recorder": (frmod.flightrec_meta(fr)
+                                        if fr is not None else None),
+                }
             if policy is not None:
                 meta["capacity"] = policy.to_meta()
             save_plane_checkpoint(
@@ -306,6 +424,39 @@ def main(argv=None) -> int:
             os._exit(137)  # abrupt: no atexit, like a SIGKILL'd run
 
     jax.block_until_ready(state)
+    telemetry_out = None
+    if harvester is not None:
+        from shadow_tpu.telemetry import export
+        from shadow_tpu.telemetry.histo import HIST_PREFIX, percentiles
+
+        if R % args.harvest_every != 0:
+            # the loop's cadence did not harvest the final instant
+            harvester.tick(R * window_ns,
+                           device={**metrics._asdict(),
+                                   **hist._asdict()})
+            if recorder is not None:
+                recorder.tick(fr)
+        harvester.finalize()
+        if recorder is not None:
+            recorder.tick(fr)
+            recorder.finalize()
+        trace_path = os.path.join(args.telemetry, "trace.json")
+        trace_info = export.write_perfetto_trace(
+            harvester.heartbeats, trace_path,
+            hops=recorder.hops if recorder is not None else None)
+        h = jax.device_get(hist)
+        telemetry_out = {
+            "dir": args.telemetry,
+            "heartbeats": harvester.emitted,
+            "trace": trace_info,
+            "latency": {
+                name[len(HIST_PREFIX):]: percentiles(
+                    np.asarray(arr, np.int64).sum(axis=0))
+                for name, arr in h._asdict().items()},
+        }
+        if recorder is not None:
+            telemetry_out["flight_recorder"] = recorder.summary()
+            telemetry_out["trace_ring"] = int(fr.ev_kind.shape[0])
     m = jax.device_get(metrics)
     out = {
         "hosts": N,
@@ -334,6 +485,8 @@ def main(argv=None) -> int:
         "events": int(np.asarray(m.events)),
         "checkpoints": checkpoints,
     }
+    if telemetry_out is not None:
+        out["telemetry"] = telemetry_out
     if policy is not None:
         # the jit cache size of the step IS the compile count: one
         # entry per ring shape stepped, so elastic recompiles must stay

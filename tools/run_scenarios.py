@@ -68,6 +68,15 @@ def main(argv=None) -> int:
     ap.add_argument("--telemetry", default=None, metavar="DIR",
                     help="write heartbeat JSONL (with workload_phase "
                          "annotations) per scenario into DIR")
+    ap.add_argument("--sample-every", type=int, default=None,
+                    metavar="K",
+                    help="thread the flight recorder: tag ~1/K packets "
+                         "and record per-hop traces (seeded from the "
+                         "scenario seed); with --telemetry DIR the "
+                         "sampled hops land in DIR/<name>.hops.jsonl")
+    ap.add_argument("--trace-ring", type=int, default=4096,
+                    help="flight-recorder trace-ring capacity "
+                         "(default 4096; overflow is counted loudly)")
     args = ap.parse_args(argv)
 
     from shadow_tpu.workloads import load_scenario_file
@@ -115,6 +124,7 @@ def main(argv=None) -> int:
     for path in paths:
         spec = load_scenario_file(path, seed=seed_override)
         harvester = None
+        hops_sink = None
         if args.telemetry:
             from shadow_tpu.telemetry import TelemetryHarvester
 
@@ -123,11 +133,17 @@ def main(argv=None) -> int:
                 interval_ns=spec.window_ns,
                 sink=os.path.join(args.telemetry,
                                   f"{spec.name}.jsonl"))
+            if args.sample_every:
+                hops_sink = os.path.join(args.telemetry,
+                                         f"{spec.name}.hops.jsonl")
         rec = runner.run_scenario(
             spec, guards=args.guards,
             use_default_faults=args.faults,
             mesh_devices=args.shard,
-            telemetry=harvester)
+            telemetry=harvester,
+            sample_every=args.sample_every,
+            trace_ring=args.trace_ring,
+            hops_sink=hops_sink)
         if harvester is not None:
             harvester.finalize()
         records.append(rec)

@@ -12,7 +12,15 @@ Usage:
   python tools/telemetry_report.py run/telemetry.jsonl --trace trace.json
   python tools/telemetry_report.py run/telemetry.jsonl --stats-dir out/
       # writes out/stats.shadow.json for tools/plot_shadow.py
+  python tools/telemetry_report.py run/telemetry.jsonl \
+      --hops run/hops.jsonl --trace trace.json
+      # flight-recorder hops -> per-packet Perfetto flow spans
   cat run/telemetry.jsonl | python tools/telemetry_report.py - --json
+
+Runs with `telemetry.histograms` enabled additionally print the fleet
+p50/p90/p99/p999 table per distribution (delivery latency, egress
+sojourn, queue depth) and a per-host latency percentile table
+(docs/observability.md "Distributions and the flight recorder").
 """
 
 from __future__ import annotations
@@ -66,6 +74,36 @@ def _print_table(summary: dict) -> None:
         for t in summary["top_talkers"]:
             print(f"  {t['host']:>16}  {_fmt_bytes(t['bytes_out']):>12}  "
                   f"{_fmt_bytes(t['bytes_in']):>12}")
+    pct = summary.get("percentiles")
+    if pct:
+        print("distributions (log2-bucket upper bounds, "
+              "docs/observability.md):")
+        for name, ps in sorted(pct.items()):
+            unit = " ns" if name.endswith("_ns") else ""
+            cols = "  ".join(f"{k}={v}{unit}"
+                             for k, v in sorted(ps.items(),
+                                                key=lambda kv: len(kv[0])))
+            print(f"  {name:>16}: {cols}")
+
+
+def _print_host_percentiles(per_host: dict, top: int) -> None:
+    if not per_host:
+        return
+    print(f"per-host delivery latency (first {top} hosts, "
+          "p50/p99/p999 ns):")
+    shown = 0
+    for host, hists in per_host.items():
+        ps = hists.get("delivery_ns")
+        if not ps:
+            continue
+        print(f"  {host:>16}  p50={ps['p50']:>12}  p99={ps['p99']:>12}  "
+              f"p999={ps['p999']:>12}")
+        shown += 1
+        if shown >= top:
+            remaining = len(per_host) - shown
+            if remaining > 0:
+                print(f"  ... and {remaining} more host(s) (--top)")
+            break
 
 
 def main(argv=None) -> int:
@@ -76,8 +114,13 @@ def main(argv=None) -> int:
                     help="print the summary as JSON instead of a table")
     ap.add_argument("--trace", metavar="OUT",
                     help="also write a Perfetto/Chrome trace.json")
+    ap.add_argument("--hops", metavar="PATH",
+                    help="flight-recorder hops JSONL; feeds --trace "
+                         "packet flow spans and the hop summary")
     ap.add_argument("--trace-max-hosts", type=int, default=256,
                     help="counter-track cap for the trace (default 256)")
+    ap.add_argument("--trace-max-flows", type=int, default=512,
+                    help="packet-flow cap for the trace (default 512)")
     ap.add_argument("--stats-dir", metavar="DIR",
                     help="also write DIR/stats.shadow.json for "
                          "tools/plot_shadow.py")
@@ -96,9 +139,17 @@ def main(argv=None) -> int:
         return 1
 
     summary = export.summarize(heartbeats, top=args.top)
+    hops = None
+    if args.hops:
+        from shadow_tpu.telemetry.flightrec import read_hops
+
+        with open(args.hops) as fh:
+            hops = read_hops(fh)
+        summary["hops"] = len(hops)
     if args.trace:
         summary["trace"] = export.write_perfetto_trace(
-            heartbeats, args.trace, max_hosts=args.trace_max_hosts)
+            heartbeats, args.trace, max_hosts=args.trace_max_hosts,
+            hops=hops, max_flows=args.trace_max_flows)
     if args.stats_dir:
         os.makedirs(args.stats_dir, exist_ok=True)
         stats_path = os.path.join(args.stats_dir, "stats.shadow.json")
@@ -106,13 +157,20 @@ def main(argv=None) -> int:
             json.dump(export.to_plot_stats(heartbeats), fh, indent=2)
         summary["stats"] = stats_path
 
+    per_host = export.host_percentiles(heartbeats)
     if args.json:
+        if per_host:
+            summary["per_host_percentiles"] = per_host
         print(json.dumps(summary, indent=2, sort_keys=True))
     else:
         _print_table(summary)
+        _print_host_percentiles(per_host, args.top)
+        if hops is not None:
+            print(f"flight recorder: {len(hops)} sampled hop(s)")
         if args.trace:
             print(f"wrote {args.trace} "
-                  f"({summary['trace']['events']} events)")
+                  f"({summary['trace']['events']} events, "
+                  f"{summary['trace']['flows_plotted']} flow span(s))")
         if args.stats_dir:
             print(f"wrote {summary['stats']}")
     return 0
