@@ -31,8 +31,9 @@ MS = 1_000_000
 N_HOSTS = int(os.environ.get("BENCH_HOSTS", "32768"))
 ROUNDS = int(os.environ.get("BENCH_ROUNDS", "192"))
 N_NODES = int(os.environ.get("BENCH_NODES", "64"))  # graph nodes (GML-like)
-# "xla" (default) or "pallas" — the experimental.plane_kernel flag's
-# bench-side twin (the fused Pallas egress kernel; see docs/performance.md)
+# "xla" (default), "pallas" (two-dispatch egress+route fusion), or
+# "pallas_fused" (the single rank→place→egress pipeline) — the
+# experimental.plane_kernel flag's bench-side twin (docs/performance.md)
 PLANE_KERNEL = os.environ.get("BENCH_PLANE_KERNEL", "xla")
 # BENCH_TELEMETRY=1 threads the PlaneMetrics counters through every
 # window and harvests heartbeat JSONL + a Perfetto trace into
@@ -77,11 +78,13 @@ GROW_EVERY = int(os.environ.get("BENCH_GROW_EVERY", "16"))
 SPAWN_PER_DELIVERY = 1
 
 
-def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None]:
+def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None,
+                         dict]:
     import jax
     import jax.numpy as jnp
 
-    from shadow_tpu.tpu import donating_jit, ingest_rows, window_step
+    from shadow_tpu.tpu import (donating_jit, ingest_rows, unpack_planes,
+                                window_step)
     from shadow_tpu.tpu import profiling
     from shadow_tpu.workloads.phold import respawn_batch
 
@@ -89,14 +92,20 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None]:
         raise SystemExit(
             f"BENCH_CAPACITY={CAPACITY_MODE!r}: expected "
             f"fixed|strict|elastic")
-    if PLANE_KERNEL == "pallas" and EGRESS_CAP & (EGRESS_CAP - 1):
+    if PLANE_KERNEL != "xla" and EGRESS_CAP & (EGRESS_CAP - 1):
         # bench-side twin of the config-time ConfigError: the fused
-        # Pallas egress kernel's bitonic row sort needs a power-of-two
-        # ring (shadow_tpu/tpu/pallas_egress.py) — fail before tracing
+        # Pallas kernels' bitonic row sorts need power-of-two rings
+        # (shadow_tpu/tpu/pallas_egress.py / pallas_pipeline.py) — fail
+        # before tracing
         raise SystemExit(
-            f"BENCH_PLANE_KERNEL=pallas needs a power-of-two "
+            f"BENCH_PLANE_KERNEL={PLANE_KERNEL} needs a power-of-two "
             f"BENCH_EGRESS_CAP, got {EGRESS_CAP}; pick a power of two "
             f"or use the xla kernel")
+    if PLANE_KERNEL == "pallas_fused" and INGRESS_CAP & (INGRESS_CAP - 1):
+        raise SystemExit(
+            f"BENCH_PLANE_KERNEL=pallas_fused needs a power-of-two "
+            f"BENCH_INGRESS_CAP (the fused in-kernel compaction), got "
+            f"{INGRESS_CAP}; pick a power of two or use xla/pallas")
 
     N, M = N_HOSTS, N_NODES
     # ONE definition of the PHOLD world, shared with the per-section
@@ -119,33 +128,29 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None]:
 
         _faults = neutral_faults(N, M)
 
-    def make_round_fn(kernel: str, track_overflow: bool = False,
-                      use_hist: bool = False):
+    # ONE window body for every mode (fixed / telemetry / elastic): the
+    # presence planes ride the scan carry, the per-ring overflow deltas
+    # the capacity policy reads accumulate alongside (idle cost gated in
+    # CI as window_step_elastic), and the whole thing is driven in
+    # device-resident chains by the SHARED driver loop
+    # (`shadow_tpu.tpu.elastic.drive_chained_windows`) — the same loop
+    # tools/chaos_smoke.py and the scenario corpus runner use, so every
+    # kernel fusion lands in all three at once.
+    def make_round_fn(kernel: str):
         def round_fn(carry, round_idx):
-            hist = None
-            if track_overflow:
-                state, spawn_seq, metrics, eg_acc, in_acc = carry
-            elif use_hist:
-                state, spawn_seq, metrics, hist = carry
-            else:
-                state, spawn_seq, metrics = carry
+            state, spawn_seq, metrics, hist, eg_acc, in_acc = carry
             state0 = state
             shift = jnp.where(round_idx == 0, jnp.int32(0), window)
             out = window_step(state, params, key, shift, window,
                               rr_enabled=False, kernel=kernel,
                               faults=_faults, metrics=metrics,
                               hist=hist)
-            if hist is not None:
-                state, delivered, next_ev, metrics, hist = out
-            elif metrics is not None:
-                state, delivered, next_ev, metrics = out
-            else:
-                state, delivered, next_ev = out
-            if track_overflow:
-                # ingress-ring overflow (the routing stage's drops) —
-                # the elastic capacity driver reads this back per chunk
-                in_acc = in_acc + (state.n_overflow_dropped
-                                   - state0.n_overflow_dropped)
+            ((state, delivered, _next_ev), metrics, _g, hist,
+             _fr) = unpack_planes(out, metrics=metrics, hist=hist)
+            # ingress-ring overflow (the routing stage's drops) — the
+            # elastic capacity driver reads this back per chain
+            in_acc = in_acc + (state.n_overflow_dropped
+                               - state0.n_overflow_dropped)
             state1 = state
             # respawn: each delivered packet triggers one new packet from
             # the receiving host to a hashed destination (deterministic).
@@ -155,7 +160,7 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None]:
             mask, new_dst, nbytes, seq_vals, ctrl = respawn_batch(
                 delivered, spawn_seq, round_idx, N,
                 state.in_src.shape[1])
-            state = ingest_rows(
+            out = ingest_rows(
                 state, new_dst, nbytes,
                 seq_vals,  # priority: reuse seq (FIFO-ish)
                 seq_vals, ctrl,
@@ -163,39 +168,39 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None]:
                 metrics=metrics,
                 hist=hist,
             )
-            if hist is not None:
-                state, metrics, hist = state
-            elif metrics is not None:
-                state, metrics = state
-            if track_overflow:
-                # egress-ring overflow (the respawn append's drops)
-                eg_acc = eg_acc + (state.n_overflow_dropped
-                                   - state1.n_overflow_dropped)
+            (state,), metrics, _g, hist, _fr = unpack_planes(
+                out, metrics=metrics, hist=hist, n_lead=1)
+            # egress-ring overflow (the respawn append's drops)
+            eg_acc = eg_acc + (state.n_overflow_dropped
+                               - state1.n_overflow_dropped)
             spawn_seq = spawn_seq + mask.sum(axis=1, dtype=jnp.int32)
-            if track_overflow:
-                carry = (state, spawn_seq, metrics, eg_acc, in_acc)
-            elif use_hist:
-                carry = (state, spawn_seq, metrics, hist)
-            else:
-                carry = (state, spawn_seq, metrics)
+            carry = (state, spawn_seq, metrics, hist, eg_acc, in_acc)
             return carry, mask.sum(dtype=jnp.int32)
         return round_fn
 
-    # the state pytree is donated: XLA reuses the input buffers for the
-    # scan carry instead of materializing a second copy of ~20 [N, C]
-    # arrays (donation contract: `state`/`state2` are dead after the call)
-    def make_run(kernel: str):
+    # the state pytree is donated in fixed/telemetry mode: XLA reuses
+    # the input buffers for the scan carry instead of materializing a
+    # second copy of ~20 [N, C] arrays (donation contract: `state` /
+    # `state2` are dead after the call). The elastic driver compiles
+    # WITHOUT donation — the chain-start snapshot must stay valid so an
+    # overflowing chain can be discarded and re-executed against grown
+    # rings (jit retraces once per ring shape, log2-bounded by the
+    # power-of-two growth).
+    def make_chain(kernel: str):
         round_fn = make_round_fn(kernel)
+        wrap = jax.jit if CAPACITY_MODE != "fixed" else donating_jit
 
-        @donating_jit
-        def run(state):
-            spawn_seq = jnp.full((N,), 10_000, jnp.int32)
-            (state, _, _), delivered_counts = jax.lax.scan(
-                round_fn, (state, spawn_seq, None),
-                jnp.arange(ROUNDS, dtype=jnp.int32)
-            )
-            return state, delivered_counts.sum()
-        return run
+        @wrap
+        def chain(state, spawn_seq, metrics, hist, round_ids):
+            zeros = jnp.zeros((N,), jnp.int32)
+            carry, delivered_counts = jax.lax.scan(
+                round_fn,
+                (state, spawn_seq, metrics, hist, zeros, zeros),
+                round_ids)
+            state, spawn_seq, metrics, hist, eg, inn = carry
+            return (state, spawn_seq, metrics, hist, eg, inn,
+                    delivered_counts.sum())
+        return chain
 
     # self-healing (faults/healing.py): a Pallas kernel that fails to
     # lower/compile on this backend demotes the bench to the
@@ -204,120 +209,64 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None]:
     # never masquerade as a healthy pallas measurement
     from shadow_tpu.faults import KernelFallback
 
-    run = KernelFallback(PLANE_KERNEL, make_run)
-
-    # telemetry mode: same loop, chunked at the harvest cadence. The
-    # metrics pytree rides the scan carry (pure jnp adds, no syncs); the
-    # state is donated, the metrics argument is NOT — the harvester's
-    # asynchronous D2H copy of the previous chunk's output must survive
-    # this chunk's dispatch (telemetry/harvest.py).
-    def make_run_chunk(kernel: str):
-        round_fn = make_round_fn(kernel, use_hist=HIST)
-
-        @donating_jit
-        def run_chunk(state, spawn_seq, metrics, hist, round_ids):
-            carry0 = ((state, spawn_seq, metrics, hist) if HIST
-                      else (state, spawn_seq, metrics))
-            carry, delivered_counts = jax.lax.scan(
-                round_fn, carry0, round_ids)
-            if HIST:
-                state, spawn_seq, metrics, hist = carry
-            else:
-                state, spawn_seq, metrics = carry
-            return state, spawn_seq, metrics, hist, \
-                delivered_counts.sum()
-        return run_chunk
-
-    run_chunk = KernelFallback(PLANE_KERNEL, make_run_chunk)
-
-    # elastic/strict capacity driver (docs/robustness.md "Elastic
-    # capacity"): the run proceeds in GROW_EVERY-window chunks through a
-    # NON-donating jit, so the chunk-start snapshot stays valid and an
-    # overflowing chunk can be discarded and re-executed against grown
-    # rings — the committed stream is bitwise-identical to a run
-    # pre-provisioned at the final capacity. jit retraces once per ring
-    # shape (log2-bounded by the power-of-two growth).
-    def make_elastic_chunk(kernel: str):
-        round_fn = make_round_fn(kernel, track_overflow=True)
-
-        @jax.jit
-        def chunk(state, spawn_seq, round_ids):
-            zeros = jnp.zeros((N,), jnp.int32)
-            (state, spawn_seq, _m, eg, inn), delivered_counts = \
-                jax.lax.scan(round_fn,
-                             (state, spawn_seq, None, zeros, zeros),
-                             round_ids)
-            return state, spawn_seq, eg, inn, delivered_counts.sum()
-        return chunk
-
-    elastic_chunk = (KernelFallback(PLANE_KERNEL, make_elastic_chunk)
-                     if CAPACITY_MODE != "fixed" else None)
+    chain_call = KernelFallback(PLANE_KERNEL, make_chain)
     capacity_info: dict | None = None
+    if CAPACITY_MODE != "fixed" and TELEMETRY:
+        raise SystemExit(
+            "BENCH_CAPACITY=elastic/strict and BENCH_TELEMETRY=1 "
+            "are mutually exclusive (each owns the chain cadence); "
+            "run them separately")
+    # windows per host sync: the whole run in fixed mode, the harvest
+    # cadence under telemetry, the growth-snapshot cadence under the
+    # capacity policy (recorded in the JSON `driver` field)
+    CHAIN_LEN = (HARVEST_EVERY if TELEMETRY
+                 else GROW_EVERY if CAPACITY_MODE != "fixed" else ROUNDS)
 
-    def run_elastic(state):
+    def run_driver(state, harvester=None, collect=None):
         nonlocal capacity_info
+        from shadow_tpu.telemetry import make_histograms, make_metrics
         from shadow_tpu.tpu import elastic
 
-        policy = elastic.RingPolicy(
-            mode=CAPACITY_MODE, max_doublings=MAX_DOUBLINGS,
-            egress_cap=EGRESS_CAP, ingress_cap=INGRESS_CAP,
-            plane="bench")
+        policy = None
+        if CAPACITY_MODE != "fixed":
+            policy = elastic.RingPolicy(
+                mode=CAPACITY_MODE, max_doublings=MAX_DOUBLINGS,
+                egress_cap=EGRESS_CAP, ingress_cap=INGRESS_CAP,
+                plane="bench")
         spawn_seq = jnp.full((N,), 10_000, jnp.int32)
-        total = jnp.int32(0)
-        ids = np.arange(ROUNDS, dtype=np.int32)
-        for i in range(0, ROUNDS, GROW_EVERY):
-            rid = jnp.asarray(ids[i:i + GROW_EVERY])
+        metrics = make_metrics(N) if TELEMETRY else None
+        hist = make_histograms(N) if (TELEMETRY and HIST) else None
 
-            def attempt(st, _sp=spawn_seq, _rid=rid):
-                st2, sp2, eg, inn, nd = elastic_chunk(st, _sp, _rid)
-                return (st2, sp2, nd), eg, inn
+        def chain_fn(state, extras, rids, _pr):
+            spawn_seq, metrics, hist, total = extras
+            state, spawn_seq, metrics, hist, eg, inn, nd = chain_call(
+                state, spawn_seq, metrics, hist, rids)
+            return state, (spawn_seq, metrics, hist, total + nd), eg, inn
 
-            out, _ = elastic.run_elastic_window(
-                state, attempt, policy, time_ns=i * int(window))
-            state, spawn_seq, nd = out
-            total = total + nd
-        capacity_info = policy.trajectory.as_dict()
-        capacity_info["initial"] = {"egress_cap": EGRESS_CAP,
-                                    "ingress_cap": INGRESS_CAP}
-        capacity_info["final"] = {"egress_cap": policy.egress_cap,
-                                  "ingress_cap": policy.ingress_cap}
-        return state, total
-
-    def telemetry_chunks():
-        ids = np.arange(ROUNDS, dtype=np.int32)
-        return [jnp.asarray(ids[i:i + HARVEST_EVERY])
-                for i in range(0, ROUNDS, HARVEST_EVERY)]
-
-    def run_telemetry(state, harvester=None, collect=None):
-        from shadow_tpu.telemetry import make_histograms, make_metrics
-
-        spawn_seq = jnp.full((N,), 10_000, jnp.int32)
-        metrics = make_metrics(N)
-        hist = make_histograms(N) if HIST else None
-        total = jnp.int32(0)
-        done = 0
-        for ids in telemetry_chunks():
-            state, spawn_seq, metrics, hist, ndel = run_chunk(
-                state, spawn_seq, metrics, hist, ids)
-            total = total + ndel
-            done += int(ids.shape[0])
+        def on_chain(r1, state, extras):
             if harvester is not None:
+                _sp, metrics, hist, _t = extras
                 device = (dict(metrics._asdict(), **hist._asdict())
-                          if HIST else metrics)
-                harvester.tick(done * int(window), device=device)
+                          if hist is not None else metrics)
+                harvester.tick(r1 * int(window), device=device)
+
+        state, extras = elastic.drive_chained_windows(
+            state, (spawn_seq, metrics, hist, jnp.int32(0)), chain_fn,
+            n_rounds=ROUNDS, chain_len=CHAIN_LEN, policy=policy,
+            window_ns=int(window),
+            on_chain=on_chain if harvester is not None else None)
+        _spawn_seq, metrics, hist, total = extras
         if collect is not None and hist is not None:
             collect["hist"] = hist
+        if policy is not None:
+            capacity_info = policy.trajectory.as_dict()
+            capacity_info["initial"] = {"egress_cap": EGRESS_CAP,
+                                        "ingress_cap": INGRESS_CAP}
+            capacity_info["final"] = {"egress_cap": policy.egress_cap,
+                                      "ingress_cap": policy.ingress_cap}
         return state, total
 
-    if CAPACITY_MODE != "fixed":
-        if TELEMETRY:
-            raise SystemExit(
-                "BENCH_CAPACITY=elastic/strict and BENCH_TELEMETRY=1 "
-                "are mutually exclusive (each owns the chunk cadence); "
-                "run them separately")
-        driver = run_elastic
-    else:
-        driver = run_telemetry if TELEMETRY else run
+    driver = run_driver
 
     # compile
     t0 = time.monotonic()
@@ -342,7 +291,7 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None]:
             slot_capacity=N * (EGRESS_CAP + INGRESS_CAP))
         collect: dict = {}
         t0 = time.monotonic()
-        state_out, ndel = run_telemetry(state2, harvester, collect)
+        state_out, ndel = driver(state2, harvester, collect)
         ndel = int(ndel)
         jax.block_until_ready(state_out)
         wall = time.monotonic() - t0
@@ -380,16 +329,26 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None]:
 
     sent = int(np.asarray(state_out.n_sent).sum())
     events = ndel + sent  # send + deliver events, like Shadow's event count
-    active = (elastic_chunk if elastic_chunk is not None
-              else run_chunk if TELEMETRY else run)
     kernel_info = {
         "requested": PLANE_KERNEL,
-        "used": active.kernel,
-        "fell_back": active.fell_back,
+        "used": chain_call.kernel,
+        "fell_back": chain_call.fell_back,
         "faults_threaded": FAULTS,
     }
+    # the chained-driver amortization record (docs/performance.md "The
+    # driver loop"): how many windows execute device-resident per host
+    # sync — the satellite metric next to the headline events/s
+    from shadow_tpu.tpu.elastic import chain_spans
+
+    n_chains = len(chain_spans(ROUNDS, CHAIN_LEN))
+    driver_info = {
+        "loop": "drive_chained_windows",
+        "chain_len": CHAIN_LEN,
+        "chains": n_chains,
+        "windows_per_sync": round(ROUNDS / max(n_chains, 1), 2),
+    }
     return events / wall, events, telemetry_info, kernel_info, \
-        capacity_info
+        capacity_info, driver_info
 
 
 def bench_cpu_baseline() -> float:
@@ -479,12 +438,35 @@ def bench_compiled_baseline() -> float:
         return 0.0
 
 
-def _regression_guard(value: float):
+def backend_fingerprint() -> dict:
+    """The container/backend identity a throughput number is only
+    comparable within: JAX platform + device kind. PR 7's false
+    regression — a CPU container measured against the accelerator-
+    backed BENCH_r05 — is exactly the comparison this stamp makes
+    impossible to repeat silently (both the `prior_round` guard below
+    and `tools/compare_runs.py --bench` refuse to gate across
+    mismatched fingerprints)."""
+    import jax
+
+    dev = jax.devices()[0]
+    return {
+        "platform": str(dev.platform),
+        "device_kind": str(getattr(dev, "device_kind", dev.platform)),
+    }
+
+
+def _regression_guard(value: float, fingerprint: dict):
     """Compare against the newest recorded BENCH_r*.json (same shape
     only): a silent -7% crept through round 4 unbisected; now any drop
-    past 20% is flagged in the output (tunnel noise stays quiet)."""
+    past 20% is flagged in the output (tunnel noise stays quiet).
+
+    A prior record whose backend fingerprint differs from this run's —
+    or predates the stamp — is NOT comparable: the guard then warns
+    loudly on stderr and reports `skipped_mismatched_backend` instead
+    of a regression verdict (the PR-7 false-regression rule)."""
     import glob
     import re
+    import sys
 
     best = None
     for path in glob.glob(os.path.join(
@@ -502,9 +484,23 @@ def _regression_guard(value: float):
             continue
         rnd = int(m.group(1))
         if best is None or rnd > best[0]:
-            best = (rnd, float(rec.get("value", 0)))
+            best = (rnd, float(rec.get("value", 0)),
+                    rec.get("backend"))
     if best is None or best[1] <= 0:
         return None
+    prior_backend = best[2]
+    if prior_backend != fingerprint:
+        print(
+            f"bench: WARNING: prior round BENCH_r{best[0]:02d} was "
+            f"measured on backend {prior_backend} but this run is on "
+            f"{fingerprint} — cross-container throughput ratios are "
+            f"meaningless, so the prior_round regression gate is "
+            f"SKIPPED. Re-measure both rounds on one container "
+            f"(docs/performance.md).", file=sys.stderr)
+        return {"vs_round": best[0],
+                "skipped_mismatched_backend": True,
+                "prior_backend": prior_backend,
+                "regressed": False}
     ratio = value / best[1]
     return {"vs_round": best[0], "ratio": round(ratio, 3),
             "regressed": ratio < 0.8}
@@ -525,22 +521,29 @@ def bench_sections(kernel: str) -> dict | None:
 
 
 def main():
-    tpu_rate, events, telemetry_info, kernel_info, capacity_info = \
-        bench_tpu()
+    (tpu_rate, events, telemetry_info, kernel_info, capacity_info,
+     driver_info) = bench_tpu()
     # sections are recorded for the default XLA kernel only: a pallas
     # run off-TPU would re-time every section in interpret mode (slow
     # and not the trajectory being tracked)
     sections = (bench_sections("xla")
                 if SECTIONS and kernel_info["used"] == "xla" else None)
+    if sections is not None:
+        # surface the chained-driver amortization next to the section
+        # times so compare_runs --bench diffs it like any other cost
+        sections["windows_per_sync"] = driver_info["windows_per_sync"]
     cpu_rate = bench_cpu_baseline()
     compiled_rate = bench_compiled_baseline()
-    guard = _regression_guard(tpu_rate)
+    fingerprint = backend_fingerprint()
+    guard = _regression_guard(tpu_rate, fingerprint)
     print(
         json.dumps(
             {
                 "metric": "packet_events_per_sec",
                 "value": round(tpu_rate, 1),
                 "unit": "events/s",
+                "backend": fingerprint,
+                "driver": driver_info,
                 "telemetry": telemetry_info,
                 "kernel": kernel_info,
                 "capacity": capacity_info,

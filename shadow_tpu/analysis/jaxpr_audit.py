@@ -17,7 +17,8 @@ independent) and walks the closed jaxpr plus every nested sub-jaxpr
   of passed as arguments.
 
 The registry (`default_entries`) covers all five kernel modules:
-``plane`` (window_step in both qdisc/AQM compile modes + chain_windows),
+``plane`` (window_step in both qdisc/AQM compile modes + chain_windows
+in every presence-switch variant — plain/metrics/guards/workload),
 ``tcp`` (event + pull + replay), ``transport`` (the DeviceTransport
 kernel set), ``floweng`` (the fused window driver), and ``codel``
 (trace replay + integrated router). Entries carry per-rule allow-lists
@@ -348,11 +349,18 @@ def _routing_entry(stage: str):
     return build
 
 
-def _chain_entry():
+def _chain_entry(variant: str = "plain"):
+    """`chain_windows` in each presence-switch compile mode: the chain
+    is THE device-resident driver loop, so every pytree that can ride
+    its while_loop carry (metrics / guards / the workload generator)
+    gets its own audited trace — a host sync smuggled into any carry
+    variant fails SL204 here, not in production."""
     def build():
         import jax
         import jax.numpy as jnp
 
+        from ..guards.plane import make_guards
+        from ..telemetry import make_metrics
         from ..tpu import plane
 
         n = 4
@@ -365,13 +373,46 @@ def _chain_entry():
                                  params=params)
         root = jax.random.key(0)
 
-        def fn(state, shift0, horizon):
+        def chain(state, shift0, horizon, **kw):
             return plane.chain_windows(
                 state, params, root, shift0, jnp.int32(1_000_000),
                 jnp.int32(1_000_000), horizon, horizon,
-                rr_enabled=False, no_loss=True)
+                rr_enabled=False, no_loss=True, **kw)
 
-        return fn, (state, jnp.int32(0), jnp.int32(50_000_000))
+        args = (state, jnp.int32(0), jnp.int32(50_000_000))
+        if variant == "metrics":
+            def fn(state, metrics, shift0, horizon):
+                return chain(state, shift0, horizon, metrics=metrics)
+
+            return fn, (args[0], make_metrics(n), *args[1:])
+        if variant == "guards":
+            def fn(state, guards, shift0, horizon):
+                return chain(state, shift0, horizon, guards=guards)
+
+            return fn, (args[0], make_guards(n), *args[1:])
+        if variant == "workload":
+            from ..workloads import compile_program, parse_scenario
+            from ..workloads import device as wdevice
+
+            prog = compile_program(parse_scenario({
+                "name": "audit-onoff", "hosts": n, "egress_cap": 8,
+                "ingress_cap": 8, "windows": 4,
+                "patterns": [{"kind": "onoff", "burst": 1, "rounds": 2,
+                              "gap_ns": 200_000,
+                              "off_mean_ns": 2_000_000}],
+            }))
+            wl = wdevice.to_device(prog)
+
+            def fn(state, ws, shift0, horizon):
+                return chain(state, shift0, horizon, workload=(wl, ws))
+
+            return fn, (args[0], wdevice.make_workload_state(prog),
+                        *args[1:])
+
+        def fn(state, shift0, horizon):
+            return chain(state, shift0, horizon)
+
+        return fn, args
 
     return build
 
@@ -505,6 +546,9 @@ def default_entries() -> list[AuditEntry]:
                    _plane_entry(True, True, False, packed_sort=False)),
         AuditEntry("window_step[pallas]", "shadow_tpu.tpu.plane",
                    _plane_entry(False, False, True, kernel="pallas")),
+        AuditEntry("window_step[pallas_fused]", "shadow_tpu.tpu.plane",
+                   _plane_entry(False, False, True,
+                                kernel="pallas_fused")),
         AuditEntry("window_step[telemetry]", "shadow_tpu.tpu.plane",
                    _plane_entry(True, True, False, telemetry=True)),
         AuditEntry("window_step[faults]", "shadow_tpu.tpu.plane",
@@ -519,6 +563,12 @@ def default_entries() -> list[AuditEntry]:
                    _routing_entry("place")),
         AuditEntry("chain_windows", "shadow_tpu.tpu.plane",
                    _chain_entry()),
+        AuditEntry("chain_windows[metrics]", "shadow_tpu.tpu.plane",
+                   _chain_entry("metrics")),
+        AuditEntry("chain_windows[guards]", "shadow_tpu.tpu.plane",
+                   _chain_entry("guards")),
+        AuditEntry("chain_windows[workload]", "shadow_tpu.tpu.plane",
+                   _chain_entry("workload")),
         AuditEntry("tcp_event_step", "shadow_tpu.tpu.tcp",
                    _tcp_entry("event")),
         AuditEntry("tcp_pull_step", "shadow_tpu.tpu.tcp",

@@ -664,27 +664,39 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
         raise ConfigError("general.stop_time is required and must be positive")
     if not cfg.hosts:
         raise ConfigError("at least one host is required")
-    if cfg.experimental.plane_kernel not in ("xla", "pallas"):
+    if cfg.experimental.plane_kernel not in ("xla", "pallas",
+                                             "pallas_fused"):
         raise ConfigError(
-            f"experimental.plane_kernel: expected 'xla' or 'pallas', got "
-            f"{cfg.experimental.plane_kernel!r}")
+            f"experimental.plane_kernel: expected 'xla', 'pallas', or "
+            f"'pallas_fused', got {cfg.experimental.plane_kernel!r}")
     for cap_name in ("tpu_egress_cap", "tpu_ingress_cap",
                      "tpu_compact_cap"):
         if getattr(cfg.experimental, cap_name) < 1:
             raise ConfigError(f"experimental.{cap_name} must be >= 1")
-    if cfg.experimental.plane_kernel == "pallas":
+    if cfg.experimental.plane_kernel != "xla":
+        kname = cfg.experimental.plane_kernel
         ce = cfg.experimental.tpu_egress_cap
         if ce & (ce - 1):
-            # the fused Pallas egress kernel's bitonic row sort needs a
-            # power-of-two egress ring (tpu/pallas_egress.py); failing
-            # HERE beats the opaque trace-time death it used to be.
-            # Elastic growth always targets powers of two, so an
-            # elastic run never grows its way out of pallas eligibility.
+            # the fused Pallas egress kernels' bitonic row sorts need a
+            # power-of-two egress ring (tpu/pallas_egress.py /
+            # tpu/pallas_pipeline.py); failing HERE beats the opaque
+            # trace-time death it used to be. Elastic growth always
+            # targets powers of two, so an elastic run never grows its
+            # way out of pallas eligibility.
             raise ConfigError(
-                f"experimental.plane_kernel: 'pallas' requires a "
+                f"experimental.plane_kernel: {kname!r} requires a "
                 f"power-of-two egress capacity (the fused kernel's "
                 f"bitonic row sort), got tpu_egress_cap={ce}; pick a "
                 f"power of two or use plane_kernel: xla")
+        ci = cfg.experimental.tpu_ingress_cap
+        if kname == "pallas_fused" and ci & (ci - 1):
+            # the fused pipeline additionally compacts the ingress ring
+            # in-kernel (tpu/pallas_pipeline.py kernel B)
+            raise ConfigError(
+                f"experimental.plane_kernel: 'pallas_fused' requires a "
+                f"power-of-two ingress capacity (the fused compaction "
+                f"bitonic), got tpu_ingress_cap={ci}; pick a power of "
+                f"two or use plane_kernel: xla|pallas")
     from .capacity import CAPACITY_MODES
 
     if cfg.capacity.mode not in CAPACITY_MODES:

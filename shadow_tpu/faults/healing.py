@@ -73,7 +73,7 @@ class KernelFallback:
 
     def __init__(self, kernel: str, build: Callable[[str], Callable],
                  enabled: bool = True):
-        if kernel not in ("xla", "pallas"):
+        if kernel not in ("xla", "pallas", "pallas_fused"):
             raise ValueError(f"unknown plane kernel {kernel!r}")
         self.kernel = kernel
         self.fell_back = False
@@ -88,7 +88,7 @@ class KernelFallback:
         try:
             return self._driver(*args, **kwargs)
         except Exception as e:
-            if self.kernel != "pallas" or not self._enabled:
+            if self.kernel == "xla" or not self._enabled:
                 raise
             # LOUD: a silent demotion would let a broken Pallas kernel
             # masquerade as a healthy run at XLA speed

@@ -10,8 +10,9 @@ metadata back to payloads buffered CPU-side.
 
 import os as _os
 
-from .plane import (NetPlaneParams, NetPlaneState, ingest, ingest_rows,
-                    make_params, make_state, window_step)
+from .plane import (NetPlaneParams, NetPlaneState, chain_windows, ingest,
+                    ingest_rows, make_params, make_state, unpack_planes,
+                    window_step)
 from .mesh import host_sharding, make_mesh, shard_state
 
 
@@ -60,11 +61,13 @@ def donating_jit(fun=None, donate_argnums=(0,), **jit_kwargs):
 __all__ = [
     "NetPlaneParams",
     "NetPlaneState",
+    "chain_windows",
     "donating_jit",
     "ingest",
     "ingest_rows",
     "make_params",
     "make_state",
+    "unpack_planes",
     "window_step",
     "make_mesh",
     "host_sharding",

@@ -26,12 +26,16 @@ the elastic parity matrix in tests/test_elastic.py pins is
 ``canonical_state(elastic) == canonical_state(pre-provisioned)`` plus
 identical delivered streams, counters, RNG, and metrics.
 
-`run_elastic_window` is the shared driver loop (tools/chaos_smoke.py,
-bench.py, tests): attempt the window, read the per-ring overflow the
-attempt reported, and — under the elastic policy — grow the offending
-dimension and re-execute the window from the pre-window snapshot
-(`jax.jit` retraces per ring shape, so recompiles are bounded at log2
-by the power-of-two growth; the PR-1 recompile harness asserts it).
+`drive_chained_windows` is THE driver loop (bench.py,
+tools/chaos_smoke.py, workloads/runner.py — pinned by the
+inspect-source gate in tests/test_chain_driver.py): K consecutive
+windows execute device-resident per dispatch, the host syncs only at
+chain ends, and under the capacity policy the chain is the
+growth-decision unit — `run_elastic_window` underneath attempts the
+chain, reads the per-ring overflow it reported, and grows + re-executes
+from the chain-start snapshot (`jax.jit` retraces per ring shape, so
+recompiles are bounded at log2 by the power-of-two growth; the PR-1
+recompile harness asserts it).
 
 jax imports are lazy (function-local) so `core/` consumers of the
 re-exported :class:`CapacityError` never pull the device stack.
@@ -46,7 +50,8 @@ from ..core.capacity import (CAPACITY_MODES, CapacityError,  # noqa: F401
 
 __all__ = [
     "CAPACITY_MODES", "CapacityError", "CapacityTrajectory", "RingPolicy",
-    "canonical_state", "grow_state", "grow_transport_state", "next_pow2",
+    "canonical_state", "chain_spans", "drive_chained_windows",
+    "grow_state", "grow_transport_state", "next_pow2",
     "ring_dims", "run_elastic_window",
 ]
 
@@ -168,6 +173,118 @@ def canonical_state(state):
         in_sock=w(iv, state.in_sock, 0),
         in_deliver_rel=w(iv, state.in_deliver_rel, I32_MAX),
     )
+
+
+def chain_spans(n_rounds: int, chain_len: int, *, start_round: int = 0,
+                boundaries=()) -> list[tuple[int, int]]:
+    """The driver's chain partition: [start_round, n_rounds) split at
+    every ABSOLUTE `chain_len` multiple and at every explicit boundary
+    round. Boundaries are where the host MUST regain control between
+    windows — checkpoint instants, tamper/kill points — on top of the
+    regular sync cadence. Empty spans collapse; spans are returned as
+    [r0, r1) pairs.
+
+    Cuts are aligned to round 0 (not to `start_round`) on purpose:
+    under the elastic capacity policy the chain IS the growth-decision
+    unit (one snapshot + one overflow read per span), so a run resumed
+    from a checkpoint must partition the remaining rounds exactly like
+    the uninterrupted run did or the two could grow different ring
+    trajectories — the kill/resume bitwise-parity contract
+    (docs/determinism.md "Chain length is bitwise-invisible" covers the
+    state stream; the ABSOLUTE alignment covers the capacity
+    trajectory). Chain lengths stay as regular as the boundary set
+    allows, which is what bounds the per-length scan retraces (one
+    compile per distinct span length)."""
+    if chain_len < 1:
+        raise ValueError(f"chain_len must be >= 1, got {chain_len}")
+    if start_round >= n_rounds:
+        # nothing left to run (a resume at or past the horizon) — the
+        # unguarded cut set would invert into a phantom
+        # (n_rounds, start_round) span and drive windows PAST the
+        # requested end
+        return []
+    cuts = {start_round, n_rounds}
+    first = ((start_round // chain_len) + 1) * chain_len
+    cuts.update(range(first, n_rounds, chain_len))
+    cuts.update(b for b in boundaries if start_round < b < n_rounds)
+    edges = sorted(cuts)
+    return [(a, b) for a, b in zip(edges[:-1], edges[1:]) if b > a]
+
+
+def drive_chained_windows(state, extras, chain_fn, *, n_rounds: int,
+                          chain_len: int, start_round: int = 0,
+                          boundaries=(), per_round=None,
+                          policy: RingPolicy | None = None,
+                          window_ns: int = 0, host_names=None,
+                          on_chain=None):
+    """THE driver loop. bench.py, tools/chaos_smoke.py, and the
+    scenario corpus runner (workloads/runner.py) all drive their
+    windows through this one function (pinned by the inspect-source
+    test in tests/test_chain_driver.py so the three loops cannot
+    silently fork again): K consecutive windows execute device-resident
+    per dispatch, and the host regains control only at chain ends —
+    once per harvest/checkpoint/growth boundary instead of once per
+    window.
+
+    `chain_fn(state, extras, round_ids, per_round_slice)` is the
+    caller's compiled chain — typically one `jax.lax.scan` of its
+    window body (window_step + respawn/workload emission, with
+    metrics/guards/hist/flight-recorder presence switches riding the
+    carry exactly as they ride `plane.chain_windows`' while_loop) —
+    and returns ``(state', extras', eg_overflow, in_overflow)`` with
+    the per-ring overflow the capacity policy reads ([N] arrays or
+    scalars; zeros when untracked). `round_ids` is the span's
+    jnp.int32 round-index vector; `per_round_slice` is
+    ``per_round(r0, r1)`` (None when per_round is None) — the hook
+    time-varying per-window inputs (the fault schedule's mask stack)
+    ride in on, as scan inputs rather than per-window host dispatches.
+
+    Under ``policy`` (elastic/strict capacity, docs/robustness.md),
+    every chain runs through :func:`run_elastic_window`: the snapshot
+    the policy re-executes from is the CHAIN-start state — one
+    snapshot per chain, not per window — and a chain that overflows is
+    discarded and re-executed against grown rings, so the committed
+    stream stays bitwise-identical to a pre-provisioned run. The
+    caller's `chain_fn` must then be a pure non-donating function of
+    its inputs.
+
+    ``on_chain(r1, state, extras)`` fires after every committed chain
+    (the host-sync point: harvester ticks, checkpoints, kill/tamper
+    hooks); returning a (state, extras) pair replaces the carried
+    values (how chaos_smoke's tamper writes corrupted device state),
+    returning None keeps them. Returns the final ``(state, extras)``.
+    """
+    import jax.numpy as jnp
+
+    for r0, r1 in chain_spans(n_rounds, chain_len,
+                              start_round=start_round,
+                              boundaries=boundaries):
+        rids = jnp.arange(r0, r1, dtype=jnp.int32)
+        pr = per_round(r0, r1) if per_round is not None else None
+        if policy is None:
+            state, extras, _eg, _in = chain_fn(state, extras, rids, pr)
+        else:
+            def attempt(st, _ex=extras, _rids=rids, _pr=pr):
+                st2, ex2, eg, inn = chain_fn(st, _ex, _rids, _pr)
+                return (st2, ex2), eg, inn
+
+            try:
+                out, _used = run_elastic_window(
+                    state, attempt, policy, time_ns=r0 * int(window_ns),
+                    host_names=host_names)
+            except CapacityError as e:
+                # under chained execution the overflow is observed per
+                # CHAIN, so the span is the precise blame unit — attach
+                # it here so every driver's error report names it
+                # without a side channel
+                e.chain_span = (r0, r1)
+                raise
+            state, extras = out
+        if on_chain is not None:
+            replaced = on_chain(r1, state, extras)
+            if replaced is not None:
+                state, extras = replaced
+    return state, extras
 
 
 def run_elastic_window(state, attempt_fn, policy: RingPolicy, *,

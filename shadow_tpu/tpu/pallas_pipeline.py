@@ -1,0 +1,279 @@
+"""The fused rank→place→egress Pallas pipeline: one VMEM-resident pass
+per host tile around the irreducible cross-host exchange.
+
+`plane_kernel: pallas` (tpu/pallas_egress.py + tpu/pallas_route.py)
+fuses the egress stage and the routing placement as TWO separate
+dispatches with XLA glue between them — the payload-column gathers
+behind the egress permutation, the routing seq-rank tensors, and the
+per-row placement loop all round-trip work through HBM or per-row
+control flow. `plane_kernel: pallas_fused` (this module) collapses
+that glue into the kernels, so a host tile's window work stays in
+VMEM end-to-end:
+
+- **egress_rank_stage** (kernel A): clock rebase → packed-key FIFO
+  bitonic sort → ALL payload columns permuted in-tile → Hillis-Steele
+  token gate → the routing stage's row-local seq order (phase A of the
+  bucketed exchange) as ONE more bitonic over the already-sorted
+  (seq, column) pairs, whose index column IS the `row_perm` the XLA
+  path materializes via an [N, CE, CE] pairwise rank + scatter
+  inversion. One dispatch where the two-dispatch path pays the egress
+  kernel plus five XLA gathers plus the rank tensors.
+- **route_place** (kernel B): the per-destination bucketed append with
+  the arrival-sorted stream resident in VMEM next to the destination
+  tile — rank arithmetic and scatter-append collapse into one
+  whole-tile masked select, with no per-row windowed-load loop (the
+  `pallas_route` formulation, whose row loop dominated the kernel's
+  cost) and no per-column placement dispatches.
+
+What stays in XLA is exactly the cross-host exchange: the flat diet
+sort establishing the global (dst, deliver) arrival order and its
+binary-searched bucket bounds (`plane._routing_rank` with the kernel-A
+`row_perm` passed through) — sorting across the host axis is what
+XLA's comparator networks are for, and under a sharded mesh that sort
+IS the all-to-all — plus the steady-state-gated ingress compaction
+(`plane._compact_ingress`) and due-release split, whose already-
+ordered fast path and wrapped-key diet make them cheaper than any
+in-kernel re-sort.
+
+Scope mirrors the split kernels: FIFO only (`rr_enabled=False`),
+power-of-two egress AND ingress capacities (the bitonic widths),
+refused at trace time when faults/guards/hist/flightrec are threaded
+(`window_step` enforces it; the self-healing `KernelFallback` demotes
+to the bitwise-identical XLA path). Off-TPU the kernels run in Pallas
+interpret mode — the interpret path and the bitwise-parity contract
+(tests/test_plane_sortdiet.py, tests/test_chain_driver.py) are what
+this module pins, like its two-dispatch siblings.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .pallas_egress import _bitonic_rows, _pick_tile
+from .plane import NO_CLAMP, _routing_rank
+
+_SIGN32 = np.uint32(0x80000000)
+I32_MAX = np.int32(2**31 - 1)
+
+
+def _require_pow2(cap: int, what: str):
+    if cap & (cap - 1):
+        raise ValueError(
+            f"plane_kernel='pallas_fused' needs a power-of-two {what} "
+            f"(the bitonic network width), got {cap}; pad the ring or "
+            f"use the xla/pallas kernels")
+
+
+# ---------------------------------------------------------------------------
+# kernel A: egress sort + token gate + routing row-perm
+# ---------------------------------------------------------------------------
+
+
+def _egress_rank_kernel(shift_ref, valid_ref, prio_ref, bytes_ref,
+                        tsend_ref, clamp_ref, dst_ref, seq_ref, sock_ref,
+                        ctrl_ref, balance_ref,
+                        prio_o, sock_o, dst_o, bytes_o, seq_o, ctrl_o,
+                        tsend_o, clamp_o, valid_o, sendable_o, spent_o,
+                        row_perm_o):
+    shift = shift_ref[0]
+    valid = valid_ref[...] != 0
+    prio = prio_ref[...]
+
+    # rebase send times / barrier clamps to this window's start
+    tsend_rb = jnp.where(valid, tsend_ref[...] - shift, 0)
+    clamp = clamp_ref[...]
+    clamp_rb = jnp.where(valid & (clamp != NO_CLAMP), clamp - shift, clamp)
+
+    # packed FIFO key (the `_pack_valid_key` layout) sorted by a bitonic
+    # over (key, column) pairs — bitwise the stable sort the XLA diet
+    # path computes; the permutation then lands EVERY payload column
+    # with in-VMEM row gathers (no HBM round trip, no separate dispatch)
+    key = jnp.where(valid, jnp.uint32(0), _SIGN32) | prio.astype(jnp.uint32)
+    n = key.shape[-1]
+    col = jax.lax.broadcasted_iota(jnp.int32, key.shape, dimension=1)
+    key_s, perm, _ = _bitonic_rows(key, col, col, ())
+    valid_s = (key_s & _SIGN32) == 0
+    take = lambda a: jnp.take_along_axis(a, perm, axis=1)
+    bytes_s = take(bytes_ref[...])
+    seq_s = take(seq_ref[...])
+
+    # Hillis-Steele inclusive prefix sum -> the token-bucket gate
+    cum = jnp.where(valid_s, bytes_s, 0)
+    shift_w = 1
+    while shift_w < n:
+        prev = jnp.concatenate(
+            [jnp.zeros_like(cum[:, :shift_w]), cum[:, :-shift_w]], axis=1)
+        cum = cum + prev
+        shift_w *= 2
+    sendable = valid_s & (cum <= balance_ref[...])
+    spent = jnp.sum(jnp.where(sendable, bytes_s, 0), axis=1, keepdims=True)
+
+    # routing phase A, fused: the XLA path ranks the SORTED rows by
+    # (seq, column) with an [N, CE, CE] pairwise tensor and inverts the
+    # rank by scatter; the inverse permutation is exactly "columns in
+    # (seq, column) order", i.e. the index output of ONE more bitonic
+    # over the sign-biased sorted seq — distinct (seq, col) pairs make
+    # the network's output the stable sort, bitwise the same perm
+    _, row_perm, _ = _bitonic_rows(seq_s.astype(jnp.uint32) ^ _SIGN32,
+                                   col, col, ())
+
+    prio_o[...] = take(prio)
+    sock_o[...] = take(sock_ref[...])
+    dst_o[...] = take(dst_ref[...])
+    bytes_o[...] = bytes_s
+    seq_o[...] = seq_s
+    ctrl_o[...] = take(ctrl_ref[...])
+    tsend_o[...] = take(tsend_rb)
+    clamp_o[...] = take(clamp_rb)
+    valid_o[...] = valid_s.astype(jnp.int32)
+    sendable_o[...] = sendable.astype(jnp.int32)
+    spent_o[...] = spent
+    row_perm_o[...] = row_perm
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _egress_rank_call(valid, prio, nbytes, tsend, clamp, dst, seq, sock,
+                      ctrl, balance, shift_ns, interpret: bool):
+    N, CE = valid.shape
+    T = _pick_tile(N)
+    row_spec = pl.BlockSpec((T, CE), lambda i: (i, 0))
+    col_spec = pl.BlockSpec((T, 1), lambda i: (i, 0))
+    out = pl.pallas_call(
+        _egress_rank_kernel,
+        grid=(N // T,),
+        in_specs=[pl.BlockSpec((1,), lambda i: (0,))]  # shift scalar
+        + [row_spec] * 9 + [col_spec],
+        out_specs=[row_spec] * 10 + [col_spec] + [row_spec],
+        out_shape=[jax.ShapeDtypeStruct((N, CE), jnp.int32)] * 10
+        + [jax.ShapeDtypeStruct((N, 1), jnp.int32),
+           jax.ShapeDtypeStruct((N, CE), jnp.int32)],
+        interpret=interpret,
+    )(shift_ns.reshape(1), valid.astype(jnp.int32), prio, nbytes, tsend,
+      clamp, dst, seq, sock, ctrl.astype(jnp.int32),
+      balance.reshape(N, 1))
+    return out
+
+
+def egress_rank_stage(valid, prio, nbytes, tsend, clamp, dst, seq, sock,
+                      ctrl, balance, shift_ns):
+    """Kernel A of the fused pipeline: returns the 9 sorted egress
+    columns (prio, sock, dst, bytes, seq, ctrl, tsend, clamp, valid)
+    plus (sendable, spent, row_perm) — bitwise equal to the XLA diet
+    path's `_egress_order` + `_token_gate` + the `_routing_order`
+    seq-rank inverse for FIFO rows, in ONE dispatch."""
+    _require_pow2(valid.shape[1], "egress capacity")
+    interpret = jax.default_backend() != "tpu"
+    (prio_s, sock_s, dst_s, bytes_s, seq_s, ctrl_s, tsend_s, clamp_s,
+     valid_s, sendable, spent, row_perm) = _egress_rank_call(
+        valid, prio, jnp.asarray(nbytes, jnp.int32),
+        jnp.asarray(tsend, jnp.int32), jnp.asarray(clamp, jnp.int32),
+        jnp.asarray(dst, jnp.int32), jnp.asarray(seq, jnp.int32),
+        jnp.asarray(sock, jnp.int32), ctrl,
+        jnp.asarray(balance, jnp.int32),
+        jnp.asarray(shift_ns, jnp.int32), interpret)
+    return (prio_s, sock_s, dst_s, bytes_s, seq_s, ctrl_s != 0, tsend_s,
+            clamp_s, valid_s != 0, sendable != 0, spent[:, 0], row_perm)
+
+
+# ---------------------------------------------------------------------------
+# kernel B: bucketed placement + due-release split
+# ---------------------------------------------------------------------------
+
+
+def _place_kernel(nv_ref, lo_ref, take_ref,
+                  s_src, s_seq, s_sock, s_bytes, s_del,
+                  b_src, b_seq, b_sock, b_bytes, b_del, b_valid,
+                  o_src, o_seq, o_sock, o_bytes, o_del, o_valid):
+    T, CI = b_src.shape
+    nv = nv_ref[...][:, None]
+    lo = lo_ref[...][:, None]
+    take_n = take_ref[...][:, None]
+    ccol = jax.lax.broadcasted_iota(jnp.int32, (T, CI), 1)
+    # append mask: slots [nv, nv + take_n) of each destination row
+    # receive the bucket's contiguous segment of the arrival-sorted
+    # stream; the segment window starts at (bucket offset - nv), so
+    # window column c IS the item for row slot c — the `pallas_route`
+    # collapse of rank + scatter-append, here as ONE whole-tile masked
+    # gather from the VMEM-resident stream instead of a per-row
+    # windowed-load loop (the loop emulation dominated interpret-mode
+    # cost; Mosaic may want the per-row `pl.ds` form back on hardware)
+    mask = (ccol >= nv) & (ccol < nv + take_n)
+    B2 = s_src.shape[0]
+    idx = jnp.clip(lo + ccol + CI, 0, B2 - 1)  # CI-left-padded stream
+    sel = lambda s_ref, base: jnp.where(mask, s_ref[...][idx], base)
+    o_src[...] = sel(s_src, b_src[...])
+    o_seq[...] = sel(s_seq, b_seq[...])
+    o_sock[...] = sel(s_sock, b_sock[...])
+    o_bytes[...] = sel(s_bytes, b_bytes[...])
+    o_del[...] = sel(s_del, b_del[...])
+    o_valid[...] = jnp.where(mask, 1, b_valid[...])
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _place_call(nv, lo, take, s_src, s_seq, s_sock, s_bytes, s_del,
+                b_src, b_seq, b_sock, b_bytes, b_del, b_valid,
+                interpret: bool):
+    N, CI = b_src.shape
+    B2 = s_src.shape[0]
+    T = _pick_tile(N)
+    tile1 = pl.BlockSpec((T,), lambda i: (i,))
+    row_spec = pl.BlockSpec((T, CI), lambda i: (i, 0))
+    full = pl.BlockSpec((B2,), lambda i: (0,))
+    return pl.pallas_call(
+        _place_kernel,
+        grid=(N // T,),
+        in_specs=[tile1] * 3 + [full] * 5 + [row_spec] * 6,
+        out_specs=[row_spec] * 6,
+        out_shape=[jax.ShapeDtypeStruct((N, CI), jnp.int32)] * 6,
+        interpret=interpret,
+    )(nv, lo, take, s_src, s_seq, s_sock, s_bytes, s_del,
+      b_src, b_seq, b_sock, b_bytes, b_del, b_valid)
+
+
+def route_place(sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel,
+                in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
+                in_valid_c, n_valid_in, row_perm):
+    """Kernel B of the fused pipeline (+ the XLA exchange): land the
+    routed arrivals into the destination tiles, bitwise equal to the
+    XLA path's `_routing_rank` + `_routing_place` composition over the
+    compacted ingress columns. `row_perm` is kernel A's fused seq-order
+    inverse. Returns the merged ingress columns + per-host overflow,
+    like `plane._route_scatter`."""
+    N, CE = eg_dst.shape
+    CI = in_src_c.shape[1]
+    _require_pow2(CI, "ingress capacity")
+    # the irreducible cross-host exchange: ONE diet flat sort over the
+    # (bucket, deliver, slot) keys + binary-searched bucket bounds —
+    # phase A's row_perm arrives precomputed from kernel A
+    row_perm, o_pos, offsets, take_n, overflow = _routing_rank(
+        sent, eg_dst, eg_seq, deliver_rel, n_valid_in, CI,
+        row_perm=row_perm)
+    lo = offsets - n_valid_in
+
+    # arrival-sorted payload streams, addressed through the composed
+    # permutation and padded CI on both sides so every masked stream
+    # index is in bounds (padding is never selected — masked lanes only
+    # cover the bucket's own segment)
+    flat = lambda a: a.reshape(-1)
+    g = (o_pos // CE) * CE + flat(row_perm)[o_pos]
+    pad = lambda a: jnp.pad(a, (CI, CI))
+    stream = lambda a: pad(flat(a)[g])
+    s_src = pad((o_pos // CE).astype(jnp.int32))
+    s_seq, s_sock = stream(eg_seq), stream(eg_sock)
+    s_bytes = stream(eg_bytes)
+    s_del = stream(deliver_rel)
+
+    interpret = jax.default_backend() != "tpu"
+    (in_src_m, in_seq_m, in_sock_m, in_bytes_m, in_deliver_m,
+     in_valid_m) = _place_call(
+        n_valid_in, lo, take_n, s_src, s_seq, s_sock, s_bytes, s_del,
+        in_src_c, in_seq_c, in_sock_c, in_bytes_c,
+        jnp.where(in_valid_c, in_deliver_c, I32_MAX),
+        in_valid_c.astype(jnp.int32), interpret)
+    return (in_src_m, in_seq_m, in_sock_m, in_bytes_m, in_deliver_m,
+            in_valid_m != 0, overflow)

@@ -518,8 +518,13 @@ def chain_windows(state: NetPlaneState, params: NetPlaneParams,
                   rng_root: jax.Array, shift0, window0_ns, runahead_ns,
                   horizon_rel, stop_rel, max_windows: int = 64, *,
                   rr_enabled: bool = True, router_aqm: bool = False,
-                  no_loss: bool = False,
-                  faults: FaultArrays | None = None):
+                  no_loss: bool = False, kernel: str = "xla",
+                  faults: FaultArrays | None = None,
+                  metrics: PlaneMetrics | None = None,
+                  guards: GuardState | None = None,
+                  hist: PlaneHistograms | None = None,
+                  flightrec: FlightRecArrays | None = None,
+                  workload=None, round0=0):
     """Advance consecutive scheduling windows ON DEVICE until one delivers.
 
     The device-resident analogue of the controller's window chain
@@ -537,40 +542,128 @@ def chain_windows(state: NetPlaneState, params: NetPlaneParams,
     must be pre-clamped to <= I32_MAX // 2 by the caller (the chain simply
     stops at the clamp and Python takes over).
 
-    Returns (state, delivered, off, next_rel, n_windows): `off` is the
-    LAST window's start relative to the first window's start — `delivered`
-    times and `next_rel` are relative to that last window's start.
+    Every `window_step` presence switch threads through the while_loop
+    carry with the same static-presence discipline as the step itself
+    (docs/observability.md, docs/robustness.md): `metrics`, `guards`,
+    `hist`, and `flightrec` pytrees accumulate across every chained
+    window with zero added host syncs — the chain is audited per
+    variant (`analysis/jaxpr_audit.py` `chain_windows[metrics]` /
+    `[guards]` / `[workload]`) so a sync smuggled into the carry fails
+    the build. `workload=(wl, ws)` additionally runs the traffic
+    generator's `workload_step` after each chained window (its
+    emission re-arms the next-event reduction, so a chain never sleeps
+    through traffic the generator just queued); `round0` is the
+    driver's window counter for `done_win` stamping. `kernel` selects
+    the plane kernel like `window_step` ("xla" | "pallas" |
+    "pallas_fused").
+
+    Returns (state, delivered, off, next_rel, n_windows[, metrics']
+    [, guards'][, hist'][, flightrec'][, ws']) — presence outputs
+    appended in `window_step` order, the workload state last. `off` is
+    the LAST window's start relative to the first window's start —
+    `delivered` times and `next_rel` are relative to that last
+    window's start.
     """
-    def step(st, shift, window_ns):
-        return window_step(st, params, rng_root, shift, window_ns,
-                           rr_enabled=rr_enabled, router_aqm=router_aqm,
-                           no_loss=no_loss, faults=faults)
+    if workload is not None:
+        from ..workloads import device as _wdevice
+
+        wl, ws0 = workload
+    else:
+        wl = ws0 = None
+
+    def step(st, planes, shift, window_ns, ridx):
+        m, g, h, fr, ws = planes
+        out = window_step(st, params, rng_root, shift, window_ns,
+                          rr_enabled=rr_enabled, router_aqm=router_aqm,
+                          no_loss=no_loss, kernel=kernel, faults=faults,
+                          metrics=m, guards=g, hist=h, flightrec=fr)
+        (st, delivered, next_ev), m, g, h, fr = unpack_planes(
+            out, metrics=m, guards=g, hist=h, flightrec=fr)
+        if ws is not None:
+            wout = _wdevice.workload_step(wl, ws, st, delivered, ridx,
+                                          window_ns, metrics=m, guards=g)
+            if m is not None and g is not None:
+                st, ws, m, g = wout
+            elif m is not None:
+                st, ws, m = wout
+            elif g is not None:
+                st, ws, g = wout
+            else:
+                st, ws = wout
+            # the emission may have re-armed an empty egress ring: the
+            # next pending event is then this window's end, exactly as
+            # window_step would have reported had the packets been
+            # queued before the step
+            next_ev = jnp.minimum(
+                next_ev, jnp.where(st.eg_valid.any(), window_ns,
+                                   I32_MAX))
+        return st, delivered, next_ev, (m, g, h, fr, ws)
 
     hs = jnp.minimum(jnp.int32(horizon_rel), jnp.int32(stop_rel))
 
-    state, delivered, next_ev = step(state, jnp.int32(shift0),
-                                     jnp.int32(window0_ns))
+    planes = (metrics, guards, hist, flightrec, ws0)
+    state, delivered, next_ev, planes = step(
+        state, planes, jnp.int32(shift0), jnp.int32(window0_ns),
+        jnp.int32(round0))
 
     def keep_going(delivered, off, next_ev):
         # hs - off > 0 and both < I32_MAX//2, so no overflow anywhere
         return (~delivered["mask"].any()) & (next_ev < hs - off)
 
     def cond(c):
-        _state, delivered, off, next_ev, n = c
+        _state, delivered, off, next_ev, n, _planes = c
         return keep_going(delivered, off, next_ev) & (n < max_windows)
 
     def body(c):
-        st, _delivered, off, next_ev, n = c
+        st, _delivered, off, next_ev, n, planes = c
         off2 = off + next_ev
         window = jnp.minimum(jnp.int32(runahead_ns),
                              jnp.int32(stop_rel) - off2)
-        st, delivered, next2 = step(st, next_ev, window)
-        return (st, delivered, off2, next2, n + 1)
+        st, delivered, next2, planes = step(st, planes, next_ev, window,
+                                            jnp.int32(round0) + n)
+        return (st, delivered, off2, next2, n + 1, planes)
 
-    state, delivered, off, next_ev, n = jax.lax.while_loop(
-        cond, body, (state, delivered, jnp.int32(0), next_ev, jnp.int32(1)),
+    state, delivered, off, next_ev, n, planes = jax.lax.while_loop(
+        cond, body,
+        (state, delivered, jnp.int32(0), next_ev, jnp.int32(1), planes),
     )
-    return state, delivered, off, next_ev, n
+    m, g, h, fr, ws = planes
+    out = (state, delivered, off, next_ev, n)
+    out += tuple(p for p in (m, g, h, fr) if p is not None)
+    if workload is not None:
+        out += (ws,)
+    return out
+
+
+def unpack_planes(out, *, metrics=None, guards=None, hist=None,
+                  flightrec=None, n_lead=3):
+    """Split a `window_step` (n_lead=3) or `ingest_rows` (n_lead=1)
+    output into its lead values plus the presence-switch outputs, in
+    the ONE declaration order both kernels append them — metrics,
+    guards, hist, flightrec. Pass the same presence pytrees the kernel
+    call received: each non-None plane comes back as its output, each
+    None stays None, so a driver writes
+
+        (st, delivered, nxt), m, g, h, fr = unpack_planes(
+            out, metrics=m, guards=g, hist=h, flightrec=fr)
+
+    instead of hand-maintaining a per-site pop sequence (a mis-ordered
+    pop swaps two pytrees silently until trace time — every window
+    driver shares this one unpacker for the same reason they share
+    `elastic.drive_chained_windows`)."""
+    if type(out) is not tuple:
+        # bare state: ingest_rows with no planes threaded returns the
+        # NetPlaneState itself — which IS a (named)tuple, so the check
+        # must be on the exact type, never isinstance
+        out = (out,)
+    lead, rest = out[:n_lead], list(out[n_lead:])
+    planes = tuple(rest.pop(0) if p is not None else None
+                   for p in (metrics, guards, hist, flightrec))
+    if rest:
+        raise TypeError(
+            f"unpack_planes: {len(rest)} unclaimed kernel output(s) — "
+            f"the presence arguments do not match the kernel call's")
+    return (lead, *planes)
 
 
 def compact_delivered(delivered: dict, cap: int):
@@ -835,9 +928,38 @@ def _egress_order(state: NetPlaneState, qkey1, qkey2, eg_tsend_rb,
     only under RR, where socket ids break rr-key ties — qkey2, with the
     payload columns permuted afterwards; vs the 12-array variadic sort
     (kept as the parity-reference path). Returns the 9 sorted columns
-    (prio, sock, dst, bytes, seq, ctrl, tsend, clamp, valid)."""
+    (prio, sock, dst, bytes, seq, ctrl, tsend, clamp, valid).
+
+    FIFO packed rows additionally gate the sort on a cheap
+    already-ordered check (the steady-state fast path): the leftover
+    prefix left by `_compact_egress` is in (validity | priority) order
+    already, and monotone-priority producers (the PHOLD respawn, the
+    workload emitters — seq-derived priorities) append in order too,
+    so most windows' rows arrive with a non-decreasing packed key. A
+    stable sort of a non-decreasing key with the column-index tiebreak
+    IS the identity, so both branches are bitwise-equal always — the
+    gate can only change speed, never a bit (same contract as
+    `ingest_rows`' gate_idle)."""
     if packed_sort:
         packed = _pack_valid_key(state.eg_valid, qkey1)
+        if not rr_enabled:
+            ordered = (packed[:, :-1] <= packed[:, 1:]).all()
+
+            def ident(_):
+                return (state.eg_prio, state.eg_sock, state.eg_dst,
+                        state.eg_bytes, state.eg_seq, state.eg_ctrl,
+                        eg_tsend_rb, eg_clamp_rb, state.eg_valid)
+
+            def do_sort(packed):
+                perm = _row_perm_sort(packed)
+                take = lambda a: jnp.take_along_axis(a, perm, axis=1)
+                return (take(state.eg_prio), take(state.eg_sock),
+                        take(state.eg_dst), take(state.eg_bytes),
+                        take(state.eg_seq), take(state.eg_ctrl),
+                        take(eg_tsend_rb), take(eg_clamp_rb),
+                        take(state.eg_valid))
+
+            return jax.lax.cond(ordered, ident, do_sort, packed)
         extra = (qkey2,) if rr_enabled else ()
         perm = _row_perm_sort(packed, *extra)
         take = lambda a: jnp.take_along_axis(a, perm, axis=1)
@@ -948,14 +1070,36 @@ def _compact_ingress(state: NetPlaneState, in_deliver, *, packed_sort: bool):
     for the scatter. Packed form: one uint32 (validity | sign-biased
     deliver) key + permutation; reference form: the 7-array variadic sort.
     Returns (deliver_c, src_c, seq_c, sock_c, bytes_c, valid_c,
-    n_valid_in)."""
+    n_valid_in).
+
+    The packed form gates the sort on an already-ordered check: after
+    the first window, the surviving ingress is EXACTLY what
+    `_release_due` (or the AQM keep-compaction) left — front-packed
+    ascending by deliver, garbage lanes behind — and the window rebase
+    is monotone, so the packed key arrives non-decreasing and the sort
+    is the identity. A stable 1-key sort of a non-decreasing key with
+    the column tiebreak IS the identity (equal keys keep column
+    order), so the branches are bitwise-equal for every input — the
+    gate trades a [N, CI] compare for the dominant steady-state row
+    sort."""
     key_deliver = jnp.where(state.in_valid, in_deliver, I32_MAX)
     if packed_sort:
-        perm = _row_perm_sort(_pack_time_key(state.in_valid, key_deliver))
-        take = lambda a: jnp.take_along_axis(a, perm, axis=1)
-        in_deliver_c, in_src_c = take(key_deliver), take(state.in_src)
-        in_seq_c, in_sock_c = take(state.in_seq), take(state.in_sock)
-        in_bytes_c, in_valid_c = take(state.in_bytes), take(state.in_valid)
+        packed = _pack_time_key(state.in_valid, key_deliver)
+        ordered = (packed[:, :-1] <= packed[:, 1:]).all()
+
+        def ident(_):
+            return (key_deliver, state.in_src, state.in_seq,
+                    state.in_sock, state.in_bytes, state.in_valid)
+
+        def do_sort(packed):
+            perm = _row_perm_sort(packed)
+            take = lambda a: jnp.take_along_axis(a, perm, axis=1)
+            return (take(key_deliver), take(state.in_src),
+                    take(state.in_seq), take(state.in_sock),
+                    take(state.in_bytes), take(state.in_valid))
+
+        (in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
+         in_valid_c) = jax.lax.cond(ordered, ident, do_sort, packed)
     else:
         inv_in = (~state.in_valid).astype(jnp.int32)
         (_, in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
@@ -969,7 +1113,7 @@ def _compact_ingress(state: NetPlaneState, in_deliver, *, packed_sort: bool):
             in_valid_c, n_valid_in)
 
 
-def _routing_order(sent, eg_dst, eg_seq, deliver_rel):
+def _routing_order(sent, eg_dst, eg_seq, deliver_rel, row_perm=None):
     """Bucketed routing, phase A: establish the deterministic global
     arrival order WITHOUT pushing payload through the flat comparator
     network. The order the CPU plane's event queue imposes per
@@ -992,22 +1136,27 @@ def _routing_order(sent, eg_dst, eg_seq, deliver_rel):
     bucket N, which sorts last and is never placed. Returns
     (row_perm [N, CE] — seq-rank position -> original column,
     o_pos [B] — sorted order -> seq-permuted flat slot,
-    offsets/counts [N] — each bucket's segment of the sorted order)."""
+    offsets/counts [N] — each bucket's segment of the sorted order).
+
+    `row_perm` may be passed in precomputed (the fused Pallas pipeline
+    derives it inside the egress kernel while the sorted rows are still
+    VMEM-resident); None computes it here via the pairwise rank."""
     N, CE = eg_dst.shape
     B = N * CE
     col = jnp.arange(CE, dtype=jnp.int32)
-    # stable rank of each slot within its row by (seq, column): the
-    # qdisc sort left rows in priority order, not seq order, and equal
-    # (dst, deliver) arrivals from one source must land by seq
-    earlier = ((eg_seq[:, None, :] < eg_seq[:, :, None])
-               | ((eg_seq[:, None, :] == eg_seq[:, :, None])
-                  & (col[None, None, :] < col[None, :, None])))
-    rank = jnp.sum(earlier, axis=2, dtype=jnp.int32)  # [N, CE]
-    rows = jnp.arange(N, dtype=jnp.int32)[:, None]
-    # rank is a permutation per row ((seq, col) pairs are distinct), so
-    # the scatter inverts it: row_perm[n, rank[n, c]] = c
-    row_perm = jnp.zeros((N, CE), jnp.int32).at[rows, rank].set(
-        jnp.broadcast_to(col, (N, CE)))
+    if row_perm is None:
+        # stable rank of each slot within its row by (seq, column): the
+        # qdisc sort left rows in priority order, not seq order, and equal
+        # (dst, deliver) arrivals from one source must land by seq
+        earlier = ((eg_seq[:, None, :] < eg_seq[:, :, None])
+                   | ((eg_seq[:, None, :] == eg_seq[:, :, None])
+                      & (col[None, None, :] < col[None, :, None])))
+        rank = jnp.sum(earlier, axis=2, dtype=jnp.int32)  # [N, CE]
+        rows = jnp.arange(N, dtype=jnp.int32)[:, None]
+        # rank is a permutation per row ((seq, col) pairs are distinct),
+        # so the scatter inverts it: row_perm[n, rank[n, c]] = c
+        row_perm = jnp.zeros((N, CE), jnp.int32).at[rows, rank].set(
+            jnp.broadcast_to(col, (N, CE)))
     take_row = lambda a: jnp.take_along_axis(a, row_perm, axis=1)
     sent_p, dst_p = take_row(sent), take_row(eg_dst)
     flat_dst = jnp.where(sent_p & (dst_p >= 0) & (dst_p < N),
@@ -1015,8 +1164,13 @@ def _routing_order(sent, eg_dst, eg_seq, deliver_rel):
     deliver_key = take_row(deliver_rel).reshape(-1) \
         .astype(jnp.uint32) ^ _SIGN32
     pos = jnp.arange(B, dtype=jnp.int32)
+    # (dst, deliver, pos) is a TOTAL order (pos is distinct), so the
+    # unstable sort with pos promoted to a key returns exactly the
+    # stable 2-key permutation — and skips the stable-sort machinery,
+    # measurably cheaper through XLA:CPU's comparator path
     o_dst, _, o_pos = jax.lax.sort((flat_dst, deliver_key, pos),
-                                   dimension=0, is_stable=True, num_keys=2)
+                                   dimension=0, is_stable=False,
+                                   num_keys=3)
     bounds = jnp.searchsorted(
         o_dst, jnp.arange(N + 1, dtype=jnp.int32)).astype(jnp.int32)
     offsets, counts = bounds[:-1], bounds[1:] - bounds[:-1]
@@ -1024,7 +1178,7 @@ def _routing_order(sent, eg_dst, eg_seq, deliver_rel):
 
 
 def _routing_rank(sent, eg_dst, eg_seq, deliver_rel, n_valid_in,
-                  ingress_cap: int):
+                  ingress_cap: int, row_perm=None):
     """Section 5a (packed): counting placement over the bucketed order.
     Each destination row accepts the first `take` items of its bucket's
     sorted segment — exactly the items whose in-bucket rank fits the
@@ -1033,7 +1187,7 @@ def _routing_rank(sent, eg_dst, eg_seq, deliver_rel, n_valid_in,
     materialized. Returns (row_perm, o_pos, offsets, take [N], overflow
     [N])."""
     row_perm, o_pos, offsets, counts = _routing_order(
-        sent, eg_dst, eg_seq, deliver_rel)
+        sent, eg_dst, eg_seq, deliver_rel, row_perm)
     # per-bucket arithmetic is exact: occupancy never exceeds capacity,
     # so free = CI - n_valid >= 0; arrivals past the free slots drop
     take_n = jnp.minimum(counts, jnp.int32(ingress_cap) - n_valid_in)
@@ -1200,20 +1354,32 @@ def _release_due(in_deliver_m, in_src_m, in_seq_m, in_sock_m, in_bytes_m,
     window's due deliveries and the surviving queue. One sort serves both:
     not-due first keyed by deliver time keeps the survivors front-packed;
     the due block lands at the row tail in deterministic (deliver_t, src,
-    seq) presentation order. The packed form carries the column index
-    through the 4-key sort instead of the 4 payload columns and permutes
-    them afterwards. Returns (delivered dict, due, surviving ingress
-    columns)."""
+    seq) presentation order. The packed form fuses the (is_due, deliver)
+    key pair into ONE uint32 via modular subtraction — is_due is exactly
+    `deliver < window_ns`, so `biased(deliver) - biased(window_ns)` in
+    wrapping uint32 arithmetic sends not-due entries to [0, ..) and due
+    entries to the wrapped top of the range, each ascending in deliver:
+    precisely the (is_due, deliver) composite order — and carries the
+    column index through the now-total-order unstable sort instead of
+    the payload columns (the deliver column itself is recovered from
+    the wrapped key by adding the bias back). Returns (delivered dict,
+    due, surviving ingress columns)."""
     in_deliver_key = jnp.where(in_valid_m, in_deliver_m, I32_MAX)
     due = in_valid_m & (in_deliver_key < window_ns)
     is_due = due.astype(jnp.int32)
     if packed_sort:
         N, CI = due.shape
         col = jnp.broadcast_to(jnp.arange(CI, dtype=jnp.int32), (N, CI))
-        (_, d_t, d_src, d_seq, perm) = jax.lax.sort(
-            (is_due, in_deliver_key, in_src_m, in_seq_m, col),
-            dimension=1, is_stable=True, num_keys=4,
+        w_bias = jnp.int32(window_ns).astype(jnp.uint32) ^ _SIGN32
+        wkey = (in_deliver_key.astype(jnp.uint32) ^ _SIGN32) - w_bias
+        # (wkey, src, seq, col) is total (col distinct), so the
+        # unstable 4-key sort equals the stable (is_due, deliver, src,
+        # seq) sort the reference path computes
+        (wkey_s, d_src, d_seq, perm) = jax.lax.sort(
+            (wkey, in_src_m, in_seq_m, col),
+            dimension=1, is_stable=False, num_keys=4,
         )
+        d_t = ((wkey_s + w_bias) ^ _SIGN32).astype(jnp.int32)
         take = lambda a: jnp.take_along_axis(a, perm, axis=1)
         d_sock, d_bytes = take(in_sock_m), take(in_bytes_m)
         d_due, d_valid = take(due), take(in_valid_m)
@@ -1405,34 +1571,35 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     per host) and `next_event_rel` is the min pending delivery time
     relative to the new window start (INT32_MAX when idle).
     """
-    if kernel not in ("xla", "pallas"):
+    if kernel not in ("xla", "pallas", "pallas_fused"):
         raise ValueError(f"unknown plane kernel {kernel!r}: "
-                         "expected 'xla' or 'pallas'")
-    if kernel == "pallas" and rr_enabled:
+                         "expected 'xla', 'pallas', or 'pallas_fused'")
+    pallas_kernel = kernel != "xla"
+    if pallas_kernel and rr_enabled:
         raise ValueError(
-            "plane_kernel='pallas' fuses the FIFO qdisc only; compile "
+            f"plane_kernel={kernel!r} fuses the FIFO qdisc only; compile "
             "with rr_enabled=False (all-FIFO configs) or use the XLA path")
-    if kernel == "pallas" and not packed_sort:
+    if pallas_kernel and not packed_sort:
         raise ValueError(
-            "plane_kernel='pallas' implements the packed/bucketed "
+            f"plane_kernel={kernel!r} implements the packed/bucketed "
             "ordering only; the packed_sort=False parity reference is an "
             "XLA-path concept — compile with kernel='xla' to measure or "
             "compare against the legacy variadic sorts")
-    if kernel == "pallas" and faults is not None:
+    if pallas_kernel and faults is not None:
         raise ValueError(
-            "plane_kernel='pallas' does not fuse the fault plane; compile "
-            "with kernel='xla' when a FaultArrays pytree is threaded (the "
-            "self-healing kernel fallback in faults/healing.py does this "
-            "automatically)")
-    if kernel == "pallas" and guards is not None:
+            f"plane_kernel={kernel!r} does not fuse the fault plane; "
+            "compile with kernel='xla' when a FaultArrays pytree is "
+            "threaded (the self-healing kernel fallback in "
+            "faults/healing.py does this automatically)")
+    if pallas_kernel and guards is not None:
         raise ValueError(
-            "plane_kernel='pallas' does not fuse the guard plane; compile "
-            "with kernel='xla' when a GuardState pytree is threaded (the "
-            "self-healing kernel fallback in faults/healing.py does this "
-            "automatically)")
-    if kernel == "pallas" and (hist is not None or flightrec is not None):
+            f"plane_kernel={kernel!r} does not fuse the guard plane; "
+            "compile with kernel='xla' when a GuardState pytree is "
+            "threaded (the self-healing kernel fallback in "
+            "faults/healing.py does this automatically)")
+    if pallas_kernel and (hist is not None or flightrec is not None):
         raise ValueError(
-            "plane_kernel='pallas' does not fuse the histogram/flight-"
+            f"plane_kernel={kernel!r} does not fuse the histogram/flight-"
             "recorder observability plane; compile with kernel='xla' "
             "when a PlaneHistograms or FlightRecArrays pytree is "
             "threaded (the self-healing kernel fallback in "
@@ -1454,7 +1621,19 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     # socket by per-source seq, which is monotone in emission order).
     # Send times / clamps of leftover packets were taken relative to the
     # window they were ingested in; rebase them too.
-    if kernel == "pallas":
+    row_perm_fused = None
+    if kernel == "pallas_fused":
+        from . import pallas_pipeline
+
+        (eg_prio, eg_sock, eg_dst, eg_bytes, eg_seq, eg_ctrl, eg_tsend,
+         eg_clamp, eg_valid, sendable, spent,
+         row_perm_fused) = pallas_pipeline.egress_rank_stage(
+            state.eg_valid, state.eg_prio, state.eg_bytes,
+            state.eg_tsend, state.eg_clamp, state.eg_dst, state.eg_seq,
+            state.eg_sock, state.eg_ctrl, balance, shift_ns)
+        balance = balance - spent
+        rr_sent = state.rr_sent
+    elif kernel == "pallas":
         from . import pallas_egress
 
         (perm, eg_bytes, eg_tsend, eg_clamp, eg_valid,
@@ -1524,21 +1703,30 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     # which still indexes this ordering)
     eg_valid_left = eg_valid & ~sendable
 
-    # --- 4. compact surviving ingress (front-packed for the scatter) -----
-    (in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c, in_valid_c,
-     n_valid_in) = _compact_ingress(state, in_deliver,
-                                    packed_sort=packed_sort)
+    # --- 4 + 5. compact surviving ingress, then route sent packets into
+    # destination ingress queues. Routing happens BEFORE the due check
+    # so a packet whose deliver time falls inside this window
+    # (integrated transport: sent last round, clamped to this window's
+    # start) is released THIS round, matching the CPU plane's
+    # push-then-execute ordering.
+    (in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
+     in_valid_c, n_valid_in) = _compact_ingress(
+        state, in_deliver, packed_sort=packed_sort)
+    if kernel == "pallas_fused":
+        from . import pallas_pipeline
 
-    # --- 5. route sent packets into destination ingress queues ----------
-    # This happens BEFORE the due check so a packet whose deliver time
-    # falls inside this window (integrated transport: sent last round,
-    # clamped to this window's start) is released THIS round, matching the
-    # CPU plane's push-then-execute ordering.
-    (in_src_m, in_seq_m, in_sock_m, in_bytes_m, in_deliver_m, in_valid_m,
-     overflowed) = _route_scatter(
-        sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel, in_deliver_c,
-        in_src_c, in_seq_c, in_sock_c, in_bytes_c, in_valid_c, n_valid_in,
-        packed_sort=packed_sort, kernel=kernel)
+        (in_src_m, in_seq_m, in_sock_m, in_bytes_m, in_deliver_m,
+         in_valid_m, overflowed) = pallas_pipeline.route_place(
+            sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel,
+            in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
+            in_valid_c, n_valid_in, row_perm_fused)
+    else:
+        (in_src_m, in_seq_m, in_sock_m, in_bytes_m, in_deliver_m,
+         in_valid_m, overflowed) = _route_scatter(
+            sent, eg_dst, eg_seq, eg_bytes, eg_sock, deliver_rel,
+            in_deliver_c, in_src_c, in_seq_c, in_sock_c, in_bytes_c,
+            in_valid_c, n_valid_in,
+            packed_sort=packed_sort, kernel=kernel)
     CI = in_src_m.shape[1]
 
     # --- 5b. destination side: release what this window hands the hosts --

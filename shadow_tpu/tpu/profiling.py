@@ -62,6 +62,19 @@ Sections (superset of the window step's numbered stages):
   telemetry section: histogram one-hot sums, the sampling threefry,
   and the trace-ring compaction may never cost the hot path a sync or
   material compute.
+- ``fused_stage`` — the span of the window step the fused Pallas
+  pipeline covers (egress order + token gate + loss/latency + ingress
+  compaction + routing), timed under whatever ``kernel`` the profile
+  runs: the number the CI perf-smoke gate compares between
+  ``--kernel pallas`` (two dispatches + XLA glue) and ``--kernel
+  pallas_fused`` (kernel A → flat exchange → kernel B,
+  tpu/pallas_pipeline.py).
+- ``window_chain8`` — EIGHT window steps as one compiled
+  `lax.scan` chain (the shared driver's device-resident unit,
+  `tpu/elastic.drive_chained_windows`): divide by 8×``window_step``
+  for the chain amortization ratio — what a host sync per window was
+  costing. bench.py surfaces the companion ``windows_per_sync`` ratio
+  in its JSON `sections`.
 - ``window_step_workload`` — the full step plus the workload plane's
   `workload_step` (`shadow_tpu/workloads/device.py`, an onoff traffic
   program at the bench shape): phase-pointer advance + table-driven
@@ -92,7 +105,8 @@ DEFAULT_SECTIONS = (
     "rebase_refill", "rr_tensors", "qdisc_sort", "token_gate",
     "loss_latency", "ingress_compact", "routing_scatter", "routing_rank",
     "routing_place", "release_due", "codel_drain", "egress_compact",
-    "ingest_rows", "window_step", "window_step_telemetry",
+    "ingest_rows", "fused_stage", "window_step", "window_chain8",
+    "window_step_telemetry",
     "window_step_faults", "window_step_guards", "window_step_elastic",
     "window_step_trace", "window_step_workload",
 )
@@ -105,6 +119,7 @@ BENCH_SECTIONS = (
     "rebase_refill", "qdisc_sort", "token_gate", "loss_latency",
     "ingress_compact", "routing_scatter", "routing_rank", "routing_place",
     "release_due", "egress_compact", "ingest_rows", "window_step",
+    "window_chain8",
 )
 
 
@@ -343,7 +358,82 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
 
         return jax.jit(probe), _wdevice.make_workload_state(prog)
 
+    def _fused_stage(st, sh):
+        """The span the fused pipeline covers (sections 2 + 3 + 4 + 5),
+        composed for the profiled `kernel` — the apples-to-apples
+        number behind the CI fused-vs-two-dispatch gate."""
+        in_dl = jnp.where(st.in_valid, st.in_deliver_rel - sh, I32_MAX)
+        balance2, _rem2 = _refill_tokens(st, params, sh)
+        if kernel == "pallas_fused":
+            from . import pallas_pipeline
+
+            (_p, f_sock, f_dst, f_bytes, f_seq, f_ctrl, f_tsend,
+             f_clamp, _v, f_send, _spent,
+             f_perm) = pallas_pipeline.egress_rank_stage(
+                st.eg_valid, st.eg_prio, st.eg_bytes, st.eg_tsend,
+                st.eg_clamp, st.eg_dst, st.eg_seq, st.eg_sock,
+                st.eg_ctrl, balance2, sh)
+            f_sent, _l, _rc, f_dr = _loss_latency(
+                st, params, rng_root, f_dst, f_ctrl, f_tsend, f_clamp,
+                f_send, window, no_loss=False)
+            comp = _compact_ingress(st, in_dl, packed_sort=True)
+            (m_src, m_seq, m_sock, m_bytes, m_del, m_valid,
+             f_ovf) = pallas_pipeline.route_place(
+                f_sent, f_dst, f_seq, f_bytes, f_sock, f_dr, *comp,
+                f_perm)
+            return f_ovf, _release_due(m_del, m_src, m_seq, m_sock,
+                                       m_bytes, m_valid, window,
+                                       packed_sort=True)
+        tsr = jnp.where(st.eg_valid, st.eg_tsend - sh, 0)
+        clr = jnp.where(st.eg_valid & (st.eg_clamp != NO_CLAMP),
+                        st.eg_clamp - sh, st.eg_clamp)
+        qk1f, qk2f, _af = _qdisc_keys(st, params, rr_enabled=rr_enabled)
+        if kernel == "pallas":
+            from . import pallas_egress
+
+            (f_permE, f_bytes, f_tsend, f_clamp, _v, f_send,
+             f_spent) = pallas_egress.egress_order_gate(
+                st.eg_valid, st.eg_prio, st.eg_bytes, st.eg_tsend,
+                st.eg_clamp, balance2, sh)
+            takeE = lambda a: jnp.take_along_axis(a, f_permE, axis=1)
+            f_dst, f_seq = takeE(st.eg_dst), takeE(st.eg_seq)
+            f_sock, f_ctrl = takeE(st.eg_sock), takeE(st.eg_ctrl)
+        else:
+            (_p, f_sock, f_dst, f_bytes, f_seq, f_ctrl, f_tsend,
+             f_clamp, f_valid) = _egress_order(
+                st, qk1f, qk2f, tsr, clr, rr_enabled=rr_enabled,
+                packed_sort=packed_sort)
+            f_send, _bal2f = _token_gate(f_valid, f_bytes, balance2)
+        f_sent, _l, _rc, f_dr = _loss_latency(
+            st, params, rng_root, f_dst, f_ctrl, f_tsend, f_clamp,
+            f_send, window, no_loss=False)
+        comp = _compact_ingress(st, in_dl, packed_sort=packed_sort)
+        (m_src, m_seq, m_sock, m_bytes, m_del, m_valid,
+         f_ovf) = _route_scatter(
+            f_sent, f_dst, f_seq, f_bytes, f_sock, f_dr, *comp,
+            packed_sort=packed_sort, kernel=kernel)
+        # the fused pipeline's span ends at the due split, so the
+        # non-fused variants time it too (apples-to-apples)
+        return f_ovf, _release_due(m_del, m_src, m_seq, m_sock,
+                                   m_bytes, m_valid, window,
+                                   packed_sort=packed_sort)
+
+    def _chain8(st, sh):
+        """Eight windows as one compiled scan — the shared driver's
+        device-resident chain unit at its smallest realistic length."""
+        def body(carry, _ridx):
+            st, sh = carry
+            st, delivered, _nxt = window_step(
+                st, params, rng_root, sh, window, rr_enabled=rr_enabled,
+                packed_sort=packed_sort, kernel=kernel)
+            return (st, window), delivered["mask"].sum(dtype=jnp.int32)
+        (st, _sh), outs = jax.lax.scan(
+            body, (st, sh), jnp.arange(8, dtype=jnp.int32))
+        return st, outs.sum()
+
     section_calls = {
+        "fused_stage": (jax.jit(_fused_stage), (state, shift)),
+        "window_chain8": (jax.jit(_chain8), (state, shift)),
         "rebase_refill": (jax.jit(rebase_refill), (state, shift)),
         "rr_tensors": (
             jax.jit(lambda st: _qdisc_keys(st, params, rr_enabled=True)),

@@ -156,7 +156,7 @@ def run_scenario(spec: ScenarioSpec, *,
     from ..telemetry import flightrec as frmod
     from ..telemetry import histo
     from ..tpu import elastic
-    from ..tpu.plane import window_step
+    from ..tpu.plane import unpack_planes, window_step
     from . import device as wdevice
 
     prog = compile_program(spec)
@@ -197,22 +197,22 @@ def run_scenario(spec: ScenarioSpec, *,
     adv = max_advance if max_advance is not None else wdevice.MAX_ADVANCE
     faulted = schedule is not None
 
-    @jax.jit
-    def step(state, ws, metrics, gstate, hstate, fstate, faults, shift,
-             ridx):
+    from ..tpu import elastic as _elastic
+
+    def round_fn(carry, xs):
+        state, ws, metrics, gstate, hstate, fstate = carry
+        if faulted:
+            ridx, faults = xs
+        else:
+            ridx, faults = xs, None
+        shift = jnp.where(ridx == 0, jnp.int32(0), window)
         out = window_step(state, params, rng_root, shift, window,
                           rr_enabled=False, faults=faults,
                           metrics=metrics, guards=gstate,
                           hist=hstate, flightrec=fstate)
-        state, delivered, _next = out[:3]
-        rest = list(out[3:])
-        metrics = rest.pop(0)
-        if gstate is not None:
-            gstate = rest.pop(0)
-        if hstate is not None:
-            hstate = rest.pop(0)
-        if fstate is not None:
-            fstate = rest.pop(0)
+        (state, delivered, _next), metrics, gstate, hstate, fstate = \
+            unpack_planes(out, metrics=metrics, guards=gstate,
+                          hist=hstate, flightrec=fstate)
         out = wdevice.workload_step(
             wl, ws, state, delivered, ridx, window, max_advance=adv,
             metrics=metrics, guards=gstate)
@@ -220,32 +220,57 @@ def run_scenario(spec: ScenarioSpec, *,
             state, ws, metrics, gstate = out
         else:
             state, ws, metrics = out
-        return state, ws, metrics, gstate, hstate, fstate
+        return (state, ws, metrics, gstate, hstate, fstate), None
 
-    def _device_counters():
-        """The harvester's device dict: metrics + histogram leaves."""
-        if hstate is None:
-            return metrics
-        return {**metrics._asdict(), **hstate._asdict()}
+    @jax.jit
+    def chain(state, ws, metrics, gstate, hstate, fstate, rids,
+              faults_stack):
+        # K windows device-resident per dispatch (the shared driver's
+        # contract): the fault-mask stack rides as per-round scan
+        # inputs, every presence plane rides the carry — bitwise
+        # identical to the per-window loop this replaced, once per
+        # telemetry harvest instead of once per window
+        xs = (rids, faults_stack) if faulted else rids
+        carry, _ = jax.lax.scan(
+            round_fn, (state, ws, metrics, gstate, hstate, fstate), xs)
+        return carry
 
-    annotated = 0
-    for r in range(spec.windows):
-        now_ns = (r + 1) * spec.window_ns
-        faults = None
-        if faulted:
-            schedule.advance(now_ns)
-            faults = schedule.device_arrays()
-        shift = jnp.int32(0 if r == 0 else spec.window_ns)
-        state, ws, metrics, gstate, hstate, fstate = step(
-            state, ws, metrics, gstate, hstate, fstate, faults, shift,
-            jnp.int32(r))
-        if (r + 1) % telemetry_every == 0:
+    def per_round(r0, r1):
+        stack = []
+        for r in range(r0, r1):
+            schedule.advance((r + 1) * spec.window_ns)
+            stack.append(schedule.device_arrays())
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *stack)
+
+    def chain_fn(state, extras, rids, faults_stack):
+        ws, metrics, gstate, hstate, fstate = extras
+        state, ws, metrics, gstate, hstate, fstate = chain(
+            state, ws, metrics, gstate, hstate, fstate, rids,
+            faults_stack)
+        return state, (ws, metrics, gstate, hstate, fstate), 0, 0
+
+    annotated = [0]
+
+    def on_chain(r1, state, extras):
+        ws, metrics, gstate, hstate, fstate = extras
+        if r1 % telemetry_every == 0:
             if telemetry is not None:
-                annotated = _annotate_phases(
-                    telemetry, spec, prog, ws, annotated)
-                telemetry.tick(now_ns, device=_device_counters())
+                annotated[0] = _annotate_phases(
+                    telemetry, spec, prog, ws, annotated[0])
+                telemetry.tick(r1 * spec.window_ns,
+                               device=_device_counters(metrics, hstate))
             if recorder is not None:
                 recorder.tick(fstate)
+
+    need_cadence = telemetry is not None or recorder is not None
+    state, extras = _elastic.drive_chained_windows(
+        state, (ws, metrics, gstate, hstate, fstate), chain_fn,
+        n_rounds=spec.windows,
+        chain_len=telemetry_every if need_cadence else spec.windows,
+        per_round=per_round if faulted else None,
+        window_ns=spec.window_ns,
+        on_chain=on_chain if need_cadence else None)
+    ws, metrics, gstate, hstate, fstate = extras
 
     jax.block_until_ready(state)
     done_win = wdevice.completion_windows(ws)
@@ -306,11 +331,20 @@ def run_scenario(spec: ScenarioSpec, *,
         # harvester's next drain (finalize); only tick again when the
         # loop's cadence did NOT already harvest this exact instant —
         # a duplicate-timestamp heartbeat reads as a broken stream
-        _annotate_phases(telemetry, spec, prog, ws, annotated)
+        _annotate_phases(telemetry, spec, prog, ws, annotated[0])
         if spec.windows % telemetry_every != 0:
             telemetry.tick(spec.windows * spec.window_ns,
-                           device=_device_counters())
+                           device=_device_counters(metrics, hstate))
     return record
+
+
+def _device_counters(metrics, hstate):
+    """The harvester's device dict: metrics + histogram leaves. Takes
+    the live pytrees explicitly (the old closure-over-locals form
+    silently captured stale loop variables)."""
+    if hstate is None:
+        return metrics
+    return {**metrics._asdict(), **hstate._asdict()}
 
 
 def _phase_completion(spec: ScenarioSpec, prog: TrafficProgram,

@@ -184,6 +184,18 @@ def test_plane_kernel_flag_validates():
         load_config_str(
             BASIC.replace("general:",
                           "experimental:\n  plane_kernel: cuda\ngeneral:"))
+    # pallas_fused: accepted, and additionally needs a power-of-two
+    # ingress ring (the in-kernel compaction bitonic)
+    cfg = load_config_str(
+        BASIC.replace("general:",
+                      "experimental:\n  plane_kernel: pallas_fused\n"
+                      "general:"))
+    assert cfg.experimental.plane_kernel == "pallas_fused"
+    with pytest.raises(ConfigError, match="power-of-two ingress"):
+        load_config_str(
+            BASIC.replace("general:",
+                          "experimental:\n  plane_kernel: pallas_fused\n"
+                          "  tpu_ingress_cap: 6\ngeneral:"))
 
 
 def test_workload_block_yaml11_spellings():

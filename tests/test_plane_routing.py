@@ -297,6 +297,59 @@ def test_bench_sections_subset_and_compare_runs_bench_mode(tmp_path,
     assert "2.50x" in out  # 20.0 -> 8.0 section ratio
 
 
+def test_bench_backend_fingerprint_refuses_cross_container(
+        tmp_path, capsys, monkeypatch):
+    """The PR-7 false-regression rule: a prior BENCH_r*.json measured
+    on a different backend (or predating the stamp) makes the
+    prior_round guard SKIP with `skipped_mismatched_backend`, and
+    compare_runs --bench prints a meaningless-comparison banner
+    instead of a speedup verdict."""
+    import bench
+    from tools import compare_runs
+
+    fp = {"platform": "cpu", "device_kind": "cpu"}
+    prior = tmp_path / "BENCH_r91.json"
+
+    def write_prior(backend):
+        rec = {"value": 5_000_000.0, "hosts": bench.N_HOSTS}
+        if backend is not None:
+            rec["backend"] = backend
+        prior.write_text(json.dumps(rec))
+
+    monkeypatch.setattr("glob.glob", lambda pat: [str(prior)])
+
+    # prior predates the stamp (no backend field): not comparable
+    write_prior(None)
+    guard = bench._regression_guard(1_000_000.0, fp)
+    assert guard["skipped_mismatched_backend"] is True
+    assert guard["regressed"] is False
+    assert "SKIPPED" in capsys.readouterr().err
+
+    # prior from another container: not comparable either
+    write_prior({"platform": "axon", "device_kind": "axon-v5"})
+    guard = bench._regression_guard(1_000_000.0, fp)
+    assert guard["skipped_mismatched_backend"] is True
+    assert guard["prior_backend"]["platform"] == "axon"
+    assert "SKIPPED" in capsys.readouterr().err
+
+    # matched fingerprint: the 20% gate applies as before
+    write_prior(fp)
+    guard = bench._regression_guard(1_000_000.0, fp)
+    assert guard == {"vs_round": 91, "ratio": 0.2, "regressed": True}
+
+    # compare_runs --bench: mismatched fingerprints warn loudly and
+    # withhold the speedup verdict
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps({"value": 1.0, "hosts": 64, "backend": fp}))
+    b.write_text(json.dumps({
+        "value": 9.0, "hosts": 64,
+        "backend": {"platform": "axon", "device_kind": "axon-v5"}}))
+    assert compare_runs.main(["--bench", str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "MISMATCHED BACKENDS" in out and "MEANINGLESS" in out
+
+
 def test_routing_rank_seq_tiebreak_vs_row_position():
     """The regression the bucketed path must not reintroduce: two
     same-src packets to the same dst with the same (clamped) deliver

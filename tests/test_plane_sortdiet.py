@@ -111,6 +111,65 @@ def test_pallas_rejects_rr_and_bad_kernel():
                     kernel="mosaic")
 
 
+@pytest.mark.parametrize("router_aqm,no_loss",
+                         [(False, False), (True, True)])
+def test_pallas_fused_kernel_matches_xla(router_aqm, no_loss):
+    """The single rank→place→egress pipeline (tpu/pallas_pipeline.py,
+    interpret mode on CPU) is bitwise the XLA path for FIFO worlds —
+    two corners covering both compile switches (the full 2×2 runs on
+    the two-dispatch kernel above; the fused pipeline shares every
+    stage downstream of the fused span)."""
+    state, params = busy_world(rr_mix=False)
+    kw = dict(rr_enabled=False, router_aqm=router_aqm, no_loss=no_loss)
+    fused = run_windows(state, params, kernel="pallas_fused", **kw)
+    ref = run_windows(state, params, kernel="xla", **kw)
+    assert_runs_equal(fused, ref, kw)
+
+
+def test_pallas_fused_overflow_parity():
+    """A deliberately tiny ingress ring: the fused placement's
+    take/overflow arithmetic (route_place kernel B) must be bitwise the
+    XLA counting placement exactly where buckets overflow their free
+    slots — merged columns, valid mask, AND the per-host overflow
+    counter the capacity policy reads."""
+    rng = np.random.default_rng(11)
+    lat = rng.integers(1 * MS, 5 * MS, size=(N, N)).astype(np.int32)
+    params = make_params(lat, np.zeros((N, N), np.float32),
+                         np.full((N,), 10_000_000, np.int64))
+    state = make_state(N, egress_cap=8, ingress_cap=4, params=params,
+                       initial_tokens=np.asarray(params.tb_cap))
+    b = 40
+    # a hot destination so at least one bucket overflows its 4 slots
+    state = ingest(
+        state,
+        jnp.asarray(rng.integers(0, N, b), jnp.int32),
+        jnp.asarray(rng.integers(0, 3, b), jnp.int32),
+        jnp.asarray(rng.integers(100, 1500, b), jnp.int32),
+        jnp.asarray(rng.integers(0, 6, b), jnp.int32),
+        jnp.arange(b, dtype=jnp.int32),
+        jnp.asarray(rng.integers(0, 3, b) == 0),
+    )
+    kw = dict(rr_enabled=False)
+    fused = run_windows(state, params, windows=3, kernel="pallas_fused",
+                        **kw)
+    ref = run_windows(state, params, windows=3, kernel="xla", **kw)
+    assert_runs_equal(fused, ref, kw)
+    drops = int(np.asarray(ref[-1][0].n_overflow_dropped).sum())
+    assert drops > 0, "ingress never overflowed — dead test"
+
+
+def test_pallas_fused_rejects_non_power_of_two_ingress():
+    rng = np.random.default_rng(0)
+    lat = np.full((4, 4), 5 * MS, np.int32)
+    params = make_params(lat, np.zeros((4, 4), np.float32),
+                         np.full((4,), 1_000_000_000, np.int64))
+    state = make_state(4, egress_cap=8, ingress_cap=6, params=params)
+    with pytest.raises(ValueError, match="power-of-two"):
+        window_step(state, params, jax.random.key(0), jnp.int32(0),
+                    jnp.int32(MS), rr_enabled=False,
+                    kernel="pallas_fused")
+
+
 def test_pallas_rejects_non_power_of_two_cap():
     rng = np.random.default_rng(0)
     lat = np.full((4, 4), 5 * MS, np.int32)

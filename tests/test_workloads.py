@@ -489,9 +489,12 @@ def test_runner_telemetry_annotations(tmp_path):
                  hosts=8, windows=20)
     sink = tmp_path / "hb.jsonl"
     h = TelemetryHarvester(interval_ns=spec.window_ns, sink=str(sink))
-    runner.run_scenario(spec, telemetry=h, telemetry_every=4)
+    # a cadence that does NOT divide the window count: the loop ticks
+    # at 6/12/18 and the runner's trailing tick covers the remainder
+    runner.run_scenario(spec, telemetry=h, telemetry_every=6)
     h.finalize()
     lines = [json.loads(ln) for ln in open(sink)]
+    assert lines[-1]["time_ns"] == spec.windows * spec.window_ns
     annos = [a for ln in lines for a in ln.get("annotations", ())]
     phases = [a for a in annos if a["kind"] == "workload_phase"]
     assert phases, lines

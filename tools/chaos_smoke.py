@@ -146,6 +146,14 @@ def main(argv=None) -> int:
     ap.add_argument("--trace-ring", type=int, default=2048,
                     help="flight-recorder trace-ring capacity "
                          "(default 2048)")
+    ap.add_argument("--chain-len", type=int, default=8,
+                    help="windows per device-resident chain (the "
+                         "shared driver's host-sync cadence; harvest/"
+                         "checkpoint/tamper/kill instants cut chains "
+                         "regardless). MUST match across runs whose "
+                         "digests are compared under --capacity "
+                         "elastic: the chain is the growth-decision "
+                         "unit (default 8)")
     args = ap.parse_args(argv)
     if args.sample_every is not None and not args.telemetry:
         ap.error("--sample-every requires --telemetry DIR (the hop "
@@ -161,7 +169,7 @@ def main(argv=None) -> int:
     from shadow_tpu.telemetry import make_metrics
     from shadow_tpu.tpu import elastic, ingest_rows, profiling
     from shadow_tpu.tpu.elastic import CapacityError
-    from shadow_tpu.tpu.plane import window_step
+    from shadow_tpu.tpu.plane import unpack_planes, window_step
     from shadow_tpu.workloads.phold import respawn_batch
 
     EXIT_GUARD = 5  # shadow_tpu.cli.EXIT_GUARD (docs/robustness.md)
@@ -183,32 +191,25 @@ def main(argv=None) -> int:
             egress_cap=args.egress_cap, ingress_cap=args.ingress_cap,
             plane="chaos_smoke")
 
-    def build_step(kernel: str):
-        @jax.jit
-        def step(state, metrics, faults, guards, hist, fr, spawn_seq,
-                 shift, round_idx):
-            # ring shapes come from the state itself (trace-time), so
-            # elastic growth retraces this step per ring size — bounded
-            # at log2 by the power-of-two growth, asserted in CI via
-            # the jit cache size (the PR-1 recompile discipline)
+    def build_chain(kernel: str):
+        def round_fn(carry, xs):
+            (state, metrics, guards, hist, fr, spawn_seq, eg_acc,
+             in_acc) = carry
+            round_idx, faults = xs
             ci = state.in_src.shape[1]
             state0 = state
+            shift = jnp.where(round_idx == 0, jnp.int32(0), window)
             out = window_step(state, world["params"], world["rng_root"],
                               shift, window, rr_enabled=False,
                               kernel=kernel, faults=faults,
                               metrics=metrics, guards=guards,
                               hist=hist, flightrec=fr)
-            state, delivered, _next = out[:3]
-            rest = list(out[3:])
-            metrics = rest.pop(0)
-            if guards is not None:
-                guards = rest.pop(0)
-            if hist is not None:
-                hist = rest.pop(0)
-            if fr is not None:
-                fr = rest.pop(0)
+            (state, delivered, _next), metrics, guards, hist, fr = \
+                unpack_planes(out, metrics=metrics, guards=guards,
+                              hist=hist, flightrec=fr)
             # ingress-ring overflow: the routing stage's ring-full drops
-            in_ovf = state.n_overflow_dropped - state0.n_overflow_dropped
+            in_acc = in_acc + (state.n_overflow_dropped
+                               - state0.n_overflow_dropped)
             state1 = state
             mask, dst, nbytes, seq, ctrl = respawn_batch(
                 delivered, spawn_seq, round_idx, N, ci)
@@ -217,23 +218,39 @@ def main(argv=None) -> int:
             out = ingest_rows(
                 state, dst, nbytes, seq, seq, ctrl, valid=mask,
                 metrics=metrics, guards=guards, hist=hist, flightrec=fr)
-            state = out[0]
-            rest = list(out[1:])
-            metrics = rest.pop(0)
-            if guards is not None:
-                guards = rest.pop(0)
-            if hist is not None:
-                hist = rest.pop(0)
-            if fr is not None:
-                fr = rest.pop(0)
+            (state,), metrics, guards, hist, fr = unpack_planes(
+                out, metrics=metrics, guards=guards, hist=hist,
+                flightrec=fr, n_lead=1)
             # egress-ring overflow: the respawn append's ring-full drops
-            eg_ovf = state.n_overflow_dropped - state1.n_overflow_dropped
-            return (state, metrics, guards, hist, fr,
-                    spawn_seq + mask.sum(axis=1, dtype=jnp.int32),
-                    eg_ovf, in_ovf)
-        return step
+            eg_acc = eg_acc + (state.n_overflow_dropped
+                               - state1.n_overflow_dropped)
+            return ((state, metrics, guards, hist, fr,
+                     spawn_seq + mask.sum(axis=1, dtype=jnp.int32),
+                     eg_acc, in_acc), None)
 
-    driver = KernelFallback(args.kernel, build_step)
+        @jax.jit
+        def chain(state, metrics, guards, hist, fr, spawn_seq, rids,
+                  faults_stack):
+            # K windows device-resident per dispatch: the fault masks
+            # ride as PER-ROUND scan inputs (so a schedule transition
+            # mid-chain is bitwise-identical to the per-window loop it
+            # replaced), every presence plane rides the carry, and the
+            # per-ring overflow the capacity policy reads accumulates
+            # alongside. Ring shapes come from the state itself
+            # (trace-time), so elastic growth retraces this chain per
+            # ring size — bounded at log2 by the power-of-two growth,
+            # asserted in CI via the jit cache size (the PR-1 recompile
+            # discipline).
+            zeros = jnp.zeros((N,), jnp.int32)
+            carry, _ = jax.lax.scan(
+                round_fn,
+                (state, metrics, guards, hist, fr, spawn_seq, zeros,
+                 zeros),
+                (rids, faults_stack))
+            return carry
+        return chain
+
+    driver = KernelFallback(args.kernel, build_chain)
 
     start_w = 0
     state = world["state"]
@@ -311,59 +328,53 @@ def main(argv=None) -> int:
               f"{args.resume}", file=sys.stderr)
 
     checkpoints = []
-    for wdx in range(start_w, R):
-        now_ns = (wdx + 1) * window_ns
-        if schedule is not None:
-            schedule.advance(now_ns)
-            faults = schedule.device_arrays()
-        else:
-            faults = neutral_faults(N, 64)
-        shift = jnp.int32(0 if wdx == 0 else window_ns)
-        if policy is None:
-            state, metrics, guards, hist, fr, spawn_seq, _eg, _in = \
-                driver(state, metrics, faults, guards, hist, fr,
-                       spawn_seq, shift, jnp.int32(wdx))
-        else:
-            # capacity policy: the attempt is a pure function of the
-            # (possibly grown) pre-window state plus the snapshots this
-            # closure holds — an overflowing attempt is discarded and
-            # re-executed after growth (elastic), or aborts (strict);
-            # hist/flight-recorder snapshots restore with the rest, so
-            # a re-executed window never double-counts an observation
-            def attempt(st, _m=metrics, _f=faults, _g=guards,
-                        _h=hist, _fr=fr, _sp=spawn_seq, _sh=shift,
-                        _w=wdx):
-                st2, m2, g2, h2, fr2, sp2, eg, inn = driver(
-                    st, _m, _f, _g, _h, _fr, _sp, _sh, jnp.int32(_w))
-                return (st2, m2, g2, h2, fr2, sp2), eg, inn
+    # the shared chained-window driver (the ONE loop bench.py and the
+    # scenario corpus runner also use): K windows device-resident per
+    # dispatch with the fault-mask stack riding as per-round scan
+    # inputs; the host regains control only at chain ends — the
+    # harvest/checkpoint cadences and the tamper/kill instants, which
+    # register as explicit boundaries below
+    last_faults = [neutral_faults(N, 64)]
+    neutral_stacks: dict[int, object] = {}
 
-            try:
-                out, _ = elastic.run_elastic_window(
-                    state, attempt, policy, time_ns=now_ns)
-            except CapacityError as e:
-                print(f"chaos_smoke: capacity abort: {e}",
-                      file=sys.stderr)
-                print(json.dumps({
-                    "capacity_error": str(e),
-                    "mode": policy.mode,
-                    "window": wdx,
-                    "egress_cap": policy.egress_cap,
-                    "ingress_cap": policy.ingress_cap,
-                }))
-                return EXIT_CAPACITY
-            state, metrics, guards, hist, fr, spawn_seq = out
-        if args.tamper_at is not None and wdx + 1 == args.tamper_at:
+    def per_round(r0, r1):
+        if schedule is None:
+            # schedule-less runs feed the SAME neutral masks to every
+            # window: build one stack per span length, not per chain
+            k = r1 - r0
+            if k not in neutral_stacks:
+                neutral_stacks[k] = jax.tree.map(
+                    lambda x: jnp.stack([x] * k), last_faults[0])
+            return neutral_stacks[k]
+        stack = []
+        for r in range(r0, r1):
+            schedule.advance((r + 1) * window_ns)
+            stack.append(schedule.device_arrays())
+        last_faults[0] = stack[-1]
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *stack)
+
+    def chain_fn(state, extras, rids, faults_stack):
+        metrics, guards, hist, fr, spawn_seq = extras
+        (state, metrics, guards, hist, fr, spawn_seq, eg, inn) = driver(
+            state, metrics, guards, hist, fr, spawn_seq, rids,
+            faults_stack)
+        return state, (metrics, guards, hist, fr, spawn_seq), eg, inn
+
+    def on_chain(r1, state, extras):
+        metrics, guards, hist, fr, spawn_seq = extras
+        replaced = False
+        if args.tamper_at is not None and r1 == args.tamper_at:
             # deliberate corruption: a phantom valid slot at the back
             # of one ingress ring (carrying the idle sentinel) — the
             # exact single-slot damage batched execution would hide
             print(f"chaos_smoke: tampering with the device state at "
-                  f"window {wdx + 1}", file=sys.stderr)
+                  f"window {r1}", file=sys.stderr)
             state = state._replace(
                 in_valid=state.in_valid.at[
                     1, state.in_src.shape[1] - 1].set(True))
-        if harvester is not None \
-                and (wdx + 1) % args.harvest_every == 0:
-            harvester.tick((wdx + 1) * window_ns,
+            replaced = True
+        if harvester is not None and r1 % args.harvest_every == 0:
+            harvester.tick(r1 * window_ns,
                            device={**metrics._asdict(),
                                    **hist._asdict()})
             if recorder is not None:
@@ -379,12 +390,13 @@ def main(argv=None) -> int:
                     if cur < cap_max:
                         fr = frmod.grow_ring(fr, min(cur * 2, cap_max))
                         recorder.note_grown()
+                        replaced = True
                         print(f"chaos_smoke: trace ring grown to "
                               f"{fr.ev_kind.shape[0]}", file=sys.stderr)
         if args.checkpoint_dir and args.checkpoint_every \
-                and (wdx + 1) % args.checkpoint_every == 0 and wdx + 1 < R:
+                and r1 % args.checkpoint_every == 0 and r1 < R:
             path = os.path.join(args.checkpoint_dir,
-                                f"ckpt-{wdx + 1:012d}")
+                                f"ckpt-{r1:012d}")
             extra = {"spawn_seq": spawn_seq}
             if use_guards:
                 # the guard accumulator rides the checkpoint so a
@@ -399,7 +411,7 @@ def main(argv=None) -> int:
             if fr is not None:
                 extra.update({f"flightrec.{f}": getattr(fr, f)
                               for f in fr._fields})
-            meta = {"window_index": wdx + 1, "hosts": N,
+            meta = {"window_index": r1, "hosts": N,
                     "state_digest": state_digest(state, spawn_seq)}
             if hist is not None:
                 from shadow_tpu.telemetry import flightrec as frmod
@@ -412,16 +424,55 @@ def main(argv=None) -> int:
             if policy is not None:
                 meta["capacity"] = policy.to_meta()
             save_plane_checkpoint(
-                path, state=state, clock_ns=now_ns,
+                path, state=state, clock_ns=r1 * window_ns,
                 rng_key_data=jax.random.key_data(world["rng_root"]),
-                faults=faults, metrics=metrics,
+                faults=last_faults[0], metrics=metrics,
                 extra_arrays=extra, meta=meta)
             checkpoints.append(path)
-        if args.kill_at is not None and wdx + 1 >= args.kill_at:
-            print(f"chaos_smoke: simulating a crash at window {wdx + 1}",
+        if args.kill_at is not None and r1 >= args.kill_at:
+            print(f"chaos_smoke: simulating a crash at window {r1}",
                   file=sys.stderr)
             sys.stderr.flush()
             os._exit(137)  # abrupt: no atexit, like a SIGKILL'd run
+        if replaced:
+            return state, (metrics, guards, hist, fr, spawn_seq)
+
+    boundaries = set()
+    if harvester is not None:
+        boundaries.update(range(args.harvest_every, R,
+                                args.harvest_every))
+    if args.checkpoint_dir and args.checkpoint_every:
+        boundaries.update(range(args.checkpoint_every, R,
+                                args.checkpoint_every))
+    if args.tamper_at is not None:
+        boundaries.add(args.tamper_at)
+    if args.kill_at is not None:
+        boundaries.add(args.kill_at)
+    try:
+        state, extras = elastic.drive_chained_windows(
+            state, (metrics, guards, hist, fr, spawn_seq), chain_fn,
+            n_rounds=R, chain_len=args.chain_len, start_round=start_w,
+            boundaries=boundaries, per_round=per_round, policy=policy,
+            window_ns=window_ns,
+            host_names=[f"h{i}" for i in range(N)],
+            on_chain=on_chain)
+    except CapacityError as e:
+        print(f"chaos_smoke: capacity abort: {e}", file=sys.stderr)
+        # the driver stamps the failing chain [r0, r1) on the error:
+        # under chained execution overflow is observed per chain, so
+        # the span is the precise blame unit (the offending window is
+        # somewhere inside it)
+        span = getattr(e, "chain_span", None)
+        print(json.dumps({
+            "capacity_error": str(e),
+            "mode": policy.mode,
+            "window": span[0] if span else None,
+            "chain_span": list(span) if span else None,
+            "egress_cap": policy.egress_cap,
+            "ingress_cap": policy.ingress_cap,
+        }))
+        return EXIT_CAPACITY
+    metrics, guards, hist, fr, spawn_seq = extras
 
     jax.block_until_ready(state)
     telemetry_out = None

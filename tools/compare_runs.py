@@ -108,14 +108,37 @@ def _delta_table(label: str, s0: dict, s1: dict, width: int = 24):
 
 def bench_delta(before_path: str, after_path: str) -> int:
     """Print the headline + per-section deltas between two bench.py JSON
-    records (informational — always exits 0)."""
+    records (informational — always exits 0). Records measured on
+    mismatched backends (the `backend` platform/device-kind
+    fingerprint bench.py stamps) get a LOUD warning and no speedup
+    verdict: a CPU-container number against an accelerator-container
+    number is how the PR-7 false regression happened
+    (docs/performance.md)."""
     before, after = _load_bench(before_path), _load_bench(after_path)
+    b0, b1 = before.get("backend"), after.get("backend")
+    if b0 != b1:
+        print("=" * 70)
+        print(f"WARNING: backend fingerprints differ — before={b0} "
+              f"after={b1}.")
+        print("Cross-container throughput ratios are MEANINGLESS; the "
+              "delta below is\nprinted for completeness only. "
+              "Re-measure both records on one container.")
+        print("=" * 70)
     v0, v1 = float(before.get("value", 0)), float(after.get("value", 0))
     speedup = (v1 / v0) if v0 else float("nan")
-    print(f"events/s: {v0:,.0f} -> {v1:,.0f}  ({speedup:.2f}x)"
+    verdict = ("  (MISMATCHED BACKENDS — not a speedup)"
+               if b0 != b1 else "")
+    print(f"events/s: {v0:,.0f} -> {v1:,.0f}  ({speedup:.2f}x){verdict}"
           f"  [hosts {before.get('hosts')} -> {after.get('hosts')}]")
-    s0 = before.get("sections") or {}
-    s1 = after.get("sections") or {}
+    s0 = dict(before.get("sections") or {})
+    s1 = dict(after.get("sections") or {})
+    # windows_per_sync is a dimensionless driver ratio riding in
+    # `sections` for the trajectory record — print it as one, never as
+    # a millisecond row in the table below
+    w0 = s0.pop("windows_per_sync", None)
+    w1 = s1.pop("windows_per_sync", None)
+    if w0 is not None or w1 is not None:
+        print(f"windows/sync: {w0} -> {w1}")
     if not (s0 or s1):
         print("(no `sections` field in either record — re-run bench.py "
               "without BENCH_SECTIONS=0 to record the breakdown)")

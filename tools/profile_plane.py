@@ -44,8 +44,13 @@ def main(argv=None) -> int:
     ap.add_argument("--legacy-sort", action="store_true",
                     help="time the pre-diet variadic sorts "
                          "(packed_sort=False) for before/after comparison")
-    ap.add_argument("--kernel", choices=("xla", "pallas"), default="xla",
-                    help="window_step egress kernel (default xla)")
+    ap.add_argument("--kernel",
+                    choices=("xla", "pallas", "pallas_fused"),
+                    default="xla",
+                    help="window_step plane kernel (default xla; "
+                         "pallas = two-dispatch egress+route fusion, "
+                         "pallas_fused = the single rank→place→egress "
+                         "pipeline)")
     ap.add_argument("--sections", default=None,
                     help="comma-separated subset of sections to time")
     ap.add_argument("-o", "--out", default=None,
