@@ -9,6 +9,10 @@ Two passes (driven by ``tools/shadowlint.py``):
 - ``jaxpr_audit`` — jaxpr rules (SL2xx) over the jitted ``tpu/`` entry
   points: x64 leaks, convert churn, host callbacks, transfers inside
   loop bodies, baked constants.
+- ``dataflow`` + ``proofs`` — the SL5xx dataflow proofs over the same
+  traced graphs: SL501 presence-invisibility taint theorems, the SL502
+  op-budget ledger (``op_budgets.json``), the SL503 donation-safety
+  AST checks (in ``astlint``), and the SL504 shardability report.
 
 Plus ``recompile`` — the jit-cache-miss counter harness swept over the
 bench-ladder shapes.
@@ -18,8 +22,13 @@ are documented in ``docs/determinism.md``.
 """
 
 from .astlint import lint_file, lint_source, rule_applies
+from .dataflow import leaf_paths, op_census, propagate_taint, shard_census
 from .jaxpr_audit import (AuditEntry, audit_all, audit_entry, audit_jaxpr,
                           default_entries)
+from .proofs import (InvisibilitySpec, build_shard_report,
+                     check_all_invisibility, check_invisibility,
+                     check_op_budgets, compute_censuses,
+                     invisibility_specs, write_op_budgets)
 from .recompile import (CompileCounter, LadderShape, ladder_shapes,
                         sweep_window_step)
 from .rules import RULES, Finding, RuleInfo, parse_suppressions
@@ -37,6 +46,18 @@ __all__ = [
     "audit_entry",
     "audit_jaxpr",
     "default_entries",
+    "leaf_paths",
+    "op_census",
+    "propagate_taint",
+    "shard_census",
+    "InvisibilitySpec",
+    "build_shard_report",
+    "check_all_invisibility",
+    "check_invisibility",
+    "check_op_budgets",
+    "compute_censuses",
+    "invisibility_specs",
+    "write_op_budgets",
     "CompileCounter",
     "LadderShape",
     "ladder_shapes",

@@ -153,6 +153,11 @@ def rule_applies(rule: str, relpath: str) -> bool:
         return p.startswith("shadow_tpu/tpu/")
     if rule == "SL401":
         return p.startswith("shadow_tpu/")
+    if rule == "SL503":
+        # donation hazards live wherever kernels are wrapped or driven:
+        # the package, the tools/ drivers, and the bench entry point
+        return (p.startswith("shadow_tpu/") or p.startswith("tools/")
+                or p == "bench.py" or p.endswith("/bench.py"))
     if rule == "SL405":
         # the telemetry package IS the harvest boundary — its drain is
         # the sanctioned place to materialize device counters
@@ -432,6 +437,232 @@ def _sl402_findings(tree: ast.AST, imports: _Imports,
                 "route runtime invariants through the guard plane "
                 "(shadow_tpu/guards/, docs/robustness.md) and use an "
                 "explicit raise for trace-time static checks"))
+    return findings
+
+
+# -- SL503: buffer-donation safety ---------------------------------------
+#
+# Two hazards around `tpu.donating_jit` (docs/performance.md donation
+# contract), sharing SL301's callee-resolution machinery:
+#
+# (a) a raw ``jax.jit(..., donate_argnums=...)`` call: it bypasses the
+#     wrapper's CPU-backend no-op, so tests exercise different aliasing
+#     than production, and it forks the donate_argnums convention the
+#     unified drivers share. (The wrapper's own forwarding call inside
+#     a def named ``donating_jit`` is exempt — it IS the one sanctioned
+#     site.)
+# (b) use-after-donation: a bare-Name argument passed at a donated
+#     position of a donating-jit-wrapped callable, then READ again
+#     later in the same statement list before being rebound. On a
+#     donating backend that read sees aliased/deleted buffers — and
+#     only there, which is why it must be caught statically.
+#
+# Detection is flow-insensitive like the rest of the linter: donated
+# callables are names/attributes bound to ``donating_jit(fn, ...)``
+# results (possibly through a single outer wrapper call), defs
+# decorated with ``donating_jit`` or an alias of it (``wrap = jax.jit
+# if cpu else donating_jit``), with donate_argnums read off the
+# wrapping site when statically countable (default ``(0,)``).
+
+
+def _static_argnums(call: ast.Call) -> tuple[int, ...] | None:
+    """donate_argnums of a donating_jit call site; (0,) when omitted,
+    None when present but not statically countable."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, int) for e in v.elts):
+                return tuple(e.value for e in v.elts)
+            return None
+    return (0,)
+
+
+def _donation_registry(tree: ast.AST, imports: _Imports):
+    """(aliases, donated): names that ARE the donating wrapper, and
+    name/attr-leaf -> donate_argnums for callables wrapped by it."""
+    aliases = {"donating_jit"}
+
+    def mentions_wrapper(node: ast.expr) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in aliases:
+                return True
+            if isinstance(sub, ast.Attribute) and sub.attr in aliases:
+                return True
+        return False
+
+    def wrapping_call(node: ast.expr) -> ast.Call | None:
+        """The donating_jit(...) Call inside `node`, looked through one
+        outer wrapper call (self._retrying(donating_jit(fn), ...))."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) \
+                    and _callee_leaf(sub.func, imports) in aliases:
+                return sub
+        return None
+
+    # pass A: plain aliases (`wrap = donating_jit`, conditional picks)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and not isinstance(node.value, ast.Call) \
+                and mentions_wrapper(node.value):
+            aliases.add(node.targets[0].id)
+
+    donated: dict[str, tuple[int, ...] | None] = {}
+
+    def decorator_argnums(dec: ast.expr):
+        """argnums when `dec` makes the def a donated kernel."""
+        if _callee_leaf(dec, imports) in aliases \
+                and not isinstance(dec, ast.Call):
+            return (0,)
+        if isinstance(dec, ast.Call) \
+                and _callee_leaf(dec.func, imports) in aliases:
+            return _static_argnums(dec)
+        return "no"
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                argnums = decorator_argnums(dec)
+                if argnums != "no":
+                    donated[node.name] = argnums
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            call = wrapping_call(node.value) \
+                if isinstance(node.value, ast.Call) else None
+            if call is None or not call.args:
+                continue  # partial form (no fn yet) stays an alias
+            target = node.targets[0]
+            if isinstance(target, ast.Name):
+                donated[target.id] = _static_argnums(call)
+            elif isinstance(target, ast.Attribute):
+                donated[target.attr] = _static_argnums(call)
+    return aliases, donated
+
+
+def _walk_scope(node: ast.AST):
+    """ast.walk that does NOT descend into nested function/class/lambda
+    definitions — their names live in their own scope, so their loads
+    and calls must not leak into the enclosing block's donation flow."""
+    yield node
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda, ast.ClassDef)):
+        return  # opaque scope boundary (its body scans as its own block)
+    for child in ast.iter_child_nodes(node):
+        yield from _walk_scope(child)
+
+
+def _stmt_rebinds(stmt: ast.stmt, name: str) -> bool:
+    targets: list[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        targets = [stmt.target]  # `state: T = step(state, ...)`
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        targets = [item.optional_vars for item in stmt.items
+                   if item.optional_vars is not None]
+    elif isinstance(stmt, ast.Delete):
+        targets = list(stmt.targets)
+    for target in targets:
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Name) and sub.id == name:
+                return True
+    return False
+
+
+def _first_load(stmt: ast.stmt, name: str) -> ast.Name | None:
+    for sub in _walk_scope(stmt):
+        if isinstance(sub, ast.Name) and sub.id == name \
+                and isinstance(sub.ctx, ast.Load):
+            return sub
+        # x += 1 reads x even though the target ctx is Store
+        if isinstance(sub, ast.AugAssign) \
+                and isinstance(sub.target, ast.Name) \
+                and sub.target.id == name:
+            return sub.target
+    return None
+
+
+def _sl503_findings(tree: ast.AST, imports: _Imports,
+                    relpath: str) -> list[Finding]:
+    if not rule_applies("SL503", relpath):
+        return []
+    findings: list[Finding] = []
+
+    # (a) raw jax.jit with donation, outside the wrapper's own body
+    wrapper_defs = [n for n in ast.walk(tree)
+                    if isinstance(n, ast.FunctionDef)
+                    and n.name == "donating_jit"]
+    exempt = {id(sub) for n in wrapper_defs for sub in ast.walk(n)}
+    for node in ast.walk(tree):
+        if id(node) in exempt or not isinstance(node, ast.Call):
+            continue
+        if imports.resolve(node.func) == "jax.jit" and any(
+                kw.arg in ("donate_argnums", "donate_argnames")
+                for kw in node.keywords):
+            findings.append(Finding(
+                "SL503", relpath, node.lineno, node.col_offset,
+                "raw jax.jit(donate_argnums=...) bypasses the "
+                "tpu.donating_jit wrapper: tests lose the CPU-backend "
+                "no-op and the drivers fork their donation convention "
+                "— route donation through donating_jit "
+                "(docs/performance.md donation contract)"))
+
+    aliases, donated = _donation_registry(tree, imports)
+
+    def donated_argnums(func: ast.expr) -> tuple[int, ...] | None:
+        leaf = _callee_leaf(func, imports)
+        return donated.get(leaf)
+
+    # (b) use-after-donation, per statement list (flow follows source
+    # order within one block; nested blocks analyze independently)
+    def scan_block(stmts: list[ast.stmt]) -> None:
+        for idx, stmt in enumerate(stmts):
+            for call in _walk_scope(stmt):
+                if not isinstance(call, ast.Call):
+                    continue
+                argnums = donated_argnums(call.func)
+                if argnums is None:
+                    continue
+                for an in argnums:
+                    if an >= len(call.args) \
+                            or not isinstance(call.args[an], ast.Name):
+                        continue
+                    name = call.args[an].id
+                    if _stmt_rebinds(stmt, name):
+                        # `state = step(state, ...)`: the donating
+                        # statement itself rebinds — the sanctioned
+                        # consume-and-rebind pattern
+                        continue
+                    for later in stmts[idx + 1:]:
+                        load = _first_load(later, name)
+                        if load is not None:
+                            findings.append(Finding(
+                                "SL503", relpath, load.lineno,
+                                load.col_offset,
+                                f"`{name}` read after being donated to "
+                                f"`{_callee_leaf(call.func, imports)}` "
+                                f"(arg {an}): the donated buffers may "
+                                "be aliased/deleted on a donating "
+                                "backend — rebind the returned state "
+                                "and never touch the input again "
+                                "(docs/performance.md donation "
+                                "contract)"))
+                            break
+                        if _stmt_rebinds(later, name):
+                            break
+    scan_block(getattr(tree, "body", []))
+    for node in ast.walk(tree):
+        for attr in ("body", "orelse", "finalbody"):
+            block = getattr(node, attr, None)
+            if isinstance(block, list) and block \
+                    and isinstance(block[0], ast.stmt) \
+                    and block is not getattr(tree, "body", None):
+                scan_block(block)
     return findings
 
 
@@ -752,6 +983,8 @@ def lint_source(source: str, relpath: str,
         _sl301_findings(tree, linter.imports, relpath))
     linter.findings.extend(
         _sl402_findings(tree, linter.imports, relpath))
+    linter.findings.extend(
+        _sl503_findings(tree, linter.imports, relpath))
     sup = suppressions if suppressions is not None \
         else parse_suppressions(source)
     for f in linter.findings:

@@ -1,0 +1,540 @@
+"""The jaxpr dataflow engine behind the SL5xx proof rules.
+
+Three analyses over the same traced graphs the SL2xx audit already
+walks, all *static* — they prove properties for every input in seconds,
+where the runtime parity matrices sample a handful of corners in
+minutes:
+
+- ``propagate_taint`` — forward taint propagation over a closed jaxpr:
+  mark a subset of the input leaves tainted and compute which output
+  leaves any tainted value can reach. Descends into every control-flow
+  sub-jaxpr (``pjit``/``closed_call``/``custom_*`` inline 1:1;
+  ``scan``/``while`` run a carry fixpoint; ``cond`` joins over
+  branches) and models IMPLICIT flows: a tainted ``while``/``cond``
+  predicate taints every output of the construct, because a
+  data-dependent trip count or branch choice changes results even when
+  no tainted value is copied directly. Unknown primitives carrying a
+  sub-jaxpr are handled conservatively (any tainted input taints all
+  outputs), so the analysis can over-approximate but never miss a
+  flow — a "clean" verdict is a theorem (SL501).
+
+- ``op_census`` — a static count of the expensive primitives
+  (sorts, gathers, scatter variants, control flow, pallas calls, host
+  transfers) across a jaxpr and every sub-jaxpr. Diffed against the
+  checked-in ``op_budgets.json`` by SL502: a reintroduced variadic
+  sort or per-column scatter changes the census and fails CI without
+  running a bench.
+
+- ``shard_census`` — classifies each expensive primitive as
+  host-axis-local (operates within a row of the ``[N, ...]`` SoA
+  layout) or cross-host (indexes, scatters, sorts, or reduces ACROSS
+  axis 0). The per-section report (SL504) is the work-list for the
+  ROADMAP-2 ``shard_map`` cut: cross-host ops need a collective or a
+  ragged exchange; host-local ops shard for free.
+
+The taint labels are human-readable provenance strings (the input leaf
+that sourced the taint), so an SL501 failure names both ends of the
+illegal flow: ``metrics.pkts_out -> new_state.rng_counter``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+try:
+    from jax.extend import core as _core
+except ImportError:  # older jax spells it jax.core
+    from jax import core as _core
+
+__all__ = [
+    "OpClass",
+    "leaf_paths",
+    "op_census",
+    "propagate_taint",
+    "shard_census",
+]
+
+
+# --------------------------------------------------------------------------
+# taint propagation
+# --------------------------------------------------------------------------
+
+#: primitives whose params carry sub-jaxprs that inline 1:1 with the
+#: equation's invars (call-like: no reordering, no carry)
+_CALL_LIKE = ("pjit", "closed_call", "core_call", "remat", "checkpoint",
+              "custom_jvp_call", "custom_vjp_call",
+              "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr")
+
+_SUB_JAXPR_KEYS = ("jaxpr", "call_jaxpr", "fun_jaxpr")
+
+
+def _first_sub_jaxpr(params):
+    for key in _SUB_JAXPR_KEYS:
+        sub = params.get(key)
+        if sub is not None:
+            return sub
+    return None
+
+
+def _any_sub_jaxpr(params):
+    """True when ANY param transitively holds a jaxpr (the conservative
+    fallback trigger for primitives we don't model)."""
+    def holds(value):
+        if isinstance(value, (_core.Jaxpr, _core.ClosedJaxpr)):
+            return True
+        if isinstance(value, (tuple, list)):
+            return any(holds(v) for v in value)
+        return False
+
+    return any(holds(v) for v in params.values())
+
+
+def _join(*labels):
+    """First non-None label wins (provenance is best-effort; taint
+    presence is exact)."""
+    for lab in labels:
+        if lab is not None:
+            return lab
+    return None
+
+
+def _eval_jaxpr(jaxpr_like, in_labels):
+    """Forward pass over one (possibly closed) jaxpr; returns the
+    output-leaf labels. Constvars are clean by definition (they are
+    trace-time data, not plane inputs)."""
+    raw = getattr(jaxpr_like, "jaxpr", jaxpr_like)
+    env: dict = {}
+
+    def read(v):
+        if isinstance(v, _core.Literal):
+            return None
+        return env.get(v)
+
+    def write(v, lab):
+        if lab is not None:
+            env[v] = lab
+
+    if len(raw.invars) != len(in_labels):
+        raise ValueError(
+            f"jaxpr arity mismatch: {len(raw.invars)} invars, "
+            f"{len(in_labels)} labels")
+    for var, lab in zip(raw.invars, in_labels):
+        write(var, lab)
+
+    for eqn in raw.eqns:
+        ins = [read(v) for v in eqn.invars]
+        outs = _eval_eqn(eqn, ins)
+        for var, lab in zip(eqn.outvars, outs):
+            write(var, lab)
+
+    return [read(v) for v in raw.outvars]
+
+
+def _fixpoint(step, carry):
+    """Iterate a monotone carry-label transformer to its fixpoint.
+    Taint only ever turns on, so len(carry)+1 rounds always suffice."""
+    for _ in range(len(carry) + 1):
+        new = step(carry)
+        merged = [_join(a, b) for a, b in zip(carry, new)]
+        if merged == carry:
+            return carry
+        carry = merged
+    return carry
+
+
+def _passthrough_outputs(branches, n_ops) -> set[int]:
+    """Output positions every branch returns verbatim from the SAME
+    operand (outvar IS an invar, identical operand index across
+    branches): those outputs are branch-invariant, so a tainted
+    predicate cannot affect them."""
+    common: dict[int, int] | None = None
+    for branch in branches:
+        raw = getattr(branch, "jaxpr", branch)
+        pos = {id(v): j for j, v in enumerate(raw.invars)}
+        this = {}
+        for i, out in enumerate(raw.outvars):
+            j = pos.get(id(out))
+            if j is not None:
+                this[i] = j
+        if common is None:
+            common = this
+        else:
+            common = {i: j for i, j in common.items()
+                      if this.get(i) == j}
+    return set(common or ())
+
+
+def _is_carry_identity(body_raw, n_body_consts: int, i: int) -> bool:
+    """True when while-body carry slot `i` is returned verbatim
+    (outvars[i] IS invars[n_body_consts + i])."""
+    if i >= len(body_raw.outvars):
+        return False
+    out = body_raw.outvars[i]
+    j = n_body_consts + i
+    return j < len(body_raw.invars) and body_raw.invars[j] is out
+
+
+def _eval_eqn(eqn, ins):
+    name = eqn.primitive.name
+    params = eqn.params
+    n_out = len(eqn.outvars)
+
+    def conservative():
+        lab = _join(*ins)
+        return [lab] * n_out
+
+    if name in _CALL_LIKE:
+        sub = _first_sub_jaxpr(params)
+        raw = getattr(sub, "jaxpr", sub) if sub is not None else None
+        if raw is not None and len(raw.invars) == len(ins):
+            outs = _eval_jaxpr(sub, ins)
+            if len(outs) >= n_out:
+                return outs[:n_out]
+        return conservative()
+
+    if name == "cond":
+        pred, ops = ins[0], ins[1:]
+        outs = [None] * n_out
+        for branch in params["branches"]:
+            raw = getattr(branch, "jaxpr", branch)
+            if len(raw.invars) != len(ops):
+                return conservative()
+            b_outs = _eval_jaxpr(branch, ops)
+            outs = [_join(a, b) for a, b in zip(outs, b_outs)]
+        if pred is not None:
+            # implicit flow: a tainted predicate selects WHICH branch
+            # ran, so every output is tainted even if no branch copies
+            # a tainted value — EXCEPT outputs every branch passes
+            # through verbatim from the same operand (branch-invariant:
+            # the identity-gated merges like ingest_rows' gate_idle
+            # return untouched leaves as the same Var in both branches,
+            # so the choice of branch cannot change them)
+            invariant = _passthrough_outputs(params["branches"], len(ops))
+            outs = [o if i in invariant else _join(o, pred)
+                    for i, o in enumerate(outs)]
+        return outs
+
+    if name == "while":
+        cn = params["cond_nconsts"]
+        bn = params["body_nconsts"]
+        cond_c, body_c = ins[:cn], ins[cn:cn + bn]
+        carry0 = ins[cn + bn:]
+
+        body_raw = getattr(params["body_jaxpr"], "jaxpr",
+                           params["body_jaxpr"])
+
+        def step(carry):
+            pred = _eval_jaxpr(
+                params["cond_jaxpr"], list(cond_c) + list(carry))[0]
+            new = _eval_jaxpr(
+                params["body_jaxpr"], list(body_c) + list(carry))
+            if pred is not None:
+                # implicit flow: a tainted trip count taints the whole
+                # carry (different iteration counts -> different
+                # values) — except slots the body passes through
+                # verbatim (carry[i] -> carry[i]): their value is the
+                # same after 0 or N iterations
+                new = [c if _is_carry_identity(body_raw, bn, i)
+                       else _join(c, pred)
+                       for i, c in enumerate(new)]
+            return new
+
+        return _fixpoint(step, list(carry0))
+
+    if name == "scan":
+        nc = params["num_consts"]
+        ncar = params["num_carry"]
+        consts, carry0, xs = ins[:nc], ins[nc:nc + ncar], ins[nc + ncar:]
+        body = params["jaxpr"]
+
+        def step(carry):
+            outs = _eval_jaxpr(body, list(consts) + list(carry) + list(xs))
+            return outs[:ncar]
+
+        carry = _fixpoint(step, list(carry0))
+        ys = _eval_jaxpr(
+            body, list(consts) + list(carry) + list(xs))[ncar:]
+        return list(carry) + list(ys)
+
+    if name == "pallas_call" or _any_sub_jaxpr(params):
+        # opaque kernel / unmodeled higher-order primitive: assume every
+        # output can see every input (sound over-approximation)
+        return conservative()
+
+    # plain primitive: pure dataflow, every output sees every input
+    return conservative()
+
+
+def propagate_taint(closed_jaxpr, in_labels):
+    """Labels (provenance strings or None) for each output leaf of
+    `closed_jaxpr`, given one label per input leaf (None = clean)."""
+    return _eval_jaxpr(closed_jaxpr, list(in_labels))
+
+
+# --------------------------------------------------------------------------
+# leaf naming
+# --------------------------------------------------------------------------
+
+
+def leaf_paths(pytree, prefix: str = "") -> list[str]:
+    """Dotted/keyed path of every leaf, in jax flatten order —
+    ``state.eg_dst``, ``delivered['mask']``, ``[2]`` — via the
+    registered keypath machinery, so NamedTuples render as attribute
+    accesses and custom nodes fall back to their registered keys."""
+    from jax import tree_util
+
+    flat = tree_util.tree_flatten_with_path(pytree)[0]
+    out = []
+    for path, _leaf in flat:
+        text = tree_util.keystr(path)
+        out.append(prefix + text if prefix else text.lstrip("."))
+    return out
+
+
+# --------------------------------------------------------------------------
+# op census (SL502) and shard classification (SL504)
+# --------------------------------------------------------------------------
+
+#: the primitives the op budget tracks: everything whose count moving is
+#: a perf event worth an explicit diff (the sort diet, the scatter diet,
+#: control-flow structure, kernel dispatches, host hops)
+_CENSUS_EXACT = frozenset({
+    "sort", "gather", "while", "cond", "scan", "pallas_call",
+    "device_put", "infeed", "outfeed",
+})
+_CENSUS_PREFIXES = ("scatter",)  # scatter, scatter-add, scatter-mul, ...
+_CENSUS_MARKERS = ("callback",)  # pure_callback, io_callback, ...
+
+
+def _census_key(name: str) -> str | None:
+    if name in _CENSUS_EXACT:
+        return name
+    for pre in _CENSUS_PREFIXES:
+        if name.startswith(pre):
+            return name
+    for marker in _CENSUS_MARKERS:
+        if marker in name:
+            return name
+    return None
+
+
+def _iter_all_eqns(jaxpr_like):
+    raw = getattr(jaxpr_like, "jaxpr", jaxpr_like)
+    for eqn in raw.eqns:
+        yield eqn
+        for value in eqn.params.values():
+            values = value if isinstance(value, (tuple, list)) else (value,)
+            for sub in values:
+                if isinstance(sub, (_core.Jaxpr, _core.ClosedJaxpr)):
+                    yield from _iter_all_eqns(sub)
+
+
+def op_census(closed_jaxpr) -> dict[str, int]:
+    """Static count of budget-tracked primitives across the jaxpr and
+    every nested sub-jaxpr. A scan body's ops count once (the census is
+    structural, not a dynamic op count)."""
+    census: dict[str, int] = {}
+    for eqn in _iter_all_eqns(closed_jaxpr):
+        key = _census_key(eqn.primitive.name)
+        if key is not None:
+            census[key] = census.get(key, 0) + 1
+    return census
+
+
+@dataclass
+class OpClass:
+    """One expensive primitive occurrence, classified for shardability."""
+
+    primitive: str
+    cls: str  # "host_local" | "cross_host" | "opaque"
+    reason: str
+    shapes: list[str] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {"primitive": self.primitive, "class": self.cls,
+                "reason": self.reason, "shapes": self.shapes}
+
+
+#: reductions whose `axes` param decides host-locality
+_REDUCE_PRIMS = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_or", "reduce_and",
+    "reduce_prod", "reduce_xor", "argmax", "argmin",
+})
+
+#: cumulative ops with an `axis` param
+_CUM_PRIMS = frozenset({
+    "cumsum", "cumprod", "cummax", "cummin", "cumlogsumexp",
+})
+
+
+def _classify_eqn(eqn, operand_static: bool) -> OpClass | None:
+    """Host-axis locality of one equation, None when it is not a
+    shard-relevant primitive. Axis 0 is the host axis by the SoA layout
+    contract (`tpu/plane.py` NetPlaneState). `operand_static` marks the
+    first operand as a trace-time constant (a lookup table): indexing a
+    constant table is a replicated read under shard_map, not a
+    cross-shard hop, regardless of how the index was computed."""
+    name = eqn.primitive.name
+    params = eqn.params
+    shapes = [str(tuple(getattr(v.aval, "shape", ())))
+              for v in eqn.invars if hasattr(v, "aval")]
+
+    def cls(kind, reason):
+        return OpClass(name, kind, reason, shapes)
+
+    if name == "sort":
+        dim = params.get("dimension", -1)
+        ndim = max((len(getattr(v.aval, "shape", ()))
+                    for v in eqn.invars if hasattr(v, "aval")),
+                   default=0)
+        if ndim <= 1 or dim == 0:
+            return cls("cross_host",
+                       "sort over a flattened/host axis: becomes a "
+                       "cross-shard merge under shard_map")
+        return cls("host_local", f"row sort along dim {dim}")
+    if name == "gather":
+        dn = params.get("dimension_numbers")
+        if dn is not None and 0 in tuple(dn.start_index_map):
+            if operand_static:
+                return cls("host_local",
+                           "replicated-table lookup (constant operand)")
+            return cls("cross_host",
+                       "gather indexed by a computed host id: "
+                       "cross-shard read")
+        return cls("host_local", "row-local gather (host axis batched)")
+    if name.startswith("scatter"):
+        dn = params.get("dimension_numbers")
+        if dn is not None and 0 in tuple(dn.scatter_dims_to_operand_dims):
+            return cls("cross_host",
+                       "scatter keyed by a computed host id: the "
+                       "routing exchange — a ragged all-to-all under "
+                       "shard_map")
+        return cls("host_local", "row-local scatter (host axis batched)")
+    if name in _REDUCE_PRIMS:
+        axes = tuple(params.get("axes", ()))
+        ndim = max((len(getattr(v.aval, "shape", ()))
+                    for v in eqn.invars if hasattr(v, "aval")),
+                   default=0)
+        if ndim >= 1 and 0 in axes:
+            return cls("cross_host",
+                       "reduction over the host axis: a collective "
+                       "(psum/pmin) under shard_map")
+        return None  # row-local reductions are free; don't report
+    if name in _CUM_PRIMS:
+        if params.get("axis") == 0:
+            return cls("cross_host", "cumulative op along the host axis")
+        return None
+    if name == "pallas_call":
+        return cls("opaque",
+                   "hand-written kernel: shardability decided by its "
+                   "grid/tile mapping, not inferable from the jaxpr")
+    return None
+
+
+def _classify_walk(jaxpr_like, in_static, sink):
+    """Recursive classification pass threading per-var STATICNESS (is
+    this value a pure function of trace-time constants?) so table
+    lookups are told apart from cross-host reads. Returns the output
+    vars' staticness."""
+    raw = getattr(jaxpr_like, "jaxpr", jaxpr_like)
+    env: dict = {}
+
+    def stat(v):
+        return isinstance(v, _core.Literal) or env.get(v, False)
+
+    for var, s in zip(raw.invars, in_static):
+        env[var] = s
+    for var in raw.constvars:
+        env[var] = True
+
+    for eqn in raw.eqns:
+        ins = [stat(v) for v in eqn.invars]
+        name = eqn.primitive.name
+        params = eqn.params
+        outs = [all(ins)] * len(eqn.outvars)
+
+        def opaque(reason):
+            # never silently drop a body the walk cannot model: the
+            # report's one job is "nothing cross-host hides here", so
+            # an unwalked sub-jaxpr must surface as an opaque entry
+            # (op_census still counts its eqns; only the host-locality
+            # classification is unavailable)
+            sink(OpClass(name, "opaque", reason,
+                         [str(tuple(getattr(v.aval, "shape", ())))
+                          for v in eqn.invars if hasattr(v, "aval")]))
+
+        if name in _CALL_LIKE:
+            sub = _first_sub_jaxpr(params)
+            sub_raw = getattr(sub, "jaxpr", sub) if sub is not None \
+                else None
+            if sub_raw is not None and len(sub_raw.invars) == len(ins):
+                sub_outs = _classify_walk(sub, ins, sink)
+                outs = (sub_outs + [False] * len(eqn.outvars)
+                        )[:len(eqn.outvars)]
+            else:
+                opaque("call-like primitive whose body the classifier "
+                       "could not map 1:1")
+                outs = [False] * len(eqn.outvars)
+        elif name == "cond":
+            for branch in params["branches"]:
+                b_raw = getattr(branch, "jaxpr", branch)
+                if len(b_raw.invars) == len(ins) - 1:
+                    _classify_walk(branch, ins[1:], sink)
+                else:
+                    opaque("cond branch whose operands the classifier "
+                           "could not map")
+            outs = [False] * len(eqn.outvars)
+        elif name == "while":
+            cn, bn = params["cond_nconsts"], params["body_nconsts"]
+            carry_f = [False] * (len(ins) - cn - bn)
+            _classify_walk(params["cond_jaxpr"], ins[:cn] + carry_f,
+                           sink)
+            _classify_walk(params["body_jaxpr"],
+                           ins[cn:cn + bn] + carry_f, sink)
+            outs = [False] * len(eqn.outvars)
+        elif name == "scan":
+            nc = params["num_consts"]
+            rest = [False] * (len(ins) - nc)
+            _classify_walk(params["jaxpr"], ins[:nc] + rest, sink)
+            outs = [False] * len(eqn.outvars)
+        else:
+            oc = _classify_eqn(eqn, bool(ins) and ins[0])
+            if oc is not None:
+                sink(oc)
+            elif name != "pallas_call" and _any_sub_jaxpr(params):
+                # an unmodeled higher-order primitive (custom_vmap,
+                # custom_root, ...) carrying a body the walk did not
+                # enter
+                opaque("unmodeled higher-order primitive: its body "
+                       "was not classified")
+                outs = [False] * len(eqn.outvars)
+        for var, s in zip(eqn.outvars, outs):
+            env[var] = s
+
+    return [stat(v) for v in raw.outvars]
+
+
+def shard_census(closed_jaxpr) -> dict:
+    """SL504 classification of one entry's jaxpr: every shard-relevant
+    primitive bucketed host_local / cross_host / opaque, with cross-host
+    occurrences enumerated individually (they are the shard_map
+    work-list) and host-local ones aggregated by primitive."""
+    host_local: dict[str, int] = {}
+    cross: list[OpClass] = []
+    opaque: list[OpClass] = []
+
+    def sink(oc: OpClass):
+        if oc.cls == "host_local":
+            host_local[oc.primitive] = host_local.get(oc.primitive, 0) + 1
+        elif oc.cls == "opaque":
+            opaque.append(oc)
+        else:
+            cross.append(oc)
+
+    raw = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    _classify_walk(closed_jaxpr, [False] * len(raw.invars), sink)
+    return {
+        "host_local": dict(sorted(host_local.items())),
+        "cross_host": [oc.to_json() for oc in cross],
+        "opaque": [oc.to_json() for oc in opaque],
+    }

@@ -18,12 +18,16 @@ independent) and walks the closed jaxpr plus every nested sub-jaxpr
 
 The registry (`default_entries`) covers all five kernel modules:
 ``plane`` (window_step in both qdisc/AQM compile modes + chain_windows
-in every presence-switch variant — plain/metrics/guards/workload),
-``tcp`` (event + pull + replay), ``transport`` (the DeviceTransport
-kernel set), ``floweng`` (the fused window driver), and ``codel``
-(trace replay + integrated router). Entries carry per-rule allow-lists
+in every presence-switch variant — plain/metrics/guards/workload — +
+ingest_rows with all four observability planes threaded), ``tcp``
+(event + pull + replay), ``transport`` (the DeviceTransport kernel
+set), ``floweng`` (the fused window driver), and ``codel`` (trace
+replay + integrated router). Entries carry per-rule allow-lists
 with justifications — the pass-2 analogue of the source-comment
 suppression syntax, since jaxpr findings have no line to anchor to.
+The same registry feeds the SL502 op-budget census and the SL504
+shardability report (``analysis/proofs.py``); the SL501 invisibility
+proofs trace their own variant surface (`proofs.invisibility_specs`).
 """
 
 from __future__ import annotations
@@ -417,6 +421,44 @@ def _chain_entry(variant: str = "plain"):
     return build
 
 
+def _ingest_rows_entry():
+    """`ingest_rows` with all four observability planes threaded — the
+    third kernel of the SL501 proof surface gets the SL2xx audit too
+    (widest compile mode: every plane section in the graph)."""
+    def build():
+        import jax.numpy as jnp
+
+        from ..guards.plane import make_guards
+        from ..telemetry import make_flightrec, make_histograms, \
+            make_metrics
+        from ..tpu import plane
+
+        n, k = 4, 4
+        params = plane.make_params(
+            latency_ns=np.full((n, n), 1_000_000, np.int64),
+            loss=np.zeros((n, n)),
+            up_bw_bps=np.full(n, 1_000_000_000, np.int64),
+        )
+        state = plane.make_state(n, egress_cap=8, ingress_cap=8,
+                                 params=params)
+        z = lambda: jnp.zeros((n, k), jnp.int32)
+
+        def fn(state, metrics, guards, hist, flightrec, dst, nbytes,
+               prio, seq, valid):
+            return plane.ingest_rows(
+                state, dst, nbytes, prio, seq,
+                jnp.zeros((n, k), bool), valid,
+                metrics=metrics, guards=guards, hist=hist,
+                flightrec=flightrec)
+
+        return fn, (state, make_metrics(n), make_guards(n),
+                    make_histograms(n),
+                    make_flightrec(0, sample_every=4, ring=64),
+                    z(), z(), z(), z(), jnp.zeros((n, k), bool))
+
+    return build
+
+
 def _tcp_entry(kind: str):
     def build():
         import jax.numpy as jnp
@@ -569,6 +611,8 @@ def default_entries() -> list[AuditEntry]:
                    _chain_entry("guards")),
         AuditEntry("chain_windows[workload]", "shadow_tpu.tpu.plane",
                    _chain_entry("workload")),
+        AuditEntry("ingest_rows[planes]", "shadow_tpu.tpu.plane",
+                   _ingest_rows_entry()),
         AuditEntry("tcp_event_step", "shadow_tpu.tpu.tcp",
                    _tcp_entry("event")),
         AuditEntry("tcp_pull_step", "shadow_tpu.tpu.tcp",

@@ -1,0 +1,555 @@
+"""shadowlint pass 3: dataflow proofs over the audited kernel surface.
+
+Three rule families on top of ``analysis/dataflow.py``:
+
+- **SL501 presence-invisibility** — for every observability-plane
+  variant of ``window_step`` / ``chain_windows`` / ``ingest_rows``
+  (metrics, guards, hist, flightrec, and their compositions) the plane
+  input leaves are tainted and the analysis must prove no tainted value
+  reaches any sim-state output leaf: NetPlaneState columns, the RNG
+  counter, the clock offsets, the delivered stream. This turns the
+  runtime "bitwise-invisible" parity matrices (tests/test_telemetry.py,
+  test_guards.py, test_flightrec.py — a handful of rr×aqm×no_loss
+  corners, minutes per run) into a theorem over ALL inputs, checked in
+  seconds on every build. The workload plane gets the relaxed
+  *append-only* theorem instead: its generator may only ever touch the
+  egress columns and the overflow counter — the wire, RNG, clocks,
+  ingress rings, and delivered stream are provably out of its reach.
+
+- **SL502 op-budget ledger** — the static census of expensive
+  primitives per audited entry, diffed against the checked-in
+  ``op_budgets.json``. A reintroduced variadic sort or per-column
+  scatter changes the census and fails CI without a bench; budget
+  updates must be explicit in the diff
+  (``tools/shadowlint.py --write-op-budgets``).
+
+- **SL504 shardability report** — informational: every entry's
+  shard-relevant primitives classified host-axis-local vs cross-host,
+  the scoping work-list for the ROADMAP-2 ``shard_map`` cut.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from .dataflow import leaf_paths, op_census, propagate_taint, shard_census
+from .jaxpr_audit import _ingest_rows_entry
+from .rules import Finding
+
+__all__ = [
+    "InvisibilitySpec",
+    "budget_path",
+    "build_shard_report",
+    "check_invisibility",
+    "check_op_budgets",
+    "compute_censuses",
+    "format_budget_delta",
+    "invisibility_specs",
+    "write_op_budgets",
+]
+
+#: NetPlaneState fields the workload generator is ALLOWED to write —
+#: the egress ring it appends to and the ring-full drop counter that
+#: append maintains. Everything else in sim state must stay
+#: taint-free under the append-only theorem.
+WORKLOAD_APPEND_OK = frozenset({
+    "eg_dst", "eg_bytes", "eg_prio", "eg_seq", "eg_ctrl", "eg_tsend",
+    "eg_clamp", "eg_sock", "eg_valid", "n_overflow_dropped",
+})
+
+
+@dataclass
+class InvisibilitySpec:
+    """One SL501 proof obligation.
+
+    ``build`` returns (fn, args) exactly like an ``AuditEntry``;
+    ``tainted_args`` maps positional arg index -> taint-label prefix
+    (the plane name); ``protected`` decides, per output leaf, whether
+    taint reaching it is a violation — given the top-level output tuple
+    index and the leaf's key path.
+    """
+
+    name: str
+    module: str
+    build: Callable[[], tuple[Callable, tuple]]
+    tainted_args: dict[int, str] = field(default_factory=dict)
+    protected: Callable[[int, str], bool] = lambda idx, path: True
+
+
+def _protect_lead(n: int):
+    """Protect the first `n` top-level outputs (the sim-state lead of a
+    window_step/chain_windows return); the plane outputs after them are
+    legitimately tainted."""
+    return lambda idx, path: idx < n
+
+
+def _out_index(path: str) -> int:
+    """Top-level tuple index of an output leaf path like
+    ``[0].eg_dst`` (single-output entries render with no prefix -> 0)."""
+    if path.startswith("["):
+        return int(path[1:path.index("]")])
+    return 0
+
+
+# --------------------------------------------------------------------------
+# spec builders (small representative shapes, like jaxpr_audit)
+# --------------------------------------------------------------------------
+
+
+def _mini_world(n: int = 4, m: int = 3):
+    import jax
+
+    from ..tpu import plane
+
+    params = plane.make_params(
+        latency_ns=np.full((m, m), 1_000_000, np.int64),
+        loss=np.full((m, m), 0.01, np.float64),
+        up_bw_bps=np.full(n, 1_000_000_000, np.int64),
+        qdisc_rr=np.array([True, False] * (n // 2)),
+        down_bw_bps=np.full(n, 1_000_000_000, np.int64),
+        host_node=np.arange(n, dtype=np.int32) % m,
+    )
+    state = plane.make_state(n, egress_cap=8, ingress_cap=8,
+                             params=params)
+    return plane, params, state, jax.random.key(0), n
+
+
+def _window_planes_entry(*, metrics: bool = False, guards: bool = False,
+                         hist: bool = False, flightrec: bool = False):
+    """window_step with any subset of the four observability planes
+    threaded (rr+aqm+loss: the widest compile mode)."""
+    def build():
+        import jax.numpy as jnp
+
+        from ..guards.plane import make_guards
+        from ..telemetry import make_flightrec, make_histograms, \
+            make_metrics
+
+        plane, params, state, root, n = _mini_world()
+        planes = {}
+        if metrics:
+            planes["metrics"] = make_metrics(n)
+        if guards:
+            planes["guards"] = make_guards(n)
+        if hist:
+            planes["hist"] = make_histograms(n)
+        if flightrec:
+            planes["flightrec"] = make_flightrec(
+                0, sample_every=4, ring=64)
+        keys = list(planes)
+
+        def fn(state, *rest):
+            plane_args = dict(zip(keys, rest[:len(keys)]))
+            shift, window = rest[len(keys):]
+            return plane.window_step(
+                state, params, root, shift, window,
+                rr_enabled=True, router_aqm=True, no_loss=False,
+                **plane_args)
+
+        args = (state, *[planes[k] for k in keys],
+                jnp.int32(0), jnp.int32(10_000_000))
+        return fn, args
+
+    return build
+
+
+def _chain_planes_entry(*, metrics: bool = False, guards: bool = False,
+                        workload: bool = False):
+    """chain_windows with metrics/guards (and optionally the workload
+    generator) riding the while-loop carry."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..guards.plane import make_guards
+        from ..telemetry import make_metrics
+        from ..tpu import plane
+
+        root = jax.random.key(0)
+        n = 4
+        params = plane.make_params(
+            latency_ns=np.full((n, n), 1_000_000, np.int64),
+            loss=np.zeros((n, n)),
+            up_bw_bps=np.full(n, 1_000_000_000, np.int64),
+        )
+        state = plane.make_state(n, egress_cap=8, ingress_cap=8,
+                                 params=params)
+        kw_builders = {}
+        if metrics:
+            kw_builders["metrics"] = make_metrics(n)
+        if guards:
+            kw_builders["guards"] = make_guards(n)
+        keys = list(kw_builders)
+
+        wl = ws0 = None
+        if workload:
+            from ..workloads import compile_program, parse_scenario
+            from ..workloads import device as wdevice
+
+            prog = compile_program(parse_scenario({
+                "name": "proof-onoff", "hosts": n, "egress_cap": 8,
+                "ingress_cap": 8, "windows": 4,
+                "patterns": [{"kind": "onoff", "burst": 1, "rounds": 2,
+                              "gap_ns": 200_000,
+                              "off_mean_ns": 2_000_000}],
+            }))
+            wl = wdevice.to_device(prog)
+            ws0 = wdevice.make_workload_state(prog)
+
+        def fn(state, *rest):
+            plane_args = dict(zip(keys, rest[:len(keys)]))
+            cursor = len(keys)
+            if workload:
+                ws = rest[cursor]
+                cursor += 1
+                plane_args["workload"] = (wl, ws)
+            shift0, horizon = rest[cursor:]
+            return plane.chain_windows(
+                state, params, root, shift0, jnp.int32(1_000_000),
+                jnp.int32(1_000_000), horizon, horizon,
+                rr_enabled=False, no_loss=True, **plane_args)
+
+        args = [state, *[kw_builders[k] for k in keys]]
+        if workload:
+            args.append(ws0)
+        args += [jnp.int32(0), jnp.int32(50_000_000)]
+        return fn, tuple(args)
+
+    return build
+
+
+# the ingest_rows builder is shared with the SL2xx audit registry
+# (jaxpr_audit._ingest_rows_entry): same trace, two rule families —
+# keeping one copy means the audit and the proof can never silently
+# diverge on what "the ingest_rows kernel" is
+
+
+def _workload_step_entry():
+    """workload_step in isolation: the append-only theorem's subject."""
+    def build():
+        import jax.numpy as jnp
+
+        from ..workloads import compile_program, parse_scenario
+        from ..workloads import device as wdevice
+
+        plane, _params, state, _root, n = _mini_world()
+        prog = compile_program(parse_scenario({
+            "name": "proof-onoff", "hosts": n, "egress_cap": 8,
+            "ingress_cap": 8, "windows": 4,
+            "patterns": [{"kind": "onoff", "burst": 1, "rounds": 2,
+                          "gap_ns": 200_000,
+                          "off_mean_ns": 2_000_000}],
+        }))
+        wl = wdevice.to_device(prog)
+        ws0 = wdevice.make_workload_state(prog)
+        ci = state.in_src.shape[1]
+        delivered = {
+            "mask": jnp.zeros((n, ci), bool),
+            "src": jnp.zeros((n, ci), jnp.int32),
+            "seq": jnp.zeros((n, ci), jnp.int32),
+            "sock": jnp.zeros((n, ci), jnp.int32),
+            "bytes": jnp.zeros((n, ci), jnp.int32),
+            "deliver_rel": jnp.zeros((n, ci), jnp.int32),
+        }
+
+        def fn(ws, wl_arrays, state, delivered):
+            return wdevice.workload_step(
+                wl_arrays, ws, state, delivered, jnp.int32(0),
+                jnp.int32(1_000_000))
+
+        return fn, (ws0, wl, state, delivered)
+
+    return build
+
+
+def _workload_protected(idx: int, path: str) -> bool:
+    """Append-only: protect every state leaf EXCEPT the egress columns
+    and the overflow counter. workload_step returns (state', ws')."""
+    if idx != 0:
+        return False
+    leaf = path.split(".")[-1].split("[")[0]
+    return leaf not in WORKLOAD_APPEND_OK
+
+
+def invisibility_specs() -> list[InvisibilitySpec]:
+    """The SL501 proof surface: every observability-plane variant of the
+    three ingest/step/chain kernels, the composed all-planes traces, and
+    the workload generator's append-only obligation."""
+    mod = "shadow_tpu.tpu.plane"
+    wmod = "shadow_tpu.workloads.device"
+    return [
+        InvisibilitySpec(
+            "window_step[metrics]", mod,
+            _window_planes_entry(metrics=True),
+            tainted_args={1: "metrics"}, protected=_protect_lead(3)),
+        InvisibilitySpec(
+            "window_step[guards]", mod,
+            _window_planes_entry(guards=True),
+            tainted_args={1: "guards"}, protected=_protect_lead(3)),
+        InvisibilitySpec(
+            "window_step[hist]", mod,
+            _window_planes_entry(hist=True),
+            tainted_args={1: "hist"}, protected=_protect_lead(3)),
+        InvisibilitySpec(
+            "window_step[flightrec]", mod,
+            _window_planes_entry(flightrec=True),
+            tainted_args={1: "flightrec"}, protected=_protect_lead(3)),
+        InvisibilitySpec(
+            "window_step[metrics+guards+hist+flightrec]", mod,
+            _window_planes_entry(metrics=True, guards=True, hist=True,
+                                 flightrec=True),
+            tainted_args={1: "metrics", 2: "guards", 3: "hist",
+                          4: "flightrec"},
+            protected=_protect_lead(3)),
+        InvisibilitySpec(
+            "chain_windows[metrics]", mod,
+            _chain_planes_entry(metrics=True),
+            tainted_args={1: "metrics"}, protected=_protect_lead(5)),
+        InvisibilitySpec(
+            "chain_windows[guards]", mod,
+            _chain_planes_entry(guards=True),
+            tainted_args={1: "guards"}, protected=_protect_lead(5)),
+        # the composed workload chain: metrics+guards thread through the
+        # generator's own ingest_rows too — prove they stay invisible to
+        # sim state AND to the workload state riding the same carry
+        # (outputs: state, delivered, off, next_rel, n, m, g, ws)
+        InvisibilitySpec(
+            "chain_windows[workload+metrics+guards]", mod,
+            _chain_planes_entry(metrics=True, guards=True,
+                                workload=True),
+            tainted_args={1: "metrics", 2: "guards"},
+            protected=lambda idx, path: idx < 5 or idx == 7),
+        InvisibilitySpec(
+            "ingest_rows[metrics+guards+hist+flightrec]", mod,
+            _ingest_rows_entry(),
+            tainted_args={1: "metrics", 2: "guards", 3: "hist",
+                          4: "flightrec"},
+            protected=_protect_lead(1)),
+        InvisibilitySpec(
+            "workload_step[append-only]", wmod,
+            _workload_step_entry(),
+            tainted_args={0: "ws", 1: "wl"},
+            protected=_workload_protected),
+    ]
+
+
+# --------------------------------------------------------------------------
+# SL501 checker
+# --------------------------------------------------------------------------
+
+
+def _flat_len(tree) -> int:
+    from jax import tree_util
+
+    return len(tree_util.tree_flatten(tree)[0])
+
+
+def check_invisibility(spec: InvisibilitySpec) -> list[Finding]:
+    """Run one proof obligation; empty list = the theorem holds."""
+    import jax
+
+    fn, args = spec.build()
+    closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(*args)
+
+    in_labels: list[str | None] = []
+    for i, arg in enumerate(args):
+        prefix = spec.tainted_args.get(i)
+        if prefix is None:
+            in_labels.extend([None] * _flat_len(arg))
+        else:
+            in_labels.extend(leaf_paths(arg, prefix=prefix))
+    if len(in_labels) != len(closed.in_avals):
+        raise AssertionError(
+            f"{spec.name}: flattened {len(in_labels)} arg leaves but "
+            f"the jaxpr has {len(closed.in_avals)} inputs")
+
+    out_labels = propagate_taint(closed, in_labels)
+    out_paths = leaf_paths(out_shape)
+    if len(out_paths) != len(out_labels):
+        raise AssertionError(
+            f"{spec.name}: {len(out_paths)} output paths vs "
+            f"{len(out_labels)} output labels")
+
+    where = f"{spec.module}:{spec.name}"
+    findings = []
+    for path, label in zip(out_paths, out_labels):
+        if label is None:
+            continue
+        if spec.protected(_out_index(path), path):
+            findings.append(Finding(
+                "SL501", where, 0, 0,
+                f"plane input `{label}` reaches sim-state output leaf "
+                f"`{path}`: the presence switch is not "
+                "bitwise-invisible (docs/determinism.md 'Proofs vs "
+                "parity tests')"))
+    return findings
+
+
+def check_all_invisibility(
+        specs: list[InvisibilitySpec] | None = None) -> list[Finding]:
+    out: list[Finding] = []
+    for spec in specs if specs is not None else invisibility_specs():
+        out.extend(check_invisibility(spec))
+    return out
+
+
+# --------------------------------------------------------------------------
+# SL502 op-budget ledger
+# --------------------------------------------------------------------------
+
+_BUDGET_FILE = "op_budgets.json"
+
+#: per-process jaxpr memo keyed by entry name: SL502's census and
+#: SL504's shard report walk the SAME traced graphs, so one full
+#: shadowlint run (census + report) traces the registry once, not
+#: twice. Registry entry names are stable per process; callers passing
+#: ad-hoc entries must give distinct names (AuditEntry convention).
+_TRACE_CACHE: dict[str, object] = {}
+
+
+def _traced(entry):
+    import jax
+
+    key = f"{entry.module}:{entry.name}"
+    closed = _TRACE_CACHE.get(key)
+    if closed is None:
+        fn, args = entry.build()
+        closed = jax.make_jaxpr(fn)(*args)
+        _TRACE_CACHE[key] = closed
+    return closed
+
+
+def budget_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        _BUDGET_FILE)
+
+
+def compute_censuses(entries=None) -> dict[str, dict[str, int]]:
+    """Static op census per audited entry (jaxpr_audit registry)."""
+    from .jaxpr_audit import default_entries
+
+    out: dict[str, dict[str, int]] = {}
+    for entry in entries if entries is not None else default_entries():
+        out[f"{entry.module}:{entry.name}"] = dict(
+            sorted(op_census(_traced(entry)).items()))
+    return out
+
+
+def write_op_budgets(path: str | None = None, entries=None) -> dict:
+    budgets = compute_censuses(entries)
+    doc = {
+        "_comment": (
+            "SL502 op-budget ledger: the static count of expensive "
+            "primitives per audited kernel entry. CI diffs the live "
+            "census against this file — a changed count fails the "
+            "build unless this file changes WITH it (regenerate via "
+            "`python tools/shadowlint.py --write-op-budgets` and "
+            "justify the delta in the PR)."),
+        "version": 1,
+        "budgets": budgets,
+    }
+    with open(path or budget_path(), "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return doc
+
+
+def check_op_budgets(path: str | None = None, entries=None
+                     ) -> tuple[list[Finding], list[dict]]:
+    """Diff the live census against the checked-in ledger. Returns
+    (findings, deltas) — deltas carry the per-primitive table the CLI
+    renders on failure."""
+    path = path or budget_path()
+    if not os.path.exists(path):
+        return [Finding(
+            "SL502", path, 0, 0,
+            "op-budget ledger missing: run `python tools/shadowlint.py "
+            "--write-op-budgets` and check the file in")], []
+    with open(path, encoding="utf-8") as fh:
+        budgets = json.load(fh)["budgets"]
+    actual = compute_censuses(entries)
+
+    findings: list[Finding] = []
+    deltas: list[dict] = []
+    for name in sorted(set(budgets) | set(actual)):
+        want = budgets.get(name)
+        have = actual.get(name)
+        if want is None:
+            findings.append(Finding(
+                "SL502", name, 0, 0,
+                "audited entry has no op budget: regenerate the ledger "
+                "(--write-op-budgets) so the new kernel's op count is "
+                "pinned"))
+            continue
+        if have is None:
+            findings.append(Finding(
+                "SL502", name, 0, 0,
+                "budgeted entry no longer audited: regenerate the "
+                "ledger (--write-op-budgets) to drop it explicitly"))
+            continue
+        if want == have:
+            continue
+        diff = {}
+        for prim in sorted(set(want) | set(have)):
+            w, h = want.get(prim, 0), have.get(prim, 0)
+            if w != h:
+                diff[prim] = {"budget": w, "actual": h}
+        deltas.append({"entry": name, "delta": diff})
+        worst = max(diff, key=lambda p: abs(diff[p]["actual"]
+                                            - diff[p]["budget"]))
+        findings.append(Finding(
+            "SL502", name, 0, 0,
+            f"op census deviates from the checked-in budget ({worst}: "
+            f"{diff[worst]['budget']} budgeted, "
+            f"{diff[worst]['actual']} actual"
+            + (f"; +{len(diff) - 1} more primitive(s)"
+               if len(diff) > 1 else "")
+            + ") — an expensive-primitive regression, or a ledger "
+            "update missing from this diff (--write-op-budgets)"))
+    return findings, deltas
+
+
+def format_budget_delta(deltas: list[dict]) -> str:
+    """Readable budget-vs-actual table for the CI log."""
+    lines = ["entry                                    primitive"
+             "            budget  actual   delta"]
+    for d in deltas:
+        for prim, v in sorted(d["delta"].items()):
+            lines.append(
+                f"{d['entry'][:40]:<40} {prim:<20} "
+                f"{v['budget']:>6}  {v['actual']:>6}  "
+                f"{v['actual'] - v['budget']:>+6}")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# SL504 shardability report
+# --------------------------------------------------------------------------
+
+
+def build_shard_report(entries=None) -> dict:
+    """Informational per-entry shardability classification — the
+    scoping work-list for the ROADMAP-2 shard_map refactor."""
+    from .jaxpr_audit import default_entries
+
+    sections = {}
+    for entry in entries if entries is not None else default_entries():
+        sections[f"{entry.module}:{entry.name}"] = shard_census(
+            _traced(entry))
+    n_cross = sum(len(s["cross_host"]) for s in sections.values())
+    return {
+        "version": 1,
+        "rule": "SL504",
+        "summary": {
+            "sections": len(sections),
+            "cross_host_ops": n_cross,
+            "opaque_kernels": sum(len(s["opaque"])
+                                  for s in sections.values()),
+        },
+        "sections": sections,
+    }

@@ -40,6 +40,14 @@ class RuleInfo:
     name: str
     summary: str
     invariant: str  # the determinism invariant the rule protects
+    #: where the rule runs — a human-readable scope line for
+    #: ``shadowlint --list-rules`` (path prefixes for AST rules, the
+    #: audited registry for jaxpr/proof rules)
+    scope: str = ""
+    #: the seeded violation under tests/lint_fixtures/ proving the rule
+    #: can fail (every rule MUST have one; pinned by
+    #: tests/test_shadowlint.py::test_every_rule_has_a_fixture)
+    fixture: str = ""
 
 
 RULES: dict[str, RuleInfo] = {
@@ -51,6 +59,8 @@ RULES: dict[str, RuleInfo] = {
             "datetime.now) in simulation code",
             "simulated time comes only from the event clock; real time "
             "feeding any simulation decision breaks replay",
+            scope="shadow_tpu/ (tools/ benchmarks measure wall time on purpose)",
+            fixture="fixture_wallclock.py",
         ),
         RuleInfo(
             "SL102", "global-randomness",
@@ -59,6 +69,8 @@ RULES: dict[str, RuleInfo] = {
             "all draws come from the seeded Xoshiro256++ streams in "
             "core/rng.py (or counter-based threefry on device), so "
             "results are a pure function of the config seed",
+            scope="everywhere except core/rng.py",
+            fixture="fixture_randomness.py",
         ),
         RuleInfo(
             "SL103", "unordered-iteration",
@@ -66,12 +78,16 @@ RULES: dict[str, RuleInfo] = {
             "scheduling",
             "event order must be scheduling-independent; set iteration "
             "order depends on insertion history and hash seeding",
+            scope="core/, net/, host/, kernel/, process/, tcp/, apps/",
+            fixture="fixture_unordered.py",
         ),
         RuleInfo(
             "SL104", "mutable-default-arg",
             "mutable default argument (list/dict/set) on a function",
             "a shared mutable default carries state across calls and "
             "hosts, making results depend on call history",
+            scope="everywhere",
+            fixture="fixture_mutable_default.py",
         ),
         RuleInfo(
             "SL105", "traced-branch",
@@ -80,6 +96,8 @@ RULES: dict[str, RuleInfo] = {
             "host branches on device values force a blocking sync and "
             "bake one branch into the compiled graph (silent recompiles "
             "or wrong results under jit)",
+            scope="shadow_tpu/tpu/",
+            fixture="fixture_traced_branch.py",
         ),
         RuleInfo(
             "SL301", "sync-in-kernel",
@@ -89,6 +107,8 @@ RULES: dict[str, RuleInfo] = {
             "OUTSIDE jitted code (docs/observability.md): a sync inside "
             "a kernel body blocks the device pipeline on every window "
             "and turns into a host callback under jit",
+            scope="shadow_tpu/tpu/",
+            fixture="fixture_kernel_sync.py",
         ),
         RuleInfo(
             "SL402", "assert-in-kernel",
@@ -101,6 +121,8 @@ RULES: dict[str, RuleInfo] = {
             "through the guard plane (shadow_tpu/guards/, "
             "docs/robustness.md); trace-time shape/static checks use "
             "an explicit raise",
+            scope="shadow_tpu/tpu/",
+            fixture="fixture_kernel_assert.py",
         ),
         RuleInfo(
             "SL401", "swallowed-error",
@@ -110,6 +132,8 @@ RULES: dict[str, RuleInfo] = {
             "as structured, attributable events (docs/robustness.md); "
             "a silently swallowed broad exception turns a real fault "
             "into an unexplained hang or wrong result",
+            scope="shadow_tpu/",
+            fixture="fixture_swallowed.py",
         ),
         RuleInfo(
             "SL403", "variadic-sort",
@@ -121,6 +145,8 @@ RULES: dict[str, RuleInfo] = {
             "anti-pattern was the window step's dominant cost until PR 2 "
             "removed it; the compiled-in packed_sort=False parity "
             "reference paths carry justified suppressions",
+            scope="shadow_tpu/tpu/",
+            fixture="fixture_variadic_sort.py",
         ),
         RuleInfo(
             "SL405", "sync-telemetry-read",
@@ -134,6 +160,8 @@ RULES: dict[str, RuleInfo] = {
             "stalls the dispatch pipeline wherever it runs — "
             "shadow_tpu/telemetry/ (the harvest boundary itself) is "
             "the one sanctioned reader",
+            scope="shadow_tpu/ except shadow_tpu/telemetry/ (the harvest boundary)",
+            fixture="fixture_telemetry_read.py",
         ),
         RuleInfo(
             "SL201", "x64-leak",
@@ -141,30 +169,102 @@ RULES: dict[str, RuleInfo] = {
             "the device plane is int32/float32 by contract "
             "(tpu/plane.py dtype discipline); x64 leaks change numerics "
             "between hosts and recompile per weak-type",
+            scope="jaxpr audit registry (analysis/jaxpr_audit.py)",
+            fixture="fixture_x64_leak.py",
         ),
         RuleInfo(
             "SL202", "convert-churn",
             "redundant convert_element_type chain in a device jaxpr",
             "dtype round-trips signal weak-type churn at jit boundaries "
             "— the classic silent-recompile trigger",
+            scope="jaxpr audit registry (analysis/jaxpr_audit.py)",
+            fixture="fixture_convert_churn.py",
         ),
         RuleInfo(
             "SL203", "host-callback",
             "host callback primitive inside a jitted kernel",
             "callbacks leave the device mid-kernel: nondeterministic "
             "interleaving and a host sync on the hot path",
+            scope="jaxpr audit registry (analysis/jaxpr_audit.py)",
+            fixture="fixture_host_callback.py",
         ),
         RuleInfo(
             "SL204", "transfer-in-loop",
             "host transfer/callback inside a while_loop/scan body",
             "a per-iteration device<->host hop turns an O(1)-dispatch "
             "window chain into O(iterations) syncs",
+            scope="jaxpr audit registry (analysis/jaxpr_audit.py)",
+            fixture="fixture_loop_transfer.py",
         ),
         RuleInfo(
             "SL205", "baked-constant",
             "large constant baked into a jitted graph",
             "big captured constants bloat every compiled executable and "
             "re-upload on each compile; pass them as arguments instead",
+            scope="jaxpr audit registry (analysis/jaxpr_audit.py)",
+            fixture="fixture_baked_constant.py",
+        ),
+        RuleInfo(
+            "SL501", "presence-invisibility",
+            "an observability-plane input leaf (metrics/guards/hist/"
+            "flightrec; workload under the append-only relaxation) "
+            "reaches a sim-state output leaf in the traced jaxpr",
+            "the presence switches are bitwise-invisible BY THEOREM: "
+            "the taint analysis (analysis/dataflow.py) proves, for "
+            "every plane variant of window_step/chain_windows/"
+            "ingest_rows and for all inputs, that no plane value can "
+            "flow into NetPlaneState columns, the RNG counter, the "
+            "clock offsets, or the delivered stream — where the "
+            "runtime parity matrices only sample rr×aqm×no_loss "
+            "corners (docs/determinism.md 'Proofs vs parity tests')",
+            scope="proof registry (analysis/proofs.invisibility_specs)",
+            fixture="fixture_taint_leak.py",
+        ),
+        RuleInfo(
+            "SL502", "op-budget",
+            "the static census of expensive primitives (sorts, "
+            "gathers, scatter variants, control flow, pallas calls, "
+            "host transfers) deviates from the checked-in "
+            "analysis/op_budgets.json ledger",
+            "the sort/scatter diet stays dieted without re-benching "
+            "every PR: a reintroduced variadic sort or per-column "
+            "scatter changes the census and fails CI in seconds; "
+            "legitimate changes regenerate the ledger "
+            "(tools/shadowlint.py --write-op-budgets) so every op-cost "
+            "delta is explicit in the diff (docs/performance.md)",
+            scope="jaxpr audit registry (analysis/jaxpr_audit.py)",
+            fixture="fixture_op_budget.py",
+        ),
+        RuleInfo(
+            "SL503", "donation-safety",
+            "a buffer-donation hazard: a donated array referenced "
+            "after dispatch, or a raw jax.jit(donate_argnums=...) "
+            "bypassing the donating_jit wrapper",
+            "the donation contract (docs/performance.md): a donated "
+            "pytree is CONSUMED by the call — XLA may alias its "
+            "buffers in place, so a later host read sees garbage (or "
+            "deleted-buffer errors) only on donating backends, i.e. "
+            "only in production. All donation goes through "
+            "tpu.donating_jit (whose CPU-backend no-op keeps tests "
+            "meaningful) with consistent donate_argnums across the "
+            "unified drivers; host code rebinds the returned state and "
+            "never touches the donated argument again",
+            scope="shadow_tpu/, tools/, bench.py",
+            fixture="fixture_donation.py",
+        ),
+        RuleInfo(
+            "SL504", "shardability-report",
+            "informational: expensive primitives classified host-axis-"
+            "local vs cross-host per audited section",
+            "the ROADMAP-2 shard_map cut needs a scoped work-list "
+            "before any million-host work starts: cross-host ops "
+            "(gathers/scatters keyed by computed host ids, full-axis "
+            "sorts, host-axis reductions) need a collective or a "
+            "ragged exchange; host-local ops shard for free. The "
+            "report (tools/shadowlint.py --shard-report) never fails "
+            "the build — it is the map, not a gate",
+            scope="jaxpr audit registry (analysis/jaxpr_audit.py)",
+            fixture="fixture_shard_classify.py",
         ),
     ]
 }

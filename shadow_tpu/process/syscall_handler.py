@@ -31,6 +31,7 @@ Multi-file waits (poll/select) are implemented over a transient kernel
 from __future__ import annotations
 
 import ctypes
+import logging
 import struct
 from time import perf_counter_ns as _perf_ns
 from typing import Optional
@@ -50,6 +51,8 @@ from ..kernel.socket.netlink import NetlinkSocket
 from ..kernel.socket.unix import UnixSocket, make_socketpair
 from ..kernel.status import FileState
 from ..kernel.timerfd import TimerFd
+
+_LOG = logging.getLogger("shadow.vfs")
 
 # ---------------------------------------------------------------------------
 # x86_64 syscall numbers (the emulated subset)
@@ -2479,14 +2482,18 @@ class SyscallHandler:
         virt = root + norm
         if len(virt) > VFS_PATH_MAX:
             # isolation would need a longer path than the rewrite event
-            # carries: fall back to the shared real path (logged) rather
-            # than failing a legal syscall
-            import logging as _logging
-
-            _logging.getLogger("shadow.vfs").warning(
-                "path too long for per-host redirect, passing through: "
-                "%r", path)
-            return None
+            # carries. Failing the syscall with ENAMETOOLONG is the
+            # only safe verdict: the old fall-through to the shared
+            # real path silently BROKE per-host isolation for
+            # deep-but-legal guest paths (two hosts writing the same
+            # long absolute path would collide), and the guest sees
+            # exactly what a real kernel with a shorter PATH_MAX would
+            # return
+            _LOG.warning(
+                "guest path too long for per-host redirect "
+                "(%d > %d incl. vfs root), failing with ENAMETOOLONG: "
+                "%r", len(virt), VFS_PATH_MAX, path)
+            raise errors.SyscallError(errors.ENAMETOOLONG)
         if write:
             parent = virt.rsplit(b"/", 1)[0]
             try:

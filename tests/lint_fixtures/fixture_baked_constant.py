@@ -1,0 +1,10 @@
+"""SL205 seeded violation: a 360 KB constant captured into the graph
+instead of passed as a kernel argument (re-uploaded per compile)."""
+
+
+def trace():
+    import jax
+    import numpy as np
+
+    big = np.ones((300, 300), np.float32)  # 360 KB > 256 KiB limit
+    return jax.make_jaxpr(lambda x: x + big)(np.float32(1.0))

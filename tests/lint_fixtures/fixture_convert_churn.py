@@ -1,0 +1,14 @@
+"""SL202 seeded violation: a dtype round-trip (int32 -> float32 ->
+int32) — the jaxpr signature of weak-type churn, the classic
+silent-recompile trigger."""
+
+
+def trace():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def churn(x):
+        return x.astype(jnp.float32).astype(jnp.int32)
+
+    return jax.make_jaxpr(churn)(np.zeros((4,), np.int32))

@@ -1,0 +1,57 @@
+"""SL503 seeded violations: buffer-donation hazards around the
+tpu.donating_jit wrapper (docs/performance.md donation contract).
+Linted as shadow_tpu/tpu/fixture_donation.py by test_shadowlint.py."""
+
+import jax
+
+from shadow_tpu.tpu import donating_jit
+
+step = donating_jit(lambda st, d: st)
+verify = donating_jit(lambda st, rows: st, donate_argnums=(0, 1))
+
+# a conditional wrapper pick still marks the decorated def as donating
+wrap = jax.jit if object() is None else donating_jit
+
+
+@wrap
+def chain(state, rids):
+    return state
+
+
+def drive_bad(state, deltas):
+    out = step(state, deltas)
+    total = state.n_sent.sum()  # violation: donated `state` read back
+    return out, total
+
+
+def drive_rebind_ok(state, deltas):
+    state = step(state, deltas)  # consume-and-rebind: the sanctioned shape
+    return state.n_sent.sum()
+
+
+def drive_chain_bad(state, rids):
+    out = chain(state, rids)
+    print(state)  # violation: read after donation to the @wrap chain
+    state = out
+    return state
+
+
+def drive_rows_bad(state, rows):
+    state = verify(state, rows)
+    return rows.sum()  # violation: arg 1 was donated too
+
+
+def drive_suppressed(state, deltas):
+    out = step(state, deltas)
+    # shadowlint: disable=SL503 -- cpu-only diagnostic path (fixture)
+    return out, state.n_sent.sum()
+
+
+def raw_jit_bad(fn):
+    return jax.jit(fn, donate_argnums=(0,))  # violation: bypasses wrapper
+
+
+def donating_jit_lookalike_ok(fn):
+    # a def NAMED donating_jit may forward donate_argnums (it IS the
+    # wrapper pattern); this one is just named differently and clean
+    return jax.jit(fn)

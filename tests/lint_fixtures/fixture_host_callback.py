@@ -1,0 +1,13 @@
+"""SL203 seeded violation: a pure_callback inside a jitted kernel —
+the device blocks on the host mid-window."""
+
+
+def trace():
+    import jax
+    import numpy as np
+
+    def cb(x):
+        return jax.pure_callback(
+            lambda v: v, jax.ShapeDtypeStruct((), np.int32), x)
+
+    return jax.make_jaxpr(cb)(np.int32(1))

@@ -1,0 +1,16 @@
+"""SL204 seeded violation: a debug callback inside a scan body — one
+host round trip per iteration."""
+
+
+def trace():
+    import jax
+    import numpy as np
+
+    def loop(x):
+        def body(c, _):
+            jax.debug.print("tick {}", c)
+            return c + 1, c
+
+        return jax.lax.scan(body, x, None, length=3)
+
+    return jax.make_jaxpr(loop)(np.int32(0))

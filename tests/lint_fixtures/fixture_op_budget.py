@@ -1,0 +1,32 @@
+"""SL502 seeded violation: a kernel whose live op census carries one
+MORE scatter than its checked-in budget — the "someone reintroduced a
+per-column scatter" regression the ledger catches without a bench.
+`entry()` returns an AuditEntry-shaped object and `BUDGET` is the
+ledger the fixture kernel must be diffed against (it budgets 1
+scatter; the kernel performs 2)."""
+
+#: the checked-in budget the fixture kernel EXCEEDS by one scatter
+BUDGET = {"scatter-add": 1, "sort": 1}
+
+
+def build():
+    import jax.numpy as jnp
+    import numpy as np
+
+    def kernel(vals, dst):
+        n = vals.shape[0]
+        order = jnp.sort(vals)
+        acc = jnp.zeros((n,), jnp.int32).at[dst].add(order)
+        # the regression: a second scatter pass that should have been
+        # folded into the first
+        acc = acc.at[dst].add(vals)
+        return acc
+
+    return kernel, (jnp.asarray(np.arange(8), jnp.int32),
+                    jnp.asarray(np.arange(8) % 4, jnp.int32))
+
+
+def entry():
+    from shadow_tpu.analysis.jaxpr_audit import AuditEntry
+
+    return AuditEntry("extra_scatter", "tests.lint_fixtures", build)

@@ -1,0 +1,29 @@
+"""SL504 seeded classification subject: one kernel mixing the three
+shard classes — a row-local sort/gather (host axis batched), a
+cross-host routing-style scatter keyed by computed destination ids, a
+host-axis reduction, and a replicated-table lookup that must NOT count
+as cross-host."""
+
+import numpy as np
+
+#: trace-time constant table (replicates under shard_map)
+TABLE = np.arange(64, dtype=np.int32)
+
+
+def build():
+    import jax.numpy as jnp
+
+    def kernel(vals, idx, dst):
+        n, c = vals.shape
+        local = jnp.take_along_axis(
+            jnp.sort(vals, axis=1), idx, axis=1)  # row-local
+        looked = jnp.asarray(TABLE)[jnp.clip(local, 0, 63)]  # table
+        routed = jnp.zeros((n,), jnp.int32).at[
+            dst.reshape(-1)].add(looked.reshape(-1),
+                                 mode="drop")  # cross-host scatter
+        return routed, looked.sum(axis=0)  # host-axis reduction
+
+    n, c = 4, 8
+    return kernel, (jnp.zeros((n, c), jnp.int32),
+                    jnp.zeros((n, c), jnp.int32),
+                    jnp.zeros((n, c), jnp.int32))

@@ -1,0 +1,44 @@
+"""SL501 seeded violation: a deliberately-broken mini plane kernel
+whose telemetry counter is wired BACK into simulation state — the
+exact class of bug the presence-invisibility theorem exists to catch
+(a plane that is no longer bitwise-invisible). `spec()` returns the
+InvisibilitySpec; the proof must FAIL naming both ends of the flow:
+`metrics.pkts` -> the sim counter output leaf."""
+
+from typing import NamedTuple
+
+
+class MiniState(NamedTuple):
+    counter: object  # jax.Array at trace time
+    clock: object
+
+
+class MiniMetrics(NamedTuple):
+    pkts: object
+
+
+def _build():
+    import jax.numpy as jnp
+
+    def broken_step(state, metrics):
+        # BAD: the metrics counter leaks into the sim-state counter —
+        # presence of the plane now changes simulation results
+        new_state = state._replace(
+            counter=state.counter + metrics.pkts,
+            clock=state.clock + 1)
+        new_metrics = metrics._replace(pkts=metrics.pkts + 1)
+        return new_state, new_metrics
+
+    state = MiniState(jnp.zeros((4,), jnp.int32),
+                      jnp.zeros((4,), jnp.int32))
+    metrics = MiniMetrics(jnp.zeros((4,), jnp.int32))
+    return broken_step, (state, metrics)
+
+
+def spec():
+    from shadow_tpu.analysis.proofs import InvisibilitySpec
+
+    return InvisibilitySpec(
+        "broken_step[metrics-leak]", "tests.lint_fixtures",
+        _build, tainted_args={1: "metrics"},
+        protected=lambda idx, path: idx < 1)

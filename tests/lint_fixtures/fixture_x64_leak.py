@@ -1,0 +1,15 @@
+"""SL201 seeded violation: a float64 value in the traced graph (the
+device plane is int32/float32 by contract). `trace()` returns the
+closed jaxpr the audit walks — the x64 leak needs the enable_x64
+context at trace time, exactly how a stray config flip leaks one into
+production graphs."""
+
+
+def trace():
+    import jax
+    import numpy as np
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        return jax.make_jaxpr(lambda x: x * np.float64(2.0))(
+            np.float64(1.0))

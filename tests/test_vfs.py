@@ -172,3 +172,58 @@ def test_dotdot_paths_cannot_escape_the_host_root():
             assert fh.read().strip() == "esc"
         assert not os.path.exists("/escape.txt")
         assert not os.path.exists(os.path.join(data, "escape.txt"))
+
+
+# -- unit: the ENAMETOOLONG verdict (no managed process needed) -----------
+
+def _bare_handler(vfs_root: bytes):
+    """A SyscallHandler with just enough state for `_vfs_resolve`."""
+    from types import SimpleNamespace
+
+    from shadow_tpu.process.syscall_handler import SyscallHandler
+
+    h = SyscallHandler.__new__(SyscallHandler)
+    h.host = SimpleNamespace(vfs_enabled=True, vfs_root=vfs_root,
+                             vfs_host_dir=None)
+    return h
+
+
+def test_overlong_guest_path_fails_with_enametoolong(tmp_path):
+    """A redirected path longer than VFS_PATH_MAX must FAIL the syscall
+    with ENAMETOOLONG — the old silent fall-through to the shared real
+    path broke per-host isolation for deep-but-legal guest paths (two
+    hosts writing the same long absolute path would collide)."""
+    from shadow_tpu.kernel import errors
+    from shadow_tpu.process.syscall_handler import VFS_PATH_MAX
+
+    # a real tmp root: the boundary probe below takes the write path,
+    # whose copy-up makedirs must never touch the shared filesystem
+    root = os.path.join(tmp_path, "root").encode()
+    h = _bare_handler(root)
+    # a legal guest path (< PATH_MAX) whose REDIRECTED form exceeds the
+    # rewrite-event budget: > 399 bytes guest-side on its own
+    deep = b"/" + b"/".join([b"d" * 40] * 11)  # 450 bytes, all legal
+    assert len(deep) > 399
+    for write in (False, True):
+        with pytest.raises(errors.SyscallError) as exc:
+            h._vfs_resolve(deep, write=write)
+        assert exc.value.errno == errors.ENAMETOOLONG
+    # the boundary: a path whose redirect lands exactly AT the budget
+    # still redirects (write-class — no lexists probe short-circuit)
+    room = VFS_PATH_MAX - len(root) - 1
+    assert room > 0, "tmp_path too deep for the boundary probe"
+    ok = b"/" + b"x" * room
+    red = h._vfs_resolve(ok, write=True)
+    assert red == root + ok
+    assert len(red) == VFS_PATH_MAX
+
+
+def test_vfs_logging_is_module_scoped():
+    """The satellite hoist: the vfs logger is created once at module
+    scope, not re-imported per overlong path."""
+    import logging
+
+    from shadow_tpu.process import syscall_handler
+
+    assert isinstance(syscall_handler._LOG, logging.Logger)
+    assert syscall_handler._LOG.name == "shadow.vfs"

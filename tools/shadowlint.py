@@ -2,16 +2,23 @@
 """shadowlint: the determinism + JAX-kernel static analysis suite.
 
 Pass 1 lints every Python file under the given paths with the AST
-determinism rules (SL1xx); pass 2 abstract-evals the jitted ``tpu/``
-kernel entry points and audits their jaxprs (SL2xx). Exit code is
+rules (SL1xx determinism + SL4xx hazards + SL503 donation safety);
+pass 2 abstract-evals the jitted ``tpu/`` kernel entry points and
+audits their jaxprs (SL2xx); pass 3 runs the dataflow proofs over the
+same traced graphs (SL501 presence-invisibility, SL502 op-budget
+ledger) and can emit the SL504 shardability report. Exit code is
 nonzero when any unsuppressed finding (or malformed suppression
 comment) exists.
 
 Usage::
 
-    python tools/shadowlint.py                  # both passes, text report
+    python tools/shadowlint.py                  # all passes, text report
     python tools/shadowlint.py --json           # machine-readable report
     python tools/shadowlint.py --no-jaxpr       # AST pass only (no jax)
+    python tools/shadowlint.py --only SL501,SL502,SL503   # one family
+    python tools/shadowlint.py --list-rules     # rule inventory
+    python tools/shadowlint.py --write-op-budgets  # regen the ledger
+    python tools/shadowlint.py --shard-report sl504.json  # SL504 artifact
     python tools/shadowlint.py --recompile      # + jit-cache sweep
     python tools/shadowlint.py shadow_tpu/core  # explicit paths
 
@@ -33,7 +40,15 @@ sys.path.insert(0, _REPO)
 from shadow_tpu.analysis import rules as _rules  # noqa: E402
 from shadow_tpu.analysis.astlint import lint_source  # noqa: E402
 
-DEFAULT_PATHS = ("shadow_tpu", "tools")
+DEFAULT_PATHS = ("shadow_tpu", "tools", "bench.py")
+
+#: which pass serves each rule family (drives --only skipping)
+AST_RULES = frozenset({"SL101", "SL102", "SL103", "SL104", "SL105",
+                       "SL301", "SL401", "SL402", "SL403", "SL405",
+                       "SL503"})
+JAXPR_RULES = frozenset({"SL201", "SL202", "SL203", "SL204", "SL205"})
+PROOF_RULES = frozenset({"SL501", "SL502"})
+REPORT_RULES = frozenset({"SL504"})
 
 
 def _iter_py_files(paths):
@@ -65,7 +80,7 @@ def run_ast_pass(paths):
     return findings, malformed
 
 
-def run_jaxpr_pass():
+def _force_cpu():
     # tracing needs a backend for the concrete example arrays; force CPU
     # exactly like tests/conftest.py (the env var is already cached by
     # sitecustomize, so the config update is the only working override)
@@ -73,9 +88,39 @@ def run_jaxpr_pass():
 
     jax.config.update("jax_platforms", "cpu")
 
+
+def run_jaxpr_pass():
+    _force_cpu()
+
     from shadow_tpu.analysis.jaxpr_audit import audit_all
 
     return audit_all()
+
+
+def run_proof_pass(selected):
+    """Pass 3: SL501 invisibility proofs + SL502 budget diff. Returns
+    (findings, budget_deltas)."""
+    _force_cpu()
+
+    from shadow_tpu.analysis import proofs
+
+    findings, deltas = [], []
+    if "SL501" in selected:
+        findings.extend(proofs.check_all_invisibility())
+    if "SL502" in selected:
+        budget_findings, deltas = proofs.check_op_budgets()
+        findings.extend(budget_findings)
+    return findings, deltas
+
+
+def list_rules() -> str:
+    lines = []
+    for rid, info in sorted(_rules.RULES.items()):
+        fixture = (f"tests/lint_fixtures/{info.fixture}"
+                   if info.fixture else "-")
+        lines.append(f"{rid}  {info.name:<24} scope: {info.scope}")
+        lines.append(f"       fixture: {fixture}")
+    return "\n".join(lines)
 
 
 def main(argv=None) -> int:
@@ -83,27 +128,118 @@ def main(argv=None) -> int:
         prog="shadowlint", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("paths", nargs="*",
-                    help="files/dirs to lint; default: shadow_tpu and "
-                         "tools, resolved against the repo root so the "
-                         "gate works from any cwd")
+                    help="files/dirs to lint; default: shadow_tpu, "
+                         "tools, and bench.py, resolved against the "
+                         "repo root so the gate works from any cwd")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable JSON report on stdout")
     ap.add_argument("--no-jaxpr", action="store_true",
-                    help="skip pass 2 (jaxpr audit of tpu/ kernels)")
+                    help="skip passes 2+3 (jaxpr audit + dataflow "
+                         "proofs; no jax import)")
+    ap.add_argument("--only", metavar="SLnnn[,SLnnn]",
+                    help="run/report only these rule IDs (passes whose "
+                         "whole family is deselected are skipped "
+                         "entirely — `--only SL501,SL502,SL503` is the "
+                         "fast CI proof gate)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule inventory (id, name, scope, "
+                         "fixture) and exit")
+    ap.add_argument("--write-op-budgets", action="store_true",
+                    help="regenerate analysis/op_budgets.json from the "
+                         "live tree (the explicit-ledger-update step "
+                         "for a justified op-cost change) and exit")
+    ap.add_argument("--shard-report", metavar="FILE",
+                    help="write the SL504 shardability report "
+                         "(host-local vs cross-host primitives per "
+                         "audited section) to FILE")
     ap.add_argument("--recompile", action="store_true",
                     help="also run the jit-cache sweep over the "
                          "bench-ladder shapes (slow: compiles kernels)")
     args = ap.parse_args(argv)
 
-    paths = args.paths or [os.path.join(_REPO, p) for p in DEFAULT_PATHS]
-    try:
-        findings, malformed = run_ast_pass(paths)
-    except FileNotFoundError as exc:
-        print(f"shadowlint: no such file or directory: {exc.args[0]}",
-              file=sys.stderr)
+    if args.list_rules:
+        print(list_rules())
+        return 0
+
+    if args.write_op_budgets:
+        _force_cpu()
+
+        from shadow_tpu.analysis import proofs
+
+        doc = proofs.write_op_budgets()
+        print(f"wrote {proofs.budget_path()} "
+              f"({len(doc['budgets'])} entries)")
+        return 0
+
+    if args.only:
+        selected = {r.strip().upper() for r in args.only.split(",")
+                    if r.strip()}
+        unknown = selected - set(_rules.RULES)
+        if unknown:
+            print(f"shadowlint: unknown rule id(s): "
+                  f"{', '.join(sorted(unknown))} (see --list-rules)",
+                  file=sys.stderr)
+            return 2
+    else:
+        selected = set(_rules.RULES)
+
+    if args.no_jaxpr and args.shard_report:
+        # the report IS a traced pass; per the help text --no-jaxpr
+        # promises "no jax import", so the combination is a
+        # contradiction, not a preference
+        print("shadowlint: --shard-report traces the audit registry "
+              "(needs jax); drop --no-jaxpr", file=sys.stderr)
         return 2
+    if args.no_jaxpr:
+        dropped = sorted(selected & (JAXPR_RULES | PROOF_RULES))
+        if dropped and not (selected & AST_RULES):
+            # a "gate" that runs nothing must never report green
+            print("shadowlint: --no-jaxpr skips every selected rule "
+                  f"({', '.join(dropped)}): nothing would be checked",
+                  file=sys.stderr)
+            return 2
+        if dropped and args.only:
+            print(f"shadowlint: note: --no-jaxpr skips "
+                  f"{', '.join(dropped)} of the selected rules",
+                  file=sys.stderr)
+    if not (selected & (AST_RULES | JAXPR_RULES | PROOF_RULES)) \
+            and not args.shard_report:
+        # --only SL504 alone: the report rule has no pass/fail pass —
+        # it needs an artifact destination to do anything at all
+        print("shadowlint: the selected rule(s) run no checking pass "
+              "(SL504 is report-only): pass --shard-report FILE to "
+              "emit the report", file=sys.stderr)
+        return 2
+
+    paths = args.paths or [os.path.join(_REPO, p) for p in DEFAULT_PATHS]
+    findings, malformed = [], []
+    if selected & AST_RULES:
+        try:
+            findings, malformed = run_ast_pass(paths)
+        except FileNotFoundError as exc:
+            print(f"shadowlint: no such file or directory: "
+                  f"{exc.args[0]}", file=sys.stderr)
+            return 2
+    budget_deltas = []
     if not args.no_jaxpr:
-        findings.extend(run_jaxpr_pass())
+        if selected & JAXPR_RULES:
+            findings.extend(run_jaxpr_pass())
+        if selected & PROOF_RULES:
+            proof_findings, budget_deltas = run_proof_pass(selected)
+            findings.extend(proof_findings)
+
+    findings = [f for f in findings if f.rule in selected]
+
+    shard_report = None
+    if args.shard_report:
+        _force_cpu()
+
+        from shadow_tpu.analysis import proofs
+
+        shard_report = proofs.build_shard_report()
+        with open(args.shard_report, "w", encoding="utf-8") as fh:
+            json.dump(shard_report, fh, indent=2)
+            fh.write("\n")
 
     recompile_report = None
     if args.recompile:
@@ -117,18 +253,28 @@ def main(argv=None) -> int:
         recompile_report and recompile_report["unexpected_misses"])
 
     if args.json:
+        hits: dict[str, dict[str, int]] = {}
+        for f in findings:
+            slot = hits.setdefault(f.rule, {"active": 0, "suppressed": 0})
+            slot["suppressed" if f.suppressed else "active"] += 1
         json.dump({
-            "version": 1,
+            "version": 2,
             "rules": {rid: {
                 "name": info.name,
                 "summary": info.summary,
                 "invariant": info.invariant,
+                "scope": info.scope,
+                "fixture": (f"tests/lint_fixtures/{info.fixture}"
+                            if info.fixture else None),
+                "selected": rid in selected,
+                "hits": hits.get(rid, {"active": 0, "suppressed": 0}),
             } for rid, info in sorted(_rules.RULES.items())},
             "findings": [f.to_json() for f in findings],
             "malformed_suppressions": [
                 {"path": p, "line": ln, "text": t}
                 for p, ln, t in malformed
             ],
+            "op_budget_deltas": budget_deltas,
             "recompile": recompile_report,
             "summary": {
                 "active": len(active),
@@ -142,6 +288,11 @@ def main(argv=None) -> int:
 
     for f in active:
         print(f)
+    if budget_deltas:
+        from shadow_tpu.analysis import proofs
+
+        print("-- op budget vs actual (SL502):")
+        print(proofs.format_budget_delta(budget_deltas))
     for path, lineno, text in malformed:
         print(f"{path}:{lineno}:1: malformed suppression (missing "
               f"`-- justification`): {text}")
@@ -149,6 +300,12 @@ def main(argv=None) -> int:
         print(f"-- {len(suppressed)} suppressed finding(s):")
         for f in suppressed:
             print(f"   {f}  ({f.justification})")
+    if shard_report is not None:
+        s = shard_report["summary"]
+        print(f"-- SL504 shardability report: {s['sections']} sections, "
+              f"{s['cross_host_ops']} cross-host op(s), "
+              f"{s['opaque_kernels']} opaque kernel(s) -> "
+              f"{args.shard_report}")
     if recompile_report is not None:
         print(f"-- recompile sweep: {recompile_report['total_compiles']} "
               f"compiles over {len(recompile_report['shapes'])} ladder "
