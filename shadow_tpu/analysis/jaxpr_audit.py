@@ -459,6 +459,59 @@ def _ingest_rows_entry():
     return build
 
 
+def _flows_entry(kind: str):
+    """The flow plane (docs/robustness.md "Flow plane"): the
+    flows-threaded window_step variant plus the standalone flow_step
+    composition — both SL2xx-audited and, for the window_step variant,
+    the SL501 append-only proof subject (`analysis/proofs.py`)."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..tpu import flows as flows_mod
+        from ..tpu import plane
+
+        n = 4
+        params = plane.make_params(
+            latency_ns=np.full((n, n), 1_000_000, np.int64),
+            loss=np.full((n, n), 0.01, np.float64),
+            up_bw_bps=np.full(n, 1_000_000_000, np.int64),
+        )
+        state = plane.make_state(n, egress_cap=8, ingress_cap=8,
+                                 params=params)
+        root = jax.random.key(0)
+        ft = flows_mod.make_flow_tables(
+            np.arange(n, dtype=np.int32),
+            (np.arange(n, dtype=np.int32) + 1) % n,
+            np.full(n, 1400, np.int32))
+        fs = flows_mod.make_flow_state(n)
+        if kind == "window":
+            def fn(state, fs, shift, window):
+                return plane.window_step(
+                    state, params, root, shift, window,
+                    rr_enabled=False, flows=(ft, fs))
+
+            return fn, (state, fs, jnp.int32(0),
+                        jnp.int32(10_000_000))
+        ci = state.in_src.shape[1]
+        delivered = {
+            "mask": jnp.zeros((n, ci), bool),
+            "src": jnp.zeros((n, ci), jnp.int32),
+            "seq": jnp.zeros((n, ci), jnp.int32),
+            "sock": jnp.zeros((n, ci), jnp.int32),
+            "bytes": jnp.zeros((n, ci), jnp.int32),
+            "deliver_rel": jnp.zeros((n, ci), jnp.int32),
+        }
+
+        def fn(ft_arrays, fs, state, delivered):
+            return flows_mod.flow_step(ft_arrays, fs, state, delivered,
+                                       jnp.int32(10_000_000))
+
+        return fn, (ft, fs, state, delivered)
+
+    return build
+
+
 def _tcp_entry(kind: str):
     def build():
         import jax.numpy as jnp
@@ -613,6 +666,10 @@ def default_entries() -> list[AuditEntry]:
                    _chain_entry("workload")),
         AuditEntry("ingest_rows[planes]", "shadow_tpu.tpu.plane",
                    _ingest_rows_entry()),
+        AuditEntry("window_step[flows]", "shadow_tpu.tpu.plane",
+                   _flows_entry("window")),
+        AuditEntry("flow_step", "shadow_tpu.tpu.flows",
+                   _flows_entry("step")),
         AuditEntry("tcp_event_step", "shadow_tpu.tpu.tcp",
                    _tcp_entry("event")),
         AuditEntry("tcp_pull_step", "shadow_tpu.tpu.tcp",

@@ -38,7 +38,7 @@ from typing import Callable
 import numpy as np
 
 from .dataflow import leaf_paths, op_census, propagate_taint, shard_census
-from .jaxpr_audit import _ingest_rows_entry
+from .jaxpr_audit import _flows_entry, _ingest_rows_entry
 from .rules import Finding
 
 __all__ = [
@@ -61,6 +61,16 @@ WORKLOAD_APPEND_OK = frozenset({
     "eg_dst", "eg_bytes", "eg_prio", "eg_seq", "eg_ctrl", "eg_tsend",
     "eg_clamp", "eg_sock", "eg_valid", "n_overflow_dropped",
 })
+
+#: the flow plane (tpu/flows.py) carries the SAME append-only
+#: confinement: its retransmissions and delayed acks enter through
+#: `plane.ingest`, so the egress columns + the overflow counter are
+#: the only sim-state leaves its taint may reach — the wire, RNG,
+#: clocks, ingress rings, and the delivered stream are provably out
+#: of its reach (the flows-off world theorem: with flows=None nothing
+#: exists to taint, and with flows threaded the plane writes ONLY the
+#: append surface).
+FLOWS_APPEND_OK = WORKLOAD_APPEND_OK
 
 
 @dataclass
@@ -276,6 +286,28 @@ def _workload_protected(idx: int, path: str) -> bool:
     return leaf not in WORKLOAD_APPEND_OK
 
 
+def _flows_window_protected(idx: int, path: str) -> bool:
+    """The flows-threaded window_step append-only theorem: state leaves
+    outside the append surface, the delivered dict, and next_event are
+    protected (delivered and next_event are computed BEFORE the flow
+    section — docs/robustness.md 'Flow plane'); the FlowState output
+    (idx 3) is legitimately tainted."""
+    if idx == 0:
+        leaf = path.split(".")[-1].split("[")[0]
+        return leaf not in FLOWS_APPEND_OK
+    return idx in (1, 2)
+
+
+def _flows_step_protected(idx: int, path: str) -> bool:
+    """flow_step standalone returns (state', fs', credits): the same
+    append-only confinement on state'; fs'/credits are the plane's
+    own outputs."""
+    if idx != 0:
+        return False
+    leaf = path.split(".")[-1].split("[")[0]
+    return leaf not in FLOWS_APPEND_OK
+
+
 def invisibility_specs() -> list[InvisibilitySpec]:
     """The SL501 proof surface: every observability-plane variant of the
     three ingest/step/chain kernels, the composed all-planes traces, and
@@ -335,6 +367,21 @@ def invisibility_specs() -> list[InvisibilitySpec]:
             _workload_step_entry(),
             tainted_args={0: "ws", 1: "wl"},
             protected=_workload_protected),
+        # the flow plane's obligations (docs/robustness.md "Flow
+        # plane"): taint the per-flow state at the kernel boundary and
+        # prove it can reach ONLY the egress append surface — the
+        # machine theorem behind "flows=None worlds are untouched and
+        # flows-on cannot perturb the wire"
+        InvisibilitySpec(
+            "window_step[flows]", "shadow_tpu.tpu.plane",
+            _flows_entry("window"),
+            tainted_args={1: "flows"},
+            protected=_flows_window_protected),
+        InvisibilitySpec(
+            "flow_step[append-only]", "shadow_tpu.tpu.flows",
+            _flows_entry("step"),
+            tainted_args={0: "ft", 1: "fs"},
+            protected=_flows_step_protected),
     ]
 
 

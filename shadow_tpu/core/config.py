@@ -300,6 +300,28 @@ class WorkloadOptions:
     seed: Optional[int] = None
 
 
+@dataclass
+class FlowsOptions:
+    """The `flows:` config block (no reference counterpart — the
+    device flow plane, docs/robustness.md "Flow plane"): RTO
+    retransmit + congestion backpressure for scenario traffic.
+
+    `emit_cap` bounds the data segments one flow emits per window
+    (cwnd beyond it carries to the next window); `recv_wnd` sizes the
+    receiver's out-of-order bitmap in segments (and therefore the
+    sender's effective window clamp). Like the workload plane and the
+    flight recorder, the flow plane rides the device-plane WINDOW
+    DRIVERS only (`tools/run_scenarios.py` executes scenarios whose
+    spec declares ``transport: flows``); declaring the block on a
+    Manager-driven run warns loudly, ConfigError under top-level
+    `strict: true`. The whole block accepts the bare YAML 1.1
+    spellings ``flows: off`` / ``flows: on``."""
+
+    enabled: bool = False
+    emit_cap: int = 8
+    recv_wnd: int = 64
+
+
 #: valid per-class guard policies (guards/report.py shares this set)
 GUARD_POLICIES = ("off", "warn", "abort", "abort+checkpoint")
 
@@ -449,6 +471,7 @@ class ConfigOptions:
     guards: GuardsOptions = field(default_factory=GuardsOptions)
     capacity: CapacityOptions = field(default_factory=CapacityOptions)
     workload: WorkloadOptions = field(default_factory=WorkloadOptions)
+    flows: FlowsOptions = field(default_factory=FlowsOptions)
     host_defaults: HostDefaultOptions = field(default_factory=HostDefaultOptions)
     hosts: dict[str, HostOptions] = field(default_factory=dict)
     # strict mode: unsupported feature combinations that normally
@@ -647,6 +670,16 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
             else:
                 cfg.workload = _fill_dataclass(WorkloadOptions, value,
                                                "workload")
+        elif key == "flows":
+            # same YAML 1.1 bare off/on hardening as the workload and
+            # flight_recorder blocks (docs/robustness.md "Flow plane")
+            if value is False:
+                cfg.flows = FlowsOptions(enabled=False)
+            elif value is True:
+                cfg.flows = FlowsOptions(enabled=True)
+            else:
+                cfg.flows = _fill_dataclass(FlowsOptions, value,
+                                            "flows")
         elif key == "strict":
             if not isinstance(value, bool):
                 raise ConfigError(
@@ -715,6 +748,19 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
             "telemetry.flight_recorder.sample_every must be >= 1")
     if cfg.telemetry.flight_recorder.ring < 1:
         raise ConfigError("telemetry.flight_recorder.ring must be >= 1")
+    # flows knobs validate unconditionally like the flight recorder's:
+    # the corpus runner consults them whether or not a Manager run
+    # would, and a bad bound must die at parse, never at trace time
+    if cfg.flows.emit_cap < 1:
+        raise ConfigError("flows.emit_cap must be >= 1")
+    if cfg.flows.recv_wnd < 1:
+        raise ConfigError("flows.recv_wnd must be >= 1")
+    if cfg.flows.emit_cap > cfg.flows.recv_wnd:
+        raise ConfigError(
+            f"flows.emit_cap ({cfg.flows.emit_cap}) must not exceed "
+            f"flows.recv_wnd ({cfg.flows.recv_wnd}): a window's "
+            "emission burst has to fit the receiver's reorder bitmap "
+            "or the tail would be discarded on arrival by design")
     if cfg.faults.checkpoint.interval is not None \
             and cfg.faults.checkpoint.interval <= 0:
         raise ConfigError(

@@ -204,6 +204,21 @@ class Manager:
                 "tools/chaos_smoke.py, tools/run_scenarios.py) — this "
                 "run proceeds without hop tracing; telemetry.histograms "
                 "remains available on the use_tpu_transport path")
+        if config.flows.enabled:
+            # the flow plane (RTO retransmit / congestion
+            # backpressure) threads the device-plane window drivers —
+            # tools/run_scenarios.py executes it for scenarios with
+            # `transport: flows`; Manager-driven runs use the CPU
+            # socket machinery (or use_tpu_transport), neither of
+            # which consults this block — a silently-ignored opt-in
+            # would look like a broken feature (docs/robustness.md
+            # "Flow plane")
+            self._unsupported_combo(
+                "flows.enabled is not consulted by Manager-driven "
+                "runs: the device flow plane rides the window drivers "
+                "(tools/run_scenarios.py, scenarios with `transport: "
+                "flows`) — this run proceeds on its normal socket "
+                "transport")
         if config.workload.enabled or config.workload.scenario not in (
                 None, "off"):
             # the workload plane's generators ride the device-plane

@@ -54,13 +54,20 @@ log = logging.getLogger("shadow_tpu.telemetry")
 
 # hop kinds (ev_kind values). DROP reasons are distinct kinds so the
 # drop taxonomy (docs/robustness.md) survives into the hop stream: an
-# injected fault never reads as wire loss, per-packet included.
+# injected fault never reads as wire loss, per-packet included. The
+# flow plane's recovery kinds share the packet's identity (src, flow
+# seq), so a sampled lost packet's trail reads
+# drop_loss -> rto_fired -> retransmit -> delivered — it never
+# silently vanishes (docs/observability.md attribution table).
 HOP_INGEST = 0  # appended to its source's egress ring
 HOP_ROUTED = 1  # cleared the egress gate and entered the wire
 HOP_DELIVERED = 2  # released to the destination host
 HOP_DROP_LOSS = 3  # Bernoulli path-loss sample
 HOP_DROP_FAULT = 4  # injected fault (crash purge / corruption burst)
 HOP_DROP_AQM = 5  # router CoDel verdict at the destination
+HOP_RTO_FIRED = 6  # flow-plane RTO expiry: go-back-N rewind (seq =
+# the snd_una segment the timer was guarding)
+HOP_RETRANSMIT = 7  # flow-plane re-emission of an already-sent seq
 
 HOP_NAMES = {
     HOP_INGEST: "ingest",
@@ -69,6 +76,8 @@ HOP_NAMES = {
     HOP_DROP_LOSS: "drop_loss",
     HOP_DROP_FAULT: "drop_fault",
     HOP_DROP_AQM: "drop_aqm",
+    HOP_RTO_FIRED: "rto_fired",
+    HOP_RETRANSMIT: "retransmit",
 }
 
 I32_MAX = np.int32(2**31 - 1)

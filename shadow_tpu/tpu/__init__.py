@@ -10,10 +10,12 @@ metadata back to payloads buffered CPU-side.
 
 import os as _os
 
+from .flows import (FlowState, FlowTables, make_flow_state,
+                    make_flow_tables)
+from .mesh import host_sharding, make_mesh, shard_state
 from .plane import (NetPlaneParams, NetPlaneState, chain_windows, ingest,
                     ingest_rows, make_params, make_state, unpack_planes,
                     window_step)
-from .mesh import host_sharding, make_mesh, shard_state
 
 
 def enable_compilation_cache() -> None:
@@ -59,10 +61,14 @@ def donating_jit(fun=None, donate_argnums=(0,), **jit_kwargs):
 
 
 __all__ = [
+    "FlowState",
+    "FlowTables",
     "NetPlaneParams",
     "NetPlaneState",
     "chain_windows",
     "donating_jit",
+    "make_flow_state",
+    "make_flow_tables",
     "ingest",
     "ingest_rows",
     "make_params",

@@ -253,6 +253,13 @@ def drive_chained_windows(state, extras, chain_fn, *, n_rounds: int,
     hooks); returning a (state, extras) pair replaces the carried
     values (how chaos_smoke's tamper writes corrupted device state),
     returning None keeps them. Returns the final ``(state, extras)``.
+
+    The flow plane (docs/robustness.md "Flow plane") rides `extras`
+    like every other non-NetPlaneState pytree: the scenario runner's
+    chain carries its FlowState next to the workload/metrics/guards
+    states, so under ``policy`` a discarded overflowing chain replays
+    the flow machine from the chain-start snapshot too — retransmit
+    schedules stay bitwise-reproducible through elastic growth.
     """
     import jax.numpy as jnp
 

@@ -524,7 +524,7 @@ def chain_windows(state: NetPlaneState, params: NetPlaneParams,
                   guards: GuardState | None = None,
                   hist: PlaneHistograms | None = None,
                   flightrec: FlightRecArrays | None = None,
-                  workload=None, round0=0):
+                  workload=None, flows=None, round0=0):
     """Advance consecutive scheduling windows ON DEVICE until one delivers.
 
     The device-resident analogue of the controller's window chain
@@ -553,32 +553,72 @@ def chain_windows(state: NetPlaneState, params: NetPlaneParams,
     generator's `workload_step` after each chained window (its
     emission re-arms the next-event reduction, so a chain never sleeps
     through traffic the generator just queued); `round0` is the
-    driver's window counter for `done_win` stamping. `kernel` selects
-    the plane kernel like `window_step` ("xla" | "pallas" |
-    "pallas_fused").
+    driver's window counter for `done_win` stamping. `flows=(ft, fs0)`
+    threads the device flow plane (docs/robustness.md "Flow plane")
+    through the carry the same way — its emission (retransmissions,
+    delayed acks) re-arms the next-event reduction too, so an idle
+    chain can never sleep through a pending retransmission; mutually
+    exclusive with `workload` here (the scenario runner interleaves
+    the two through `flow_recv`/`flow_emit` around the phase credits
+    instead — workloads/runner.py). `kernel` selects the plane kernel
+    like `window_step` ("xla" | "pallas" | "pallas_fused").
 
     Returns (state, delivered, off, next_rel, n_windows[, metrics']
-    [, guards'][, hist'][, flightrec'][, ws']) — presence outputs
-    appended in `window_step` order, the workload state last. `off` is
-    the LAST window's start relative to the first window's start —
-    `delivered` times and `next_rel` are relative to that last
-    window's start.
+    [, guards'][, hist'][, flightrec'][, ws'][, fs']) — presence
+    outputs appended in `window_step` order, the workload / flow
+    state last. `off` is the LAST window's start relative to the
+    first window's start — `delivered` times and `next_rel` are
+    relative to that last window's start.
     """
+    if workload is not None and flows is not None:
+        raise ValueError(
+            "chain_windows composes workload= or flows=, not both: a "
+            "workload riding a flow transport must interleave the "
+            "phase credits between flow_recv and flow_emit, which is "
+            "the scenario runner's split-form loop "
+            "(workloads/runner.py)")
     if workload is not None:
         from ..workloads import device as _wdevice
 
         wl, ws0 = workload
     else:
         wl = ws0 = None
+    if flows is not None:
+        ft, fs0 = flows
+    else:
+        ft = fs0 = None
 
     def step(st, planes, shift, window_ns, ridx):
-        m, g, h, fr, ws = planes
+        m, g, h, fr, ws, fstate = planes
         out = window_step(st, params, rng_root, shift, window_ns,
                           rr_enabled=rr_enabled, router_aqm=router_aqm,
                           no_loss=no_loss, kernel=kernel, faults=faults,
-                          metrics=m, guards=g, hist=h, flightrec=fr)
-        (st, delivered, next_ev), m, g, h, fr = unpack_planes(
-            out, metrics=m, guards=g, hist=h, flightrec=fr)
+                          metrics=m, guards=g, hist=h, flightrec=fr,
+                          flows=(ft, fstate) if fstate is not None
+                          else None)
+        (st, delivered, next_ev), m, g, h, fr, fstate = unpack_planes(
+            out, metrics=m, guards=g, hist=h, flightrec=fr,
+            flows=fstate)
+        if fstate is not None:
+            from . import flows as _flows_mod
+
+            # the flow emission (retransmits / delayed acks) may have
+            # re-armed an empty egress ring, exactly like the workload
+            # emission below — and a pending RTO deadline must wake
+            # the chain even when NOTHING is in flight (every packet
+            # of a window lost): the deadline is a real future event,
+            # relative to this window's end = window_ns + rel
+            next_ev = jnp.minimum(
+                next_ev, jnp.where(st.eg_valid.any(), window_ns,
+                                   I32_MAX))
+            rto_rel = _flows_mod.next_deadline_rel_ns(ft, fstate)
+            # guard the add against the no-deadline sentinel: rel is
+            # clamped <= I32_MAX//2 when a timer pends, so the sum
+            # stays in int32 (window_ns <= I32_MAX//4 by the spec
+            # budget)
+            wake = jnp.where(rto_rel > I32_MAX // 2, I32_MAX,
+                             jnp.int32(window_ns) + rto_rel)
+            next_ev = jnp.minimum(next_ev, wake)
         if ws is not None:
             wout = _wdevice.workload_step(wl, ws, st, delivered, ridx,
                                           window_ns, metrics=m, guards=g)
@@ -597,11 +637,11 @@ def chain_windows(state: NetPlaneState, params: NetPlaneParams,
             next_ev = jnp.minimum(
                 next_ev, jnp.where(st.eg_valid.any(), window_ns,
                                    I32_MAX))
-        return st, delivered, next_ev, (m, g, h, fr, ws)
+        return st, delivered, next_ev, (m, g, h, fr, ws, fstate)
 
     hs = jnp.minimum(jnp.int32(horizon_rel), jnp.int32(stop_rel))
 
-    planes = (metrics, guards, hist, flightrec, ws0)
+    planes = (metrics, guards, hist, flightrec, ws0, fs0)
     state, delivered, next_ev, planes = step(
         state, planes, jnp.int32(shift0), jnp.int32(window0_ns),
         jnp.int32(round0))
@@ -627,22 +667,27 @@ def chain_windows(state: NetPlaneState, params: NetPlaneParams,
         cond, body,
         (state, delivered, jnp.int32(0), next_ev, jnp.int32(1), planes),
     )
-    m, g, h, fr, ws = planes
+    m, g, h, fr, ws, fstate = planes
     out = (state, delivered, off, next_ev, n)
     out += tuple(p for p in (m, g, h, fr) if p is not None)
     if workload is not None:
         out += (ws,)
+    if flows is not None:
+        out += (fstate,)
     return out
 
 
+_UNSET = object()
+
+
 def unpack_planes(out, *, metrics=None, guards=None, hist=None,
-                  flightrec=None, n_lead=3):
+                  flightrec=None, flows=_UNSET, n_lead=3):
     """Split a `window_step` (n_lead=3) or `ingest_rows` (n_lead=1)
     output into its lead values plus the presence-switch outputs, in
     the ONE declaration order both kernels append them — metrics,
-    guards, hist, flightrec. Pass the same presence pytrees the kernel
-    call received: each non-None plane comes back as its output, each
-    None stays None, so a driver writes
+    guards, hist, flightrec[, flows]. Pass the same presence pytrees
+    the kernel call received: each non-None plane comes back as its
+    output, each None stays None, so a driver writes
 
         (st, delivered, nxt), m, g, h, fr = unpack_planes(
             out, metrics=m, guards=g, hist=h, flightrec=fr)
@@ -650,15 +695,24 @@ def unpack_planes(out, *, metrics=None, guards=None, hist=None,
     instead of hand-maintaining a per-site pop sequence (a mis-ordered
     pop swaps two pytrees silently until trace time — every window
     driver shares this one unpacker for the same reason they share
-    `elastic.drive_chained_windows`)."""
+    `elastic.drive_chained_windows`).
+
+    `flows` is the FlowState the kernel's ``flows=(ft, fs)`` pair
+    carried (the tables are static). Passing it — even as None — adds
+    a sixth slot to the return, so flow-plane drivers unpack
+    ``(lead), m, g, h, fr, fs = unpack_planes(..., flows=fs)``;
+    omitting it keeps the legacy five-slot shape."""
     if type(out) is not tuple:
         # bare state: ingest_rows with no planes threaded returns the
         # NetPlaneState itself — which IS a (named)tuple, so the check
         # must be on the exact type, never isinstance
         out = (out,)
     lead, rest = out[:n_lead], list(out[n_lead:])
+    want = [metrics, guards, hist, flightrec]
+    if flows is not _UNSET:
+        want.append(flows)
     planes = tuple(rest.pop(0) if p is not None else None
-                   for p in (metrics, guards, hist, flightrec))
+                   for p in want)
     if rest:
         raise TypeError(
             f"unpack_planes: {len(rest)} unclaimed kernel output(s) — "
@@ -1474,7 +1528,8 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
                 metrics: PlaneMetrics | None = None,
                 guards: GuardState | None = None,
                 hist: PlaneHistograms | None = None,
-                flightrec: FlightRecArrays | None = None):
+                flightrec: FlightRecArrays | None = None,
+                flows=None):
     """Advance one scheduling round [t, t + window_ns).
 
     `rr_enabled` is a static (trace-time) switch: False compiles the
@@ -1561,11 +1616,29 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     counter-based stream (like fault corruption), so recording never
     perturbs the simulation. XLA kernel only.
 
+    `flows` (static presence switch, docs/robustness.md "Flow plane")
+    threads the device flow plane as a ``(FlowTables, FlowState)``
+    pair (`tpu/flows.py`): this window's deliveries feed per-flow
+    cumulative-ack / in-order-credit processing, expired RTO deadlines
+    rewind go-back-N with exponential backoff, and the window's
+    emissions (retransmissions + delayed acks) append through the
+    normal ingest path — ordinary packets, visible to every other
+    plane. Unlike the observability planes this one legitimately
+    WRITES sim state, but only the egress append columns + the
+    overflow counter (the SL501 append-only obligation
+    `window_step[flows]`, same theorem as the workload generator);
+    threading tables whose flows are all inactive is bitwise-inert
+    (tests/test_flows.py). flows=None compiles the section out. XLA
+    kernel only, like faults. The returned state's next_event was
+    reduced BEFORE the flow emission; chained callers re-arm it like
+    the workload emission (`chain_windows`).
+
     `shift_ns` = this window's start minus the previous window's start;
     stored relative times are rebased by it. Returns
     (state', delivered, next_event_rel) — plus metrics', guards',
     hist', and/or flightrec' appended in that order when the
-    respective pytrees were passed — where `delivered` is a dict of
+    respective pytrees were passed (the flow plane's FlowState', when
+    threaded, appends last) — where `delivered` is a dict of
     [N, CI] arrays masked by delivered['mask'] (packets that arrived
     within this window, in deterministic (deliver_t, src, seq) order
     per host) and `next_event_rel` is the min pending delivery time
@@ -1603,6 +1676,12 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
             "recorder observability plane; compile with kernel='xla' "
             "when a PlaneHistograms or FlightRecArrays pytree is "
             "threaded (the self-healing kernel fallback in "
+            "faults/healing.py does this automatically)")
+    if pallas_kernel and flows is not None:
+        raise ValueError(
+            f"plane_kernel={kernel!r} does not fuse the flow plane; "
+            "compile with kernel='xla' when a (FlowTables, FlowState) "
+            "pair is threaded (the self-healing kernel fallback in "
             "faults/healing.py does this automatically)")
     N, CE = state.eg_dst.shape
 
@@ -1836,7 +1915,6 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
         **({"n_fault_dropped": state.n_fault_dropped + fault_drops}
            if faults is not None else {}),
     )
-    out = (new_state, delivered, next_event)
     if metrics is not None:
         # --- 8. telemetry accumulation (static; compiled out when off) --
         metrics = _accumulate_metrics(
@@ -1844,7 +1922,6 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
             in_valid_m, rt_out.dropped - state.router.dropped,
             fault_drops if faults is not None
             else jnp.zeros((N,), jnp.int32), eg_bytes)
-        out += (metrics,)
     if guards is not None:
         # --- 9. guard plane (static; compiled out when off) -------------
         # pure reads over values the step already materialized; nothing
@@ -1874,7 +1951,6 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
             new_state=new_state,
             rng_delta=rng_counter - state.rng_counter,
             egress_cap=CE, shift_ns=shift_ns, window_ns=window_ns)
-        out += (guards,)
     if hist is not None:
         # --- 10. latency/depth histograms (static; compiled out when
         # off) — pure reads over already-materialized values, like the
@@ -1899,7 +1975,6 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
                 state.eg_valid.sum(axis=1, dtype=jnp.int32)
                 + in_valid_m.sum(axis=1, dtype=jnp.int32)),
         )
-        out += (hist,)
     if flightrec is not None:
         # --- 11. sampled flight recorder (static; compiled out when
         # off): per-hop events for the ~1/K packets whose (src, seq)
@@ -1966,5 +2041,40 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
             jnp.concatenate(ev_kind), jnp.concatenate(ev_src),
             jnp.concatenate(ev_seq), jnp.concatenate(ev_dst),
             jnp.concatenate(ev_t), jnp.concatenate(ev_mask))
-        out += (flightrec_mod.advance_window(flightrec),)
+        flightrec = flightrec_mod.advance_window(flightrec)
+    fs_out = None
+    if flows is not None:
+        # --- 12. device flow plane (static; compiled out when off):
+        # RTO retransmit + congestion backpressure, docs/robustness.md
+        # "Flow plane". Ack/credit processing reads the delivered dict
+        # the step just released; emission (retransmissions, delayed
+        # acks) appends through the normal ingest path AFTER every
+        # observability section, so the guards' window conservation
+        # checked the pre-append state and the append itself threads
+        # check_ingest like any producer. The flow plane's writes
+        # confine to the egress columns + the overflow counter (the
+        # SL501 append-only obligation `window_step[flows]`); NOTE
+        # next_event was reduced before the append — chained callers
+        # re-arm it exactly like the workload emission (the min with
+        # window_ns in `chain_windows`).
+        from . import flows as flows_mod  # lazy: flows.py imports plane
+
+        ft, fs = flows
+        fout = flows_mod.flow_step(
+            ft, fs, new_state, delivered, window_ns,
+            metrics=metrics, guards=guards, flightrec=flightrec)
+        new_state, fs_out = fout[0], fout[1]
+        rest = list(fout[3:])
+        if metrics is not None:
+            metrics = rest.pop(0)
+        if guards is not None:
+            guards = rest.pop(0)
+        if flightrec is not None:
+            flightrec = rest.pop(0)
+    out = (new_state, delivered, next_event)
+    for plane_out in (metrics, guards, hist, flightrec):
+        if plane_out is not None:
+            out += (plane_out,)
+    if flows is not None:
+        out += (fs_out,)
     return out

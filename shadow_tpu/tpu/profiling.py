@@ -83,6 +83,14 @@ Sections (superset of the window step's numbered stages):
   other plane sections (ratio vs ``window_step`` <= 1.35,
   docs/workloads.md).
 
+- ``window_step_flows`` — the full step with the device flow plane
+  threaded (`tpu/flows.py`: ack/credit classification over the
+  delivered dict, the vmapped Reno/RTO handlers, and the masked
+  emission append) over one IDLE flow per host — the neutral
+  presence cost, exactly how the faults/guards sections price their
+  planes. Gated in CI (ratio vs ``window_step`` <= 1.35,
+  docs/robustness.md "Flow plane").
+
 Drive it from the CLI: ``python tools/profile_plane.py --hosts 1024,32768``.
 """
 
@@ -108,7 +116,7 @@ DEFAULT_SECTIONS = (
     "ingest_rows", "fused_stage", "window_step", "window_chain8",
     "window_step_telemetry",
     "window_step_faults", "window_step_guards", "window_step_elastic",
-    "window_step_trace", "window_step_workload",
+    "window_step_trace", "window_step_workload", "window_step_flows",
 )
 
 #: the cheap per-section subset bench.py records in its JSON `sections`
@@ -514,6 +522,25 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
         _probe, _wstate = _make_workload_probe()
         section_calls["window_step_workload"] = (
             _probe, (state, _wstate, shift))
+    if "window_step_flows" in wanted:
+        # the flow plane's presence cost: one idle flow per host
+        # (active endpoints, nothing left to send) — the recv
+        # classification, the vmapped ack/RTO handlers, and the
+        # masked emission all run at fleet width, like the neutral
+        # fault masks / clean guards the sibling sections thread
+        from . import flows as _flows
+
+        _ftab = _flows.make_flow_tables(
+            np.arange(n_hosts, dtype=np.int32),
+            (np.arange(n_hosts, dtype=np.int32) + 1) % n_hosts,
+            np.full(n_hosts, 1400, np.int32))
+        _fstate = _flows.make_flow_state(n_hosts)
+        section_calls["window_step_flows"] = (
+            jax.jit(lambda st, fst, sh: window_step(
+                st, params, rng_root, sh, window,
+                rr_enabled=rr_enabled, packed_sort=packed_sort,
+                kernel="xla", flows=(_ftab, fst))),
+            (state, _fstate, shift))
 
     out_sections = {}
     for name in wanted:

@@ -46,7 +46,14 @@ ACK_BYTES = 64
 
 
 class TrafficProgram(NamedTuple):
-    """SoA phase tables (numpy; `device.to_device` uploads them)."""
+    """SoA phase tables (numpy; `device.to_device` uploads them).
+
+    The trailing flow fields exist only under ``transport: flows``
+    (`_lower_flows`): one flow per distinct (src, dst, bytes) send
+    triple, plus the [N, P, K] lane -> flow id bridge the generator's
+    `enqueue` path consults. They stay None on direct-transport
+    programs so the first six fields — and therefore
+    `program_digest` of every existing corpus entry — are unchanged."""
 
     dep: np.ndarray  # [N, P] int32
     hold_ns: np.ndarray  # [N, P] int32
@@ -57,6 +64,10 @@ class TrafficProgram(NamedTuple):
     n_hosts: int
     max_phases: int  # P
     max_sends: int  # K
+    flow_src: np.ndarray | None = None  # [F] int32 (-1 = pad slot)
+    flow_dst: np.ndarray | None = None  # [F] int32
+    flow_bytes: np.ndarray | None = None  # [F] int32
+    lane_flow: np.ndarray | None = None  # [N, P, K] int32 (-1 = none)
 
 
 class _Builder:
@@ -224,6 +235,40 @@ _COMPILERS = {
 }
 
 
+def _lower_flows(prog: TrafficProgram) -> TrafficProgram:
+    """Enumerate the program's flows (``transport: flows``): one flow
+    per distinct (src host, dst host, bytes) send triple, ids assigned
+    in deterministic first-use order over (host, phase, lane) — a pure
+    function of the program tables, so the flow layout rides the
+    program digest. Fills `flow_src`/`flow_dst`/`flow_bytes` plus the
+    `lane_flow` bridge. One segment = one message of the triple's
+    byte size, so the phase dependency counts carry over unchanged.
+
+    NOTE the per-lane ``send_delay`` does NOT survive the flow
+    transport: emission is window-quantized by the flow plane's
+    cwnd-gated window, so sub-window think/burst offsets quantize to
+    the emission window (docs/workloads.md determinism contract)."""
+    N, P, K = prog.send_peer.shape
+    ids: dict[tuple[int, int, int], int] = {}
+    lane_flow = np.full((N, P, K), -1, np.int32)
+    for h in range(N):
+        for p in range(int(prog.n_phases[h])):
+            for k in range(K):
+                peer = int(prog.send_peer[h, p, k])
+                if peer < 0:
+                    continue
+                key = (h, peer, int(prog.send_bytes[h, p, k]))
+                lane_flow[h, p, k] = ids.setdefault(key, len(ids))
+    F = max(1, len(ids))  # >= 1 pad slot: zero-size arrays trace badly
+    src = np.full((F,), -1, np.int32)
+    dst = np.full((F,), -1, np.int32)
+    nbytes = np.zeros((F,), np.int32)
+    for (h, peer, by), f in ids.items():
+        src[f], dst[f], nbytes[f] = h, peer, by
+    return prog._replace(flow_src=src, flow_dst=dst, flow_bytes=nbytes,
+                         lane_flow=lane_flow)
+
+
 def compile_program(spec: ScenarioSpec) -> TrafficProgram:
     """Lower a validated scenario to its traffic program. Each pattern
     instance draws from its own `default_rng((seed, index))` substream,
@@ -241,12 +286,17 @@ def compile_program(spec: ScenarioSpec) -> TrafficProgram:
             f"egress_cap={spec.egress_cap} — the append would be "
             f"guaranteed to overflow; raise egress_cap or shrink the "
             f"fan-out/burst")
+    if spec.transport == "flows":
+        prog = _lower_flows(prog)
     return prog
 
 
 def program_digest(prog: TrafficProgram) -> str:
     """sha256 over the program tables — the compile-determinism pin:
-    equal (spec, seed) must produce byte-equal tables."""
+    equal (spec, seed) must produce byte-equal tables. Flow tables
+    (``transport: flows``) fold in only when present, so every
+    direct-transport program's digest is unchanged by their
+    existence."""
     h = hashlib.sha256()
     for arr in prog[:6]:
         a = np.asarray(arr)
@@ -255,4 +305,11 @@ def program_digest(prog: TrafficProgram) -> str:
         h.update(a.tobytes())
     h.update(f"{prog.n_hosts}/{prog.max_phases}/{prog.max_sends}"
              .encode())
+    if prog.flow_src is not None:
+        for arr in (prog.flow_src, prog.flow_dst, prog.flow_bytes,
+                    prog.lane_flow):
+            a = np.asarray(arr)
+            h.update(str(a.dtype).encode())
+            h.update(str(a.shape).encode())
+            h.update(a.tobytes())
     return h.hexdigest()

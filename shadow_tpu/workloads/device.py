@@ -133,14 +133,38 @@ def _emit(state, ws: WorkloadState, valid, peer, nbytes, delay, *,
     return state_out, extras, ws
 
 
+def _lane_flows(ft, phase, entered):
+    """[N, K] flow ids of each host's `phase` send lanes (the
+    ``transport: flows`` bridge: `FlowTables.lane_flow` gathered
+    exactly like `_phase_sends` gathers the send tables)."""
+    idx = jnp.clip(phase, 0, ft.lane_flow.shape[1] - 1)[:, None, None]
+    lf = jnp.take_along_axis(ft.lane_flow, idx, axis=1)[:, 0, :]
+    return jnp.where(entered[:, None], lf, -1)
+
+
 def prime(wl: WorkloadArrays, ws: WorkloadState, state, *,
-          metrics=None, guards=None):
+          metrics=None, guards=None, flows=None):
     """Emit every participant's phase-0 sends (drivers call this once
     before the first window; hosts start IN phase 0). Returns
-    (state', ws'[, metrics'][, guards']) like `workload_step`."""
+    (state', ws'[, metrics'][, guards']) like `workload_step`.
+
+    ``flows=(ft, fs)`` (the flow transport) ENQUEUES the sends onto
+    their flows instead of emitting raw packets — the driver follows
+    with one `flows.flow_emit` so the cwnd-gated window goes out
+    before window 0. The return becomes (state, ws, fs'[, metrics']
+    [, guards']) with state/metrics/guards passed through untouched
+    (enqueue writes flow state only)."""
     entered = wl.n_phases > 0
-    valid, peer, nbytes, delay = _phase_sends(
-        wl, jnp.zeros_like(ws.phase), entered)
+    phase0 = jnp.zeros_like(ws.phase)
+    valid, peer, nbytes, delay = _phase_sends(wl, phase0, entered)
+    if flows is not None:
+        from ..tpu import flows as flows_mod
+
+        ft, fs = flows
+        fs = flows_mod.enqueue(ft, fs, _lane_flows(ft, phase0, entered),
+                               valid)
+        extras = tuple(p for p in (metrics, guards) if p is not None)
+        return (state, ws, fs, *extras)
     state, extras, ws = _emit(state, ws, valid, peer, nbytes, delay,
                               metrics=metrics, guards=guards)
     return (state, ws, *extras)
@@ -149,7 +173,7 @@ def prime(wl: WorkloadArrays, ws: WorkloadState, state, *,
 def workload_step(wl: WorkloadArrays, ws: WorkloadState, state,
                   delivered, round_idx, window_ns, *,
                   max_advance: int = MAX_ADVANCE,
-                  metrics=None, guards=None):
+                  metrics=None, guards=None, flows=None):
     """Advance the generator by one window and emit the next sends.
 
     `delivered` is `window_step`'s released dict for THIS window;
@@ -158,9 +182,23 @@ def workload_step(wl: WorkloadArrays, ws: WorkloadState, state,
     the driver's window counter (stamps `done_win`); `window_ns`
     decrements the hold clocks. Returns
     (state', ws'[, metrics'][, guards']) — the same presence-switch
-    return discipline as `ingest_rows`."""
+    return discipline as `ingest_rows`.
+
+    ``flows=(ft, fs, credits)`` switches the generator onto the flow
+    transport (docs/robustness.md "Flow plane"): phase credits are
+    the `credits` vector `flows.flow_recv` computed — ACKED in-order
+    segments, never raw deliveries, so a duplicate from a spurious
+    retransmit can never double-credit a phase — and the emission
+    ENQUEUES segments onto their flows (`flows.enqueue`) for the
+    driver's following `flow_emit` instead of appending raw packets.
+    The return becomes (state, ws', fs'[, metrics'][, guards']) with
+    state/metrics/guards passed through untouched."""
     N, P = wl.dep.shape
-    got = delivered["mask"].sum(axis=1, dtype=jnp.int32)
+    if flows is not None:
+        ft, fs, credits = flows
+        got = credits
+    else:
+        got = delivered["mask"].sum(axis=1, dtype=jnp.int32)
     recv_acc = ws.recv_acc + got
     hold_left = jnp.maximum(ws.hold_left - jnp.int32(window_ns), 0)
     phase = ws.phase
@@ -187,12 +225,21 @@ def workload_step(wl: WorkloadArrays, ws: WorkloadState, state,
                                        axis=1)[:, 0]
         hold_left = jnp.where(entered, hold_new, hold_left)
         lanes.append(_phase_sends(wl, phase, entered))
+        if flows is not None:
+            lanes[-1] = (*lanes[-1], _lane_flows(ft, phase, entered))
     valid = jnp.concatenate([ln[0] for ln in lanes], axis=1)
     peer = jnp.concatenate([ln[1] for ln in lanes], axis=1)
     nbytes = jnp.concatenate([ln[2] for ln in lanes], axis=1)
     delay = jnp.concatenate([ln[3] for ln in lanes], axis=1)
     ws = ws._replace(phase=phase, recv_acc=recv_acc,
                      hold_left=hold_left, done_win=done_win)
+    if flows is not None:
+        from ..tpu import flows as flows_mod
+
+        lf = jnp.concatenate([ln[4] for ln in lanes], axis=1)
+        fs = flows_mod.enqueue(ft, fs, lf, valid)
+        extras = tuple(p for p in (metrics, guards) if p is not None)
+        return (state, ws, fs, *extras)
     state, extras, ws = _emit(state, ws, valid, peer, nbytes, delay,
                               metrics=metrics, guards=guards)
     return (state, ws, *extras)

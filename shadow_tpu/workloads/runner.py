@@ -1,11 +1,15 @@
 """The scenario corpus runner: execute, digest, and report workloads.
 
 Drives a compiled scenario through the device plane: a deterministic
-scenario world (lossless — the phase machine has no retransmit layer,
-so a lost dependency would stall a collective forever; loss/fault
-behavior is exercised by threading the fault plane instead), the
-window loop composing `window_step` + `workload_step`, and a JSON
-record per scenario carrying:
+scenario world, the window loop composing `window_step` +
+`workload_step`, and a JSON record per scenario carrying the fields
+below. Worlds are lossless by default; a scenario that declares
+``transport: flows`` runs the device flow plane (`tpu/flows.py`:
+cwnd/RTO/go-back-N retransmit) under its declared ``loss_p`` — the
+lossy half of the corpus, where phases credit ACKED in-order segments
+and lost dependencies are retransmitted instead of stalling the
+collective (docs/robustness.md "Flow plane"; direct-transport
+scenarios with loss_p > 0 are refused at parse). Each record carries:
 
 - the scenario ``fingerprint`` (pure function of (spec, seed)) and
   ``program_digest`` (the compiled tables);
@@ -61,15 +65,17 @@ def digest_pytrees(*pytrees) -> str:
 
 def build_scenario_world(spec: ScenarioSpec):
     """Deterministic net-plane world for a scenario: host-pair latency
-    table seeded from the scenario seed, zero loss, 10 Gbit hosts,
-    full initial token buckets. Returns (state, params)."""
+    table seeded from the scenario seed, the spec's uniform ``loss_p``
+    (zero by default; parse-time validation requires ``transport:
+    flows`` for anything else), 10 Gbit hosts, full initial token
+    buckets. Returns (state, params)."""
     from ..tpu import make_params, make_state
 
     N = spec.n_hosts
     rng = np.random.default_rng([spec.seed, 0x57A7])
     lat = rng.integers(1 * MS, 5 * MS, size=(N, N), dtype=np.int32)
     lat = np.minimum(lat, lat.T)
-    loss = np.zeros((N, N), np.float32)
+    loss = np.full((N, N), spec.loss_p, np.float32)
     bw = np.full((N,), 10_000_000_000, np.int64)
     params = make_params(lat, loss, bw)
     state = make_state(N, egress_cap=spec.egress_cap,
@@ -135,7 +141,9 @@ def run_scenario(spec: ScenarioSpec, *,
                  sample_every: Optional[int] = None,
                  trace_ring: int = 4096,
                  hops_sink=None,
-                 max_advance: Optional[int] = None) -> dict:
+                 max_advance: Optional[int] = None,
+                 flow_emit_cap: Optional[int] = None,
+                 flow_recv_wnd: Optional[int] = None) -> dict:
     """Execute one scenario for its full window budget. Returns the
     JSON-ready record (no wall-clock anywhere — byte-stable across
     runs by construction).
@@ -164,6 +172,28 @@ def run_scenario(spec: ScenarioSpec, *,
     wl = wdevice.to_device(prog)
     ws = wdevice.make_workload_state(prog)
     N = spec.n_hosts
+    use_flows = spec.transport == "flows"
+    ftab = flowst = None
+    emit_cap = recv_wnd = 0
+    if use_flows:
+        from ..tpu import flows as flowsmod
+
+        # the `flows:` config-block knobs arrive here (run_scenarios
+        # --config plumbs cfg.flows through); None = module defaults
+        emit_cap = (flow_emit_cap if flow_emit_cap is not None
+                    else flowsmod.EMIT_CAP)
+        recv_wnd = (flow_recv_wnd if flow_recv_wnd is not None
+                    else flowsmod.RECV_WND)
+        if emit_cap < 1 or recv_wnd < 1 or emit_cap > recv_wnd:
+            raise ValueError(
+                f"flow knobs out of range: emit_cap={emit_cap} must be "
+                f">= 1 and <= recv_wnd={recv_wnd} (the config block's "
+                "validation rule, core/config.py)")
+        ftab = flowsmod.make_flow_tables(
+            prog.flow_src, prog.flow_dst, prog.flow_bytes,
+            prog.lane_flow)
+        flowst = flowsmod.make_flow_state(prog.flow_src.shape[0],
+                                          recv_wnd=recv_wnd)
     metrics = make_metrics(N)
     gstate = make_guards(N) if guards else None
     hstate = histo.make_histograms(N) if histograms else None
@@ -176,6 +206,12 @@ def run_scenario(spec: ScenarioSpec, *,
     schedule = fault_events
     if schedule is None and use_default_faults:
         schedule = default_fault_schedule(spec)
+    if mesh_devices is not None and use_flows:
+        raise ValueError(
+            "transport: flows does not support --shard yet: the flow "
+            "axis is flow-major, not host-major, and its credit "
+            "scatter-adds need the cross-shard reduction the "
+            "ROADMAP-2 shard_map cut will bring")
     if mesh_devices is not None:
         from ..tpu import make_mesh, shard_state
 
@@ -191,7 +227,17 @@ def run_scenario(spec: ScenarioSpec, *,
             hstate = _shard_host_axis(hstate, mesh)
         # the flight-recorder ring is [R] (not host-major) and stays
         # replicated; the partitioner gathers the sampled events
-    state, ws, metrics = wdevice.prime(wl, ws, state, metrics=metrics)
+    if use_flows:
+        # prime enqueues phase-0 sends onto their flows; one flow_emit
+        # puts the first cwnd-gated window on the wire before window 0
+        # (exactly when the direct-mode prime emission would land)
+        state, ws, flowst, metrics = wdevice.prime(
+            wl, ws, state, metrics=metrics, flows=(ftab, flowst))
+        state, flowst, metrics = flowsmod.flow_emit(
+            ftab, flowst, state, emit_cap=emit_cap, metrics=metrics)
+    else:
+        state, ws, metrics = wdevice.prime(wl, ws, state,
+                                           metrics=metrics)
     rng_root = jax.random.key(spec.seed)
     window = jnp.int32(spec.window_ns)
     adv = max_advance if max_advance is not None else wdevice.MAX_ADVANCE
@@ -200,7 +246,7 @@ def run_scenario(spec: ScenarioSpec, *,
     from ..tpu import elastic as _elastic
 
     def round_fn(carry, xs):
-        state, ws, metrics, gstate, hstate, fstate = carry
+        state, ws, metrics, gstate, hstate, fstate, flowst = carry
         if faulted:
             ridx, faults = xs
         else:
@@ -213,18 +259,47 @@ def run_scenario(spec: ScenarioSpec, *,
         (state, delivered, _next), metrics, gstate, hstate, fstate = \
             unpack_planes(out, metrics=metrics, guards=gstate,
                           hist=hstate, flightrec=fstate)
-        out = wdevice.workload_step(
-            wl, ws, state, delivered, ridx, window, max_advance=adv,
-            metrics=metrics, guards=gstate)
-        if gstate is not None:
-            state, ws, metrics, gstate = out
+        if use_flows:
+            # the split-form flow loop (tpu/flows.py): credit ACKED
+            # in-order arrivals, advance the phase machine on those
+            # credits, enqueue its sends onto their flows, then emit
+            # the cwnd-gated window (+ retransmits + delayed acks)
+            # through the normal ingest path
+            flowst, credits = flowsmod.flow_recv(ftab, flowst,
+                                                 delivered, window)
+            wout = wdevice.workload_step(
+                wl, ws, state, delivered, ridx, window,
+                max_advance=adv, metrics=metrics, guards=gstate,
+                flows=(ftab, flowst, credits))
+            if gstate is not None:
+                state, ws, flowst, metrics, gstate = wout
+            else:
+                state, ws, flowst, metrics = wout
+            eout = flowsmod.flow_emit(ftab, flowst, state,
+                                      emit_cap=emit_cap,
+                                      metrics=metrics, guards=gstate,
+                                      flightrec=fstate)
+            state, flowst = eout[0], eout[1]
+            rest = list(eout[2:])
+            metrics = rest.pop(0)
+            if gstate is not None:
+                gstate = rest.pop(0)
+            if fstate is not None:
+                fstate = rest.pop(0)
         else:
-            state, ws, metrics = out
-        return (state, ws, metrics, gstate, hstate, fstate), None
+            wout = wdevice.workload_step(
+                wl, ws, state, delivered, ridx, window,
+                max_advance=adv, metrics=metrics, guards=gstate)
+            if gstate is not None:
+                state, ws, metrics, gstate = wout
+            else:
+                state, ws, metrics = wout
+        return (state, ws, metrics, gstate, hstate, fstate,
+                flowst), None
 
     @jax.jit
-    def chain(state, ws, metrics, gstate, hstate, fstate, rids,
-              faults_stack):
+    def chain(state, ws, metrics, gstate, hstate, fstate, flowst,
+              rids, faults_stack):
         # K windows device-resident per dispatch (the shared driver's
         # contract): the fault-mask stack rides as per-round scan
         # inputs, every presence plane rides the carry — bitwise
@@ -232,7 +307,8 @@ def run_scenario(spec: ScenarioSpec, *,
         # telemetry harvest instead of once per window
         xs = (rids, faults_stack) if faulted else rids
         carry, _ = jax.lax.scan(
-            round_fn, (state, ws, metrics, gstate, hstate, fstate), xs)
+            round_fn, (state, ws, metrics, gstate, hstate, fstate,
+                       flowst), xs)
         return carry
 
     def per_round(r0, r1):
@@ -243,16 +319,17 @@ def run_scenario(spec: ScenarioSpec, *,
         return jax.tree.map(lambda *xs: jnp.stack(xs), *stack)
 
     def chain_fn(state, extras, rids, faults_stack):
-        ws, metrics, gstate, hstate, fstate = extras
-        state, ws, metrics, gstate, hstate, fstate = chain(
-            state, ws, metrics, gstate, hstate, fstate, rids,
+        ws, metrics, gstate, hstate, fstate, flowst = extras
+        state, ws, metrics, gstate, hstate, fstate, flowst = chain(
+            state, ws, metrics, gstate, hstate, fstate, flowst, rids,
             faults_stack)
-        return state, (ws, metrics, gstate, hstate, fstate), 0, 0
+        return state, (ws, metrics, gstate, hstate, fstate,
+                       flowst), 0, 0
 
     annotated = [0]
 
     def on_chain(r1, state, extras):
-        ws, metrics, gstate, hstate, fstate = extras
+        ws, metrics, gstate, hstate, fstate, flowst = extras
         if r1 % telemetry_every == 0:
             if telemetry is not None:
                 annotated[0] = _annotate_phases(
@@ -264,13 +341,13 @@ def run_scenario(spec: ScenarioSpec, *,
 
     need_cadence = telemetry is not None or recorder is not None
     state, extras = _elastic.drive_chained_windows(
-        state, (ws, metrics, gstate, hstate, fstate), chain_fn,
+        state, (ws, metrics, gstate, hstate, fstate, flowst), chain_fn,
         n_rounds=spec.windows,
         chain_len=telemetry_every if need_cadence else spec.windows,
         per_round=per_round if faulted else None,
         window_ns=spec.window_ns,
         on_chain=on_chain if need_cadence else None)
-    ws, metrics, gstate, hstate, fstate = extras
+    ws, metrics, gstate, hstate, fstate, flowst = extras
 
     jax.block_until_ready(state)
     done_win = wdevice.completion_windows(ws)
@@ -286,8 +363,13 @@ def run_scenario(spec: ScenarioSpec, *,
         "window_ns": spec.window_ns,
         "phases": prog.max_phases,
         "faults_active": faulted,
+        "transport": spec.transport,
+        # flow worlds fold the per-flow state into the comparison key:
+        # a retransmit-schedule divergence must fail the golden gate
+        # even when the net-plane state happens to converge
         "canonical_digest": digest_pytrees(
-            elastic.canonical_state(state), ws),
+            elastic.canonical_state(state), ws,
+            *((flowst,) if use_flows else ())),
         "all_done": bool(np.asarray(
             jax.device_get(ws.phase) >= prog.n_phases).all()),
         "completed_hosts": int(
@@ -304,8 +386,15 @@ def run_scenario(spec: ScenarioSpec, *,
             "loss": int(np.asarray(m.drop_loss).sum()),
             "fault": int(np.asarray(m.drop_fault).sum()),
         },
+        "retransmits": int(np.asarray(m.retransmits)
+                           .astype(np.int64).sum()),
         **completion,
     }
+    if use_flows:
+        record["flows"] = {
+            **flowsmod.flow_totals(ftab, flowst),
+            "emit_cap": emit_cap, "recv_wnd": recv_wnd,
+        }
     if gstate is not None:
         record["guards"] = summarize(gstate)
     if hstate is not None:

@@ -120,7 +120,15 @@ class PatternSpec:
 
 @dataclass(frozen=True)
 class ScenarioSpec:
-    """A validated scenario: fleet shape + pattern instances."""
+    """A validated scenario: fleet shape + pattern instances.
+
+    ``transport`` selects how pattern sends reach the wire:
+    ``direct`` (default) emits raw packets and credits phases on raw
+    deliveries — the lossless contract; ``flows`` routes every send
+    through the device flow plane (`tpu/flows.py`: cwnd/RTO/go-back-N
+    retransmit), phases credit ACKED in-order segments, and the
+    scenario may declare a non-zero uniform ``loss_p`` — the lossy
+    half of the corpus (docs/robustness.md "Flow plane")."""
 
     name: str
     family: str  # the headline pattern family (corpus bookkeeping)
@@ -130,16 +138,26 @@ class ScenarioSpec:
     window_ns: int
     egress_cap: int
     ingress_cap: int
+    transport: str = "direct"  # direct | flows
+    loss_p: float = 0.0  # uniform path-loss probability
     patterns: tuple[PatternSpec, ...] = field(default_factory=tuple)
 
     def as_dict(self) -> dict:
-        return {
+        d = {
             "name": self.name, "family": self.family, "seed": self.seed,
             "hosts": self.n_hosts, "windows": self.windows,
             "window_ns": self.window_ns, "egress_cap": self.egress_cap,
             "ingress_cap": self.ingress_cap,
             "patterns": [p.as_dict() for p in self.patterns],
         }
+        # non-default transport/loss keys only: the canonical
+        # serialization (and therefore every existing fingerprint)
+        # must not change under a default-valued new field
+        if self.transport != "direct":
+            d["transport"] = self.transport
+        if self.loss_p:
+            d["loss_p"] = self.loss_p
+        return d
 
 
 def _parse_pattern(raw: Any, idx: int, n_hosts: int) -> PatternSpec:
@@ -215,7 +233,8 @@ def parse_scenario(raw: Any, *, seed: Optional[int] = None) -> ScenarioSpec:
         raise ScenarioError(
             f"scenario: expected a mapping, got {type(raw).__name__}")
     known = {"name", "family", "seed", "hosts", "windows", "window_ns",
-             "egress_cap", "ingress_cap", "patterns"}
+             "egress_cap", "ingress_cap", "patterns", "transport",
+             "loss_p"}
     unknown = set(map(str, raw)) - known
     if unknown:
         raise ScenarioError(f"scenario: unknown option(s) "
@@ -236,6 +255,27 @@ def parse_scenario(raw: Any, *, seed: Optional[int] = None) -> ScenarioSpec:
                           lo=1, hi=1 << 16)
     ingress_cap = _req_int(raw, "ingress_cap", "scenario", default=32,
                            lo=1, hi=1 << 16)
+    transport = raw.get("transport", "direct")
+    if transport not in ("direct", "flows"):
+        raise ScenarioError(
+            f"scenario: transport expected direct|flows, got "
+            f"{transport!r}")
+    loss_p = _req_float(raw, "loss_p", "scenario", default=0.0,
+                        lo=0.0, hi=0.9)
+    if loss_p > 0 and transport != "flows":
+        # the lossless caveat, now ENFORCED instead of documented: the
+        # direct phase machine has no retransmit layer, so a lost
+        # dependency would stall a collective forever
+        raise ScenarioError(
+            f"scenario: loss_p={loss_p} requires `transport: flows` — "
+            "direct-transport phases credit raw deliveries and have "
+            "no retransmit layer, so any loss stalls the scenario "
+            "(docs/robustness.md 'Flow plane')")
+    if transport == "flows" and window_ns < 1_000_000:
+        raise ScenarioError(
+            f"scenario: `transport: flows` needs window_ns >= 1ms "
+            f"(got {window_ns}): the flow plane's RTO clock advances "
+            "in whole milliseconds per window (tpu/flows.py)")
     raw_patterns = raw.get("patterns")
     if not isinstance(raw_patterns, list) or not raw_patterns:
         raise ScenarioError("scenario: patterns must be a non-empty "
@@ -261,7 +301,8 @@ def parse_scenario(raw: Any, *, seed: Optional[int] = None) -> ScenarioSpec:
     return ScenarioSpec(
         name=name, family=family, seed=spec_seed, n_hosts=n_hosts,
         windows=windows, window_ns=window_ns, egress_cap=egress_cap,
-        ingress_cap=ingress_cap, patterns=patterns)
+        ingress_cap=ingress_cap, transport=transport, loss_p=loss_p,
+        patterns=patterns)
 
 
 def load_scenario_file(path: str, *,

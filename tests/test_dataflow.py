@@ -171,7 +171,8 @@ def test_spec_surface_covers_the_three_kernels_and_planes():
                      "chain_windows[metrics]", "chain_windows[guards]",
                      "chain_windows[workload+metrics+guards]",
                      "ingest_rows[metrics+guards+hist+flightrec]",
-                     "workload_step[append-only]"):
+                     "workload_step[append-only]",
+                     "window_step[flows]", "flow_step[append-only]"):
         assert required in names, required
 
 

@@ -83,6 +83,8 @@ def main(argv=None) -> int:
     from shadow_tpu.workloads import runner
 
     seed_override = None
+    flow_emit_cap = flow_recv_wnd = None
+    flows_enabled = False
     if args.config is not None:
         if args.scenarios:
             ap.error("--config and positional scenarios are mutually "
@@ -104,6 +106,13 @@ def main(argv=None) -> int:
             if not os.path.isabs(cfg.workload.scenario)
             else cfg.workload.scenario]
         seed_override = cfg.workload.seed
+        # the `flows:` block's validated knobs govern flow-transport
+        # scenarios run through this config (docs/robustness.md
+        # "Flow plane"); scenarios without `transport: flows` never
+        # consult them
+        flow_emit_cap = cfg.flows.emit_cap
+        flow_recv_wnd = cfg.flows.recv_wnd
+        flows_enabled = cfg.flows.enabled
     else:
         paths = args.scenarios or sorted(
             glob.glob(os.path.join(CORPUS_DIR, "*.yaml")))
@@ -123,6 +132,16 @@ def main(argv=None) -> int:
     guards_dirty = False
     for path in paths:
         spec = load_scenario_file(path, seed=seed_override)
+        if flows_enabled and spec.transport != "flows":
+            # the config opted into the flow plane but the scenario
+            # governs the transport: say so loudly instead of the
+            # silently-ignored-opt-in failure mode the `flows:` block
+            # exists to prevent (docs/robustness.md "Flow plane")
+            print(f"run_scenarios: flows.enabled is set but scenario "
+                  f"{spec.name!r} declares transport: "
+                  f"{spec.transport} — the flow plane only runs for "
+                  f"`transport: flows` scenarios; this run proceeds "
+                  f"on the direct transport", file=sys.stderr)
         harvester = None
         hops_sink = None
         if args.telemetry:
@@ -143,7 +162,9 @@ def main(argv=None) -> int:
             telemetry=harvester,
             sample_every=args.sample_every,
             trace_ring=args.trace_ring,
-            hops_sink=hops_sink)
+            hops_sink=hops_sink,
+            flow_emit_cap=flow_emit_cap,
+            flow_recv_wnd=flow_recv_wnd)
         if harvester is not None:
             harvester.finalize()
         records.append(rec)
