@@ -12,23 +12,33 @@ Two passes (driven by ``tools/shadowlint.py``):
 - ``dataflow`` + ``proofs`` — the SL5xx dataflow proofs over the same
   traced graphs: SL501 presence-invisibility taint theorems, the SL502
   op-budget ledger (``op_budgets.json``), the SL503 donation-safety
-  AST checks (in ``astlint``), and the SL504 shardability report.
+  AST checks (in ``astlint``), and the SL504 shardability report +
+  row-local fence.
+- ``condeq`` — the SL505 branch-equivalence prover for the gated
+  ``lax.cond``s (structural sort-of-sorted/selection-witness proofs
+  with an exhaustive boundary-lattice fallback).
+- ``ranges`` — the SL506 integer range / bit-budget abstract
+  interpretation with its checked-in input-domain registry.
 
 Plus ``recompile`` — the jit-cache-miss counter harness swept over the
-bench-ladder shapes.
+bench-ladder shapes. All traced passes share one per-process jaxpr
+cache (``jaxpr_audit.traced``).
 
 Rule IDs, invariants, and the suppression syntax live in ``rules`` and
 are documented in ``docs/determinism.md``.
 """
 
 from .astlint import lint_file, lint_source, rule_applies
+from .condeq import GateObligation, check_all_gates, gate_obligations
 from .dataflow import leaf_paths, op_census, propagate_taint, shard_census
 from .jaxpr_audit import (AuditEntry, audit_all, audit_entry, audit_jaxpr,
-                          default_entries)
+                          default_entries, traced)
 from .proofs import (InvisibilitySpec, build_shard_report,
                      check_all_invisibility, check_invisibility,
-                     check_op_budgets, compute_censuses,
-                     invisibility_specs, write_op_budgets)
+                     check_op_budgets, check_row_local_fence,
+                     compute_censuses, invisibility_specs,
+                     write_op_budgets)
+from .ranges import RangeSpec, check_all_ranges, range_specs
 from .recompile import (CompileCounter, LadderShape, ladder_shapes,
                         sweep_window_step)
 from .rules import RULES, Finding, RuleInfo, parse_suppressions
@@ -46,6 +56,7 @@ __all__ = [
     "audit_entry",
     "audit_jaxpr",
     "default_entries",
+    "traced",
     "leaf_paths",
     "op_census",
     "propagate_taint",
@@ -55,9 +66,16 @@ __all__ = [
     "check_all_invisibility",
     "check_invisibility",
     "check_op_budgets",
+    "check_row_local_fence",
     "compute_censuses",
     "invisibility_specs",
     "write_op_budgets",
+    "GateObligation",
+    "check_all_gates",
+    "gate_obligations",
+    "RangeSpec",
+    "check_all_ranges",
+    "range_specs",
     "CompileCounter",
     "LadderShape",
     "ladder_shapes",

@@ -1,0 +1,1045 @@
+"""SL505: build-time equivalence proofs for the gated ``lax.cond``s.
+
+The device plane leans on a handful of `lax.cond` *gates* — conds whose
+two branches are claimed bitwise-equal on the domain where the gate
+selects the fast branch, so the cond can only ever change SPEED, never
+a bit:
+
+- `plane.ingest_rows`' ``gate_idle`` (an entry-free merge is the
+  identity on a front-packed row),
+- the PR-11 ident-vs-sort gates (`plane._compact_ingress`,
+  `plane._egress_order` FIFO: a stable sort of an already-ordered
+  packed key with the column tiebreak IS the identity),
+- the flow plane's idle gates (`flows.flow_recv` / `flows.flow_emit`:
+  a window with no tagged deliveries / no valid emission lanes leaves
+  every field untouched).
+
+Until this pass those contracts were docstring sentences sampled by
+runtime parity tests. Here each registered gate becomes a build-time
+obligation, proved one of three ways (recorded per gate in the report):
+
+1. **syntactic** — the two branch jaxprs are identical after
+   canonicalization (dead-code elimination, constant folding,
+   alpha-renaming). The degenerate-but-cheap case.
+2. **structural** — predicate-assumption proof: the gate predicate is
+   recognized as a sortedness check (``(k[:, :-1] <= k[:, 1:]).all()``
+   over a cond operand), every stable 1-key sort of that operand in a
+   branch is rewritten to the identity (stability + the in-key
+   tiebreak make the permutation the identity on sorted input — the
+   "sort-of-sorted" rewrite), and the remaining branch bodies are
+   proved extensionally equal by a *selection witness*: both branches
+   are evaluated on position-coded operands where every op must be
+   either constant-derived (index arithmetic — concretely folded) or
+   selection-transparent (gather / select_n / reshape / slice /
+   concatenate / broadcast — ops that only COPY operand elements).
+   Equal witness outputs under two independent code bases prove the
+   branches compute the identical selection of their operands, for
+   every input satisfying the predicate.
+3. **exhaustive** — the fallback, clearly marked: the whole entry is
+   evaluated concretely over a registered input lattice (tiny N/CE
+   worlds with boundary values: empty/full rows, 0/1/I32_MAX
+   sentinels, duplicate keys, foreign-tagged traffic) and on every
+   lattice point where the predicate selects the fast branch, both
+   branches must produce bitwise-equal outputs. The lattice must hit
+   the gated domain at least ``min_gated`` times, or the proof fails
+   as vacuous.
+
+A failed proof names the FIRST diverging output leaf (and the lattice
+point that exposed it) — see ``tests/lint_fixtures/fixture_condeq_gate.py``
+for the seeded violation.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from .rules import Finding
+
+try:
+    from jax.extend import core as _core
+except ImportError:  # older jax spells it jax.core
+    from jax import core as _core
+
+__all__ = [
+    "GateObligation",
+    "GateProof",
+    "check_all_gates",
+    "check_gate",
+    "gate_obligations",
+]
+
+I32_MAX = np.int32(2**31 - 1)
+
+
+# --------------------------------------------------------------------------
+# obligation + proof records
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class GateObligation:
+    """One registered `lax.cond` gate.
+
+    ``build`` returns (fn, args) like an AuditEntry; the traced jaxpr
+    must contain exactly one top-level ``cond`` (the gate).
+    ``gate_value`` is the predicate value under which the gate claims
+    branch equivalence (True for the ident-vs-sort gates — ordered
+    input takes the identity branch; False for the idle gates — an
+    empty window takes the identity branch). ``lattice`` returns the
+    exhaustive-fallback input points (arg tuples shaped like
+    ``build``'s args); ``out_names`` labels the cond's output leaves
+    for the diverging-leaf message."""
+
+    name: str
+    module: str
+    build: Callable[[], tuple[Callable, tuple]]
+    gate_value: bool
+    lattice: Callable[[], list[tuple]] | None = None
+    out_names: Callable[[], list[str]] | None = None
+    #: fail the proof unless at least this many lattice points land in
+    #: the gated domain (a lattice that never exercises the gate would
+    #: prove nothing)
+    min_gated: int = 4
+
+
+@dataclass
+class GateProof:
+    """The per-gate verdict for the ``--condeq-report`` artifact."""
+
+    name: str
+    module: str
+    mode: str  # "syntactic" | "structural" | "exhaustive" | "failed"
+    ok: bool
+    detail: str = ""
+    lattice_points: int = 0
+    gated_points: int = 0
+    findings: list[Finding] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "gate": f"{self.module}:{self.name}",
+            "mode": self.mode,
+            "ok": self.ok,
+            "detail": self.detail,
+            "lattice_points": self.lattice_points,
+            "gated_points": self.gated_points,
+        }
+
+
+# --------------------------------------------------------------------------
+# jaxpr utilities: locate the gate, evaluate eagerly
+# --------------------------------------------------------------------------
+
+
+def _raw(jaxpr_like):
+    return getattr(jaxpr_like, "jaxpr", jaxpr_like)
+
+
+def _find_gate(closed):
+    """(eqn_index, eqn) of the single top-level cond."""
+    conds = [(i, e) for i, e in enumerate(_raw(closed).eqns)
+             if e.primitive.name == "cond"]
+    if len(conds) != 1:
+        raise ValueError(
+            f"expected exactly one top-level lax.cond in the gate "
+            f"entry, found {len(conds)} — trace the section helper "
+            "that owns the gate, not a composite kernel")
+    return conds[0]
+
+
+def _eval_eqns(raw, consts, in_vals, *, until=None):
+    """Eager forward evaluation of a (raw) jaxpr via primitive.bind.
+
+    Evaluates equations [0, until) and returns the environment reader;
+    with until=None evaluates everything and returns the output values.
+    """
+    env: dict = {}
+
+    def read(v):
+        if isinstance(v, _core.Literal):
+            return v.val
+        return env[v]
+
+    for var, val in zip(raw.constvars, consts):
+        env[var] = val
+    if len(raw.invars) != len(in_vals):
+        raise ValueError(f"arity mismatch: {len(raw.invars)} invars, "
+                         f"{len(in_vals)} values")
+    for var, val in zip(raw.invars, in_vals):
+        env[var] = val
+
+    stop = len(raw.eqns) if until is None else until
+    for eqn in raw.eqns[:stop]:
+        vals = [read(v) for v in eqn.invars]
+        outs = eqn.primitive.bind(*vals, **eqn.params)
+        if not eqn.primitive.multiple_results:
+            outs = [outs]
+        for var, out in zip(eqn.outvars, outs):
+            env[var] = out
+    if until is None:
+        return [read(v) for v in raw.outvars]
+    return read
+
+
+def _eval_branch(branch_closed, operand_vals):
+    raw = _raw(branch_closed)
+    consts = getattr(branch_closed, "consts", [])
+    return _eval_eqns(raw, consts, list(operand_vals))
+
+
+# --------------------------------------------------------------------------
+# mode 1: syntactic canonical equality
+# --------------------------------------------------------------------------
+
+
+def _canon_param(value) -> str:
+    if isinstance(value, (_core.Jaxpr, _core.ClosedJaxpr)):
+        return f"jaxpr<{_canonical_form(value)}>"
+    if isinstance(value, (tuple, list)):
+        return "(" + ",".join(_canon_param(v) for v in value) + ")"
+    if isinstance(value, np.ndarray):
+        return (f"ndarray<{value.dtype}{value.shape}:"
+                f"{hashlib.sha256(np.ascontiguousarray(value).tobytes()).hexdigest()[:16]}>")
+    return repr(value)
+
+
+def _canon_const(value) -> str:
+    try:
+        return _canon_param(np.asarray(value))
+    except TypeError:  # extended dtypes (PRNG keys) refuse conversion
+        return f"opaque<{type(value).__name__}>"
+
+
+def _live_eqns(raw):
+    """Dead-code elimination: equations whose outputs (transitively)
+    feed the jaxpr outputs, in original order."""
+    needed = {v for v in raw.outvars if not isinstance(v, _core.Literal)}
+    keep = []
+    for eqn in reversed(raw.eqns):
+        if any(v in needed for v in eqn.outvars):
+            keep.append(eqn)
+            for v in eqn.invars:
+                if not isinstance(v, _core.Literal):
+                    needed.add(v)
+    keep.reverse()
+    return keep
+
+
+def _canonical_form(jaxpr_like) -> str:
+    """Alpha-renamed, dead-code-eliminated textual form. Constants fold
+    implicitly: a literal renders by value, and consts render by their
+    byte hash, so two branches differing only in var names or dead
+    equations canonicalize identically."""
+    raw = _raw(jaxpr_like)
+    consts = list(getattr(jaxpr_like, "consts", []))
+    names: dict = {}
+
+    def ref(v):
+        if isinstance(v, _core.Literal):
+            return f"lit:{_canon_const(v.val)}"
+        if v not in names:
+            names[v] = f"v{len(names)}"
+        return names[v]
+
+    lines = []
+    for var, const in zip(raw.constvars, consts):
+        lines.append(f"const {ref(var)} = {_canon_const(const)}")
+    for var in raw.invars:
+        lines.append(f"in {ref(var)} : {var.aval.str_short()}")
+    for eqn in _live_eqns(raw):
+        params = ",".join(f"{k}={_canon_param(v)}"
+                          for k, v in sorted(eqn.params.items()))
+        ins = ",".join(ref(v) for v in eqn.invars)
+        outs = ",".join(ref(v) for v in eqn.outvars)
+        lines.append(f"{outs} = {eqn.primitive.name}[{params}]({ins})")
+    lines.append("out " + ",".join(ref(v) for v in raw.outvars))
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# mode 2: predicate assumptions + sort elimination + selection witness
+# --------------------------------------------------------------------------
+
+
+def _full_slice(start, limit, strides, shape, axis):
+    """True when the slice spans every axis fully except `axis`."""
+    if strides is not None and any(s != 1 for s in strides):
+        return False
+    for d, (s, l, n) in enumerate(zip(start, limit, shape)):
+        if d == axis:
+            continue
+        if s != 0 or l != n:
+            return False
+    return True
+
+
+def _sorted_assumptions(raw, gate_eqn):
+    """Operand indices the predicate asserts are sorted.
+
+    Recognizes the in-tree gate pattern: the cond's index operand
+    derives (through convert_element_type) from
+    ``reduce_and(le(slice(x, ..axis window [0, C-1]),
+    slice(x, ..axis window [1, C])))`` — pairwise-adjacent
+    non-decreasing along `axis`, which is sortedness. Returns
+    {operand_position: axis} for operands x that are passed to the
+    branches."""
+    producers = {}
+    for eqn in raw.eqns:
+        for v in eqn.outvars:
+            producers[v] = eqn
+
+    def producer(v):
+        return None if isinstance(v, _core.Literal) else producers.get(v)
+
+    idx_eqn = producer(gate_eqn.invars[0])
+    while idx_eqn is not None and idx_eqn.primitive.name in (
+            "convert_element_type", "copy"):
+        idx_eqn = producer(idx_eqn.invars[0])
+    if idx_eqn is None or idx_eqn.primitive.name != "reduce_and":
+        return {}
+    le_eqn = producer(idx_eqn.invars[0])
+    if le_eqn is None or le_eqn.primitive.name != "le":
+        return {}
+    lo_eqn, hi_eqn = (producer(le_eqn.invars[0]),
+                      producer(le_eqn.invars[1]))
+    if not (lo_eqn and hi_eqn) or lo_eqn.primitive.name != "slice" \
+            or hi_eqn.primitive.name != "slice":
+        return {}
+    if lo_eqn.invars[0] is not hi_eqn.invars[0]:
+        return {}
+    x = lo_eqn.invars[0]
+    shape = tuple(x.aval.shape)
+    lo_p, hi_p = lo_eqn.params, hi_eqn.params
+    axis = None
+    for d, n in enumerate(shape):
+        if (lo_p["start_indices"][d] == 0
+                and lo_p["limit_indices"][d] == n - 1
+                and hi_p["start_indices"][d] == 1
+                and hi_p["limit_indices"][d] == n):
+            axis = d
+            break
+    if axis is None:
+        return {}
+    if not (_full_slice(lo_p["start_indices"], lo_p["limit_indices"],
+                        lo_p.get("strides"), shape, axis)
+            and _full_slice(hi_p["start_indices"],
+                            hi_p["limit_indices"],
+                            hi_p.get("strides"), shape, axis)):
+        return {}
+    # x must reach the branches as an operand (invars[1:] of the cond)
+    out = {}
+    for pos, v in enumerate(gate_eqn.invars[1:]):
+        if v is x:
+            out[pos] = axis
+    return out
+
+
+#: ops that only COPY operand elements (or insert constants) — safe to
+#: apply to position-coded witnesses; indices/predicates must be
+#: constant-derived
+_SELECTION_PRIMS = frozenset({
+    "gather", "select_n", "reshape", "broadcast_in_dim", "transpose",
+    "slice", "squeeze", "concatenate", "rev", "expand_dims", "copy",
+    "pad", "dynamic_slice",
+})
+
+
+#: call-like primitives the witness evaluator descends through
+#: (take_along_axis and jnp.where trace as pjit wrappers)
+_WITNESS_CALLS = ("pjit", "closed_call", "core_call", "custom_jvp_call",
+                  "custom_vjp_call")
+
+
+class _WitnessFail(Exception):
+    pass
+
+
+def _witness_run(jaxpr_like, in_vals, in_coded, sorted_vars):
+    """One (sub-)jaxpr pass of the selection-witness evaluation:
+    values are concrete numpy arrays, `coded` flags mark values derived
+    from operand position codes. Returns (out_vals, out_coded)."""
+    raw = _raw(jaxpr_like)
+    consts = list(getattr(jaxpr_like, "consts", []))
+    env: dict = {}
+    coded: dict = {}
+
+    def read(v):
+        if isinstance(v, _core.Literal):
+            return np.asarray(v.val)
+        return env[v]
+
+    def is_coded(v):
+        return (not isinstance(v, _core.Literal)) and coded.get(v, False)
+
+    for var, const in zip(raw.constvars, consts):
+        env[var] = np.asarray(const)
+        coded[var] = False
+    for var, val, c in zip(raw.invars, in_vals, in_coded):
+        env[var] = np.asarray(val)
+        coded[var] = c
+
+    for eqn in raw.eqns:
+        name = eqn.primitive.name
+        ins = [read(v) for v in eqn.invars]
+        ins_coded = [is_coded(v) for v in eqn.invars]
+
+        if name == "sort":
+            key_var = eqn.invars[0]
+            if (key_var in sorted_vars
+                    and eqn.params.get("num_keys") == 1
+                    and eqn.params.get("is_stable")
+                    and eqn.params.get("dimension")
+                    == sorted_vars[key_var]):
+                # sort-of-sorted: stability + the in-key tiebreak make
+                # the permutation the identity on sorted keys, so the
+                # outputs are the operands verbatim
+                outs = list(ins)
+                out_coded = list(ins_coded)
+            else:
+                raise _WitnessFail(
+                    "sort without a predicate sortedness assumption")
+        elif name in _WITNESS_CALLS:
+            from .dataflow import _first_sub_jaxpr
+
+            sub = _first_sub_jaxpr(eqn.params)
+            if sub is None or len(_raw(sub).invars) != len(ins):
+                raise _WitnessFail(
+                    f"call-like `{name}` the witness cannot map 1:1")
+            outs, out_coded = _witness_run(sub, ins, ins_coded,
+                                           sorted_vars)
+            outs = outs[:len(eqn.outvars)]
+            out_coded = out_coded[:len(eqn.outvars)]
+        elif not any(ins_coded):
+            # constant-derived (index arithmetic): fold concretely
+            outs = eqn.primitive.bind(*[np.asarray(v) for v in ins],
+                                      **eqn.params)
+            if not eqn.primitive.multiple_results:
+                outs = [outs]
+            out_coded = [False] * len(outs)
+        elif name in _SELECTION_PRIMS:
+            # coded data may only ride the DATA slots: indices
+            # (gather/dynamic_slice trailing args) and select_n's
+            # predicate must be constant-derived
+            if name in ("gather", "dynamic_slice") and any(
+                    ins_coded[1:]):
+                raise _WitnessFail(f"{name} with coded indices")
+            if name == "select_n" and ins_coded[0]:
+                raise _WitnessFail("select_n with a coded predicate")
+            params = eqn.params
+            if name == "gather" and params.get("fill_value") is not None:
+                # bool operands were re-typed to int32 codes; keep the
+                # fill binding-compatible (fill positions are index-
+                # determined and compare by value across branches)
+                params = dict(params)
+                fv = params["fill_value"]
+                params["fill_value"] = np.int32(
+                    int(bool(fv)) if isinstance(fv, (bool, np.bool_))
+                    else int(fv))
+            outs = eqn.primitive.bind(
+                *[np.asarray(v) for v in ins], **params)
+            if not eqn.primitive.multiple_results:
+                outs = [outs]
+            out_coded = [True] * len(outs)
+        else:
+            raise _WitnessFail(
+                f"non-selection primitive `{name}` touches coded data")
+        for var, out, c in zip(eqn.outvars, outs, out_coded):
+            env[var] = np.asarray(out)
+            coded[var] = c
+
+    return ([read(v) for v in raw.outvars],
+            [is_coded(v) for v in raw.outvars])
+
+
+def _witness_codes(gate_eqn, code_base):
+    """Position-coded witness values for the cond operands.
+
+    jax unions the two branches' closures WITHOUT dedup, so the same
+    parent value can appear at several operand positions — those
+    positions must carry IDENTICAL codes (the branches are compared as
+    functions of the distinct parent values, not of the positional
+    slots)."""
+    vals: list = []
+    by_parent: dict[int, np.ndarray] = {}
+    next_code = code_base
+    for v in gate_eqn.invars[1:]:
+        if not isinstance(v, _core.Literal) and id(v) in by_parent:
+            vals.append(by_parent[id(v)])
+            continue
+        aval = v.aval
+        shape = tuple(aval.shape)
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        dt = str(aval.dtype)
+        if dt not in ("bool", "int32", "uint32", "int8", "int16"):
+            raise _WitnessFail(f"operand dtype {dt} not codeable")
+        codes = np.arange(next_code, next_code + n,
+                          dtype=np.int32).reshape(shape)
+        next_code += n
+        vals.append(codes)
+        if not isinstance(v, _core.Literal):
+            by_parent[id(v)] = codes
+    return vals
+
+
+def _witness_eval(branch_closed, operand_vals, sorted_ops):
+    """Evaluate one branch on position-coded operands (see
+    `_witness_codes`; bool/int32/uint32 operands are substituted with
+    int32 codes of the same shape — selection ops are dtype-generic,
+    so the selection map the codes reveal is the operand's too). Equal
+    witness outputs across branches (under two independent code bases)
+    prove both branches compute the identical selection of their
+    operands, for every input satisfying the predicate assumption."""
+    raw = _raw(branch_closed)
+    sorted_vars = {raw.invars[pos]: axis
+                   for pos, axis in sorted_ops.items()}
+    return _witness_run(branch_closed, list(operand_vals),
+                        [True] * len(operand_vals), sorted_vars)
+
+
+def _structural_proof(gate_eqn, parent_raw):
+    """Try the predicate-assumption structural proof. Returns
+    (ok, detail) — ok=None means 'not applicable, fall back'."""
+    branches = gate_eqn.params["branches"]
+    sorted_ops = _sorted_assumptions(parent_raw, gate_eqn)
+    results = []
+    for base in (10_000_019, 20_000_033):  # two independent code bases
+        try:
+            operand_vals = _witness_codes(gate_eqn, base)
+            pair = [_witness_eval(b, operand_vals, sorted_ops)
+                    for b in branches]
+        except _WitnessFail as exc:
+            return None, str(exc)
+        results.append(pair)
+    for (outs_a, _), (outs_b, _) in results:
+        for i, (a, b) in enumerate(zip(outs_a, outs_b)):
+            if a.shape != b.shape or not np.array_equal(
+                    np.asarray(a), np.asarray(b)):
+                return False, (f"selection witness diverges at output "
+                               f"{i}")
+    assumed = (f"assuming operand(s) {sorted(sorted_ops)} sorted "
+               f"(predicate pattern)" if sorted_ops else "no assumption")
+    return True, f"selection-witness equality; {assumed}"
+
+
+# --------------------------------------------------------------------------
+# mode 3: exhaustive lattice evaluation
+# --------------------------------------------------------------------------
+
+
+def _flatten_args(args):
+    from jax import tree_util
+
+    return tree_util.tree_leaves(args)
+
+
+def _exhaustive_proof(obl: GateObligation, closed, gate_idx, gate_eqn,
+                      names: list[str]):
+    """Evaluate the entry over the lattice; on every gated point both
+    branches must agree bitwise. Returns (ok, gated, total, detail)."""
+    raw = _raw(closed)
+    consts = list(closed.consts)
+    branches = gate_eqn.params["branches"]
+    fast = 1 if obl.gate_value else 0
+
+    points = obl.lattice() if obl.lattice is not None else []
+    if not points:
+        return False, 0, 0, "no lattice registered and structural proof"\
+            " not applicable"
+    gated = 0
+    for p_idx, args in enumerate(points):
+        flat = _flatten_args(args)
+        read = _eval_eqns(raw, consts, flat, until=gate_idx)
+        op_vals = [read(v) for v in gate_eqn.invars]
+        sel = int(np.asarray(op_vals[0]))
+        if sel != fast:
+            continue
+        gated += 1
+        outs_fast = _eval_branch(branches[fast], op_vals[1:])
+        outs_ref = _eval_branch(branches[1 - fast], op_vals[1:])
+        for i, (a, b) in enumerate(zip(outs_fast, outs_ref)):
+            a, b = np.asarray(a), np.asarray(b)
+            if not np.array_equal(a, b):
+                leaf = names[i] if i < len(names) else f"out[{i}]"
+                bad = np.argwhere(a != b)
+                first = tuple(int(x) for x in bad[0]) if bad.size else ()
+                return False, gated, len(points), (
+                    f"branches diverge at output leaf `{leaf}`"
+                    f"{list(first)} on lattice point {p_idx}: "
+                    f"fast={a[first] if first else a!r} "
+                    f"ref={b[first] if first else b!r}")
+    if gated < obl.min_gated:
+        return False, gated, len(points), (
+            f"lattice exercises the gated domain only {gated}x "
+            f"(need >= {obl.min_gated}): the proof would be vacuous")
+    return True, gated, len(points), "bitwise-equal on every gated point"
+
+
+# --------------------------------------------------------------------------
+# the checker
+# --------------------------------------------------------------------------
+
+
+def check_gate(obl: GateObligation, *, trace=None) -> GateProof:
+    """Prove one gate obligation; `trace` short-circuits the build with
+    an already-traced closed jaxpr (the shared proof-pass cache)."""
+    if trace is None:
+        from .jaxpr_audit import traced
+
+        trace = traced(f"{obl.module}:{obl.name}", obl.build)[0]
+    raw = _raw(trace)
+    gate_idx, gate_eqn = _find_gate(trace)
+    branches = gate_eqn.params["branches"]
+    where = f"{obl.module}:{obl.name}"
+    names = obl.out_names() if obl.out_names is not None else []
+
+    # mode 1: canonical syntactic equality
+    if _canonical_form(branches[0]) == _canonical_form(branches[1]):
+        return GateProof(obl.name, obl.module, "syntactic", True,
+                         "branches canonicalize identically")
+
+    # mode 2: predicate-assumption structural proof
+    ok, detail = _structural_proof(gate_eqn, raw)
+    if ok is True:
+        return GateProof(obl.name, obl.module, "structural", True,
+                         detail)
+    if ok is False:
+        proof = GateProof(obl.name, obl.module, "failed", False, detail)
+        proof.findings.append(Finding(
+            "SL505", where, 0, 0,
+            f"branch-equivalence proof failed (structural): {detail} — "
+            "the gate is not bitwise-invisible "
+            "(docs/determinism.md 'Branch gates are theorems')"))
+        return proof
+
+    # mode 3: exhaustive fallback (clearly marked in the report)
+    ok, gated, total, detail = _exhaustive_proof(
+        obl, trace, gate_idx, gate_eqn, names)
+    proof = GateProof(obl.name, obl.module,
+                      "exhaustive" if ok else "failed", ok, detail,
+                      lattice_points=total, gated_points=gated)
+    if not ok:
+        proof.findings.append(Finding(
+            "SL505", where, 0, 0,
+            f"branch-equivalence proof failed (exhaustive): {detail}"))
+    return proof
+
+
+def check_all_gates(obligations=None, *, traces=None
+                    ) -> tuple[list[Finding], list[GateProof]]:
+    findings: list[Finding] = []
+    proofs: list[GateProof] = []
+    for obl in (obligations if obligations is not None
+                else gate_obligations()):
+        trace = (traces or {}).get(f"{obl.module}:{obl.name}")
+        proof = check_gate(obl, trace=trace)
+        proofs.append(proof)
+        findings.extend(proof.findings)
+    return findings, proofs
+
+
+# --------------------------------------------------------------------------
+# the registered gate surface (the real tree)
+# --------------------------------------------------------------------------
+
+
+def _mini_state(rng, n=4, ce=8, ci=8, *, occupancies=None,
+                prio_choices=(0, 1, 5, 5, 1_000, int(I32_MAX) - 1),
+                deliver_sorted=False):
+    """One front-packed NetPlaneState lattice point: per-row occupancy
+    with boundary payload values (0/1/dups/near-sentinel), dead lanes
+    at the make_state defaults."""
+    import jax.numpy as jnp
+
+    from ..tpu import plane
+
+    state = plane.make_state(n, egress_cap=ce, ingress_cap=ci)
+    occ = (occupancies if occupancies is not None
+           else [int(rng.integers(0, ce + 1)) for _ in range(n)])
+    eg_valid = np.zeros((n, ce), bool)
+    eg_prio = np.full((n, ce), int(I32_MAX), np.int64)
+    eg_dst = np.full((n, ce), -1, np.int64)
+    eg_bytes = np.zeros((n, ce), np.int64)
+    eg_seq = np.zeros((n, ce), np.int64)
+    eg_sock = np.zeros((n, ce), np.int64)
+    in_valid = np.zeros((n, ci), bool)
+    in_deliver = np.full((n, ci), int(I32_MAX), np.int64)
+    in_src = np.full((n, ci), -1, np.int64)
+    in_seq = np.zeros((n, ci), np.int64)
+    for row in range(n):
+        k = min(occ[row % len(occ)], ce)
+        eg_valid[row, :k] = True
+        vals = np.sort(rng.choice(prio_choices, size=k))
+        eg_prio[row, :k] = vals
+        eg_dst[row, :k] = rng.integers(0, n, size=k)
+        eg_bytes[row, :k] = rng.choice([0, 1, 64, 1500], size=k)
+        eg_seq[row, :k] = rng.integers(0, 100, size=k)
+        eg_sock[row, :k] = rng.integers(0, 4, size=k)
+        ki = min(occ[row % len(occ)], ci)
+        in_valid[row, :ki] = True
+        dv = rng.choice([0, 1, 7, 7, 50_000, 9_999_999], size=ki)
+        in_deliver[row, :ki] = np.sort(dv) if deliver_sorted else dv
+        in_src[row, :ki] = rng.integers(0, n, size=ki)
+        in_seq[row, :ki] = rng.integers(0, 100, size=ki)
+    return state._replace(
+        eg_valid=jnp.asarray(eg_valid),
+        eg_prio=jnp.asarray(eg_prio, jnp.int32),
+        eg_dst=jnp.asarray(eg_dst, jnp.int32),
+        eg_bytes=jnp.asarray(eg_bytes, jnp.int32),
+        eg_seq=jnp.asarray(eg_seq, jnp.int32),
+        eg_sock=jnp.asarray(eg_sock, jnp.int32),
+        in_valid=jnp.asarray(in_valid),
+        in_deliver_rel=jnp.asarray(in_deliver, jnp.int32),
+        in_src=jnp.asarray(in_src, jnp.int32),
+        in_seq=jnp.asarray(in_seq, jnp.int32),
+    )
+
+
+def _state_leaf_names(n=4, ce=8, ci=8):
+    from ..tpu import plane
+
+    from .dataflow import leaf_paths
+
+    return leaf_paths(plane.make_state(n, egress_cap=ce, ingress_cap=ci),
+                      prefix="state")
+
+
+def _ingest_rows_gate():
+    """ingest_rows' gate_idle: an entry-free merge must be the identity
+    on a front-packed row (the contract every producer-side gate and
+    the flow plane's emit gate inherit)."""
+    import jax.numpy as jnp
+
+    from ..tpu import plane
+
+    n, k = 4, 4
+    z = lambda: jnp.zeros((n, k), jnp.int32)
+
+    def build():
+        state = _mini_state(np.random.default_rng(0))
+
+        def fn(state, dst, nbytes, prio, seq, valid):
+            return plane.ingest_rows(state, dst, nbytes, prio, seq,
+                                     jnp.zeros((n, k), bool), valid)
+
+        return fn, (state, z(), z(), z(), z(), jnp.zeros((n, k), bool))
+
+    def lattice():
+        rng = np.random.default_rng(7)
+        pts = []
+        occ_sets = ([0, 0, 0, 0], [1, 0, 8, 3], [8, 8, 8, 8],
+                    [7, 1, 0, 8], [2, 2, 2, 2])
+        for occ in occ_sets:
+            for _ in range(3):
+                st = _mini_state(rng, occupancies=occ)
+                # gated domain: no new valid entries at all
+                pts.append((st, z(), z(), z(), z(),
+                            jnp.zeros((n, k), bool)))
+        # reference-branch coverage (vacuous for the theorem, keeps the
+        # lattice honest about both domains)
+        st = _mini_state(rng, occupancies=[1, 2, 3, 4])
+        valid = jnp.zeros((n, k), bool).at[0, 0].set(True)
+        pts.append((st, z(), z(),
+                    jnp.full((n, k), 3, jnp.int32), z(), valid))
+        return pts
+
+    return GateObligation(
+        "ingest_rows[gate_idle]", "shadow_tpu.tpu.plane", build,
+        gate_value=False, lattice=lattice,
+        out_names=_state_leaf_names, min_gated=12)
+
+
+def _compact_ingress_gate():
+    """_compact_ingress's ordered gate: a stable sort of an
+    already-sorted (validity | deliver) packed key is the identity."""
+    import jax.numpy as jnp
+
+    from ..tpu import plane
+
+    def build():
+        state = _mini_state(np.random.default_rng(1),
+                            deliver_sorted=True)
+        in_deliver = jnp.where(state.in_valid, state.in_deliver_rel,
+                               plane.I32_MAX)
+
+        def fn(state, in_deliver):
+            return plane._compact_ingress(state, in_deliver,
+                                          packed_sort=True)
+
+        return fn, (state, in_deliver)
+
+    def lattice():
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(11)
+        pts = []
+        for occ in ([0, 0, 0, 0], [8, 8, 8, 8], [1, 3, 0, 8],
+                    [4, 4, 4, 4]):
+            for _ in range(3):
+                st = _mini_state(rng, occupancies=occ,
+                                 deliver_sorted=True)
+                dv = jnp.where(st.in_valid, st.in_deliver_rel,
+                               plane.I32_MAX)
+                pts.append((st, dv))
+        # unsorted points exercise the reference branch
+        st = _mini_state(rng, occupancies=[5, 5, 5, 5],
+                         deliver_sorted=False)
+        dv = jnp.where(st.in_valid, st.in_deliver_rel, plane.I32_MAX)
+        pts.append((st, dv))
+        return pts
+
+    def out_names():
+        return ["deliver_c", "src_c", "seq_c", "sock_c", "bytes_c",
+                "valid_c"]
+
+    return GateObligation(
+        "_compact_ingress[ordered]", "shadow_tpu.tpu.plane", build,
+        gate_value=True, lattice=lattice, out_names=out_names,
+        min_gated=8)
+
+
+def _egress_order_gate():
+    """_egress_order's FIFO fast path: a stable sort of a
+    non-decreasing (validity | priority) packed key is the identity."""
+    import jax.numpy as jnp
+
+    from ..tpu import plane
+
+    def _args(state):
+        tsend_rb = jnp.where(state.eg_valid, state.eg_tsend, 0)
+        return (state, state.eg_prio, jnp.zeros_like(state.eg_sock),
+                tsend_rb, state.eg_clamp)
+
+    def build():
+        state = _mini_state(np.random.default_rng(2))
+
+        def fn(state, qkey1, qkey2, tsend_rb, clamp_rb):
+            return plane._egress_order(state, qkey1, qkey2, tsend_rb,
+                                       clamp_rb, rr_enabled=False,
+                                       packed_sort=True)
+
+        return fn, _args(state)
+
+    def lattice():
+        rng = np.random.default_rng(13)
+        pts = []
+        for occ in ([0, 0, 0, 0], [8, 8, 8, 8], [1, 3, 0, 8],
+                    [2, 6, 4, 4]):
+            for _ in range(3):
+                pts.append(_args(_mini_state(rng, occupancies=occ)))
+        # an out-of-order row for the reference branch
+        st = _mini_state(rng, occupancies=[4, 4, 4, 4])
+        st = st._replace(eg_prio=st.eg_prio[:, ::-1])
+        pts.append(_args(st))
+        return pts
+
+    def out_names():
+        return ["eg_prio", "eg_sock", "eg_dst", "eg_bytes", "eg_seq",
+                "eg_ctrl", "eg_tsend", "eg_clamp", "eg_valid"]
+
+    return GateObligation(
+        "_egress_order[fifo-ordered]", "shadow_tpu.tpu.plane", build,
+        gate_value=True, lattice=lattice, out_names=out_names,
+        min_gated=8)
+
+
+def _flow_tables():
+    from ..tpu import flows as flows_mod
+
+    n = 4
+    return flows_mod.make_flow_tables(
+        np.arange(n, dtype=np.int32),
+        (np.arange(n, dtype=np.int32) + 1) % n,
+        np.full(n, 1400, np.int32)), n
+
+
+def _flow_state_points(rng, n, count, *, emittable: bool):
+    """FlowState lattice points honoring the shift invariant (rcv_bits
+    bit 0 False) with boundary cwnd/RTO/clock values; `emittable`
+    controls whether any flow has unsent stream or a pending ack."""
+    import jax.numpy as jnp
+
+    from ..tpu import flows as flows_mod
+
+    pts = []
+    for _ in range(count):
+        fs = flows_mod.make_flow_state(n)
+        una = rng.integers(0, 50, size=n)
+        sent = una + rng.integers(0, 8, size=n)
+        bits = rng.integers(0, 2, size=(n, flows_mod.RECV_WND)) == 1
+        bits[:, 0] = False  # the post-advance shift invariant
+        fs = fs._replace(
+            snd_una=jnp.asarray(una, jnp.int32),
+            snd_nxt=jnp.asarray(sent, jnp.int32),
+            snd_max=jnp.asarray(sent + rng.integers(0, 3, size=n),
+                                jnp.int32),
+            stream_len=jnp.asarray(
+                sent + (rng.integers(1, 5, size=n) if emittable
+                        else 0), jnp.int32),
+            rcv_nxt=jnp.asarray(rng.integers(0, 40, size=n), jnp.int32),
+            rcv_bits=jnp.asarray(bits),
+            ack_pending=jnp.asarray(
+                rng.integers(0, 2, size=n) == 1 if emittable
+                else np.zeros(n, bool)),
+            cwnd=jnp.asarray(rng.choice([1, 2, 64, 1 << 20], size=n),
+                             jnp.int32),
+            srtt_ms=jnp.asarray(rng.choice([0, 1, 3000], size=n),
+                                jnp.int32),
+            rto_ms=jnp.asarray(rng.choice([200, 60_000], size=n),
+                               jnp.int32),
+            rto_armed=jnp.asarray(rng.integers(0, 2, size=n) == 1),
+            rto_deadline_ms=jnp.asarray(rng.integers(0, 100, size=n),
+                                        jnp.int32),
+            clock_ms=jnp.asarray(rng.integers(0, 50, size=n),
+                                 jnp.int32),
+        )
+        pts.append(fs)
+    return pts
+
+
+def _delivered_dict(rng, n, ci, kind: str):
+    """A delivered dict for the flow_recv lattice. kind:
+    'empty' (no deliveries), 'untagged' (mask set, reserved socks),
+    'foreign' (flow-tagged but endpoint-mismatched — must still read
+    as idle), 'tagged' (real flow traffic, reference branch)."""
+    import jax.numpy as jnp
+
+    mask = np.zeros((n, ci), bool)
+    sock = np.zeros((n, ci), np.int64)
+    seq = np.zeros((n, ci), np.int64)
+    src = np.zeros((n, ci), np.int64)
+    if kind != "empty":
+        k = 3
+        for row in range(n):
+            mask[row, :k] = True
+            seq[row, :k] = rng.integers(0, 64, size=k)
+            if kind == "untagged":
+                sock[row, :k] = rng.integers(0, 2, size=k)  # reserved
+                src[row, :k] = rng.integers(0, n, size=k)
+            elif kind == "foreign":
+                sock[row, :k] = (rng.integers(0, n, size=k) + 1) * 2
+                src[row, :k] = row  # never the flow's src for dst=row
+            else:  # tagged: flow f = row-1 delivers data to dst row
+                f = (row - 1) % n
+                sock[row, :k] = (f + 1) * 2
+                src[row, :k] = f
+    return {
+        "mask": jnp.asarray(mask),
+        "src": jnp.asarray(src, jnp.int32),
+        "seq": jnp.asarray(seq, jnp.int32),
+        "sock": jnp.asarray(sock, jnp.int32),
+        "bytes": jnp.asarray(np.full((n, ci), 1400), jnp.int32),
+        "deliver_rel": jnp.asarray(
+            rng.integers(0, 1_000_000, size=(n, ci)), jnp.int32),
+    }
+
+
+def _flow_recv_gate():
+    """flow_recv's idle gate: a window with no flow-tagged deliveries
+    (including untagged and endpoint-mismatched tagged traffic) leaves
+    every flow field untouched."""
+    import jax.numpy as jnp
+
+    from ..tpu import flows as flows_mod
+
+    ft, n = _flow_tables()
+    ci = 8
+
+    def build():
+        rng = np.random.default_rng(3)
+        fs = _flow_state_points(rng, n, 1, emittable=False)[0]
+
+        def fn(fs, delivered, window_ns):
+            return flows_mod.flow_recv(ft, fs, delivered, window_ns)
+
+        return fn, (fs, _delivered_dict(rng, n, ci, "empty"),
+                    jnp.int32(2_000_000))
+
+    def lattice():
+        rng = np.random.default_rng(17)
+        pts = []
+        for kind in ("empty", "untagged", "foreign"):
+            for fs in _flow_state_points(rng, n, 4, emittable=False):
+                pts.append((fs, _delivered_dict(rng, n, ci, kind),
+                            jnp.int32(int(rng.choice(
+                                [1_000_000, 10_000_000])))))
+        for fs in _flow_state_points(rng, n, 2, emittable=False):
+            pts.append((fs, _delivered_dict(rng, n, ci, "tagged"),
+                        jnp.int32(1_000_000)))
+        return pts
+
+    def out_names():
+        from .dataflow import leaf_paths
+
+        from ..tpu import flows as flows_mod
+
+        return leaf_paths(flows_mod.make_flow_state(n), prefix="fs") \
+            + ["credits"]
+
+    return GateObligation(
+        "flow_recv[idle]", "shadow_tpu.tpu.flows", build,
+        gate_value=False, lattice=lattice, out_names=out_names,
+        min_gated=10)
+
+
+def _flow_emit_gate():
+    """flow_emit's idle gate: an append with zero valid emission lanes
+    is the bitwise identity on the egress rings — including full rows,
+    where the overflow counter must not move."""
+    from ..tpu import flows as flows_mod
+
+    ft, n = _flow_tables()
+
+    def build():
+        rng = np.random.default_rng(4)
+        fs = _flow_state_points(rng, n, 1, emittable=False)[0]
+        state = _mini_state(rng)
+
+        def fn(fs, state):
+            return flows_mod.flow_emit(ft, fs, state)
+
+        return fn, (fs, state)
+
+    def lattice():
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(19)
+        pts = []
+        for occ in ([0, 0, 0, 0], [8, 8, 8, 8], [1, 3, 0, 8]):
+            for fs in _flow_state_points(rng, n, 4, emittable=False):
+                pts.append((fs, _mini_state(rng, occupancies=occ)))
+                # an armed RTO that fires rewinds snd_nxt and re-emits
+                # (reference branch); the disarmed twin is guaranteed
+                # idle, so the gated domain keeps its coverage floor
+                pts.append((fs._replace(
+                    rto_armed=jnp.zeros((n,), bool)),
+                    _mini_state(rng, occupancies=occ)))
+        for fs in _flow_state_points(rng, n, 2, emittable=True):
+            pts.append((fs, _mini_state(rng, occupancies=[2, 2, 2, 2])))
+        return pts
+
+    def out_names():
+        return _state_leaf_names()
+
+    return GateObligation(
+        "flow_emit[idle]", "shadow_tpu.tpu.flows", build,
+        gate_value=False, lattice=lattice, out_names=out_names,
+        # a lattice point whose RTO fires rewinds snd_nxt and emits
+        # (reference branch); the remainder stay idle — require a
+        # healthy gated majority without pinning the exact split
+        min_gated=6)
+
+
+def gate_obligations() -> list[GateObligation]:
+    """The SL505 proof surface: every gated lax.cond the device plane
+    relies on (docs/determinism.md 'Branch gates are theorems')."""
+    return [
+        _ingest_rows_gate(),
+        _compact_ingress_gate(),
+        _egress_order_gate(),
+        _flow_recv_gate(),
+        _flow_emit_gate(),
+    ]

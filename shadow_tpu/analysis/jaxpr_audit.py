@@ -45,6 +45,7 @@ __all__ = [
     "audit_entry",
     "audit_jaxpr",
     "default_entries",
+    "traced",
 ]
 
 # constants above this many bytes should be kernel *arguments*
@@ -198,11 +199,33 @@ def audit_jaxpr(closed_jaxpr, where: str,
     return findings
 
 
-def audit_entry(entry: AuditEntry) -> list[Finding]:
-    import jax
+#: the one shared per-process jaxpr cache, keyed "module:name". Every
+#: traced analysis pass — the SL2xx audit, the SL501 invisibility
+#: proofs, the SL502 census, the SL504 shard report, and the SL505/
+#: SL506 provers — re-traces the same audited entries; hoisting one
+#: memo here means a full shadowlint run (or the gating CI proof step)
+#: traces each entry ONCE. Entry names are stable per process; callers
+#: passing ad-hoc entries must give distinct names.
+_TRACE_CACHE: dict[str, tuple] = {}
 
-    fn, args = entry.build()
-    closed = jax.make_jaxpr(fn)(*args)
+
+def traced(key: str, build):
+    """(closed_jaxpr, out_shape, args) for one audited entry,
+    memoized across every analysis pass."""
+    hit = _TRACE_CACHE.get(key)
+    if hit is None:
+        import jax
+
+        fn, args = build()
+        closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(*args)
+        hit = (closed, out_shape, args)
+        _TRACE_CACHE[key] = hit
+    return hit
+
+
+def audit_entry(entry: AuditEntry) -> list[Finding]:
+    closed, _shape, _args = traced(f"{entry.module}:{entry.name}",
+                                   entry.build)
     findings = audit_jaxpr(closed, f"{entry.module}:{entry.name}")
     for f in findings:
         just = entry.allow.get(f.rule)
@@ -394,6 +417,19 @@ def _chain_entry(variant: str = "plain"):
                 return chain(state, shift0, horizon, guards=guards)
 
             return fn, (args[0], make_guards(n), *args[1:])
+        if variant == "flows":
+            from ..tpu import flows as flows_mod
+
+            ft = flows_mod.make_flow_tables(
+                np.arange(n, dtype=np.int32),
+                (np.arange(n, dtype=np.int32) + 1) % n,
+                np.full(n, 1400, np.int32))
+
+            def fn(state, fs, shift0, horizon):
+                return chain(state, shift0, horizon, flows=(ft, fs))
+
+            return fn, (args[0], flows_mod.make_flow_state(n),
+                        *args[1:])
         if variant == "workload":
             from ..workloads import compile_program, parse_scenario
             from ..workloads import device as wdevice
@@ -664,6 +700,8 @@ def default_entries() -> list[AuditEntry]:
                    _chain_entry("guards")),
         AuditEntry("chain_windows[workload]", "shadow_tpu.tpu.plane",
                    _chain_entry("workload")),
+        AuditEntry("chain_windows[flows]", "shadow_tpu.tpu.plane",
+                   _chain_entry("flows")),
         AuditEntry("ingest_rows[planes]", "shadow_tpu.tpu.plane",
                    _ingest_rows_entry()),
         AuditEntry("window_step[flows]", "shadow_tpu.tpu.plane",

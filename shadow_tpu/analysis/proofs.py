@@ -1,6 +1,9 @@
 """shadowlint pass 3: dataflow proofs over the audited kernel surface.
 
-Three rule families on top of ``analysis/dataflow.py``:
+Three rule families on top of ``analysis/dataflow.py`` (the SL505
+branch-equivalence prover and the SL506 range analysis live in their
+own modules, ``analysis/condeq.py`` / ``analysis/ranges.py``, sharing
+the same per-process trace cache — ``jaxpr_audit.traced``):
 
 - **SL501 presence-invisibility** — for every observability-plane
   variant of ``window_step`` / ``chain_windows`` / ``ingest_rows``
@@ -23,9 +26,11 @@ Three rule families on top of ``analysis/dataflow.py``:
   updates must be explicit in the diff
   (``tools/shadowlint.py --write-op-budgets``).
 
-- **SL504 shardability report** — informational: every entry's
+- **SL504 shardability report + row-local fence** — every entry's
   shard-relevant primitives classified host-axis-local vs cross-host,
-  the scoping work-list for the ROADMAP-2 ``shard_map`` cut.
+  the scoping work-list for the ROADMAP-2 ``shard_map`` cut; the
+  tcp/codel row-local stages (``ROW_LOCAL_PINNED``) GATE on keeping
+  an empty cross-host set.
 """
 
 from __future__ import annotations
@@ -38,15 +43,18 @@ from typing import Callable
 import numpy as np
 
 from .dataflow import leaf_paths, op_census, propagate_taint, shard_census
-from .jaxpr_audit import _flows_entry, _ingest_rows_entry
+from .jaxpr_audit import (_chain_entry, _flows_entry,
+                          _ingest_rows_entry, _plane_entry)
 from .rules import Finding
 
 __all__ = [
     "InvisibilitySpec",
+    "ROW_LOCAL_PINNED",
     "budget_path",
     "build_shard_report",
     "check_invisibility",
     "check_op_budgets",
+    "check_row_local_fence",
     "compute_censuses",
     "format_budget_delta",
     "invisibility_specs",
@@ -81,7 +89,9 @@ class InvisibilitySpec:
     ``tainted_args`` maps positional arg index -> taint-label prefix
     (the plane name); ``protected`` decides, per output leaf, whether
     taint reaching it is a violation — given the top-level output tuple
-    index and the leaf's key path.
+    index and the leaf's key path. ``trace_key`` overrides the shared
+    trace-cache key (default ``module:name``) when the spec shares a
+    builder with an audit entry under a different display name.
     """
 
     name: str
@@ -89,6 +99,11 @@ class InvisibilitySpec:
     build: Callable[[], tuple[Callable, tuple]]
     tainted_args: dict[int, str] = field(default_factory=dict)
     protected: Callable[[int, str], bool] = lambda idx, path: True
+    trace_key: str | None = None
+
+    @property
+    def cache_key(self) -> str:
+        return self.trace_key or f"{self.module}:{self.name}"
 
 
 def _protect_lead(n: int):
@@ -315,13 +330,20 @@ def invisibility_specs() -> list[InvisibilitySpec]:
     mod = "shadow_tpu.tpu.plane"
     wmod = "shadow_tpu.workloads.device"
     return [
+        # the single-plane window/chain specs REUSE the SL2xx audit
+        # builders outright (not merely equivalent copies): the shared
+        # trace cache keys by entry name, so a same-named spec with a
+        # different builder would silently win or lose the trace
+        # depending on pass order — one builder per key removes the
+        # ambiguity by construction
         InvisibilitySpec(
             "window_step[metrics]", mod,
-            _window_planes_entry(metrics=True),
-            tainted_args={1: "metrics"}, protected=_protect_lead(3)),
+            _plane_entry(True, True, False, telemetry=True),
+            tainted_args={1: "metrics"}, protected=_protect_lead(3),
+            trace_key="shadow_tpu.tpu.plane:window_step[telemetry]"),
         InvisibilitySpec(
             "window_step[guards]", mod,
-            _window_planes_entry(guards=True),
+            _plane_entry(True, True, False, guards=True),
             tainted_args={1: "guards"}, protected=_protect_lead(3)),
         InvisibilitySpec(
             "window_step[hist]", mod,
@@ -340,11 +362,11 @@ def invisibility_specs() -> list[InvisibilitySpec]:
             protected=_protect_lead(3)),
         InvisibilitySpec(
             "chain_windows[metrics]", mod,
-            _chain_planes_entry(metrics=True),
+            _chain_entry("metrics"),
             tainted_args={1: "metrics"}, protected=_protect_lead(5)),
         InvisibilitySpec(
             "chain_windows[guards]", mod,
-            _chain_planes_entry(guards=True),
+            _chain_entry("guards"),
             tainted_args={1: "guards"}, protected=_protect_lead(5)),
         # the composed workload chain: metrics+guards thread through the
         # generator's own ingest_rows too — prove they stay invisible to
@@ -361,7 +383,8 @@ def invisibility_specs() -> list[InvisibilitySpec]:
             _ingest_rows_entry(),
             tainted_args={1: "metrics", 2: "guards", 3: "hist",
                           4: "flightrec"},
-            protected=_protect_lead(1)),
+            protected=_protect_lead(1),
+            trace_key="shadow_tpu.tpu.plane:ingest_rows[planes]"),
         InvisibilitySpec(
             "workload_step[append-only]", wmod,
             _workload_step_entry(),
@@ -381,7 +404,8 @@ def invisibility_specs() -> list[InvisibilitySpec]:
             "flow_step[append-only]", "shadow_tpu.tpu.flows",
             _flows_entry("step"),
             tainted_args={0: "ft", 1: "fs"},
-            protected=_flows_step_protected),
+            protected=_flows_step_protected,
+            trace_key="shadow_tpu.tpu.flows:flow_step"),
     ]
 
 
@@ -398,10 +422,9 @@ def _flat_len(tree) -> int:
 
 def check_invisibility(spec: InvisibilitySpec) -> list[Finding]:
     """Run one proof obligation; empty list = the theorem holds."""
-    import jax
+    from .jaxpr_audit import traced
 
-    fn, args = spec.build()
-    closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(*args)
+    closed, out_shape, args = traced(spec.cache_key, spec.build)
 
     in_labels: list[str | None] = []
     for i, arg in enumerate(args):
@@ -451,24 +474,16 @@ def check_all_invisibility(
 
 _BUDGET_FILE = "op_budgets.json"
 
-#: per-process jaxpr memo keyed by entry name: SL502's census and
-#: SL504's shard report walk the SAME traced graphs, so one full
-#: shadowlint run (census + report) traces the registry once, not
-#: twice. Registry entry names are stable per process; callers passing
-#: ad-hoc entries must give distinct names (AuditEntry convention).
-_TRACE_CACHE: dict[str, object] = {}
-
 
 def _traced(entry):
-    import jax
+    """The shared per-process jaxpr memo (`jaxpr_audit.traced`): the
+    SL2xx audit, SL501 proofs, SL502 census, SL504 report, and the
+    SL505/SL506 provers all walk the same traced graphs — one full
+    shadowlint run traces each audited entry once, not once per pass
+    (the gating CI proof step's time budget rests on this)."""
+    from .jaxpr_audit import traced
 
-    key = f"{entry.module}:{entry.name}"
-    closed = _TRACE_CACHE.get(key)
-    if closed is None:
-        fn, args = entry.build()
-        closed = jax.make_jaxpr(fn)(*args)
-        _TRACE_CACHE[key] = closed
-    return closed
+    return traced(f"{entry.module}:{entry.name}", entry.build)[0]
 
 
 def budget_path() -> str:
@@ -580,8 +595,9 @@ def format_budget_delta(deltas: list[dict]) -> str:
 
 
 def build_shard_report(entries=None) -> dict:
-    """Informational per-entry shardability classification — the
-    scoping work-list for the ROADMAP-2 shard_map refactor."""
+    """Per-entry shardability classification — the scoping work-list
+    for the ROADMAP-2 shard_map refactor. Informational EXCEPT for the
+    `ROW_LOCAL_PINNED` fence below."""
     from .jaxpr_audit import default_entries
 
     sections = {}
@@ -598,5 +614,50 @@ def build_shard_report(entries=None) -> dict:
             "opaque_kernels": sum(len(s["opaque"])
                                   for s in sections.values()),
         },
+        "row_local_pinned": sorted(ROW_LOCAL_PINNED),
         "sections": sections,
     }
+
+
+#: entries whose cross-host set is pinned EMPTY — the row-local stages
+#: the ROADMAP-2 shard_map refactor relies on shard-for-free. A
+#: cross-host primitive appearing in one of these is a sharding
+#: regression fence, not a report line: SL504 GATES on it.
+ROW_LOCAL_PINNED = frozenset({
+    "shadow_tpu.tpu.tcp:tcp_event_step",
+    "shadow_tpu.tpu.tcp:tcp_pull_step",
+    "shadow_tpu.tpu.codel:codel_drain",
+    "shadow_tpu.tpu.codel:router_drain",
+})
+
+
+def check_row_local_fence(report: dict | None = None) -> list[Finding]:
+    """SL504's gating half: every `ROW_LOCAL_PINNED` entry must report
+    an empty cross-host set (the regression fence for the ROADMAP-2
+    shard_map cut — these stages shard for free today and must stay
+    that way). Without a pre-built report, only the pinned entries are
+    traced/classified (the fast gating path; `--shard-report` still
+    emits the full registry)."""
+    if report is None:
+        from .jaxpr_audit import default_entries
+
+        pinned = [e for e in default_entries()
+                  if f"{e.module}:{e.name}" in ROW_LOCAL_PINNED]
+        report = build_shard_report(pinned)
+    findings: list[Finding] = []
+    for key in sorted(ROW_LOCAL_PINNED):
+        section = report["sections"].get(key)
+        if section is None:
+            findings.append(Finding(
+                "SL504", key, 0, 0,
+                "row-local-pinned entry missing from the audit "
+                "registry: the shard fence cannot check it"))
+            continue
+        for oc in section["cross_host"]:
+            findings.append(Finding(
+                "SL504", key, 0, 0,
+                f"cross-host `{oc['primitive']}` in a row-local-pinned "
+                f"stage ({oc['reason']}; shapes {oc['shapes']}): a "
+                "sharding regression — this stage must stay "
+                "host-axis-local for the ROADMAP-2 shard_map cut"))
+    return findings

@@ -253,18 +253,58 @@ RULES: dict[str, RuleInfo] = {
             fixture="fixture_donation.py",
         ),
         RuleInfo(
-            "SL504", "shardability-report",
-            "informational: expensive primitives classified host-axis-"
-            "local vs cross-host per audited section",
+            "SL504", "shardability-fence",
+            "expensive primitives classified host-axis-local vs "
+            "cross-host per audited section; GATES when a cross-host "
+            "op appears in a row-local-pinned stage (tcp/codel)",
             "the ROADMAP-2 shard_map cut needs a scoped work-list "
             "before any million-host work starts: cross-host ops "
             "(gathers/scatters keyed by computed host ids, full-axis "
             "sorts, host-axis reductions) need a collective or a "
             "ragged exchange; host-local ops shard for free. The "
-            "report (tools/shadowlint.py --shard-report) never fails "
-            "the build — it is the map, not a gate",
+            "report (tools/shadowlint.py --shard-report) stays "
+            "informational for most entries, but the tcp/codel "
+            "row-local stages are pinned EMPTY "
+            "(proofs.ROW_LOCAL_PINNED): a cross-host primitive "
+            "sneaking into one fails the build — the regression fence "
+            "for the shard_map refactor",
             scope="jaxpr audit registry (analysis/jaxpr_audit.py)",
             fixture="fixture_shard_classify.py",
+        ),
+        RuleInfo(
+            "SL505", "branch-equivalence",
+            "a registered lax.cond gate (gate_idle / ident-vs-sort / "
+            "flow idle gates) whose branches are NOT provably "
+            "bitwise-equal on the gated domain",
+            "the device plane's cond gates may only ever change "
+            "SPEED, never a bit: the idle gates must be the identity "
+            "on entry-free windows and the ident-vs-sort gates must "
+            "equal the sort on ordered input — the contract memoized "
+            "replay and deeper sort-diet gating stand on. The prover "
+            "(analysis/condeq.py) shows branch equality structurally "
+            "(canonicalization + the sort-of-sorted rewrite + a "
+            "selection witness) or by exhaustive evaluation over a "
+            "registered boundary-value lattice, with the mode "
+            "recorded per gate (docs/determinism.md 'Branch gates "
+            "are theorems')",
+            scope="gate registry (analysis/condeq.gate_obligations)",
+            fixture="fixture_condeq_gate.py",
+        ),
+        RuleInfo(
+            "SL506", "integer-range",
+            "a non-exempt signed-int32 op whose interval (seeded from "
+            "the checked-in input-domain registry) admits wraparound",
+            "the plane's int32-ns dtype discipline holds by interval "
+            "arithmetic, not by luck: analysis/ranges.py propagates "
+            "[lo, hi] through every audited plane/flows jaxpr — "
+            "while-loop carries refined by the loop predicate, "
+            "declared-modular counters wrap-exempt — and fails the "
+            "build on any op that can overflow, naming the op, its "
+            "source line, and the computed interval. Every 'no "
+            "overflow because ...' comment is now either this "
+            "theorem or a caught bug (docs/determinism.md)",
+            scope="range registry (analysis/ranges.range_specs)",
+            fixture="fixture_int_overflow.py",
         ),
     ]
 }

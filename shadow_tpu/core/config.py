@@ -695,6 +695,21 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
             raise ConfigError(f"unknown top-level config section {key!r}")
     if cfg.general.stop_time <= 0:
         raise ConfigError("general.stop_time is required and must be positive")
+    # the device plane's window budget (SL506 input-domain registry,
+    # analysis/ranges.py: window_ns <= I32_MAX//4): runahead is the
+    # window-length floor, and a window beyond a quarter of the int32-ns
+    # range breaks the rebase/deliver arithmetic the range proof
+    # guarantees — fail at parse, not as silent wraparound mid-run
+    if cfg.experimental.runahead < 1:
+        raise ConfigError("experimental.runahead must be a positive "
+                          "duration")
+    if cfg.experimental.runahead > (2**31 - 1) // 4:
+        raise ConfigError(
+            f"experimental.runahead ({cfg.experimental.runahead} ns) "
+            f"exceeds the device window budget of I32_MAX//4 ns "
+            "(~0.53 s): the int32-ns window arithmetic the SL506 range "
+            "proof covers (docs/determinism.md) requires windows "
+            "within a quarter of the int32 range")
     if not cfg.hosts:
         raise ConfigError("at least one host is required")
     if cfg.experimental.plane_kernel not in ("xla", "pallas",

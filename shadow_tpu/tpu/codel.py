@@ -367,10 +367,21 @@ def rebase_router_state(st: RouterDownState, shift_ns, dn_rate,
     num = span // interval_ms
     headroom = jnp.maximum(dn_cap - st.dn_balance, 0)
     need = (headroom + dn_rate - 1) // dn_rate
-    balance = jnp.minimum(
-        st.dn_balance + dn_rate * jnp.minimum(num, need), dn_cap
+    # == min(balance + refund, cap) for refund >= 0 (min(u, c) is
+    # c - max(c - u, 0)); the headroom form keeps every intermediate
+    # interval-bounded even at the 2^30 - MTU rate clamp — the SL506
+    # range proof closes it without the relational
+    # "refund < headroom + rate" argument
+    balance = dn_cap - jnp.maximum(
+        headroom - dn_rate * jnp.minimum(num, need), 0
     )
-    lref = lref + num * interval_ms  # now in (-1 ms, 0] (or small positive)
+    # re-anchor into (-1 ms, 0] (or keep a small positive value):
+    # algebraically identical to `lref + num * interval_ms` (lref +
+    # span == max(lref, 0) and num * interval_ms == span - span %
+    # interval_ms), but every term is interval-bounded — the SL506
+    # range proof (analysis/ranges.py `state.router.dn_last_refill`)
+    # needs no relational reasoning to close it
+    lref = jnp.maximum(lref, 0) - span % interval_ms
     return st._replace(
         interval_end=jnp.where(st.has_interval_end,
                                st.interval_end - shift, st.interval_end),
@@ -408,8 +419,14 @@ def _route_one_host(arrival, size, window_ns, dn_rate, dn_cap, st):
         num = span // interval_ms
         headroom = jnp.maximum(dn_cap - bal, 0)
         need = (headroom + dn_rate - 1) // dn_rate
-        bal2 = jnp.minimum(bal + dn_rate * jnp.minimum(num, need), dn_cap)
-        return bal2, lref + num * interval_ms
+        # == min(bal + refund, cap); headroom form for the SL506 range
+        # proof, like rebase_router_state
+        bal2 = dn_cap - jnp.maximum(
+            headroom - dn_rate * jnp.minimum(num, need), 0)
+        # == lref + num * interval_ms (lref + span == max(now, lref));
+        # the max form keeps the anchor interval-bounded by the window
+        # horizon for the SL506 range proof (analysis/ranges.py)
+        return bal2, jnp.maximum(now, lref) - span % interval_ms
 
     def micro_step(_, carry):
         (mode, has_ie, ie, has_dn, dn, cur, prev, bal, lref, has_c, c_size,

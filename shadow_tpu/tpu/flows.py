@@ -345,7 +345,10 @@ def flow_recv(ft: FlowTables, fs: FlowState, delivered, window_ns):
     # shift is the identity, ack_val stays -1, and every _ack_one is
     # the identity select — so both branches are bitwise-equal for
     # every input and the gate only skips the scatter/vmap cost of
-    # quiet (or flow-free) windows
+    # quiet (or flow-free) windows. PROVEN per build: the SL505
+    # obligation `flow_recv[idle]` (analysis/condeq.py) evaluates both
+    # branches over a boundary-value lattice (incl. untagged and
+    # endpoint-mismatched tagged traffic) on every CI run
     return jax.lax.cond(
         (is_data | is_ackp).any(), do_recv,
         lambda fs: (fs, jnp.zeros((N,), jnp.int32)), fs)
@@ -431,7 +434,10 @@ def flow_emit(ft: FlowTables, fs: FlowState, state, *,
     # all-inactive presence probe (window_step_flows) cheap. Metrics
     # and guards apply OUTSIDE the gate from the state's own overflow
     # counter delta (the ingest_rows discipline), so the guard checks
-    # counter advances identically through both branches.
+    # counter advances identically through both branches. PROVEN per
+    # build: the SL505 obligation `flow_emit[idle]`
+    # (analysis/condeq.py), with full-ring lattice points pinning the
+    # zero-overflow edge.
     pre_occ = state.eg_valid.sum(axis=1, dtype=jnp.int32)
     pre_ovf = state.n_overflow_dropped
     state = jax.lax.cond(
@@ -507,7 +513,9 @@ def next_deadline_rel_ns(ft: FlowTables, fs: FlowState) -> jax.Array:
     a pending retransmission. Already-due deadlines report 0 (fire in
     the next window); the ms->ns conversion clamps to the int32
     window budget (a far-off deadline just reads 'beyond the chain
-    horizon', which is all the reduction needs)."""
+    horizon', which is all the reduction needs — the clamp is part of
+    the SL506 range proof of the chain wake arithmetic,
+    analysis/ranges.py `chain_windows[flows]`)."""
     active = (ft.src >= 0) & fs.rto_armed & (fs.snd_nxt > fs.snd_una)
     rel_ms = jnp.clip(fs.rto_deadline_ms - fs.clock_ms, 0,
                       (I32_MAX // 2) // 1_000_000)

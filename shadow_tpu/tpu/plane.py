@@ -142,8 +142,22 @@ def make_params(latency_ns: np.ndarray, loss: np.ndarray, up_bw_bps: np.ndarray,
     `down_bw_bps` [N] feeds the destination-side router's down-bandwidth
     relay bucket (active only when window_step runs with router_aqm=True);
     None = transparent (max rate)."""
+    # the path-latency budget (SL506 input-domain registry,
+    # analysis/ranges.py `state.in_deliver_rel`): deliver = max(tsend +
+    # latency, clamp) with tsend <= window <= I32_MAX//4 stays inside
+    # int32 only while latency <= I32_MAX//2 (~1.07 s — beyond any
+    # modeled path; the fault plane's lat_mult clamps to the same
+    # budget). Was a docstring sentence ("path latency + window length
+    # < ~2.1 s"); now refused at construction.
+    lat = np.asarray(latency_ns)
+    if lat.size and (lat.min() < 0 or lat.max() > (2**31 - 1) // 2):
+        raise ValueError(
+            f"latency_ns out of the device budget [0, I32_MAX//2 ns]: "
+            f"min={lat.min()}, max={lat.max()} — the int32-ns deliver "
+            "arithmetic (SL506 range proof, docs/determinism.md) "
+            "admits wraparound beyond ~1.07 s of path latency")
     # cap the per-ms rate at 2^30 - mtu so the refill arithmetic in
-    # window_step (balance + rate*elapsed_eff <= cap + rate <= 2*rate + mtu)
+    # window_step (rate * elapsed_eff <= headroom + rate <= cap + rate)
     # can never overflow int32; 2^30 B/ms ~ 8.6 Tbit/s, beyond any modeled NIC
     rate = np.minimum(
         np.maximum(1, (np.asarray(up_bw_bps) // 8) // 1000), 2**30 - mtu
@@ -613,11 +627,15 @@ def chain_windows(state: NetPlaneState, params: NetPlaneParams,
                                    I32_MAX))
             rto_rel = _flows_mod.next_deadline_rel_ns(ft, fstate)
             # guard the add against the no-deadline sentinel: rel is
-            # clamped <= I32_MAX//2 when a timer pends, so the sum
-            # stays in int32 (window_ns <= I32_MAX//4 by the spec
-            # budget)
+            # clamped <= I32_MAX//2 when a timer pends (window_ns <=
+            # I32_MAX//4 by the spec budget), and the min below keeps
+            # the sentinel lane's add in-range too — its sum is
+            # discarded by the where, but the SL506 range proof
+            # (analysis/ranges.py `chain_windows[flows]`) covers every
+            # computed lane, not just the selected ones
             wake = jnp.where(rto_rel > I32_MAX // 2, I32_MAX,
-                             jnp.int32(window_ns) + rto_rel)
+                             jnp.int32(window_ns)
+                             + jnp.minimum(rto_rel, I32_MAX // 2))
             next_ev = jnp.minimum(next_ev, wake)
         if ws is not None:
             wout = _wdevice.workload_step(wl, ws, st, delivered, ridx,
@@ -647,7 +665,13 @@ def chain_windows(state: NetPlaneState, params: NetPlaneParams,
         jnp.int32(round0))
 
     def keep_going(delivered, off, next_ev):
-        # hs - off > 0 and both < I32_MAX//2, so no overflow anywhere
+        # hs - off > 0 and both < I32_MAX//2, so no overflow anywhere —
+        # no longer hand-reasoned: the SL506 range proof
+        # (analysis/ranges.py `chain_windows`) closes the whole chain
+        # loop's arithmetic by refining the carry intervals with THIS
+        # predicate (`next_ev < hs - off` bounds off + next_ev below
+        # I32_MAX inside the body, for all inputs in the registered
+        # domains)
         return (~delivered["mask"].any()) & (next_ev < hs - off)
 
     def cond(c):
@@ -768,7 +792,10 @@ def ingest_rows(state: NetPlaneState, dst: jax.Array, nbytes: jax.Array,
     tests). `gate_idle` wraps the merge in a `lax.cond` on "any new valid
     entries", so windows that produce nothing pay one reduction instead of
     a full merge sort; both are bitwise no-ops on the result (rows are
-    front-packed, so an entry-free merge is the identity).
+    front-packed, so an entry-free merge is the identity — proven per
+    build by the SL505 obligation `ingest_rows[gate_idle]`,
+    analysis/condeq.py, docs/determinism.md "Branch gates are
+    theorems").
 
     `metrics` (static presence) accumulates ring-overflow drops into
     `drop_ring_full` and switches the return to (state', metrics'); the
@@ -930,14 +957,18 @@ def _refill_tokens(state: NetPlaneState, params: NetPlaneParams, shift_ns,
     elapsed_ms = (shift_ns // 1_000_000) + (rem_total // 1_000_000)
     tb_rem_ns = rem_total % 1_000_000
     # refill only up to the headroom, clamping elapsed BEFORE multiplying:
-    # rate * elapsed_eff <= headroom + rate and balance + that <= cap + rate,
-    # which stays inside int32 for any rate <= 2^30 (make_params guarantees
-    # it) — the naive balance + rate*fill_ms wrapped negative for rates near
-    # 1e9 B/ms and stalled every egress queue for one round
+    # rate * elapsed_eff <= headroom + rate <= cap + rate, inside int32 for
+    # any rate <= 2^30 - MTU (make_params guarantees it) — the naive
+    # balance + rate*fill_ms wrapped negative for rates near 1e9 B/ms and
+    # stalled every egress queue for one round. The headroom form of the
+    # final clamp (min(u, c) == c - max(c - u, 0) for a non-negative
+    # refund) keeps every intermediate interval-bounded: the SL506 range
+    # proof (analysis/ranges.py, window_step entries) closes this whole
+    # section as a theorem instead of this comment's relational argument.
     headroom = jnp.maximum(cap - state.tb_balance, 0)
     need_ms = (headroom + rate - 1) // rate
     elapsed_eff = jnp.minimum(elapsed_ms, need_ms)
-    balance = jnp.minimum(state.tb_balance + rate * elapsed_eff, cap)
+    balance = cap - jnp.maximum(headroom - rate * elapsed_eff, 0)
     return balance, tb_rem_ns
 
 
@@ -993,7 +1024,8 @@ def _egress_order(state: NetPlaneState, qkey1, qkey2, eg_tsend_rb,
     stable sort of a non-decreasing key with the column-index tiebreak
     IS the identity, so both branches are bitwise-equal always — the
     gate can only change speed, never a bit (same contract as
-    `ingest_rows`' gate_idle)."""
+    `ingest_rows`' gate_idle; proven structurally per build by SL505
+    `_egress_order[fifo-ordered]`, analysis/condeq.py)."""
     if packed_sort:
         packed = _pack_valid_key(state.eg_valid, qkey1)
         if not rr_enabled:
@@ -1135,7 +1167,9 @@ def _compact_ingress(state: NetPlaneState, in_deliver, *, packed_sort: bool):
     the column tiebreak IS the identity (equal keys keep column
     order), so the branches are bitwise-equal for every input — the
     gate trades a [N, CI] compare for the dominant steady-state row
-    sort."""
+    sort. Proven structurally per build (SL505
+    `_compact_ingress[ordered]`: the sort-of-sorted rewrite + a
+    selection witness, analysis/condeq.py)."""
     key_deliver = jnp.where(state.in_valid, in_deliver, I32_MAX)
     if packed_sort:
         packed = _pack_time_key(state.in_valid, key_deliver)

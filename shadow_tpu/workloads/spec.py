@@ -42,8 +42,12 @@ import yaml
 #: int32 virtual-time budget shared with the device plane
 #: (path latency + window length < ~2.1 s, tpu/plane.py dtype discipline)
 _I32_TIME_BUDGET = 2**31 - 1
-#: byte sizes must stay clear of the token-bucket int32 arithmetic
-_MAX_BYTES = 2**30
+#: the wire-size budget (SL506 input-domain registry,
+#: analysis/ranges.py `BYTES_BUDGET` — pinned equal by
+#: tests/test_ranges.py): capacity-scaled prefix sums over packet
+#: bytes (the token-gate cumsum, per-window byte counters) must stay
+#: inside int32, so one message caps at 16 MiB
+_MAX_BYTES = 2**24
 
 PATTERN_KINDS = ("ring_allreduce", "all_to_all", "incast", "rpc_fanout",
                  "onoff")

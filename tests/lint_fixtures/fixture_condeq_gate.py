@@ -1,0 +1,65 @@
+"""SL505 seeded violation: a deliberately-broken cond gate whose
+"idle" fast branch is NOT the identity — it bumps a counter the merge
+branch leaves alone, so the gate changes a bit, not just speed. The
+prover must FAIL naming the first diverging output leaf
+(`state.counter`) and the lattice point that exposed it."""
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+class MiniRing(NamedTuple):
+    vals: object  # jax.Array at trace time
+    counter: object
+
+
+def _build():
+    import jax
+    import jax.numpy as jnp
+
+    def gated_step(state, new_vals, valid):
+        def merge(st):
+            return st._replace(
+                vals=jnp.where(valid, new_vals, st.vals))
+
+        def idle(st):
+            # BAD: the gated branch mutates state — on an entry-free
+            # window the cond is no longer bitwise-invisible
+            return st._replace(counter=st.counter + 1)
+
+        return jax.lax.cond(valid.any(), merge, idle, state)
+
+    state = MiniRing(jnp.zeros((4,), jnp.int32),
+                     jnp.zeros((4,), jnp.int32))
+    return gated_step, (state, jnp.zeros((4,), jnp.int32),
+                        jnp.zeros((4,), bool))
+
+
+def _lattice():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    pts = []
+    for _ in range(6):
+        state = MiniRing(
+            jnp.asarray(rng.integers(0, 100, 4), jnp.int32),
+            jnp.asarray(rng.integers(0, 100, 4), jnp.int32))
+        # gated domain: no valid entries
+        pts.append((state, jnp.zeros((4,), jnp.int32),
+                    jnp.zeros((4,), bool)))
+    state = MiniRing(jnp.zeros((4,), jnp.int32),
+                     jnp.zeros((4,), jnp.int32))
+    pts.append((state, jnp.ones((4,), jnp.int32),
+                jnp.ones((4,), bool)))
+    return pts
+
+
+def obligation():
+    from shadow_tpu.analysis.condeq import GateObligation
+
+    return GateObligation(
+        "broken_gate[counter-bump]", "tests.lint_fixtures", _build,
+        gate_value=False, lattice=_lattice,
+        out_names=lambda: ["state.vals", "state.counter"],
+        min_gated=4)

@@ -1,0 +1,36 @@
+"""SL506 seeded violation: a kernel whose int32 arithmetic admits
+wraparound under its registered input domains — the deliver clamp is
+computed WITHOUT the latency budget, so `tsend + latency` can exceed
+I32_MAX (exactly the bug class plane.make_params' latency clamp
+exists to rule out). The range analysis must FAIL naming the op and
+its computed interval."""
+
+I32 = 2**31 - 1
+
+
+def build():
+    import jax.numpy as jnp
+
+    def kernel(tsend, latency, window_ns):
+        # BAD: latency is seeded to the FULL positive int32 domain
+        # (no make_params budget), so the add wraps for late sends
+        deliver = jnp.maximum(tsend + latency, window_ns)
+        return deliver
+
+    n = 4
+    return kernel, (jnp.zeros((n,), jnp.int32),
+                    jnp.zeros((n,), jnp.int32), jnp.int32(0))
+
+
+def spec():
+    from shadow_tpu.analysis.ranges import RangeSpec
+
+    return RangeSpec(
+        key="tests.lint_fixtures:unbudgeted_deliver",
+        arg_names=["tsend", "latency", "window_ns"],
+        domains={
+            "tsend": (0, I32 // 4, "send times within the window"),
+            "latency": (0, I32, "UNBUDGETED path latency — the seeded "
+                                "violation"),
+            "window_ns": (0, I32 // 4, "window budget"),
+        })

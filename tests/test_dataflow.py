@@ -148,6 +148,85 @@ def test_pjit_descent_keeps_precision():
     assert out[0] == "t" and out[1] is None
 
 
+def test_custom_jvp_descent_keeps_precision():
+    """`custom_jvp_call` carries its primal body as `call_jaxpr` and
+    inlines 1:1 — the analysis must descend (a clean operand stays
+    clean) instead of falling back to all-outputs-tainted."""
+    @jax.custom_jvp
+    def f(x, y):
+        return x * 2.0, y
+
+    @f.defjvp
+    def f_jvp(primals, tangents):
+        return f(*primals), (tangents[0] * 2.0, tangents[1])
+
+    def fn(a, b):
+        return f(a, b)
+
+    out, _ = _labels(fn, (jnp.float32(1), jnp.float32(2)), {0: "t"})
+    assert out[0] == "t"
+    assert out[1] is None  # descent, not the conservative fallback
+
+
+def test_custom_vjp_descent_keeps_precision():
+    """`custom_vjp_call_jaxpr` spells its body `fun_jaxpr`; same
+    descent contract. The second output passes the clean operand
+    through a genuinely-mixing first output."""
+    @jax.custom_vjp
+    def g(x, y):
+        return x + 0.0 * x, y
+
+    def g_fwd(x, y):
+        return g(x, y), None
+
+    def g_bwd(res, ct):
+        return ct
+
+    g.defvjp(g_fwd, g_bwd)
+
+    def fn(a, b):
+        return g(a, b)
+
+    out, _ = _labels(fn, (jnp.float32(1), jnp.float32(2)), {0: "t"})
+    assert out[0] == "t" and out[1] is None
+
+
+def test_scan_closed_over_const_feeds_carry():
+    """A traced value closed over by the scan body enters as a
+    num_consts operand, NOT a carry: its taint must still reach the
+    carry through the body (and an untouched ys stays clean)."""
+    def fn(k, a, xs):
+        scale = k * 2  # closed over by the body -> scan const
+
+        def body(c, x):
+            return c + scale, x
+
+        return jax.lax.scan(body, a, xs)
+
+    out, _ = _labels(
+        fn, (jnp.int32(3), jnp.int32(0), jnp.zeros(3, jnp.int32)),
+        {0: "k"})
+    assert out[0] == "k"  # carry absorbed the closed-over const
+    assert out[1] is None  # ys = xs passthrough, untouched
+
+
+def test_scan_const_taint_needs_no_carry_seed():
+    """Fixpoint sanity for the const-into-carry flow: the carry starts
+    CLEAN and only the closed-over const is tainted — one body pass
+    must already propagate it (the carry fixpoint may not converge to
+    the clean initial value)."""
+    def fn(k, a, xs):
+        def body(c, x):
+            return jnp.where(x > 0, c + k, c), c
+
+        return jax.lax.scan(body, a, xs)
+
+    out, _ = _labels(
+        fn, (jnp.int32(3), jnp.int32(0), jnp.zeros(3, jnp.int32)),
+        {0: "k"})
+    assert out[0] == "k" and out[1] == "k"
+
+
 def test_leaf_paths_namedtuples_and_dicts():
     from shadow_tpu.tpu import plane
 

@@ -578,6 +578,8 @@ def _poison_first_attempt(monkeypatch, drops=3):
     return calls
 
 
+@pytest.mark.slow  # Manager-driven flow-engine run + poisoned rerun
+# (~35s); stays GATING in CI's flow-engine-slow step (tier-1 budget)
 def test_flowplan_ring_rerun_lands_in_trajectory(monkeypatch):
     from shadow_tpu.core.manager import Manager
 

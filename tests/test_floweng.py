@@ -77,6 +77,8 @@ def test_flow_completion_tracks_cpu_pair_driver():
     assert cpu_us < 4 * dev_us, (dev_us, cpu_us)
 
 
+@pytest.mark.slow  # two full 3s-sim engine runs (~27s); stays GATING
+# in CI's flow-engine-slow step (tier-1 runtime budget)
 def test_flow_world_is_deterministic():
     r1, e1 = run_flows([20, 35, 50], [100_000, 65_536, 32_768],
                        sim_ms=3_000)
@@ -102,6 +104,9 @@ def test_many_heterogeneous_flows_complete():
     assert res["retransmits"] <= F  # lossless wire: only spurious RTOs
 
 
+@pytest.mark.slow  # the saturating + clean twin runs (~53s, the
+# single heaviest tier-1 test); stays GATING in CI's flow-engine-slow
+# step (tier-1 runtime budget)
 def test_saturated_window_rerun_matches_unsaturated():
     """VERDICT r4 #9: a step cap that truncates windows must not distort
     results. run_to_completion re-runs from the initial world with a

@@ -169,6 +169,8 @@ def test_flow_plan_rejects_client_start_past_int32_us():
         compile_flow_plan(cfg, mgr.routing)
 
 
+@pytest.mark.slow  # drives a full flow-engine sim twice (~29s);
+# stays GATING in CI's flow-engine-slow step (tier-1 runtime budget)
 def test_ring_drops_rerun_bucket_with_doubled_queue_slots(monkeypatch):
     """Nonzero engine ring-capacity queue_drops must trigger the same
     re-run discipline as step-cap saturation: a fresh bucket run with

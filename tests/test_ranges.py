@@ -1,0 +1,399 @@
+"""The SL506 integer range analysis (analysis/ranges.py):
+
+- the acceptance gate: the whole registered surface (window_step
+  family, ingest_rows, flow_step, chain_windows) is wrap-free under
+  the checked-in input domains — zero active findings, every residual
+  suppression justified;
+- transfer-function semantics: add/sub/mul wrap detection, exact
+  trunc-division, cumsum/reduce_sum shape factors, the modular
+  exemption, select/clamp joins, the floor_divide/searchsorted
+  library-call models;
+- the while-loop predicate refinement: the `chain_windows` hand-proof
+  (`off + next_ev` stays inside int32 BECAUSE the loop only continues
+  while `next_ev < hs - off`) closes mechanically — and stops closing
+  when the guard is removed;
+- the overflow fixture fails naming the op and its computed interval;
+- report shape: per-entry interval tables, seeds, assumptions.
+"""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from shadow_tpu.analysis import ranges  # noqa: E402
+from shadow_tpu.analysis.ranges import RangeSpec  # noqa: E402
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+
+I32 = 2**31 - 1
+
+
+def _load_fixture(name: str):
+    spec = importlib.util.spec_from_file_location(
+        name.removesuffix(".py"), os.path.join(FIXTURES, name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _analyze(fn, args, domains=None, modular=None, arg_names=None):
+    spec = RangeSpec(
+        key="tests:inline",
+        arg_names=arg_names or [f"a{i}" for i in range(len(args))],
+        domains=domains or {}, modular=modular or {})
+    trace, shape = jax.make_jaxpr(fn, return_shape=True)(*args)
+    return ranges.analyze_entry(spec, trace=trace, args=args,
+                                out_shape=shape)
+
+
+# -- acceptance: the real tree ---------------------------------------------
+
+@pytest.mark.slow  # traces + analyzes the full registered surface;
+# the CI proof gate runs the identical analysis via shadowlint
+# --only SL506, and CI's proof-suite step runs this file UNFILTERED
+@pytest.mark.parametrize(
+    "spec", ranges.range_specs(), ids=lambda s: s.key.split(":")[1])
+def test_registered_surface_is_wrap_free(spec):
+    findings, report = ranges.analyze_entry(spec)
+    active = [f for f in findings if not f.suppressed]
+    assert active == [], "\n".join(f.message for f in active)
+    for f in findings:
+        assert f.justification, f.message  # residuals all justified
+
+
+@pytest.mark.slow  # a second full-surface sweep; CI proof-suite step
+# runs it unfiltered
+def test_report_shape_and_summary():
+    findings, report = ranges.check_all_ranges()
+    assert report["summary"]["active_findings"] == 0
+    assert report["summary"]["entries"] == len(ranges.range_specs())
+    by_key = {s["entry"]: s for s in report["entries"]}
+    lean = by_key["shadow_tpu.tpu.plane:window_step[lean]"]
+    # the per-entry interval table names output leaves with intervals
+    assert any(v is not None for v in lean["outputs"].values())
+    assert lean["seeds"] and lean["assumptions"]
+    # the suppression inventory is explicit in the artifact
+    aqm = by_key["shadow_tpu.tpu.plane:window_step[rr,aqm,loss]"]
+    assert aqm["suppressed"] and not aqm["findings"]
+
+
+# -- transfer semantics ----------------------------------------------------
+
+def test_add_wrap_detected_and_bounded_add_clean():
+    def fn(a, b):
+        return a + b
+
+    args = (jnp.int32(0), jnp.int32(0))
+    findings, _ = _analyze(fn, args,
+                           domains={"a0": (0, I32, "x"),
+                                    "a1": (0, 8, "x")})
+    assert len(findings) == 1 and "add" in findings[0].message
+    assert f"[0, {I32 + 8}]" in findings[0].message
+    findings, _ = _analyze(fn, args,
+                           domains={"a0": (0, I32 // 2, "x"),
+                                    "a1": (0, I32 // 2, "x")})
+    assert findings == []
+
+
+def test_modular_exemption_propagates():
+    def fn(counter, k):
+        return counter + k, k + 1
+
+    args = (jnp.zeros((4,), jnp.int32), jnp.int32(0))
+    findings, _ = _analyze(
+        fn, args, domains={"a1": (0, I32, "x")},
+        modular={"a0": "declared counter"})
+    # counter + k exempt (modular operand); k + 1 still checked
+    assert len(findings) == 1 and "add" in findings[0].message
+
+
+def test_trunc_division_is_exact():
+    def fn(a, b):
+        return a // jnp.int32(125000), jax.lax.div(a, b)
+
+    args = (jnp.int32(0), jnp.int32(1))
+    findings, report = _analyze(
+        fn, args, domains={"a0": (0, 251_499, "x"),
+                           "a1": (1, 1, "y")})
+    assert findings == []
+    # jnp floor-divide is modeled (the q-1 correction arm must not
+    # join): [0, 251499] // 125000 == [0, 2]
+    assert report["outputs"]["[0]"] == [0, 2]
+    assert report["outputs"]["[1]"] == [0, 251_499]
+
+
+def test_cumsum_and_reduce_sum_scale_by_shape():
+    def fn(x):
+        return jnp.cumsum(x, axis=1), x.sum(axis=1, dtype=jnp.int32)
+
+    args = (jnp.zeros((4, 8), jnp.int32),)
+    findings, report = _analyze(fn, args,
+                                domains={"a0": (0, 100, "x")})
+    assert findings == []
+    assert report["outputs"]["[0]"] == [0, 800]
+    assert report["outputs"]["[1]"] == [0, 800]
+    findings, _ = _analyze(fn, args,
+                           domains={"a0": (0, I32 // 4, "x")})
+    assert any("cumsum" in f.message for f in findings)
+
+
+def test_clamp_is_monotone_per_argument():
+    """Review-found soundness bug: clamp bounds must use each
+    operand's MATCHING bound (a computed upper bound below x must not
+    produce an interval excluding reachable values)."""
+    def fn(x, hi):
+        return jnp.clip(x, 0, hi), jnp.clip(jnp.int32(0), x, 1000)
+
+    args = (jnp.int32(0), jnp.int32(0))
+    _, report = _analyze(fn, args,
+                         domains={"a0": (100, 200, "x"),
+                                  "a1": (0, 50, "computed hi")})
+    # clamp(x in [100,200], 0, hi in [0,50]) reaches every value in
+    # [0, 50] (hi=0 -> 0), not just 50
+    assert report["outputs"]["[0]"] == [0, 50]
+    # clamp(0, lo in [100,200], 1000) = lo itself: [100, 200]
+    assert report["outputs"]["[1]"] == [100, 200]
+
+
+def test_clip_launders_the_modular_exemption():
+    """A clip/clamp pins its output into the bound operands' range for
+    ANY input — including a wrapped modular counter — so arithmetic on
+    the clipped value is ordinary checked arithmetic (the flow plane's
+    `clip(deadline - clock, 0, budget)` wake path must be genuinely
+    proven, not modular-exempt). Covers BOTH spellings: jnp.clip (a
+    pjit of max-then-min) and the raw lax.clamp primitive."""
+    def fn(counter):
+        clipped = jnp.clip(counter, 0, jnp.int32(1073))
+        clamped = jax.lax.clamp(jnp.int32(0), counter,
+                                jnp.int32(1073))
+        return clipped * 1_000_000, clipped + jnp.int32(I32), clamped
+
+    args = (jnp.int32(0),)
+    findings, report = _analyze(fn, args,
+                                modular={"a0": "wrapped counter"})
+    # the in-budget product is proven, NOT exempted...
+    assert report["outputs"]["[0]"] == [0, 1_073_000_000]
+    assert report["outputs"]["[2]"] == [0, 1073]
+    # ...and an over-budget add on the clipped value still FAILS
+    assert any("add" in f.message for f in findings)
+
+
+def test_where_select_and_sentinel_join():
+    def fn(valid, x):
+        return jnp.where(valid, x, jnp.int32(I32))
+
+    args = (jnp.zeros((4,), bool), jnp.zeros((4,), jnp.int32))
+    findings, report = _analyze(fn, args,
+                                domains={"a1": (-5, 100, "x")})
+    assert findings == []
+    assert report["outputs"][""] == [-5, I32]
+
+
+def test_searchsorted_modeled_as_insertion_range():
+    def fn(sorted_arr, q):
+        return jnp.searchsorted(sorted_arr, q)
+
+    args = (jnp.zeros((32,), jnp.int32), jnp.zeros((5,), jnp.int32))
+    findings, report = _analyze(
+        fn, args, domains={"a0": (-I32, I32, "x"),
+                           "a1": (-I32, I32, "x")})
+    assert findings == []
+    assert report["outputs"][""] == [0, 32]
+
+
+def test_scan_exact_unroll_bounds_loop_counters():
+    """A bounded scan's carry counter stays exact (no widening): the
+    codel micro-step / searchsorted shape."""
+    def fn(x):
+        def body(c, xi):
+            return c + 1, c
+
+        return jax.lax.scan(body, jnp.int32(0), x)
+
+    args = (jnp.zeros((16,), jnp.int32),)
+    findings, report = _analyze(fn, args)
+    assert findings == []
+    assert report["outputs"]["[0]"] == [16, 16]
+    assert report["outputs"]["[1]"] == [0, 15]
+
+
+def test_allow_suppresses_with_justification():
+    def fn(a):
+        return a + a
+
+    args = (jnp.int32(0),)
+    spec = RangeSpec(
+        key="tests:allowed", arg_names=["a"],
+        domains={"a": (0, I32, "x")},
+        allow={"`add` admits wraparound": "known-masked lanes"})
+    trace, _ = jax.make_jaxpr(fn, return_shape=True)(*args)
+    findings, report = ranges.analyze_entry(spec, trace=trace,
+                                            args=args)
+    assert len(findings) == 1 and findings[0].suppressed
+    assert findings[0].justification == "known-masked lanes"
+    assert report["findings"] == [] and report["suppressed"]
+
+
+# -- the while-loop predicate refinement -----------------------------------
+
+def _chain_shaped(guarded: bool):
+    """The chain_windows arithmetic shape: off += next_ev while
+    next_ev < hs - off (guarded) or unconditionally (broken)."""
+    def fn(hs, step):
+        def cond(c):
+            off, n = c
+            pred = n < 64
+            if guarded:
+                pred = pred & (step < hs - off)
+            return pred
+
+        def body(c):
+            off, n = c
+            return off + step, n + 1
+
+        return jax.lax.while_loop(cond, body,
+                                  (jnp.int32(0), jnp.int32(0)))
+
+    return fn, (jnp.int32(0), jnp.int32(0))
+
+
+def test_while_refinement_proves_the_chain_theorem():
+    """`off + next_ev` fits int32 BECAUSE the predicate keeps both
+    below I32_MAX//2 — the plane.py:650 hand-proof, mechanized."""
+    fn, args = _chain_shaped(guarded=True)
+    findings, _ = _analyze(
+        fn, args, domains={"a0": (0, I32 // 2, "horizon clamp"),
+                           "a1": (0, I32, "unclamped step")})
+    assert findings == []
+
+
+def test_while_without_the_guard_admits_the_wrap():
+    """Drop the predicate and the same arithmetic must FAIL — the
+    refinement is load-bearing, not decorative."""
+    fn, args = _chain_shaped(guarded=False)
+    findings, _ = _analyze(
+        fn, args, domains={"a0": (0, I32 // 2, "horizon clamp"),
+                           "a1": (0, I32, "unclamped step")})
+    assert any("add" in f.message for f in findings)
+
+
+# -- the fixture -----------------------------------------------------------
+
+def test_overflow_fixture_fails_naming_op_and_interval():
+    fixture = _load_fixture("fixture_int_overflow.py")
+    fn, args = fixture.build()
+    trace, _ = jax.make_jaxpr(fn, return_shape=True)(*args)
+    findings, _ = ranges.analyze_entry(fixture.spec(), trace=trace,
+                                       args=args)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "SL506" and not f.suppressed
+    assert "`add`" in f.message
+    assert f"[0, {I32 // 4 + I32}]" in f.message  # computed interval
+
+
+# -- registry hygiene ------------------------------------------------------
+
+def test_every_domain_and_modular_entry_carries_a_why():
+    for spec in ranges.range_specs():
+        for pat, (lo, hi, why) in spec.domains.items():
+            assert lo <= hi and why, (spec.key, pat)
+        for pat, why in spec.modular.items():
+            assert why, (spec.key, pat)
+        for pat, why in spec.allow.items():
+            assert why, (spec.key, pat)
+
+
+# -- the registry's domains are ENFORCED, not just assumed -----------------
+
+def test_window_budget_enforced_at_scenario_parse():
+    """window_ns <= I32_MAX//4 (the registry's _WHY_WINDOW) is a
+    ScenarioError at parse, not a comment."""
+    from shadow_tpu.workloads.spec import ScenarioError, parse_scenario
+
+    base = {"name": "t", "hosts": 4, "windows": 2,
+            "patterns": [{"kind": "onoff", "burst": 1, "rounds": 1}]}
+    with pytest.raises(ScenarioError, match="window_ns"):
+        parse_scenario({**base, "window_ns": I32 // 4 + 1})
+    parse_scenario({**base, "window_ns": I32 // 4})  # the boundary
+
+
+def test_window_budget_enforced_on_config_runahead():
+    """The Manager path's window floor obeys the same budget: a
+    runahead beyond I32_MAX//4 ns is a ConfigError."""
+    from shadow_tpu.core.config import ConfigError, parse_config_dict
+
+    def cfg(runahead):
+        return {
+            "general": {"stop_time": "1s"},
+            "experimental": {"runahead": runahead},
+            "hosts": {"h1": {"network_node_id": 0}},
+        }
+
+    parse_config_dict(cfg("100ms"))
+    with pytest.raises(ConfigError, match="runahead.*budget"):
+        parse_config_dict(cfg("3s"))
+    with pytest.raises(ConfigError, match="runahead"):
+        parse_config_dict(cfg(0))
+
+
+def test_latency_budget_enforced_in_make_params():
+    """Path latencies beyond I32_MAX//2 ns (or negative) are refused
+    at params construction — the deliver-arithmetic budget the
+    `state.in_deliver_rel` domain cites."""
+    import numpy as np
+
+    from shadow_tpu.tpu import plane
+
+    good = dict(loss=np.zeros((2, 2)),
+                up_bw_bps=np.full(2, 1_000_000_000))
+    plane.make_params(
+        latency_ns=np.full((2, 2), I32 // 2), **good)  # boundary
+    with pytest.raises(ValueError, match="latency_ns.*budget"):
+        plane.make_params(
+            latency_ns=np.full((2, 2), I32 // 2 + 1), **good)
+    with pytest.raises(ValueError, match="latency_ns"):
+        plane.make_params(latency_ns=np.full((2, 2), -1), **good)
+
+
+def test_byte_budget_matches_the_registry():
+    """The spec's per-message byte cap IS the registry's BYTES_BUDGET
+    — the two constants must never drift apart."""
+    from shadow_tpu.workloads import spec as wspec
+
+    assert wspec._MAX_BYTES == ranges.BYTES_BUDGET
+    base = {"name": "t", "hosts": 4, "windows": 2,
+            "patterns": [{"kind": "onoff", "burst": 1, "rounds": 1,
+                          "bytes": ranges.BYTES_BUDGET + 1}]}
+    with pytest.raises(wspec.ScenarioError, match="bytes"):
+        wspec.parse_scenario(base)
+
+
+def test_flows_window_floor_still_enforced():
+    """The flow plane's ms-clock floor (window_ns >= 1ms) — part of
+    the same enforced-domain inventory."""
+    from shadow_tpu.workloads.spec import ScenarioError, parse_scenario
+
+    with pytest.raises(ScenarioError, match="1ms"):
+        parse_scenario({
+            "name": "t", "hosts": 4, "windows": 2,
+            "window_ns": 500_000, "transport": "flows",
+            "patterns": [{"kind": "onoff", "burst": 1, "rounds": 1}]})
+
+
+def test_unseeded_leaves_default_to_full_range():
+    """Conservatism check: a leaf the registry forgot defaults to the
+    full dtype range and forces the assumption to be written down."""
+    def fn(a):
+        return a + 1
+
+    findings, report = _analyze(fn, (jnp.int32(0),))
+    assert any("unseeded" in n for n in report["seeds"])
+    assert findings and "add" in findings[0].message

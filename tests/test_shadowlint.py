@@ -448,7 +448,7 @@ def test_clean_fixture_and_sl101_scope():
 def test_rule_registry_complete():
     assert set(RULES) == {f"SL10{i}" for i in range(1, 6)} | {
         f"SL20{i}" for i in range(1, 6)} | {
-        f"SL50{i}" for i in range(1, 5)} | {"SL301", "SL401", "SL402",
+        f"SL50{i}" for i in range(1, 7)} | {"SL301", "SL401", "SL402",
                                             "SL403", "SL405"}
     for rid in ("SL101", "SL102", "SL103", "SL104", "SL105", "SL301",
                 "SL401", "SL402", "SL403", "SL405", "SL503"):
@@ -662,6 +662,7 @@ def _fires_shard():
 
         import jax
 
+        from shadow_tpu.analysis import proofs
         from shadow_tpu.analysis.dataflow import shard_census
 
         spec = importlib.util.spec_from_file_location(
@@ -670,7 +671,58 @@ def _fires_shard():
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         fn, args = mod.build()
-        assert shard_census(jax.make_jaxpr(fn)(*args))["cross_host"]
+        census = shard_census(jax.make_jaxpr(fn)(*args))
+        assert census["cross_host"]
+        # the GATING half: the same cross-host census planted on a
+        # row-local-pinned entry must fail the fence with SL504
+        pinned = sorted(proofs.ROW_LOCAL_PINNED)[0]
+        findings = proofs.check_row_local_fence(
+            {"sections": {key: (census if key == pinned
+                                else {"cross_host": [],
+                                      "host_local": {}, "opaque": []})
+                          for key in proofs.ROW_LOCAL_PINNED}})
+        assert findings and all(f.rule == "SL504" for f in findings)
+        assert pinned in findings[0].path
+    return check
+
+
+def _fires_condeq():
+    def check():
+        import importlib.util
+
+        from shadow_tpu.analysis import condeq
+
+        spec = importlib.util.spec_from_file_location(
+            "fixture_condeq_gate",
+            os.path.join(FIXTURES, "fixture_condeq_gate.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        proof = condeq.check_gate(mod.obligation())
+        assert not proof.ok
+        assert proof.findings \
+            and proof.findings[0].rule == "SL505"
+    return check
+
+
+def _fires_range():
+    def check():
+        import importlib.util
+
+        import jax
+
+        from shadow_tpu.analysis import ranges
+
+        spec = importlib.util.spec_from_file_location(
+            "fixture_int_overflow",
+            os.path.join(FIXTURES, "fixture_int_overflow.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        fn, args = mod.build()
+        trace = jax.make_jaxpr(fn)(*args)
+        findings, _report = ranges.analyze_entry(
+            mod.spec(), trace=trace, args=args)
+        assert findings and findings[0].rule == "SL506" \
+            and not findings[0].suppressed
     return check
 
 
@@ -708,6 +760,8 @@ RULE_TRIGGERS = {
     "SL503": _fires_ast("fixture_donation.py",
                         "shadow_tpu/tpu/f.py", "SL503"),
     "SL504": _fires_shard(),
+    "SL505": _fires_condeq(),
+    "SL506": _fires_range(),
 }
 
 

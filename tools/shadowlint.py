@@ -4,21 +4,26 @@
 Pass 1 lints every Python file under the given paths with the AST
 rules (SL1xx determinism + SL4xx hazards + SL503 donation safety);
 pass 2 abstract-evals the jitted ``tpu/`` kernel entry points and
-audits their jaxprs (SL2xx); pass 3 runs the dataflow proofs over the
-same traced graphs (SL501 presence-invisibility, SL502 op-budget
-ledger) and can emit the SL504 shardability report. Exit code is
-nonzero when any unsuppressed finding (or malformed suppression
-comment) exists.
+audits their jaxprs (SL2xx); pass 3 runs the proofs over the same
+traced graphs (SL501 presence-invisibility, SL502 op-budget ledger,
+SL504 row-local shard fence, SL505 cond branch-equivalence, SL506
+integer ranges) and can emit the SL504/SL505/SL506 artifacts. All
+traced passes share one per-process jaxpr cache
+(``jaxpr_audit.traced``), so each audited entry traces once. Exit
+code is nonzero when any unsuppressed finding (or malformed
+suppression comment) exists.
 
 Usage::
 
     python tools/shadowlint.py                  # all passes, text report
     python tools/shadowlint.py --json           # machine-readable report
     python tools/shadowlint.py --no-jaxpr       # AST pass only (no jax)
-    python tools/shadowlint.py --only SL501,SL502,SL503   # one family
+    python tools/shadowlint.py --only SL501,SL502,SL503,SL504,SL505,SL506
     python tools/shadowlint.py --list-rules     # rule inventory
     python tools/shadowlint.py --write-op-budgets  # regen the ledger
     python tools/shadowlint.py --shard-report sl504.json  # SL504 artifact
+    python tools/shadowlint.py --condeq-report sl505.json # SL505 artifact
+    python tools/shadowlint.py --range-report sl506.json  # SL506 artifact
     python tools/shadowlint.py --recompile      # + jit-cache sweep
     python tools/shadowlint.py shadow_tpu/core  # explicit paths
 
@@ -47,8 +52,9 @@ AST_RULES = frozenset({"SL101", "SL102", "SL103", "SL104", "SL105",
                        "SL301", "SL401", "SL402", "SL403", "SL405",
                        "SL503"})
 JAXPR_RULES = frozenset({"SL201", "SL202", "SL203", "SL204", "SL205"})
-PROOF_RULES = frozenset({"SL501", "SL502"})
-REPORT_RULES = frozenset({"SL504"})
+# SL504's row-local fence gates alongside the proof rules; its full
+# per-entry report stays an artifact (--shard-report)
+PROOF_RULES = frozenset({"SL501", "SL502", "SL504", "SL505", "SL506"})
 
 
 def _iter_py_files(paths):
@@ -97,20 +103,57 @@ def run_jaxpr_pass():
     return audit_all()
 
 
+def _build_condeq_report():
+    """(findings, report) for the SL505 gate surface — the ONE place
+    the report shape is spelled, shared by the proof pass and the
+    `--condeq-report`-without-SL505 fallback."""
+    _force_cpu()
+
+    from shadow_tpu.analysis import condeq
+
+    gate_findings, gate_proofs = condeq.check_all_gates()
+    return gate_findings, {
+        "version": 1,
+        "rule": "SL505",
+        "gates": [p.to_json() for p in gate_proofs],
+    }
+
+
+def _build_range_report():
+    """(findings, report) for the SL506 range surface (same sharing)."""
+    _force_cpu()
+
+    from shadow_tpu.analysis import ranges
+
+    return ranges.check_all_ranges()
+
+
 def run_proof_pass(selected):
-    """Pass 3: SL501 invisibility proofs + SL502 budget diff. Returns
-    (findings, budget_deltas)."""
+    """Pass 3: the dataflow/interval proofs — SL501 invisibility,
+    SL502 budget diff, SL504 row-local fence, SL505 branch-equivalence,
+    SL506 integer ranges. Returns (findings, budget_deltas,
+    condeq_report, range_report); the reports are None for deselected
+    families."""
     _force_cpu()
 
     from shadow_tpu.analysis import proofs
 
     findings, deltas = [], []
+    condeq_report = range_report = None
     if "SL501" in selected:
         findings.extend(proofs.check_all_invisibility())
     if "SL502" in selected:
         budget_findings, deltas = proofs.check_op_budgets()
         findings.extend(budget_findings)
-    return findings, deltas
+    if "SL504" in selected:
+        findings.extend(proofs.check_row_local_fence())
+    if "SL505" in selected:
+        gate_findings, condeq_report = _build_condeq_report()
+        findings.extend(gate_findings)
+    if "SL506" in selected:
+        range_findings, range_report = _build_range_report()
+        findings.extend(range_findings)
+    return findings, deltas, condeq_report, range_report
 
 
 def list_rules() -> str:
@@ -152,6 +195,14 @@ def main(argv=None) -> int:
                     help="write the SL504 shardability report "
                          "(host-local vs cross-host primitives per "
                          "audited section) to FILE")
+    ap.add_argument("--condeq-report", metavar="FILE",
+                    help="write the SL505 branch-equivalence report "
+                         "(per-gate proof mode + lattice coverage) to "
+                         "FILE")
+    ap.add_argument("--range-report", metavar="FILE",
+                    help="write the SL506 range report (per-entry "
+                         "output-interval tables + the assumption "
+                         "inventory) to FILE")
     ap.add_argument("--recompile", action="store_true",
                     help="also run the jit-cache sweep over the "
                          "bench-ladder shapes (slow: compiles kernels)")
@@ -183,12 +234,14 @@ def main(argv=None) -> int:
     else:
         selected = set(_rules.RULES)
 
-    if args.no_jaxpr and args.shard_report:
-        # the report IS a traced pass; per the help text --no-jaxpr
+    if args.no_jaxpr and (args.shard_report or args.condeq_report
+                          or args.range_report):
+        # the reports ARE traced passes; per the help text --no-jaxpr
         # promises "no jax import", so the combination is a
         # contradiction, not a preference
-        print("shadowlint: --shard-report traces the audit registry "
-              "(needs jax); drop --no-jaxpr", file=sys.stderr)
+        print("shadowlint: --shard-report/--condeq-report/"
+              "--range-report trace the audit registry (needs jax); "
+              "drop --no-jaxpr", file=sys.stderr)
         return 2
     if args.no_jaxpr:
         dropped = sorted(selected & (JAXPR_RULES | PROOF_RULES))
@@ -202,14 +255,6 @@ def main(argv=None) -> int:
             print(f"shadowlint: note: --no-jaxpr skips "
                   f"{', '.join(dropped)} of the selected rules",
                   file=sys.stderr)
-    if not (selected & (AST_RULES | JAXPR_RULES | PROOF_RULES)) \
-            and not args.shard_report:
-        # --only SL504 alone: the report rule has no pass/fail pass —
-        # it needs an artifact destination to do anything at all
-        print("shadowlint: the selected rule(s) run no checking pass "
-              "(SL504 is report-only): pass --shard-report FILE to "
-              "emit the report", file=sys.stderr)
-        return 2
 
     paths = args.paths or [os.path.join(_REPO, p) for p in DEFAULT_PATHS]
     findings, malformed = [], []
@@ -221,11 +266,13 @@ def main(argv=None) -> int:
                   f"{exc.args[0]}", file=sys.stderr)
             return 2
     budget_deltas = []
+    condeq_report = range_report = None
     if not args.no_jaxpr:
         if selected & JAXPR_RULES:
             findings.extend(run_jaxpr_pass())
         if selected & PROOF_RULES:
-            proof_findings, budget_deltas = run_proof_pass(selected)
+            (proof_findings, budget_deltas, condeq_report,
+             range_report) = run_proof_pass(selected)
             findings.extend(proof_findings)
 
     findings = [f for f in findings if f.rule in selected]
@@ -239,6 +286,18 @@ def main(argv=None) -> int:
         shard_report = proofs.build_shard_report()
         with open(args.shard_report, "w", encoding="utf-8") as fh:
             json.dump(shard_report, fh, indent=2)
+            fh.write("\n")
+    if args.condeq_report:
+        if condeq_report is None:  # SL505 deselected: report-only run
+            _f, condeq_report = _build_condeq_report()
+        with open(args.condeq_report, "w", encoding="utf-8") as fh:
+            json.dump(condeq_report, fh, indent=2)
+            fh.write("\n")
+    if args.range_report:
+        if range_report is None:  # SL506 deselected: report-only run
+            _f, range_report = _build_range_report()
+        with open(args.range_report, "w", encoding="utf-8") as fh:
+            json.dump(range_report, fh, indent=2)
             fh.write("\n")
 
     recompile_report = None
@@ -275,6 +334,17 @@ def main(argv=None) -> int:
                 for p, ln, t in malformed
             ],
             "op_budget_deltas": budget_deltas,
+            "condeq": condeq_report,
+            "ranges": ({
+                "caveat": range_report["caveat"],
+                "summary": range_report["summary"],
+                "entries": [{
+                    "entry": s["entry"],
+                    "findings": s["findings"],
+                    "suppressed": s["suppressed"],
+                    "unmodeled": s["unmodeled"],
+                } for s in range_report["entries"]],
+            } if range_report is not None else None),
             "recompile": recompile_report,
             "summary": {
                 "active": len(active),
@@ -288,6 +358,20 @@ def main(argv=None) -> int:
 
     for f in active:
         print(f)
+    if condeq_report is not None:
+        print("-- SL505 branch-equivalence proofs:")
+        for g in condeq_report["gates"]:
+            cov = (f", lattice {g['gated_points']}/{g['lattice_points']}"
+                   if g["lattice_points"] else "")
+            print(f"   {g['gate']}: "
+                  f"{'PROVEN' if g['ok'] else 'FAILED'} "
+                  f"[{g['mode']}{cov}] {g['detail']}")
+    if range_report is not None:
+        s = range_report["summary"]
+        print(f"-- SL506 integer ranges: {s['entries']} entries, "
+              f"{s['active_findings']} active, "
+              f"{s['suppressed_findings']} suppressed-with-"
+              "justification")
     if budget_deltas:
         from shadow_tpu.analysis import proofs
 
