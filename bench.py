@@ -484,10 +484,10 @@ def _regression_guard(value: float, fingerprint: dict):
             continue
         rnd = int(m.group(1))
         if best is None or rnd > best[0]:
-            best = (rnd, float(rec.get("value", 0)),
-                    rec.get("backend"))
-    if best is None or best[1] <= 0:
+            best = (rnd, rec.get("value"), rec.get("backend"))
+    if best is None or not best[1] or float(best[1]) <= 0:
         return None
+    best = (best[0], float(best[1]), best[2])
     prior_backend = best[2]
     if prior_backend != fingerprint:
         print(

@@ -19,10 +19,16 @@ Two passes (driven by ``tools/shadowlint.py``):
   with an exhaustive boundary-lattice fallback).
 - ``ranges`` — the SL506 integer range / bit-budget abstract
   interpretation with its checked-in input-domain registry.
+- ``costmodel`` — the SL6xx shadowcost fences over the COMPILED
+  artifacts: SL601 platform-keyed cost budgets
+  (``cost_budgets.json``) + two-shape watermark extrapolation, the
+  SL602 fusion-boundary census and ranked worklist, and the SL603
+  driver-loop host-sync fence.
 
 Plus ``recompile`` — the jit-cache-miss counter harness swept over the
 bench-ladder shapes. All traced passes share one per-process jaxpr
-cache (``jaxpr_audit.traced``).
+cache (``jaxpr_audit.traced``); the cost pass shares one
+lower+compile memo on top of it (``jaxpr_audit.compiled``).
 
 Rule IDs, invariants, and the suppression syntax live in ``rules`` and
 are documented in ``docs/determinism.md``.
@@ -30,9 +36,13 @@ are documented in ``docs/determinism.md``.
 
 from .astlint import lint_file, lint_source, rule_applies
 from .condeq import GateObligation, check_all_gates, gate_obligations
+from .costmodel import (CostEntry, build_cost_report, check_cost_budgets,
+                        check_host_sync, check_watermarks,
+                        default_cost_entries, fusion_boundaries,
+                        write_cost_budgets)
 from .dataflow import leaf_paths, op_census, propagate_taint, shard_census
 from .jaxpr_audit import (AuditEntry, audit_all, audit_entry, audit_jaxpr,
-                          default_entries, traced)
+                          compiled, default_entries, traced)
 from .proofs import (InvisibilitySpec, build_shard_report,
                      check_all_invisibility, check_invisibility,
                      check_op_budgets, check_row_local_fence,
@@ -55,8 +65,17 @@ __all__ = [
     "audit_all",
     "audit_entry",
     "audit_jaxpr",
+    "compiled",
     "default_entries",
     "traced",
+    "CostEntry",
+    "build_cost_report",
+    "check_cost_budgets",
+    "check_host_sync",
+    "check_watermarks",
+    "default_cost_entries",
+    "fusion_boundaries",
+    "write_cost_budgets",
     "leaf_paths",
     "op_census",
     "propagate_taint",

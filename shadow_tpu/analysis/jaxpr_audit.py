@@ -44,6 +44,7 @@ __all__ = [
     "audit_all",
     "audit_entry",
     "audit_jaxpr",
+    "compiled",
     "default_entries",
     "traced",
 ]
@@ -205,8 +206,19 @@ def audit_jaxpr(closed_jaxpr, where: str,
 #: SL506 provers — re-traces the same audited entries; hoisting one
 #: memo here means a full shadowlint run (or the gating CI proof step)
 #: traces each entry ONCE. Entry names are stable per process; callers
-#: passing ad-hoc entries must give distinct names.
+#: passing ad-hoc entries must give distinct names. Values are
+#: (closed_jaxpr, out_shape, args, fn) — the build thunk's fn rides
+#: along so `compiled` below can lower the SAME entry without
+#: re-running the builder.
 _TRACE_CACHE: dict[str, tuple] = {}
+
+#: the compiled-artifact memo on top of the trace cache, keyed
+#: (trace_key, platform): the SL6xx cost fences (analysis/costmodel.py)
+#: pull XLA cost_analysis(), memory_analysis(), and the optimized HLO
+#: text off each audited entry — one lower+compile per entry per
+#: platform, shared across SL601 (cost budgets), SL602 (fusion
+#: boundaries), and the watermark extrapolation.
+_COMPILE_CACHE: dict[tuple[str, str], object] = {}
 
 
 def traced(key: str, build):
@@ -218,8 +230,28 @@ def traced(key: str, build):
 
         fn, args = build()
         closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(*args)
-        hit = (closed, out_shape, args)
+        hit = (closed, out_shape, args, fn)
         _TRACE_CACHE[key] = hit
+    return hit[:3]
+
+
+def compiled(key: str, build):
+    """The compiled XLA executable for one audited entry, memoized
+    per (trace_key, platform). Populates/shares the jaxpr trace memo,
+    then lowers through ``jit(fn).lower(*args).compile()`` exactly
+    once — so a full SL6xx pass (cost budgets + fusion census +
+    watermark extrapolation) compiles each registered entry once, not
+    once per rule family."""
+    import jax
+
+    platform = jax.devices()[0].platform
+    cache_key = (key, platform)
+    hit = _COMPILE_CACHE.get(cache_key)
+    if hit is None:
+        traced(key, build)  # one builder call, shared with every pass
+        _closed, _shape, args, fn = _TRACE_CACHE[key]
+        hit = jax.jit(fn).lower(*args).compile()
+        _COMPILE_CACHE[cache_key] = hit
     return hit
 
 
@@ -259,7 +291,8 @@ class _StubRouting:
 def _plane_entry(rr_enabled: bool, router_aqm: bool, no_loss: bool,
                  packed_sort: bool = True, kernel: str = "xla",
                  telemetry: bool = False, faults: bool = False,
-                 guards: bool = False, trace: bool = False):
+                 guards: bool = False, trace: bool = False,
+                 n: int = 4):
     def build():
         import jax
         import jax.numpy as jnp
@@ -270,7 +303,7 @@ def _plane_entry(rr_enabled: bool, router_aqm: bool, no_loss: bool,
             make_metrics
         from ..tpu import plane
 
-        n, m = 4, 3
+        m = 3
         params = plane.make_params(
             latency_ns=np.full((m, m), 1_000_000, np.int64),
             loss=np.full((m, m), 0.0 if no_loss else 0.01, np.float64),
@@ -376,7 +409,7 @@ def _routing_entry(stage: str):
     return build
 
 
-def _chain_entry(variant: str = "plain"):
+def _chain_entry(variant: str = "plain", n: int = 4):
     """`chain_windows` in each presence-switch compile mode: the chain
     is THE device-resident driver loop, so every pytree that can ride
     its while_loop carry (metrics / guards / the workload generator)
@@ -389,8 +422,6 @@ def _chain_entry(variant: str = "plain"):
         from ..guards.plane import make_guards
         from ..telemetry import make_metrics
         from ..tpu import plane
-
-        n = 4
         params = plane.make_params(
             latency_ns=np.full((n, n), 1_000_000, np.int64),
             loss=np.zeros((n, n)),

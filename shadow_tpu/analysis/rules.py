@@ -291,6 +291,68 @@ RULES: dict[str, RuleInfo] = {
             fixture="fixture_condeq_gate.py",
         ),
         RuleInfo(
+            "SL601", "compiled-cost-budget",
+            "a registered entry's compiled-HLO cost (XLA "
+            "cost_analysis flops / bytes accessed / transcendentals) "
+            "deviates from the platform-keyed "
+            "analysis/cost_budgets.json beyond its tolerance band, or "
+            "its peak temp watermark grows super-linearly across the "
+            "two traced shapes",
+            "the perf fences hold at BUILD time on the compiled "
+            "artifact, which is container-independent for a given "
+            "platform key — where every runtime gate only holds on a "
+            "matched container (the PR-7/PR-11 cross-container "
+            "false-regression lesson). analysis/costmodel.py lowers "
+            "each cached jaxpr through jit().lower().compile(), diffs "
+            "the cost scalars against the checked-in ledger, and "
+            "extrapolates the temp watermark across two host-axis "
+            "shapes (the ROADMAP-2 million-host memory fence); "
+            "legitimate changes regenerate the ledger "
+            "(--write-cost-budgets) so every cost delta is explicit "
+            "in the diff (docs/performance.md 'Static cost fences')",
+            scope="cost registry (analysis/costmodel.default_cost_entries)",
+            fixture="fixture_fusion_break.py",
+        ),
+        RuleInfo(
+            "SL602", "fusion-boundary",
+            "a registered entry's optimized HLO materializes more "
+            "[N,CE]-or-larger intermediates between fusions than the "
+            "checked-in budget (or its fusion count drifts): a "
+            "producer->consumer pair writing + re-reading a "
+            "ring-sized buffer the fusion work should elide",
+            "the compiled-floor attack (ROADMAP-4) is fusion work, "
+            "and its progress must be monotone: every materialized "
+            ">=[N,CE] boundary is a write+read of HBM/cache the "
+            "rank->place->egress pipeline exists to remove, so the "
+            "census is budgeted per entry and the full ranked "
+            "worklist (shape, bytes, both ends, source op_name) is "
+            "the artifact that fusion work consumes "
+            "(--cost-report; docs/performance.md 'Static cost "
+            "fences')",
+            scope="cost registry (analysis/costmodel.default_cost_entries)",
+            fixture="fixture_fusion_break.py",
+        ),
+        RuleInfo(
+            "SL603", "host-sync-fence",
+            "a per-iteration host sync (jax.device_get / .item() / "
+            "float() / np.asarray / block_until_ready on a device "
+            "value) inside a for/while body of a driver-loop module "
+            "(bench.py, tools/chaos_smoke.py, workloads/runner.py, "
+            "tpu/elastic.py)",
+            "the chained driver's whole value is host syncs ONLY at "
+            "chain ends (docs/performance.md 'The driver loop'): a "
+            "blocking D2H read inside a driver loop re-serializes "
+            "the dispatch pipeline per iteration — the SL405 "
+            "telemetry rule generalized to every device value in the "
+            "four modules that own a window loop. Chain-end/teardown "
+            "reads outside loops and values already pulled through "
+            "jax.device_get are the sanctioned pattern; deliberate "
+            "in-loop syncs (the elastic overflow readback) carry "
+            "justified allows in costmodel.HOST_SYNC_ALLOWED",
+            scope="driver-loop modules (costmodel.DRIVER_MODULES)",
+            fixture="fixture_host_sync.py",
+        ),
+        RuleInfo(
             "SL506", "integer-range",
             "a non-exempt signed-int32 op whose interval (seeded from "
             "the checked-in input-domain registry) admits wraparound",

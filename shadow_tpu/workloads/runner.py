@@ -51,15 +51,17 @@ MS = 1_000_000
 
 def digest_pytrees(*pytrees) -> str:
     """sha256 over every leaf's dtype+bytes (the chaos_smoke digest
-    discipline)."""
+    discipline). ONE device_get for the whole tuple — tuple flattening
+    preserves per-tree leaf order, so the digest bytes are identical
+    to a per-tree pull (golden-pinned) without a D2H sync per pytree
+    (the SL603 fence)."""
     import jax
 
     h = hashlib.sha256()
-    for tree in pytrees:
-        for leaf in jax.tree.leaves(jax.device_get(tree)):
-            arr = np.asarray(leaf)
-            h.update(str(arr.dtype).encode())
-            h.update(arr.tobytes())
+    for leaf in jax.tree.leaves(jax.device_get(pytrees)):
+        arr = np.asarray(leaf)
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
     return h.hexdigest()
 
 

@@ -1,0 +1,53 @@
+"""SL601/SL602 seeded violation: a kernel that deliberately
+materializes an [N, CE, CE] intermediate between two fusions — the
+exact pairwise-rank blow-up the rank->place->egress fusion work
+(ROADMAP-4) exists to remove. The producer fusion writes the cube,
+the sort re-reads it, and a budget that pins ``big_boundaries: 0``
+(or any tampered cost scalar) must fail naming the entry, the HLO op
+pair, and the budget-vs-actual delta.
+
+`entry()` returns the CostEntry; `budget(**overrides)` builds the
+ledger document the checker is pointed at (defaults to the kernel's
+LIVE costs, so a test perturbs exactly one number and every other
+metric stays within tolerance).
+"""
+
+N, CE = 8, 8
+
+
+def build():
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(x):
+        # fusion 1 writes the [N, CE, CE] pairwise cube; the sort
+        # cannot fuse with it, so the cube MATERIALIZES between them
+        cube = jnp.exp(x)[:, :, None] * jnp.exp(x)[:, None, :]
+        ranked = jax.lax.sort(cube, dimension=2)
+        # fusion 2 re-reads the sorted cube
+        return (ranked * 2).sum(axis=(1, 2))
+
+    return kernel, (jnp.ones((N, CE), jnp.float32),)
+
+
+def entry():
+    from shadow_tpu.analysis.costmodel import CostEntry
+
+    return CostEntry("tests.lint_fixtures:fusion_break", N, CE, build)
+
+
+def budget(**overrides):
+    """A cost_budgets.json document for the fixture entry: live costs
+    with `overrides` applied (e.g. big_boundaries=0 to seed the SL602
+    violation, or flops=<10x> to seed the SL601 drift)."""
+    from shadow_tpu.analysis.costmodel import (_DEFAULT_TOLERANCE,
+                                               _platform, entry_costs)
+
+    metrics = dict(entry_costs(entry())["metrics"])
+    metrics.update(overrides)
+    return {
+        "version": 1,
+        "tolerance": _DEFAULT_TOLERANCE,
+        "platforms": {_platform(): {
+            "tests.lint_fixtures:fusion_break": metrics}},
+    }

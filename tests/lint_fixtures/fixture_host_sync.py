@@ -1,0 +1,51 @@
+"""SL603 seeded violation: per-iteration host syncs inside a driver
+loop — a ``float()`` on a device value per window, a ``.item()`` tally,
+a ``jax.device_get`` in the body, and a ``block_until_ready`` heartbeat
+— exactly the per-window D2H stalls the chained driver exists to
+amortize to chain ends. The clean shapes below (teardown reads outside
+the loop, values already pulled through ONE device_get, numpy-on-host
+arithmetic) must NOT fire.
+
+Linted AS IF it were a driver module (relpath ``bench.py``) by
+tests/test_costmodel.py.
+"""
+
+import jax
+import numpy as np
+
+
+def drive(state, windows, step):
+    total = 0.0
+    for w in range(windows):
+        state, delivered, metrics = step(state, w)
+        # violation: a blocking per-window materialization
+        total += float(delivered.sum())
+        # violation: a per-window counter read
+        if metrics.events.item() > 0:
+            pass
+        # violation: a per-window device pull
+        snap = jax.device_get(state.n_sent)  # noqa: F841
+        # violation: a per-window pipeline flush
+        jax.block_until_ready(state)
+        # violation, then suppressed: the comment form works here too
+        # shadowlint: disable=SL603 -- fixture: sanctioned debug read
+        probe = np.asarray(delivered)  # noqa: F841
+    return state, total
+
+
+def drain_after(state, windows, step):
+    """The sanctioned pattern: syncs at the chain end, not per
+    iteration."""
+    for w in range(windows):
+        state, _delivered, _metrics = step(state, w)
+    jax.block_until_ready(state)  # teardown flush: outside the loop
+    return float(jax.device_get(state.n_sent).sum())
+
+
+def digest(trees):
+    """One pull, host loop after: the digest_pytrees shape."""
+    total = 0
+    for leaf in jax.tree.leaves(jax.device_get(trees)):
+        arr = np.asarray(leaf)  # host value (device_get'd iterable)
+        total += int(arr.sum())
+    return total

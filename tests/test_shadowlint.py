@@ -448,7 +448,8 @@ def test_clean_fixture_and_sl101_scope():
 def test_rule_registry_complete():
     assert set(RULES) == {f"SL10{i}" for i in range(1, 6)} | {
         f"SL20{i}" for i in range(1, 6)} | {
-        f"SL50{i}" for i in range(1, 7)} | {"SL301", "SL401", "SL402",
+        f"SL50{i}" for i in range(1, 7)} | {
+        f"SL60{i}" for i in range(1, 4)} | {"SL301", "SL401", "SL402",
                                             "SL403", "SL405"}
     for rid in ("SL101", "SL102", "SL103", "SL104", "SL105", "SL301",
                 "SL401", "SL402", "SL403", "SL405", "SL503"):
@@ -726,6 +727,49 @@ def _fires_range():
     return check
 
 
+def _fires_cost(rule: str, **overrides):
+    """SL601/SL602 through the real checker: the fusion-break fixture
+    kernel against a tampered budget (flops drift for SL601, a zeroed
+    boundary count for SL602)."""
+    def check():
+        import importlib.util
+        import json
+        import tempfile
+
+        from shadow_tpu.analysis import costmodel
+
+        spec = importlib.util.spec_from_file_location(
+            "fixture_fusion_break",
+            os.path.join(FIXTURES, "fixture_fusion_break.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        doc = mod.budget(**overrides)
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".json", delete=False) as fh:
+            json.dump(doc, fh)
+        try:
+            findings, _ = costmodel.check_cost_budgets(
+                fh.name, entries=[mod.entry()])
+        finally:
+            os.unlink(fh.name)
+        assert any(f.rule == rule for f in findings), \
+            f"fixture_fusion_break does not trigger {rule}"
+    return check
+
+
+def _fires_host_sync():
+    def check():
+        from shadow_tpu.analysis import costmodel
+
+        with open(os.path.join(FIXTURES, "fixture_host_sync.py"),
+                  encoding="utf-8") as fh:
+            findings = costmodel.check_host_sync_source(
+                fh.read(), "bench.py")
+        assert any(f.rule == "SL603" and not f.suppressed
+                   for f in findings)
+    return check
+
+
 #: rule id -> a check that its fixture actually TRIGGERS it. Keys must
 #: exactly cover the registry: a new rule cannot land without a failing
 #: fixture (test_every_rule_has_a_fixture).
@@ -762,6 +806,9 @@ RULE_TRIGGERS = {
     "SL504": _fires_shard(),
     "SL505": _fires_condeq(),
     "SL506": _fires_range(),
+    "SL601": _fires_cost("SL601", flops=10**9),
+    "SL602": _fires_cost("SL602", big_boundaries=0),
+    "SL603": _fires_host_sync(),
 }
 
 

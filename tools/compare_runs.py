@@ -92,18 +92,20 @@ def _load_bench(path: str) -> dict:
     return rec.get("parsed", rec)  # the PR driver wraps the JSON line
 
 
-def _delta_table(label: str, s0: dict, s1: dict, width: int = 24):
-    """The shared per-key before/after/ratio printer (bench sections
-    and scenario completion tables use the same shape)."""
+def _delta_table(label: str, s0: dict, s1: dict, width: int = 24,
+                 unit: str = "ms"):
+    """The shared per-key before/after/ratio printer (bench sections,
+    scenario completion tables, and the static cost reports all use
+    the same shape; `unit` labels the value columns)."""
     names = sorted(set(s0) | set(s1),
                    key=lambda n: -float(s0.get(n, s1.get(n, 0)) or 0))
-    print(f"{label:<{width}} {'before ms':>10} {'after ms':>10} "
-          f"{'ratio':>7}")
+    print(f"{label:<{width}} {'before ' + unit:>12} "
+          f"{'after ' + unit:>12} {'ratio':>7}")
     for name in names:
         a, b = s0.get(name), s1.get(name)
         ratio = (f"{a / b:.2f}x" if a and b else "-")
         fmt = lambda x: f"{x:.2f}" if x is not None else "-"
-        print(f"{name:<{width}} {fmt(a):>10} {fmt(b):>10} {ratio:>7}")
+        print(f"{name:<{width}} {fmt(a):>12} {fmt(b):>12} {ratio:>7}")
 
 
 def bench_delta(before_path: str, after_path: str) -> int:
@@ -190,6 +192,53 @@ def scenarios_delta(before_path: str, after_path: str) -> int:
     return 0
 
 
+def _cost_metrics(path: str) -> tuple[str | None, dict]:
+    """Load a shadowlint --cost-report record -> (platform key,
+    entry short-name -> metrics dict)."""
+    with open(path) as fh:
+        rec = json.load(fh)
+    per_entry = {}
+    for section in rec.get("entries", []):
+        short = section["entry"].rsplit(":", 1)[-1]
+        per_entry[short] = dict(section.get("metrics") or {})
+    return rec.get("platform"), per_entry
+
+
+def cost_delta(before_path: str, after_path: str) -> int:
+    """Print per-entry flops / bytes-accessed / fusion-count deltas
+    between two shadowlint cost reports (informational — always exits
+    0). Reports whose PLATFORM keys differ get the loud banner: the
+    static-analysis twin of the bench backend-fingerprint rule — an
+    accelerator compile diffed against a CPU compile is a different
+    program, not a cost delta (docs/performance.md)."""
+    p0, e0 = _cost_metrics(before_path)
+    p1, e1 = _cost_metrics(after_path)
+    if p0 != p1:
+        print("=" * 70)
+        print(f"WARNING: platform keys differ — before={p0} "
+              f"after={p1}.")
+        print("The two reports budget DIFFERENT compiled programs; "
+              "the deltas below are\nprinted for completeness only. "
+              "Regenerate both reports on one platform.")
+        print("=" * 70)
+
+    def table(metric, unit):
+        s0 = {k: v.get(metric) for k, v in e0.items()
+              if v.get(metric) is not None}
+        s1 = {k: v.get(metric) for k, v in e1.items()
+              if v.get(metric) is not None}
+        if s0 or s1:
+            _delta_table(f"entry ({metric})", s0, s1, width=32,
+                         unit=unit)
+            print()
+
+    table("flops", "flops")
+    table("bytes_accessed", "B")
+    table("fusions", "count")
+    table("big_boundaries", "count")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("config", nargs="?")
@@ -210,9 +259,18 @@ def main(argv=None) -> int:
              "time deltas per scenario family) instead of running the "
              "determinism harness",
     )
+    ap.add_argument(
+        "--cost", nargs=2, metavar=("BEFORE", "AFTER"), default=None,
+        help="diff two shadowlint --cost-report records (per-entry "
+             "flops/bytes/fusion-count deltas; loud banner when the "
+             "platform keys differ) instead of running the "
+             "determinism harness",
+    )
     args = ap.parse_args(argv)
-    if args.bench is not None and args.scenarios is not None:
-        ap.error("--bench and --scenarios are mutually exclusive")
+    modes = [m for m in (args.bench, args.scenarios, args.cost)
+             if m is not None]
+    if len(modes) > 1:
+        ap.error("--bench/--scenarios/--cost are mutually exclusive")
     if args.bench is not None:
         if args.config or args.matrix or args.runs is not None:
             ap.error("--bench takes exactly two bench JSONs and no config")
@@ -222,6 +280,11 @@ def main(argv=None) -> int:
             ap.error("--scenarios takes exactly two scenario record "
                      "files and no config")
         return scenarios_delta(*args.scenarios)
+    if args.cost is not None:
+        if args.config or args.matrix or args.runs is not None:
+            ap.error("--cost takes exactly two cost reports and no "
+                     "config")
+        return cost_delta(*args.cost)
     if args.config is None:
         ap.error("config is required (or use --bench)")
     if args.matrix and args.runs is not None:

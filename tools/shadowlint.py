@@ -7,11 +7,16 @@ pass 2 abstract-evals the jitted ``tpu/`` kernel entry points and
 audits their jaxprs (SL2xx); pass 3 runs the proofs over the same
 traced graphs (SL501 presence-invisibility, SL502 op-budget ledger,
 SL504 row-local shard fence, SL505 cond branch-equivalence, SL506
-integer ranges) and can emit the SL504/SL505/SL506 artifacts. All
-traced passes share one per-process jaxpr cache
-(``jaxpr_audit.traced``), so each audited entry traces once. Exit
-code is nonzero when any unsuppressed finding (or malformed
-suppression comment) exists.
+integer ranges) and can emit the SL504/SL505/SL506 artifacts; pass 4
+(shadowcost) lowers the cached jaxprs through XLA and fences the
+COMPILED artifacts (SL601 cost budgets + watermark extrapolation,
+SL602 fusion-boundary census, SL603 driver-loop host-sync fence) with
+the ``--cost-report`` artifact. All traced passes share one
+per-process jaxpr cache (``jaxpr_audit.traced``), and the cost pass
+shares one lower+compile memo on top of it (``jaxpr_audit.compiled``),
+so each audited entry traces once and compiles once. Exit code is
+nonzero when any unsuppressed finding (or malformed suppression
+comment) exists.
 
 Usage::
 
@@ -19,11 +24,14 @@ Usage::
     python tools/shadowlint.py --json           # machine-readable report
     python tools/shadowlint.py --no-jaxpr       # AST pass only (no jax)
     python tools/shadowlint.py --only SL501,SL502,SL503,SL504,SL505,SL506
+    python tools/shadowlint.py --only SL601,SL602,SL603  # cost fences
     python tools/shadowlint.py --list-rules     # rule inventory
-    python tools/shadowlint.py --write-op-budgets  # regen the ledger
+    python tools/shadowlint.py --write-op-budgets  # regen the SL502 ledger
+    python tools/shadowlint.py --write-cost-budgets  # regen the SL6xx one
     python tools/shadowlint.py --shard-report sl504.json  # SL504 artifact
     python tools/shadowlint.py --condeq-report sl505.json # SL505 artifact
     python tools/shadowlint.py --range-report sl506.json  # SL506 artifact
+    python tools/shadowlint.py --cost-report cost.json    # SL6xx artifact
     python tools/shadowlint.py --recompile      # + jit-cache sweep
     python tools/shadowlint.py shadow_tpu/core  # explicit paths
 
@@ -55,6 +63,10 @@ JAXPR_RULES = frozenset({"SL201", "SL202", "SL203", "SL204", "SL205"})
 # SL504's row-local fence gates alongside the proof rules; its full
 # per-entry report stays an artifact (--shard-report)
 PROOF_RULES = frozenset({"SL501", "SL502", "SL504", "SL505", "SL506"})
+# pass 4 (analysis/costmodel.py): SL601/SL602 compile the registered
+# cost entries; SL603 is an AST fence over the driver-loop modules but
+# gates with its family (it rides the same registry + report)
+COST_RULES = frozenset({"SL601", "SL602", "SL603"})
 
 
 def _iter_py_files(paths):
@@ -128,6 +140,29 @@ def _build_range_report():
     return ranges.check_all_ranges()
 
 
+def run_cost_pass(selected):
+    """Pass 4: the shadowcost fences (SL601 compiled-cost budgets +
+    watermarks, SL602 fusion boundaries, SL603 host-sync fence).
+    Returns (findings, cost_deltas, cost_report); the report is None
+    when no compiled family was selected. SL601/SL602 need jax (they
+    compile); a pure-SL603 selection is AST-only."""
+    from shadow_tpu.analysis import costmodel
+
+    if {"SL601", "SL602"} & selected:
+        _force_cpu()
+    return costmodel.run_cost_pass(selected & COST_RULES)
+
+
+def _build_cost_report():
+    """Report fallback for a `--cost-report`-without-SL6xx run (one
+    spelling of the artifact, shared with run_cost_pass)."""
+    _force_cpu()
+
+    from shadow_tpu.analysis import costmodel
+
+    return costmodel.build_cost_report()
+
+
 def run_proof_pass(selected):
     """Pass 3: the dataflow/interval proofs — SL501 invisibility,
     SL502 budget diff, SL504 row-local fence, SL505 branch-equivalence,
@@ -191,6 +226,11 @@ def main(argv=None) -> int:
                     help="regenerate analysis/op_budgets.json from the "
                          "live tree (the explicit-ledger-update step "
                          "for a justified op-cost change) and exit")
+    ap.add_argument("--write-cost-budgets", action="store_true",
+                    help="regenerate THIS platform's section of "
+                         "analysis/cost_budgets.json from the live "
+                         "compiled entries (other platforms' budgets "
+                         "are preserved) and exit")
     ap.add_argument("--shard-report", metavar="FILE",
                     help="write the SL504 shardability report "
                          "(host-local vs cross-host primitives per "
@@ -203,6 +243,11 @@ def main(argv=None) -> int:
                     help="write the SL506 range report (per-entry "
                          "output-interval tables + the assumption "
                          "inventory) to FILE")
+    ap.add_argument("--cost-report", metavar="FILE",
+                    help="write the SL6xx cost report (per-entry "
+                         "compiled costs, the ranked fusion-boundary "
+                         "worklist ROADMAP-4 consumes, watermark "
+                         "extrapolations, host-sync scan) to FILE")
     ap.add_argument("--recompile", action="store_true",
                     help="also run the jit-cache sweep over the "
                          "bench-ladder shapes (slow: compiles kernels)")
@@ -222,6 +267,17 @@ def main(argv=None) -> int:
               f"({len(doc['budgets'])} entries)")
         return 0
 
+    if args.write_cost_budgets:
+        _force_cpu()
+
+        from shadow_tpu.analysis import costmodel
+
+        doc = costmodel.write_cost_budgets()
+        plats = {p: len(v) for p, v in doc["platforms"].items()}
+        print(f"wrote {costmodel.cost_budget_path()} "
+              f"(entries per platform: {plats})")
+        return 0
+
     if args.only:
         selected = {r.strip().upper() for r in args.only.split(",")
                     if r.strip()}
@@ -235,16 +291,17 @@ def main(argv=None) -> int:
         selected = set(_rules.RULES)
 
     if args.no_jaxpr and (args.shard_report or args.condeq_report
-                          or args.range_report):
+                          or args.range_report or args.cost_report):
         # the reports ARE traced passes; per the help text --no-jaxpr
         # promises "no jax import", so the combination is a
         # contradiction, not a preference
         print("shadowlint: --shard-report/--condeq-report/"
-              "--range-report trace the audit registry (needs jax); "
-              "drop --no-jaxpr", file=sys.stderr)
+              "--range-report/--cost-report trace the audit registry "
+              "(needs jax); drop --no-jaxpr", file=sys.stderr)
         return 2
     if args.no_jaxpr:
-        dropped = sorted(selected & (JAXPR_RULES | PROOF_RULES))
+        dropped = sorted(selected
+                         & (JAXPR_RULES | PROOF_RULES | COST_RULES))
         if dropped and not (selected & AST_RULES):
             # a "gate" that runs nothing must never report green
             print("shadowlint: --no-jaxpr skips every selected rule "
@@ -266,7 +323,8 @@ def main(argv=None) -> int:
                   f"{exc.args[0]}", file=sys.stderr)
             return 2
     budget_deltas = []
-    condeq_report = range_report = None
+    cost_deltas = []
+    condeq_report = range_report = cost_report = None
     if not args.no_jaxpr:
         if selected & JAXPR_RULES:
             findings.extend(run_jaxpr_pass())
@@ -274,6 +332,10 @@ def main(argv=None) -> int:
             (proof_findings, budget_deltas, condeq_report,
              range_report) = run_proof_pass(selected)
             findings.extend(proof_findings)
+        if selected & COST_RULES:
+            cost_findings, cost_deltas, cost_report = \
+                run_cost_pass(selected)
+            findings.extend(cost_findings)
 
     findings = [f for f in findings if f.rule in selected]
 
@@ -298,6 +360,12 @@ def main(argv=None) -> int:
             _f, range_report = _build_range_report()
         with open(args.range_report, "w", encoding="utf-8") as fh:
             json.dump(range_report, fh, indent=2)
+            fh.write("\n")
+    if args.cost_report:
+        if cost_report is None:  # SL601/602 deselected: report-only
+            cost_report = _build_cost_report()
+        with open(args.cost_report, "w", encoding="utf-8") as fh:
+            json.dump(cost_report, fh, indent=2)
             fh.write("\n")
 
     recompile_report = None
@@ -334,6 +402,22 @@ def main(argv=None) -> int:
                 for p, ln, t in malformed
             ],
             "op_budget_deltas": budget_deltas,
+            "cost_budget_deltas": cost_deltas,
+            "cost": ({
+                "platform": cost_report["platform"],
+                "summary": cost_report["summary"],
+                "watermarks": cost_report["watermarks"],
+                # head only — the FULL ranked list is the
+                # --cost-report artifact (no silent caps)
+                "fusion_worklist_total":
+                    len(cost_report["fusion_worklist"]),
+                "fusion_worklist": cost_report["fusion_worklist"][:20],
+                "entries": [{
+                    "entry": s["entry"],
+                    "metrics": s["metrics"],
+                    "temp_bytes": s["temp_bytes"],
+                } for s in cost_report["entries"]],
+            } if cost_report is not None else None),
             "condeq": condeq_report,
             "ranges": ({
                 "caveat": range_report["caveat"],
@@ -372,11 +456,28 @@ def main(argv=None) -> int:
               f"{s['active_findings']} active, "
               f"{s['suppressed_findings']} suppressed-with-"
               "justification")
+    if cost_report is not None:
+        s = cost_report["summary"]
+        wm = cost_report["watermarks"]
+        print(f"-- SL601/SL602 compiled-cost fences "
+              f"[{cost_report['platform']}]: {s['entries']} entries, "
+              f"{s['budget_deltas']} over budget, "
+              f"{s['watermark_failures']}/{len(wm)} watermark "
+              f"failure(s), worklist {s['worklist']} boundaries")
+        for w in cost_report["fusion_worklist"][:3]:
+            print(f"   worklist: {w['bytes']:>6} B  {w['producer']} -> "
+                  f"{', '.join(w['consumers'])[:40]}  "
+                  f"[{w['entry'].rsplit(':', 1)[-1]}]")
     if budget_deltas:
         from shadow_tpu.analysis import proofs
 
         print("-- op budget vs actual (SL502):")
         print(proofs.format_budget_delta(budget_deltas))
+    if cost_deltas:
+        from shadow_tpu.analysis import costmodel
+
+        print("-- compiled cost budget vs actual (SL601/SL602):")
+        print(costmodel.format_cost_delta(cost_deltas))
     for path, lineno, text in malformed:
         print(f"{path}:{lineno}:1: malformed suppression (missing "
               f"`-- justification`): {text}")
