@@ -75,6 +75,17 @@ INGRESS_CAP = int(os.environ.get("BENCH_INGRESS_CAP", "32"))
 CAPACITY_MODE = os.environ.get("BENCH_CAPACITY", "fixed")
 MAX_DOUBLINGS = int(os.environ.get("BENCH_MAX_DOUBLINGS", "4"))
 GROW_EVERY = int(os.environ.get("BENCH_GROW_EVERY", "16"))
+# BENCH_WORLDS=W (0=off) appends an ensemble rep after the solo run:
+# the SAME PHOLD chain vmapped over W worlds with per-world fold_in
+# keys (tpu/elastic.drive_ensemble — the SL701/SL702-proven driver,
+# docs/determinism.md "Worlds are theorems"), one host sync per chain
+# for the whole ensemble. The JSON gains a `worlds` record with the
+# summed events/s and the amortization ratio vs W sequential solo
+# runs (docs/performance.md "Ensemble amortization"). xla kernel,
+# fixed capacity, no telemetry — per-world ring growth would diverge
+# array shapes across the batch, so the modes are exclusive by
+# construction.
+N_WORLDS = int(os.environ.get("BENCH_WORLDS", "0"))
 SPAWN_PER_DELIVERY = 1
 
 
@@ -351,6 +362,103 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None,
         capacity_info, driver_info
 
 
+def bench_tpu_worlds(solo_rate: float) -> dict:
+    """The BENCH_WORLDS ensemble rep: the PHOLD chain vmapped over
+    N_WORLDS worlds via `drive_ensemble`, per-world keys from the
+    proven `world_keys` fold chain, one compiled batched program per
+    chain. Returns the `worlds` JSON record — summed delivered+sent
+    events/s across the ensemble and the amortization ratio vs
+    running the same W worlds as sequential solo runs (approximated
+    by W x the solo run's measured rate on this container)."""
+    import jax
+    import jax.numpy as jnp
+
+    from shadow_tpu.tpu import (ingest_rows, profiling, unpack_planes,
+                                window_step)
+    from shadow_tpu.tpu import elastic
+    from shadow_tpu.workloads.phold import respawn_batch
+
+    W, N, M = N_WORLDS, N_HOSTS, N_NODES
+    world = profiling.build_world(N, n_nodes=M, egress_cap=EGRESS_CAP,
+                                  ingress_cap=INGRESS_CAP, seed=0,
+                                  warmup_windows=0)
+    state, params = world["state"], world["params"]
+    window = world["window"]
+    keys = elastic.world_keys(world["rng_root"],
+                              jnp.arange(W, dtype=jnp.int32))
+    chain_len = min(GROW_EVERY, ROUNDS) if CAPACITY_MODE != "fixed" \
+        else ROUNDS
+
+    def chain_fn(state, extras, rids, _pr):
+        key, spawn_seq, total = extras
+
+        def round_fn(carry, round_idx):
+            state, spawn_seq = carry
+            shift = jnp.where(round_idx == 0, jnp.int32(0), window)
+            out = window_step(state, params, key, shift, window,
+                              rr_enabled=False)
+            (state, delivered, _nx), _m, _g, _h, _fr = \
+                unpack_planes(out)
+            mask, new_dst, nbytes, seq_vals, ctrl = respawn_batch(
+                delivered, spawn_seq, round_idx, N,
+                state.in_src.shape[1])
+            out = ingest_rows(state, new_dst, nbytes, seq_vals,
+                              seq_vals, ctrl, valid=mask)
+            (state,), _m, _g, _h, _fr = unpack_planes(out, n_lead=1)
+            spawn_seq = spawn_seq + mask.sum(axis=1, dtype=jnp.int32)
+            return (state, spawn_seq), mask.sum(dtype=jnp.int32)
+
+        (state, spawn_seq), nd = jax.lax.scan(
+            round_fn, (state, spawn_seq), rids)
+        zeros = jnp.zeros((N,), jnp.int32)
+        return state, (key, spawn_seq, total + nd.sum()), zeros, zeros
+
+    def stacked(tree):
+        return jax.tree.map(lambda x: jnp.stack([x] * W), tree)
+
+    def run(states):
+        extras = (keys, stacked(jnp.full((N,), 10_000, jnp.int32)),
+                  jnp.zeros((W,), jnp.int32))
+        states, extras = elastic.drive_ensemble(
+            states, extras, chain_fn, n_rounds=ROUNDS,
+            chain_len=chain_len)
+        return states, extras[2]
+
+    # compile run, then the timed run on a fresh replicated state
+    states_out, totals = run(stacked(state))
+    jax.block_until_ready(states_out)
+    state2 = profiling.build_world(
+        N, n_nodes=M, egress_cap=EGRESS_CAP, ingress_cap=INGRESS_CAP,
+        seed=0, warmup_windows=0)["state"]
+    states2 = stacked(state2)
+    jax.block_until_ready(states2)
+    t0 = time.monotonic()
+    states_out, totals = run(states2)
+    totals = np.asarray(jax.device_get(totals), np.int64)
+    jax.block_until_ready(states_out)
+    wall = time.monotonic() - t0
+
+    sent = np.asarray(jax.device_get(states_out.n_sent),
+                      np.int64).sum(axis=tuple(range(
+                          1, states_out.n_sent.ndim)))
+    per_world_events = (totals + sent).tolist()
+    events = int(sum(per_world_events))
+    rate = events / wall
+    return {
+        "n_worlds": W,
+        "driver": "drive_ensemble",
+        "chain_len": chain_len,
+        "events": events,
+        "min_world_events": int(min(per_world_events)),
+        "events_per_sec_sum": round(rate, 1),
+        # summed ensemble throughput vs W sequential solo runs (which
+        # deliver solo_rate in aggregate): >1 means the world axis
+        # amortizes dispatch + compilation across the ensemble
+        "amortization_vs_solo": (round(rate / solo_rate, 2)
+                                 if solo_rate > 0 else None),
+    }
+
+
 def bench_cpu_baseline() -> float:
     """PHOLD on the object plane (Host/EventQueue/Worker path)."""
     from shadow_tpu.core.config import load_config_str
@@ -532,6 +640,7 @@ def main():
         # surface the chained-driver amortization next to the section
         # times so compare_runs --bench diffs it like any other cost
         sections["windows_per_sync"] = driver_info["windows_per_sync"]
+    worlds_info = bench_tpu_worlds(tpu_rate) if N_WORLDS > 0 else None
     cpu_rate = bench_cpu_baseline()
     compiled_rate = bench_compiled_baseline()
     fingerprint = backend_fingerprint()
@@ -547,6 +656,7 @@ def main():
                 "telemetry": telemetry_info,
                 "kernel": kernel_info,
                 "capacity": capacity_info,
+                "worlds": worlds_info,
                 "vs_baseline": round(tpu_rate / cpu_rate, 2),
                 "vs_compiled": (round(tpu_rate / compiled_rate, 3)
                                 if compiled_rate else None),

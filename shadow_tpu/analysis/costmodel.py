@@ -79,6 +79,7 @@ __all__ = [
     "entry_costs",
     "format_cost_delta",
     "fusion_boundaries",
+    "proof_gate_budget_s",
     "run_cost_pass",
     "write_cost_budgets",
 ]
@@ -133,7 +134,8 @@ def default_cost_entries() -> list[CostEntry]:
     window_step and chain_windows carry the two-shape watermark pairs
     the ROADMAP-2 shard_map fence extrapolates from."""
     from .jaxpr_audit import (_chain_entry, _flows_entry,
-                              _ingest_rows_entry, _plane_entry)
+                              _ingest_rows_entry, _plane_entry,
+                              ensemble_step_build)
 
     mod = "shadow_tpu.tpu.plane"
     return [
@@ -153,6 +155,16 @@ def default_cost_entries() -> list[CostEntry]:
                   scale_n=8, scale_build=_chain_entry(n=8)),
         CostEntry("shadow_tpu.tpu.flows:flow_step", 4, 8,
                   _flows_entry("step")),
+        # the SL601 ensemble fence (ISSUE-16): the vmapped ensemble
+        # step at two WORLD counts — `n` here is the scaled dimension
+        # (worlds, not hosts), so the W=2 -> W=4 watermark pair fences
+        # super-linear ensemble memory exactly like the host-axis
+        # n=4 -> n=8 pairs above. The key matches batchdim's @vmapW2
+        # trace-cache variant, so the proof pass and the cost pass
+        # share one trace of the batched step.
+        CostEntry("shadow_tpu.tpu.elastic:ensemble_step[lean]@vmapW2",
+                  2, 8, ensemble_step_build(2),
+                  scale_n=4, scale_build=ensemble_step_build(4)),
     ]
 
 
@@ -627,6 +639,30 @@ def format_cost_delta(deltas: list[dict]) -> str:
 #: per-column pads) that would otherwise dominate tiny trace shapes
 WATERMARK_SLACK = 1.5
 WATERMARK_FLOOR_BYTES = 4096
+
+
+def proof_gate_budget_s(n_cpus: int | None = None) -> int:
+    """THE wall-time budget for the gating shadowlint proof step,
+    scaled to the runner: CI wraps the gate in
+    ``timeout $(python -c 'from shadow_tpu.analysis.costmodel import
+    proof_gate_budget_s; print(proof_gate_budget_s())')``.
+
+    The fixed 30s budget PR 15 inherited failed by ~1.3s on 1-CPU
+    containers, so the pin is now a measured cost model instead of a
+    constant: on a 1-CPU runner the seven SL5xx/SL6xx families cost
+    ~31s and the SL7xx batch pass adds ~45-55s of vmap re-tracing
+    (two world counts over the 28 non-refused entries; measured
+    2026-08 on the CI container class). Tracing parallelizes poorly
+    but XLA compilation and the interpreter walks do gain from extra
+    cores, hence the 1/n term; the constant floor absorbs the
+    serial trace path. Budget = 60 + 120/n seconds, i.e. 180s on the
+    1-CPU runner (~2x the measured 86s total) and 90s at 4 cores —
+    tight enough that a hung trace or an accidental second compile
+    sweep still fails fast, loose enough that scheduler jitter on
+    small runners cannot flake the gate."""
+    if n_cpus is None:
+        n_cpus = os.cpu_count() or 1
+    return 60 + 120 // max(1, n_cpus)
 
 
 def check_watermarks(entries=None) -> tuple[list[Finding], list[dict]]:

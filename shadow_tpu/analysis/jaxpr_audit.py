@@ -46,7 +46,10 @@ __all__ = [
     "audit_jaxpr",
     "compiled",
     "default_entries",
+    "ensemble_step_build",
     "traced",
+    "traced_vmap",
+    "vmap_build",
 ]
 
 # constants above this many bytes should be kernel *arguments*
@@ -233,6 +236,70 @@ def traced(key: str, build):
         hit = (closed, out_shape, args, fn)
         _TRACE_CACHE[key] = hit
     return hit[:3]
+
+
+def vmap_build(build, w: int):
+    """Wrap an audit-entry build thunk into its W-world vmapped
+    variant: every argument leaf is tree-stacked along a new leading
+    world axis and the entry fn is wrapped in ``jax.vmap``. Closed-over
+    data (params tables, the RNG root) stays SHARED across worlds — it
+    lands in the batched jaxpr as constvars, which is exactly the
+    world-free/world-batched split the SL701 axis-provenance walk
+    (analysis/batchdim.py) starts from."""
+    def vbuild():
+        import jax
+        import jax.numpy as jnp
+
+        fn, args = build()
+        vargs = jax.tree.map(lambda x: jnp.stack([x] * w), args)
+        return jax.vmap(fn), vargs
+
+    return vbuild
+
+
+def traced_vmap(key: str, build, w: int):
+    """The ``@vmapW{w}`` trace-cache variant of one audited entry:
+    (closed_jaxpr, out_shape, args) of the entry vmapped over ``w``
+    stacked worlds, memoized in the SAME per-process cache as the solo
+    trace — so the SL701/SL703 batch pass and the SL601 ensemble
+    watermark twins each trace a given (entry, world-count) once."""
+    return traced(f"{key}@vmapW{w}", vmap_build(build, w))
+
+
+def ensemble_step_build(w: int, n: int = 4):
+    """The ensemble consumer at W worlds — the step
+    ``tpu/elastic.drive_ensemble`` vmaps: one loss-enabled
+    ``window_step`` per world with PER-WORLD fold_in keys, shifts, and
+    windows batched along the leading world axis while the params
+    tables stay shared. This is the entry whose SL701 proof covers the
+    batched-RNG path (per-world threefry keys), and the one the SL601
+    W=2/W=4 watermark twins fence for super-linear ensemble memory."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..tpu import elastic, plane
+
+        params = plane.make_params(
+            latency_ns=np.full((n, n), 1_000_000, np.int64),
+            loss=np.full((n, n), 0.01, np.float64),
+            up_bw_bps=np.full(n, 1_000_000_000, np.int64),
+        )
+        state = plane.make_state(n, egress_cap=8, ingress_cap=8,
+                                 params=params)
+        root = jax.random.key(0)
+        keys = elastic.world_keys(root, jnp.arange(w, dtype=jnp.int32))
+        states = jax.tree.map(lambda x: jnp.stack([x] * w), state)
+
+        def step(state, key, shift, window):
+            return plane.window_step(state, params, key, shift, window,
+                                     rr_enabled=False)
+
+        return jax.vmap(step), (states, keys,
+                                jnp.zeros((w,), jnp.int32),
+                                jnp.full((w,), 10_000_000, jnp.int32))
+
+    return build
 
 
 def compiled(key: str, build):

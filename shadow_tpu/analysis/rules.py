@@ -368,6 +368,66 @@ RULES: dict[str, RuleInfo] = {
             scope="range registry (analysis/ranges.range_specs)",
             fixture="fixture_int_overflow.py",
         ),
+        RuleInfo(
+            "SL701", "world-isolation",
+            "a primitive in a vmapped entry's batched jaxpr that "
+            "reduces, gathers, scatters, sorts, concatenates, or "
+            "otherwise combines values ACROSS the leading world axis",
+            "the ensemble contract — world b of a W-world vmapped "
+            "run equals world b's solo run — holds because NO "
+            "dataflow path mixes two worlds: analysis/batchdim.py "
+            "re-traces every audited entry under jax.vmap and walks "
+            "axis provenance through every primitive (broadcast "
+            "moves the world dim by broadcast_dimensions, gather/"
+            "scatter must carry it in their declared batching dims, "
+            "reductions must not name it). A finding names the op, "
+            "its source line, and the offending axis; zero findings "
+            "is the world-isolation theorem the worlds-parity test "
+            "witnesses at runtime (docs/determinism.md 'Worlds are "
+            "theorems')",
+            scope="batch registry (analysis/batchdim.batch_entries)",
+            fixture="fixture_cross_world.py",
+        ),
+        RuleInfo(
+            "SL702", "rng-stream-disjointness",
+            "a per-world RNG key derivation chain that is not "
+            "provably injective in the world seed",
+            "per-world randomness never collides because the "
+            "derivation seed -> key is injective: "
+            "analysis/batchdim.py walks the fold chain's jaxpr "
+            "symbolically (mod-2^n bijections pass outright, "
+            "non-bijective affine steps need a wrap-free interval "
+            "argument over the declared seed domain — the SL506 "
+            "machinery on fold-in arithmetic) and a threefry "
+            "invocation under a fixed root key is a counter-block "
+            "bijection. Distinct seeds therefore yield distinct "
+            "derived keys, so no two worlds ever issue the same "
+            "(key, counter) cipher call — counter streams are "
+            "pairwise disjoint for all b != c",
+            scope="RNG obligation registry "
+                  "(analysis/batchdim.rng_obligations)",
+            fixture="fixture_rng_overlap.py",
+        ),
+        RuleInfo(
+            "SL703", "vmap-traceability-census",
+            "an audited entry that fails to vmap at the two audit "
+            "world counts, whose batched primitive census drifts "
+            "with the world count, or a vmap refusal that is stale "
+            "or rationale-free",
+            "every entry on the audit surface is ensemble-ready BY "
+            "CONSTRUCTION or refuses in writing: "
+            "analysis/batchdim.py traces each entry under vmap at "
+            "W=2 and W=3 and requires an identical primitive census "
+            "(same graph, wider arrays — the world-count "
+            "shape-polymorphism witness). Pallas kernels refuse via "
+            "batchdim.VMAP_REFUSALS with a written rationale, "
+            "exactly like the faults/guards refusals — registered, "
+            "not silent; a refusal naming a de-registered entry is "
+            "itself a finding",
+            scope="batch registry (analysis/batchdim.batch_entries "
+                  "+ batchdim.VMAP_REFUSALS)",
+            fixture="fixture_vmap_refusal.py",
+        ),
     ]
 }
 

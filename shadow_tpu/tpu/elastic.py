@@ -51,8 +51,8 @@ from ..core.capacity import (CAPACITY_MODES, CapacityError,  # noqa: F401
 __all__ = [
     "CAPACITY_MODES", "CapacityError", "CapacityTrajectory", "RingPolicy",
     "canonical_state", "chain_spans", "drive_chained_windows",
-    "grow_state", "grow_transport_state", "next_pow2",
-    "ring_dims", "run_elastic_window",
+    "drive_ensemble", "grow_state", "grow_transport_state", "next_pow2",
+    "ring_dims", "run_elastic_window", "world_key", "world_keys",
 ]
 
 
@@ -292,6 +292,93 @@ def drive_chained_windows(state, extras, chain_fn, *, n_rounds: int,
             if replaced is not None:
                 state, extras = replaced
     return state, extras
+
+
+def world_key(rng_root, seed):
+    """THE per-world RNG key derivation — and the registered SL702
+    obligation (``analysis/batchdim.rng_obligations``).
+
+    ``fold_in(root, seed)`` is one threefry invocation with the ROOT
+    key fixed: a block cipher keyed by a constant is a bijection of
+    its counter block, so distinct 32-bit seeds yield distinct derived
+    key blocks, and every subsequent device draw is
+    ``threefry(derived_key, counter)`` — two worlds with distinct
+    derived keys can never issue the same cipher invocation. That
+    chain (seed -> bijective widen -> fold_in under a fixed key) is
+    exactly what the SL702 prover walks symbolically; changing this
+    derivation to anything non-injective (``seed % k``, ``seed * 2``)
+    fails the proof gate, not a 2x-run parity sweep."""
+    import jax
+
+    return jax.random.fold_in(rng_root, seed)
+
+
+def world_keys(rng_root, seeds):
+    """Vector of per-world keys for :func:`drive_ensemble` — the
+    vmapped :func:`world_key` chain over a batch of world seeds."""
+    import jax
+
+    return jax.vmap(lambda s: world_key(rng_root, s))(seeds)
+
+
+def drive_ensemble(states, extras, chain_fn, *, n_rounds: int,
+                   chain_len: int, start_round: int = 0,
+                   boundaries=(), per_round=None, per_round_axis=None,
+                   on_chain=None):
+    """The PROVEN vmap ensemble driver (ROADMAP item 4): W independent
+    worlds execute the same chained-window schedule as ONE batched
+    program, with one host sync per chain for the whole ensemble.
+
+    ``chain_fn`` is the identical per-world step
+    :func:`drive_chained_windows` drives solo —
+    ``chain_fn(state, extras, round_ids, per_round_slice) ->
+    (state', extras', eg_overflow, in_overflow)`` — vmapped ONCE over
+    the leading world axis of ``states``/``extras``. Per-world inputs
+    (the :func:`world_keys` RNG keys, fault schedules, workload
+    parameters) ride ``extras`` (or ``per_round`` with
+    ``per_round_axis=0``) as batched leaves; ``round_ids`` is shared
+    (in_axes=None), so every world sees the same round schedule and
+    the chain partition is bitwise-identical to the solo run's
+    (:func:`chain_spans` ABSOLUTE alignment).
+
+    Why this is trustworthy without running every world twice: the
+    SL701 world-isolation proofs (analysis/batchdim.py) show the
+    batched step's jaxpr has NO primitive that reduces, gathers,
+    scatters, or concatenates across the world axis, and SL702 proves
+    the per-world RNG streams disjoint — so world b of a W-world run
+    is the solo run of world b by theorem, and the worlds-parity test
+    (tests/test_ensemble.py) pins the canonical digests as the
+    runtime witness.
+
+    Deliberately NOT supported: a capacity ``policy``. Ring growth is
+    per-world (one world's overflow would re-shape every world's
+    arrays), so ensemble runs must be provisioned at fixed capacity —
+    the per-chain overflow totals are surfaced to ``on_chain`` via
+    ``extras`` untouched instead. ``on_chain(r1, states, extras)`` is
+    the ONE host-sync point per chain (harvest/checkpoint cadence for
+    the whole ensemble); returning a (states, extras) pair replaces
+    the carried values, returning None keeps them. Returns the final
+    batched ``(states, extras)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    # jit OUTSIDE the vmap: one compiled batched program per chain
+    # length (the final partial chain retraces once), dispatched W
+    # worlds at a time — the amortization BENCH_WORLDS measures
+    vchain = jax.jit(jax.vmap(chain_fn,
+                              in_axes=(0, 0, None, per_round_axis)))
+    for r0, r1 in chain_spans(n_rounds, chain_len,
+                              start_round=start_round,
+                              boundaries=boundaries):
+        rids = jnp.arange(r0, r1, dtype=jnp.int32)
+        pr = per_round(r0, r1) if per_round is not None else None
+        states, extras, _eg, _in = vchain(states, extras, rids, pr)
+        if on_chain is not None:
+            replaced = on_chain(r1, states, extras)
+            if replaced is not None:
+                states, extras = replaced
+    return states, extras
 
 
 def run_elastic_window(state, attempt_fn, policy: RingPolicy, *,

@@ -1,0 +1,20 @@
+"""SL701 seeded violation: a cross-world reduce inside an ensemble step.
+
+The step normalizes each world's load vector by the ENSEMBLE-wide mean
+— ``jnp.mean(loads)`` reduces over axis 0, which is the world axis, so
+world b's output depends on every other world's state. The provenance
+walk must flag the ``reduce_sum`` (and the broadcast of its result back
+across worlds) as operations that cross the world axis.
+"""
+
+import jax.numpy as jnp
+
+
+def build():
+    def ensemble_step(loads):
+        # BAD: the mean is taken over ALL worlds, then broadcast back —
+        # worlds are no longer isolated.
+        return loads / jnp.mean(loads)
+
+    w = 4
+    return ensemble_step, (jnp.arange(w * 8, dtype=jnp.float32).reshape(w, 8),)

@@ -1,0 +1,26 @@
+"""SL702 seeded violation: a non-injective per-world key derivation.
+
+The chain folds ``seed * 2`` into the root key. Multiplication by an
+even constant is not injective mod 2**32 over the declared seed domain
+(0, 2**31 - 1): seeds b and b + 2**31 collide after the wrap, so two
+worlds would draw the same RNG stream. The fold-chain prover must
+demote the seed at the ``mul`` and report the obligation unproved.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.analysis.batchdim import RngObligation
+
+
+def obligation():
+    def build():
+        root = jax.random.key(0)
+
+        def fn(seed):
+            # BAD: seed * 2 wraps mod 2**32 — worlds collide pairwise.
+            return jax.random.fold_in(root, seed * 2)
+
+        return fn, (jnp.int32(0),), 0, (0, 2**31 - 1)
+
+    return RngObligation("tests.lint_fixtures:doubled_seed", build)

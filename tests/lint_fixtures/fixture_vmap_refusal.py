@@ -1,0 +1,56 @@
+"""SL703 seeded violations: census drift plus refusal-hygiene rot.
+
+Three findings are seeded across ``entries()`` / ``refusals()``:
+
+1. ``world_count_unroll`` is a batched kernel whose graph is unrolled
+   at the Python level over the world count — the primitive census
+   grows with W, so the jaxpr is not world-count-stable (per-world
+   behavior depends on how many worlds ride along).
+2. ``refusals()`` carries a stale key naming no audited entry — the
+   kernel it refused was renamed and the refusal never cleaned up.
+3. ``lazy_refusal`` is refused with a whitespace rationale — a refusal
+   is a registered engineering decision, not a skip.
+"""
+
+import jax.numpy as jnp
+
+from shadow_tpu.analysis.batchdim import BatchEntry
+
+
+def entries():
+    def unroll_build_w(w):
+        def build():
+            def stepped(x):
+                # BAD: Python-level unroll over the world count — the
+                # graph (and its census) grows with W.
+                y = x
+                for _ in range(x.shape[0]):
+                    y = y + 1.0
+                return y
+
+            return stepped, (jnp.zeros((w, 4)),)
+
+        return build
+
+    def plain_build_w(w):
+        def build():
+            def bump(x):
+                return x + 1.0
+
+            return bump, (jnp.zeros((w, 4)),)
+
+        return build
+
+    return [
+        BatchEntry("tests.lint_fixtures:world_count_unroll", unroll_build_w),
+        BatchEntry("tests.lint_fixtures:lazy_refusal", plain_build_w),
+    ]
+
+
+def refusals():
+    return {
+        # BAD: no audited entry by this key.
+        "tests.lint_fixtures:ghost_kernel[pallas]": "ref: manual grid",
+        # BAD: rationale-free refusal on a real (fixture) entry.
+        "tests.lint_fixtures:lazy_refusal": "   ",
+    }

@@ -138,6 +138,45 @@ def test_scan_carry_and_ys():
     assert out[0] == "xs" and out[1] == "xs"
 
 
+def test_vmap_broadcast_carries_taint():
+    """Batched jaxprs (the SL701 ensemble surface) route shared
+    operands through vmap-introduced `broadcast_in_dim`s: taint on the
+    unbatched arg must survive the broadcast into every world's lane,
+    and a clean batched arg must stay clean beside it."""
+    def per_world(x, shared):
+        return x * 2.0, x + shared
+
+    fn = jax.vmap(per_world, in_axes=(0, None))
+    out, _ = _labels(fn, (jnp.ones((2, 3)), jnp.ones(3)), {1: "t"})
+    assert out[0] is None  # world-local product never touches `shared`
+    assert out[1] == "t"   # broadcast_in_dim propagated the taint
+
+
+def test_vmap_batched_scan_carry_taint():
+    """vmap over a scanned body batches the carry: the per-world seed's
+    taint must flow through the batched carry into both the final
+    carry and the stacked ys, while the clean per-world xs stay
+    clean in the untouched output slot."""
+    def per_world(seed, xs):
+        def body(c, x):
+            return c + x, c
+
+        return jax.lax.scan(body, seed, xs)
+
+    fn = jax.vmap(per_world)
+    out, _ = _labels(
+        fn, (jnp.zeros(2, jnp.int32), jnp.zeros((2, 3), jnp.int32)),
+        {0: "seed"})
+    assert out[0] == "seed" and out[1] == "seed"
+
+    # and the converse: clean seed, tainted xs — the batched carry
+    # absorbs xs-taint across iterations exactly as in the solo scan
+    out, _ = _labels(
+        fn, (jnp.zeros(2, jnp.int32), jnp.zeros((2, 3), jnp.int32)),
+        {1: "xs"})
+    assert out[0] == "xs" and out[1] == "xs"
+
+
 def test_pjit_descent_keeps_precision():
     inner = jax.jit(lambda x, y: (x + 1, y))
 

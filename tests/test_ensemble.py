@@ -1,0 +1,140 @@
+"""Worlds-parity: the runtime witness behind the SL701/702 proofs.
+
+`drive_ensemble` batches W independent worlds into one program. The
+SL701 world-isolation proof says no primitive in the batched jaxpr
+crosses the world axis, and SL702 says the per-world RNG streams are
+disjoint — so world b of a W-world run IS the solo run of world b, by
+theorem. This file pins that claim at runtime: per-world slices of the
+ensemble's final canonical state are digest-identical to solo
+`drive_chained_windows` twins driven with the same `world_key`, and
+every world stays live (>0 events).
+
+The W=2 case is tier-1; the 8-world GATING case is @slow and runs
+unfiltered in CI's worlds-parity step.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from shadow_tpu.tpu import (ingest_rows, profiling, unpack_planes,  # noqa: E402
+                            window_step)
+from shadow_tpu.tpu import elastic  # noqa: E402
+from shadow_tpu.workloads.phold import respawn_batch  # noqa: E402
+from shadow_tpu.workloads.runner import digest_pytrees  # noqa: E402
+
+N = 32
+M = 8
+ROUNDS = 12
+CHAIN_LEN = 4
+SPAWN_BASE = 10_000
+
+
+def _world():
+    return profiling.build_world(N, n_nodes=M, egress_cap=8,
+                                 ingress_cap=16, seed=3,
+                                 warmup_windows=1)
+
+
+def _make_chain_fn(params, window):
+    """The per-world PHOLD chain — the SAME function is handed solo to
+    `drive_chained_windows` and batched to `drive_ensemble` (that
+    identity is the whole point of the parity claim)."""
+    def chain_fn(state, extras, rids, _pr):
+        key, spawn_seq, total = extras
+
+        def round_fn(carry, round_idx):
+            state, spawn_seq = carry
+            shift = jnp.where(round_idx == 0, jnp.int32(0), window)
+            out = window_step(state, params, key, shift, window,
+                              rr_enabled=False)
+            (state, delivered, _nx), _m, _g, _h, _fr = \
+                unpack_planes(out)
+            mask, new_dst, nbytes, seq_vals, ctrl = respawn_batch(
+                delivered, spawn_seq, round_idx, N,
+                state.in_src.shape[1])
+            out = ingest_rows(state, new_dst, nbytes, seq_vals,
+                              seq_vals, ctrl, valid=mask)
+            (state,), _m, _g, _h, _fr = unpack_planes(out, n_lead=1)
+            spawn_seq = spawn_seq + mask.sum(axis=1, dtype=jnp.int32)
+            return (state, spawn_seq), mask.sum(dtype=jnp.int32)
+
+        (state, spawn_seq), nd = jax.lax.scan(
+            round_fn, (state, spawn_seq), rids)
+        zeros = jnp.zeros((N,), jnp.int32)
+        return state, (key, spawn_seq, total + nd.sum()), zeros, zeros
+
+    return chain_fn
+
+
+def _solo_run(world, chain_fn, key):
+    extras = (key, jnp.full((N,), SPAWN_BASE, jnp.int32),
+              jnp.zeros((), jnp.int32))
+    state, extras = elastic.drive_chained_windows(
+        world["state"], extras, chain_fn, n_rounds=ROUNDS,
+        chain_len=CHAIN_LEN)
+    return state, extras
+
+
+def _ensemble_run(world, chain_fn, keys, w):
+    stacked = jax.tree.map(lambda x: jnp.stack([x] * w),
+                           world["state"])
+    extras = (keys,
+              jnp.full((w, N), SPAWN_BASE, jnp.int32),
+              jnp.zeros((w,), jnp.int32))
+    return elastic.drive_ensemble(stacked, extras, chain_fn,
+                                  n_rounds=ROUNDS, chain_len=CHAIN_LEN)
+
+
+def _world_slice(tree, b):
+    return jax.tree.map(lambda x: x[b], tree)
+
+
+def _parity(w):
+    world = _world()
+    chain_fn = _make_chain_fn(world["params"], world["window"])
+    keys = elastic.world_keys(world["rng_root"],
+                              jnp.arange(w, dtype=jnp.int32))
+    states, extras = _ensemble_run(world, chain_fn, keys, w)
+    totals = np.asarray(jax.device_get(extras[2]), np.int64)
+
+    # every world is LIVE: spawned events and a non-degenerate run
+    assert (totals > 0).all(), totals
+
+    digests = []
+    for b in range(w):
+        solo_state, solo_extras = _solo_run(world, chain_fn, keys[b])
+        ens = digest_pytrees(
+            elastic.canonical_state(_world_slice(states, b)),
+            _world_slice(extras[1], b), _world_slice(extras[2], b))
+        solo = digest_pytrees(
+            elastic.canonical_state(solo_state),
+            solo_extras[1], solo_extras[2])
+        assert ens == solo, f"world {b}/{w} diverged from its solo twin"
+        digests.append(ens)
+
+    # and the worlds actually SEPARATE: the per-world `world_key` fold
+    # gives every world a distinct trajectory (pairwise-distinct
+    # digests) — parity green with aliased digests would mean the
+    # SL702 premise is broken at the call site
+    assert len(set(digests)) == w, digests
+    return totals
+
+
+def test_worlds_parity_w2():
+    """Tier-1: both worlds of a 2-world ensemble match their solo
+    twins bitwise in canonical digest, and the two trajectories are
+    distinct."""
+    _parity(2)
+
+
+@pytest.mark.slow  # CI's worlds-parity gate runs this file unfiltered
+def test_worlds_parity_w8_gating():
+    """The GATING case: all 8 worlds of an 8-world run digest-match
+    their solo twins and every world processes >0 events."""
+    totals = _parity(8)
+    assert len(totals) == 8

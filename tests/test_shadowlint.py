@@ -449,7 +449,8 @@ def test_rule_registry_complete():
     assert set(RULES) == {f"SL10{i}" for i in range(1, 6)} | {
         f"SL20{i}" for i in range(1, 6)} | {
         f"SL50{i}" for i in range(1, 7)} | {
-        f"SL60{i}" for i in range(1, 4)} | {"SL301", "SL401", "SL402",
+        f"SL60{i}" for i in range(1, 4)} | {
+        f"SL70{i}" for i in range(1, 4)} | {"SL301", "SL401", "SL402",
                                             "SL403", "SL405"}
     for rid in ("SL101", "SL102", "SL103", "SL104", "SL105", "SL301",
                 "SL401", "SL402", "SL403", "SL405", "SL503"):
@@ -770,6 +771,60 @@ def _fires_host_sync():
     return check
 
 
+def _load_fixture(fixture: str):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        fixture.removesuffix(".py"), os.path.join(FIXTURES, fixture))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fires_world():
+    def check():
+        import jax
+
+        from shadow_tpu.analysis import batchdim
+
+        mod = _load_fixture("fixture_cross_world.py")
+        fn, args = mod.build()
+        findings, row = batchdim.world_axis_findings(
+            jax.make_jaxpr(fn)(*args), "fixture:cross_world",
+            args[0].shape[0])
+        assert findings and all(f.rule == "SL701" for f in findings)
+        assert not row["proved"]
+    return check
+
+
+def _fires_rng():
+    def check():
+        from shadow_tpu.analysis import batchdim
+
+        mod = _load_fixture("fixture_rng_overlap.py")
+        findings, row = batchdim.prove_fold_chain(mod.obligation())
+        assert findings and findings[0].rule == "SL702"
+        assert not row["ok"]
+        # the prover names the demoting primitive, not just "unproved"
+        assert "mul" in findings[0].message
+    return check
+
+
+def _fires_refusal():
+    def check():
+        from shadow_tpu.analysis import batchdim
+
+        mod = _load_fixture("fixture_vmap_refusal.py")
+        findings, _rows, _refs = batchdim.check_vmap_census(
+            mod.entries(), refusals=mod.refusals())
+        msgs = " | ".join(f.message for f in findings)
+        assert all(f.rule == "SL703" for f in findings)
+        assert "stale vmap refusal" in msgs
+        assert "without a written rationale" in msgs
+        assert "not world-count-stable" in msgs
+    return check
+
+
 #: rule id -> a check that its fixture actually TRIGGERS it. Keys must
 #: exactly cover the registry: a new rule cannot land without a failing
 #: fixture (test_every_rule_has_a_fixture).
@@ -809,6 +864,9 @@ RULE_TRIGGERS = {
     "SL601": _fires_cost("SL601", flops=10**9),
     "SL602": _fires_cost("SL602", big_boundaries=0),
     "SL603": _fires_host_sync(),
+    "SL701": _fires_world(),
+    "SL702": _fires_rng(),
+    "SL703": _fires_refusal(),
 }
 
 

@@ -11,12 +11,16 @@ integer ranges) and can emit the SL504/SL505/SL506 artifacts; pass 4
 (shadowcost) lowers the cached jaxprs through XLA and fences the
 COMPILED artifacts (SL601 cost budgets + watermark extrapolation,
 SL602 fusion-boundary census, SL603 driver-loop host-sync fence) with
-the ``--cost-report`` artifact. All traced passes share one
-per-process jaxpr cache (``jaxpr_audit.traced``), and the cost pass
-shares one lower+compile memo on top of it (``jaxpr_audit.compiled``),
-so each audited entry traces once and compiles once. Exit code is
-nonzero when any unsuppressed finding (or malformed suppression
-comment) exists.
+the ``--cost-report`` artifact; pass 5 (shadowbatch) re-traces every
+entry under ``jax.vmap`` and proves the ensemble contract (SL701
+world-isolation, SL702 RNG stream disjointness, SL703
+vmap-traceability census) with the ``--batch-report`` artifact. All
+traced passes share one per-process jaxpr cache
+(``jaxpr_audit.traced`` — the batch pass adds ``@vmapW{w}`` key
+variants), and the cost pass shares one lower+compile memo on top of
+it (``jaxpr_audit.compiled``), so each audited entry traces once per
+world count and compiles once. Exit code is nonzero when any
+unsuppressed finding (or malformed suppression comment) exists.
 
 Usage::
 
@@ -25,6 +29,7 @@ Usage::
     python tools/shadowlint.py --no-jaxpr       # AST pass only (no jax)
     python tools/shadowlint.py --only SL501,SL502,SL503,SL504,SL505,SL506
     python tools/shadowlint.py --only SL601,SL602,SL603  # cost fences
+    python tools/shadowlint.py --only SL701,SL702,SL703  # world proofs
     python tools/shadowlint.py --list-rules     # rule inventory
     python tools/shadowlint.py --write-op-budgets  # regen the SL502 ledger
     python tools/shadowlint.py --write-cost-budgets  # regen the SL6xx one
@@ -32,6 +37,7 @@ Usage::
     python tools/shadowlint.py --condeq-report sl505.json # SL505 artifact
     python tools/shadowlint.py --range-report sl506.json  # SL506 artifact
     python tools/shadowlint.py --cost-report cost.json    # SL6xx artifact
+    python tools/shadowlint.py --batch-report batch.json  # SL7xx artifact
     python tools/shadowlint.py --recompile      # + jit-cache sweep
     python tools/shadowlint.py shadow_tpu/core  # explicit paths
 
@@ -67,6 +73,10 @@ PROOF_RULES = frozenset({"SL501", "SL502", "SL504", "SL505", "SL506"})
 # cost entries; SL603 is an AST fence over the driver-loop modules but
 # gates with its family (it rides the same registry + report)
 COST_RULES = frozenset({"SL601", "SL602", "SL603"})
+# pass 5 (analysis/batchdim.py): the world-axis independence proofs
+# over the vmapped audit surface (SL701 isolation, SL702 RNG
+# disjointness, SL703 traceability census + refusal hygiene)
+BATCH_RULES = frozenset({"SL701", "SL702", "SL703"})
 
 
 def _iter_py_files(paths):
@@ -163,6 +173,24 @@ def _build_cost_report():
     return costmodel.build_cost_report()
 
 
+def run_batch_pass(selected):
+    """Pass 5: the shadowbatch world-axis proofs. Returns
+    (findings, batch_report) — the report is the ``--batch-report``
+    artifact and the json-v2 ``batch`` section."""
+    _force_cpu()
+
+    from shadow_tpu.analysis import batchdim
+
+    return batchdim.check_all_batch(selected & BATCH_RULES)
+
+
+def _build_batch_report():
+    """Report fallback for a `--batch-report`-without-SL7xx run (one
+    spelling of the artifact, shared with run_batch_pass)."""
+    _f, report = run_batch_pass(BATCH_RULES)
+    return report
+
+
 def run_proof_pass(selected):
     """Pass 3: the dataflow/interval proofs — SL501 invisibility,
     SL502 budget diff, SL504 row-local fence, SL505 branch-equivalence,
@@ -248,6 +276,11 @@ def main(argv=None) -> int:
                          "compiled costs, the ranked fusion-boundary "
                          "worklist ROADMAP-4 consumes, watermark "
                          "extrapolations, host-sync scan) to FILE")
+    ap.add_argument("--batch-report", metavar="FILE",
+                    help="write the SL7xx batch report (per-entry "
+                         "world-isolation proofs + batched-op census, "
+                         "vmap refusals with rationales, RNG "
+                         "fold-chain proofs) to FILE")
     ap.add_argument("--recompile", action="store_true",
                     help="also run the jit-cache sweep over the "
                          "bench-ladder shapes (slow: compiles kernels)")
@@ -291,17 +324,20 @@ def main(argv=None) -> int:
         selected = set(_rules.RULES)
 
     if args.no_jaxpr and (args.shard_report or args.condeq_report
-                          or args.range_report or args.cost_report):
+                          or args.range_report or args.cost_report
+                          or args.batch_report):
         # the reports ARE traced passes; per the help text --no-jaxpr
         # promises "no jax import", so the combination is a
         # contradiction, not a preference
         print("shadowlint: --shard-report/--condeq-report/"
-              "--range-report/--cost-report trace the audit registry "
-              "(needs jax); drop --no-jaxpr", file=sys.stderr)
+              "--range-report/--cost-report/--batch-report trace the "
+              "audit registry (needs jax); drop --no-jaxpr",
+              file=sys.stderr)
         return 2
     if args.no_jaxpr:
         dropped = sorted(selected
-                         & (JAXPR_RULES | PROOF_RULES | COST_RULES))
+                         & (JAXPR_RULES | PROOF_RULES | COST_RULES
+                            | BATCH_RULES))
         if dropped and not (selected & AST_RULES):
             # a "gate" that runs nothing must never report green
             print("shadowlint: --no-jaxpr skips every selected rule "
@@ -324,7 +360,7 @@ def main(argv=None) -> int:
             return 2
     budget_deltas = []
     cost_deltas = []
-    condeq_report = range_report = cost_report = None
+    condeq_report = range_report = cost_report = batch_report = None
     if not args.no_jaxpr:
         if selected & JAXPR_RULES:
             findings.extend(run_jaxpr_pass())
@@ -336,6 +372,9 @@ def main(argv=None) -> int:
             cost_findings, cost_deltas, cost_report = \
                 run_cost_pass(selected)
             findings.extend(cost_findings)
+        if selected & BATCH_RULES:
+            batch_findings, batch_report = run_batch_pass(selected)
+            findings.extend(batch_findings)
 
     findings = [f for f in findings if f.rule in selected]
 
@@ -366,6 +405,12 @@ def main(argv=None) -> int:
             cost_report = _build_cost_report()
         with open(args.cost_report, "w", encoding="utf-8") as fh:
             json.dump(cost_report, fh, indent=2)
+            fh.write("\n")
+    if args.batch_report:
+        if batch_report is None:  # SL7xx deselected: report-only run
+            batch_report = _build_batch_report()
+        with open(args.batch_report, "w", encoding="utf-8") as fh:
+            json.dump(batch_report, fh, indent=2)
             fh.write("\n")
 
     recompile_report = None
@@ -429,6 +474,22 @@ def main(argv=None) -> int:
                     "unmodeled": s["unmodeled"],
                 } for s in range_report["entries"]],
             } if range_report is not None else None),
+            "batch": ({
+                "caveat": batch_report["caveat"],
+                "summary": batch_report["summary"],
+                "world_counts": batch_report["world_counts"],
+                "refusals": batch_report["refusals"],
+                "rng": [{
+                    "obligation": r["obligation"],
+                    "ok": r["ok"],
+                    "seed_domain": r["seed_domain"],
+                } for r in batch_report["rng"]],
+                "entries": [{
+                    "entry": e["entry"],
+                    "proved": e["proved"],
+                    "findings": e["findings"],
+                } for e in batch_report["entries"]],
+            } if batch_report is not None else None),
             "recompile": recompile_report,
             "summary": {
                 "active": len(active),
@@ -468,6 +529,14 @@ def main(argv=None) -> int:
             print(f"   worklist: {w['bytes']:>6} B  {w['producer']} -> "
                   f"{', '.join(w['consumers'])[:40]}  "
                   f"[{w['entry'].rsplit(':', 1)[-1]}]")
+    if batch_report is not None:
+        s = batch_report["summary"]
+        print(f"-- SL701/SL702/SL703 world-axis proofs "
+              f"[W={'/'.join(map(str, batch_report['world_counts']))}]"
+              f": {s['proved']}/{s['entries']} entries proved, "
+              f"{s['refused']} written refusal(s), "
+              f"{s['rng_obligations']} RNG obligation(s), "
+              f"{s['active_findings']} active finding(s)")
     if budget_deltas:
         from shadow_tpu.analysis import proofs
 
