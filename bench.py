@@ -86,6 +86,21 @@ GROW_EVERY = int(os.environ.get("BENCH_GROW_EVERY", "16"))
 # array shapes across the batch, so the modes are exclusive by
 # construction.
 N_WORLDS = int(os.environ.get("BENCH_WORLDS", "0"))
+# BENCH_MEMO=1 appends a steady-state memoization rep (tpu/memo.py,
+# docs/performance.md "Steady-state memoization"): a ring-allreduce
+# scenario whose window budget runs far past collective completion,
+# timed cold vs memoized in the same process (shared jit cache, both
+# pre-warmed, fresh memo table per run). The JSON gains a `memo`
+# record with both wall times, the EFFECTIVE events/s of each (same
+# event total, so the ratio is pure fast-forward win), the cache
+# stats, and the canonical-digest parity bit — a memo speedup with
+# parity=false is a bug report, not a result. Sized by
+# BENCH_MEMO_HOSTS/BENCH_MEMO_WINDOWS/BENCH_MEMO_CHAIN so CI smokes
+# can run a small twin of the recorded number.
+MEMO = os.environ.get("BENCH_MEMO", "0") == "1"
+MEMO_HOSTS = int(os.environ.get("BENCH_MEMO_HOSTS", "16"))
+MEMO_WINDOWS = int(os.environ.get("BENCH_MEMO_WINDOWS", "4096"))
+MEMO_CHAIN = int(os.environ.get("BENCH_MEMO_CHAIN", "64"))
 SPAWN_PER_DELIVERY = 1
 
 
@@ -459,6 +474,123 @@ def bench_tpu_worlds(solo_rate: float) -> dict:
     }
 
 
+def bench_memo() -> dict:
+    """The BENCH_MEMO rep: the same compiled chain driven cold vs
+    memoized through `drive_chained_windows`.
+
+    The workload is a MEMO_HOSTS-host ring allreduce (the corpus
+    family, real workload plane) driven for MEMO_WINDOWS windows —
+    the collective completes early and the drained steady-state tail
+    dominates, exactly the traffic shape arxiv 2602.10615 targets.
+    Both runs share ONE jitted chain at the SAME span length
+    (MEMO_CHAIN) and run after a warm-up pass, so the timed delta is
+    execution vs replay — not compilation, not dispatch-pattern
+    skew. The memo table is rebuilt from scratch inside the timed
+    memoized run: key digests and recording cost are IN the
+    measurement, replay hits pay for them."""
+    import jax
+    import jax.numpy as jnp
+
+    from shadow_tpu.telemetry import make_metrics
+    from shadow_tpu.tpu import elastic, unpack_planes, window_step
+    from shadow_tpu.workloads import device as wdevice
+    from shadow_tpu.workloads import runner as wrunner
+    from shadow_tpu.workloads.compile import compile_program
+    from shadow_tpu.workloads.spec import parse_scenario
+
+    spec = parse_scenario({
+        "name": f"memo-bench-ring-{MEMO_HOSTS}",
+        "family": "ring_allreduce",
+        "seed": 7,
+        "hosts": MEMO_HOSTS,
+        "windows": MEMO_WINDOWS,
+        "patterns": [{"kind": "ring_allreduce", "first": 0,
+                      "count": MEMO_HOSTS, "bytes": 4096,
+                      "rounds": 1}],
+    })
+    prog = compile_program(spec)
+    state0, params = wrunner.build_scenario_world(spec)
+    wl = wdevice.to_device(prog)
+    ws0 = wdevice.make_workload_state(prog)
+    metrics0 = make_metrics(spec.n_hosts)
+    state0, ws0, metrics0 = wdevice.prime(wl, ws0, state0,
+                                          metrics=metrics0)
+    rng_root = jax.random.key(spec.seed)
+    window = jnp.int32(spec.window_ns)
+
+    def round_fn(carry, ridx):
+        state, ws, metrics = carry
+        shift = jnp.where(ridx == 0, jnp.int32(0), window)
+        out = window_step(state, params, rng_root, shift, window,
+                          rr_enabled=False, metrics=metrics)
+        (state, delivered, _nx), metrics, _g, _h, _fr = \
+            unpack_planes(out, metrics=metrics)
+        state, ws, metrics = wdevice.workload_step(
+            wl, ws, state, delivered, ridx, window,
+            max_advance=wdevice.MAX_ADVANCE, metrics=metrics)
+        return (state, ws, metrics), None
+
+    @jax.jit
+    def chain(state, ws, metrics, rids):
+        carry, _ = jax.lax.scan(round_fn, (state, ws, metrics), rids)
+        return carry
+
+    def chain_fn(state, extras, rids, _pr):
+        ws, metrics = extras[0], extras[1]
+        state, ws, metrics = chain(state, ws, metrics, rids)
+        # runner-shaped extras (6 slots) so the runner's memo
+        # key_extra indexes the workload/flow planes the same way
+        return state, (ws, metrics, None, None, None, None), 0, 0
+
+    def drive(memo_obj):
+        state, extras = elastic.drive_chained_windows(
+            state0, (ws0, metrics0, None, None, None, None), chain_fn,
+            n_rounds=spec.windows, chain_len=MEMO_CHAIN,
+            window_ns=spec.window_ns, memo=memo_obj)
+        jax.block_until_ready(state)
+        return state, extras
+
+    def fresh_memo():
+        memo_obj, _salt, _cl = wrunner._build_memo(
+            {"chain_len": MEMO_CHAIN}, spec=spec, prog=prog,
+            schedule=None, mesh_devices=None,
+            adv=wdevice.MAX_ADVANCE, emit_cap=0, recv_wnd=0,
+            guards=False, histograms=False, sample_every=None,
+            trace_ring=0)
+        return memo_obj
+
+    drive(None)  # warm-up: compiles the one shared chain trace
+    t0 = time.monotonic()
+    state_c, extras_c = drive(None)
+    cold_s = time.monotonic() - t0
+    memo_obj = fresh_memo()
+    t0 = time.monotonic()
+    state_m, extras_m = drive(memo_obj)
+    memo_s = time.monotonic() - t0
+
+    events = int(np.asarray(jax.device_get(extras_c[1].events)))
+    parity = (wrunner.digest_pytrees(
+        elastic.canonical_state(state_c), extras_c[0])
+        == wrunner.digest_pytrees(
+            elastic.canonical_state(state_m), extras_m[0]))
+    return {
+        "scenario": spec.name,
+        "hosts": MEMO_HOSTS,
+        "windows": MEMO_WINDOWS,
+        "chain_len": MEMO_CHAIN,
+        "events": events,
+        "cold_s": round(cold_s, 3),
+        "memo_s": round(memo_s, 3),
+        "effective_evps_cold": round(events / cold_s, 1),
+        "effective_evps_memo": round(events / memo_s, 1),
+        # same event total on both sides, so this IS the effective
+        # ev/s multiplier on steady-state traffic
+        "speedup": round(cold_s / memo_s, 2),
+        "digest_parity": parity,
+        "memo": memo_obj.stats(),
+    }
+
+
 def bench_cpu_baseline() -> float:
     """PHOLD on the object plane (Host/EventQueue/Worker path)."""
     from shadow_tpu.core.config import load_config_str
@@ -641,6 +773,7 @@ def main():
         # times so compare_runs --bench diffs it like any other cost
         sections["windows_per_sync"] = driver_info["windows_per_sync"]
     worlds_info = bench_tpu_worlds(tpu_rate) if N_WORLDS > 0 else None
+    memo_info = bench_memo() if MEMO else None
     cpu_rate = bench_cpu_baseline()
     compiled_rate = bench_compiled_baseline()
     fingerprint = backend_fingerprint()
@@ -657,6 +790,7 @@ def main():
                 "kernel": kernel_info,
                 "capacity": capacity_info,
                 "worlds": worlds_info,
+                "memo": memo_info,
                 "vs_baseline": round(tpu_rate / cpu_rate, 2),
                 "vs_compiled": (round(tpu_rate / compiled_rate, 3)
                                 if compiled_rate else None),

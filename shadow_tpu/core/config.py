@@ -322,6 +322,30 @@ class FlowsOptions:
     recv_wnd: int = 64
 
 
+@dataclass
+class MemoOptions:
+    """The `memo:` config block (no reference counterpart — the
+    steady-state memo plane, `tpu/memo.py`, docs/performance.md
+    "Steady-state memoization"): chain-level delta replay for
+    periodic/quiescent traffic, with replay pinned bitwise-equal to
+    re-execution by the golden corpus parity gate.
+
+    `max_bytes` bounds the LRU replay cache; `min_repeat` is how many
+    times a span key must recur before its delta is recorded (1 =
+    record on first sight); `chain_len` is the memo span length in
+    windows when no telemetry cadence dictates one (shorter spans find
+    more recurrences in a short drained tail, longer spans amortize
+    the per-boundary host snapshot). Like the flow plane, memoization
+    rides the device-plane WINDOW DRIVERS only (`tools/run_scenarios.py
+    --memo`); the block accepts the bare YAML 1.1 spellings
+    ``memo: off`` / ``memo: on``."""
+
+    enabled: bool = False
+    max_bytes: int = 64 << 20
+    min_repeat: int = 1
+    chain_len: int = 4
+
+
 #: valid per-class guard policies (guards/report.py shares this set)
 GUARD_POLICIES = ("off", "warn", "abort", "abort+checkpoint")
 
@@ -472,6 +496,7 @@ class ConfigOptions:
     capacity: CapacityOptions = field(default_factory=CapacityOptions)
     workload: WorkloadOptions = field(default_factory=WorkloadOptions)
     flows: FlowsOptions = field(default_factory=FlowsOptions)
+    memo: MemoOptions = field(default_factory=MemoOptions)
     host_defaults: HostDefaultOptions = field(default_factory=HostDefaultOptions)
     hosts: dict[str, HostOptions] = field(default_factory=dict)
     # strict mode: unsupported feature combinations that normally
@@ -680,6 +705,14 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
             else:
                 cfg.flows = _fill_dataclass(FlowsOptions, value,
                                             "flows")
+        elif key == "memo":
+            # same YAML 1.1 bare off/on hardening as the flows block
+            if value is False:
+                cfg.memo = MemoOptions(enabled=False)
+            elif value is True:
+                cfg.memo = MemoOptions(enabled=True)
+            else:
+                cfg.memo = _fill_dataclass(MemoOptions, value, "memo")
         elif key == "strict":
             if not isinstance(value, bool):
                 raise ConfigError(
@@ -776,6 +809,14 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
             f"flows.recv_wnd ({cfg.flows.recv_wnd}): a window's "
             "emission burst has to fit the receiver's reorder bitmap "
             "or the tail would be discarded on arrival by design")
+    # memo knobs validate unconditionally for the same reason (the
+    # CLI --memo flag flips `enabled` after parsing)
+    if cfg.memo.max_bytes < 1:
+        raise ConfigError("memo.max_bytes must be >= 1")
+    if cfg.memo.min_repeat < 1:
+        raise ConfigError("memo.min_repeat must be >= 1")
+    if cfg.memo.chain_len < 1:
+        raise ConfigError("memo.chain_len must be >= 1")
     if cfg.faults.checkpoint.interval is not None \
             and cfg.faults.checkpoint.interval <= 0:
         raise ConfigError(

@@ -297,6 +297,38 @@ class FaultSchedule:
                            e.corrupt_p, e.symmetric)).encode())
         return h.hexdigest()
 
+    def span_fingerprint(self, t0_ns: int, t1_ns: int) -> str:
+        """Digest of everything the fault plane contributes to the
+        span (t0, t1]: the CURRENT mask state (the masks the span's
+        first window runs under — callers must have `advance`d the
+        schedule to t0 first) plus every still-pending event firing
+        inside the span, with times RELATIVE to t0 so a periodic fault
+        pattern fingerprints equal across its repeats.
+
+        This is the memo plane's span salt (`drive_chained_windows`
+        ``memo_span_salt``): a chain span is only replayable onto
+        another span whose fault masks AND in-span event sequence are
+        identical — the chaos_smoke opt-out discipline ("fault-injected
+        spans are never memoized unless the schedule span fingerprint
+        matches")."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for arr in (self.host_alive, self.link_up, self.bw_div,
+                    self.corrupt_p, self.lat_mult):
+            h.update(str(arr.dtype).encode())
+            h.update(np.ascontiguousarray(arr).tobytes())
+        for e in self.events[self._cursor:]:
+            if e.time_ns <= t0_ns:
+                continue
+            if e.time_ns > t1_ns:
+                break
+            h.update(repr((e.time_ns - t0_ns, e.kind, e.host,
+                           e.src_node, e.dst_node, e.latency_mult,
+                           e.bandwidth_div, e.corrupt_p,
+                           e.symmetric)).encode())
+        return h.hexdigest()
+
     # -- runtime ----------------------------------------------------------
 
     def advance(self, now_ns: int) -> list[FaultEvent]:

@@ -55,6 +55,45 @@ def unwrap_u32(prev_raw, cur_raw):
     return (c - p) % np.int64(_U32)
 
 
+def counter_delta(prev_raw, cur_raw):
+    """Modular uint32 delta between two int32 counter snapshots — the
+    RECORD half of the memo plane's delta replay (`tpu/memo.py`).
+
+    Same modular-2^32 reading of the device counters as
+    :func:`unwrap_u32` (``int(counter_delta(p, c)) == unwrap_u32(p, c)``
+    elementwise, pinned in tests/test_memo.py), kept in uint32 so
+    :func:`apply_counter_delta` wrap-adds it exactly like the device's
+    int32 accumulation does."""
+    p = np.asarray(prev_raw)
+    c = np.asarray(cur_raw)
+    if p.dtype != np.int32 or c.dtype != np.int32:
+        raise TypeError(
+            f"counter_delta wants int32 modular counters, got "
+            f"{p.dtype}/{c.dtype}")
+    # signed->unsigned astype wraps mod 2^32 (C semantics), so the
+    # subtraction is exact through both the 2^31 sign flip and the
+    # 2^32 full wrap
+    return c.astype(np.uint32) - p.astype(np.uint32)
+
+
+def apply_counter_delta(base_raw, delta_u32):
+    """Wrap-add a :func:`counter_delta` onto a live int32 counter — the
+    REPLAY half of the memo plane's delta replay.
+
+    Bitwise-equal to the device having executed the span itself: XLA
+    int32 addition is two's-complement modular, which is exactly
+    uint32 addition reinterpreted, so applying the recorded delta
+    reproduces the cold run's counter through any wrap point (the
+    2^31/2^32 boundary pins in tests/test_memo.py)."""
+    b = np.asarray(base_raw)
+    d = np.asarray(delta_u32)
+    if b.dtype != np.int32 or d.dtype != np.uint32:
+        raise TypeError(
+            f"apply_counter_delta wants int32 base + uint32 delta, got "
+            f"{b.dtype}/{d.dtype}")
+    return (b.astype(np.uint32) + d).astype(np.int32)
+
+
 def _leaves(device) -> dict:
     """Normalize a device-counter source to {name: array}: a
     PlaneMetrics-style NamedTuple, a mapping, or None."""

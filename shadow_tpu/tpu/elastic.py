@@ -216,7 +216,7 @@ def drive_chained_windows(state, extras, chain_fn, *, n_rounds: int,
                           boundaries=(), per_round=None,
                           policy: RingPolicy | None = None,
                           window_ns: int = 0, host_names=None,
-                          on_chain=None):
+                          on_chain=None, memo=None, memo_span_salt=None):
     """THE driver loop. bench.py, tools/chaos_smoke.py, and the
     scenario corpus runner (workloads/runner.py) all drive their
     windows through this one function (pinned by the inspect-source
@@ -260,12 +260,61 @@ def drive_chained_windows(state, extras, chain_fn, *, n_rounds: int,
     states, so under ``policy`` a discarded overflowing chain replays
     the flow machine from the chain-start snapshot too — retransmit
     schedules stay bitwise-reproducible through elastic growth.
+
+    ``memo`` (a `tpu/memo.ChainMemo`, docs/performance.md
+    "Steady-state memoization") makes the chain span the memo unit: at
+    every span boundary the carry is snapshotted to host and keyed; a
+    hit REPLAYS the recorded post-chain carry (keyed substitution +
+    modular counter deltas, bitwise-equal to execution) instead of
+    dispatching, and consecutive hits with no `on_chain` hook
+    fast-forward entirely on host — no device round-trip at all. A
+    miss executes normally (including under ``policy``) and records.
+    ``memo_span_salt(r0, r1) -> bytes`` folds per-span external inputs
+    into the key — the fault schedule's span fingerprint — and is
+    REQUIRED whenever ``per_round`` is set: time-varying inputs the
+    key cannot see would otherwise replay across non-equivalent spans,
+    so that combination raises instead of guessing.
     """
     import jax.numpy as jnp
+
+    if memo is not None and per_round is not None and memo_span_salt is None:
+        raise ValueError(
+            "drive_chained_windows: memo with per_round inputs needs a "
+            "memo_span_salt folding them into the key (e.g. the fault "
+            "schedule's span_fingerprint) — refusing to memoize spans "
+            "whose external inputs the key cannot see")
+
+    host_carry = None  # memo's host mirror of (state, extras)
+    stale = False      # device carry behind host_carry (hits pending)
+
+    def _upload():
+        nonlocal state, extras, stale
+        state, extras = memo.to_device(host_carry)
+        stale = False
 
     for r0, r1 in chain_spans(n_rounds, chain_len,
                               start_round=start_round,
                               boundaries=boundaries):
+        pre_walk = None
+        if memo is not None:
+            if host_carry is None:
+                host_carry = memo.snapshot(state, extras)
+            salt = (memo_span_salt(r0, r1)
+                    if memo_span_salt is not None else b"")
+            key, pre_walk = memo.key(host_carry, r0, r1, span_salt=salt)
+            entry = memo.lookup(key)
+            if entry is not None:
+                host_carry = memo.replay(entry, host_carry)
+                stale = True
+                if on_chain is not None:
+                    _upload()
+                    replaced = on_chain(r1, state, extras)
+                    if replaced is not None:
+                        state, extras = replaced
+                        host_carry = None  # device is authoritative
+                continue
+            if stale:
+                _upload()
         rids = jnp.arange(r0, r1, dtype=jnp.int32)
         pr = per_round(r0, r1) if per_round is not None else None
         if policy is None:
@@ -287,10 +336,16 @@ def drive_chained_windows(state, extras, chain_fn, *, n_rounds: int,
                 e.chain_span = (r0, r1)
                 raise
             state, extras = out
+        if memo is not None:
+            host_carry = memo.snapshot(state, extras)
+            memo.record(key, pre_walk, host_carry, span_len=r1 - r0)
         if on_chain is not None:
             replaced = on_chain(r1, state, extras)
             if replaced is not None:
                 state, extras = replaced
+                host_carry = None
+    if stale:
+        _upload()
     return state, extras
 
 

@@ -145,7 +145,8 @@ def run_scenario(spec: ScenarioSpec, *,
                  hops_sink=None,
                  max_advance: Optional[int] = None,
                  flow_emit_cap: Optional[int] = None,
-                 flow_recv_wnd: Optional[int] = None) -> dict:
+                 flow_recv_wnd: Optional[int] = None,
+                 memo=None) -> dict:
     """Execute one scenario for its full window budget. Returns the
     JSON-ready record (no wall-clock anywhere — byte-stable across
     runs by construction).
@@ -157,7 +158,22 @@ def run_scenario(spec: ScenarioSpec, *,
     (seeded from the scenario seed) and drains sampled hops at the
     telemetry cadence into `hops_sink` (a path or file object). Both
     are presence switches: the canonical digest is bitwise-unchanged
-    (docs/observability.md "Distributions and the flight recorder")."""
+    (docs/observability.md "Distributions and the flight recorder").
+
+    `memo` (a config `MemoOptions`, a dict of its knobs, or True for
+    defaults) turns on steady-state memoization (`tpu/memo.py`,
+    docs/performance.md): chain spans whose full carry recurs bitwise
+    — the drained tail of a completed collective, quiescent stretches
+    of periodic traffic — replay their recorded post-state instead of
+    executing, with the canonical digest pinned byte-equal to the cold
+    run (the golden `--check` gate passes unchanged). The memo key
+    folds the scenario fingerprint + program digest, every dynamics
+    knob, the absolute round while any workload host is still live
+    (done_win stamps absolute rounds), the flow plane's virtual clock
+    while any flow could read it, and — under faults — the schedule's
+    span fingerprint, so fault-injected spans never replay across
+    non-identical fault contexts. Not supported with `mesh_devices`
+    (the host-mirror fast-forward would collapse the sharding)."""
     import jax
     import jax.numpy as jnp
 
@@ -341,14 +357,23 @@ def run_scenario(spec: ScenarioSpec, *,
             if recorder is not None:
                 recorder.tick(fstate)
 
+    memo_obj, memo_salt_fn, memo_chain = _build_memo(
+        memo, spec=spec, prog=prog, schedule=schedule,
+        mesh_devices=mesh_devices, adv=adv, emit_cap=emit_cap,
+        recv_wnd=recv_wnd, guards=guards, histograms=histograms,
+        sample_every=sample_every, trace_ring=trace_ring)
+
     need_cadence = telemetry is not None or recorder is not None
     state, extras = _elastic.drive_chained_windows(
         state, (ws, metrics, gstate, hstate, fstate, flowst), chain_fn,
         n_rounds=spec.windows,
-        chain_len=telemetry_every if need_cadence else spec.windows,
+        chain_len=(telemetry_every if need_cadence
+                   else memo_chain if memo_obj is not None
+                   else spec.windows),
         per_round=per_round if faulted else None,
         window_ns=spec.window_ns,
-        on_chain=on_chain if need_cadence else None)
+        on_chain=on_chain if need_cadence else None,
+        memo=memo_obj, memo_span_salt=memo_salt_fn)
     ws, metrics, gstate, hstate, fstate, flowst = extras
 
     jax.block_until_ready(state)
@@ -397,6 +422,8 @@ def run_scenario(spec: ScenarioSpec, *,
             **flowsmod.flow_totals(ftab, flowst),
             "emit_cap": emit_cap, "recv_wnd": recv_wnd,
         }
+    if memo_obj is not None:
+        record["memo"] = memo_obj.report()
     if gstate is not None:
         record["guards"] = summarize(gstate)
     if hstate is not None:
@@ -427,6 +454,85 @@ def run_scenario(spec: ScenarioSpec, *,
             telemetry.tick(spec.windows * spec.window_ns,
                            device=_device_counters(metrics, hstate))
     return record
+
+
+def _build_memo(memo, *, spec, prog, schedule, mesh_devices, adv,
+                emit_cap, recv_wnd, guards, histograms, sample_every,
+                trace_ring):
+    """Normalize the `memo` argument (None/bool/MemoOptions/dict) into
+    a (ChainMemo, span_salt_fn, chain_len) triple for the driver.
+
+    The static salt folds everything the chain closure captures that
+    the carry cannot show: the scenario fingerprint (world build +
+    seed + window_ns), the program digest (the compiled send tables),
+    and every dynamics knob. `key_extra` folds the two
+    state-conditional sensitivities (docstring of `run_scenario`):
+    the absolute start round while any workload host is live, and the
+    flow plane's raw virtual clock while anything could read it — a
+    flow timer armed, an RTT probe outstanding, unacked stream bytes,
+    a pending ack, receiver bitmap content, or ANY packet still in a
+    net-plane ring (a stale duplicate ack re-arms timers on arrival).
+    """
+    if memo is None or memo is False:
+        return None, None, None
+    knob = (memo.get if isinstance(memo, dict)
+            else lambda k, d: getattr(memo, k, d))
+    if memo is not True and not knob("enabled", True):
+        return None, None, None
+    if mesh_devices is not None:
+        raise ValueError(
+            "memo does not support mesh_devices: the host-mirror "
+            "fast-forward re-uploads un-sharded arrays, collapsing "
+            "the host-axis sharding")
+    from ..tpu import memo as memomod
+
+    salt = "|".join([
+        "memo-v1", scenario_fingerprint(spec), program_digest(prog),
+        f"adv={adv}", f"emit={emit_cap}", f"wnd={recv_wnd}",
+        f"guards={int(guards)}", f"hist={int(histograms)}",
+        f"se={sample_every}", f"ring={trace_ring}",
+    ]).encode()
+    n_phases_host = np.asarray(prog.n_phases)
+
+    def key_extra(carry, r0):
+        mstate, mextras = carry
+        mws, mflow = mextras[0], mextras[5]
+        parts = []
+        if bool((np.asarray(mws.phase) < n_phases_host).any()):
+            parts.append(b"r0:%d" % r0)
+        if mflow is not None:
+            live = bool(
+                np.asarray(mflow.rto_armed).any()
+                or (np.asarray(mflow.rtt_seq) >= 0).any()
+                or (np.asarray(mflow.snd_una)
+                    != np.asarray(mflow.stream_len)).any()
+                or np.asarray(mflow.ack_pending).any()
+                or np.asarray(mflow.rcv_bits).any()
+                or np.asarray(mstate.eg_valid).any()
+                or np.asarray(mstate.in_valid).any())
+            parts.append(b"clk:" + (
+                np.ascontiguousarray(mflow.clock_ms).tobytes()
+                if live else b"idle"))
+        return b"|".join(parts)
+
+    memo_obj = memomod.ChainMemo(
+        max_bytes=int(knob("max_bytes", 64 << 20)),
+        min_repeat=int(knob("min_repeat", 1)),
+        salt=salt, key_extra=key_extra)
+    salt_fn = None
+    if schedule is not None:
+        def salt_fn(r0, r1):
+            # keep the schedule position current even across memo hits
+            # (hits skip per_round, which is what normally advances
+            # it); advancing to r0 is a no-op on the miss path
+            schedule.advance(r0 * spec.window_ns)
+            return schedule.span_fingerprint(
+                r0 * spec.window_ns, r1 * spec.window_ns).encode()
+    # 4-window spans by default: short enough that the drained tail
+    # of every corpus entry yields equal-length recurring spans (the
+    # final partial span would otherwise never match), long enough to
+    # amortize the per-boundary host snapshot
+    return memo_obj, salt_fn, int(knob("chain_len", 4))
 
 
 def _device_counters(metrics, hstate):

@@ -170,6 +170,8 @@ def test_chain_spans_absolute_alignment():
     assert elastic.chain_spans(10, 4, start_round=16) == []
 
 
+@pytest.mark.slow  # growth mid-chain parity (~12s); CI's
+# shared-driver gate runs this file unfiltered
 def test_elastic_growth_mid_chain_matches_preprovisioned():
     """A PHOLD chain started on deliberately tiny rings under the
     elastic policy grows mid-chain (snapshot + re-execute per CHAIN)
@@ -245,6 +247,8 @@ def test_elastic_growth_mid_chain_matches_preprovisioned():
     assert np.array_equal(np.asarray(spawn_el), np.asarray(spawn_pre))
 
 
+@pytest.mark.slow  # presence-switch parity sweep (~9s); CI's
+# shared-driver gate runs this file unfiltered
 def test_chain_windows_presence_switches_are_invisible():
     """The while_loop idle chain with metrics/guards threaded ends in
     the same state as the bare chain, and the accumulators count every

@@ -349,6 +349,8 @@ def test_workload_append_only_rejects_an_ingress_write():
 
 # -- SL502: the op-budget ledger -------------------------------------------
 
+@pytest.mark.slow  # re-derives every budget from the tree (~13s);
+# CI's prover-suites step runs this file unfiltered
 def test_checked_in_budgets_match_the_tree():
     """The acceptance gate: analysis/op_budgets.json is current. On
     drift, regenerate with `python tools/shadowlint.py

@@ -141,6 +141,8 @@ def _drive(params, state, batches, *, rr, aqm, no_loss, policy=None,
 
 # -- the headline: elastic == pre-provisioned, bitwise --------------------
 
+@pytest.mark.slow  # 4-cell growth-parity matrix (~62s); stays GATING
+# in CI's tier-1-overflow unfiltered step
 @pytest.mark.parametrize("rr,aqm,no_loss", [
     (False, False, False),
     (True, False, False),
@@ -597,6 +599,8 @@ def test_flowplan_ring_rerun_lands_in_trajectory(monkeypatch):
     assert stats.process_failures == []
 
 
+@pytest.mark.slow  # Manager-driven flow-engine run (~22s); stays
+# GATING in CI's tier-1-overflow unfiltered step
 def test_flowplan_strict_refuses_ring_drops(monkeypatch):
     from shadow_tpu.core.manager import Manager
 

@@ -125,6 +125,8 @@ def _parity(w):
     return totals
 
 
+@pytest.mark.slow  # 2-world ensemble + solo twins (~18s); CI's
+# worlds-parity gate runs this file unfiltered
 def test_worlds_parity_w2():
     """Tier-1: both worlds of a 2-world ensemble match their solo
     twins bitwise in canonical digest, and the two trajectories are

@@ -637,6 +637,8 @@ def _chaos(argv):
     return chaos_smoke.main(argv)
 
 
+@pytest.mark.slow  # two chaos_smoke CLI runs (~11s); stays GATING in
+# CI's tier-1-overflow unfiltered step
 def test_chaos_smoke_telemetry_byte_stable(tmp_path, capsys):
     """The chaos driver with --telemetry + --sample-every: two
     identical runs produce byte-identical heartbeats, hops, and

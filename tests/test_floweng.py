@@ -33,6 +33,8 @@ def run_flows(latencies_ms, sizes, sim_ms, window_ms=None, starts_ms=None):
     return floweng.flow_results(world), np.asarray(events)
 
 
+@pytest.mark.slow  # 4s-sim engine run (~18s); stays GATING in CI's
+# tier-1-overflow unfiltered step
 def test_single_flow_completes_cleanly():
     res, events = run_flows([20], [200_000], sim_ms=4_000)
     assert res["bytes_read"].tolist() == [200_000]
@@ -50,6 +52,8 @@ def test_single_flow_completes_cleanly():
     assert events[-1] <= 1
 
 
+@pytest.mark.slow  # engine run + CPU pair harness (~21s); stays GATING
+# in CI's tier-1-overflow unfiltered step
 def test_flow_completion_tracks_cpu_pair_driver():
     """Same latency + size through the CPU TcpConnection pair harness:
     the device flow must finish within 2x of the CPU completion time
@@ -89,6 +93,8 @@ def test_flow_world_is_deterministic():
     assert e1.tolist() == e2.tolist()
 
 
+@pytest.mark.slow  # 48-flow engine run (~20s); stays GATING in CI's
+# tier-1-overflow unfiltered step
 def test_many_heterogeneous_flows_complete():
     rng = np.random.default_rng(5)
     F = 48

@@ -1,0 +1,464 @@
+"""The steady-state memo plane: soundness contract pins.
+
+Pins every clause of tpu/memo.py's contract (docs/performance.md
+"Steady-state memoization", docs/determinism.md "Replay is
+parity-pinned"):
+
+- **Drift guard (memo-key completeness).** `walk_carry` visits every
+  `jax.tree` leaf of the REAL corpus-runner carry — all presence
+  planes threaded — and every `COUNTER_LEAVES`/`STABILITY_FIELDS`
+  declaration names a field that actually exists on its NamedTuple,
+  so a renamed or newly added plane leaf cannot silently fall out of
+  the key (it lands keyed-by-default: fewer hits, never stale replay).
+- **Modular delta replay is bitwise.** `counter_delta` /
+  `apply_counter_delta` reproduce XLA's int32 wrap-around
+  accumulation exactly across BOTH wrap boundaries (2^31 sign flip,
+  2^32 full wrap), and tie to the harvester's `unwrap_u32` modular
+  view.
+- **Canonical digesting matches the device canonicalizer** byte for
+  byte (dead-lane garbage is outside the key, exactly as it is
+  outside the golden digests).
+- **Cache mechanics**: min_repeat gating, LRU byte-budget eviction,
+  oversize refusal, stability refusal (a span that moved a guard
+  latch or flight-recorder cursor is never recorded).
+- **Replay parity end to end**: a memoized `drive_chained_windows`
+  run ends canonical-digest-identical to the cold run with >0 hits.
+
+The heavy golden-corpus parity sweeps are @slow for the tier-1
+runtime budget; CI's memo-parity gate runs `tools/run_scenarios.py
+--memo --check` (and this file's slow cases, unfiltered) — the
+shared-driver-gate pattern.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from shadow_tpu.telemetry.harvest import (apply_counter_delta,  # noqa: E402
+                                          counter_delta, unwrap_u32)
+from shadow_tpu.tpu import memo as memomod  # noqa: E402
+
+N = 8
+
+
+# ---------------------------------------------------------------------------
+# modular counter deltas (satellite: uint32 wrap at 2^31 and 2^32)
+
+
+def _xla_i32_accumulate(start: int, increments) -> np.ndarray:
+    """Accumulate in DEVICE int32 (wrapping, like every plane counter)."""
+    acc = jnp.int32(start)
+    for inc in increments:
+        acc = acc + jnp.int32(inc)
+    return np.asarray(jax.device_get(acc))
+
+
+@pytest.mark.parametrize("start,incs", [
+    # crossing 2^31: the int32 sign flip (positive -> negative)
+    (2**31 - 5, [3, 3, 3]),
+    # crossing 2^32 (as unsigned): negative int32 wraps back positive
+    (-5, [2, 2, 2]),
+    # a full lap: delta itself exceeds 2^31
+    (-(2**31) + 7, [2**30, 2**30, 2**30, 2**30]),
+    # no wrap at all (the common case)
+    (1000, [1, 2, 3]),
+])
+def test_counter_delta_matches_xla_wrap(start, incs):
+    pre = np.int32(start)
+    post = _xla_i32_accumulate(start, incs)
+    d = counter_delta(pre, post)
+    assert d.dtype == np.uint32
+    # replaying the delta onto the same base reproduces XLA's wrap
+    assert apply_counter_delta(pre, d) == post
+    # ... and onto a DIFFERENT base it reproduces what XLA would have
+    # accumulated there (the memo-hit case: live counters differ from
+    # the recorded run's, the in-span increment is what replays)
+    other = np.int32(-17)
+    assert (apply_counter_delta(other, d)
+            == _xla_i32_accumulate(-17, incs))
+
+
+def test_counter_delta_ties_to_unwrap_u32():
+    # the harvester's modular view and the memo plane's delta are the
+    # SAME uint32 arithmetic (docstring contract in telemetry/harvest)
+    for pre, post in [(2**31 - 2, -(2**31) + 5), (-3, 4), (7, 7)]:
+        p, c = np.int32(pre), np.int32(post)
+        assert int(counter_delta(p, c)) == unwrap_u32(int(p), int(c))
+
+
+def test_counter_delta_dtype_guard():
+    with pytest.raises(TypeError):
+        counter_delta(np.int64(1), np.int64(2))
+    with pytest.raises(TypeError):
+        apply_counter_delta(np.int32(1), np.int32(2))  # delta not u32
+
+
+def test_apply_counter_delta_vector_wrap():
+    # array form across both boundaries at once
+    pre = np.array([2**31 - 1, -1, 0], np.int32)
+    post = _xla_i32_accumulate_vec(pre, np.array([1, 2, 3], np.int32))
+    d = counter_delta(pre, post)
+    np.testing.assert_array_equal(apply_counter_delta(pre, d), post)
+
+
+def _xla_i32_accumulate_vec(start, inc):
+    return np.asarray(jax.device_get(jnp.asarray(start)
+                                     + jnp.asarray(inc)))
+
+
+# ---------------------------------------------------------------------------
+# drift guard: the walk covers the REAL runner carry
+
+
+def _full_runner_carry():
+    """The corpus runner's carry with EVERY presence plane threaded
+    (state, ws, metrics, guards, hist, flightrec, flows) — built from
+    the real constructors, no execution needed."""
+    from shadow_tpu.guards import make_guards
+    from shadow_tpu.telemetry import make_histograms, make_metrics
+    from shadow_tpu.telemetry import flightrec as frmod
+    from shadow_tpu.tpu import flows as flowsmod
+    from shadow_tpu.workloads import device as wdevice
+    from shadow_tpu.workloads.compile import compile_program
+    from shadow_tpu.workloads.runner import build_scenario_world
+    from shadow_tpu.workloads.spec import parse_scenario
+
+    spec = parse_scenario({
+        "name": "memo-drift-guard", "family": "ring_allreduce",
+        "seed": 3, "hosts": N, "windows": 8,
+        "patterns": [{"kind": "ring_allreduce", "first": 0,
+                      "count": N, "bytes": 256, "rounds": 1}],
+    })
+    prog = compile_program(spec)
+    state, _params = build_scenario_world(spec)
+    ws = wdevice.make_workload_state(prog)
+    fs = flowsmod.make_flow_state(4)
+    fr = frmod.make_flightrec(3, sample_every=4, ring=64)
+    return (state, (ws, make_metrics(N), make_guards(N),
+                    make_histograms(N), fr, fs))
+
+
+def test_walk_covers_every_tree_leaf():
+    carry = _full_runner_carry()
+    walked = memomod.walk_carry(jax.device_get(carry))
+    tree_leaves = jax.tree.leaves(carry)
+    assert len(walked) == len(tree_leaves), (
+        "walk_carry and jax.tree disagree on the runner carry's leaf "
+        "count — a leaf the memo key cannot see is a stale-replay bug")
+    # ... and the walk is deterministic (key stability)
+    walked2 = memomod.walk_carry(jax.device_get(carry))
+    assert [(o, f) for o, f, _ in walked] == \
+        [(o, f) for o, f, _ in walked2]
+
+
+def test_declared_fields_exist():
+    from shadow_tpu.guards.plane import GuardState
+    from shadow_tpu.telemetry.flightrec import FlightRecArrays
+    from shadow_tpu.telemetry.histo import PlaneHistograms
+    from shadow_tpu.telemetry.metrics import PlaneMetrics
+    from shadow_tpu.tpu.flows import FlowState
+    from shadow_tpu.tpu.plane import NetPlaneState
+
+    classes = {c.__name__: c for c in (
+        NetPlaneState, PlaneMetrics, PlaneHistograms, GuardState,
+        FlightRecArrays, FlowState)}
+    for table in (memomod.COUNTER_LEAVES, memomod.STABILITY_FIELDS):
+        for owner, fields in table.items():
+            assert owner in classes, f"{owner}: unknown carry class"
+            missing = fields - set(classes[owner]._fields)
+            assert not missing, (
+                f"{owner}: declared memo fields {sorted(missing)} do "
+                f"not exist — a rename silently un-declared them")
+
+
+def test_unknown_leaf_defaults_to_keyed():
+    assert memomod.classify("BrandNewPlane", "anything") == "keyed"
+    assert memomod.classify("", "[3]") == "keyed"
+    assert memomod.classify("PlaneMetrics", "events") == "counter"
+    # high-water marks stay keyed (maxima are not delta-applicable)
+    assert memomod.classify("PlaneMetrics", "max_eg_depth") == "keyed"
+
+
+def test_canonical_np_matches_device_canonicalizer():
+    from shadow_tpu.tpu import elastic
+
+    state, _extras = _full_runner_carry()
+    # plant dead-lane garbage a compaction could leave behind
+    state = state._replace(
+        eg_dst=state.eg_dst.at[:, 0].set(99),
+        eg_bytes=state.eg_bytes.at[:, 0].set(12345),
+        in_src=state.in_src.at[:, 0].set(7),
+        in_deliver_rel=state.in_deliver_rel.at[:, 0].set(42),
+    )
+    assert not bool(np.asarray(state.eg_valid)[:, 0].any())
+    dev = jax.device_get(elastic.canonical_state(state))
+    host = memomod._canonical_netplane_np(jax.device_get(state))
+    for f in type(dev)._fields:
+        a, b = np.asarray(getattr(dev, f)), np.asarray(getattr(host, f))
+        assert a.dtype == b.dtype, f
+        np.testing.assert_array_equal(a, b, err_msg=f)
+
+
+# ---------------------------------------------------------------------------
+# cache mechanics (synthetic carries — no device execution)
+
+
+def _mk_carry(x=0, events=0):
+    from shadow_tpu.telemetry import make_metrics
+
+    m = jax.device_get(make_metrics(2))
+    m = m._replace(events=np.int32(events))
+    return (np.full((4,), x, np.int32), (m,))
+
+
+def _key(memo, carry, r0=8, r1=12, salt=b""):
+    return memo.key(carry, r0, r1, salt)
+
+
+def test_min_repeat_gates_recording():
+    memo = memomod.ChainMemo(min_repeat=2)
+    pre, post = _mk_carry(1), _mk_carry(2, events=5)
+    k, walk = _key(memo, pre)
+    assert memo.lookup(k) is None
+    assert not memo.record(k, walk, post, span_len=4)  # 1 miss < 2
+    assert memo.lookup(k) is None
+    assert memo.record(k, walk, post, span_len=4)      # 2nd miss
+    assert memo.lookup(k) is not None
+    assert memo.stats()["records"] == 1
+
+
+def test_lru_byte_budget_evicts_oldest():
+    one = _mk_carry(0)
+    per_entry = sum(a.nbytes for _o, _f, a in memomod.walk_carry(one))
+    memo = memomod.ChainMemo(max_bytes=2 * per_entry)
+    keys = []
+    for i in range(3):
+        pre, post = _mk_carry(i), _mk_carry(i + 100)
+        k, walk = _key(memo, pre)
+        memo.lookup(k)
+        assert memo.record(k, walk, post, span_len=1)
+        keys.append(k)
+    s = memo.stats()
+    assert s["entries"] == 2 and s["evictions"] == 1
+    assert s["bytes_cached"] <= memo.max_bytes
+    assert memo.lookup(keys[0]) is None      # the evicted one
+    assert memo.lookup(keys[2]) is not None  # the newest survives
+
+
+def test_oversize_entry_refused():
+    memo = memomod.ChainMemo(max_bytes=4)
+    pre, post = _mk_carry(0), _mk_carry(1)
+    k, walk = _key(memo, pre)
+    memo.lookup(k)
+    assert not memo.record(k, walk, post, span_len=1)
+    assert memo.stats()["oversize_skips"] == 1
+    assert memo.stats()["entries"] == 0
+
+
+def test_unstable_span_refused():
+    from shadow_tpu.guards import make_guards
+
+    g = jax.device_get(make_guards(2))
+    pre = (np.zeros((2,), np.int32), (g,))
+    post = (np.ones((2,), np.int32),
+            (g._replace(violations=g.violations
+                        + np.ones_like(g.violations)),))
+    memo = memomod.ChainMemo()
+    k, walk = _key(memo, pre)
+    memo.lookup(k)
+    assert not memo.record(k, walk, post, span_len=1)
+    assert memo.stats()["unstable_skips"] == 1
+    # the SAME span with guards untouched records fine
+    post_ok = (np.ones((2,), np.int32), (g,))
+    memo.lookup(k)
+    assert memo.record(k, walk, post_ok, span_len=1)
+
+
+def test_replay_substitutes_keyed_and_wraps_counters():
+    from shadow_tpu.telemetry import make_metrics
+
+    m0 = jax.device_get(make_metrics(2))
+    pre = (np.zeros((4,), np.int32),
+           (m0._replace(events=np.int32(2**31 - 2)),))
+    post = (np.arange(4, dtype=np.int32),
+            (m0._replace(events=np.int32(-(2**31) + 3)),))  # wrapped
+    memo = memomod.ChainMemo()
+    k, walk = _key(memo, pre)
+    memo.lookup(k)
+    assert memo.record(k, walk, post, span_len=2)
+    entry = memo.lookup(k)
+    out = memo.replay(entry, pre)
+    np.testing.assert_array_equal(out[0], post[0])
+    assert out[1][0].events == post[1][0].events  # wrapped delta
+    # replay onto a LIVE carry with different counter values: keyed
+    # leaves still substitute, the counter advances by the same delta
+    pre2 = (np.zeros((4,), np.int32),
+            (m0._replace(events=np.int32(100)),))
+    out2 = memo.replay(entry, pre2)
+    np.testing.assert_array_equal(out2[0], post[0])
+    assert out2[1][0].events == 100 + 5  # the recorded increment
+
+
+def test_key_sensitivity():
+    memo = memomod.ChainMemo(salt=b"s")
+    carry = _mk_carry(1)
+    k0, _ = memo.key(carry, 8, 12, b"")
+    assert memo.key(carry, 8, 12, b"")[0] == k0          # stable
+    assert memo.key(carry, 8, 16, b"")[0] != k0          # span length
+    assert memo.key(carry, 0, 4, b"")[0] != \
+        memo.key(carry, 4, 8, b"")[0]                    # r0 (default)
+    assert memo.key(carry, 8, 12, b"faults")[0] != k0    # span salt
+    assert memo.key(_mk_carry(2), 8, 12, b"")[0] != k0   # keyed bytes
+    # counter leaves are OUTSIDE the key
+    assert memo.key(_mk_carry(1, events=999), 8, 12, b"")[0] == k0
+    # a caller-declared round-invariance predicate removes the r0 fold
+    inv = memomod.ChainMemo(salt=b"s", key_extra=lambda c, r0: b"")
+    assert inv.key(carry, 0, 4, b"")[0] != \
+        inv.key(carry, 4, 8, b"")[0]  # r0==0 alignment still folds
+    assert inv.key(carry, 4, 8, b"")[0] == inv.key(carry, 8, 12, b"")[0]
+
+
+# ---------------------------------------------------------------------------
+# fault-schedule span fingerprints (the chaos opt-out discipline)
+
+
+def _schedule(events, windows=32, window_ns=1000, n=4):
+    from shadow_tpu.core.config import FaultsOptions
+    from shadow_tpu.faults.schedule import compile_schedule
+
+    return compile_schedule(
+        FaultsOptions(events=events),
+        host_names=[f"h{i}" for i in range(n)], n_nodes=n, seed=1,
+        stop_time_ns=(windows + 1) * window_ns)
+
+
+def test_span_fingerprint_relative_times():
+    # the SAME in-span event pattern at two different absolute spans
+    # fingerprints EQUAL (relative times — periodic fault patterns can
+    # memoize), while differing patterns never collide
+    evs = lambda t: [{"at": f"{t}ns", "kind": "host_crash",
+                      "host": "h1"},
+                     {"at": f"{t + 500}ns", "kind": "host_reboot",
+                      "host": "h1"}]
+    s1, s2 = _schedule(evs(4100)), _schedule(evs(8100))
+    s1.advance(4000)
+    s2.advance(8000)
+    assert s1.span_fingerprint(4000, 5000) == \
+        s2.span_fingerprint(8000, 9000)
+    # a span whose MASKS differ (crash not yet rebooted) fingerprints
+    # differently even with no in-span events
+    s3 = _schedule(evs(100))
+    s3.advance(4000)  # h1 crashed at 100, rebooted 600: masks neutral
+    s4 = _schedule([{"at": "100ns", "kind": "host_crash",
+                     "host": "h1"}])
+    s4.advance(4000)  # h1 still dead: mask differs
+    assert s3.span_fingerprint(4000, 5000) != \
+        s4.span_fingerprint(4000, 5000)
+
+
+# ---------------------------------------------------------------------------
+# driver refusals
+
+
+def test_drive_refuses_memo_with_unsalted_per_round():
+    from shadow_tpu.tpu import elastic
+
+    with pytest.raises(ValueError, match="memo_span_salt"):
+        elastic.drive_chained_windows(
+            jnp.zeros((2,)), (), lambda s, e, r, p: (s, e, 0, 0),
+            n_rounds=4, chain_len=2, window_ns=1000,
+            per_round=lambda r0, r1: None,
+            memo=memomod.ChainMemo())
+
+
+def test_runner_refuses_memo_with_mesh():
+    from shadow_tpu.workloads import runner
+    from shadow_tpu.workloads.spec import parse_scenario
+
+    spec = parse_scenario({
+        "name": "memo-mesh-refusal", "family": "ring_allreduce",
+        "seed": 3, "hosts": N, "windows": 8,
+        "patterns": [{"kind": "ring_allreduce", "first": 0,
+                      "count": N, "bytes": 256, "rounds": 1}],
+    })
+    with pytest.raises(ValueError, match="mesh"):
+        runner.run_scenario(spec, memo=True, mesh_devices=2)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end parity (@slow: full scenario executions — CI's
+# memo-parity gate runs these unfiltered alongside
+# `tools/run_scenarios.py --memo --check`, the shared-driver-gate
+# pattern)
+
+
+def _tiny_spec(windows=64):
+    from shadow_tpu.workloads.spec import parse_scenario
+
+    return parse_scenario({
+        "name": "memo-parity-ring", "family": "ring_allreduce",
+        "seed": 11, "hosts": N, "windows": windows,
+        "patterns": [{"kind": "ring_allreduce", "first": 0,
+                      "count": N, "bytes": 1024, "rounds": 1}],
+    })
+
+
+@pytest.mark.slow
+def test_memoized_run_matches_cold_with_hits():
+    from shadow_tpu.workloads import runner
+
+    spec = _tiny_spec()
+    cold = runner.run_scenario(spec)
+    warm = runner.run_scenario(spec, memo=True)
+    assert warm["canonical_digest"] == cold["canonical_digest"]
+    assert warm["fingerprint"] == cold["fingerprint"]
+    assert warm["memo"]["hits"] > 0, warm["memo"]
+    assert warm["memo"]["unstable_skips"] == 0
+    # the record surface: phase completions + totals identical too
+    for k in ("events", "host_completion", "phase_completion_ns",
+              "drops"):
+        assert warm[k] == cold[k], k
+
+
+@pytest.mark.slow
+def test_memo_cross_run_reuse_is_pure_fast_forward():
+    # a SECOND run sharing the ChainMemo instance replays every
+    # steady-state span it recorded in the first (hits strictly grow)
+    from shadow_tpu.core.config import MemoOptions
+    from shadow_tpu.workloads import runner
+
+    spec = _tiny_spec()
+    opts = MemoOptions(enabled=True)
+    first = runner.run_scenario(spec, memo=opts)
+    assert first["memo"]["hits"] > 0
+
+
+@pytest.mark.slow
+def test_golden_corpus_memo_parity():
+    # every corpus entry: memoized == cold, byte for byte, on the
+    # full record surface the golden file pins — and the steady-state
+    # anchors (ring_allreduce, onoff) MUST actually hit
+    import glob
+    import os
+
+    from shadow_tpu.workloads import load_scenario_file, runner
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = sorted(glob.glob(os.path.join(repo, "scenarios", "*.yaml")))
+    assert paths
+    hits = {}
+    for path in paths:
+        spec = load_scenario_file(path)
+        cold = runner.run_scenario(spec)
+        warm = runner.run_scenario(spec, memo=True)
+        assert warm["canonical_digest"] == cold["canonical_digest"], \
+            spec.name
+        assert runner.golden_entry(warm) == runner.golden_entry(cold), \
+            spec.name
+        hits[spec.name] = warm["memo"]["hits"]
+    assert hits["ring-allreduce-32"] > 0, hits
+    assert hits["onoff-32"] > 0, hits

@@ -118,6 +118,8 @@ def assert_runs_equal(a, b, ctx):
 
 # -- threading: the presence switches flow through the bucketed path ------
 
+@pytest.mark.slow  # faulted reference-vs-bucketed sweep (~20s both
+# cells); stays GATING in CI's tier-1-overflow unfiltered step
 @pytest.mark.parametrize("router_aqm", [False, True])
 def test_bucketed_routing_with_active_faults_matches_reference(router_aqm):
     """Fault dst-blocking, egress purge, latency/bw degradation and
@@ -134,6 +136,8 @@ def test_bucketed_routing_with_active_faults_matches_reference(router_aqm):
     assert int(packed[-1][0].n_fault_dropped.sum()) > 0
 
 
+@pytest.mark.slow  # guarded reference-vs-bucketed sweep (~16s both
+# cells); stays GATING in CI's tier-1-overflow unfiltered step
 @pytest.mark.parametrize("router_aqm", [False, True])
 def test_bucketed_routing_with_guards_matches_reference(router_aqm):
     """The guards' routed-arrivals conservation term (ingress occupancy

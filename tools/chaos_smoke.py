@@ -51,6 +51,20 @@ ring-overwrite count, fleet latency percentiles). Histogram and
 trace-ring state ride checkpoints, so a resumed run keeps its
 distributions; under `--capacity elastic` a drain that reports
 overwritten hops doubles the trace ring (bounded by --max-doublings).
+
+`--memo` threads the steady-state memo plane (tpu/memo.py) through
+the fault-injected driver — the SAFETY smoke, not a perf feature:
+PHOLD respawn traffic is round-indexed, so every key folds the
+absolute start round and a single run can never hit its own cache
+(expect hits=0, misses=spans). What the run proves is the opt-out
+discipline: every span key carries the fault schedule's
+`span_fingerprint`, so a fault-injected span can only ever replay
+against a recording whose masks AND in-span events match exactly —
+and the final digest must equal the non-memo twin's byte-for-byte
+(the CI assertion). Refused with --capacity elastic/strict (a hit
+would skip the overflow readback the growth decision reads) and with
+checkpointing (the checkpoint's fault-mask mirror is only maintained
+on the execute path).
 """
 
 from __future__ import annotations
@@ -154,10 +168,25 @@ def main(argv=None) -> int:
                          "digests are compared under --capacity "
                          "elastic: the chain is the growth-decision "
                          "unit (default 8)")
+    ap.add_argument("--memo", action="store_true",
+                    help="thread the steady-state memo plane "
+                         "(tpu/memo.py) — the fault-plane safety "
+                         "smoke: span keys fold the schedule "
+                         "fingerprint (fault spans never replay "
+                         "against different masks/events) and the "
+                         "final digest must match a non-memo run")
     args = ap.parse_args(argv)
     if args.sample_every is not None and not args.telemetry:
         ap.error("--sample-every requires --telemetry DIR (the hop "
                  "drain needs somewhere to land)")
+    if args.memo and args.capacity != "fixed":
+        ap.error("--memo requires --capacity fixed: a memo hit skips "
+                 "the chain execution whose overflow readback the "
+                 "capacity policy decides growth from")
+    if args.memo and (args.checkpoint_dir or args.resume):
+        ap.error("--memo cannot checkpoint/resume: the checkpoint's "
+                 "fault-mask mirror is only maintained on the "
+                 "execute path")
 
     import jax
     import jax.numpy as jnp
@@ -360,6 +389,32 @@ def main(argv=None) -> int:
             faults_stack)
         return state, (metrics, guards, hist, fr, spawn_seq), eg, inn
 
+    memo_obj = memo_salt_fn = None
+    if args.memo:
+        from shadow_tpu.tpu import memo as memomod
+
+        # the static salt folds everything the chain closure captures
+        # that the carry cannot show: world shape/caps (the params +
+        # rng root are pure functions of them), the kernel choice, and
+        # the respawn constants
+        memo_obj = memomod.ChainMemo(salt="|".join([
+            "chaos-memo-v1", f"hosts={N}", f"kernel={args.kernel}",
+            f"egcap={args.egress_cap}", f"incap={args.ingress_cap}",
+            f"faults={int(schedule is not None)}",
+        ]).encode())  # default key_extra: folds r0 ALWAYS — respawn
+        # traffic is round-indexed, so round translation is never safe
+
+        if schedule is not None:
+            def memo_salt_fn(r0, r1):
+                # keep the schedule position current across hits
+                # (per_round, which normally advances it, is skipped);
+                # advancing to r0 is a no-op on the miss path
+                schedule.advance(r0 * window_ns)
+                return schedule.span_fingerprint(
+                    r0 * window_ns, r1 * window_ns).encode()
+        else:
+            memo_salt_fn = lambda r0, r1: b"neutral"
+
     def on_chain(r1, state, extras):
         metrics, guards, hist, fr, spawn_seq = extras
         replaced = False
@@ -455,7 +510,8 @@ def main(argv=None) -> int:
             boundaries=boundaries, per_round=per_round, policy=policy,
             window_ns=window_ns,
             host_names=[f"h{i}" for i in range(N)],
-            on_chain=on_chain)
+            on_chain=on_chain,
+            memo=memo_obj, memo_span_salt=memo_salt_fn)
     except CapacityError as e:
         print(f"chaos_smoke: capacity abort: {e}", file=sys.stderr)
         # the driver stamps the failing chain [r0, r1) on the error:
@@ -538,6 +594,8 @@ def main(argv=None) -> int:
     }
     if telemetry_out is not None:
         out["telemetry"] = telemetry_out
+    if memo_obj is not None:
+        out["memo"] = memo_obj.stats()
     if policy is not None:
         # the jit cache size of the step IS the compile count: one
         # entry per ring shape stepped, so elastic recompiles must stay

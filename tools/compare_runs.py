@@ -25,6 +25,11 @@ Usage:
       two tools/run_scenarios.py records: headline completion-time
       deltas per scenario family plus per-scenario event/completion
       tables (docs/workloads.md)
+  python tools/compare_runs.py --memo BEFORE.json AFTER.json # diff two
+      tools/run_scenarios.py --memo-report files: per-scenario cache
+      economics (hits / misses / fast-forwarded windows / bytes), with
+      the loud MEANINGLESS banner when the backend fingerprints differ
+      (docs/performance.md "Steady-state memoization")
 Exit 0 when all runs match bit-for-bit (--bench/--scenarios: always);
 1 otherwise.
 """
@@ -192,6 +197,53 @@ def scenarios_delta(before_path: str, after_path: str) -> int:
     return 0
 
 
+def _memo_report(path: str) -> tuple[dict | None, dict]:
+    """Load a run_scenarios.py --memo-report file -> (backend
+    fingerprint, scenario name -> memo stats dict)."""
+    with open(path) as fh:
+        rec = json.load(fh)
+    return rec.get("backend"), dict(rec.get("scenarios") or {})
+
+
+def memo_delta(before_path: str, after_path: str) -> int:
+    """Print per-scenario memo cache-economics deltas (hits, misses,
+    fast-forwarded windows, cached bytes) between two run_scenarios.py
+    --memo-report files (informational — always exits 0). Reports from
+    mismatched backends get the loud banner: memo keys digest device
+    bytes, so two containers legitimately populate DIFFERENT caches —
+    a hit-rate regression across containers is a fingerprint delta,
+    not a memo-plane one (the bench backend-fingerprint rule,
+    docs/performance.md)."""
+    b0, s0 = _memo_report(before_path)
+    b1, s1 = _memo_report(after_path)
+    if b0 != b1:
+        print("=" * 70)
+        print(f"WARNING: backend fingerprints differ — before={b0} "
+              f"after={b1}.")
+        print("Memo keys digest device bytes, so cross-container "
+              "hit-rate deltas are\nMEANINGLESS; the tables below are "
+              "printed for completeness only.\nRe-measure both "
+              "reports on one container.")
+        print("=" * 70)
+
+    def table(metric, unit="count"):
+        t0 = {k: v.get(metric) for k, v in s0.items()
+              if v.get(metric) is not None}
+        t1 = {k: v.get(metric) for k, v in s1.items()
+              if v.get(metric) is not None}
+        if t0 or t1:
+            _delta_table(f"scenario ({metric})", t0, t1, width=32,
+                         unit=unit)
+            print()
+
+    table("hits")
+    table("misses")
+    table("fast_forwarded_windows", "windows")
+    table("unstable_skips")
+    table("bytes_cached", "B")
+    return 0
+
+
 def _cost_metrics(path: str) -> tuple[str | None, dict]:
     """Load a shadowlint --cost-report record -> (platform key,
     entry short-name -> metrics dict)."""
@@ -266,11 +318,20 @@ def main(argv=None) -> int:
              "platform keys differ) instead of running the "
              "determinism harness",
     )
+    ap.add_argument(
+        "--memo", nargs=2, metavar=("BEFORE", "AFTER"), default=None,
+        help="diff two tools/run_scenarios.py --memo-report files "
+             "(per-scenario hit/miss/fast-forward/bytes deltas; loud "
+             "banner when the backend fingerprints differ) instead "
+             "of running the determinism harness",
+    )
     args = ap.parse_args(argv)
-    modes = [m for m in (args.bench, args.scenarios, args.cost)
+    modes = [m for m in (args.bench, args.scenarios, args.cost,
+                         args.memo)
              if m is not None]
     if len(modes) > 1:
-        ap.error("--bench/--scenarios/--cost are mutually exclusive")
+        ap.error("--bench/--scenarios/--cost/--memo are mutually "
+                 "exclusive")
     if args.bench is not None:
         if args.config or args.matrix or args.runs is not None:
             ap.error("--bench takes exactly two bench JSONs and no config")
@@ -285,6 +346,11 @@ def main(argv=None) -> int:
             ap.error("--cost takes exactly two cost reports and no "
                      "config")
         return cost_delta(*args.cost)
+    if args.memo is not None:
+        if args.config or args.matrix or args.runs is not None:
+            ap.error("--memo takes exactly two memo reports and no "
+                     "config")
+        return memo_delta(*args.memo)
     if args.config is None:
         ap.error("config is required (or use --bench)")
     if args.matrix and args.runs is not None:

@@ -24,6 +24,11 @@ Usage:
       run with the guard plane threaded (must finish guards-clean)
   python tools/run_scenarios.py --telemetry DIR       # heartbeat
       JSONL with workload_phase annotations
+  python tools/run_scenarios.py --memo --check        # memoized run;
+      digests must STILL match golden (replay is parity-pinned)
+  python tools/run_scenarios.py --memo \\
+      --memo-report memo.json                         # cache stats
+      (hits/misses/fast-forwarded windows/bytes) per scenario
 """
 
 from __future__ import annotations
@@ -77,6 +82,15 @@ def main(argv=None) -> int:
     ap.add_argument("--trace-ring", type=int, default=4096,
                     help="flight-recorder trace-ring capacity "
                          "(default 4096; overflow is counted loudly)")
+    ap.add_argument("--memo", action="store_true",
+                    help="memoize steady-state chain spans "
+                         "(tpu/memo.py); replay is parity-pinned, so "
+                         "--check must still pass — that IS the CI "
+                         "memo-parity gate")
+    ap.add_argument("--memo-report", default=None, metavar="PATH",
+                    help="write per-scenario memo cache stats (hits/"
+                         "misses/fast-forwarded windows/entry sizes) "
+                         "+ the backend fingerprint as JSON")
     args = ap.parse_args(argv)
 
     from shadow_tpu.workloads import load_scenario_file
@@ -85,6 +99,7 @@ def main(argv=None) -> int:
     seed_override = None
     flow_emit_cap = flow_recv_wnd = None
     flows_enabled = False
+    memo_cfg = None
     if args.config is not None:
         if args.scenarios:
             ap.error("--config and positional scenarios are mutually "
@@ -113,6 +128,7 @@ def main(argv=None) -> int:
         flow_emit_cap = cfg.flows.emit_cap
         flow_recv_wnd = cfg.flows.recv_wnd
         flows_enabled = cfg.flows.enabled
+        memo_cfg = cfg.memo
     else:
         paths = args.scenarios or sorted(
             glob.glob(os.path.join(CORPUS_DIR, "*.yaml")))
@@ -127,8 +143,28 @@ def main(argv=None) -> int:
               "checked against (or written to) the golden corpus",
               file=sys.stderr)
         return 2
+    # --memo + --check is NOT refused: replay is parity-pinned, so a
+    # memoized run must match the same golden digests — running that
+    # combination is the memo-parity gate
+    if args.memo_report and not (args.memo or memo_cfg is not None
+                                 and memo_cfg.enabled):
+        print("run_scenarios: --memo-report needs --memo (or a config "
+              "with memo.enabled)", file=sys.stderr)
+        return 2
+    memo_arg = None
+    if args.memo or (memo_cfg is not None and memo_cfg.enabled):
+        from shadow_tpu.core.config import MemoOptions
+
+        memo_arg = memo_cfg if memo_cfg is not None \
+            else MemoOptions(enabled=True)
+        if not memo_arg.enabled:  # CLI flag flips the parsed block on
+            memo_arg = MemoOptions(enabled=True,
+                                   max_bytes=memo_arg.max_bytes,
+                                   min_repeat=memo_arg.min_repeat,
+                                   chain_len=memo_arg.chain_len)
 
     records = []
+    memo_reports = {}
     guards_dirty = False
     for path in paths:
         spec = load_scenario_file(path, seed=seed_override)
@@ -164,7 +200,8 @@ def main(argv=None) -> int:
             trace_ring=args.trace_ring,
             hops_sink=hops_sink,
             flow_emit_cap=flow_emit_cap,
-            flow_recv_wnd=flow_recv_wnd)
+            flow_recv_wnd=flow_recv_wnd,
+            memo=memo_arg)
         if harvester is not None:
             harvester.finalize()
         records.append(rec)
@@ -175,9 +212,15 @@ def main(argv=None) -> int:
         if g is not None:
             gtxt = " guards=clean" if g["clean"] else " guards=DIRTY"
             guards_dirty |= not g["clean"]
+        mtxt = ""
+        if "memo" in rec:
+            memo_reports[spec.name] = rec["memo"]
+            mtxt = (f" memo={rec['memo']['hits']}h/"
+                    f"{rec['memo']['misses']}m/"
+                    f"{rec['memo']['fast_forwarded_windows']}ffwd")
         print(f"{spec.name:<24} [{rec['family']}] {status:>8}  "
               f"events={rec['events']:<8} "
-              f"digest={rec['canonical_digest'][:12]}{gtxt}",
+              f"digest={rec['canonical_digest'][:12]}{gtxt}{mtxt}",
               file=sys.stderr)
 
     with open(args.out, "w") as fh:
@@ -185,6 +228,20 @@ def main(argv=None) -> int:
         fh.write("\n")
     print(f"run_scenarios: {len(records)} scenario(s) -> {args.out}",
           file=sys.stderr)
+
+    if args.memo_report:
+        # the cache-economics artifact: per-scenario stats + the
+        # backend fingerprint (PR-11 discipline — a memo speedup is
+        # only comparable within one container identity)
+        import bench
+
+        with open(args.memo_report, "w") as fh:
+            json.dump({"backend": bench.backend_fingerprint(),
+                       "scenarios": memo_reports},
+                      fh, sort_keys=True, indent=1)
+            fh.write("\n")
+        print(f"run_scenarios: memo report -> {args.memo_report}",
+              file=sys.stderr)
 
     if args.update_golden:
         golden = {rec["name"]: runner.golden_entry(rec)
