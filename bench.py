@@ -101,6 +101,14 @@ MEMO = os.environ.get("BENCH_MEMO", "0") == "1"
 MEMO_HOSTS = int(os.environ.get("BENCH_MEMO_HOSTS", "16"))
 MEMO_WINDOWS = int(os.environ.get("BENCH_MEMO_WINDOWS", "4096"))
 MEMO_CHAIN = int(os.environ.get("BENCH_MEMO_CHAIN", "64"))
+# BENCH_TRACE=PATH writes the shadowscope run ledger (telemetry/
+# tracer.RunTracer JSONL, docs/observability.md "Run ledger") for the
+# TIMED solo run; a BENCH_WORLDS rep appends its own ensemble ledger
+# next to it at PATH.worlds.jsonl. The tracer only samples the clock
+# at the chain-boundary host syncs the driver already takes, so the
+# measured rate is the same rate CI gates untraced (the <=1.05x
+# traced-overhead gate pins that claim).
+TRACE_PATH = os.environ.get("BENCH_TRACE", "")
 SPAWN_PER_DELIVERY = 1
 
 
@@ -248,7 +256,7 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None,
     CHAIN_LEN = (HARVEST_EVERY if TELEMETRY
                  else GROW_EVERY if CAPACITY_MODE != "fixed" else ROUNDS)
 
-    def run_driver(state, harvester=None, collect=None):
+    def run_driver(state, harvester=None, collect=None, tracer=None):
         nonlocal capacity_info
         from shadow_tpu.telemetry import make_histograms, make_metrics
         from shadow_tpu.tpu import elastic
@@ -271,6 +279,9 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None,
 
         def on_chain(r1, state, extras):
             if harvester is not None:
+                if tracer is not None:
+                    tracer.annotate("harvest", r=int(r1),
+                                    time_ns=int(r1) * int(window))
                 _sp, metrics, hist, _t = extras
                 device = (dict(metrics._asdict(), **hist._asdict())
                           if hist is not None else metrics)
@@ -280,7 +291,8 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None,
             state, (spawn_seq, metrics, hist, jnp.int32(0)), chain_fn,
             n_rounds=ROUNDS, chain_len=CHAIN_LEN, policy=policy,
             window_ns=int(window),
-            on_chain=on_chain if harvester is not None else None)
+            on_chain=on_chain if harvester is not None else None,
+            tracer=tracer)
         _spawn_seq, metrics, hist, total = extras
         if collect is not None and hist is not None:
             collect["hist"] = hist
@@ -306,6 +318,17 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None,
                                    ingress_cap=INGRESS_CAP, seed=0,
                                    warmup_windows=0)["state"]
     jax.block_until_ready(state2)
+    tracer = None
+    if TRACE_PATH:
+        from shadow_tpu.telemetry import RunTracer
+
+        # the ledger covers the TIMED run only — the compile run's
+        # wall time is already reported as compile_and_first
+        tracer = RunTracer(
+            "bench", backend=backend_fingerprint(),
+            meta={"hosts": N, "rounds": ROUNDS, "chain_len": CHAIN_LEN,
+                  "kernel": PLANE_KERNEL, "capacity": CAPACITY_MODE,
+                  "telemetry": TELEMETRY})
     telemetry_info = None
     if TELEMETRY:
         from shadow_tpu.telemetry import TelemetryHarvester
@@ -317,7 +340,7 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None,
             slot_capacity=N * (EGRESS_CAP + INGRESS_CAP))
         collect: dict = {}
         t0 = time.monotonic()
-        state_out, ndel = driver(state2, harvester, collect)
+        state_out, ndel = driver(state2, harvester, collect, tracer)
         ndel = int(ndel)
         jax.block_until_ready(state_out)
         wall = time.monotonic() - t0
@@ -348,10 +371,13 @@ def bench_tpu() -> tuple[float, int, dict | None, dict, dict | None,
                 for name, arr in h._asdict().items()}
     else:
         t0 = time.monotonic()
-        state_out, ndel = driver(state2)
+        state_out, ndel = driver(state2, tracer=tracer)
         ndel = int(ndel)
         jax.block_until_ready(state_out)
         wall = time.monotonic() - t0
+    if tracer is not None:
+        tracer.close(wall_s=round(wall, 6))
+        tracer.write(TRACE_PATH)
 
     sent = int(np.asarray(state_out.n_sent).sum())
     events = ndel + sent  # send + deliver events, like Shadow's event count
@@ -431,12 +457,12 @@ def bench_tpu_worlds(solo_rate: float) -> dict:
     def stacked(tree):
         return jax.tree.map(lambda x: jnp.stack([x] * W), tree)
 
-    def run(states):
+    def run(states, tracer=None):
         extras = (keys, stacked(jnp.full((N,), 10_000, jnp.int32)),
                   jnp.zeros((W,), jnp.int32))
         states, extras = elastic.drive_ensemble(
             states, extras, chain_fn, n_rounds=ROUNDS,
-            chain_len=chain_len)
+            chain_len=chain_len, tracer=tracer)
         return states, extras[2]
 
     # compile run, then the timed run on a fresh replicated state
@@ -447,11 +473,22 @@ def bench_tpu_worlds(solo_rate: float) -> dict:
         seed=0, warmup_windows=0)["state"]
     states2 = stacked(state2)
     jax.block_until_ready(states2)
+    tracer = None
+    if TRACE_PATH:
+        from shadow_tpu.telemetry import RunTracer
+
+        tracer = RunTracer(
+            "bench-worlds", backend=backend_fingerprint(),
+            meta={"worlds": W, "hosts": N, "rounds": ROUNDS,
+                  "chain_len": chain_len})
     t0 = time.monotonic()
-    states_out, totals = run(states2)
+    states_out, totals = run(states2, tracer)
     totals = np.asarray(jax.device_get(totals), np.int64)
     jax.block_until_ready(states_out)
     wall = time.monotonic() - t0
+    if tracer is not None:
+        tracer.close(wall_s=round(wall, 6))
+        tracer.write(TRACE_PATH + ".worlds.jsonl")
 
     sent = np.asarray(jax.device_get(states_out.n_sent),
                       np.int64).sum(axis=tuple(range(

@@ -707,15 +707,20 @@ def check_watermarks(entries=None) -> tuple[list[Finding], list[dict]]:
 # SL603: the tree-wide host-sync fence
 # --------------------------------------------------------------------------
 
-#: the driver-loop modules the fence covers — the four files that own
-#: a window-driving loop (everything else either is the sanctioned
+#: the driver-loop modules the fence covers — the files that own a
+#: window-driving loop (everything else either is the sanctioned
 #: harvest boundary, shadow_tpu/telemetry/, or never holds device
-#: values in a loop)
+#: values in a loop). The shadowscope tracer and its report CLI are
+#: swept too: the run ledger is emitted AT the chain-boundary sync and
+#: must stay incapable of smuggling a per-span device read in later
+#: (docs/observability.md "Run ledger").
 DRIVER_MODULES = (
     "bench.py",
     "tools/chaos_smoke.py",
+    "tools/trace_report.py",
     "shadow_tpu/workloads/runner.py",
     "shadow_tpu/tpu/elastic.py",
+    "shadow_tpu/telemetry/tracer.py",
 )
 
 #: (repo-relative path, enclosing function) -> justification. The

@@ -14,6 +14,9 @@ heartbeats, and trace/metrics exporters.
 - `flightrec` — the sampled per-packet flight recorder: a seeded
   deterministic 1/K sampling mask, a device-side hop trace ring, and
   the asynchronous host drain (`FlightRecorder`).
+- `tracer` — shadowscope: the per-chain-span run ledger (`RunTracer`,
+  JSONL, emitted at the driver's existing chain-boundary host sync)
+  and the two-clock wall/virtual Chrome-trace exporter.
 
 Design rule (docs/observability.md): telemetry may never add a device
 sync to the per-window hot path — harvest happens OUTSIDE jitted code,
@@ -25,6 +28,7 @@ from .flightrec import FlightRecArrays, FlightRecorder, make_flightrec
 from .harvest import TelemetryHarvester, unwrap_u32
 from .histo import HIST_BUCKETS, PlaneHistograms, make_histograms
 from .metrics import PlaneMetrics, add_retransmits, make_metrics
+from .tracer import RUNLEDGER_SCHEMA, RunTracer
 
 __all__ = [
     "FlightRecArrays",
@@ -32,6 +36,8 @@ __all__ = [
     "HIST_BUCKETS",
     "PlaneHistograms",
     "PlaneMetrics",
+    "RUNLEDGER_SCHEMA",
+    "RunTracer",
     "TelemetryHarvester",
     "add_retransmits",
     "make_flightrec",

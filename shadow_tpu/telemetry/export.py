@@ -71,25 +71,13 @@ def _merged_counters(rec: dict) -> dict[str, int]:
     return out
 
 
-def write_perfetto_trace(heartbeats: list[dict], path: str, *,
-                         max_hosts: int = 256,
-                         hops: Optional[list[dict]] = None,
-                         max_flows: int = 512) -> dict:
-    """Write a Chrome trace-event JSON file; returns a small summary
-    dict (events written, hosts plotted/dropped). Hosts are capped at
-    `max_hosts` counter rows (top talkers by total bytes) so a 4096-host
-    run stays loadable; the cap is recorded in the trace's otherData —
-    never silent.
-
-    When the sim heartbeats carry `hist` bucket vectors
-    (telemetry/histo.py), the simulation row gains per-interval
-    percentile COUNTER tracks on the virtual-time axis (p50/p90/p99/
-    p999 of each histogram's interval delta). When `hops` (flight-
-    recorder hop records, telemetry/flightrec.py) are given, sampled
-    packets become FLOW events: a send slice on the source host row
-    bound by an `s` arrow to a deliver slice on the destination row —
-    one packet's life, linked across hosts. Flows are capped at
-    `max_flows` (recorded in otherData, never silent)."""
+def build_sim_events(heartbeats: list[dict], *, max_hosts: int = 256,
+                     hops: Optional[list[dict]] = None,
+                     max_flows: int = 512) -> tuple[list[dict], dict]:
+    """The virtual-time trace-event rows of `write_perfetto_trace`,
+    as (events, caps-summary) — shared with the two-clock merged
+    exporter (telemetry/tracer.py `write_chrome_trace`), which lays
+    these beside the wall-time driver row."""
     events: list[dict] = [
         {"ph": "M", "pid": 0, "tid": 0, "name": "process_name",
          "args": {"name": "simulation (virtual time)"}},
@@ -174,23 +162,44 @@ def write_perfetto_trace(heartbeats: list[dict], path: str, *,
         flows_written, flows_dropped = _flow_events(
             events, hops, max_flows)
 
+    return events, {"hosts_plotted": len(plotted),
+                    "hosts_dropped_by_cap": len(dropped),
+                    "flows_plotted": flows_written,
+                    "flows_dropped_by_cap": flows_dropped}
+
+
+def write_perfetto_trace(heartbeats: list[dict], path: str, *,
+                         max_hosts: int = 256,
+                         hops: Optional[list[dict]] = None,
+                         max_flows: int = 512) -> dict:
+    """Write a Chrome trace-event JSON file; returns a small summary
+    dict (events written, hosts plotted/dropped). Hosts are capped at
+    `max_hosts` counter rows (top talkers by total bytes) so a 4096-host
+    run stays loadable; the cap is recorded in the trace's otherData —
+    never silent.
+
+    When the sim heartbeats carry `hist` bucket vectors
+    (telemetry/histo.py), the simulation row gains per-interval
+    percentile COUNTER tracks on the virtual-time axis (p50/p90/p99/
+    p999 of each histogram's interval delta). When `hops` (flight-
+    recorder hop records, telemetry/flightrec.py) are given, sampled
+    packets become FLOW events: a send slice on the source host row
+    bound by an `s` arrow to a deliver slice on the destination row —
+    one packet's life, linked across hosts. Flows are capped at
+    `max_flows` (recorded in otherData, never silent)."""
+    events, caps = build_sim_events(heartbeats, max_hosts=max_hosts,
+                                    hops=hops, max_flows=max_flows)
     trace = {
         "traceEvents": events,
         "displayTimeUnit": "ms",
         "otherData": {
             "clock": "virtual simulated time (1 trace us = 1 sim us)",
-            "hosts_plotted": len(plotted),
-            "hosts_dropped_by_cap": len(dropped),
-            "flows_plotted": flows_written,
-            "flows_dropped_by_cap": flows_dropped,
+            **caps,
         },
     }
     with open(path, "w") as fh:
         json.dump(trace, fh, sort_keys=True)
-    return {"events": len(events), "hosts_plotted": len(plotted),
-            "hosts_dropped_by_cap": len(dropped),
-            "flows_plotted": flows_written,
-            "flows_dropped_by_cap": flows_dropped, "path": path}
+    return {"events": len(events), "path": path, **caps}
 
 
 def _flow_events(events: list[dict], hops: list[dict],

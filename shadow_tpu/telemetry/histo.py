@@ -170,3 +170,33 @@ def percentiles(counts, qs=QUANTILES) -> dict:
         key = "p" + (digits + "0" if len(digits) == 1 else digits)
         out[key] = percentile(counts, q)
     return out
+
+
+def ensemble_percentiles(world_counts, qs=QUANTILES) -> dict:
+    """Percentile-of-percentiles across an ensemble of worlds
+    (ROADMAP item 4's error bars): `world_counts` is one [B]
+    bucket-count vector PER WORLD for the same histogram; each world's
+    quantiles are extracted independently (`percentiles`), then each
+    quantile's cross-world spread is reported as min/median/max —
+    ``{"p99": {"min": ..., "median": ..., "max": ..., "worlds": W}}``.
+
+    The median is `statistics.median` (the midpoint average for even
+    W), so a 2-world ensemble reports exactly the two worlds' mean —
+    the hand-computable case tests/test_tracer.py pins. Worlds whose
+    histogram is empty still contribute (their percentiles are 0, a
+    real "this world saw no observations" datum), and an empty world
+    LIST raises — an ensemble of zero worlds has no percentiles."""
+    import statistics
+
+    if not world_counts:
+        raise ValueError(
+            "ensemble_percentiles needs >= 1 world bucket vector")
+    per_world = [percentiles(c, qs) for c in world_counts]
+    out = {}
+    for key in per_world[0]:
+        vals = sorted(p[key] for p in per_world)
+        out[key] = {"min": vals[0],
+                    "median": statistics.median(vals),
+                    "max": vals[-1],
+                    "worlds": len(vals)}
+    return out

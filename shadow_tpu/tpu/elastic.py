@@ -216,7 +216,8 @@ def drive_chained_windows(state, extras, chain_fn, *, n_rounds: int,
                           boundaries=(), per_round=None,
                           policy: RingPolicy | None = None,
                           window_ns: int = 0, host_names=None,
-                          on_chain=None, memo=None, memo_span_salt=None):
+                          on_chain=None, memo=None, memo_span_salt=None,
+                          tracer=None):
     """THE driver loop. bench.py, tools/chaos_smoke.py, and the
     scenario corpus runner (workloads/runner.py) all drive their
     windows through this one function (pinned by the inspect-source
@@ -274,6 +275,18 @@ def drive_chained_windows(state, extras, chain_fn, *, n_rounds: int,
     REQUIRED whenever ``per_round`` is set: time-varying inputs the
     key cannot see would otherwise replay across non-equivalent spans,
     so that combination raises instead of guessing.
+
+    ``tracer`` (a `telemetry/tracer.RunTracer`, docs/observability.md
+    "Run ledger") records one ledger record per committed span AT the
+    chain-boundary sync the loop already owns: the wall-time split
+    (dispatch / memo bookkeeping / on_chain hook), the span mode
+    (execute, or a memo `replay`/host-only `ffwd`), the capacity
+    trajectory events the span committed, and the span-salt
+    fingerprint when one exists. The tracer reads host wall clocks and
+    values this loop already materialized — zero new device syncs
+    (`costmodel.DRIVER_MODULES` re-proves that statically), and
+    presence-invisible: tracer-on and tracer-off runs are
+    digest-identical (the trace-parity CI gate).
     """
     import jax.numpy as jnp
 
@@ -295,28 +308,45 @@ def drive_chained_windows(state, extras, chain_fn, *, n_rounds: int,
     for r0, r1 in chain_spans(n_rounds, chain_len,
                               start_round=start_round,
                               boundaries=boundaries):
+        t0 = tracer.clock() if tracer is not None else 0.0
+        salt_hex = None
         pre_walk = None
+        salt = b""
+        if memo_span_salt is not None \
+                and (memo is not None or tracer is not None):
+            salt = memo_span_salt(r0, r1)
+            if tracer is not None:
+                # the span's external-input fingerprint (the fault
+                # schedule's) — host bytes, hashed before the loop ran
+                salt_hex = salt.hex()
         if memo is not None:
             if host_carry is None:
                 host_carry = memo.snapshot(state, extras)
-            salt = (memo_span_salt(r0, r1)
-                    if memo_span_salt is not None else b"")
             key, pre_walk = memo.key(host_carry, r0, r1, span_salt=salt)
             entry = memo.lookup(key)
             if entry is not None:
                 host_carry = memo.replay(entry, host_carry)
                 stale = True
+                mode, hook_ms = "ffwd", 0.0
                 if on_chain is not None:
+                    mode = "replay"
                     _upload()
+                    th = tracer.clock() if tracer is not None else 0.0
                     replaced = on_chain(r1, state, extras)
+                    if tracer is not None:
+                        hook_ms = (tracer.clock() - th) * 1e3
                     if replaced is not None:
                         state, extras = replaced
                         host_carry = None  # device is authoritative
+                if tracer is not None:
+                    tracer.span(r0, r1, mode=mode, t0=t0,
+                                hook_ms=hook_ms, span_salt=salt_hex)
                 continue
             if stale:
                 _upload()
         rids = jnp.arange(r0, r1, dtype=jnp.int32)
         pr = per_round(r0, r1) if per_round is not None else None
+        growth = None
         if policy is None:
             state, extras, _eg, _in = chain_fn(state, extras, rids, pr)
         else:
@@ -324,6 +354,7 @@ def drive_chained_windows(state, extras, chain_fn, *, n_rounds: int,
                 st2, ex2, eg, inn = chain_fn(st, _ex, _rids, _pr)
                 return (st2, ex2), eg, inn
 
+            n_events = len(policy.trajectory.events)
             try:
                 out, _used = run_elastic_window(
                     state, attempt, policy, time_ns=r0 * int(window_ns),
@@ -336,14 +367,32 @@ def drive_chained_windows(state, extras, chain_fn, *, n_rounds: int,
                 e.chain_span = (r0, r1)
                 raise
             state, extras = out
+            # the span's committed capacity decisions (growth / drop /
+            # exhaustion) — already-host trajectory dicts, by slice
+            growth = policy.trajectory.events[n_events:]
+        dispatch_ms = ((tracer.clock() - t0) * 1e3
+                       if tracer is not None else 0.0)
+        memo_ms = 0.0
         if memo is not None:
+            tm = tracer.clock() if tracer is not None else 0.0
             host_carry = memo.snapshot(state, extras)
             memo.record(key, pre_walk, host_carry, span_len=r1 - r0)
+            if tracer is not None:
+                memo_ms = (tracer.clock() - tm) * 1e3
+        hook_ms = 0.0
         if on_chain is not None:
+            th = tracer.clock() if tracer is not None else 0.0
             replaced = on_chain(r1, state, extras)
+            if tracer is not None:
+                hook_ms = (tracer.clock() - th) * 1e3
             if replaced is not None:
                 state, extras = replaced
                 host_carry = None
+        if tracer is not None:
+            tracer.span(r0, r1, mode="execute", t0=t0,
+                        dispatch_ms=dispatch_ms, memo_ms=memo_ms,
+                        hook_ms=hook_ms, growth=growth,
+                        span_salt=salt_hex)
     if stale:
         _upload()
     return state, extras
@@ -379,7 +428,7 @@ def world_keys(rng_root, seeds):
 def drive_ensemble(states, extras, chain_fn, *, n_rounds: int,
                    chain_len: int, start_round: int = 0,
                    boundaries=(), per_round=None, per_round_axis=None,
-                   on_chain=None):
+                   on_chain=None, tracer=None):
     """The PROVEN vmap ensemble driver (ROADMAP item 4): W independent
     worlds execute the same chained-window schedule as ONE batched
     program, with one host sync per chain for the whole ensemble.
@@ -412,8 +461,10 @@ def drive_ensemble(states, extras, chain_fn, *, n_rounds: int,
     ``extras`` untouched instead. ``on_chain(r1, states, extras)`` is
     the ONE host-sync point per chain (harvest/checkpoint cadence for
     the whole ensemble); returning a (states, extras) pair replaces
-    the carried values, returning None keeps them. Returns the final
-    batched ``(states, extras)``.
+    the carried values, returning None keeps them. ``tracer`` records
+    one ``mode="ensemble"`` run-ledger span per batched chain (same
+    zero-sync contract as :func:`drive_chained_windows`). Returns the
+    final batched ``(states, extras)``.
     """
     import jax
     import jax.numpy as jnp
@@ -426,13 +477,23 @@ def drive_ensemble(states, extras, chain_fn, *, n_rounds: int,
     for r0, r1 in chain_spans(n_rounds, chain_len,
                               start_round=start_round,
                               boundaries=boundaries):
+        t0 = tracer.clock() if tracer is not None else 0.0
         rids = jnp.arange(r0, r1, dtype=jnp.int32)
         pr = per_round(r0, r1) if per_round is not None else None
         states, extras, _eg, _in = vchain(states, extras, rids, pr)
+        dispatch_ms = ((tracer.clock() - t0) * 1e3
+                       if tracer is not None else 0.0)
+        hook_ms = 0.0
         if on_chain is not None:
+            th = tracer.clock() if tracer is not None else 0.0
             replaced = on_chain(r1, states, extras)
+            if tracer is not None:
+                hook_ms = (tracer.clock() - th) * 1e3
             if replaced is not None:
                 states, extras = replaced
+        if tracer is not None:
+            tracer.span(r0, r1, mode="ensemble", t0=t0,
+                        dispatch_ms=dispatch_ms, hook_ms=hook_ms)
     return states, extras
 
 

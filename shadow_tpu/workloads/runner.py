@@ -146,7 +146,8 @@ def run_scenario(spec: ScenarioSpec, *,
                  max_advance: Optional[int] = None,
                  flow_emit_cap: Optional[int] = None,
                  flow_recv_wnd: Optional[int] = None,
-                 memo=None) -> dict:
+                 memo=None,
+                 tracer=None) -> dict:
     """Execute one scenario for its full window budget. Returns the
     JSON-ready record (no wall-clock anywhere — byte-stable across
     runs by construction).
@@ -173,7 +174,14 @@ def run_scenario(spec: ScenarioSpec, *,
     while any flow could read it, and — under faults — the schedule's
     span fingerprint, so fault-injected spans never replay across
     non-identical fault contexts. Not supported with `mesh_devices`
-    (the host-mirror fast-forward would collapse the sharding)."""
+    (the host-mirror fast-forward would collapse the sharding).
+
+    `tracer` (a `telemetry/tracer.RunTracer`) records the run ledger:
+    one span record per chain at the driver's existing boundary sync,
+    harvest-tick annotations, and the folded memo report when
+    memoized. Presence-invisible by contract — the returned record
+    (and therefore the golden digests) is byte-identical with or
+    without it; wall time lives ONLY on the ledger."""
     import jax
     import jax.numpy as jnp
 
@@ -356,12 +364,24 @@ def run_scenario(spec: ScenarioSpec, *,
                                device=_device_counters(metrics, hstate))
             if recorder is not None:
                 recorder.tick(fstate)
+            if tracer is not None:
+                tracer.annotate("harvest", r=int(r1),
+                                time_ns=int(r1) * spec.window_ns)
 
     memo_obj, memo_salt_fn, memo_chain = _build_memo(
         memo, spec=spec, prog=prog, schedule=schedule,
         mesh_devices=mesh_devices, adv=adv, emit_cap=emit_cap,
         recv_wnd=recv_wnd, guards=guards, histograms=histograms,
         sample_every=sample_every, trace_ring=trace_ring)
+
+    if tracer is not None and memo_salt_fn is None and faulted:
+        # no memo, but the ledger still wants the fault-span
+        # fingerprint: the same schedule-position-preserving salt the
+        # memoized path uses (advance to r0 is a no-op mid-run)
+        def memo_salt_fn(r0, r1):
+            schedule.advance(r0 * spec.window_ns)
+            return schedule.span_fingerprint(
+                r0 * spec.window_ns, r1 * spec.window_ns).encode()
 
     need_cadence = telemetry is not None or recorder is not None
     state, extras = _elastic.drive_chained_windows(
@@ -373,7 +393,7 @@ def run_scenario(spec: ScenarioSpec, *,
         per_round=per_round if faulted else None,
         window_ns=spec.window_ns,
         on_chain=on_chain if need_cadence else None,
-        memo=memo_obj, memo_span_salt=memo_salt_fn)
+        memo=memo_obj, memo_span_salt=memo_salt_fn, tracer=tracer)
     ws, metrics, gstate, hstate, fstate, flowst = extras
 
     jax.block_until_ready(state)
@@ -424,6 +444,10 @@ def run_scenario(spec: ScenarioSpec, *,
         }
     if memo_obj is not None:
         record["memo"] = memo_obj.report()
+        if tracer is not None:
+            # ONE artifact: the ledger folds the same report
+            # `--memo-report` publishes (trace_report --memo-view)
+            tracer.memo_close(memo_obj)
     if gstate is not None:
         record["guards"] = summarize(gstate)
     if hstate is not None:

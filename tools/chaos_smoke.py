@@ -175,6 +175,12 @@ def main(argv=None) -> int:
                          "fingerprint (fault spans never replay "
                          "against different masks/events) and the "
                          "final digest must match a non-memo run")
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="shadowscope run ledger: write the per-span "
+                         "JSONL (wall split, span modes, capacity "
+                         "growth, fault-span fingerprints, tamper/"
+                         "harvest/checkpoint annotations) to PATH; "
+                         "presence-invisible — digests are unchanged")
     args = ap.parse_args(argv)
     if args.sample_every is not None and not args.telemetry:
         ap.error("--sample-every requires --telemetry DIR (the hop "
@@ -205,6 +211,17 @@ def main(argv=None) -> int:
     EXIT_CAPACITY = 6  # shadow_tpu.cli.EXIT_CAPACITY
 
     N, R = args.hosts, args.windows
+    tracer = None
+    if args.trace:
+        from shadow_tpu.telemetry import RunTracer
+
+        tracer = RunTracer(
+            "chaos_smoke",
+            meta={"hosts": N, "windows": R, "kernel": args.kernel,
+                  "capacity": args.capacity,
+                  "chain_len": args.chain_len,
+                  "faults": not args.no_faults,
+                  "memo": bool(args.memo)})
     world = profiling.build_world(N, warmup_windows=0,
                                   egress_cap=args.egress_cap,
                                   ingress_cap=args.ingress_cap)
@@ -414,6 +431,15 @@ def main(argv=None) -> int:
                     r0 * window_ns, r1 * window_ns).encode()
         else:
             memo_salt_fn = lambda r0, r1: b"neutral"
+    if tracer is not None and memo_salt_fn is None \
+            and schedule is not None:
+        # trace-only runs still stamp fault-span fingerprints on the
+        # ledger; advance-to-r0 is a no-op mid-run (per_round already
+        # moved the schedule there), so digests are untouched
+        def memo_salt_fn(r0, r1):
+            schedule.advance(r0 * window_ns)
+            return schedule.span_fingerprint(
+                r0 * window_ns, r1 * window_ns).encode()
 
     def on_chain(r1, state, extras):
         metrics, guards, hist, fr, spawn_seq = extras
@@ -428,7 +454,12 @@ def main(argv=None) -> int:
                 in_valid=state.in_valid.at[
                     1, state.in_src.shape[1] - 1].set(True))
             replaced = True
+            if tracer is not None:
+                tracer.annotate("tamper", r=int(r1))
         if harvester is not None and r1 % args.harvest_every == 0:
+            if tracer is not None:
+                tracer.annotate("harvest", r=int(r1),
+                                time_ns=int(r1) * window_ns)
             harvester.tick(r1 * window_ns,
                            device={**metrics._asdict(),
                                    **hist._asdict()})
@@ -484,7 +515,11 @@ def main(argv=None) -> int:
                 faults=last_faults[0], metrics=metrics,
                 extra_arrays=extra, meta=meta)
             checkpoints.append(path)
+            if tracer is not None:
+                tracer.annotate("checkpoint", r=int(r1), path=path)
         if args.kill_at is not None and r1 >= args.kill_at:
+            if tracer is not None:
+                tracer.annotate("kill", r=int(r1))
             print(f"chaos_smoke: simulating a crash at window {r1}",
                   file=sys.stderr)
             sys.stderr.flush()
@@ -511,7 +546,8 @@ def main(argv=None) -> int:
             window_ns=window_ns,
             host_names=[f"h{i}" for i in range(N)],
             on_chain=on_chain,
-            memo=memo_obj, memo_span_salt=memo_salt_fn)
+            memo=memo_obj, memo_span_salt=memo_salt_fn,
+            tracer=tracer)
     except CapacityError as e:
         print(f"chaos_smoke: capacity abort: {e}", file=sys.stderr)
         # the driver stamps the failing chain [r0, r1) on the error:
@@ -519,6 +555,13 @@ def main(argv=None) -> int:
         # the span is the precise blame unit (the offending window is
         # somewhere inside it)
         span = getattr(e, "chain_span", None)
+        if tracer is not None:
+            # the partial ledger is the abort postmortem: every span
+            # that completed before the blamed chain is on it
+            tracer.annotate("capacity-abort", error=str(e),
+                            chain_span=list(span) if span else None)
+            tracer.close()
+            tracer.write(args.trace)
         print(json.dumps({
             "capacity_error": str(e),
             "mode": policy.mode,
@@ -615,12 +658,22 @@ def main(argv=None) -> int:
     if use_guards:
         gsum = summarize(guards)
         out["guards"] = gsum
-        if not gsum["clean"]:
-            print("chaos_smoke: guard violations: "
-                  + json.dumps(gsum["by_class"]), file=sys.stderr)
-            if args.guards == "abort":
-                print(json.dumps(out))
-                return EXIT_GUARD
+    if tracer is not None:
+        if memo_obj is not None:
+            tracer.memo_close(memo_obj)
+        if use_guards:
+            # the end-of-run guard pull rides the ledger: the delta
+            # from a clean run is the per-class violation census
+            tracer.annotate("guards", summary=out["guards"])
+        tracer.close()
+        tracer.write(args.trace)
+        out["trace"] = args.trace
+    if use_guards and not out["guards"]["clean"]:
+        print("chaos_smoke: guard violations: "
+              + json.dumps(out["guards"]["by_class"]), file=sys.stderr)
+        if args.guards == "abort":
+            print(json.dumps(out))
+            return EXIT_GUARD
     print(json.dumps(out))
     return 0
 

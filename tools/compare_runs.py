@@ -30,6 +30,13 @@ Usage:
       economics (hits / misses / fast-forwarded windows / bytes), with
       the loud MEANINGLESS banner when the backend fingerprints differ
       (docs/performance.md "Steady-state memoization")
+  python tools/compare_runs.py --trace BEFORE.json AFTER.json # diff two
+      tools/run_scenarios.py --trace-report files: per-scenario wall-
+      time attribution deltas (total / dispatch / memo / hook, span
+      modes) from the shadowscope run ledgers, with the loud
+      MEANINGLESS banner when the backend fingerprints differ —
+      wall-clock numbers never compare across containers
+      (docs/observability.md "Run ledger")
 Exit 0 when all runs match bit-for-bit (--bench/--scenarios: always);
 1 otherwise.
 """
@@ -244,6 +251,54 @@ def memo_delta(before_path: str, after_path: str) -> int:
     return 0
 
 
+def _trace_report(path: str) -> tuple[dict | None, dict]:
+    """Load a run_scenarios.py --trace-report file -> (backend
+    fingerprint, scenario name -> phase-totals dict)."""
+    with open(path) as fh:
+        rec = json.load(fh)
+    return rec.get("backend"), dict(rec.get("scenarios") or {})
+
+
+def trace_delta(before_path: str, after_path: str) -> int:
+    """Print per-scenario wall-time attribution deltas between two
+    run_scenarios.py --trace-report files (informational — always
+    exits 0). Every value here is wall clock, so this mode carries the
+    loudest version of the backend-fingerprint rule: a ledger from a
+    different container times a different machine, and the banner says
+    so before any table prints (docs/observability.md "Run ledger")."""
+    b0, s0 = _trace_report(before_path)
+    b1, s1 = _trace_report(after_path)
+    if b0 != b1:
+        print("=" * 70)
+        print(f"WARNING: backend fingerprints differ — before={b0} "
+              f"after={b1}.")
+        print("Every number on a run ledger is wall clock, so "
+              "cross-container deltas are\nMEANINGLESS; the tables "
+              "below are printed for completeness only.\nRe-trace "
+              "both runs on one container.")
+        print("=" * 70)
+
+    def table(metric, unit="ms"):
+        t0 = {k: v.get(metric) for k, v in s0.items()
+              if v.get(metric) is not None}
+        t1 = {k: v.get(metric) for k, v in s1.items()
+              if v.get(metric) is not None}
+        if t0 or t1:
+            _delta_table(f"scenario ({metric})", t0, t1, width=32,
+                         unit=unit)
+            print()
+
+    table("wall_ms")
+    table("dispatch_ms")
+    table("memo_ms")
+    table("hook_ms")
+    table("replay_ms")
+    table("ffwd_ms")
+    table("spans", "count")
+    table("growth_events", "count")
+    return 0
+
+
 def _cost_metrics(path: str) -> tuple[str | None, dict]:
     """Load a shadowlint --cost-report record -> (platform key,
     entry short-name -> metrics dict)."""
@@ -325,13 +380,20 @@ def main(argv=None) -> int:
              "banner when the backend fingerprints differ) instead "
              "of running the determinism harness",
     )
+    ap.add_argument(
+        "--trace", nargs=2, metavar=("BEFORE", "AFTER"), default=None,
+        help="diff two tools/run_scenarios.py --trace-report files "
+             "(per-scenario wall-time attribution deltas from the run "
+             "ledgers; loud banner when the backend fingerprints "
+             "differ) instead of running the determinism harness",
+    )
     args = ap.parse_args(argv)
     modes = [m for m in (args.bench, args.scenarios, args.cost,
-                         args.memo)
+                         args.memo, args.trace)
              if m is not None]
     if len(modes) > 1:
-        ap.error("--bench/--scenarios/--cost/--memo are mutually "
-                 "exclusive")
+        ap.error("--bench/--scenarios/--cost/--memo/--trace are "
+                 "mutually exclusive")
     if args.bench is not None:
         if args.config or args.matrix or args.runs is not None:
             ap.error("--bench takes exactly two bench JSONs and no config")
@@ -351,6 +413,11 @@ def main(argv=None) -> int:
             ap.error("--memo takes exactly two memo reports and no "
                      "config")
         return memo_delta(*args.memo)
+    if args.trace is not None:
+        if args.config or args.matrix or args.runs is not None:
+            ap.error("--trace takes exactly two trace reports and no "
+                     "config")
+        return trace_delta(*args.trace)
     if args.config is None:
         ap.error("config is required (or use --bench)")
     if args.matrix and args.runs is not None:

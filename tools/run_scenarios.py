@@ -29,6 +29,14 @@ Usage:
   python tools/run_scenarios.py --memo \\
       --memo-report memo.json                         # cache stats
       (hits/misses/fast-forwarded windows/bytes) per scenario
+  python tools/run_scenarios.py --trace DIR --check   # shadowscope:
+      run-ledger JSONL + two-clock Chrome trace per scenario in DIR;
+      tracing is presence-invisible, so --check must still pass —
+      that IS the CI trace-parity gate
+  python tools/run_scenarios.py --trace DIR \\
+      --trace-report trace.json                       # per-scenario
+      wall-time phase totals + the backend fingerprint (the
+      compare_runs --trace artifact)
 """
 
 from __future__ import annotations
@@ -91,6 +99,16 @@ def main(argv=None) -> int:
                     help="write per-scenario memo cache stats (hits/"
                          "misses/fast-forwarded windows/entry sizes) "
                          "+ the backend fingerprint as JSON")
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="shadowscope run ledger: write "
+                         "DIR/<name>.ledger.jsonl + the two-clock "
+                         "Chrome trace DIR/<name>.trace.json per "
+                         "scenario (presence-invisible: digests are "
+                         "unchanged)")
+    ap.add_argument("--trace-report", default=None, metavar="PATH",
+                    help="write per-scenario wall-time phase totals + "
+                         "the backend fingerprint as JSON (needs "
+                         "--trace)")
     args = ap.parse_args(argv)
 
     from shadow_tpu.workloads import load_scenario_file
@@ -151,6 +169,10 @@ def main(argv=None) -> int:
         print("run_scenarios: --memo-report needs --memo (or a config "
               "with memo.enabled)", file=sys.stderr)
         return 2
+    if args.trace_report and not args.trace:
+        print("run_scenarios: --trace-report needs --trace",
+              file=sys.stderr)
+        return 2
     memo_arg = None
     if args.memo or (memo_cfg is not None and memo_cfg.enabled):
         from shadow_tpu.core.config import MemoOptions
@@ -165,7 +187,10 @@ def main(argv=None) -> int:
 
     records = []
     memo_reports = {}
+    trace_summaries = {}
     guards_dirty = False
+    if args.trace:
+        os.makedirs(args.trace, exist_ok=True)
     for path in paths:
         spec = load_scenario_file(path, seed=seed_override)
         if flows_enabled and spec.transport != "flows":
@@ -191,6 +216,16 @@ def main(argv=None) -> int:
             if args.sample_every:
                 hops_sink = os.path.join(args.telemetry,
                                          f"{spec.name}.hops.jsonl")
+        tracer_obj = None
+        if args.trace:
+            from shadow_tpu.telemetry import tracer as tracermod
+
+            tracer_obj = tracermod.RunTracer(
+                spec.name, meta={"family": spec.family,
+                                 "hosts": spec.n_hosts,
+                                 "windows": spec.windows,
+                                 "memo": memo_arg is not None,
+                                 "faults": bool(args.faults)})
         rec = runner.run_scenario(
             spec, guards=args.guards,
             use_default_faults=args.faults,
@@ -201,9 +236,27 @@ def main(argv=None) -> int:
             hops_sink=hops_sink,
             flow_emit_cap=flow_emit_cap,
             flow_recv_wnd=flow_recv_wnd,
-            memo=memo_arg)
+            memo=memo_arg,
+            tracer=tracer_obj)
         if harvester is not None:
             harvester.finalize()
+        if tracer_obj is not None:
+            tracer_obj.close()
+            tracer_obj.write(os.path.join(
+                args.trace, f"{spec.name}.ledger.jsonl"))
+            heartbeats = None
+            if args.telemetry:
+                from shadow_tpu.telemetry import export
+
+                with open(os.path.join(args.telemetry,
+                                       f"{spec.name}.jsonl")) as fh:
+                    heartbeats = export.read_heartbeats(fh)
+            tracermod.write_chrome_trace(
+                tracer_obj.records,
+                os.path.join(args.trace, f"{spec.name}.trace.json"),
+                heartbeats=heartbeats)
+            trace_summaries[spec.name] = tracermod.phase_totals(
+                tracer_obj.records)
         records.append(rec)
         g = rec.get("guards")
         status = ("done" if rec["all_done"]
@@ -241,6 +294,22 @@ def main(argv=None) -> int:
                       fh, sort_keys=True, indent=1)
             fh.write("\n")
         print(f"run_scenarios: memo report -> {args.memo_report}",
+              file=sys.stderr)
+
+    if args.trace_report:
+        # the wall-attribution artifact (compare_runs --trace): phase
+        # totals per scenario + the backend fingerprint — wall numbers
+        # are only comparable within one container identity
+        import bench
+        from shadow_tpu.telemetry import tracer as tracermod
+
+        with open(args.trace_report, "w") as fh:
+            json.dump({"backend": bench.backend_fingerprint(),
+                       "schema": tracermod.RUNLEDGER_SCHEMA,
+                       "scenarios": trace_summaries},
+                      fh, sort_keys=True, indent=1)
+            fh.write("\n")
+        print(f"run_scenarios: trace report -> {args.trace_report}",
               file=sys.stderr)
 
     if args.update_golden:

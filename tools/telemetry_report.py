@@ -21,6 +21,15 @@ Runs with `telemetry.histograms` enabled additionally print the fleet
 p50/p90/p99/p999 table per distribution (delivery latency, egress
 sojourn, queue depth) and a per-host latency percentile table
 (docs/observability.md "Distributions and the flight recorder").
+
+Ensemble mode (docs/observability.md "Ensemble percentiles"):
+  python tools/telemetry_report.py w0.jsonl w1.jsonl w2.jsonl w3.jsonl \
+      --ensemble
+takes one heartbeat stream PER WORLD and prints the percentile of
+percentiles: each world's final cumulative histograms reduce to their
+own p50/p90/p99/p999 first, then each quantile reports the min/median/
+max across worlds — cross-world error bars on every latency quantile
+(telemetry/histo.ensemble_percentiles).
 """
 
 from __future__ import annotations
@@ -32,7 +41,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from shadow_tpu.telemetry import export  # noqa: E402
+from shadow_tpu.telemetry import export, histo  # noqa: E402
 
 
 def _fmt_bytes(n: float) -> str:
@@ -106,10 +115,64 @@ def _print_host_percentiles(per_host: dict, top: int) -> None:
             break
 
 
+def _final_hist(heartbeats: list[dict]) -> dict | None:
+    """One world's final cumulative fleet histograms — the same line
+    `summarize` reduces to run-level percentiles."""
+    sims = sorted((r for r in heartbeats if r.get("type") == "sim"),
+                  key=lambda r: r["time_ns"])
+    return next((r["hist"] for r in reversed(sims) if r.get("hist")),
+                None)
+
+
+def ensemble_report(paths: list[str]) -> dict:
+    """The percentile-of-percentiles report over one heartbeat stream
+    per world: per-world histogram percentiles first, then min/median/
+    max across worlds per quantile (histo.ensemble_percentiles)."""
+    hists = []
+    for path in paths:
+        with open(path) as fh:
+            heartbeats = export.read_heartbeats(fh)
+        hist = _final_hist(heartbeats)
+        if hist is None:
+            raise SystemExit(
+                f"telemetry_report: {path} carries no histogram "
+                "heartbeats — ensemble mode needs runs with "
+                "telemetry.histograms enabled")
+        hists.append(hist)
+    names = sorted(set().union(*(h.keys() for h in hists)))
+    report = {}
+    for name in names:
+        worlds = [h[name] for h in hists if name in h]
+        report[name.removeprefix(histo.HIST_PREFIX)] = \
+            histo.ensemble_percentiles(worlds)
+    return {"worlds": len(paths), "files": list(paths),
+            "percentile_of_percentiles": report}
+
+
+def _print_ensemble(rep: dict) -> None:
+    print(f"ensemble percentile of percentiles "
+          f"({rep['worlds']} worlds):")
+    for name, qs in sorted(rep["percentile_of_percentiles"].items()):
+        unit = " ns" if name.endswith("_ns") else ""
+        print(f"  {name}:")
+        for q, bars in sorted(qs.items(), key=lambda kv: len(kv[0])):
+            print(f"    {q:>5}: min={bars['min']}{unit}  "
+                  f"median={bars['median']}{unit}  "
+                  f"max={bars['max']}{unit}  "
+                  f"(n={bars['worlds']})")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("jsonl", metavar="PATH",
-                    help="heartbeat JSONL (or a shadow log; '-' = stdin)")
+    ap.add_argument("jsonl", metavar="PATH", nargs="+",
+                    help="heartbeat JSONL (or a shadow log; '-' = "
+                         "stdin); with --ensemble, one stream per "
+                         "world")
+    ap.add_argument("--ensemble", action="store_true",
+                    help="percentile-of-percentiles across one "
+                         "heartbeat stream per world: per-world "
+                         "histogram percentiles, then min/median/max "
+                         "error bars across worlds")
     ap.add_argument("--json", action="store_true",
                     help="print the summary as JSON instead of a table")
     ap.add_argument("--trace", metavar="OUT",
@@ -128,10 +191,26 @@ def main(argv=None) -> int:
                     help="top talkers to list (default 10)")
     args = ap.parse_args(argv)
 
-    if args.jsonl == "-":
+    if args.ensemble:
+        if len(args.jsonl) < 2:
+            print("telemetry_report: --ensemble needs at least two "
+                  "per-world heartbeat streams", file=sys.stderr)
+            return 2
+        rep = ensemble_report(args.jsonl)
+        if args.json:
+            print(json.dumps(rep, indent=2, sort_keys=True))
+        else:
+            _print_ensemble(rep)
+        return 0
+    if len(args.jsonl) != 1:
+        print("telemetry_report: multiple heartbeat streams need "
+              "--ensemble", file=sys.stderr)
+        return 2
+
+    if args.jsonl[0] == "-":
         heartbeats = export.read_heartbeats(sys.stdin)
     else:
-        with open(args.jsonl) as fh:
+        with open(args.jsonl[0]) as fh:
             heartbeats = export.read_heartbeats(fh)
     if not heartbeats:
         print("telemetry_report: no heartbeat records found",
