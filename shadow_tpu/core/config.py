@@ -411,8 +411,13 @@ class FaultsOptions:
     overrides `general.seed` for the fault-schedule RNG stream.
     `kernel_fallback` lets a failing Pallas plane kernel degrade to the
     XLA path (logged loudly) instead of killing the run;
-    `device_retries`/`retry_backoff` govern the transient-device-error
-    retry loop around transport dispatches."""
+    `device_retries`/`retry_backoff`/`retry_cap`/`retry_jitter` govern
+    the transient-device-error retry loop around transport dispatches:
+    exponential backoff from `retry_backoff`, capped at `retry_cap`,
+    with seeded jitter shaving up to `retry_jitter` (a [0,1] fraction)
+    off each delay — the whole sleep schedule is a pure function of
+    the config (faults/healing.backoff_schedule), so retry timing is
+    replicable in postmortems."""
 
     seed: Optional[int] = None
     events: list = field(default_factory=list)
@@ -422,6 +427,8 @@ class FaultsOptions:
     kernel_fallback: bool = True
     device_retries: int = 3
     retry_backoff: int = 50 * simtime.MILLISECOND  # WALL ns
+    retry_cap: int = 2 * simtime.SECOND  # WALL ns, backoff ceiling
+    retry_jitter: float = 0.5  # [0,1] fraction shaved per delay
     checkpoint: FaultCheckpointOptions = field(
         default_factory=FaultCheckpointOptions)
 
@@ -525,6 +532,7 @@ _DUR_FIELDS = {
     "interval",  # telemetry.interval / faults.checkpoint.interval
     "watchdog",  # faults.watchdog (WALL-clock round timeout)
     "retry_backoff",  # faults.retry_backoff (WALL-clock)
+    "retry_cap",  # faults.retry_cap (WALL-clock backoff ceiling)
 }
 _RATE_FIELDS = {"bandwidth_down", "bandwidth_up"}
 _BYTE_FIELDS = {"socket_send_buffer", "socket_recv_buffer", "pcap_capture_size"}
@@ -829,6 +837,11 @@ def parse_config_dict(raw: dict) -> ConfigOptions:
         raise ConfigError("faults.device_retries must be >= 0")
     if cfg.faults.retry_backoff < 0:
         raise ConfigError("faults.retry_backoff must be >= 0")
+    if cfg.faults.retry_cap < cfg.faults.retry_backoff:
+        raise ConfigError("faults.retry_cap must be >= faults."
+                          "retry_backoff (it is the backoff ceiling)")
+    if not 0.0 <= cfg.faults.retry_jitter <= 1.0:
+        raise ConfigError("faults.retry_jitter must be in [0, 1]")
     if cfg.workload.seed is not None and cfg.workload.seed < 0:
         raise ConfigError("workload.seed must be >= 0")
     for cls in ("device", "reconcile", "progress"):

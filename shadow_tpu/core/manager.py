@@ -395,6 +395,14 @@ class Manager:
             # before the crash path (faults/healing.py)
             self.transport.retry_attempts = config.faults.device_retries
             self.transport.retry_backoff_s = config.faults.retry_backoff / 1e9
+            self.transport.retry_cap_s = config.faults.retry_cap / 1e9
+            self.transport.retry_jitter = config.faults.retry_jitter
+            # the retry sleep schedule is seeded like the fault plane
+            # (faults.seed falls back to general.seed) so identical
+            # configs retry on identical wall cadences
+            self.transport.retry_seed = (
+                config.faults.seed if config.faults.seed is not None
+                else config.general.seed)
             # guard plane: thread the device invariant accumulator
             # through every transport dispatch, and pair the device
             # counters with the CPU ledger for reconciliation (mid-run

@@ -34,6 +34,15 @@ Three checkpoint kinds share the format (``meta["kind"]``):
   managed native processes hold kernel state no serializer can see —
   docs/robustness.md spells out the boundary), but it preserves the
   forensic state of exactly the runs that need explaining.
+
+A second, SINGLE-FILE format lives alongside the directory format:
+`write_npz_checkpoint` / `load_npz_checkpoint` pack every array plus an
+embedded JSON meta record (with a per-ARRAY sha256 map and a schema
+stamp) into one ``.npz``, written tmp + fsync + rename so the file
+either exists whole or not at all. `faults/runstate.py` (full-run
+checkpoints) and `tpu/memo.py` (`ChainMemo.save/load`) both ride this
+format. The checksums are corruption detection — truncation, bit rot,
+schema drift — not a cryptographic tamper seal.
 """
 
 from __future__ import annotations
@@ -65,6 +74,21 @@ def _sha256(path: str) -> str:
         for block in iter(lambda: fh.read(1 << 20), b""):
             h.update(block)
     return h.hexdigest()
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a just-renamed entry survives power loss
+    (POSIX only promises the rename is durable once the parent is)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # e.g. platforms refusing O_RDONLY on directories
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def write_checkpoint(path: str, *, meta: dict,
@@ -114,6 +138,7 @@ def write_checkpoint(path: str, *, meta: dict,
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
+    _fsync_dir(parent)
     return manifest
 
 
@@ -132,7 +157,17 @@ def load_checkpoint(path: str) -> tuple[dict, dict[str, np.ndarray]]:
         raise CheckpointError(
             f"{path}: checkpoint format {manifest.get('format')!r} != "
             f"supported {FORMAT_VERSION}")
-    for name, want in manifest.get("sha256", {}).items():
+    shas = manifest.get("sha256")
+    # a manifest that lists no checksum for a payload file verifies
+    # nothing about it — a truncated arrays.npz would be half-accepted.
+    # Both payload files MUST be covered (the old hole: iterate-what's-
+    # listed silently skipped anything missing from the map).
+    if not isinstance(shas, dict) or not {_ARRAYS, _META} <= set(shas):
+        absent = sorted({_ARRAYS, _META} - set(shas or ()))
+        raise CheckpointError(
+            f"{path}: manifest lists no checksum for {absent} — refusing "
+            f"a checkpoint whose payload cannot be verified")
+    for name, want in shas.items():
         fpath = os.path.join(path, name)
         if not os.path.isfile(fpath):
             raise CheckpointError(f"{path}: missing payload file {name}")
@@ -141,10 +176,16 @@ def load_checkpoint(path: str) -> tuple[dict, dict[str, np.ndarray]]:
             raise CheckpointError(
                 f"{path}: checksum mismatch on {name} (manifest {want[:12]}"
                 f"..., file {got[:12]}...) — the checkpoint is corrupt")
-    with open(os.path.join(path, _META)) as fh:
-        meta = json.load(fh)
-    with np.load(os.path.join(path, _ARRAYS)) as z:
-        arrays = {k: z[k] for k in z.files}
+    try:
+        with open(os.path.join(path, _META)) as fh:
+            meta = json.load(fh)
+        with np.load(os.path.join(path, _ARRAYS)) as z:
+            arrays = {k: z[k] for k in z.files}
+    except CheckpointError:
+        raise
+    except Exception as e:  # truncated zip, bad JSON, OSError, ...
+        raise CheckpointError(
+            f"{path}: unreadable payload (truncated or corrupt): {e}") from e
     return meta, arrays
 
 
@@ -162,6 +203,131 @@ def prune_checkpoints(directory: str, keep: int, prefix: str = "ckpt-") -> None:
     for e in os.listdir(directory):
         if ".tmp-" in e or ".old-" in e:
             shutil.rmtree(os.path.join(directory, e), ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# single-file atomic checkpoints: .npz with an embedded, self-verifying
+# meta record (the runstate / ChainMemo persistence format)
+# ---------------------------------------------------------------------------
+
+NPZ_META_KEY = "__meta__"
+
+
+def _array_sha256(arr: np.ndarray) -> str:
+    """Content hash of one array: dtype + shape + bytes, so a bit flip,
+    a silent dtype cast, or a reshape all read as corruption."""
+    arr = np.ascontiguousarray(arr)
+    h = hashlib.sha256()
+    h.update(str(arr.dtype).encode())
+    h.update(repr(tuple(arr.shape)).encode())
+    h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def write_npz_checkpoint(path: str, *, schema: str, meta: dict,
+                         arrays: dict[str, np.ndarray]) -> dict:
+    """Atomically write one self-verifying ``.npz`` checkpoint file.
+
+    The JSON-serializable `meta` is embedded in the archive itself (as
+    a uint8 blob under `NPZ_META_KEY`) together with a `schema` stamp,
+    the format version, and a per-array sha256 map covering EVERY
+    array — so there is exactly one file to rename, and a load can
+    refuse truncation/corruption naming the offending field. Write
+    order is tmp file -> fsync -> os.replace -> parent-dir fsync; a
+    kill at any instant leaves either the old file or the new one,
+    never a prefix. Returns the full embedded meta."""
+    path = os.path.abspath(path)
+    parent = os.path.dirname(path)
+    os.makedirs(parent, exist_ok=True)
+    clean: dict[str, np.ndarray] = {}
+    for name, arr in arrays.items():
+        if name == NPZ_META_KEY:
+            raise CheckpointError(
+                f"array name {name!r} collides with the embedded meta key")
+        clean[name] = np.asarray(arr)
+    full_meta = dict(meta)
+    full_meta["format"] = FORMAT_VERSION
+    full_meta["schema"] = schema
+    full_meta["sha256"] = {n: _array_sha256(a)
+                           for n, a in sorted(clean.items())}
+    blob = np.frombuffer(
+        json.dumps(full_meta, sort_keys=True).encode(), dtype=np.uint8)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **{NPZ_META_KEY: blob}, **clean)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(parent)
+    return full_meta
+
+
+def load_npz_checkpoint(path: str, *,
+                        schema: str) -> tuple[dict, dict[str, np.ndarray]]:
+    """Load + verify a `write_npz_checkpoint` file; (meta, arrays).
+
+    Refuses — always as `CheckpointError`, always naming what's wrong —
+    an unreadable/truncated archive, a missing or undecodable meta
+    record, a format/schema mismatch, an array listed in the checksum
+    map but absent from the archive, an array present but NOT covered
+    by the map, and any per-array checksum mismatch."""
+    path = os.path.abspath(path)
+    if not os.path.isfile(path):
+        raise CheckpointError(f"{path}: no such checkpoint file")
+    try:
+        with np.load(path) as z:
+            payload = {k: z[k] for k in z.files}
+    except Exception as e:  # BadZipFile / EOF / OSError / pickle refusal
+        raise CheckpointError(
+            f"{path}: unreadable checkpoint (truncated or corrupt): "
+            f"{e}") from e
+    if NPZ_META_KEY not in payload:
+        raise CheckpointError(
+            f"{path}: missing embedded meta record {NPZ_META_KEY!r} — not "
+            f"a runstate-format checkpoint")
+    try:
+        meta = json.loads(bytes(payload.pop(NPZ_META_KEY)).decode())
+    except ValueError as e:
+        raise CheckpointError(
+            f"{path}: undecodable embedded meta record: {e}") from e
+    if meta.get("format") != FORMAT_VERSION:
+        raise CheckpointError(
+            f"{path}: checkpoint format {meta.get('format')!r} != "
+            f"supported {FORMAT_VERSION}")
+    if meta.get("schema") != schema:
+        raise CheckpointError(
+            f"{path}: schema {meta.get('schema')!r} != expected {schema!r} "
+            f"— written by an incompatible shadow_tpu version?")
+    want = meta.get("sha256")
+    if not isinstance(want, dict):
+        raise CheckpointError(
+            f"{path}: meta carries no per-array sha256 map — refusing a "
+            f"checkpoint whose arrays cannot be verified")
+    missing = sorted(set(want) - set(payload))
+    if missing:
+        raise CheckpointError(
+            f"{path}: missing array {missing[0]!r} (listed in the checksum "
+            f"map but absent from the archive — truncated checkpoint?)")
+    extra = sorted(set(payload) - set(want))
+    if extra:
+        raise CheckpointError(
+            f"{path}: array {extra[0]!r} is not covered by the checksum "
+            f"map — refusing an unverifiable field")
+    for name in sorted(want):
+        got = _array_sha256(payload[name])
+        if got != want[name]:
+            raise CheckpointError(
+                f"{path}: checksum mismatch on array {name!r} (meta "
+                f"{want[name][:12]}..., file {got[:12]}...) — the "
+                f"checkpoint is corrupt")
+    return meta, payload
 
 
 # ---------------------------------------------------------------------------

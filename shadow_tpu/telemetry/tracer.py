@@ -55,6 +55,7 @@ are simulated µs; `otherData.clocks` names both.
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Iterable, Optional
 
@@ -99,13 +100,33 @@ class RunTracer:
     The driver calls `clock()`/`span()` at chain boundaries; boundary
     hooks call `annotate()`; the owner calls `memo_close()`/`close()`/
     `write()` once after the drive loop returns. Nothing here may read
-    a device value — pass host scalars/dicts only."""
+    a device value — pass host scalars/dicts only.
+
+    ``sink`` switches the ledger to STREAMING mode: every record is
+    appended (and flushed + fsynced) to the file the instant it is
+    recorded, so a SIGKILL preserves everything up to the last chain
+    boundary — the crash-survivable ledger a checkpointed run needs.
+    ``resume=True`` (requires ``sink``) APPENDS to an existing ledger
+    instead of truncating it, and suppresses the duplicate head meta
+    record (`read_ledger`'s first-line contract): the resumed run's
+    records continue the killed run's stream, and the caller marks the
+    seam with an ``annotate("resume", checkpoint=...)`` record that
+    `stitch_ledger` / trace_report use to rebase the second segment's
+    wall clocks (docs/observability.md "Ledger stitching")."""
 
     def __init__(self, label: str = "run", *, backend: dict | None = None,
-                 meta: dict | None = None):
+                 meta: dict | None = None, sink: str | None = None,
+                 resume: bool = False):
+        if resume and sink is None:
+            raise ValueError("RunTracer(resume=True) requires a sink "
+                             "path — only a streamed ledger can be "
+                             "appended across a resume")
         self.label = label
         self._origin = time.monotonic()  # shadowlint: disable=SL101 -- wall-clock ledger origin; never feeds sim time
         self._seq = 0
+        self._sink = None
+        self.sink_path = sink
+        self.resumed = bool(resume)
         head = {"schema": RUNLEDGER_SCHEMA, "kind": "meta",
                 "label": label,
                 "backend": dict(backend) if backend is not None
@@ -114,6 +135,17 @@ class RunTracer:
             head.update({k: v for k, v in meta.items()
                          if k not in ("schema", "kind")})
         self.records: list[dict] = [head]
+        if sink is not None:
+            self._sink = open(sink, "a" if resume else "w")
+            if not resume:
+                self._emit(head)
+
+    def _emit(self, rec: dict) -> None:
+        if self._sink is None:
+            return
+        self._sink.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._sink.flush()
+        os.fsync(self._sink.fileno())
 
     # -- driver hooks ----------------------------------------------------
 
@@ -144,16 +176,18 @@ class RunTracer:
         rec.update(extra)
         self._seq += 1
         self.records.append(rec)
+        self._emit(rec)
         return rec
 
     def annotate(self, kind: str, **fields) -> dict:
         """A boundary-hook event (harvest tick, guard deltas,
-        checkpoint/tamper/kill, fault-span fingerprint) at its wall
-        instant. `fields` must be host values."""
+        checkpoint/tamper/kill, resume seam, fault-span fingerprint)
+        at its wall instant. `fields` must be host values."""
         rec = {"kind": kind,
                "wall_t0_ms": (time.monotonic() - self._origin) * 1e3}  # shadowlint: disable=SL101 -- annotation wall instant
         rec.update(fields)
         self.records.append(rec)
+        self._emit(rec)
         return rec
 
     # -- finalization ----------------------------------------------------
@@ -164,10 +198,13 @@ class RunTracer:
         (trace_report.py --memo-view, pinned by test)."""
         rec = {"kind": "memo", "report": memo.report()}
         self.records.append(rec)
+        self._emit(rec)
         return rec
 
     def close(self, **fields) -> dict:
-        """Terminal record: total wall + span/sync accounting."""
+        """Terminal record: total wall + span/sync accounting (spans
+        counted from THIS process — a resumed ledger's earlier
+        segments live only in the sink file). Closes the sink."""
         spans = [r for r in self.records if r.get("kind") == "span"]
         rec = {"kind": "end",
                "wall_ms": (time.monotonic() - self._origin) * 1e3,  # shadowlint: disable=SL101 -- total run wall
@@ -175,11 +212,22 @@ class RunTracer:
                "windows": sum(r["windows"] for r in spans)}
         rec.update(fields)
         self.records.append(rec)
+        self._emit(rec)
+        if self._sink is not None:
+            self._sink.close()
+            self._sink = None
         return rec
 
     def write(self, path: str) -> dict:
         """Dump the ledger as JSONL (meta first, end last when
-        `close()` ran). Returns a tiny summary."""
+        `close()` ran). In streaming-sink mode the file is already on
+        disk record-by-record: writing to the sink path is a no-op
+        (returns its summary); writing elsewhere copies the in-memory
+        records (which on a resumed tracer are THIS segment only)."""
+        if self.sink_path is not None and (
+                os.path.abspath(path) == os.path.abspath(self.sink_path)):
+            return {"path": path, "records": len(self.records),
+                    "streamed": True}
         with open(path, "w") as fh:
             for rec in self.records:
                 fh.write(json.dumps(rec, sort_keys=True) + "\n")
@@ -217,6 +265,36 @@ def load_ledger(path: str) -> list[dict]:
         return read_ledger(fh)
 
 
+def stitch_ledger(records: list[dict]) -> tuple[list[dict], int]:
+    """Rebase a resumed ledger's wall clocks onto one monotone
+    timeline.
+
+    A killed-and-resumed run's ledger is one stream with a ``resume``
+    annotation at every seam (the resumed tracer appends; no duplicate
+    head meta). Each segment's wall clocks restart at its own process
+    origin, so raw ``wall_t0_ms`` values overlap; this shifts every
+    post-seam record forward by the maximum wall extent seen so far —
+    purely presentational (WALL_FIELDS are excluded from every
+    compare), but it is what makes the Chrome export render segments
+    side by side instead of stacked. Returns ``(rebased_records,
+    n_resumes)``; untouched pass-through when no seam exists."""
+    out: list[dict] = []
+    offset = 0.0
+    seg_max = 0.0
+    resumes = 0
+    for rec in records:
+        if rec.get("kind") == "resume":
+            resumes += 1
+            offset = seg_max
+        if "wall_t0_ms" in rec:
+            rec = dict(rec)
+            rec["wall_t0_ms"] += offset
+            seg_max = max(seg_max,
+                          rec["wall_t0_ms"] + rec.get("wall_ms", 0.0))
+        out.append(rec)
+    return out, resumes
+
+
 def phase_totals(records: list[dict]) -> dict:
     """Aggregate wall attribution — the per-phase table compare_runs
     --trace and trace_report print: totals plus a per-mode breakdown.
@@ -231,6 +309,7 @@ def phase_totals(records: list[dict]) -> dict:
         "memo_ms": sum(r["memo_ms"] for r in spans),
         "hook_ms": sum(r["hook_ms"] for r in spans),
         "growth_events": sum(len(r.get("growth", ())) for r in spans),
+        "resumes": sum(1 for r in records if r.get("kind") == "resume"),
     }
     for mode in SPAN_MODES:
         picked = [r for r in spans if r["mode"] == mode]
@@ -273,6 +352,9 @@ def write_chrome_trace(records: list[dict], path: str, *,
     percentile counters, per-host traffic, flight-recorder flows — on
     the VIRTUAL axis (1 trace µs = 1 simulated µs). The two tracks
     share a timeline but not a clock; `otherData.clocks` names each."""
+    # a resumed ledger's segments get their wall clocks rebased onto
+    # one monotone axis first (no-op for single-segment ledgers)
+    records, _resumes = stitch_ledger(records)
     meta = records[0] if records and records[0].get("kind") == "meta" \
         else {"label": "run"}
     events: list[dict] = [

@@ -217,7 +217,7 @@ def drive_chained_windows(state, extras, chain_fn, *, n_rounds: int,
                           policy: RingPolicy | None = None,
                           window_ns: int = 0, host_names=None,
                           on_chain=None, memo=None, memo_span_salt=None,
-                          tracer=None):
+                          tracer=None, checkpointer=None):
     """THE driver loop. bench.py, tools/chaos_smoke.py, and the
     scenario corpus runner (workloads/runner.py) all drive their
     windows through this one function (pinned by the inspect-source
@@ -287,6 +287,18 @@ def drive_chained_windows(state, extras, chain_fn, *, n_rounds: int,
     (`costmodel.DRIVER_MODULES` re-proves that statically), and
     presence-invisible: tracer-on and tracer-off runs are
     digest-identical (the trace-parity CI gate).
+
+    ``checkpointer`` (a `faults/runstate.RunCheckpointer`,
+    docs/robustness.md "Resumable runs") spills the FULL carry to an
+    atomic file at its own cadence: its checkpoint instants join the
+    boundary set (extra cuts are bitwise-invisible — the chain-length
+    theorem), and the save fires AFTER the span's on_chain hook so a
+    resume replays nothing the hook already observed. On the memo
+    fast-forward path the checkpoint is written straight from the host
+    mirror — a crash-survivable run costs zero extra device syncs.
+    A checkpointed run SIGKILLed at any boundary and resumed is
+    byte-identical to its uninterrupted twin (the kill/resume CI
+    gate).
     """
     import jax.numpy as jnp
 
@@ -296,6 +308,8 @@ def drive_chained_windows(state, extras, chain_fn, *, n_rounds: int,
             "memo_span_salt folding them into the key (e.g. the fault "
             "schedule's span_fingerprint) — refusing to memoize spans "
             "whose external inputs the key cannot see")
+    if checkpointer is not None:
+        boundaries = tuple(boundaries) + checkpointer.cut_rounds(n_rounds)
 
     host_carry = None  # memo's host mirror of (state, extras)
     stale = False      # device carry behind host_carry (hits pending)
@@ -304,6 +318,16 @@ def drive_chained_windows(state, extras, chain_fn, *, n_rounds: int,
         nonlocal state, extras, stale
         state, extras = memo.to_device(host_carry)
         stale = False
+
+    def _maybe_checkpoint(r1):
+        # fires at the span end, after on_chain: the carry saved is
+        # exactly the carry the next span starts from. The memo host
+        # mirror, when authoritative, is saved as-is (no device sync).
+        if checkpointer is None or not checkpointer.due(r1, n_rounds):
+            return
+        carry = host_carry if host_carry is not None else (state, extras)
+        checkpointer.save(r1, carry, host=host_carry is not None,
+                          tracer=tracer)
 
     for r0, r1 in chain_spans(n_rounds, chain_len,
                               start_round=start_round,
@@ -341,6 +365,7 @@ def drive_chained_windows(state, extras, chain_fn, *, n_rounds: int,
                 if tracer is not None:
                     tracer.span(r0, r1, mode=mode, t0=t0,
                                 hook_ms=hook_ms, span_salt=salt_hex)
+                _maybe_checkpoint(r1)
                 continue
             if stale:
                 _upload()
@@ -393,6 +418,7 @@ def drive_chained_windows(state, extras, chain_fn, *, n_rounds: int,
                         dispatch_ms=dispatch_ms, memo_ms=memo_ms,
                         hook_ms=hook_ms, growth=growth,
                         span_salt=salt_hex)
+        _maybe_checkpoint(r1)
     if stale:
         _upload()
     return state, extras
@@ -428,7 +454,7 @@ def world_keys(rng_root, seeds):
 def drive_ensemble(states, extras, chain_fn, *, n_rounds: int,
                    chain_len: int, start_round: int = 0,
                    boundaries=(), per_round=None, per_round_axis=None,
-                   on_chain=None, tracer=None):
+                   on_chain=None, tracer=None, checkpointer=None):
     """The PROVEN vmap ensemble driver (ROADMAP item 4): W independent
     worlds execute the same chained-window schedule as ONE batched
     program, with one host sync per chain for the whole ensemble.
@@ -463,8 +489,11 @@ def drive_ensemble(states, extras, chain_fn, *, n_rounds: int,
     the whole ensemble); returning a (states, extras) pair replaces
     the carried values, returning None keeps them. ``tracer`` records
     one ``mode="ensemble"`` run-ledger span per batched chain (same
-    zero-sync contract as :func:`drive_chained_windows`). Returns the
-    final batched ``(states, extras)``.
+    zero-sync contract as :func:`drive_chained_windows`).
+    ``checkpointer`` spills the batched per-world carries into ONE
+    runstate file per cadence (docs/robustness.md "Resumable runs" —
+    ensemble kill/resume parity is the solo theorem applied
+    worldwise). Returns the final batched ``(states, extras)``.
     """
     import jax
     import jax.numpy as jnp
@@ -474,6 +503,13 @@ def drive_ensemble(states, extras, chain_fn, *, n_rounds: int,
     # worlds at a time — the amortization BENCH_WORLDS measures
     vchain = jax.jit(jax.vmap(chain_fn,
                               in_axes=(0, 0, None, per_round_axis)))
+    if checkpointer is not None:
+        # per-world batched carries spill to ONE file: the leading
+        # world axis is just another array dimension to the flattener,
+        # and chain_spans' absolute alignment makes the resumed
+        # ensemble partition identical (the solo parity argument,
+        # batched)
+        boundaries = tuple(boundaries) + checkpointer.cut_rounds(n_rounds)
     for r0, r1 in chain_spans(n_rounds, chain_len,
                               start_round=start_round,
                               boundaries=boundaries):
@@ -494,6 +530,8 @@ def drive_ensemble(states, extras, chain_fn, *, n_rounds: int,
         if tracer is not None:
             tracer.span(r0, r1, mode="ensemble", t0=t0,
                         dispatch_ms=dispatch_ms, hook_ms=hook_ms)
+        if checkpointer is not None and checkpointer.due(r1, n_rounds):
+            checkpointer.save(r1, (states, extras), tracer=tracer)
     return states, extras
 
 

@@ -57,9 +57,20 @@ hits the driver never touches the device at all (the fast-forward
 fast path): replay is host-side numpy, uploaded lazily only when a
 miss must execute or an `on_chain` hook needs device values.
 
-The cache is per-`ChainMemo`-instance, i.e. per driver invocation:
-entries never outlive the closures (params, program tables, RNG
-root) whose behavior they summarize.
+The cache OUTLIVES a driver invocation: `ChainMemo.save/load`
+round-trip the recorded entries through the single-file atomic
+checkpoint format (`faults/checkpoint.write_npz_checkpoint` — tmp +
+fsync + rename, per-array sha256, schema stamp), and `spill/absorb`
+embed the same payload inside a full-run checkpoint
+(`faults/runstate.py`). Soundness across runs is the same argument as
+within a run: every key digests the full canonical carry plus the
+caller's static salt, so a persisted entry can only hit when the
+world, knobs, and carry bytes are identical — and `absorb` refuses a
+cache whose salt fingerprint disagrees (the closures the entries
+summarize — params, program tables, RNG root — are exactly what the
+salt names). Entries restored from disk are flagged `persisted`;
+`stats()["persisted_hits"]` counts hits served by them (the CI
+cross-run witness).
 """
 
 from __future__ import annotations
@@ -73,8 +84,21 @@ import numpy as np
 from ..telemetry.harvest import apply_counter_delta, counter_delta
 
 __all__ = [
-    "COUNTER_LEAVES", "STABILITY_FIELDS", "ChainMemo", "walk_carry",
+    "COUNTER_LEAVES", "MEMO_SCHEMA", "STABILITY_FIELDS", "ChainMemo",
+    "walk_carry",
 ]
+
+#: schema stamp for persisted caches (`write_npz_checkpoint` refuses a
+#: mismatch before any entry is trusted)
+MEMO_SCHEMA = "chainmemo-v1"
+
+#: instance counters `spill` serializes and `absorb(restore=True)`
+#: reproduces verbatim — the memoized kill/resume parity surface
+_COUNTER_ATTRS = (
+    "lookups", "hits", "misses", "records", "evictions",
+    "unstable_skips", "oversize_skips", "fast_forwarded_windows",
+    "peak_bytes", "loaded_entries", "persisted_hits",
+)
 
 #: (NamedTuple class name) -> field names excluded from the memo key
 #: and replayed as modular uint32 deltas. Declaration rules:
@@ -210,14 +234,17 @@ def classify(owner: str, field: str) -> str:
 
 
 class _Entry:
-    __slots__ = ("post_keyed", "deltas", "nbytes", "span_len", "hits")
+    __slots__ = ("post_keyed", "deltas", "nbytes", "span_len", "hits",
+                 "persisted")
 
-    def __init__(self, post_keyed, deltas, nbytes, span_len):
+    def __init__(self, post_keyed, deltas, nbytes, span_len,
+                 persisted=False):
         self.post_keyed = post_keyed
         self.deltas = deltas
         self.nbytes = nbytes
         self.span_len = span_len
         self.hits = 0
+        self.persisted = persisted
 
 
 class ChainMemo:
@@ -258,6 +285,8 @@ class ChainMemo:
         self.oversize_skips = 0
         self.fast_forwarded_windows = 0
         self.peak_bytes = 0
+        self.loaded_entries = 0
+        self.persisted_hits = 0
 
     # -- snapshot / key ---------------------------------------------------
 
@@ -303,6 +332,8 @@ class ChainMemo:
             return None
         self.hits += 1
         entry.hits += 1
+        if entry.persisted:
+            self.persisted_hits += 1
         self.fast_forwarded_windows += entry.span_len
         self._entries.move_to_end(key)
         return entry
@@ -397,6 +428,146 @@ class ChainMemo:
 
         return jax.tree.map(jnp.asarray, carry_host)
 
+    # -- persistence ------------------------------------------------------
+
+    def _salt_sha(self) -> str:
+        return hashlib.sha256(self.salt).hexdigest()
+
+    def spill(self, prefix: str = "") -> tuple[dict, dict]:
+        """Serialize the cache: ``(meta_fragment, arrays)``.
+
+        Each entry's leaves become arrays named
+        ``{prefix}e{j}.post.{i}`` (keyed snapshot) or
+        ``{prefix}e{j}.delta.{i}`` (modular counter delta); the meta
+        fragment records insertion order, keys, span lengths, and a
+        sha256 of the salt (the world identity the keys were minted
+        under). Used standalone by `save` and embedded by
+        `faults/runstate.py` full-run checkpoints."""
+        arrays: dict[str, np.ndarray] = {}
+        entries_meta = []
+        for j, (key, e) in enumerate(self._entries.items()):
+            leaves = []
+            for i, post in enumerate(e.post_keyed):
+                if post is not None:
+                    arrays[f"{prefix}e{j}.post.{i}"] = post
+                    leaves.append("post")
+                else:
+                    arrays[f"{prefix}e{j}.delta.{i}"] = e.deltas[i]
+                    leaves.append("delta")
+            entries_meta.append({"key": key, "span_len": int(e.span_len),
+                                 "hits": int(e.hits), "leaves": leaves,
+                                 "persisted": bool(e.persisted)})
+        meta = {
+            "salt_sha256": self._salt_sha(),
+            "entries": entries_meta,
+            "max_bytes": int(self.max_bytes),
+            "min_repeat": int(self.min_repeat),
+            # the full counter census + pre-record miss counts: what
+            # `absorb(restore=True)` needs to reproduce this instance
+            # EXACTLY (the memoized kill/resume byte-parity contract —
+            # a resumed run's memo report matches the uninterrupted
+            # twin's, entry hits and all)
+            "counters": {f: int(getattr(self, f))
+                         for f in _COUNTER_ATTRS},
+            "seen": {k: int(v) for k, v in self._seen.items()},
+        }
+        return meta, arrays
+
+    def absorb(self, meta: dict, arrays: dict, prefix: str = "",
+               source: str = "<memo>", restore: bool = False) -> int:
+        """Inverse of `spill`. Two modes:
+
+        - cross-run import (default): re-admit entries flagged
+          ``persisted`` with hit counts restarting at 0 — a later hit
+          counts toward `persisted_hits`, the ROADMAP-3 proof surface.
+        - ``restore=True`` (full-run checkpoint resume): reproduce the
+          spilled instance EXACTLY — per-entry hits and persisted
+          flags, every counter, and the pre-record miss census — so a
+          resumed run's memo report is byte-identical to the
+          uninterrupted twin's.
+
+        Refuses — as `CheckpointError` — a cache minted under a
+        different salt (different world/knobs: its keys could never
+        soundly hit) or one missing a serialized leaf. The caller must
+        also keep its ``key_extra`` policy consistent across runs;
+        that closure is not serializable, so it is a documented
+        contract, not a check. Returns the number of entries admitted
+        (LRU budget applies)."""
+        from ..faults.checkpoint import CheckpointError
+
+        want_salt = meta.get("salt_sha256")
+        if want_salt != self._salt_sha():
+            raise CheckpointError(
+                f"{source}: memo cache salt_sha256 {str(want_salt)[:12]}... "
+                f"does not match this run's salt {self._salt_sha()[:12]}... "
+                f"— the cache was recorded for a different world/knob "
+                f"configuration; refusing to replay it")
+        loaded = 0
+        for j, em in enumerate(meta.get("entries", ())):
+            key = em["key"]
+            if key in self._entries:
+                continue
+            post_keyed, deltas, nbytes = [], [], 0
+            for i, kind in enumerate(em["leaves"]):
+                name = f"{prefix}e{j}.{kind}.{i}"
+                if name not in arrays:
+                    raise CheckpointError(
+                        f"{source}: memo entry {j} is missing serialized "
+                        f"leaf {name!r}")
+                arr = np.asarray(arrays[name])
+                if kind == "post":
+                    post_keyed.append(arr)
+                    deltas.append(None)
+                else:
+                    post_keyed.append(None)
+                    deltas.append(arr)
+                nbytes += arr.nbytes
+            if nbytes > self.max_bytes:
+                self.oversize_skips += 1
+                continue
+            while (self.bytes_cached + nbytes > self.max_bytes
+                   and self._entries):
+                _k, old = self._entries.popitem(last=False)
+                self.bytes_cached -= old.nbytes
+                self.evictions += 1
+            entry = _Entry(post_keyed, deltas, nbytes,
+                           int(em["span_len"]),
+                           persisted=(bool(em.get("persisted"))
+                                      if restore else True))
+            if restore:
+                entry.hits = int(em.get("hits", 0))
+            self._entries[key] = entry
+            self.bytes_cached += nbytes
+            loaded += 1
+        if restore:
+            for f in _COUNTER_ATTRS:
+                if f in meta.get("counters", {}):
+                    setattr(self, f, int(meta["counters"][f]))
+            self._seen = OrderedDict(
+                (k, int(v)) for k, v in meta.get("seen", {}).items())
+        else:
+            self.peak_bytes = max(self.peak_bytes, self.bytes_cached)
+            self.loaded_entries += loaded
+        return loaded
+
+    def save(self, path: str) -> dict:
+        """Persist the cache to one atomic self-verifying ``.npz``
+        (ROADMAP-3 "cross-run cache persistence"). Returns the written
+        meta."""
+        from ..faults import checkpoint as ckpt
+
+        meta, arrays = self.spill()
+        meta["kind"] = "chainmemo"
+        return ckpt.write_npz_checkpoint(path, schema=MEMO_SCHEMA,
+                                         meta=meta, arrays=arrays)
+
+    def load(self, path: str) -> int:
+        """Load a `save`d cache file; returns entries admitted."""
+        from ..faults import checkpoint as ckpt
+
+        meta, arrays = ckpt.load_npz_checkpoint(path, schema=MEMO_SCHEMA)
+        return self.absorb(meta, arrays, source=path)
+
     # -- reporting --------------------------------------------------------
 
     def stats(self) -> dict:
@@ -409,6 +580,8 @@ class ChainMemo:
             "unstable_skips": self.unstable_skips,
             "oversize_skips": self.oversize_skips,
             "fast_forwarded_windows": self.fast_forwarded_windows,
+            "loaded_entries": self.loaded_entries,
+            "persisted_hits": self.persisted_hits,
             "entries": len(self._entries),
             "bytes_cached": self.bytes_cached,
             "peak_bytes": self.peak_bytes,

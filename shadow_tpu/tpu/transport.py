@@ -218,6 +218,9 @@ class DeviceTransport:
         # Manager sets attempts > 0 from `faults.device_retries`
         self.retry_attempts = 0
         self.retry_backoff_s = 0.05
+        self.retry_cap_s = 2.0
+        self.retry_jitter = 0.5
+        self.retry_seed = 0
 
         CI = ingress_cap
         z = lambda shape: jnp.zeros(shape, jnp.int32)
@@ -580,6 +583,8 @@ class DeviceTransport:
             return retry_transient(
                 kernel, *args, attempts=self.retry_attempts,
                 backoff_s=self.retry_backoff_s,
+                cap_s=self.retry_cap_s, jitter=self.retry_jitter,
+                seed=self.retry_seed,
                 what=f"device transport {what}", **kwargs)
 
         return call

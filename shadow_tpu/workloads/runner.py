@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 from typing import Optional
 
 import numpy as np
@@ -147,7 +148,13 @@ def run_scenario(spec: ScenarioSpec, *,
                  flow_emit_cap: Optional[int] = None,
                  flow_recv_wnd: Optional[int] = None,
                  memo=None,
-                 tracer=None) -> dict:
+                 tracer=None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 16,
+                 resume: bool = False,
+                 kill_at: Optional[int] = None,
+                 memo_cache: Optional[str] = None,
+                 provenance: Optional[dict] = None) -> dict:
     """Execute one scenario for its full window budget. Returns the
     JSON-ready record (no wall-clock anywhere — byte-stable across
     runs by construction).
@@ -181,7 +188,22 @@ def run_scenario(spec: ScenarioSpec, *,
     harvest-tick annotations, and the folded memo report when
     memoized. Presence-invisible by contract — the returned record
     (and therefore the golden digests) is byte-identical with or
-    without it; wall time lives ONLY on the ledger."""
+    without it; wall time lives ONLY on the ledger.
+
+    `checkpoint_dir` + `checkpoint_every` make the run
+    crash-survivable (`faults/runstate.py`, docs/robustness.md
+    "Resumable runs"): the full carry — every presence plane, the
+    fault-schedule position, the memo cache — spills atomically every
+    K windows. `resume=True` restarts from the newest checkpoint for
+    this scenario (cold start when none exists); the returned record
+    is byte-identical to the uninterrupted run's, so provenance rides
+    OUT OF BAND: the `provenance` dict (when given) is filled with
+    ``resumed_from``/``start_round``/``checkpoints_written``, and the
+    tracer gets a ``resume`` annotation. `memo_cache` (a file path)
+    persists the `ChainMemo` across invocations: loaded before the
+    run when present, saved after — the second invocation's spans
+    replay from the persisted entries (``persisted_hits`` in the memo
+    report is the witness)."""
     import jax
     import jax.numpy as jnp
 
@@ -383,6 +405,62 @@ def run_scenario(spec: ScenarioSpec, *,
             return schedule.span_fingerprint(
                 r0 * spec.window_ns, r1 * spec.window_ns).encode()
 
+    if memo_cache is not None:
+        if memo_obj is None:
+            raise ValueError("memo_cache requires memo: there is no "
+                             "cache to persist on a non-memoized run")
+        if os.path.isfile(memo_cache):
+            memo_obj.load(memo_cache)
+
+    checkpointer = None
+    start_round = 0
+    resumed_from = None
+    if checkpoint_dir is not None:
+        from ..faults import runstate
+        from ..faults.checkpoint import CheckpointError
+
+        if mesh_devices is not None:
+            raise ValueError(
+                "checkpointing does not support mesh_devices yet: the "
+                "flattened carry re-uploads un-sharded arrays, "
+                "collapsing the host-axis sharding")
+        checkpointer = runstate.RunCheckpointer(
+            checkpoint_dir, every=checkpoint_every, label=spec.name,
+            window_ns=spec.window_ns, schedule=schedule, memo=memo_obj,
+            kill_after=kill_at,
+            extra_meta={"fingerprint": scenario_fingerprint(spec),
+                        "program_digest": program_digest(prog)})
+        if resume:
+            ckpt_path = runstate.latest_checkpoint(checkpoint_dir,
+                                                   label=spec.name)
+            if ckpt_path is not None:
+                # refuse world drift BEFORE touching the carry: a
+                # same-named scenario with different physics should be
+                # named as such, not as whatever leaf mismatches first
+                want_fp = runstate.load_runstate(ckpt_path)[0].get(
+                    "fingerprint")
+                if want_fp != scenario_fingerprint(spec):
+                    raise CheckpointError(
+                        f"{ckpt_path}: scenario fingerprint mismatch "
+                        f"(checkpoint {str(want_fp)[:12]}..., this run "
+                        f"{scenario_fingerprint(spec)[:12]}...) — the "
+                        f"checkpoint belongs to a different world")
+                template = (state, (ws, metrics, gstate, hstate,
+                                    fstate, flowst))
+                res = runstate.resume_carry(template_carry=template,
+                                            path=ckpt_path,
+                                            schedule=schedule,
+                                            memo=memo_obj)
+                state, (ws, metrics, gstate, hstate, fstate,
+                        flowst) = res["carry"]
+                start_round = res["round"]
+                resumed_from = os.path.basename(ckpt_path)
+                if resumed_from.endswith(".runstate.npz"):
+                    resumed_from = resumed_from[:-len(".runstate.npz")]
+                if tracer is not None:
+                    tracer.annotate("resume", checkpoint=resumed_from,
+                                    r=start_round)
+
     need_cadence = telemetry is not None or recorder is not None
     state, extras = _elastic.drive_chained_windows(
         state, (ws, metrics, gstate, hstate, fstate, flowst), chain_fn,
@@ -390,11 +468,23 @@ def run_scenario(spec: ScenarioSpec, *,
         chain_len=(telemetry_every if need_cadence
                    else memo_chain if memo_obj is not None
                    else spec.windows),
+        start_round=start_round,
         per_round=per_round if faulted else None,
         window_ns=spec.window_ns,
         on_chain=on_chain if need_cadence else None,
-        memo=memo_obj, memo_span_salt=memo_salt_fn, tracer=tracer)
+        memo=memo_obj, memo_span_salt=memo_salt_fn, tracer=tracer,
+        checkpointer=checkpointer)
     ws, metrics, gstate, hstate, fstate, flowst = extras
+
+    if memo_cache is not None and memo_obj is not None:
+        memo_obj.save(memo_cache)
+    if provenance is not None:
+        provenance.update({
+            "resumed_from": resumed_from,
+            "start_round": int(start_round),
+            "checkpoints_written": (checkpointer.saved
+                                    if checkpointer is not None else 0),
+        })
 
     jax.block_until_ready(state)
     done_win = wdevice.completion_windows(ws)

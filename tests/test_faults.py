@@ -419,6 +419,102 @@ def test_retry_transient_never_retries_real_bugs():
     assert len(calls) == 1
 
 
+def test_backoff_schedule_seed_pinned():
+    """The exact delay floats for one (seed, what): the schedule is a
+    pure function of its arguments, so these values are FROZEN — a
+    drift here means retry timing silently changed for every run."""
+    from shadow_tpu.faults.healing import backoff_schedule
+
+    got = backoff_schedule(4, base_s=0.05, cap_s=2.0, jitter=0.5,
+                           seed=0, what="device dispatch")
+    assert got == backoff_schedule(4, base_s=0.05, cap_s=2.0,
+                                   jitter=0.5, seed=0,
+                                   what="device dispatch")
+    assert len(got) == 4
+    for k, d in enumerate(got):
+        unjittered = min(2.0, 0.05 * 2.0 ** k)
+        # jitter only SHAVES: (1 - 0.5) * base <= delay <= base
+        assert unjittered * 0.5 <= d <= unjittered
+    # pin the first draw to 12 decimal places: sha256("0|device
+    # dispatch|0")[:8] mapped to [0,1) is a frozen constant
+    assert round(got[0], 12) == round(0.045871920679567496, 12)
+
+
+def test_backoff_schedule_seed_and_what_sensitivity():
+    from shadow_tpu.faults.healing import backoff_schedule
+
+    base = backoff_schedule(3, seed=0)
+    assert backoff_schedule(3, seed=1) != base
+    assert backoff_schedule(3, seed=0, what="checkpoint write") != base
+
+
+def test_backoff_schedule_cap_and_zero_jitter():
+    from shadow_tpu.faults.healing import backoff_schedule
+
+    # jitter=0 is the pure capped exponential, exactly
+    got = backoff_schedule(8, base_s=0.05, cap_s=0.4, jitter=0.0)
+    assert got == (0.05, 0.1, 0.2, 0.4, 0.4, 0.4, 0.4, 0.4)
+    assert backoff_schedule(0) == ()
+    with pytest.raises(ValueError, match="attempts"):
+        backoff_schedule(-1)
+    with pytest.raises(ValueError, match="jitter"):
+        backoff_schedule(2, jitter=1.5)
+
+
+def test_retry_transient_sleeps_the_pinned_schedule(monkeypatch):
+    """The sleeps retry_transient performs ARE the backoff_schedule
+    floats, in order — no other randomness sneaks in."""
+    from shadow_tpu.faults import healing
+
+    slept = []
+    monkeypatch.setattr(healing._walltime, "sleep", slept.append)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 4:
+            raise RuntimeError("UNAVAILABLE: link reset")
+        return "ok"
+
+    assert retry_transient(flaky, attempts=3, backoff_s=0.05,
+                           cap_s=2.0, jitter=0.5, seed=7) == "ok"
+    want = healing.backoff_schedule(3, base_s=0.05, cap_s=2.0,
+                                    jitter=0.5, seed=7)
+    assert tuple(slept) == want[:3]
+
+
+def test_retry_config_wires_cap_jitter_seed_to_transport():
+    """faults.retry_cap / retry_jitter / the seed convention reach the
+    transport attrs the Manager dispatches through."""
+    from shadow_tpu.core.manager import Manager
+
+    cfg = load_config_str("""
+general: {stop_time: 1s, seed: 9}
+network: {graph: {type: 1_gbit_switch}}
+experimental: {scheduler: serial, use_tpu_transport: true}
+faults: {device_retries: 2, retry_backoff: 10ms, retry_cap: 3s,
+         retry_jitter: 0.25}
+hosts: {a: {network_node_id: 0, processes: []}}
+""")
+    mgr = Manager(cfg)
+    tr = mgr.transport
+    assert tr.retry_attempts == 2
+    assert tr.retry_backoff_s == pytest.approx(0.01)
+    assert tr.retry_cap_s == pytest.approx(3.0)
+    assert tr.retry_jitter == pytest.approx(0.25)
+    assert tr.retry_seed == 9  # faults.seed unset -> general.seed
+
+
+def test_retry_cap_below_backoff_refused():
+    with pytest.raises(ConfigError, match="retry_cap"):
+        load_config_str("""
+general: {stop_time: 1s}
+network: {graph: {type: 1_gbit_switch}}
+faults: {retry_backoff: 2s, retry_cap: 1s}
+hosts: {a: {network_node_id: 0, processes: []}}
+""")
+
+
 def test_kernel_fallback_demotes_pallas_to_xla(caplog):
     import logging
 
@@ -488,6 +584,52 @@ def test_watchdog_disarms_on_healthy_round():
         pass
     _walltime.sleep(0.35)
     assert not fired and wd.strike is None
+
+
+def test_watchdog_timeout_must_be_positive():
+    for bad in (0, -1.5):
+        with pytest.raises(ValueError, match="positive"):
+            RoundWatchdog(bad, lambda t: [])
+
+
+def test_kill_blamed_skips_dead_pids_kills_live_ones():
+    """A blamed pid that already exited (raced its own death) is
+    skipped silently; the live wedged one is SIGKILLed and reported."""
+    from shadow_tpu.faults.watchdog import kill_blamed
+
+    dead = subprocess.Popen(["true"])
+    dead.wait()  # reaped: its pid no longer resolves
+    live = subprocess.Popen(["sleep", "300"])
+    try:
+        blame = [HostBlame("hostA", ["hostA.gone.0"], [dead.pid],
+                           []),
+                 HostBlame("hostB", ["hostB.wedge.0"], [live.pid],
+                           [live.pid])]
+        killed = kill_blamed(blame)
+        assert killed == [live.pid]
+        live.wait(timeout=10)  # really dead, not just signalled
+    finally:
+        if live.poll() is None:
+            live.kill()
+            live.wait()
+
+
+def test_watchdog_blame_collection_failure_still_strikes():
+    """A collect_blame that itself dies must not lose the strike: the
+    round still fails structured, attributed as 'no live blame'."""
+
+    def broken(_round_start):
+        raise RuntimeError("process table scan exploded")
+
+    wd = RoundWatchdog(0.15, broken)
+    wd.arm(round_start_ns=77)
+    deadline = _walltime.monotonic() + 10
+    while wd.strike is None and _walltime.monotonic() < deadline:
+        _walltime.sleep(0.02)
+    assert isinstance(wd.strike, WatchdogError)
+    assert wd.strike.killed == []
+    assert "no live blame" in str(wd.strike)
+    assert wd.strike.round_start_ns == 77
 
 
 def _manager_watchdog_sim(monkeypatch):

@@ -1,0 +1,644 @@
+"""Full-run checkpoint/resume (`faults/runstate.py`) and the shared
+atomic npz format (`faults/checkpoint.write_npz_checkpoint`).
+
+Pins the PR-19 crash-survivability contract (docs/robustness.md
+"Resumable runs"):
+
+- the single-file format round-trips and REFUSES truncation, array
+  bit flips, schema drift, and missing/extra arrays — each with a
+  `CheckpointError` naming the offending field;
+- `flatten_carry`/`restore_carry` round-trip a full driver carry with
+  disabled presence planes recorded as explicit ``none_paths`` and
+  presence drift refused by path;
+- a `drive_chained_windows` / `drive_ensemble` run resumed from a
+  mid-run checkpoint ends bitwise-identical to the uninterrupted
+  twin (the chain-length-invisibility theorem at work);
+- `ChainMemo.save/load` persists the cache across driver invocations
+  with hits > 0 on the second (ROADMAP-3 "cross-run cache
+  persistence"), and `absorb(restore=True)` reproduces the spilled
+  instance exactly (the memoized kill/resume parity surface).
+
+The heavy end-to-end cases are @slow; CI's kill/resume gate runs this
+file unfiltered alongside the `tools/run_scenarios.py --kill-at /
+--resume` corpus proof (the shared-driver-gate pattern).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from shadow_tpu.faults.checkpoint import (CheckpointError,  # noqa: E402
+                                          NPZ_META_KEY,
+                                          load_npz_checkpoint,
+                                          write_npz_checkpoint)
+from shadow_tpu.faults.runstate import (RUNSTATE_SCHEMA,  # noqa: E402
+                                        RunCheckpointer, flatten_carry,
+                                        latest_checkpoint, load_runstate,
+                                        restore_carry, resume_carry)
+from shadow_tpu.tpu import elastic, ingest_rows, profiling  # noqa: E402
+from shadow_tpu.tpu import memo as memomod  # noqa: E402
+from shadow_tpu.tpu.plane import unpack_planes, window_step  # noqa: E402
+from shadow_tpu.workloads.phold import respawn_batch  # noqa: E402
+from shadow_tpu.workloads.runner import digest_pytrees  # noqa: E402
+
+N = 16
+SPAWN_BASE = 10_000
+
+
+# ---------------------------------------------------------------------------
+# the shared single-file npz format
+
+
+def _write_sample(path):
+    arrays = {"x": np.arange(6, dtype=np.int32).reshape(2, 3),
+              "y": np.linspace(0.0, 1.0, 4)}
+    write_npz_checkpoint(path, schema="fmt-test-v1",
+                         meta={"knob": 7}, arrays=arrays)
+    return arrays
+
+
+def test_npz_roundtrip(tmp_path):
+    path = str(tmp_path / "a.npz")
+    arrays = _write_sample(path)
+    meta, got = load_npz_checkpoint(path, schema="fmt-test-v1")
+    assert meta["knob"] == 7
+    assert set(got) == set(arrays)
+    for k in arrays:
+        np.testing.assert_array_equal(got[k], arrays[k])
+        assert got[k].dtype == arrays[k].dtype
+
+
+def test_npz_no_tmp_left_behind(tmp_path):
+    path = str(tmp_path / "a.npz")
+    _write_sample(path)
+    assert os.listdir(tmp_path) == ["a.npz"]
+
+
+def test_npz_meta_key_collision_refused(tmp_path):
+    with pytest.raises(CheckpointError, match="collides"):
+        write_npz_checkpoint(str(tmp_path / "a.npz"), schema="s",
+                             meta={}, arrays={NPZ_META_KEY: np.zeros(1)})
+
+
+def test_npz_schema_drift_refused(tmp_path):
+    path = str(tmp_path / "a.npz")
+    _write_sample(path)
+    with pytest.raises(CheckpointError, match="schema 'fmt-test-v1'"):
+        load_npz_checkpoint(path, schema="fmt-test-v2")
+
+
+def test_npz_truncation_refused(tmp_path):
+    path = str(tmp_path / "a.npz")
+    _write_sample(path)
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[: len(raw) // 2])
+    with pytest.raises(CheckpointError, match="truncated or corrupt"):
+        load_npz_checkpoint(path, schema="fmt-test-v1")
+
+
+def _rewrite(src, dst, mutate):
+    """Re-pack an npz with ``mutate(arrays)`` applied — the zip stays
+    well-formed, so only the per-array checksums can catch it."""
+    with np.load(src) as z:
+        arrays = {k: z[k] for k in z.files}
+    mutate(arrays)
+    np.savez(dst, **arrays)
+
+
+def test_npz_array_bitflip_refused(tmp_path):
+    path = str(tmp_path / "a.npz")
+    _write_sample(path)
+
+    def flip(arrays):
+        x = arrays["x"].copy()
+        x.flat[0] ^= 1
+        arrays["x"] = x
+
+    _rewrite(path, path, flip)
+    with pytest.raises(CheckpointError,
+                       match="checksum mismatch on array 'x'"):
+        load_npz_checkpoint(path, schema="fmt-test-v1")
+
+
+def test_npz_missing_array_refused(tmp_path):
+    path = str(tmp_path / "a.npz")
+    _write_sample(path)
+    _rewrite(path, path, lambda arrays: arrays.pop("y"))
+    with pytest.raises(CheckpointError, match="'y'"):
+        load_npz_checkpoint(path, schema="fmt-test-v1")
+
+
+def test_npz_extra_uncovered_array_refused(tmp_path):
+    path = str(tmp_path / "a.npz")
+    _write_sample(path)
+    _rewrite(path, path,
+             lambda arrays: arrays.__setitem__("smuggled", np.zeros(2)))
+    with pytest.raises(CheckpointError, match="'smuggled'"):
+        load_npz_checkpoint(path, schema="fmt-test-v1")
+
+
+def test_npz_meta_corruption_refused(tmp_path):
+    # a damaged meta blob (valid zip, broken JSON) is refused by name
+    # — the corruption-detection contract; checksums are not a
+    # cryptographic tamper seal (module docstring)
+    path = str(tmp_path / "a.npz")
+    _write_sample(path)
+
+    def smash(arrays):
+        blob = bytearray(bytes(arrays[NPZ_META_KEY]))
+        blob[0] = ord("X")  # no longer parses as JSON
+        arrays[NPZ_META_KEY] = np.frombuffer(bytes(blob), np.uint8)
+
+    _rewrite(path, path, smash)
+    with pytest.raises(CheckpointError, match="meta"):
+        load_npz_checkpoint(path, schema="fmt-test-v1")
+
+
+def test_npz_is_a_plain_zip(tmp_path):
+    # operators can inspect checkpoints with stock tooling
+    path = str(tmp_path / "a.npz")
+    _write_sample(path)
+    with zipfile.ZipFile(path) as z:
+        names = {n.removesuffix(".npy") for n in z.namelist()}
+    assert {"x", "y", NPZ_META_KEY} <= names
+
+
+# ---------------------------------------------------------------------------
+# carry flatten/restore
+
+
+def _toy_carry(hist=True):
+    from shadow_tpu.telemetry import make_metrics
+
+    # device-realistic dtypes (int32/float32): restore_carry
+    # re-uploads with jnp.asarray, which honors the session's default
+    # 32-bit precision — exactly what real driver carries hold
+    metrics = jax.device_get(make_metrics(4))
+    h = np.arange(8, dtype=np.int32) if hist else None
+    return (np.arange(12, dtype=np.int32).reshape(3, 4),
+            (metrics, h, {"b": np.float32(2.5), "a": np.int32(3)}))
+
+
+def test_flatten_restore_roundtrip():
+    carry = _toy_carry()
+    arrays, none_paths = flatten_carry(carry)
+    assert none_paths == []
+    # structural paths: namedtuple fields + tuple indices + dict keys
+    assert "carry.0" in arrays
+    assert "carry.1.1" in arrays and "carry.1.2.a" in arrays
+    assert any(p.startswith("carry.1.0.") for p in arrays)
+    back = jax.device_get(
+        restore_carry(carry, arrays, none_paths=none_paths))
+    la, lb = jax.tree.leaves(carry), jax.tree.leaves(back)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        assert np.asarray(x).dtype == np.asarray(y).dtype
+
+
+def test_restore_takes_shapes_from_file():
+    # elastic growth: the checkpoint's GROWN shapes win over the
+    # cold-template shapes
+    grown = (np.zeros((4, 8), np.int32), (np.ones((5,), np.int32),))
+    arrays, nones = flatten_carry(grown)
+    template = (np.zeros((4, 2), np.int32), (np.ones((5,), np.int32),))
+    back = restore_carry(template, arrays, none_paths=nones)
+    assert back[0].shape == (4, 8)
+
+
+def test_restore_none_roundtrip_and_presence_refusals():
+    carry_off = _toy_carry(hist=False)
+    arrays_off, nones_off = flatten_carry(carry_off)
+    assert nones_off == ["carry.1.1"]
+    back = restore_carry(carry_off, arrays_off, none_paths=nones_off)
+    assert back[1][1] is None
+
+    carry_on = _toy_carry(hist=True)
+    arrays_on, nones_on = flatten_carry(carry_on)
+    # checkpoint recorded the plane LIVE, this run disabled it
+    with pytest.raises(CheckpointError,
+                       match=r"presence mismatch at 'carry\.1\.1'"):
+        restore_carry(carry_off, arrays_on, none_paths=nones_on)
+    # checkpoint recorded the plane DISABLED, this run enabled it
+    with pytest.raises(CheckpointError,
+                       match=r"presence mismatch at 'carry\.1\.1'"):
+        restore_carry(carry_on, arrays_off, none_paths=nones_off)
+
+
+def test_restore_missing_leaf_refused():
+    carry = _toy_carry()
+    arrays, nones = flatten_carry(carry)
+    del arrays["carry.1.1"]
+    with pytest.raises(CheckpointError,
+                       match=r"missing carry leaf 'carry\.1\.1'"):
+        restore_carry(carry, arrays, none_paths=nones)
+
+
+# ---------------------------------------------------------------------------
+# RunCheckpointer mechanics
+
+
+def test_checkpointer_validation(tmp_path):
+    with pytest.raises(ValueError, match="every"):
+        RunCheckpointer(str(tmp_path), every=0)
+    with pytest.raises(ValueError, match="keep"):
+        RunCheckpointer(str(tmp_path), every=4, keep=0)
+
+
+def test_checkpointer_cadence(tmp_path):
+    ck = RunCheckpointer(str(tmp_path), every=4)
+    assert ck.cut_rounds(12) == (4, 8)
+    assert ck.due(4, 12) and ck.due(8, 12)
+    assert not ck.due(3, 12)
+    assert not ck.due(12, 12)  # final boundary: run already finishing
+
+
+def test_checkpointer_save_prune_latest(tmp_path):
+    ck = RunCheckpointer(str(tmp_path), every=2, label="toy", keep=2)
+    carry = _toy_carry()
+    for r1 in (2, 4, 6):
+        info = ck.save(r1, carry, host=True)
+        assert os.path.isfile(info["path"])
+    names = sorted(os.listdir(tmp_path))
+    assert names == ["toy-r00000004.runstate.npz",
+                     "toy-r00000006.runstate.npz"]  # keep=2 pruned r2
+    assert ck.saved == 3
+    latest = latest_checkpoint(str(tmp_path), label="toy")
+    assert latest.endswith("toy-r00000006.runstate.npz")
+    meta, arrays = load_runstate(latest)
+    assert meta["round"] == 6
+    res = resume_carry(latest, carry)
+    assert res["round"] == 6
+    got = jax.device_get(res["carry"])
+    for x, y in zip(jax.tree.leaves(carry), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_runstate_refuses_other_kind(tmp_path):
+    path = str(tmp_path / "x.runstate.npz")
+    write_npz_checkpoint(path, schema=RUNSTATE_SCHEMA,
+                         meta={"kind": "other"}, arrays={})
+    with pytest.raises(CheckpointError, match="kind"):
+        load_runstate(path)
+
+
+def test_resume_refuses_schedule_fingerprint_mismatch(tmp_path):
+    class FakeSched:
+        def __init__(self, fp):
+            self._fp = fp
+            self.advanced = []
+
+        def fingerprint(self):
+            return self._fp
+
+        def advance(self, now_ns):
+            self.advanced.append(now_ns)
+
+    carry = _toy_carry()
+    ck = RunCheckpointer(str(tmp_path), every=2, window_ns=100,
+                         schedule=FakeSched("aaaa"))
+    info = ck.save(2, carry, host=True)
+    with pytest.raises(CheckpointError, match="fingerprint mismatch"):
+        resume_carry(info["path"], carry, schedule=FakeSched("bbbb"))
+    sched = FakeSched("aaaa")
+    resume_carry(info["path"], carry, schedule=sched)
+    assert sched.advanced == [200]  # one advance to round * window_ns
+
+
+# ---------------------------------------------------------------------------
+# ChainMemo persistence (ROADMAP-3 "cross-run cache persistence")
+
+
+def _mk_carry(x=0, events=0):
+    from shadow_tpu.telemetry import make_metrics
+
+    m = jax.device_get(make_metrics(2))
+    m = m._replace(events=np.int32(events))
+    return (np.full((4,), x, np.int32), (m,))
+
+
+def _record_one(memo, x=1, r0=8):
+    pre, post = _mk_carry(x), _mk_carry(x + 1, events=5)
+    k, walk = memo.key(pre, r0, r0 + 4)
+    memo.lookup(k)
+    assert memo.record(k, walk, post, span_len=4)
+    return k, pre, post
+
+
+def test_memo_save_load_hits_on_second_invocation(tmp_path):
+    path = str(tmp_path / "cache.memo.npz")
+    first = memomod.ChainMemo(salt=b"world-v1")
+    k, pre, post = _record_one(first)
+    first.save(path)
+
+    second = memomod.ChainMemo(salt=b"world-v1")
+    assert second.load(path) == 1
+    assert second.loaded_entries == 1
+    entry = second.lookup(k)
+    assert entry is not None and entry.persisted
+    assert second.persisted_hits == 1  # ROADMAP-3: hits > 0, run 2
+    replayed = second.replay(entry, jax.device_get(pre))
+    np.testing.assert_array_equal(replayed[0], post[0])
+    assert int(replayed[1][0].events) == int(post[1][0].events)
+
+
+def test_memo_load_salt_mismatch_refused(tmp_path):
+    path = str(tmp_path / "cache.memo.npz")
+    first = memomod.ChainMemo(salt=b"world-v1")
+    _record_one(first)
+    first.save(path)
+    other = memomod.ChainMemo(salt=b"world-v2")
+    with pytest.raises(CheckpointError, match="salt_sha256"):
+        other.load(path)
+
+
+def test_memo_absorb_missing_leaf_refused():
+    memo = memomod.ChainMemo(salt=b"w")
+    _record_one(memo)
+    meta, arrays = memo.spill()
+    victim = next(iter(arrays))
+    del arrays[victim]
+    fresh = memomod.ChainMemo(salt=b"w")
+    with pytest.raises(CheckpointError, match=victim):
+        fresh.absorb(meta, arrays)
+
+
+def test_memo_restore_reproduces_instance_exactly():
+    # the memoized kill/resume byte-parity surface: spill +
+    # absorb(restore=True) reproduces stats() and report() verbatim,
+    # including per-entry hit counts and the pre-record miss census
+    memo = memomod.ChainMemo(salt=b"w", min_repeat=2)
+    pre, post = _mk_carry(1), _mk_carry(2, events=3)
+    k, walk = memo.key(pre, 8, 12)
+    memo.lookup(k)                       # miss 1 (below min_repeat)
+    assert not memo.record(k, walk, post, span_len=4)
+    memo.lookup(k)                       # miss 2
+    assert memo.record(k, walk, post, span_len=4)
+    assert memo.lookup(k) is not None    # a hit on the entry
+
+    meta, arrays = memo.spill()
+    twin = memomod.ChainMemo(salt=b"w", min_repeat=2)
+    twin.absorb(meta, arrays, restore=True)
+    assert twin.stats() == memo.stats()
+    assert twin.report() == memo.report()
+    assert twin._seen == memo._seen
+    # and the restored entry still replays
+    entry = twin.lookup(k)
+    replayed = twin.replay(entry, jax.device_get(pre))
+    np.testing.assert_array_equal(replayed[0], post[0])
+
+
+def test_memo_spill_rides_runstate_checkpoint(tmp_path):
+    memo = memomod.ChainMemo(salt=b"w")
+    k, pre, post = _record_one(memo)
+    ck = RunCheckpointer(str(tmp_path), every=2, memo=memo)
+    info = ck.save(2, _toy_carry(), host=True)
+    fresh = memomod.ChainMemo(salt=b"w")
+    res = resume_carry(info["path"], _toy_carry(), memo=fresh)
+    assert res["memo_loaded"] == 1
+    assert fresh.stats() == memo.stats()
+
+
+# ---------------------------------------------------------------------------
+# driver-level resume parity (@slow — CI runs this file unfiltered)
+
+
+ROUNDS, CHAIN_LEN, EVERY = 12, 4, 4
+
+
+def _world():
+    return profiling.build_world(N, n_nodes=8, egress_cap=8,
+                                 ingress_cap=16, seed=3,
+                                 warmup_windows=1)
+
+
+def _make_chain_fn(params, window):
+    def chain_fn(state, extras, rids, _pr):
+        key, spawn_seq, total = extras
+
+        def round_fn(carry, round_idx):
+            state, spawn_seq = carry
+            shift = jnp.where(round_idx == 0, jnp.int32(0), window)
+            out = window_step(state, params, key, shift, window,
+                              rr_enabled=False)
+            (state, delivered, _nx), _m, _g, _h, _fr = \
+                unpack_planes(out)
+            mask, new_dst, nbytes, seq_vals, ctrl = respawn_batch(
+                delivered, spawn_seq, round_idx, N,
+                state.in_src.shape[1])
+            out = ingest_rows(state, new_dst, nbytes, seq_vals,
+                              seq_vals, ctrl, valid=mask)
+            (state,), _m, _g, _h, _fr = unpack_planes(out, n_lead=1)
+            spawn_seq = spawn_seq + mask.sum(axis=1, dtype=jnp.int32)
+            return (state, spawn_seq), mask.sum(dtype=jnp.int32)
+
+        (state, spawn_seq), nd = jax.lax.scan(
+            round_fn, (state, spawn_seq), rids)
+        zeros = jnp.zeros((N,), jnp.int32)
+        return state, (key, spawn_seq, total + nd.sum()), zeros, zeros
+
+    return chain_fn
+
+
+def _fresh_extras(key):
+    return (key, jnp.full((N,), SPAWN_BASE, jnp.int32),
+            jnp.zeros((), jnp.int32))
+
+
+def _digest(state, extras):
+    return digest_pytrees(elastic.canonical_state(state),
+                          extras[1], extras[2])
+
+
+@pytest.mark.slow
+def test_driver_checkpoint_resume_parity(tmp_path):
+    """The tentpole theorem at driver level: run-to-r8, resume-from-r8
+    ends bitwise-identical to the uninterrupted run — and the
+    checkpointing run itself matches too (cuts are invisible)."""
+    world = _world()
+    chain_fn = _make_chain_fn(world["params"], world["window"])
+    key = world["rng_root"]
+
+    plain_state, plain_extras = elastic.drive_chained_windows(
+        world["state"], _fresh_extras(key), chain_fn,
+        n_rounds=ROUNDS, chain_len=CHAIN_LEN)
+    want = _digest(plain_state, plain_extras)
+
+    ck = RunCheckpointer(str(tmp_path), every=EVERY, label="drv")
+    ck_state, ck_extras = elastic.drive_chained_windows(
+        world["state"], _fresh_extras(key), chain_fn,
+        n_rounds=ROUNDS, chain_len=CHAIN_LEN, checkpointer=ck)
+    assert _digest(ck_state, ck_extras) == want
+    assert ck.saved == 2  # r4 and r8; r12 skipped (final)
+
+    # "crash" after r8: rebuild a cold template, restore, continue
+    res = resume_carry(latest_checkpoint(str(tmp_path), label="drv"),
+                       (world["state"], _fresh_extras(key)))
+    assert res["round"] == 8
+    r_state, r_extras = res["carry"]
+    r_state, r_extras = elastic.drive_chained_windows(
+        r_state, r_extras, chain_fn, n_rounds=ROUNDS,
+        chain_len=CHAIN_LEN, start_round=res["round"])
+    assert _digest(r_state, r_extras) == want
+
+
+@pytest.mark.slow
+def test_ensemble_checkpoint_resume_parity(tmp_path):
+    """2-world ensemble: the per-world batched carries spill to ONE
+    file and a resumed ensemble matches the uninterrupted one
+    bitwise, world by world."""
+    W = 2
+    world = _world()
+    chain_fn = _make_chain_fn(world["params"], world["window"])
+    keys = elastic.world_keys(world["rng_root"],
+                              jnp.arange(W, dtype=jnp.int32))
+    stacked = jax.tree.map(lambda x: jnp.stack([x] * W), world["state"])
+
+    def fresh_extras():
+        return (keys, jnp.full((W, N), SPAWN_BASE, jnp.int32),
+                jnp.zeros((W,), jnp.int32))
+
+    plain_states, plain_extras = elastic.drive_ensemble(
+        stacked, fresh_extras(), chain_fn, n_rounds=ROUNDS,
+        chain_len=CHAIN_LEN)
+    want = digest_pytrees(plain_states, plain_extras[1],
+                          plain_extras[2])
+
+    ck = RunCheckpointer(str(tmp_path), every=EVERY, label="ens")
+    ck_states, ck_extras = elastic.drive_ensemble(
+        stacked, fresh_extras(), chain_fn, n_rounds=ROUNDS,
+        chain_len=CHAIN_LEN, checkpointer=ck)
+    assert digest_pytrees(ck_states, ck_extras[1],
+                          ck_extras[2]) == want
+    assert ck.saved == 2
+
+    res = resume_carry(latest_checkpoint(str(tmp_path), label="ens"),
+                       (stacked, fresh_extras()))
+    r_states, r_extras = res["carry"]
+    r_states, r_extras = elastic.drive_ensemble(
+        r_states, r_extras, chain_fn, n_rounds=ROUNDS,
+        chain_len=CHAIN_LEN, start_round=res["round"])
+    assert digest_pytrees(r_states, r_extras[1], r_extras[2]) == want
+
+
+def _tiny_spec(windows=48, lossy=False):
+    from shadow_tpu.workloads.spec import parse_scenario
+
+    d = {
+        "name": "runstate-ring", "family": "ring_allreduce",
+        "seed": 11, "hosts": N, "windows": windows,
+        "patterns": [{"kind": "ring_allreduce", "first": 0,
+                      "count": N, "bytes": 1024, "rounds": 1}],
+    }
+    if lossy:
+        d["transport"] = "flows"
+        d["loss_p"] = 0.05
+    return parse_scenario(d)
+
+
+@pytest.mark.slow
+def test_run_scenario_resume_record_identical(tmp_path):
+    """`run_scenario(resume=True)` reproduces the EXACT record dict of
+    the uninterrupted run (the byte-parity CI gate's in-process twin),
+    and stamps provenance on the side channel only."""
+    from shadow_tpu.workloads import runner
+
+    spec = _tiny_spec()
+    plain = runner.run_scenario(spec)
+
+    ckdir = str(tmp_path / "ck")
+    prov: dict = {}
+    ck_rec = runner.run_scenario(spec, checkpoint_dir=ckdir,
+                                 checkpoint_every=16, provenance=prov)
+    assert ck_rec == plain  # checkpoint cuts are bitwise-invisible
+    assert prov["checkpoints_written"] == 2
+    assert prov["resumed_from"] is None
+
+    prov2: dict = {}
+    res_rec = runner.run_scenario(spec, checkpoint_dir=ckdir,
+                                  checkpoint_every=16, resume=True,
+                                  provenance=prov2)
+    assert res_rec == plain
+    assert prov2["resumed_from"] == "runstate-ring-r00000032"
+    assert prov2["start_round"] == 32
+    assert json.dumps(res_rec, sort_keys=True) == \
+        json.dumps(plain, sort_keys=True)
+
+
+@pytest.mark.slow
+def test_run_scenario_resume_parity_lossy(tmp_path):
+    """Resume parity under the flows transport with the loss plane
+    live (the CI corpus gate's in-process twin)."""
+    from shadow_tpu.workloads import runner
+
+    spec = _tiny_spec(lossy=True)
+    plain = runner.run_scenario(spec)
+
+    ckdir = str(tmp_path / "ck")
+    runner.run_scenario(spec, checkpoint_dir=ckdir, checkpoint_every=16)
+    res = runner.run_scenario(spec, checkpoint_dir=ckdir,
+                              checkpoint_every=16, resume=True)
+    assert res == plain
+
+
+@pytest.mark.slow
+def test_run_scenario_resume_parity_memoized(tmp_path):
+    """Resume parity with the memo plane live — the memo census comes
+    back verbatim (hits and all), so even the report matches."""
+    from shadow_tpu.workloads import runner
+
+    spec = _tiny_spec()
+    plain = runner.run_scenario(spec, memo=True)
+    # the memo plane is really live: spans were looked up and recorded
+    # (in-run hits need longer periodic runs; the census-restoration
+    # exactness is pinned by test_memo_restore_reproduces_instance_exactly)
+    assert plain["memo"]["lookups"] > 0
+    assert plain["memo"]["records"] > 0
+
+    ckdir = str(tmp_path / "ck")
+    runner.run_scenario(spec, memo=True, checkpoint_dir=ckdir,
+                        checkpoint_every=16)
+    res = runner.run_scenario(spec, memo=True, checkpoint_dir=ckdir,
+                              checkpoint_every=16, resume=True)
+    assert res == plain
+
+
+@pytest.mark.slow
+def test_run_scenario_memo_cache_second_invocation(tmp_path):
+    """`--memo-cache` end to end: run 2 serves every span from the
+    persisted cache (persisted hits > 0, zero misses) with an
+    identical canonical digest."""
+    from shadow_tpu.workloads import runner
+
+    spec = _tiny_spec()
+    cache = str(tmp_path / "ring.memo.npz")
+    first = runner.run_scenario(spec, memo=True, memo_cache=cache)
+    assert os.path.isfile(cache)
+    assert first["memo"]["persisted_hits"] == 0
+
+    second = runner.run_scenario(spec, memo=True, memo_cache=cache)
+    assert second["canonical_digest"] == first["canonical_digest"]
+    assert second["memo"]["loaded_entries"] > 0
+    assert second["memo"]["persisted_hits"] > 0
+    assert second["memo"]["misses"] == 0
+
+
+@pytest.mark.slow
+def test_run_scenario_resume_refuses_drifted_scenario(tmp_path):
+    from shadow_tpu.workloads import runner
+
+    ckdir = str(tmp_path / "ck")
+    runner.run_scenario(_tiny_spec(), checkpoint_dir=ckdir,
+                        checkpoint_every=16)
+    drifted = _tiny_spec(lossy=True)  # same name, different physics
+    with pytest.raises(CheckpointError, match="fingerprint"):
+        runner.run_scenario(drifted, checkpoint_dir=ckdir,
+                            checkpoint_every=16, resume=True)

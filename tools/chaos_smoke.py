@@ -62,9 +62,14 @@ discipline: every span key carries the fault schedule's
 against a recording whose masks AND in-span events match exactly —
 and the final digest must equal the non-memo twin's byte-for-byte
 (the CI assertion). Refused with --capacity elastic/strict (a hit
-would skip the overflow readback the growth decision reads) and with
-checkpointing (the checkpoint's fault-mask mirror is only maintained
-on the execute path).
+would skip the overflow readback the growth decision reads).
+
+`--memo` composes with checkpoint/resume: the checkpoint's fault
+masks are recomputed from the schedule position at the cut (sound on
+the execute AND memo-replay paths), the recorded spans spill into the
+checkpoint and are absorbed on restore, and a run killed mid-flight
+and resumed must report the same final digest as the uninterrupted
+memoized run — the resumed-memoized smoke CI gates on.
 """
 
 from __future__ import annotations
@@ -189,11 +194,6 @@ def main(argv=None) -> int:
         ap.error("--memo requires --capacity fixed: a memo hit skips "
                  "the chain execution whose overflow readback the "
                  "capacity policy decides growth from")
-    if args.memo and (args.checkpoint_dir or args.resume):
-        ap.error("--memo cannot checkpoint/resume: the checkpoint's "
-                 "fault-mask mirror is only maintained on the "
-                 "execute path")
-
     import jax
     import jax.numpy as jnp
 
@@ -324,6 +324,31 @@ def main(argv=None) -> int:
                 window_ns=window_ns,
                 sink=os.path.join(args.telemetry, "hops.jsonl"))
     spawn_seq = jnp.full((N,), 10_000, jnp.int32)
+    memo_obj = memo_salt_fn = None
+    if args.memo:
+        from shadow_tpu.tpu import memo as memomod
+
+        # the static salt folds everything the chain closure captures
+        # that the carry cannot show: world shape/caps (the params +
+        # rng root are pure functions of them), the kernel choice, and
+        # the respawn constants
+        memo_obj = memomod.ChainMemo(salt="|".join([
+            "chaos-memo-v1", f"hosts={N}", f"kernel={args.kernel}",
+            f"egcap={args.egress_cap}", f"incap={args.ingress_cap}",
+            f"faults={int(schedule is not None)}",
+        ]).encode())  # default key_extra: folds r0 ALWAYS — respawn
+        # traffic is round-indexed, so round translation is never safe
+
+        if schedule is not None:
+            def memo_salt_fn(r0, r1):
+                # keep the schedule position current across hits
+                # (per_round, which normally advances it, is skipped);
+                # advancing to r0 is a no-op on the miss path
+                schedule.advance(r0 * window_ns)
+                return schedule.span_fingerprint(
+                    r0 * window_ns, r1 * window_ns).encode()
+        else:
+            memo_salt_fn = lambda r0, r1: b"neutral"
     if args.resume:
         restored = load_plane_checkpoint(
             args.resume, state_template=state,
@@ -370,6 +395,15 @@ def main(argv=None) -> int:
             # replay the schedule's mask state up to the restore point
             # (the schedule is a pure function of config — cheap)
             schedule.advance(start_w * window_ns)
+        if memo_obj is not None and "memo" in restored["meta"]:
+            # the recorded spans outlive the kill: absorb the spilled
+            # cache so the resumed run reports the same memo census
+            # (salt mismatch — different world/kernel — is refused)
+            n = memo_obj.absorb(restored["meta"]["memo"],
+                                restored["extra"], prefix="memo.",
+                                source=args.resume, restore=True)
+            print(f"chaos_smoke: absorbed {n} memoized span(s)",
+                  file=sys.stderr)
         print(f"chaos_smoke: resumed at window {start_w} from "
               f"{args.resume}", file=sys.stderr)
 
@@ -406,31 +440,6 @@ def main(argv=None) -> int:
             faults_stack)
         return state, (metrics, guards, hist, fr, spawn_seq), eg, inn
 
-    memo_obj = memo_salt_fn = None
-    if args.memo:
-        from shadow_tpu.tpu import memo as memomod
-
-        # the static salt folds everything the chain closure captures
-        # that the carry cannot show: world shape/caps (the params +
-        # rng root are pure functions of them), the kernel choice, and
-        # the respawn constants
-        memo_obj = memomod.ChainMemo(salt="|".join([
-            "chaos-memo-v1", f"hosts={N}", f"kernel={args.kernel}",
-            f"egcap={args.egress_cap}", f"incap={args.ingress_cap}",
-            f"faults={int(schedule is not None)}",
-        ]).encode())  # default key_extra: folds r0 ALWAYS — respawn
-        # traffic is round-indexed, so round translation is never safe
-
-        if schedule is not None:
-            def memo_salt_fn(r0, r1):
-                # keep the schedule position current across hits
-                # (per_round, which normally advances it, is skipped);
-                # advancing to r0 is a no-op on the miss path
-                schedule.advance(r0 * window_ns)
-                return schedule.span_fingerprint(
-                    r0 * window_ns, r1 * window_ns).encode()
-        else:
-            memo_salt_fn = lambda r0, r1: b"neutral"
     if tracer is not None and memo_salt_fn is None \
             and schedule is not None:
         # trace-only runs still stamp fault-span fingerprints on the
@@ -509,10 +518,26 @@ def main(argv=None) -> int:
                 }
             if policy is not None:
                 meta["capacity"] = policy.to_meta()
+            if memo_obj is not None:
+                # the cache rides the checkpoint: spill the recorded
+                # spans alongside the plane arrays so a resumed run
+                # absorbs them (ChainMemo.spill/absorb)
+                memo_meta, memo_arrays = memo_obj.spill(prefix="memo.")
+                meta["memo"] = memo_meta
+                extra.update(memo_arrays)
+            if schedule is not None:
+                # recompute the masks AT the cut from the schedule —
+                # last_faults is only maintained by per_round, which a
+                # memo hit skips; advance() is a no-op on the execute
+                # path (per_round already walked the cursor to r1)
+                schedule.advance(r1 * window_ns)
+                faults_now = schedule.device_arrays()
+            else:
+                faults_now = last_faults[0]
             save_plane_checkpoint(
                 path, state=state, clock_ns=r1 * window_ns,
                 rng_key_data=jax.random.key_data(world["rng_root"]),
-                faults=last_faults[0], metrics=metrics,
+                faults=faults_now, metrics=metrics,
                 extra_arrays=extra, meta=meta)
             checkpoints.append(path)
             if tracer is not None:

@@ -37,6 +37,22 @@ Usage:
       --trace-report trace.json                       # per-scenario
       wall-time phase totals + the backend fingerprint (the
       compare_runs --trace artifact)
+  python tools/run_scenarios.py --checkpoint-dir D \\
+      --checkpoint-every 16                           # full-run
+      checkpoints at chain boundaries (faults/runstate.py); a killed
+      run resumes with --resume and the output file is byte-identical
+      to the uninterrupted run (the kill/resume CI gate)
+  python tools/run_scenarios.py --checkpoint-dir D --resume
+                                                      # continue from
+      the newest checkpoint per scenario (cold start if none)
+  python tools/run_scenarios.py --checkpoint-dir D \\
+      --kill-at 32                                    # CI crash
+      point: exit 137 right after the round-32 checkpoint is durable
+  python tools/run_scenarios.py --memo --memo-cache D --check
+                                                      # persistent
+      memo cache: DIR/<name>.memo.npz loaded before + saved after
+      each scenario; a second invocation replays from the persisted
+      entries (persisted_hits > 0 — the cross-run cache gate)
 """
 
 from __future__ import annotations
@@ -109,6 +125,32 @@ def main(argv=None) -> int:
                     help="write per-scenario wall-time phase totals + "
                          "the backend fingerprint as JSON (needs "
                          "--trace)")
+    ap.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                    help="write full-run checkpoints (runstate format: "
+                         "carry + fault-schedule position + memo "
+                         "cache, atomic single-file) into DIR")
+    ap.add_argument("--checkpoint-every", type=int, default=16,
+                    metavar="K",
+                    help="checkpoint cadence in windows (default 16); "
+                         "must match across the killed run and its "
+                         "--resume for identical chain partitions")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume each scenario from its newest "
+                         "checkpoint in --checkpoint-dir (cold start "
+                         "when none exists); the output file is "
+                         "byte-identical to the uninterrupted run — "
+                         "resume provenance rides the "
+                         "<out>.provenance.json sidecar + the ledger")
+    ap.add_argument("--kill-at", type=int, default=None, metavar="R",
+                    help="exit 137 immediately after the checkpoint "
+                         "at round R lands (the CI kill/resume "
+                         "gate's deterministic preemption; needs "
+                         "--checkpoint-dir, R a multiple of "
+                         "--checkpoint-every)")
+    ap.add_argument("--memo-cache", default=None, metavar="DIR",
+                    help="persist the memo cache across invocations: "
+                         "DIR/<name>.memo.npz is loaded before and "
+                         "saved after each scenario (needs --memo)")
     args = ap.parse_args(argv)
 
     from shadow_tpu.workloads import load_scenario_file
@@ -173,6 +215,28 @@ def main(argv=None) -> int:
         print("run_scenarios: --trace-report needs --trace",
               file=sys.stderr)
         return 2
+    if args.resume and not args.checkpoint_dir:
+        print("run_scenarios: --resume needs --checkpoint-dir",
+              file=sys.stderr)
+        return 2
+    if args.kill_at is not None:
+        if not args.checkpoint_dir:
+            print("run_scenarios: --kill-at needs --checkpoint-dir "
+                  "(the kill fires after a durable checkpoint)",
+                  file=sys.stderr)
+            return 2
+        if args.kill_at % max(1, args.checkpoint_every) != 0 \
+                or args.kill_at < args.checkpoint_every:
+            print(f"run_scenarios: --kill-at {args.kill_at} is not a "
+                  f"checkpoint instant (must be a positive multiple "
+                  f"of --checkpoint-every {args.checkpoint_every})",
+                  file=sys.stderr)
+            return 2
+    if args.memo_cache and not (args.memo or (memo_cfg is not None
+                                              and memo_cfg.enabled)):
+        print("run_scenarios: --memo-cache needs --memo (or a config "
+              "with memo.enabled)", file=sys.stderr)
+        return 2
     memo_arg = None
     if args.memo or (memo_cfg is not None and memo_cfg.enabled):
         from shadow_tpu.core.config import MemoOptions
@@ -188,9 +252,12 @@ def main(argv=None) -> int:
     records = []
     memo_reports = {}
     trace_summaries = {}
+    provenance_all = {}
     guards_dirty = False
     if args.trace:
         os.makedirs(args.trace, exist_ok=True)
+    if args.memo_cache:
+        os.makedirs(args.memo_cache, exist_ok=True)
     for path in paths:
         spec = load_scenario_file(path, seed=seed_override)
         if flows_enabled and spec.transport != "flows":
@@ -217,15 +284,27 @@ def main(argv=None) -> int:
                 hops_sink = os.path.join(args.telemetry,
                                          f"{spec.name}.hops.jsonl")
         tracer_obj = None
+        ledger_path = None
         if args.trace:
             from shadow_tpu.telemetry import tracer as tracermod
 
+            ledger_path = os.path.join(args.trace,
+                                       f"{spec.name}.ledger.jsonl")
+            # under checkpointing the ledger STREAMS (each record
+            # flushed + fsynced) so a SIGKILL preserves it; a resume
+            # appends to the killed run's stream instead of truncating
+            resuming = bool(
+                args.resume and args.checkpoint_dir
+                and os.path.isfile(ledger_path))
             tracer_obj = tracermod.RunTracer(
                 spec.name, meta={"family": spec.family,
                                  "hosts": spec.n_hosts,
                                  "windows": spec.windows,
                                  "memo": memo_arg is not None,
-                                 "faults": bool(args.faults)})
+                                 "faults": bool(args.faults)},
+                sink=ledger_path if args.checkpoint_dir else None,
+                resume=resuming)
+        prov = {}
         rec = runner.run_scenario(
             spec, guards=args.guards,
             use_default_faults=args.faults,
@@ -237,13 +316,22 @@ def main(argv=None) -> int:
             flow_emit_cap=flow_emit_cap,
             flow_recv_wnd=flow_recv_wnd,
             memo=memo_arg,
-            tracer=tracer_obj)
+            tracer=tracer_obj,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+            resume=args.resume,
+            kill_at=args.kill_at,
+            memo_cache=(os.path.join(args.memo_cache,
+                                     f"{spec.name}.memo.npz")
+                        if args.memo_cache else None),
+            provenance=prov)
+        if args.checkpoint_dir:
+            provenance_all[spec.name] = prov
         if harvester is not None:
             harvester.finalize()
         if tracer_obj is not None:
             tracer_obj.close()
-            tracer_obj.write(os.path.join(
-                args.trace, f"{spec.name}.ledger.jsonl"))
+            tracer_obj.write(ledger_path)
             heartbeats = None
             if args.telemetry:
                 from shadow_tpu.telemetry import export
@@ -251,12 +339,18 @@ def main(argv=None) -> int:
                 with open(os.path.join(args.telemetry,
                                        f"{spec.name}.jsonl")) as fh:
                     heartbeats = export.read_heartbeats(fh)
+            # a resumed tracer holds only THIS segment in memory; the
+            # streamed file has the whole stitched history — report
+            # and export from the file of record
+            ledger_records = (tracermod.load_ledger(ledger_path)
+                              if tracer_obj.sink_path is not None
+                              else tracer_obj.records)
             tracermod.write_chrome_trace(
-                tracer_obj.records,
+                ledger_records,
                 os.path.join(args.trace, f"{spec.name}.trace.json"),
                 heartbeats=heartbeats)
             trace_summaries[spec.name] = tracermod.phase_totals(
-                tracer_obj.records)
+                ledger_records)
         records.append(rec)
         g = rec.get("guards")
         status = ("done" if rec["all_done"]
@@ -281,6 +375,26 @@ def main(argv=None) -> int:
         fh.write("\n")
     print(f"run_scenarios: {len(records)} scenario(s) -> {args.out}",
           file=sys.stderr)
+
+    if provenance_all:
+        # resume provenance rides a SIDECAR, never the record file:
+        # the record artifact is byte-identical between a resumed run
+        # and its uninterrupted twin BY CONTRACT (the CI gate cmp's
+        # them), so "where did this run restart" is stamped next to
+        # it, and on the run ledger's `resume` annotation
+        sidecar = args.out + ".provenance.json"
+        with open(sidecar, "w") as fh:
+            json.dump({"schema": "runprov-v1",
+                       "checkpoint_dir": args.checkpoint_dir,
+                       "checkpoint_every": args.checkpoint_every,
+                       "scenarios": provenance_all},
+                      fh, sort_keys=True, indent=1)
+            fh.write("\n")
+        resumed = sorted(n for n, p in provenance_all.items()
+                         if p.get("resumed_from"))
+        print(f"run_scenarios: provenance -> {sidecar}"
+              + (f" (resumed: {', '.join(resumed)})" if resumed else ""),
+              file=sys.stderr)
 
     if args.memo_report:
         # the cache-economics artifact: per-scenario stats + the

@@ -67,6 +67,11 @@ def summarize(records: list[dict]) -> dict:
         if kind not in ("meta", "span", "memo", "end"):
             notes[kind] = notes.get(kind, 0) + 1
     growth = [ev for r in spans for ev in r.get("growth", ())]
+    # a resumed run's ledger is ONE file appended across invocations:
+    # each `resume` record is a seam (the checkpoint id + restart
+    # round), and wall times are stitched per segment for display
+    resumes = [{"checkpoint": r.get("checkpoint"), "r": r.get("r")}
+               for r in records if r.get("kind") == "resume"]
     out = {
         "schema": meta.get("schema"),
         "label": meta.get("label"),
@@ -75,6 +80,8 @@ def summarize(records: list[dict]) -> dict:
         "annotations": notes,
         "growth": growth,
     }
+    if resumes:
+        out["resumes"] = resumes
     memo = tracer.memo_view(records)
     if memo is not None:
         out["memo"] = memo
@@ -102,6 +109,12 @@ def print_summary(rep: dict) -> None:
         print(f"  capacity events: {ph['growth_events']}")
         for ev in rep["growth"]:
             print(f"    {json.dumps(ev, sort_keys=True)}")
+    if rep.get("resumes"):
+        print(f"  resumes: {len(rep['resumes'])} (ledger stitched "
+              f"across invocations)")
+        for seam in rep["resumes"]:
+            print(f"    resumed at r={seam['r']} from "
+                  f"{seam['checkpoint']}")
     for kind in sorted(rep["annotations"]):
         print(f"  annotations[{kind}]: {rep['annotations'][kind]}")
     if "memo" in rep:
