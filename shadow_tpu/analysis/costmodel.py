@@ -133,9 +133,9 @@ def default_cost_entries() -> list[CostEntry]:
     ``flow_step`` where it is not diluted by the window body).
     window_step and chain_windows carry the two-shape watermark pairs
     the ROADMAP-2 shard_map fence extrapolates from."""
-    from .jaxpr_audit import (_chain_entry, _flows_entry,
-                              _ingest_rows_entry, _plane_entry,
-                              ensemble_step_build)
+    from .jaxpr_audit import (_chain_entry, _compute_entry,
+                              _flows_entry, _ingest_rows_entry,
+                              _plane_entry, ensemble_step_build)
 
     mod = "shadow_tpu.tpu.plane"
     return [
@@ -155,6 +155,13 @@ def default_cost_entries() -> list[CostEntry]:
                   scale_n=8, scale_build=_chain_entry(n=8)),
         CostEntry("shadow_tpu.tpu.flows:flow_step", 4, 8,
                   _flows_entry("step")),
+        # the compute plane (ISSUE-20): the compute-threaded window
+        # step IS a dispatched driver mode (family `serve`), so it is
+        # budgeted whole — unlike window_step[flows] it adds only the
+        # O(N*CI) FIFO section, and a regression there would hide
+        # inside the lean budget's slack if priced by decomposition
+        CostEntry(f"{mod}:window_step[compute]", 4, 8,
+                  _compute_entry("window")),
         # the SL601 ensemble fence (ISSUE-16): the vmapped ensemble
         # step at two WORLD counts — `n` here is the scaled dimension
         # (worlds, not hosts), so the W=2 -> W=4 watermark pair fences

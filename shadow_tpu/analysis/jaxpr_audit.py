@@ -528,6 +528,17 @@ def _chain_entry(variant: str = "plain", n: int = 4):
 
             return fn, (args[0], flows_mod.make_flow_state(n),
                         *args[1:])
+        if variant == "compute":
+            from ..tpu import compute as compute_mod
+
+            ct = compute_mod.make_compute_tables(
+                np.full((n, 1), 25_000, np.int32), queue_cap=16)
+
+            def fn(state, cs, shift0, horizon):
+                return chain(state, shift0, horizon, compute=(ct, cs))
+
+            return fn, (args[0], compute_mod.make_compute_state(ct),
+                        *args[1:])
         if variant == "workload":
             from ..workloads import compile_program, parse_scenario
             from ..workloads import device as wdevice
@@ -642,6 +653,60 @@ def _flows_entry(kind: str):
                                        jnp.int32(10_000_000))
 
         return fn, (ft, fs, state, delivered)
+
+    return build
+
+
+def _compute_entry(kind: str):
+    """The device compute plane (docs/workloads.md "Serving load & the
+    compute plane"): the compute-threaded window_step variant plus the
+    standalone compute_step FIFO kernel — both SL2xx-audited and, for
+    the window_step variant, the SL501 FULL-invisibility proof subject
+    (`analysis/proofs.py`): compute taint may reach only the appended
+    ComputeState output, never state / delivered / next_event."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from ..tpu import compute as compute_mod
+        from ..tpu import plane
+
+        n = 4
+        params = plane.make_params(
+            latency_ns=np.full((n, n), 1_000_000, np.int64),
+            loss=np.zeros((n, n)),
+            up_bw_bps=np.full(n, 1_000_000_000, np.int64),
+        )
+        state = plane.make_state(n, egress_cap=8, ingress_cap=8,
+                                 params=params)
+        root = jax.random.key(0)
+        ct = compute_mod.make_compute_tables(
+            np.full((n, 1), 25_000, np.int32), queue_cap=16)
+        cs = compute_mod.make_compute_state(ct)
+        if kind == "window":
+            def fn(state, cs, shift, window):
+                return plane.window_step(
+                    state, params, root, shift, window,
+                    rr_enabled=False, compute=(ct, cs))
+
+            return fn, (state, cs, jnp.int32(0),
+                        jnp.int32(10_000_000))
+        ci = state.in_src.shape[1]
+        delivered = {
+            "mask": jnp.zeros((n, ci), bool),
+            "src": jnp.zeros((n, ci), jnp.int32),
+            "seq": jnp.zeros((n, ci), jnp.int32),
+            "sock": jnp.zeros((n, ci), jnp.int32),
+            "bytes": jnp.zeros((n, ci), jnp.int32),
+            "deliver_rel": jnp.zeros((n, ci), jnp.int32),
+        }
+
+        def fn(ct_arrays, cs, delivered):
+            return compute_mod.compute_step(
+                ct_arrays, cs, delivered, jnp.int32(0),
+                jnp.int32(10_000_000))
+
+        return fn, (ct, cs, delivered)
 
     return build
 
@@ -806,6 +871,12 @@ def default_entries() -> list[AuditEntry]:
                    _flows_entry("window")),
         AuditEntry("flow_step", "shadow_tpu.tpu.flows",
                    _flows_entry("step")),
+        AuditEntry("chain_windows[compute]", "shadow_tpu.tpu.plane",
+                   _chain_entry("compute")),
+        AuditEntry("window_step[compute]", "shadow_tpu.tpu.plane",
+                   _compute_entry("window")),
+        AuditEntry("compute_step", "shadow_tpu.tpu.compute",
+                   _compute_entry("step")),
         AuditEntry("tcp_event_step", "shadow_tpu.tpu.tcp",
                    _tcp_entry("event")),
         AuditEntry("tcp_pull_step", "shadow_tpu.tpu.tcp",

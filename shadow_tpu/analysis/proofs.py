@@ -43,7 +43,7 @@ from typing import Callable
 import numpy as np
 
 from .dataflow import leaf_paths, op_census, propagate_taint, shard_census
-from .jaxpr_audit import (_chain_entry, _flows_entry,
+from .jaxpr_audit import (_chain_entry, _compute_entry, _flows_entry,
                           _ingest_rows_entry, _plane_entry)
 from .rules import Finding
 
@@ -406,6 +406,17 @@ def invisibility_specs() -> list[InvisibilitySpec]:
             tainted_args={0: "ft", 1: "fs"},
             protected=_flows_step_protected,
             trace_key="shadow_tpu.tpu.flows:flow_step"),
+        # the compute plane's obligation (docs/workloads.md "Serving
+        # load & the compute plane"): FULL invisibility, not append-
+        # only — compute consumes the delivered dict read-only and owes
+        # nothing back to the wire (credit gating composes in the
+        # runner, outside this kernel), so taint on the ComputeState
+        # input may reach ONLY the appended ComputeState output (idx 3)
+        InvisibilitySpec(
+            "window_step[compute]", "shadow_tpu.tpu.plane",
+            _compute_entry("window"),
+            tainted_args={1: "compute"},
+            protected=_protect_lead(3)),
     ]
 
 

@@ -172,6 +172,14 @@ def percentiles(counts, qs=QUANTILES) -> dict:
     return out
 
 
+def fleet_percentiles(hist_nb, qs=QUANTILES) -> dict:
+    """`percentiles` over the fleet-summed [N, B] histogram (int64
+    accumulation, so a saturated 2^31-count fleet cannot wrap the
+    sum): the per-scenario SLO view the runner records — one p99/p999
+    line per histogram, aggregated over every host."""
+    return percentiles(np.asarray(hist_nb, np.int64).sum(axis=0), qs)
+
+
 def ensemble_percentiles(world_counts, qs=QUANTILES) -> dict:
     """Percentile-of-percentiles across an ensemble of worlds
     (ROADMAP item 4's error bars): `world_counts` is one [B]

@@ -538,7 +538,7 @@ def chain_windows(state: NetPlaneState, params: NetPlaneParams,
                   guards: GuardState | None = None,
                   hist: PlaneHistograms | None = None,
                   flightrec: FlightRecArrays | None = None,
-                  workload=None, flows=None, round0=0):
+                  workload=None, flows=None, compute=None, round0=0):
     """Advance consecutive scheduling windows ON DEVICE until one delivers.
 
     The device-resident analogue of the controller's window chain
@@ -574,13 +574,20 @@ def chain_windows(state: NetPlaneState, params: NetPlaneParams,
     chain can never sleep through a pending retransmission; mutually
     exclusive with `workload` here (the scenario runner interleaves
     the two through `flow_recv`/`flow_emit` around the phase credits
-    instead — workloads/runner.py). `kernel` selects the plane kernel
-    like `window_step` ("xla" | "pallas" | "pallas_fused").
+    instead — workloads/runner.py). `compute=(ct, cs0)` threads the
+    device compute plane (`tpu/compute.py`) through the carry the
+    same way; it emits no traffic (service completions only gate
+    phase credits in the runner's split-form loop), so it never
+    re-arms the next-event reduction — an idle chain may sleep
+    through a backlog draining, which is fine because nothing
+    observes the backlog until the next delivery wakes the chain.
+    `kernel` selects the plane kernel like `window_step`
+    ("xla" | "pallas" | "pallas_fused").
 
     Returns (state, delivered, off, next_rel, n_windows[, metrics']
-    [, guards'][, hist'][, flightrec'][, ws'][, fs']) — presence
-    outputs appended in `window_step` order, the workload / flow
-    state last. `off` is the LAST window's start relative to the
+    [, guards'][, hist'][, flightrec'][, ws'][, fs'][, cs']) —
+    presence outputs appended in `window_step` order, the workload /
+    flow / compute state last. `off` is the LAST window's start relative to the
     first window's start — `delivered` times and `next_rel` are
     relative to that last window's start.
     """
@@ -601,18 +608,24 @@ def chain_windows(state: NetPlaneState, params: NetPlaneParams,
         ft, fs0 = flows
     else:
         ft = fs0 = None
+    if compute is not None:
+        ctab, cs0 = compute
+    else:
+        ctab = cs0 = None
 
     def step(st, planes, shift, window_ns, ridx):
-        m, g, h, fr, ws, fstate = planes
+        m, g, h, fr, ws, fstate, cstate = planes
         out = window_step(st, params, rng_root, shift, window_ns,
                           rr_enabled=rr_enabled, router_aqm=router_aqm,
                           no_loss=no_loss, kernel=kernel, faults=faults,
                           metrics=m, guards=g, hist=h, flightrec=fr,
                           flows=(ft, fstate) if fstate is not None
+                          else None,
+                          compute=(ctab, cstate) if cstate is not None
                           else None)
-        (st, delivered, next_ev), m, g, h, fr, fstate = unpack_planes(
-            out, metrics=m, guards=g, hist=h, flightrec=fr,
-            flows=fstate)
+        (st, delivered, next_ev), m, g, h, fr, fstate, cstate = \
+            unpack_planes(out, metrics=m, guards=g, hist=h,
+                          flightrec=fr, flows=fstate, compute=cstate)
         if fstate is not None:
             from . import flows as _flows_mod
 
@@ -655,11 +668,11 @@ def chain_windows(state: NetPlaneState, params: NetPlaneParams,
             next_ev = jnp.minimum(
                 next_ev, jnp.where(st.eg_valid.any(), window_ns,
                                    I32_MAX))
-        return st, delivered, next_ev, (m, g, h, fr, ws, fstate)
+        return st, delivered, next_ev, (m, g, h, fr, ws, fstate, cstate)
 
     hs = jnp.minimum(jnp.int32(horizon_rel), jnp.int32(stop_rel))
 
-    planes = (metrics, guards, hist, flightrec, ws0, fs0)
+    planes = (metrics, guards, hist, flightrec, ws0, fs0, cs0)
     state, delivered, next_ev, planes = step(
         state, planes, jnp.int32(shift0), jnp.int32(window0_ns),
         jnp.int32(round0))
@@ -691,13 +704,15 @@ def chain_windows(state: NetPlaneState, params: NetPlaneParams,
         cond, body,
         (state, delivered, jnp.int32(0), next_ev, jnp.int32(1), planes),
     )
-    m, g, h, fr, ws, fstate = planes
+    m, g, h, fr, ws, fstate, cstate = planes
     out = (state, delivered, off, next_ev, n)
     out += tuple(p for p in (m, g, h, fr) if p is not None)
     if workload is not None:
         out += (ws,)
     if flows is not None:
         out += (fstate,)
+    if compute is not None:
+        out += (cstate,)
     return out
 
 
@@ -705,7 +720,8 @@ _UNSET = object()
 
 
 def unpack_planes(out, *, metrics=None, guards=None, hist=None,
-                  flightrec=None, flows=_UNSET, n_lead=3):
+                  flightrec=None, flows=_UNSET, compute=_UNSET,
+                  n_lead=3):
     """Split a `window_step` (n_lead=3) or `ingest_rows` (n_lead=1)
     output into its lead values plus the presence-switch outputs, in
     the ONE declaration order both kernels append them — metrics,
@@ -725,7 +741,10 @@ def unpack_planes(out, *, metrics=None, guards=None, hist=None,
     carried (the tables are static). Passing it — even as None — adds
     a sixth slot to the return, so flow-plane drivers unpack
     ``(lead), m, g, h, fr, fs = unpack_planes(..., flows=fs)``;
-    omitting it keeps the legacy five-slot shape."""
+    omitting it keeps the legacy five-slot shape. `compute` is the
+    ComputeState of the kernel's ``compute=(ct, cs)`` pair and adds a
+    further trailing slot the same way (kernel output order: flows
+    then compute, both last)."""
     if type(out) is not tuple:
         # bare state: ingest_rows with no planes threaded returns the
         # NetPlaneState itself — which IS a (named)tuple, so the check
@@ -735,6 +754,8 @@ def unpack_planes(out, *, metrics=None, guards=None, hist=None,
     want = [metrics, guards, hist, flightrec]
     if flows is not _UNSET:
         want.append(flows)
+    if compute is not _UNSET:
+        want.append(compute)
     planes = tuple(rest.pop(0) if p is not None else None
                    for p in want)
     if rest:
@@ -1563,7 +1584,7 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
                 guards: GuardState | None = None,
                 hist: PlaneHistograms | None = None,
                 flightrec: FlightRecArrays | None = None,
-                flows=None):
+                flows=None, compute=None):
     """Advance one scheduling round [t, t + window_ns).
 
     `rr_enabled` is a static (trace-time) switch: False compiles the
@@ -1667,12 +1688,26 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
     reduced BEFORE the flow emission; chained callers re-arm it like
     the workload emission (`chain_windows`).
 
+    `compute` (static presence switch, docs/workloads.md "Serving
+    load & the compute plane") threads the device compute plane as a
+    ``(ComputeTables, ComputeState)`` pair (`tpu/compute.py`): this
+    window's deliveries feed each host's bounded-FIFO service station
+    (busy-until clock, closed-form completion times, queueing-delay /
+    sojourn histograms). Pure reads over the delivered dict the step
+    already materialized; writes ONLY the ComputeState' appended last
+    — the SL501 full-invisibility obligation `window_step[compute]`
+    proves no compute taint reaches the lead outputs. The
+    delivery-AND-service phase coupling lives in the scenario runner
+    (`compute.gate_credits`), never here. compute=None compiles the
+    section out. XLA kernel only, like faults.
+
     `shift_ns` = this window's start minus the previous window's start;
     stored relative times are rebased by it. Returns
     (state', delivered, next_event_rel) — plus metrics', guards',
     hist', and/or flightrec' appended in that order when the
     respective pytrees were passed (the flow plane's FlowState', when
-    threaded, appends last) — where `delivered` is a dict of
+    threaded, appends next; the compute plane's ComputeState'
+    appends last) — where `delivered` is a dict of
     [N, CI] arrays masked by delivered['mask'] (packets that arrived
     within this window, in deterministic (deliver_t, src, seq) order
     per host) and `next_event_rel` is the min pending delivery time
@@ -1717,6 +1752,12 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
             "compile with kernel='xla' when a (FlowTables, FlowState) "
             "pair is threaded (the self-healing kernel fallback in "
             "faults/healing.py does this automatically)")
+    if pallas_kernel and compute is not None:
+        raise ValueError(
+            f"plane_kernel={kernel!r} does not fuse the compute plane; "
+            "compile with kernel='xla' when a (ComputeTables, "
+            "ComputeState) pair is threaded (the self-healing kernel "
+            "fallback in faults/healing.py does this automatically)")
     N, CE = state.eg_dst.shape
 
     # --- 1. rebase clocks + refill token buckets -----------------------
@@ -2105,10 +2146,25 @@ def window_step(state: NetPlaneState, params: NetPlaneParams, rng_root: jax.Arra
             guards = rest.pop(0)
         if flightrec is not None:
             flightrec = rest.pop(0)
+    cs_out = None
+    if compute is not None:
+        # --- 13. device compute plane (static; compiled out when
+        # off): bounded-FIFO service occupancy over this window's
+        # deliveries, docs/workloads.md "Serving load & the compute
+        # plane". Pure reads of the delivered dict; writes only the
+        # ComputeState appended last — the SL501 full-invisibility
+        # obligation `window_step[compute]` (analysis/proofs.py).
+        from . import compute as compute_mod  # lazy: compute imports plane
+
+        ctab, cstate = compute
+        cs_out = compute_mod.compute_step(ctab, cstate, delivered,
+                                          shift_ns, window_ns)
     out = (new_state, delivered, next_event)
     for plane_out in (metrics, guards, hist, flightrec):
         if plane_out is not None:
             out += (plane_out,)
     if flows is not None:
         out += (fs_out,)
+    if compute is not None:
+        out += (cs_out,)
     return out

@@ -91,6 +91,14 @@ Sections (superset of the window step's numbered stages):
   planes. Gated in CI (ratio vs ``window_step`` <= 1.35,
   docs/robustness.md "Flow plane").
 
+- ``window_step_compute`` — the full step with the compute plane
+  threaded (`tpu/compute.py`: the closed-form FIFO cummax over the
+  delivered dict + the bounded-queue tail trim + the wait/sojourn
+  histogram folds) over an IDLE zero-backlog ComputeState — the
+  neutral presence cost, priced exactly like the flows/faults/guards
+  sections. Gated in CI (ratio vs ``window_step`` <= 1.35,
+  docs/workloads.md "Serving load & the compute plane").
+
 Drive it from the CLI: ``python tools/profile_plane.py --hosts 1024,32768``.
 """
 
@@ -117,6 +125,7 @@ DEFAULT_SECTIONS = (
     "window_step_telemetry",
     "window_step_faults", "window_step_guards", "window_step_elastic",
     "window_step_trace", "window_step_workload", "window_step_flows",
+    "window_step_compute",
 )
 
 #: the cheap per-section subset bench.py records in its JSON `sections`
@@ -541,6 +550,23 @@ def profile_sections(n_hosts: int, *, reps: int = 20,
                 rr_enabled=rr_enabled, packed_sort=packed_sort,
                 kernel="xla", flows=(_ftab, fst))),
             (state, _fstate, shift))
+    if "window_step_compute" in wanted:
+        # the compute plane's presence cost: a one-phase uniform
+        # service table, zero backlog — the closed-form FIFO and the
+        # histogram folds run at full delivered width, like the idle
+        # flow / neutral fault sections above (compute, like every
+        # presence plane, refuses the pallas fusion — pin xla)
+        from . import compute as _compute
+
+        _ctab = _compute.make_compute_tables(
+            np.full((n_hosts, 1), 25_000, np.int32), 64)
+        _cstate = _compute.make_compute_state(_ctab)
+        section_calls["window_step_compute"] = (
+            jax.jit(lambda st, cst, sh: window_step(
+                st, params, rng_root, sh, window,
+                rr_enabled=rr_enabled, packed_sort=packed_sort,
+                kernel="xla", compute=(_ctab, cst))),
+            (state, _cstate, shift))
 
     out_sections = {}
     for name in wanted:

@@ -68,6 +68,11 @@ class TrafficProgram(NamedTuple):
     flow_dst: np.ndarray | None = None  # [F] int32
     flow_bytes: np.ndarray | None = None  # [F] int32
     lane_flow: np.ndarray | None = None  # [N, P, K] int32 (-1 = none)
+    #: per-(host, phase) service cost, lowered from the scenario's
+    #: ``compute:`` block + the checked-in op-timing table
+    #: (`serve.lower_service_table`); None without a compute block, so
+    #: pre-compute programs digest unchanged
+    compute_service_ns: np.ndarray | None = None  # [N, P] int32
 
 
 class _Builder:
@@ -226,12 +231,20 @@ def _compile_onoff(b: _Builder, p: PatternSpec, rng):
             b.add_phase(h, dep=0, hold_ns=int(off[c]))
 
 
+def _compile_serve(b: _Builder, p: PatternSpec, rng):
+    """Open-loop serving arrivals (`serve._compile_serve` — kept in
+    its own module with the op-timing machinery it pairs with)."""
+    from . import serve
+    serve._compile_serve(b, p, rng)
+
+
 _COMPILERS = {
     "ring_allreduce": _compile_ring_allreduce,
     "all_to_all": _compile_all_to_all,
     "incast": _compile_incast,
     "rpc_fanout": _compile_rpc_fanout,
     "onoff": _compile_onoff,
+    "serve": _compile_serve,
 }
 
 
@@ -288,6 +301,10 @@ def compile_program(spec: ScenarioSpec) -> TrafficProgram:
             f"fan-out/burst")
     if spec.transport == "flows":
         prog = _lower_flows(prog)
+    if spec.compute is not None:
+        from . import serve
+        prog = prog._replace(
+            compute_service_ns=serve.lower_service_table(spec, prog))
     return prog
 
 
@@ -312,4 +329,13 @@ def program_digest(prog: TrafficProgram) -> str:
             h.update(str(a.dtype).encode())
             h.update(str(a.shape).encode())
             h.update(a.tobytes())
+    if prog.compute_service_ns is not None:
+        # the lowered op-timing costs ride the digest, so editing the
+        # checked-in table invalidates every memo/golden entry that
+        # consumed it (tests/test_compute.py drift guard)
+        a = np.asarray(prog.compute_service_ns)
+        h.update(b"compute")
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
     return h.hexdigest()

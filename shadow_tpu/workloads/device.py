@@ -173,7 +173,8 @@ def prime(wl: WorkloadArrays, ws: WorkloadState, state, *,
 def workload_step(wl: WorkloadArrays, ws: WorkloadState, state,
                   delivered, round_idx, window_ns, *,
                   max_advance: int = MAX_ADVANCE,
-                  metrics=None, guards=None, flows=None):
+                  metrics=None, guards=None, flows=None,
+                  credits=None):
     """Advance the generator by one window and emit the next sends.
 
     `delivered` is `window_step`'s released dict for THIS window;
@@ -192,10 +193,18 @@ def workload_step(wl: WorkloadArrays, ws: WorkloadState, state,
     ENQUEUES segments onto their flows (`flows.enqueue`) for the
     driver's following `flow_emit` instead of appending raw packets.
     The return becomes (state, ws', fs'[, metrics'][, guards']) with
-    state/metrics/guards passed through untouched."""
+    state/metrics/guards passed through untouched.
+
+    ``credits`` (direct transport only; the flows triple carries its
+    own) overrides the raw per-host delivery count with an externally
+    metered credit vector — the compute plane's delivery-AND-service
+    gate (`tpu/compute.gate_credits`, docs/workloads.md "Serving load
+    & the compute plane")."""
     N, P = wl.dep.shape
     if flows is not None:
         ft, fs, credits = flows
+        got = credits
+    elif credits is not None:
         got = credits
     else:
         got = delivered["mask"].sum(axis=1, dtype=jnp.int32)

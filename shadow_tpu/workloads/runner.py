@@ -242,6 +242,17 @@ def run_scenario(spec: ScenarioSpec, *,
             prog.lane_flow)
         flowst = flowsmod.make_flow_state(prog.flow_src.shape[0],
                                           recv_wnd=recv_wnd)
+    use_compute = spec.compute is not None
+    ctab = cstate = None
+    if use_compute:
+        from ..tpu import compute as computemod
+
+        # per-host service model (`tpu/compute.py`): the occupancy
+        # plane rides window_step as a presence switch; the credit
+        # coupling ("delivered AND serviced") lives in this loop
+        ctab = computemod.make_compute_tables(
+            prog.compute_service_ns, spec.compute.queue_cap)
+        cstate = computemod.make_compute_state(ctab)
     metrics = make_metrics(N)
     gstate = make_guards(N) if guards else None
     hstate = histo.make_histograms(N) if histograms else None
@@ -260,6 +271,12 @@ def run_scenario(spec: ScenarioSpec, *,
             "axis is flow-major, not host-major, and its credit "
             "scatter-adds need the cross-shard reduction the "
             "ROADMAP-2 shard_map cut will bring")
+    if mesh_devices is not None and use_compute:
+        raise ValueError(
+            "the compute plane does not support --shard yet: the "
+            "service tables ride the chain closure un-sharded, and "
+            "mixing them with a host-sharded ComputeState waits on "
+            "the same ROADMAP-2 shard_map cut as flows")
     if mesh_devices is not None:
         from ..tpu import make_mesh, shard_state
 
@@ -294,7 +311,8 @@ def run_scenario(spec: ScenarioSpec, *,
     from ..tpu import elastic as _elastic
 
     def round_fn(carry, xs):
-        state, ws, metrics, gstate, hstate, fstate, flowst = carry
+        state, ws, metrics, gstate, hstate, fstate, flowst, cstate = \
+            carry
         if faulted:
             ridx, faults = xs
         else:
@@ -303,10 +321,19 @@ def run_scenario(spec: ScenarioSpec, *,
         out = window_step(state, params, rng_root, shift, window,
                           rr_enabled=False, faults=faults,
                           metrics=metrics, guards=gstate,
-                          hist=hstate, flightrec=fstate)
-        (state, delivered, _next), metrics, gstate, hstate, fstate = \
-            unpack_planes(out, metrics=metrics, guards=gstate,
-                          hist=hstate, flightrec=fstate)
+                          hist=hstate, flightrec=fstate,
+                          compute=((ctab, cstate) if use_compute
+                                   else None))
+        if use_compute:
+            ((state, delivered, _next), metrics, gstate, hstate,
+             fstate, cstate) = unpack_planes(
+                out, metrics=metrics, guards=gstate, hist=hstate,
+                flightrec=fstate, compute=cstate)
+        else:
+            (state, delivered, _next), metrics, gstate, hstate, \
+                fstate = unpack_planes(out, metrics=metrics,
+                                       guards=gstate, hist=hstate,
+                                       flightrec=fstate)
         if use_flows:
             # the split-form flow loop (tpu/flows.py): credit ACKED
             # in-order arrivals, advance the phase machine on those
@@ -315,6 +342,12 @@ def run_scenario(spec: ScenarioSpec, *,
             # through the normal ingest path
             flowst, credits = flowsmod.flow_recv(ftab, flowst,
                                                  delivered, window)
+            if use_compute:
+                # the serving coupling: the k-th credit advances the
+                # phase machine only once the k-th service completion
+                # has happened too (tpu/compute.gate_credits)
+                cstate, credits = computemod.gate_credits(cstate,
+                                                          credits)
             wout = wdevice.workload_step(
                 wl, ws, state, delivered, ridx, window,
                 max_advance=adv, metrics=metrics, guards=gstate,
@@ -335,19 +368,29 @@ def run_scenario(spec: ScenarioSpec, *,
             if fstate is not None:
                 fstate = rest.pop(0)
         else:
+            credits = None
+            if use_compute:
+                cstate, credits = computemod.gate_credits(
+                    cstate, delivered["mask"].sum(axis=1,
+                                                  dtype=jnp.int32))
             wout = wdevice.workload_step(
                 wl, ws, state, delivered, ridx, window,
-                max_advance=adv, metrics=metrics, guards=gstate)
+                max_advance=adv, metrics=metrics, guards=gstate,
+                credits=credits)
             if gstate is not None:
                 state, ws, metrics, gstate = wout
             else:
                 state, ws, metrics = wout
+        if use_compute:
+            # re-arm each host's per-request cost from the phase the
+            # machine just advanced to (window_step never sees phases)
+            cstate = computemod.phase_service(ctab, cstate, ws.phase)
         return (state, ws, metrics, gstate, hstate, fstate,
-                flowst), None
+                flowst, cstate), None
 
     @jax.jit
     def chain(state, ws, metrics, gstate, hstate, fstate, flowst,
-              rids, faults_stack):
+              cstate, rids, faults_stack):
         # K windows device-resident per dispatch (the shared driver's
         # contract): the fault-mask stack rides as per-round scan
         # inputs, every presence plane rides the carry — bitwise
@@ -356,7 +399,7 @@ def run_scenario(spec: ScenarioSpec, *,
         xs = (rids, faults_stack) if faulted else rids
         carry, _ = jax.lax.scan(
             round_fn, (state, ws, metrics, gstate, hstate, fstate,
-                       flowst), xs)
+                       flowst, cstate), xs)
         return carry
 
     def per_round(r0, r1):
@@ -367,17 +410,17 @@ def run_scenario(spec: ScenarioSpec, *,
         return jax.tree.map(lambda *xs: jnp.stack(xs), *stack)
 
     def chain_fn(state, extras, rids, faults_stack):
-        ws, metrics, gstate, hstate, fstate, flowst = extras
-        state, ws, metrics, gstate, hstate, fstate, flowst = chain(
-            state, ws, metrics, gstate, hstate, fstate, flowst, rids,
-            faults_stack)
+        ws, metrics, gstate, hstate, fstate, flowst, cstate = extras
+        (state, ws, metrics, gstate, hstate, fstate, flowst,
+         cstate) = chain(state, ws, metrics, gstate, hstate, fstate,
+                         flowst, cstate, rids, faults_stack)
         return state, (ws, metrics, gstate, hstate, fstate,
-                       flowst), 0, 0
+                       flowst, cstate), 0, 0
 
     annotated = [0]
 
     def on_chain(r1, state, extras):
-        ws, metrics, gstate, hstate, fstate, flowst = extras
+        ws, metrics, gstate, hstate, fstate, flowst, cstate = extras
         if r1 % telemetry_every == 0:
             if telemetry is not None:
                 annotated[0] = _annotate_phases(
@@ -446,13 +489,13 @@ def run_scenario(spec: ScenarioSpec, *,
                         f"{scenario_fingerprint(spec)[:12]}...) — the "
                         f"checkpoint belongs to a different world")
                 template = (state, (ws, metrics, gstate, hstate,
-                                    fstate, flowst))
+                                    fstate, flowst, cstate))
                 res = runstate.resume_carry(template_carry=template,
                                             path=ckpt_path,
                                             schedule=schedule,
                                             memo=memo_obj)
                 state, (ws, metrics, gstate, hstate, fstate,
-                        flowst) = res["carry"]
+                        flowst, cstate) = res["carry"]
                 start_round = res["round"]
                 resumed_from = os.path.basename(ckpt_path)
                 if resumed_from.endswith(".runstate.npz"):
@@ -463,7 +506,8 @@ def run_scenario(spec: ScenarioSpec, *,
 
     need_cadence = telemetry is not None or recorder is not None
     state, extras = _elastic.drive_chained_windows(
-        state, (ws, metrics, gstate, hstate, fstate, flowst), chain_fn,
+        state, (ws, metrics, gstate, hstate, fstate, flowst, cstate),
+        chain_fn,
         n_rounds=spec.windows,
         chain_len=(telemetry_every if need_cadence
                    else memo_chain if memo_obj is not None
@@ -474,7 +518,7 @@ def run_scenario(spec: ScenarioSpec, *,
         on_chain=on_chain if need_cadence else None,
         memo=memo_obj, memo_span_salt=memo_salt_fn, tracer=tracer,
         checkpointer=checkpointer)
-    ws, metrics, gstate, hstate, fstate, flowst = extras
+    ws, metrics, gstate, hstate, fstate, flowst, cstate = extras
 
     if memo_cache is not None and memo_obj is not None:
         memo_obj.save(memo_cache)
@@ -506,7 +550,8 @@ def run_scenario(spec: ScenarioSpec, *,
         # even when the net-plane state happens to converge
         "canonical_digest": digest_pytrees(
             elastic.canonical_state(state), ws,
-            *((flowst,) if use_flows else ())),
+            *((flowst,) if use_flows else ()),
+            *((cstate,) if use_compute else ())),
         "all_done": bool(np.asarray(
             jax.device_get(ws.phase) >= prog.n_phases).all()),
         "completed_hosts": int(
@@ -532,6 +577,31 @@ def run_scenario(spec: ScenarioSpec, *,
             **flowsmod.flow_totals(ftab, flowst),
             "emit_cap": emit_cap, "recv_wnd": recv_wnd,
         }
+    if use_compute:
+        # the serving record: compute-plane totals + the SLO block
+        # (docs/workloads.md "SLO record schema") — request-sojourn
+        # p99/p999 from the fleet-summed compute histograms, judged
+        # against the scenario's `serve:` targets when declared
+        c = jax.device_get(cstate)
+        i64sum = lambda a: int(np.asarray(a).astype(np.int64).sum())
+        record["compute"] = {
+            "op": spec.compute.op,
+            "queue_cap": spec.compute.queue_cap,
+            "served": i64sum(c.n_served),
+            "queued": i64sum(c.n_queued),
+            "overflow": i64sum(c.n_overflow),
+        }
+        slo = {"wait_ns": histo.fleet_percentiles(c.hist_wait_ns),
+               "sojourn_ns": histo.fleet_percentiles(c.hist_sojourn_ns)}
+        if spec.serve is not None:
+            soj = slo["sojourn_ns"]
+            slo["targets"] = {
+                q: {"target_ns": target, "measured_ns": soj[q],
+                    "met": bool(soj[q] <= target)}
+                for q, target in (("p99", spec.serve.p99_ns),
+                                  ("p999", spec.serve.p999_ns))
+                if target is not None}
+        record["slo"] = slo
     if memo_obj is not None:
         record["memo"] = memo_obj.report()
         if tracer is not None:
@@ -549,7 +619,7 @@ def run_scenario(spec: ScenarioSpec, *,
         record["latency"] = {
             name[len(histo.HIST_PREFIX):] if name.startswith(
                 histo.HIST_PREFIX) else name:
-            histo.percentiles(np.asarray(arr, np.int64).sum(axis=0))
+            histo.fleet_percentiles(arr)
             for name, arr in h._asdict().items()}
     if recorder is not None:
         # final drain: one tick to queue the last ring snapshot, one

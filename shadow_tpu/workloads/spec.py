@@ -50,7 +50,7 @@ _I32_TIME_BUDGET = 2**31 - 1
 _MAX_BYTES = 2**24
 
 PATTERN_KINDS = ("ring_allreduce", "all_to_all", "incast", "rpc_fanout",
-                 "onoff")
+                 "onoff", "serve")
 
 
 class ScenarioError(ValueError):
@@ -104,6 +104,15 @@ class PatternSpec:
     on_hold_ns: int = 0
     off_mean_ns: int = 0
     off_alpha: float = 1.5
+    # serve only: open-loop arrival process (diurnal rate curve x
+    # bounded-Pareto burst sizes) from `count - servers` clients
+    # fanning into the first `servers` hosts of the range
+    servers: int = 1
+    mean_gap_ns: int = 0
+    diurnal_period_ns: int = 0
+    diurnal_amp: float = 0.0
+    burst_cap: int = 8
+    burst_alpha: float = 1.4
 
     def hosts(self) -> range:
         return range(self.first, self.first + self.count)
@@ -119,6 +128,45 @@ class PatternSpec:
                      on_hold_ns=self.on_hold_ns,
                      off_mean_ns=self.off_mean_ns,
                      off_alpha=self.off_alpha)
+        if self.kind == "serve":
+            d.update(servers=self.servers, mean_gap_ns=self.mean_gap_ns,
+                     diurnal_period_ns=self.diurnal_period_ns,
+                     diurnal_amp=self.diurnal_amp,
+                     burst_cap=self.burst_cap,
+                     burst_alpha=self.burst_alpha)
+        return d
+
+
+@dataclass(frozen=True)
+class ComputeSpec:
+    """The scenario's ``compute:`` block — the per-host service model
+    (`tpu/compute.py`): ``op`` names an entry of the checked-in
+    op-timing table (`workloads/op_timings.json`, validated at compile
+    time), ``queue_cap`` bounds the FIFO service queue."""
+
+    op: str
+    queue_cap: int = 64
+
+    def as_dict(self) -> dict:
+        return {"op": self.op, "queue_cap": self.queue_cap}
+
+
+@dataclass(frozen=True)
+class ServeSpec:
+    """The scenario's ``serve:`` block — SLO targets for the recorded
+    request-sojourn percentiles (docs/workloads.md "SLO record
+    schema"). Targets are optional; when present the record carries a
+    per-quantile ``met`` verdict next to the measured value."""
+
+    p99_ns: Optional[int] = None
+    p999_ns: Optional[int] = None
+
+    def as_dict(self) -> dict:
+        d: dict = {}
+        if self.p99_ns is not None:
+            d["p99_ns"] = self.p99_ns
+        if self.p999_ns is not None:
+            d["p999_ns"] = self.p999_ns
         return d
 
 
@@ -144,6 +192,8 @@ class ScenarioSpec:
     ingress_cap: int
     transport: str = "direct"  # direct | flows
     loss_p: float = 0.0  # uniform path-loss probability
+    compute: Optional[ComputeSpec] = None  # per-host service model
+    serve: Optional[ServeSpec] = None  # SLO targets for the record
     patterns: tuple[PatternSpec, ...] = field(default_factory=tuple)
 
     def as_dict(self) -> dict:
@@ -154,13 +204,18 @@ class ScenarioSpec:
             "ingress_cap": self.ingress_cap,
             "patterns": [p.as_dict() for p in self.patterns],
         }
-        # non-default transport/loss keys only: the canonical
-        # serialization (and therefore every existing fingerprint)
-        # must not change under a default-valued new field
+        # non-default transport/loss/compute/serve keys only: the
+        # canonical serialization (and therefore every existing
+        # fingerprint) must not change under a default-valued new
+        # field
         if self.transport != "direct":
             d["transport"] = self.transport
         if self.loss_p:
             d["loss_p"] = self.loss_p
+        if self.compute is not None:
+            d["compute"] = self.compute.as_dict()
+        if self.serve is not None:
+            d["serve"] = self.serve.as_dict()
         return d
 
 
@@ -180,6 +235,9 @@ def _parse_pattern(raw: Any, idx: int, n_hosts: int) -> PatternSpec:
     if kind == "onoff":
         known |= {"burst", "gap_ns", "on_hold_ns", "off_mean_ns",
                   "off_alpha"}
+    if kind == "serve":
+        known |= {"servers", "mean_gap_ns", "diurnal_period_ns",
+                  "diurnal_amp", "burst_cap", "burst_alpha"}
     unknown = set(map(str, raw)) - known
     if unknown:
         raise ScenarioError(
@@ -223,6 +281,26 @@ def _parse_pattern(raw: Any, idx: int, n_hosts: int) -> PatternSpec:
                                      hi=_I32_TIME_BUDGET // 4)
         kw["off_alpha"] = _req_float(raw, "off_alpha", where,
                                      default=1.5, lo=1.01, hi=10.0)
+    if kind == "serve":
+        kw["servers"] = _req_int(raw, "servers", where, default=1,
+                                 lo=1, hi=count - 1)
+        kw["mean_gap_ns"] = _req_int(raw, "mean_gap_ns", where,
+                                     default=5_000_000, lo=1,
+                                     hi=_I32_TIME_BUDGET // 4)
+        kw["diurnal_period_ns"] = _req_int(
+            raw, "diurnal_period_ns", where, default=0, lo=0,
+            hi=_I32_TIME_BUDGET)
+        kw["diurnal_amp"] = _req_float(raw, "diurnal_amp", where,
+                                       default=0.0, lo=0.0, hi=0.95)
+        kw["burst_cap"] = _req_int(raw, "burst_cap", where, default=8,
+                                   lo=1, hi=64)
+        kw["burst_alpha"] = _req_float(raw, "burst_alpha", where,
+                                       default=1.4, lo=1.01, hi=10.0)
+        if kw["diurnal_amp"] > 0 and kw["diurnal_period_ns"] == 0:
+            raise ScenarioError(
+                f"{where}: diurnal_amp={kw['diurnal_amp']} needs a "
+                "non-zero diurnal_period_ns (a rate curve with no "
+                "period is a constant)")
     return PatternSpec(kind=kind, first=first, count=count,
                        rounds=rounds, bytes=nbytes, **kw)
 
@@ -238,7 +316,7 @@ def parse_scenario(raw: Any, *, seed: Optional[int] = None) -> ScenarioSpec:
             f"scenario: expected a mapping, got {type(raw).__name__}")
     known = {"name", "family", "seed", "hosts", "windows", "window_ns",
              "egress_cap", "ingress_cap", "patterns", "transport",
-             "loss_p"}
+             "loss_p", "compute", "serve"}
     unknown = set(map(str, raw)) - known
     if unknown:
         raise ScenarioError(f"scenario: unknown option(s) "
@@ -280,12 +358,66 @@ def parse_scenario(raw: Any, *, seed: Optional[int] = None) -> ScenarioSpec:
             f"scenario: `transport: flows` needs window_ns >= 1ms "
             f"(got {window_ns}): the flow plane's RTO clock advances "
             "in whole milliseconds per window (tpu/flows.py)")
+    compute = None
+    raw_compute = raw.get("compute")
+    if raw_compute is not None:
+        if not isinstance(raw_compute, dict):
+            raise ScenarioError(
+                f"scenario.compute: expected a mapping, got "
+                f"{type(raw_compute).__name__}")
+        unknown = set(map(str, raw_compute)) - {"op", "queue_cap"}
+        if unknown:
+            raise ScenarioError(f"scenario.compute: unknown option(s) "
+                                f"{sorted(unknown)}")
+        op = raw_compute.get("op")
+        if not isinstance(op, str) or not op:
+            raise ScenarioError(
+                "scenario.compute: op is required (a non-empty name "
+                "from workloads/op_timings.json)")
+        compute = ComputeSpec(
+            op=op,
+            queue_cap=_req_int(raw_compute, "queue_cap",
+                               "scenario.compute", default=64, lo=1,
+                               hi=4096))
+    serve_spec = None
+    raw_serve = raw.get("serve")
+    if raw_serve is not None:
+        if not isinstance(raw_serve, dict):
+            raise ScenarioError(
+                f"scenario.serve: expected a mapping, got "
+                f"{type(raw_serve).__name__}")
+        unknown = set(map(str, raw_serve)) - {"p99_ns", "p999_ns"}
+        if unknown:
+            raise ScenarioError(f"scenario.serve: unknown option(s) "
+                                f"{sorted(unknown)}")
+        targets = {}
+        for key in ("p99_ns", "p999_ns"):
+            if raw_serve.get(key) is not None:
+                targets[key] = _req_int(raw_serve, key,
+                                        "scenario.serve", lo=1,
+                                        hi=_I32_TIME_BUDGET)
+        serve_spec = ServeSpec(**targets)
     raw_patterns = raw.get("patterns")
     if not isinstance(raw_patterns, list) or not raw_patterns:
         raise ScenarioError("scenario: patterns must be a non-empty "
                             "list")
     patterns = tuple(_parse_pattern(p, i, n_hosts)
                      for i, p in enumerate(raw_patterns))
+    if any(p.kind == "serve" for p in patterns):
+        # open-loop arrivals are meaningless without the service model
+        # they are measured against, and the server tier's single
+        # aggregate-dep phase is only deterministic when credits come
+        # from the flow plane's ACKED in-order count
+        if transport != "flows":
+            raise ScenarioError(
+                "scenario: serve patterns require `transport: flows` — "
+                "server phases credit ACKED in-order segments, not raw "
+                "deliveries (docs/workloads.md 'Serving load')")
+        if compute is None:
+            raise ScenarioError(
+                "scenario: serve patterns require a `compute:` block — "
+                "the open-loop arrival process is measured against the "
+                "host service model (docs/workloads.md 'Serving load')")
     # host ranges must not overlap: each host carries exactly one phase
     # program (the compiler's phase axis is per-host, docs/workloads.md)
     claimed: dict[int, int] = {}
@@ -306,7 +438,7 @@ def parse_scenario(raw: Any, *, seed: Optional[int] = None) -> ScenarioSpec:
         name=name, family=family, seed=spec_seed, n_hosts=n_hosts,
         windows=windows, window_ns=window_ns, egress_cap=egress_cap,
         ingress_cap=ingress_cap, transport=transport, loss_p=loss_p,
-        patterns=patterns)
+        compute=compute, serve=serve_spec, patterns=patterns)
 
 
 def load_scenario_file(path: str, *,

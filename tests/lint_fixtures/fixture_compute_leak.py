@@ -1,0 +1,47 @@
+"""SL501 seeded violation for the compute plane: a deliberately-broken
+mini window kernel that lets the compute plane's busy clock leak into
+the delivery timestamps — the exact class of bug the FULL-invisibility
+obligation ``window_step[compute]`` exists to catch (a compute plane
+that back-pressures the wire inside the kernel instead of composing
+through ``compute.gate_credits`` in the runner). `spec()` returns the
+InvisibilitySpec; the proof must FAIL naming both ends of the flow:
+``compute.busy_rel`` -> the delivered ``deliver_rel`` output leaf."""
+
+from typing import NamedTuple
+
+
+class MiniState(NamedTuple):
+    clock: object  # jax.Array at trace time
+
+
+class MiniCompute(NamedTuple):
+    busy_rel: object
+
+
+def _build():
+    import jax.numpy as jnp
+
+    def broken_step(state, compute):
+        # BAD: service backlog delays the wire's delivery instants —
+        # compute presence now perturbs simulation results
+        delivered = {
+            "deliver_rel": state.clock + compute.busy_rel,
+            "mask": jnp.ones((4,), bool),
+        }
+        new_state = state._replace(clock=state.clock + 1)
+        new_compute = compute._replace(
+            busy_rel=compute.busy_rel + 10)
+        return new_state, delivered, new_compute
+
+    state = MiniState(jnp.zeros((4,), jnp.int32))
+    compute = MiniCompute(jnp.zeros((4,), jnp.int32))
+    return broken_step, (state, compute)
+
+
+def spec():
+    from shadow_tpu.analysis.proofs import InvisibilitySpec
+
+    return InvisibilitySpec(
+        "broken_step[compute-leak]", "tests.lint_fixtures",
+        _build, tainted_args={1: "compute"},
+        protected=lambda idx, path: idx < 2)

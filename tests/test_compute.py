@@ -1,0 +1,393 @@
+"""Compute plane + serving workload family (docs/workloads.md
+"Serving load & the compute plane").
+
+Covers:
+- the presence contract at the kernel boundary: a world stepped with
+  the compute plane threaded is bitwise-identical on sim state and
+  the delivered stream to its no-compute twin (the runtime counterpart
+  of the SL501 FULL-invisibility obligation `window_step[compute]`);
+- presence-off parity at the corpus level: every pre-compute scenario
+  fingerprint is pinned byte-for-byte (spec.as_dict emits `compute:` /
+  `serve:` only when non-default);
+- the seeded arrival process: compile-determinism of the serve family,
+  end-to-end record determinism, and exact served/queued count pins;
+- bounded-FIFO semantics: closed-form completion, queue-overflow
+  refusal (tail trim) with exact counter pins, the queue_cap >= 1
+  refusal, and credit gating;
+- the service-table drift guard: the checked-in op-timing table is
+  content-addressed (sha256 pin) and unknown ops refuse at compile;
+- the analysis registry: the compute entries are registered across
+  SL2xx/SL501/SL601 with checked-in budgets, and a seeded compute
+  leak actually FIRES the invisibility checker.
+
+Heavy full-corpus cases are @slow (the serving-corpus CI step runs
+them unfiltered).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shadow_tpu.workloads import (ScenarioError, compile_program,
+                                  load_scenario_file, parse_scenario,
+                                  program_digest, scenario_fingerprint)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+MS = 1_000_000
+
+#: sha256 of the checked-in op-timing table (workloads/op_timings.json)
+#: — the service-table drift guard. Any byte change to the table shifts
+#: every serve-family program digest, so it must be a DELIBERATE,
+#: golden-regenerating edit: update this pin and scenarios/GOLDEN.json
+#: in the same commit.
+OP_TIMINGS_SHA256 = \
+    "1de31c94fae9adac33a52cc5402ab770a023fba2524bb476a86c2a6be04bc0fe"
+
+#: scenario fingerprints of the PRE-compute corpus, pinned from
+#: scenarios/GOLDEN.json at the commit that introduced the compute
+#: plane: `compute:`/`serve:` default to absent in spec.as_dict, so
+#: these may never move when the subsystem evolves.
+PRE_COMPUTE_FINGERPRINTS = {
+    "all_to_all.yaml":
+        "94704010235100e64355918f4aa55703fdeed6a8bb47753b5c5bf9185cde5e71",
+    "incast.yaml":
+        "6fb9653e49ad596d3c746ff8dbc191e8861e9f8964d6444d7181e33cfef1030e",
+    "incast_lossy.yaml":
+        "dc51e65ccd762121ca6e7df6210c650d1a9d6b5bc4754269641c8b5391f8933d",
+    "mixed.yaml":
+        "1a5fcc4eec3f4bb3c5f9c569230c0c67c73f863ad7af3ae2fe8bc0cf0b3277bf",
+    "onoff.yaml":
+        "fcc4df627ee1fc12664fb457ea6c60e0cc732266ca4f0188b3c1307a14e546c0",
+    "ring_allreduce.yaml":
+        "55f3787aecb8fb022e5592b9ddb2e0e05509e8e818e7d7cbd81b333ca088b6a1",
+    "rpc_fanout.yaml":
+        "7bab9cc091be8e0e529399a625e5774659307327bd89550d80a3dd7ef18cc67c",
+    "rpc_fanout_lossy.yaml":
+        "90922436e925e86a5c723ba5a2aa39159ed1be80a5e43d1c96c58c60f2d94e93",
+}
+
+
+def _serve_raw(**kw):
+    raw = {"name": "serve-mini", "hosts": 6, "windows": 48,
+           "window_ns": 5 * MS, "egress_cap": 8, "ingress_cap": 32,
+           "transport": "flows", "seed": 3,
+           "compute": {"op": "embed_lookup", "queue_cap": 8},
+           "serve": {"p99_ns": 50 * MS},
+           "patterns": [{"kind": "serve", "count": 6, "servers": 1,
+                         "rounds": 2, "bytes": 512,
+                         "mean_gap_ns": 1 * MS, "burst_cap": 2,
+                         "burst_alpha": 1.4}]}
+    raw.update(kw)
+    return raw
+
+
+# -- presence parity at the kernel boundary --------------------------------
+
+
+def test_compute_presence_bitwise_invisible():
+    """Twin worlds, 4 windows: one threads the compute plane through
+    window_step, the twin does not. Sim state and the delivered dict
+    must match bitwise — the compute plane reads deliveries, it never
+    back-pressures the wire inside the kernel (credit gating composes
+    in the runner, `compute.gate_credits`)."""
+    import jax
+    import jax.numpy as jnp
+
+    from shadow_tpu.tpu import compute as cm
+    from shadow_tpu.tpu import profiling
+    from shadow_tpu.tpu.plane import window_step
+
+    world = profiling.build_world(32, warmup_windows=0)
+    params, key, window = world["params"], world["rng_root"], \
+        world["window"]
+    ct = cm.make_compute_tables(np.full((32, 1), 25_000, np.int32),
+                                queue_cap=16)
+
+    def run(with_compute):
+        state = profiling.build_world(32, warmup_windows=0)["state"]
+        cs = cm.make_compute_state(ct) if with_compute else None
+
+        @jax.jit
+        def step(st, cs, sh):
+            out = window_step(st, params, key, sh, window,
+                              rr_enabled=False,
+                              compute=((ct, cs) if with_compute
+                                       else None))
+            if with_compute:
+                return out[0], out[1], out[3]
+            return out[0], out[1], None
+
+        last_d = None
+        for r in range(4):
+            state, last_d, cs = step(
+                state, cs, jnp.int32(0 if r == 0 else int(window)))
+        return state, last_d, cs
+
+    a_state, a_d, _ = run(False)
+    b_state, b_d, cs = run(True)
+    for name, la, lb in zip(a_state._fields, a_state, b_state):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), name
+    for k in a_d:
+        assert np.array_equal(np.asarray(a_d[k]), np.asarray(b_d[k])), k
+    # the plane was actually live on the twin, not compiled out
+    assert int(np.asarray(cs.n_served).sum()) > 0
+
+
+def test_pre_compute_corpus_fingerprints_pinned():
+    """Presence-off parity at the corpus level: every existing
+    scenario's fingerprint is byte-unchanged by the compute subsystem
+    (spec.as_dict emits `compute:`/`serve:` only when set)."""
+    for fname, want in sorted(PRE_COMPUTE_FINGERPRINTS.items()):
+        spec = load_scenario_file(os.path.join(REPO, "scenarios", fname))
+        assert scenario_fingerprint(spec) == want, fname
+        assert "compute" not in spec.as_dict()
+        assert "serve" not in spec.as_dict()
+
+
+# -- spec + compile refusals -----------------------------------------------
+
+
+def test_serve_spec_validation():
+    with pytest.raises(ScenarioError, match="transport: flows"):
+        parse_scenario(_serve_raw(transport="direct"))
+    raw = _serve_raw()
+    del raw["compute"]
+    with pytest.raises(ScenarioError, match="compute"):
+        parse_scenario(raw)
+    with pytest.raises(ScenarioError, match="diurnal"):
+        parse_scenario(_serve_raw(patterns=[
+            {**_serve_raw()["patterns"][0], "diurnal_amp": 0.5}]))
+    with pytest.raises(ScenarioError, match="op"):
+        compile_program(parse_scenario(_serve_raw(
+            compute={"op": "not_a_real_op", "queue_cap": 8})))
+
+
+def test_queue_cap_refusal():
+    from shadow_tpu.tpu import compute as cm
+
+    with pytest.raises(ValueError, match="queue_cap"):
+        cm.make_compute_tables(np.zeros((4, 1), np.int32), queue_cap=0)
+    with pytest.raises(ScenarioError, match="queue_cap"):
+        parse_scenario(_serve_raw(
+            compute={"op": "embed_lookup", "queue_cap": 0}))
+
+
+def test_service_table_drift_guard():
+    """The op-timing table is content-addressed: compile-time service
+    costs come ONLY from the checked-in file, and this pin makes any
+    edit a deliberate golden-regenerating change."""
+    from shadow_tpu.workloads import serve
+
+    assert serve.op_timings_digest() == OP_TIMINGS_SHA256
+    # cost formula on the checked-in entries: fixed + per_kib * ceil
+    assert serve.op_service_ns("embed_lookup", 512) == 1800 + 120
+    assert serve.op_service_ns("embed_lookup", 1025) == 1800 + 2 * 120
+    assert serve.op_service_ns("attn_decode", 1024) == 21000 + 310
+    with pytest.raises(ScenarioError, match="op timing table"):
+        serve.op_service_ns("not_a_real_op", 64)
+
+
+# -- bounded FIFO semantics ------------------------------------------------
+
+
+def _delivered(n, ci, mask):
+    import jax.numpy as jnp
+
+    return {"mask": jnp.asarray(mask),
+            "src": jnp.zeros((n, ci), jnp.int32),
+            "seq": jnp.asarray(
+                np.tile(np.arange(ci, dtype=np.int32), (n, 1))),
+            "sock": jnp.zeros((n, ci), jnp.int32),
+            "bytes": jnp.full((n, ci), 512, jnp.int32),
+            "deliver_rel": jnp.zeros((n, ci), jnp.int32)}
+
+
+def test_queue_overflow_tail_trim_and_gating():
+    """8 simultaneous arrivals into a 4-deep queue at 4 ms service in a
+    10 ms window: 2 complete, 4 wait, the LAST 2 are refused — and the
+    credit gate releases exactly the served count."""
+    import jax.numpy as jnp
+
+    from shadow_tpu.tpu import compute as cm
+
+    ct = cm.make_compute_tables(np.full((2, 1), 4 * MS, np.int32),
+                                queue_cap=4)
+    cs = cm.make_compute_state(ct)
+    mask = np.zeros((2, 8), bool)
+    mask[0, :] = True
+    cs2 = cm.compute_step(ct, cs, _delivered(2, 8, mask),
+                          jnp.int32(0), jnp.int32(10 * MS))
+    assert np.asarray(cs2.n_served).tolist() == [2, 0]
+    assert np.asarray(cs2.n_overflow).tolist() == [2, 0]
+    assert np.asarray(cs2.q_depth).tolist() == [4, 0]
+    # refused arrivals never enter the backlog: busy ends when the 6
+    # admitted requests drain, not the 8 offered
+    assert int(np.asarray(cs2.busy_rel)[0]) == 6 * 4 * MS
+    cs3, got = cm.gate_credits(
+        cs2, jnp.asarray(np.array([8, 0], np.int32)))
+    assert np.asarray(got).tolist() == [2, 0]
+    assert np.asarray(cs3.n_granted).tolist() == [2, 0]
+    # the gate is cumulative: re-offering grants nothing new until
+    # more service completes
+    _, again = cm.gate_credits(cs3,
+                               jnp.asarray(np.array([8, 0], np.int32)))
+    assert np.asarray(again).tolist() == [0, 0]
+
+
+def test_zero_service_host_passes_credits_through():
+    """svc == 0 rows (clients, emission-only phases) serve instantly:
+    every arrival completes in its own window with no backlog, so the
+    credit gate passes the raw counts through bitwise-unchanged."""
+    import jax.numpy as jnp
+
+    from shadow_tpu.tpu import compute as cm
+
+    ct = cm.make_compute_tables(np.zeros((3, 1), np.int32), queue_cap=4)
+    cs = cm.make_compute_state(ct)
+    mask = np.zeros((3, 8), bool)
+    mask[0, :5] = True
+    mask[2, :7] = True
+    cs2 = cm.compute_step(ct, cs, _delivered(3, 8, mask),
+                          jnp.int32(0), jnp.int32(10 * MS))
+    assert np.asarray(cs2.n_served).tolist() == [5, 0, 7]
+    assert np.asarray(cs2.n_queued).tolist() == [0, 0, 0]
+    raw = jnp.asarray(np.array([5, 0, 7], np.int32))
+    _, got = cm.gate_credits(cs2, raw)
+    assert np.asarray(got).tolist() == [5, 0, 7]
+
+
+# -- seeded arrival process ------------------------------------------------
+
+
+def test_serve_compile_deterministic_and_seeded():
+    a = compile_program(parse_scenario(_serve_raw()))
+    b = compile_program(parse_scenario(_serve_raw()))
+    assert program_digest(a) == program_digest(b)
+    assert a.compute_service_ns is not None
+    assert a.compute_service_ns.dtype == np.int32
+    # a different seed draws a different arrival process
+    c = compile_program(parse_scenario(_serve_raw(seed=4)))
+    assert program_digest(a) != program_digest(c)
+    # the table is folded into the digest: same arrivals, different
+    # op => different program
+    d = compile_program(parse_scenario(_serve_raw(
+        compute={"op": "attn_decode", "queue_cap": 8})))
+    assert program_digest(a) != program_digest(d)
+
+
+def test_serve_record_deterministic_with_exact_counts():
+    """End-to-end: the mini serve scenario double-runs byte-identical,
+    completes, and pins its exact served/queued counts (the seeded
+    arrival process is part of the determinism contract)."""
+    from shadow_tpu.workloads import runner
+
+    a = runner.run_scenario(parse_scenario(_serve_raw()))
+    b = runner.run_scenario(parse_scenario(_serve_raw()))
+    assert json.dumps(a, sort_keys=True) == json.dumps(b,
+                                                      sort_keys=True)
+    assert a["all_done"]
+    assert a["compute"] == {"op": "embed_lookup", "queue_cap": 8,
+                            "served": 24, "queued": 12, "overflow": 0}
+    soj = a["slo"]["sojourn_ns"]
+    assert all(soj[q] >= 0 for q in ("p50", "p90", "p99", "p999"))
+    assert soj["p999"] >= soj["p99"] >= soj["p50"]
+    assert a["slo"]["targets"]["p99"]["met"] is True
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fname,served,queued", [
+    ("serve_diurnal.yaml", 186, 112),
+    ("serve_burst_lossy.yaml", 297, 203),
+])
+def test_serve_corpus_entry_pins(fname, served, queued):
+    """The checked-in serving corpus against GOLDEN.json plus exact
+    arrival-count pins — >0 queued proves the SLO histograms measure
+    real contention, not an idle queue."""
+    from shadow_tpu.workloads import runner
+
+    spec = load_scenario_file(os.path.join(REPO, "scenarios", fname))
+    rec = runner.run_scenario(spec)
+    golden = runner.load_golden(
+        os.path.join(REPO, "scenarios", "GOLDEN.json"))
+    assert runner.check_against_golden(
+        [rec], {rec["name"]: golden[rec["name"]]}) == []
+    assert rec["all_done"]
+    assert rec["compute"]["served"] == served
+    assert rec["compute"]["queued"] == queued
+    assert rec["compute"]["overflow"] == 0
+    assert queued > 0
+    for q in ("p99", "p999"):
+        t = rec["slo"]["targets"][q]
+        assert t["measured_ns"] <= t["target_ns"], (q, t)
+
+
+# -- analysis registry -----------------------------------------------------
+
+
+def test_compute_entries_registered_with_budgets():
+    """The compute plane is on every proof surface: SL2xx audit
+    entries, the SL501 obligation, the SL601 cost entry — and both
+    budget ledgers carry the checked-in rows (regenerating budgets can
+    never silently drop them)."""
+    from shadow_tpu.analysis import costmodel, jaxpr_audit, proofs
+
+    names = {f"{e.module}:{e.name}"
+             for e in jaxpr_audit.default_entries()}
+    assert "shadow_tpu.tpu.plane:window_step[compute]" in names
+    assert "shadow_tpu.tpu.plane:chain_windows[compute]" in names
+    assert "shadow_tpu.tpu.compute:compute_step" in names
+    specs = {s.name for s in proofs.invisibility_specs()}
+    assert "window_step[compute]" in specs
+    cost_keys = {e.key for e in costmodel.default_cost_entries()}
+    assert "shadow_tpu.tpu.plane:window_step[compute]" in cost_keys
+    with open(os.path.join(
+            REPO, "shadow_tpu", "analysis", "op_budgets.json"),
+            encoding="utf-8") as fh:
+        budgets = json.load(fh)["budgets"]
+    for key in ("shadow_tpu.tpu.plane:window_step[compute]",
+                "shadow_tpu.tpu.plane:chain_windows[compute]",
+                "shadow_tpu.tpu.compute:compute_step"):
+        assert key in budgets, key
+    with open(os.path.join(
+            REPO, "shadow_tpu", "analysis", "cost_budgets.json"),
+            encoding="utf-8") as fh:
+        cost = json.load(fh)["platforms"]
+    assert any("shadow_tpu.tpu.plane:window_step[compute]" in v
+               for v in cost.values())
+
+
+def test_compute_leak_fixture_fires_sl501():
+    """The obligation has teeth: a seeded compute->wire leak (busy
+    clock added to delivery instants) FAILS the invisibility proof
+    naming both ends of the flow."""
+    import importlib.util
+
+    from shadow_tpu.analysis import proofs
+
+    spec = importlib.util.spec_from_file_location(
+        "fixture_compute_leak",
+        os.path.join(FIXTURES, "fixture_compute_leak.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    findings = proofs.check_invisibility(mod.spec())
+    assert findings and findings[0].rule == "SL501"
+    assert "busy_rel" in findings[0].message
+    assert "deliver_rel" in findings[0].message
+
+
+@pytest.mark.slow
+def test_compute_invisibility_proof_holds():
+    """The real kernel passes its SL501 obligation (the gating CI proof
+    step runs the full surface; this pins the compute spec alone)."""
+    from shadow_tpu.analysis import proofs
+
+    spec = [s for s in proofs.invisibility_specs()
+            if s.name == "window_step[compute]"]
+    assert len(spec) == 1
+    assert proofs.check_invisibility(spec[0]) == []

@@ -299,6 +299,53 @@ def trace_delta(before_path: str, after_path: str) -> int:
     return 0
 
 
+def _slo_report(path: str) -> tuple[dict | None, dict]:
+    """Load a run_scenarios.py --slo-report file -> (backend
+    fingerprint, scenario name -> {compute, slo} dict)."""
+    with open(path) as fh:
+        rec = json.load(fh)
+    return rec.get("backend"), dict(rec.get("scenarios") or {})
+
+
+def slo_delta(before_path: str, after_path: str) -> int:
+    """Print per-scenario serving-SLO deltas between two
+    run_scenarios.py --slo-report files (informational — always exits
+    0): request-sojourn/wait percentiles in virtual ms plus the
+    compute-plane served/queued/overflow totals. The values are
+    VIRTUAL time (deterministic), so no backend banner — but a missed
+    SLO target in the AFTER record is called out per scenario
+    (docs/workloads.md 'SLO record schema')."""
+    _, s0 = _slo_report(before_path)
+    _, s1 = _slo_report(after_path)
+
+    def table(pick, label, unit="ms"):
+        t0 = {k: pick(v) for k, v in s0.items() if pick(v) is not None}
+        t1 = {k: pick(v) for k, v in s1.items() if pick(v) is not None}
+        if t0 or t1:
+            _delta_table(f"scenario ({label})", t0, t1, width=32,
+                         unit=unit)
+            print()
+
+    for q in ("p99", "p999"):
+        table(lambda v, q=q: (v["slo"]["sojourn_ns"].get(q, 0) / 1e6
+                              if "slo" in v else None),
+              f"sojourn {q}")
+        table(lambda v, q=q: (v["slo"]["wait_ns"].get(q, 0) / 1e6
+                              if "slo" in v else None),
+              f"wait {q}")
+    for metric in ("served", "queued", "overflow"):
+        table(lambda v, m=metric: (v.get("compute") or {}).get(m),
+              metric, unit="count")
+    missed = [(name, q, t)
+              for name, v in sorted(s1.items())
+              for q, t in (v.get("slo", {}).get("targets") or {}).items()
+              if not t.get("met", True)]
+    for name, q, t in missed:
+        print(f"SLO MISS (after): {name} {q} measured "
+              f"{t['measured_ns']} ns > target {t['target_ns']} ns")
+    return 0
+
+
 def _cost_metrics(path: str) -> tuple[str | None, dict]:
     """Load a shadowlint --cost-report record -> (platform key,
     entry short-name -> metrics dict)."""
@@ -387,12 +434,20 @@ def main(argv=None) -> int:
              "ledgers; loud banner when the backend fingerprints "
              "differ) instead of running the determinism harness",
     )
+    ap.add_argument(
+        "--slo", nargs=2, metavar=("BEFORE", "AFTER"), default=None,
+        help="diff two tools/run_scenarios.py --slo-report files "
+             "(per-scenario serving sojourn/wait percentile + "
+             "compute-plane totals deltas; SLO misses in the AFTER "
+             "record are called out) instead of running the "
+             "determinism harness",
+    )
     args = ap.parse_args(argv)
     modes = [m for m in (args.bench, args.scenarios, args.cost,
-                         args.memo, args.trace)
+                         args.memo, args.trace, args.slo)
              if m is not None]
     if len(modes) > 1:
-        ap.error("--bench/--scenarios/--cost/--memo/--trace are "
+        ap.error("--bench/--scenarios/--cost/--memo/--trace/--slo are "
                  "mutually exclusive")
     if args.bench is not None:
         if args.config or args.matrix or args.runs is not None:
@@ -418,6 +473,11 @@ def main(argv=None) -> int:
             ap.error("--trace takes exactly two trace reports and no "
                      "config")
         return trace_delta(*args.trace)
+    if args.slo is not None:
+        if args.config or args.matrix or args.runs is not None:
+            ap.error("--slo takes exactly two slo reports and no "
+                     "config")
+        return slo_delta(*args.slo)
     if args.config is None:
         ap.error("config is required (or use --bench)")
     if args.matrix and args.runs is not None:

@@ -147,6 +147,12 @@ def main(argv=None) -> int:
                          "gate's deterministic preemption; needs "
                          "--checkpoint-dir, R a multiple of "
                          "--checkpoint-every)")
+    ap.add_argument("--slo-report", default=None, metavar="PATH",
+                    help="write the per-scenario SLO summary (compute-"
+                         "plane totals + wait/sojourn percentiles + "
+                         "target verdicts, scenarios with a `compute:` "
+                         "block only) + the backend fingerprint as "
+                         "JSON — the compare_runs --slo artifact")
     ap.add_argument("--memo-cache", default=None, metavar="DIR",
                     help="persist the memo cache across invocations: "
                          "DIR/<name>.memo.npz is loaded before and "
@@ -408,6 +414,26 @@ def main(argv=None) -> int:
                       fh, sort_keys=True, indent=1)
             fh.write("\n")
         print(f"run_scenarios: memo report -> {args.memo_report}",
+              file=sys.stderr)
+
+    if args.slo_report:
+        # the serving-SLO artifact (compare_runs --slo): compute-plane
+        # totals + percentile/target blocks per scenario, stamped with
+        # the backend fingerprint like every cross-run report (the
+        # values are virtual-time ints — byte-stable — but the stamp
+        # keeps the artifact family uniform)
+        import bench
+
+        slo_summary = {
+            rec["name"]: {"compute": rec["compute"], "slo": rec["slo"]}
+            for rec in records if "slo" in rec}
+        with open(args.slo_report, "w") as fh:
+            json.dump({"backend": bench.backend_fingerprint(),
+                       "scenarios": slo_summary},
+                      fh, sort_keys=True, indent=1)
+            fh.write("\n")
+        print(f"run_scenarios: slo report -> {args.slo_report} "
+              f"({len(slo_summary)} scenario(s) with a compute plane)",
               file=sys.stderr)
 
     if args.trace_report:

@@ -162,9 +162,57 @@ def _print_ensemble(rep: dict) -> None:
                   f"(n={bars['worlds']})")
 
 
+def slo_table(path: str) -> int:
+    """Print a run_scenarios --slo-report file as per-quantile
+    target-vs-measured tables, reusing compare_runs' shared
+    `_delta_table` (before = the scenario's declared SLO target, after
+    = the measured percentile; the ratio column is the headroom), plus
+    the compute-plane served/queued/overflow totals."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from compare_runs import _delta_table
+
+    with open(path) as fh:
+        rec = json.load(fh)
+    scenarios = dict(rec.get("scenarios") or {})
+    if not scenarios:
+        print("telemetry_report: no compute-plane scenarios in "
+              f"{path}", file=sys.stderr)
+        return 1
+    for q in ("p99", "p999"):
+        targets = {}
+        measured = {}
+        for name, v in scenarios.items():
+            t = (v.get("slo", {}).get("targets") or {}).get(q)
+            if t is not None:
+                targets[name] = t["target_ns"] / 1e6
+                measured[name] = t["measured_ns"] / 1e6
+            elif q in v.get("slo", {}).get("sojourn_ns", {}):
+                measured[name] = v["slo"]["sojourn_ns"][q] / 1e6
+        if targets or measured:
+            print(f"sojourn {q} — SLO target (before) vs measured "
+                  "(after); ratio = headroom:")
+            _delta_table("scenario", targets, measured, width=32)
+            print()
+    totals = {m: {n: (v.get("compute") or {}).get(m)
+                  for n, v in scenarios.items()
+                  if (v.get("compute") or {}).get(m) is not None}
+              for m in ("served", "queued", "overflow")}
+    for m, t in totals.items():
+        if t:
+            _delta_table(f"scenario ({m})", t, t, width=32,
+                         unit="count")
+            print()
+    missed = [(n, q) for n, v in sorted(scenarios.items())
+              for q, t in (v.get("slo", {}).get("targets") or {}).items()
+              if not t.get("met", True)]
+    for n, q in missed:
+        print(f"SLO MISS: {n} {q}")
+    return 1 if missed else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("jsonl", metavar="PATH", nargs="+",
+    ap.add_argument("jsonl", metavar="PATH", nargs="*",
                     help="heartbeat JSONL (or a shadow log; '-' = "
                          "stdin); with --ensemble, one stream per "
                          "world")
@@ -189,7 +237,21 @@ def main(argv=None) -> int:
                          "tools/plot_shadow.py")
     ap.add_argument("--top", type=int, default=10,
                     help="top talkers to list (default 10)")
+    ap.add_argument("--slo", metavar="REPORT", default=None,
+                    help="print a run_scenarios --slo-report file as "
+                         "per-quantile target-vs-measured tables "
+                         "(compare_runs' shared delta-table shape); "
+                         "exit 1 on any missed SLO target")
     args = ap.parse_args(argv)
+
+    if args.slo is not None:
+        if args.jsonl or args.ensemble:
+            print("telemetry_report: --slo takes the report path only "
+                  "(no heartbeat streams)", file=sys.stderr)
+            return 2
+        return slo_table(args.slo)
+    if not args.jsonl:
+        ap.error("heartbeat PATH required (or --slo REPORT)")
 
     if args.ensemble:
         if len(args.jsonl) < 2:
